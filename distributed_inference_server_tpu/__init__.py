@@ -1,0 +1,32 @@
+"""distributed_inference_server_tpu — a TPU-native distributed LLM inference framework.
+
+A ground-up rebuild of the capability surface of the reference Rust serving stack
+(`its-me-ojas/distributed-inference-server`), designed TPU-first:
+
+- Model execution: JAX/XLA via jit + shard_map over explicit device meshes, with
+  Pallas/Mosaic kernels for the hot ops (paged attention, RMSNorm, RoPE, dequant-matmul).
+- Serving layer: priority queueing with backpressure hysteresis, windowed admission
+  batching feeding a continuous-batching engine, adaptive scheduling over engine
+  replicas, SSE token streaming, Prometheus metrics, config precedence.
+- KV cache: paged, block-allocated in HBM with prefix reuse and LRU page reclamation.
+- Parallelism: TP over ICI, expert parallelism, pipeline stages, and context-parallel
+  (ring attention) prefill — absent from the reference, first-class here.
+
+Layer map mirrors SURVEY.md §1 (reference layers L1–L5):
+
+- ``core``     — L4 request processing: types, errors, API models, validator, queue.
+- ``models``   — JAX model zoo (Llama, Mixtral-style MoE) + weight loading.
+- ``ops``      — Pallas TPU kernels and jnp reference ops (attention, norms, sampling).
+- ``engine``   — L2/L3: paged KV cache, continuous batching engine, batcher, scheduler.
+- ``parallel`` — device meshes, sharding rules, ring attention, collectives.
+- ``serving``  — L5/L1: HTTP/SSE front-end, streamer, metrics, config, orchestration.
+- ``native``   — C++ runtime components (queue, page allocator) behind ctypes.
+- ``utils``    — tracing, logging, misc.
+"""
+
+__version__ = "0.1.0"
+
+from distributed_inference_server_tpu.core import (  # noqa: F401
+    Priority,
+    new_request_id,
+)

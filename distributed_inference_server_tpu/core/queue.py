@@ -1,0 +1,202 @@
+"""Priority queue manager with backpressure hysteresis.
+
+Behavioral parity with reference ``crates/core/src/queue.rs``: three FIFO
+queues (High/Normal/Low) drained in strict priority order
+(``queue.rs:130-158``), hysteresis backpressure — reject above the high
+watermark (default 1000), resume below the low watermark (default 500)
+(``queue.rs:235-249``), an absolute cap (default 2000, ``queue.rs:110-113``),
+and timeout expiry sweeps (default 30s, ``queue.rs:198-226``).
+
+Conformance Properties 6-8 (design.md:716-732).
+
+Differences from the reference, deliberate:
+
+- Thread-safe: guarded by a lock so the asyncio front-end, the engine thread,
+  and the sweeper can share it (the reference relies on Rust ownership and a
+  single tokio task).
+- ``remove_expired`` is a single O(n) rebuild per queue rather than the
+  reference's O(n^2) ``VecDeque::remove`` loop (flagged in SURVEY.md §3.5).
+- A C++ implementation with the same contract lives in ``native/`` for the
+  C++ serving layer; this module is the canonical semantics both are tested
+  against.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field as dc_field
+from typing import Deque, Dict, Generic, List, Optional, TypeVar
+
+from distributed_inference_server_tpu.core.errors import QueueFull
+from distributed_inference_server_tpu.core.types import Priority, RequestId
+
+T = TypeVar("T")
+
+
+@dataclass(frozen=True)
+class QueueConfig:
+    """Queue manager configuration (reference queue.rs:12-33)."""
+
+    high_watermark: int = 1000
+    low_watermark: int = 500
+    request_timeout_s: float = 30.0
+    max_queue_size: int = 2000
+
+
+@dataclass(frozen=True)
+class QueueDepth:
+    """Queue depth statistics by priority (reference queue.rs:36-42)."""
+
+    high: int = 0
+    normal: int = 0
+    low: int = 0
+    total: int = 0
+
+
+@dataclass
+class QueuedRequest(Generic[T]):
+    """A queued request with metadata (reference queue.rs:45-67)."""
+
+    id: RequestId
+    data: T
+    priority: Priority = Priority.NORMAL
+    enqueued_at: float = dc_field(default_factory=time.monotonic)
+
+    def is_expired(self, timeout_s: float, now: Optional[float] = None) -> bool:
+        """True if the request has waited longer than ``timeout_s``
+        (reference queue.rs:64-66)."""
+        now = time.monotonic() if now is None else now
+        return (now - self.enqueued_at) > timeout_s
+
+
+class PriorityQueueManager(Generic[T]):
+    """Three-level priority queue with hysteresis backpressure
+    (reference queue.rs:75-250)."""
+
+    def __init__(self, config: Optional[QueueConfig] = None):
+        self.config = config or QueueConfig()
+        self._queues: Dict[Priority, Deque[QueuedRequest[T]]] = {
+            Priority.HIGH: deque(),
+            Priority.NORMAL: deque(),
+            Priority.LOW: deque(),
+        }
+        self._backpressure_active = False
+        self._lock = threading.Lock()
+
+    # -- admission ---------------------------------------------------------
+
+    def enqueue(self, request: QueuedRequest[T]) -> None:
+        """Enqueue a request; raises ``QueueFull`` while backpressure is
+        active or the absolute cap is reached (reference queue.rs:103-126)."""
+        with self._lock:
+            if self._backpressure_active:
+                raise QueueFull()
+            if self._total() >= self.config.max_queue_size:
+                raise QueueFull()
+            self._queues[request.priority].append(request)
+            self._update_backpressure()
+
+    # -- draining ----------------------------------------------------------
+
+    def dequeue_batch(self, max_count: int) -> List[QueuedRequest[T]]:
+        """Dequeue up to ``max_count`` requests: all available High first,
+        then Normal, then Low; FIFO within a level (reference
+        queue.rs:130-158; Property 6)."""
+        batch: List[QueuedRequest[T]] = []
+        with self._lock:
+            for level in (Priority.HIGH, Priority.NORMAL, Priority.LOW):
+                q = self._queues[level]
+                while len(batch) < max_count and q:
+                    batch.append(q.popleft())
+            self._update_backpressure()
+        return batch
+
+    def dequeue_one(self) -> Optional[QueuedRequest[T]]:
+        """Dequeue the single highest-priority request
+        (reference queue.rs:161-170)."""
+        with self._lock:
+            for level in (Priority.HIGH, Priority.NORMAL, Priority.LOW):
+                q = self._queues[level]
+                if q:
+                    req = q.popleft()
+                    self._update_backpressure()
+                    return req
+            self._update_backpressure()
+            return None
+
+    # -- introspection -----------------------------------------------------
+
+    def queue_depth(self) -> QueueDepth:
+        """Current depths by priority (reference queue.rs:173-180)."""
+        with self._lock:
+            h = len(self._queues[Priority.HIGH])
+            n = len(self._queues[Priority.NORMAL])
+            l = len(self._queues[Priority.LOW])
+            return QueueDepth(high=h, normal=n, low=l, total=h + n + l)
+
+    def is_accepting(self) -> bool:
+        """False while backpressure is active (reference queue.rs:183-185)."""
+        with self._lock:
+            return not self._backpressure_active
+
+    def total_depth(self) -> int:
+        with self._lock:
+            return self._total()
+
+    def is_empty(self) -> bool:
+        with self._lock:
+            return self._total() == 0
+
+    # -- maintenance -------------------------------------------------------
+
+    def remove_expired(self, now: Optional[float] = None) -> List[QueuedRequest[T]]:
+        """Remove and return all requests older than the configured timeout,
+        preserving FIFO order of survivors (reference queue.rs:198-226;
+        Property 8). O(n) rebuild instead of the reference's O(n^2) removal."""
+        timeout = self.config.request_timeout_s
+        now = time.monotonic() if now is None else now
+        expired: List[QueuedRequest[T]] = []
+        with self._lock:
+            for level in (Priority.HIGH, Priority.NORMAL, Priority.LOW):
+                q = self._queues[level]
+                survivors = deque()
+                while q:
+                    req = q.popleft()
+                    if req.is_expired(timeout, now):
+                        expired.append(req)
+                    else:
+                        survivors.append(req)
+                self._queues[level] = survivors
+            self._update_backpressure()
+        return expired
+
+    def cancel(self, request_id: RequestId) -> Optional[QueuedRequest[T]]:
+        """Remove a specific queued request by id (client disconnect before
+        dispatch). Returns the removed request, or None if not queued."""
+        with self._lock:
+            for level in (Priority.HIGH, Priority.NORMAL, Priority.LOW):
+                q = self._queues[level]
+                for i, req in enumerate(q):
+                    if req.id == request_id:
+                        del q[i]
+                        self._update_backpressure()
+                        return req
+            return None
+
+    # -- internals ---------------------------------------------------------
+
+    def _total(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _update_backpressure(self) -> None:
+        """Hysteresis: activate above high watermark, release below low
+        watermark (reference queue.rs:235-249; Property 7)."""
+        total = self._total()
+        if self._backpressure_active:
+            if total < self.config.low_watermark:
+                self._backpressure_active = False
+        else:
+            if total > self.config.high_watermark:
+                self._backpressure_active = True

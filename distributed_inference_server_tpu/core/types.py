@@ -1,0 +1,80 @@
+"""Core identifier types and priority levels.
+
+Behavioral parity with reference ``crates/core/src/types.rs:7-28``:
+UUID-valued request/batch/worker IDs, a token-sequence cache key, and a
+three-level priority ordering with ``NORMAL`` as the default.
+
+TPU-native notes: IDs are plain strings (UUID4 hex) so they cross the
+Python/C++/JSON boundaries without a dependency; ``CacheKey`` is a tuple of
+token ids so it is hashable (the paged KV cache keys pages by token-prefix
+hash chains built from these).
+"""
+
+from __future__ import annotations
+
+import enum
+import uuid
+from typing import Tuple
+
+# Unique identifier for an inference request (reference: types.rs:7).
+RequestId = str
+# Batches group multiple requests for efficient accelerator execution (types.rs:9).
+BatchId = str
+# Identifier for an engine/worker replica (types.rs:11).
+WorkerId = str
+# Token-sequence cache key: requests sharing a prefix can share KV pages
+# (reference: types.rs:13). Tuple (not list) so it can key dicts.
+CacheKey = Tuple[int, ...]
+
+
+def new_request_id() -> RequestId:
+    """Fresh UUID4 request id."""
+    return str(uuid.uuid4())
+
+
+def new_batch_id() -> BatchId:
+    """Fresh UUID4 batch id."""
+    return str(uuid.uuid4())
+
+
+def new_worker_id() -> WorkerId:
+    """Fresh UUID4 worker id."""
+    return str(uuid.uuid4())
+
+
+class Priority(enum.IntEnum):
+    """Request scheduling priority; higher values are served first.
+
+    Parity with reference ``types.rs:17-28`` (Low=0, Normal=1, High=2,
+    default Normal). Integer-valued so the C++ queue and the wire format
+    agree on ordering.
+    """
+
+    LOW = 0
+    NORMAL = 1
+    HIGH = 2
+
+    @classmethod
+    def default(cls) -> "Priority":
+        return cls.NORMAL
+
+    @classmethod
+    def parse(cls, value: object) -> "Priority":
+        """Parse a priority from JSON: accepts "low"/"normal"/"high" in any
+        case (the reference's serde accepts the Rust variant names
+        "Low"/"Normal"/"High"), or an integer level."""
+        if isinstance(value, Priority):
+            return value
+        if isinstance(value, bool):
+            raise ValueError(f"invalid priority: {value!r}")
+        if isinstance(value, int):
+            return cls(value)
+        if isinstance(value, str):
+            try:
+                return cls[value.upper()]
+            except KeyError:
+                raise ValueError(f"invalid priority: {value!r}") from None
+        raise ValueError(f"invalid priority: {value!r}")
+
+    def to_json(self) -> str:
+        return self.name.capitalize()

@@ -1,0 +1,143 @@
+"""Request validation against configurable limits.
+
+Behavioral parity with reference ``crates/core/src/validator.rs``:
+per-endpoint checks (empty prompt ``validator.rs:73-75``, context-window limit
+via the chars/4 approximation ``validator.rs:60-65``, max_tokens
+``validator.rs:87-95``, temperature ``validator.rs:98-108``, top_p
+``validator.rs:111-119``), chat message checks (``validator.rs:129-154``),
+per-input embeddings checks (``validator.rs:195-225``), and the
+``Validated[T]`` proof-of-validation wrapper (``validator.rs:31-39``).
+
+Conformance Properties 1-3 (design.md:686-701).
+
+The char-approximation token count is only the *admission* estimate; the
+engine re-counts with the real tokenizer after dequeue (the reference planned
+the same split — admission checks are cheap and tokenizer-free).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Generic, TypeVar
+
+from distributed_inference_server_tpu.core.errors import (
+    EmptyPrompt,
+    InvalidParameter,
+    MissingField,
+    TokenLimitExceeded,
+)
+from distributed_inference_server_tpu.core.models import (
+    ChatRequest,
+    EmbeddingsRequest,
+    GenerateRequest,
+)
+
+T = TypeVar("T")
+
+
+@dataclass(frozen=True)
+class ValidatorConfig:
+    """Limits for request validation (reference validator.rs:7-28)."""
+
+    max_context_tokens: int = 8192
+    max_output_tokens: int = 4096
+    min_temperature: float = 0.0
+    max_temperature: float = 2.0
+    min_top_p: float = 0.0
+    max_top_p: float = 1.0
+
+
+@dataclass(frozen=True)
+class Validated(Generic[T]):
+    """Proof-of-validation wrapper: downstream layers accept only
+    ``Validated[...]`` requests (reference validator.rs:31-39)."""
+
+    inner: T
+
+    def into_inner(self) -> T:
+        return self.inner
+
+
+class RequestValidator:
+    """Validates incoming requests against configured limits
+    (reference validator.rs:42-232)."""
+
+    def __init__(self, config: ValidatorConfig | None = None):
+        self.config = config or ValidatorConfig()
+
+    def token_count(self, text: str) -> int:
+        """Cheap admission-time token estimate: ceil(len/4), 0 for empty
+        (reference validator.rs:60-65)."""
+        if not text:
+            return 0
+        return (len(text) + 3) // 4
+
+    # -- shared parameter checks ------------------------------------------
+
+    def _check_sampling_params(
+        self, max_tokens: int, temperature: float, top_p: float
+    ) -> None:
+        cfg = self.config
+        # Negative max_tokens is unrepresentable in the reference (usize,
+        # models.rs:62); here it must be rejected explicitly.
+        if max_tokens < 0 or max_tokens > cfg.max_output_tokens:
+            raise InvalidParameter(
+                "max_tokens",
+                f"must be <= {cfg.max_output_tokens}, got {max_tokens}",
+            )
+        if not (cfg.min_temperature <= temperature <= cfg.max_temperature):
+            raise InvalidParameter(
+                "temperature",
+                f"must be between {cfg.min_temperature} and "
+                f"{cfg.max_temperature}, got {temperature}",
+            )
+        if not (cfg.min_top_p <= top_p <= cfg.max_top_p):
+            raise InvalidParameter(
+                "top_p",
+                f"must be between {cfg.min_top_p} and {cfg.max_top_p}, got {top_p}",
+            )
+
+    # -- endpoints ---------------------------------------------------------
+
+    def validate_generate(
+        self, request: GenerateRequest
+    ) -> Validated[GenerateRequest]:
+        """Validate a /generate request (reference validator.rs:68-122)."""
+        if not request.prompt.strip():
+            raise EmptyPrompt()
+        prompt_tokens = self.token_count(request.prompt)
+        if prompt_tokens > self.config.max_context_tokens:
+            raise TokenLimitExceeded(prompt_tokens, self.config.max_context_tokens)
+        self._check_sampling_params(
+            request.max_tokens, request.temperature, request.top_p
+        )
+        return Validated(request)
+
+    def validate_chat(self, request: ChatRequest) -> Validated[ChatRequest]:
+        """Validate a /chat request (reference validator.rs:125-191)."""
+        if not request.messages:
+            raise MissingField("messages")
+        if not any(m.content.strip() for m in request.messages):
+            raise EmptyPrompt()
+        total_tokens = sum(self.token_count(m.content) for m in request.messages)
+        if total_tokens > self.config.max_context_tokens:
+            raise TokenLimitExceeded(total_tokens, self.config.max_context_tokens)
+        self._check_sampling_params(
+            request.max_tokens, request.temperature, request.top_p
+        )
+        return Validated(request)
+
+    def validate_embeddings(
+        self, request: EmbeddingsRequest
+    ) -> Validated[EmbeddingsRequest]:
+        """Validate an /embeddings request (reference validator.rs:194-225)."""
+        inputs = request.input_list()
+        if not inputs:
+            raise MissingField("input")
+        for i, text in enumerate(inputs):
+            if not text.strip():
+                raise InvalidParameter(f"input[{i}]", "cannot be empty")
+            tokens = self.token_count(text)
+            if tokens > self.config.max_context_tokens:
+                raise TokenLimitExceeded(tokens, self.config.max_context_tokens)
+        return Validated(request)

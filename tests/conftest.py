@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Tests run on the XLA CPU backend with 8 virtual devices so TP/PP/EP/CP mesh
+code is exercised without TPU hardware (SURVEY.md §4.3). Must be set before
+jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,283 @@
+"""Conformance tests for the wire models.
+
+Ports the reference's property suite (``crates/core/src/models.rs:328-476``):
+serde round-trip properties for all response types at 100 cases each
+(**Property 25**, design.md:830-834), plus the SSE TokenEvent wire format
+(**Properties 13-15**, design.md:758-774), serde defaults
+(models.rs:294-304), and error-body shape (**Property 24**).
+"""
+
+import json
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from distributed_inference_server_tpu.core import (
+    ChatChoice,
+    ChatMessage,
+    ChatRequest,
+    ChatResponse,
+    EmbeddingData,
+    EmbeddingsRequest,
+    EmbeddingsResponse,
+    ErrorResponse,
+    FinishReason,
+    GenerateChoice,
+    GenerateRequest,
+    GenerateResponse,
+    InvalidJson,
+    Priority,
+    Role,
+    TokenEvent,
+    Usage,
+    dumps,
+    loads,
+)
+
+CASES = settings(max_examples=100, deadline=None)
+
+# -- generator strategies (mirroring models.rs:334-381) ----------------------
+
+arb_usage = st.builds(
+    Usage.of,
+    st.integers(min_value=0, max_value=100_000),
+    st.integers(min_value=0, max_value=100_000),
+)
+arb_finish = st.sampled_from(list(FinishReason))
+arb_role = st.sampled_from(list(Role))
+arb_text = st.text(max_size=200)
+arb_chat_message = st.builds(ChatMessage, role=arb_role, content=arb_text)
+
+arb_generate_response = st.builds(
+    GenerateResponse,
+    id=st.uuids().map(str),
+    object=st.just("text_completion"),
+    created=st.integers(min_value=0, max_value=2**40),
+    model=st.text(min_size=1, max_size=50),
+    choices=st.lists(
+        st.builds(
+            GenerateChoice,
+            text=arb_text,
+            index=st.integers(min_value=0, max_value=64),
+            finish_reason=arb_finish,
+        ),
+        max_size=4,
+    ).map(tuple),
+    usage=arb_usage,
+)
+
+arb_chat_response = st.builds(
+    ChatResponse,
+    id=st.uuids().map(str),
+    object=st.just("chat.completion"),
+    created=st.integers(min_value=0, max_value=2**40),
+    model=st.text(min_size=1, max_size=50),
+    choices=st.lists(
+        st.builds(
+            ChatChoice,
+            index=st.integers(min_value=0, max_value=64),
+            message=arb_chat_message,
+            finish_reason=arb_finish,
+        ),
+        max_size=4,
+    ).map(tuple),
+    usage=arb_usage,
+)
+
+arb_embeddings_response = st.builds(
+    EmbeddingsResponse,
+    object=st.just("list"),
+    data=st.lists(
+        st.builds(
+            EmbeddingData,
+            object=st.just("embedding"),
+            embedding=st.lists(
+                st.floats(
+                    allow_nan=False, allow_infinity=False, width=32, min_value=-10, max_value=10
+                ),
+                max_size=16,
+            ).map(tuple),
+            index=st.integers(min_value=0, max_value=64),
+        ),
+        max_size=4,
+    ).map(tuple),
+    model=st.text(min_size=1, max_size=50),
+    usage=arb_usage,
+)
+
+arb_error_response = st.builds(
+    ErrorResponse.of,
+    st.text(max_size=200),
+    st.sampled_from(
+        ["invalid_request_error", "rate_limit_error", "timeout_error", "server_error"]
+    ),
+    st.text(min_size=1, max_size=40),
+)
+
+
+# -- Property 25: response serialization round-trips -------------------------
+
+
+@CASES
+@given(arb_generate_response)
+def test_generate_response_roundtrip(resp):
+    assert loads(GenerateResponse, dumps(resp)) == resp
+
+
+@CASES
+@given(arb_chat_response)
+def test_chat_response_roundtrip(resp):
+    assert loads(ChatResponse, dumps(resp)) == resp
+
+
+@CASES
+@given(arb_embeddings_response)
+def test_embeddings_response_roundtrip(resp):
+    assert loads(EmbeddingsResponse, dumps(resp)) == resp
+
+
+@CASES
+@given(arb_error_response)
+def test_error_response_roundtrip(resp):
+    assert loads(ErrorResponse, dumps(resp)) == resp
+
+
+# -- Property 23/24: response shapes ----------------------------------------
+
+
+@CASES
+@given(arb_generate_response)
+def test_generate_response_shape(resp):
+    obj = json.loads(dumps(resp))
+    for key in ("id", "object", "created", "model", "choices", "usage"):
+        assert key in obj
+    assert isinstance(obj["created"], int)
+    for key in ("prompt_tokens", "completion_tokens", "total_tokens"):
+        assert key in obj["usage"]
+
+
+@CASES
+@given(arb_error_response)
+def test_error_response_shape(resp):
+    obj = json.loads(dumps(resp))
+    assert set(obj) == {"error"}
+    for key in ("message", "error_type", "code"):
+        assert key in obj["error"]
+
+
+# -- request parsing defaults (models.rs:294-304) ---------------------------
+
+
+def test_generate_request_defaults():
+    req = loads(GenerateRequest, '{"prompt": "hello"}')
+    assert req.max_tokens == 256
+    assert req.temperature == 1.0
+    assert req.top_p == 1.0
+    assert req.stop_sequences == []
+    assert req.stream is False
+    assert req.priority is None
+
+
+def test_generate_request_priority_parsing():
+    for wire in ("High", "high", "HIGH"):
+        req = loads(GenerateRequest, json.dumps({"prompt": "x", "priority": wire}))
+        assert req.priority == Priority.HIGH
+    with pytest.raises(InvalidJson):
+        loads(GenerateRequest, '{"prompt": "x", "priority": "urgent"}')
+
+
+def test_chat_request_parsing():
+    req = loads(
+        ChatRequest,
+        json.dumps(
+            {
+                "messages": [
+                    {"role": "system", "content": "be brief"},
+                    {"role": "user", "content": "hi"},
+                ],
+                "stream": True,
+            }
+        ),
+    )
+    assert req.messages[0].role == Role.SYSTEM
+    assert req.stream is True
+    assert req.max_tokens == 256
+
+
+def test_embeddings_untagged_input():
+    single = loads(EmbeddingsRequest, '{"input": "hello"}')
+    assert single.input_list() == ["hello"]
+    multi = loads(EmbeddingsRequest, '{"input": ["a", "b"]}')
+    assert multi.input_list() == ["a", "b"]
+    with pytest.raises(InvalidJson):
+        loads(EmbeddingsRequest, '{"input": 42}')
+
+
+def test_malformed_json_rejected():
+    with pytest.raises(InvalidJson):
+        loads(GenerateRequest, "{not json")
+
+
+def test_wrong_field_types_rejected():
+    # Strict-typed fields: the reference's serde rejects these with 400
+    # invalid_json; no truthiness coercion ("false" must not enable streaming).
+    bad = [
+        '{"prompt": "x", "max_tokens": null}',
+        '{"prompt": "x", "max_tokens": "many"}',
+        '{"prompt": "x", "max_tokens": true}',
+        '{"prompt": "x", "temperature": "hot"}',
+        '{"prompt": "x", "stream": "false"}',
+        '{"prompt": "x", "stop_sequences": "END"}',
+        '{"prompt": "x", "stop_sequences": [1, 2]}',
+        '{"prompt": 42}',
+    ]
+    for payload in bad:
+        with pytest.raises(InvalidJson):
+            loads(GenerateRequest, payload)
+    with pytest.raises(InvalidJson):
+        loads(ChatRequest, '{"messages": [{"role": "user", "content": "x"}], "stream": 1}')
+
+
+# -- Properties 13-15: SSE token event wire format --------------------------
+
+
+@CASES
+@given(
+    token=arb_text,
+    index=st.integers(min_value=0, max_value=10_000),
+    logprob=st.one_of(
+        st.none(), st.floats(allow_nan=False, allow_infinity=False, width=32)
+    ),
+)
+def test_token_event_format(token, index, logprob):
+    ev = TokenEvent.token_event(token, index, logprob)
+    obj = json.loads(dumps(ev))
+    assert obj["type"] == "token"
+    assert obj["token"] == token
+    assert obj["index"] == index
+    if logprob is None:
+        assert "logprob" not in obj  # skip_serializing_if (models.rs:275)
+    assert TokenEvent.from_dict(obj) == ev
+
+
+@CASES
+@given(finish=arb_finish, usage=arb_usage)
+def test_done_event_format(finish, usage):
+    ev = TokenEvent.done_event(finish, usage)
+    obj = json.loads(dumps(ev))
+    assert obj["type"] == "done"
+    assert obj["finish_reason"] in ("stop", "length", "stop_sequence")
+    assert set(obj["usage"]) == {"prompt_tokens", "completion_tokens", "total_tokens"}
+    assert TokenEvent.from_dict(obj) == ev
+
+
+@CASES
+@given(messages=arb_text, code=st.text(min_size=1, max_size=40))
+def test_error_event_format(messages, code):
+    ev = TokenEvent.error_event(messages, code)
+    obj = json.loads(dumps(ev))
+    assert obj["type"] == "error"
+    assert obj["messages"] == messages
+    assert obj["code"] == code
+    assert TokenEvent.from_dict(obj) == ev

@@ -1,0 +1,195 @@
+"""Conformance tests for the priority queue manager.
+
+The reference has **no** queue tests despite spec'ing Properties 6-8
+(design.md:716-732) — a gap SURVEY.md §4.1 calls out. This suite closes it:
+strict priority ordering with FIFO within a level (**Property 6**),
+backpressure hysteresis (**Property 7**), and timeout expiry (**Property 8**),
+plus the absolute cap (queue.rs:110-113).
+"""
+
+import itertools
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+import pytest
+
+from distributed_inference_server_tpu.core import (
+    Priority,
+    PriorityQueueManager,
+    QueueConfig,
+    QueueFull,
+    QueuedRequest,
+)
+
+CASES = settings(max_examples=100, deadline=None)
+
+arb_priority = st.sampled_from(list(Priority))
+
+
+def make(i, priority):
+    return QueuedRequest(id=f"req-{i}", data=i, priority=priority)
+
+
+# -- Property 6: strict priority order, FIFO within level --------------------
+
+
+@CASES
+@given(priorities=st.lists(arb_priority, max_size=50))
+def test_dequeue_order(priorities):
+    q = PriorityQueueManager(QueueConfig(high_watermark=10_000, max_queue_size=20_000))
+    for i, p in enumerate(priorities):
+        q.enqueue(make(i, p))
+    out = q.dequeue_batch(len(priorities) + 10)
+    assert len(out) == len(priorities)
+    # strict priority order: High block, then Normal, then Low
+    levels = [r.priority for r in out]
+    assert levels == sorted(levels, key=lambda p: -int(p))
+    # FIFO within each level
+    for level in Priority:
+        ids = [r.data for r in out if r.priority == level]
+        assert ids == sorted(ids)
+
+
+@CASES
+@given(
+    priorities=st.lists(arb_priority, min_size=1, max_size=50),
+    max_count=st.integers(min_value=0, max_value=60),
+)
+def test_dequeue_batch_size_cap(priorities, max_count):
+    q = PriorityQueueManager(QueueConfig(high_watermark=10_000, max_queue_size=20_000))
+    for i, p in enumerate(priorities):
+        q.enqueue(make(i, p))
+    out = q.dequeue_batch(max_count)
+    assert len(out) == min(max_count, len(priorities))
+    assert q.total_depth() == len(priorities) - len(out)
+
+
+def test_dequeue_one_priority():
+    q = PriorityQueueManager()
+    q.enqueue(make(0, Priority.LOW))
+    q.enqueue(make(1, Priority.HIGH))
+    q.enqueue(make(2, Priority.NORMAL))
+    assert q.dequeue_one().priority == Priority.HIGH
+    assert q.dequeue_one().priority == Priority.NORMAL
+    assert q.dequeue_one().priority == Priority.LOW
+    assert q.dequeue_one() is None
+
+
+# -- Property 7: backpressure hysteresis ------------------------------------
+
+
+def test_backpressure_hysteresis_cycle():
+    cfg = QueueConfig(high_watermark=10, low_watermark=5, max_queue_size=100)
+    q = PriorityQueueManager(cfg)
+    counter = itertools.count()
+
+    # fill to the high watermark: still accepting (activation is strict >)
+    for _ in range(10):
+        q.enqueue(make(next(counter), Priority.NORMAL))
+    assert q.is_accepting()
+    # cross the high watermark -> backpressure activates
+    q.enqueue(make(next(counter), Priority.NORMAL))
+    assert not q.is_accepting()
+    with pytest.raises(QueueFull):
+        q.enqueue(make(next(counter), Priority.NORMAL))
+
+    # drain to low watermark: still rejecting (release is strict <)
+    q.dequeue_batch(6)  # 11 -> 5
+    assert not q.is_accepting()
+    # below the low watermark -> accepting again
+    q.dequeue_batch(1)  # 5 -> 4
+    assert q.is_accepting()
+    q.enqueue(make(next(counter), Priority.NORMAL))
+
+
+@CASES
+@given(
+    ops=st.lists(
+        st.one_of(st.just("enq"), st.just("deq")), min_size=1, max_size=200
+    )
+)
+def test_backpressure_invariants(ops):
+    """After any op sequence: accepting implies depth could grow; rejecting
+    implies depth >= low watermark (hysteresis band invariant)."""
+    cfg = QueueConfig(high_watermark=20, low_watermark=10, max_queue_size=50)
+    q = PriorityQueueManager(cfg)
+    counter = itertools.count()
+    for op in ops:
+        if op == "enq":
+            try:
+                q.enqueue(make(next(counter), Priority.NORMAL))
+            except QueueFull:
+                pass
+        else:
+            q.dequeue_one()
+        depth = q.total_depth()
+        if depth > cfg.high_watermark:
+            assert not q.is_accepting()
+        if depth < cfg.low_watermark:
+            assert q.is_accepting()
+
+
+def test_absolute_cap():
+    cfg = QueueConfig(high_watermark=1000, low_watermark=500, max_queue_size=5)
+    q = PriorityQueueManager(cfg)
+    for i in range(5):
+        q.enqueue(make(i, Priority.NORMAL))
+    with pytest.raises(QueueFull):
+        q.enqueue(make(5, Priority.NORMAL))
+
+
+# -- Property 8: timeout expiry ---------------------------------------------
+
+
+def test_remove_expired():
+    cfg = QueueConfig(request_timeout_s=10.0)
+    q = PriorityQueueManager(cfg)
+    import time
+
+    now = time.monotonic()
+    old = QueuedRequest(id="old", data=0, priority=Priority.NORMAL,
+                        enqueued_at=now - 11.0)
+    fresh = QueuedRequest(id="fresh", data=1, priority=Priority.NORMAL,
+                          enqueued_at=now - 1.0)
+    high_old = QueuedRequest(id="high-old", data=2, priority=Priority.HIGH,
+                             enqueued_at=now - 30.0)
+    q.enqueue(old)
+    q.enqueue(fresh)
+    q.enqueue(high_old)
+    expired = q.remove_expired(now=now)
+    assert {r.id for r in expired} == {"old", "high-old"}
+    assert q.total_depth() == 1
+    assert q.dequeue_one().id == "fresh"
+
+
+def test_remove_expired_releases_backpressure():
+    import time
+
+    cfg = QueueConfig(
+        high_watermark=4, low_watermark=2, max_queue_size=100, request_timeout_s=10.0
+    )
+    q = PriorityQueueManager(cfg)
+    now = time.monotonic()
+    for i in range(5):
+        q.enqueue(
+            QueuedRequest(id=str(i), data=i, priority=Priority.NORMAL,
+                          enqueued_at=now - 60.0)
+        )
+    assert not q.is_accepting()
+    expired = q.remove_expired(now=now)
+    assert len(expired) == 5
+    assert q.is_accepting()
+
+
+# -- cancellation -----------------------------------------------------------
+
+
+def test_cancel_removes_specific_request():
+    q = PriorityQueueManager()
+    for i in range(3):
+        q.enqueue(make(i, Priority.NORMAL))
+    removed = q.cancel("req-1")
+    assert removed is not None and removed.data == 1
+    assert q.cancel("req-1") is None
+    remaining = [r.data for r in q.dequeue_batch(10)]
+    assert remaining == [0, 2]

@@ -1,0 +1,205 @@
+"""Conformance tests for request validation.
+
+Ports the reference's validator property suite
+(``crates/core/src/validator.rs:233-435``): valid-accepted, empty-rejected,
+out-of-range-rejected with field-name assertions, oversized-rejected, and
+token-count monotonicity — **Properties 1-3** (design.md:686-701).
+"""
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from distributed_inference_server_tpu.core import (
+    ChatMessage,
+    ChatRequest,
+    EmbeddingsRequest,
+    EmptyPrompt,
+    GenerateRequest,
+    InvalidParameter,
+    MissingField,
+    RequestValidator,
+    Role,
+    TokenLimitExceeded,
+    ValidatorConfig,
+)
+
+CASES = settings(max_examples=100, deadline=None)
+V = RequestValidator()
+
+# valid-input generators (mirroring validator.rs:243-302)
+valid_prompt = st.text(min_size=1, max_size=1000).filter(lambda s: s.strip())
+valid_temperature = st.floats(min_value=0.0, max_value=2.0, allow_nan=False)
+valid_top_p = st.floats(min_value=0.0, max_value=1.0, allow_nan=False)
+valid_max_tokens = st.integers(min_value=0, max_value=4096)
+
+# adversarial generators (validator.rs:305-330)
+blank_prompt = st.sampled_from(["", " ", "\t", "\n", "   \n\t "])
+bad_temperature = st.one_of(
+    st.floats(min_value=2.0001, max_value=100.0, allow_nan=False),
+    st.floats(min_value=-100.0, max_value=-0.0001, allow_nan=False),
+)
+bad_top_p = st.one_of(
+    st.floats(min_value=1.0001, max_value=100.0, allow_nan=False),
+    st.floats(min_value=-100.0, max_value=-0.0001, allow_nan=False),
+)
+oversized_prompt = st.integers(min_value=35_000, max_value=40_000).map(
+    lambda n: "x" * n
+)
+
+
+# -- Property 1: valid request acceptance ------------------------------------
+
+
+@CASES
+@given(
+    prompt=valid_prompt,
+    max_tokens=valid_max_tokens,
+    temperature=valid_temperature,
+    top_p=valid_top_p,
+)
+def test_valid_generate_accepted(prompt, max_tokens, temperature, top_p):
+    req = GenerateRequest(
+        prompt=prompt, max_tokens=max_tokens, temperature=temperature, top_p=top_p
+    )
+    validated = V.validate_generate(req)
+    assert validated.into_inner() is req
+
+
+# -- Property 2: invalid request rejection ----------------------------------
+
+
+@CASES
+@given(prompt=blank_prompt)
+def test_empty_prompt_rejected(prompt):
+    with pytest.raises(EmptyPrompt):
+        V.validate_generate(GenerateRequest(prompt=prompt))
+
+
+@CASES
+@given(prompt=valid_prompt, temperature=bad_temperature)
+def test_bad_temperature_rejected_with_field_name(prompt, temperature):
+    with pytest.raises(InvalidParameter) as e:
+        V.validate_generate(GenerateRequest(prompt=prompt, temperature=temperature))
+    assert e.value.field == "temperature"  # field-name assertion (validator.rs:377-383)
+
+
+@CASES
+@given(prompt=valid_prompt, top_p=bad_top_p)
+def test_bad_top_p_rejected_with_field_name(prompt, top_p):
+    with pytest.raises(InvalidParameter) as e:
+        V.validate_generate(GenerateRequest(prompt=prompt, top_p=top_p))
+    assert e.value.field == "top_p"
+
+
+@CASES
+@given(prompt=valid_prompt, max_tokens=st.integers(min_value=4097, max_value=100_000))
+def test_excess_max_tokens_rejected(prompt, max_tokens):
+    with pytest.raises(InvalidParameter) as e:
+        V.validate_generate(GenerateRequest(prompt=prompt, max_tokens=max_tokens))
+    assert e.value.field == "max_tokens"
+
+
+@CASES
+@given(prompt=valid_prompt, max_tokens=st.integers(min_value=-100_000, max_value=-1))
+def test_negative_max_tokens_rejected(prompt, max_tokens):
+    # unrepresentable in the reference (usize); must be rejected here
+    with pytest.raises(InvalidParameter) as e:
+        V.validate_generate(GenerateRequest(prompt=prompt, max_tokens=max_tokens))
+    assert e.value.field == "max_tokens"
+
+
+# -- Property 3: token limit enforcement ------------------------------------
+
+
+@CASES
+@given(prompt=oversized_prompt)
+def test_oversized_prompt_rejected(prompt):
+    with pytest.raises(TokenLimitExceeded) as e:
+        V.validate_generate(GenerateRequest(prompt=prompt))
+    assert e.value.actual > e.value.limit
+    assert e.value.limit == 8192
+
+
+@CASES
+@given(a=st.text(max_size=500), b=st.text(max_size=500))
+def test_token_count_monotonic(a, b):
+    # token_count(a + b) >= token_count(a) (validator.rs:422-433)
+    assert V.token_count(a + b) >= V.token_count(a)
+    assert V.token_count(a) == (0 if not a else (len(a) + 3) // 4)
+
+
+# -- chat validation (validator.rs:129-154) ---------------------------------
+
+
+def test_chat_empty_messages_rejected():
+    with pytest.raises(MissingField) as e:
+        V.validate_chat(ChatRequest(messages=[]))
+    assert e.value.field == "messages"
+
+
+def test_chat_all_blank_messages_rejected():
+    req = ChatRequest(
+        messages=[
+            ChatMessage(Role.USER, "  "),
+            ChatMessage(Role.ASSISTANT, "\n"),
+        ]
+    )
+    with pytest.raises(EmptyPrompt):
+        V.validate_chat(req)
+
+
+@CASES
+@given(contents=st.lists(valid_prompt, min_size=1, max_size=5))
+def test_chat_token_sum(contents):
+    req = ChatRequest(messages=[ChatMessage(Role.USER, c) for c in contents])
+    total = sum(V.token_count(c) for c in contents)
+    if total > 8192:
+        with pytest.raises(TokenLimitExceeded):
+            V.validate_chat(req)
+    else:
+        V.validate_chat(req)
+
+
+def test_chat_oversized_total_rejected():
+    msgs = [ChatMessage(Role.USER, "y" * 20_000), ChatMessage(Role.USER, "z" * 20_000)]
+    with pytest.raises(TokenLimitExceeded):
+        V.validate_chat(ChatRequest(messages=msgs))
+
+
+# -- embeddings validation (validator.rs:195-225) ---------------------------
+
+
+def test_embeddings_empty_list_rejected():
+    with pytest.raises(MissingField):
+        V.validate_embeddings(EmbeddingsRequest(input=[]))
+
+
+def test_embeddings_blank_item_rejected_with_index():
+    with pytest.raises(InvalidParameter) as e:
+        V.validate_embeddings(EmbeddingsRequest(input=["ok", "  "]))
+    assert e.value.field == "input[1]"
+
+
+def test_embeddings_oversized_item_rejected():
+    with pytest.raises(TokenLimitExceeded):
+        V.validate_embeddings(EmbeddingsRequest(input=["x" * 40_000]))
+
+
+@CASES
+@given(inputs=st.lists(valid_prompt.filter(lambda s: len(s) < 1000), min_size=1, max_size=4))
+def test_embeddings_valid_accepted(inputs):
+    validated = V.validate_embeddings(EmbeddingsRequest(input=inputs))
+    assert validated.into_inner().input_list() == inputs
+
+
+# -- custom config ----------------------------------------------------------
+
+
+def test_custom_config_limits():
+    v = RequestValidator(ValidatorConfig(max_context_tokens=10, max_output_tokens=5))
+    with pytest.raises(TokenLimitExceeded):
+        v.validate_generate(GenerateRequest(prompt="x" * 100))
+    with pytest.raises(InvalidParameter):
+        v.validate_generate(GenerateRequest(prompt="hi", max_tokens=6))
+    v.validate_generate(GenerateRequest(prompt="hi", max_tokens=5))
