@@ -97,9 +97,11 @@ class Usage:
     def from_dict(cls, obj: Dict[str, Any]) -> "Usage":
         obj = _expect_dict(obj, "usage")
         return cls(
-            prompt_tokens=int(_require(obj, "prompt_tokens")),
-            completion_tokens=int(_require(obj, "completion_tokens")),
-            total_tokens=int(_require(obj, "total_tokens")),
+            prompt_tokens=_as_int(_require(obj, "prompt_tokens"), "prompt_tokens"),
+            completion_tokens=_as_int(
+                _require(obj, "completion_tokens"), "completion_tokens"
+            ),
+            total_tokens=_as_int(_require(obj, "total_tokens"), "total_tokens"),
         )
 
 
@@ -305,7 +307,7 @@ class GenerateChoice:
         obj = _expect_dict(obj, "choice")
         return cls(
             text=str(_require(obj, "text")),
-            index=int(_require(obj, "index")),
+            index=_as_int(_require(obj, "index"), "index"),
             finish_reason=FinishReason.parse(_require(obj, "finish_reason")),
         )
 
@@ -338,7 +340,7 @@ class GenerateResponse:
         return cls(
             id=str(_require(obj, "id")),
             object=str(_require(obj, "object")),
-            created=int(_require(obj, "created")),
+            created=_as_int(_require(obj, "created"), "created"),
             model=str(_require(obj, "model")),
             choices=tuple(
                 GenerateChoice.from_dict(c) for c in _require(obj, "choices")
@@ -366,7 +368,7 @@ class ChatChoice:
     def from_dict(cls, obj: Dict[str, Any]) -> "ChatChoice":
         obj = _expect_dict(obj, "choice")
         return cls(
-            index=int(_require(obj, "index")),
+            index=_as_int(_require(obj, "index"), "index"),
             message=ChatMessage.from_dict(_require(obj, "message")),
             finish_reason=FinishReason.parse(_require(obj, "finish_reason")),
         )
@@ -400,7 +402,7 @@ class ChatResponse:
         return cls(
             id=str(_require(obj, "id")),
             object=str(_require(obj, "object")),
-            created=int(_require(obj, "created")),
+            created=_as_int(_require(obj, "created"), "created"),
             model=str(_require(obj, "model")),
             choices=tuple(ChatChoice.from_dict(c) for c in _require(obj, "choices")),
             usage=Usage.from_dict(_require(obj, "usage")),
@@ -428,8 +430,10 @@ class EmbeddingData:
         obj = _expect_dict(obj, "embedding data")
         return cls(
             object=str(_require(obj, "object")),
-            embedding=tuple(float(x) for x in _require(obj, "embedding")),
-            index=int(_require(obj, "index")),
+            embedding=tuple(
+                _as_float(x, "embedding") for x in _require(obj, "embedding")
+            ),
+            index=_as_int(_require(obj, "index"), "index"),
         )
 
 
@@ -587,8 +591,8 @@ class TokenEvent:
             logprob = obj.get("logprob")
             return cls.token_event(
                 token=str(_require(obj, "token")),
-                index=int(_require(obj, "index")),
-                logprob=None if logprob is None else float(logprob),
+                index=_as_int(_require(obj, "index"), "index"),
+                logprob=None if logprob is None else _as_float(logprob, "logprob"),
             )
         if kind == "done":
             return cls.done_event(

@@ -1,0 +1,152 @@
+"""Model architecture configurations.
+
+The reference planned to serve GGUF Llama-family checkpoints through
+llama.cpp (``design.md:7``, ``requirements.md:5`` [spec]); here the model
+zoo is native JAX. Configs are frozen (hashable) so they can be passed as
+static arguments to ``jax.jit``.
+
+``head_dim`` may differ from ``hidden_size // num_heads`` (e.g. Llama-3.2).
+``num_kv_heads < num_heads`` gives grouped-query attention.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3 style rope frequency scaling."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Dense transformer (Llama-family) architecture description."""
+
+    name: str = "unnamed"
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 8192
+    num_layers: int = 16
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 64
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    rope_scaling: Optional[RopeScaling] = None
+    tie_word_embeddings: bool = True
+    max_position_embeddings: int = 131072
+    # MoE (Mixtral-style); num_experts == 0 means dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def with_overrides(self, **kw) -> "ModelConfig":
+        return replace(self, **kw)
+
+
+# -- presets ----------------------------------------------------------------
+
+LLAMA_3_2_1B = ModelConfig(
+    name="llama-3.2-1b",
+    vocab_size=128256,
+    hidden_size=2048,
+    intermediate_size=8192,
+    num_layers=16,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=64,
+    rope_theta=500000.0,
+    rope_scaling=RopeScaling(factor=32.0, low_freq_factor=1.0,
+                             high_freq_factor=4.0, original_max_position=8192),
+    tie_word_embeddings=True,
+)
+
+LLAMA_3_8B = ModelConfig(
+    name="llama-3-8b",
+    vocab_size=128256,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=500000.0,
+    tie_word_embeddings=False,
+)
+
+LLAMA_3_70B = ModelConfig(
+    name="llama-3-70b",
+    vocab_size=128256,
+    hidden_size=8192,
+    intermediate_size=28672,
+    num_layers=80,
+    num_heads=64,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=500000.0,
+    tie_word_embeddings=False,
+)
+
+MIXTRAL_8X7B = ModelConfig(
+    name="mixtral-8x7b",
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=1e6,
+    tie_word_embeddings=False,
+    num_experts=8,
+    num_experts_per_tok=2,
+)
+
+# Tiny configs for tests: small enough to run on the CPU backend in ms.
+TINY = ModelConfig(
+    name="tiny",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    rope_theta=10000.0,
+    tie_word_embeddings=True,
+    max_position_embeddings=512,
+)
+
+TINY_MOE = TINY.with_overrides(name="tiny-moe", num_experts=4, num_experts_per_tok=2)
+
+PRESETS = {
+    c.name: c
+    for c in (LLAMA_3_2_1B, LLAMA_3_8B, LLAMA_3_70B, MIXTRAL_8X7B, TINY, TINY_MOE)
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    try:
+        return PRESETS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model preset {name!r}; known: {sorted(PRESETS)}"
+        ) from None

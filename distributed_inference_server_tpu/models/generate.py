@@ -1,0 +1,166 @@
+"""Single-sequence / static-batch generation over the dense KV cache.
+
+This is BASELINE.md config 1 (single-request greedy decode) and the
+correctness anchor for the continuous-batching engine: same model forward,
+simplest possible loop. The decode loop is fully on-device
+(``lax.while_loop`` under one jit) so benchmarking it measures the chip, not
+Python dispatch — the reference's per-token host loop (design.md:660-674
+[spec]) would bottleneck a TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_inference_server_tpu.models.configs import ModelConfig
+from distributed_inference_server_tpu.models.llama import KVCache, Params, forward
+from distributed_inference_server_tpu.ops.sampling import sample_tokens
+
+
+class GenerateResult(NamedTuple):
+    tokens: jnp.ndarray  # [B, max_new] generated ids (padded with pad_id)
+    lengths: jnp.ndarray  # [B] number of valid generated tokens
+    finished_eos: jnp.ndarray  # [B] bool: stopped on EOS (vs length)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "max_seq", "eos_ids"),
+    donate_argnames=(),
+)
+def generate(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,  # [B, T] right-padded prompts
+    prompt_lens: jnp.ndarray,  # [B]
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    max_new_tokens: int,
+    max_seq: int,
+    eos_ids: Tuple[int, ...] = (),
+) -> GenerateResult:
+    """Prefill + on-device decode loop. Returns generated tokens per row."""
+    B, T = input_ids.shape
+    cache = KVCache.create(cfg, B, max_seq, dtype=params["embed"].dtype)
+
+    # ---- prefill ----
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    in_prompt = positions < prompt_lens[:, None]
+    write_pos = jnp.where(in_prompt, positions, max_seq)  # drop padding writes
+    logits, cache = forward(
+        params, cfg, input_ids, positions, cache, write_pos, prompt_lens
+    )
+    # logits at the last *valid* prompt token per row
+    last_idx = jnp.maximum(prompt_lens - 1, 0)
+    next_logits = logits[jnp.arange(B), last_idx]  # [B, V]
+
+    eos_arr = (
+        jnp.asarray(eos_ids, dtype=jnp.int32)
+        if eos_ids
+        else jnp.full((1,), -1, jnp.int32)
+    )
+
+    class Carry(NamedTuple):
+        cache: KVCache
+        next_logits: jnp.ndarray
+        seq_lens: jnp.ndarray  # current cache length per row
+        out_tokens: jnp.ndarray  # [B, max_new]
+        out_len: jnp.ndarray  # [B]
+        done: jnp.ndarray  # [B] bool
+        done_eos: jnp.ndarray  # [B] bool: stopped specifically on EOS
+        rng: jax.Array
+        step: jnp.ndarray
+
+    def cond(c: Carry):
+        return jnp.logical_and(c.step < max_new_tokens, ~jnp.all(c.done))
+
+    def body(c: Carry):
+        rng, sub = jax.random.split(c.rng)
+        tokens = sample_tokens(sub, c.next_logits, temperature, top_p)  # [B]
+        is_eos = jnp.any(tokens[:, None] == eos_arr[None, :], axis=-1)
+        emit = ~c.done
+        out_tokens = c.out_tokens.at[jnp.arange(B), c.out_len].set(
+            jnp.where(emit, tokens, 0), mode="drop"
+        )
+        # EOS tokens are recorded as finishing, not emitted to the client
+        emit_token = emit & ~is_eos
+        out_len = c.out_len + emit_token.astype(jnp.int32)
+        done_eos = c.done_eos | (emit & is_eos)
+        done = c.done | (emit & is_eos)
+
+        # run one decode step for all rows (finished rows write then discard)
+        pos = c.seq_lens  # [B] next position
+        write = jnp.where(emit_token, pos, max_seq)[:, None]
+        logits, cache = forward(
+            params,
+            cfg,
+            tokens[:, None],
+            pos[:, None],
+            c.cache,
+            write,
+            c.seq_lens + emit_token.astype(jnp.int32),
+        )
+        seq_lens = c.seq_lens + emit_token.astype(jnp.int32)
+        done = done | (seq_lens >= max_seq) | (out_len >= max_new_tokens)
+        return Carry(
+            cache=cache,
+            next_logits=logits[:, 0],
+            seq_lens=seq_lens,
+            out_tokens=out_tokens,
+            out_len=out_len,
+            done=done,
+            done_eos=done_eos,
+            rng=rng,
+            step=c.step + 1,
+        )
+
+    init = Carry(
+        cache=cache,
+        next_logits=next_logits,
+        seq_lens=prompt_lens,
+        out_tokens=jnp.zeros((B, max_new_tokens), jnp.int32),
+        out_len=jnp.zeros((B,), jnp.int32),
+        done=prompt_lens <= 0,
+        done_eos=jnp.zeros((B,), bool),
+        rng=rng,
+        step=jnp.zeros((), jnp.int32),
+    )
+    final = lax.while_loop(cond, body, init)
+    return GenerateResult(
+        tokens=final.out_tokens, lengths=final.out_len, finished_eos=final.done_eos
+    )
+
+
+def greedy_generate(
+    params: Params,
+    cfg: ModelConfig,
+    prompt_ids,
+    max_new_tokens: int = 32,
+    max_seq: int = 256,
+    eos_ids: Tuple[int, ...] = (),
+) -> list:
+    """Convenience wrapper: greedy-decode one prompt (Python list of ids)."""
+    import numpy as np
+
+    ids = jnp.asarray([prompt_ids], jnp.int32)
+    lens = jnp.asarray([len(prompt_ids)], jnp.int32)
+    result = generate(
+        params,
+        cfg,
+        ids,
+        lens,
+        jax.random.PRNGKey(0),
+        jnp.zeros((1,)),
+        jnp.ones((1,)),
+        max_new_tokens,
+        max_seq,
+        eos_ids,
+    )
+    n = int(result.lengths[0])
+    return np.asarray(result.tokens[0, :n]).tolist()

@@ -1,0 +1,233 @@
+"""Llama-family transformer as pure JAX functions.
+
+This realizes the model-execution layer the reference left as a stub
+(``crates/inference/src/worker.rs:1``; llama.cpp was the planned backend,
+``design.md:7``, ``tasks.md:196-200`` [spec]) — natively in JAX/XLA.
+
+Design, TPU-first:
+
+- Parameters are a pytree of **stacked** per-layer weights (leading axis =
+  layer), and the forward pass runs layers with ``lax.scan`` — compile time
+  is O(1) in depth and XLA sees one fused block body.
+- Weights live in bf16 (MXU-native); RMSNorm statistics, softmax, and the
+  final logits are f32.
+- Linear weights are stored [in, out] so the hot path is plain ``x @ W``
+  (row-major MXU tiling), the transpose of the HF [out, in] layout.
+- Attention is pluggable: the block computes q/k/v and delegates cache
+  write + attention to an ``AttentionBackend`` (dense here; paged in
+  engine/kv_cache.py; Pallas kernels in ops/pallas/). All backends share the
+  (q_positions, kv_valid_len) ragged-batch contract of ops/attention.py.
+- MoE layers (Mixtral-style) route with top-k gating and compute every
+  expert on every token at small scale; the expert-parallel path in
+  parallel/ replaces this with all-to-all dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_inference_server_tpu.models.configs import ModelConfig
+from distributed_inference_server_tpu.ops.attention import gqa_attention
+from distributed_inference_server_tpu.ops.norms import rms_norm
+from distributed_inference_server_tpu.ops.rotary import apply_rope, rope_frequencies
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Parameter initialization
+# ---------------------------------------------------------------------------
+
+
+def init_params(
+    rng: jax.Array, cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random parameters with HF-compatible shapes (stacked per layer)."""
+    keys = jax.random.split(rng, 16)
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    std = 0.02
+
+    def w(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+    layers: Dict[str, jnp.ndarray] = {
+        "attn_norm": jnp.ones((L, H), dtype),
+        "wq": w(keys[0], (L, H, cfg.q_size)),
+        "wk": w(keys[1], (L, H, cfg.kv_size)),
+        "wv": w(keys[2], (L, H, cfg.kv_size)),
+        "wo": w(keys[3], (L, cfg.q_size, H)),
+        "mlp_norm": jnp.ones((L, H), dtype),
+    }
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers.update(
+            router=w(keys[4], (L, H, E)),
+            w_gate=w(keys[5], (L, E, H, I)),
+            w_up=w(keys[6], (L, E, H, I)),
+            w_down=w(keys[7], (L, E, I, H)),
+        )
+    else:
+        layers.update(
+            w_gate=w(keys[5], (L, H, I)),
+            w_up=w(keys[6], (L, H, I)),
+            w_down=w(keys[7], (L, I, H)),
+        )
+
+    params: Params = {
+        "embed": w(keys[8], (cfg.vocab_size, H)),
+        "layers": layers,
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(keys[9], (H, cfg.vocab_size))
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Dense contiguous KV cache (M1 backend; the paged cache lives in engine/)
+# ---------------------------------------------------------------------------
+
+
+class KVCache(NamedTuple):
+    """Contiguous per-layer KV cache: k, v are [L, B, S, KV_heads, head_dim]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @classmethod
+    def create(
+        cls, cfg: ModelConfig, batch: int, max_seq: int, dtype=jnp.bfloat16
+    ) -> "KVCache":
+        shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _write_kv(
+    cache_layer: jnp.ndarray, new: jnp.ndarray, write_pos: jnp.ndarray
+) -> jnp.ndarray:
+    """Scatter new K or V ([B, T, KV, D]) into a cache layer ([B, S, KV, D])
+    at per-row positions ([B, T]); out-of-range positions are dropped (used
+    to discard padding tokens)."""
+    B = new.shape[0]
+    rows = jnp.arange(B)[:, None]
+    return cache_layer.at[rows, write_pos].set(new, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# Transformer forward
+# ---------------------------------------------------------------------------
+
+
+def _mlp(h: jnp.ndarray, layer: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """SwiGLU MLP: down( silu(gate(x)) * up(x) )."""
+    gate = jax.nn.silu(h @ layer["w_gate"])
+    up = h @ layer["w_up"]
+    return (gate * up) @ layer["w_down"]
+
+
+def _moe_mlp(h: jnp.ndarray, layer: Dict[str, jnp.ndarray], cfg: ModelConfig):
+    """Mixtral-style sparse MoE, dense-compute form: softmax(top-k) routing
+    with every expert evaluated and combined by weight. Efficient enough at
+    test scale; parallel/expert.py provides the all-to-all sharded version."""
+    B, T, H = h.shape
+    x = h.reshape(-1, H)  # [N, H]
+    router_logits = (x @ layer["router"]).astype(jnp.float32)  # [N, E]
+    weights, idx = lax.top_k(router_logits, cfg.num_experts_per_tok)
+    weights = jax.nn.softmax(weights, axis=-1)  # [N, k]
+    # combine weights per expert: [N, E]
+    combine = jnp.zeros_like(router_logits)
+    combine = combine.at[jnp.arange(x.shape[0])[:, None], idx].set(weights)
+    # every expert on every token: [E, N, H] -> weighted sum
+    gate = jax.nn.silu(jnp.einsum("nh,ehi->eni", x, layer["w_gate"]))
+    up = jnp.einsum("nh,ehi->eni", x, layer["w_up"])
+    expert_out = jnp.einsum("eni,eih->enh", gate * up, layer["w_down"])
+    out = jnp.einsum("enh,ne->nh", expert_out, combine.astype(expert_out.dtype))
+    return out.reshape(B, T, H)
+
+
+def _run_layers(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache: KVCache,
+    write_pos: jnp.ndarray,
+    kv_valid_len: jnp.ndarray,
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Shared transformer trunk: embed, scan layer blocks, final norm.
+    Returns (normed hidden states [B, T, H], updated cache)."""
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    h = params["embed"][input_ids]  # [B, T, H]
+    B, T, H = h.shape
+
+    def block(h, xs):
+        layer, k_layer, v_layer = xs
+        # attention
+        x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        q = (x @ layer["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+        k = (x @ layer["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        v = (x @ layer["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        k_layer = _write_kv(k_layer, k, write_pos)
+        v_layer = _write_kv(v_layer, v, write_pos)
+        attn = gqa_attention(q, k_layer, v_layer, positions, kv_valid_len)
+        h = h + attn.reshape(B, T, cfg.q_size) @ layer["wo"]
+        # mlp
+        x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+        h = h + (_moe_mlp(x, layer, cfg) if cfg.is_moe else _mlp(x, layer))
+        return h, (k_layer, v_layer)
+
+    h, (new_k, new_v) = lax.scan(block, h, (params["layers"], cache.k, cache.v))
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return h, KVCache(k=new_k, v=new_v)
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache: KVCache,
+    write_pos: jnp.ndarray,
+    kv_valid_len: jnp.ndarray,
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Run the transformer over new tokens, updating the dense KV cache.
+
+    Args:
+      input_ids: [B, T] new token ids (prefill: the prompt; decode: T=1).
+      positions: [B, T] absolute positions of those tokens.
+      cache: dense KV cache to read/write.
+      write_pos: [B, T] cache slot to write each new token's K/V into
+        (>= max_seq to drop, e.g. padding).
+      kv_valid_len: [B] valid cache length per row AFTER this write.
+
+    Returns: (logits [B, T, vocab] f32, updated cache).
+    """
+    h, cache = _run_layers(
+        params, cfg, input_ids, positions, cache, write_pos, kv_valid_len
+    )
+    unembed = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = jnp.einsum("bth,hv->btv", h, unembed, preferred_element_type=jnp.float32)
+    return logits, cache
+
+
+def hidden_states(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    kv_valid_len: jnp.ndarray,
+) -> jnp.ndarray:
+    """Final-layer hidden states (pre-unembedding) for the embeddings
+    endpoint: a cache-less full forward. Returns [B, T, H] f32."""
+    B, T = input_ids.shape
+    cache = KVCache.create(cfg, B, T, dtype=params["embed"].dtype)
+    h, _ = _run_layers(
+        params, cfg, input_ids, positions, cache, positions, kv_valid_len
+    )
+    return h.astype(jnp.float32)

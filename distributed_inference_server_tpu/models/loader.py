@@ -1,0 +1,156 @@
+"""Weight loading: HF checkpoints (safetensors) -> stacked JAX param pytrees.
+
+This is the framework's "restore" path — the TPU-native analogue of the
+reference's planned GGUF model load (``design.md:315-332``,
+``tasks.md:196-200`` [spec]): weights stream from safetensors straight into
+(optionally sharded) device buffers.
+
+HF Llama naming is mapped to the stacked layout of models/llama.py:
+``model.layers.{i}.self_attn.q_proj.weight`` [out, in] becomes row ``i`` of
+``layers.wq`` [L, in, out] (transposed so the hot path is ``x @ W``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Callable, Dict, Iterable, Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_inference_server_tpu.core.errors import ModelLoadError
+from distributed_inference_server_tpu.models.configs import ModelConfig, RopeScaling
+
+# (our stacked name, HF per-layer suffix, transpose?)
+_LAYER_MAP = [
+    ("attn_norm", "input_layernorm.weight", False),
+    ("wq", "self_attn.q_proj.weight", True),
+    ("wk", "self_attn.k_proj.weight", True),
+    ("wv", "self_attn.v_proj.weight", True),
+    ("wo", "self_attn.o_proj.weight", True),
+    ("mlp_norm", "post_attention_layernorm.weight", False),
+    ("w_gate", "mlp.gate_proj.weight", True),
+    ("w_up", "mlp.up_proj.weight", True),
+    ("w_down", "mlp.down_proj.weight", True),
+]
+
+_MOE_LAYER_MAP = [
+    ("attn_norm", "input_layernorm.weight", False),
+    ("wq", "self_attn.q_proj.weight", True),
+    ("wk", "self_attn.k_proj.weight", True),
+    ("wv", "self_attn.v_proj.weight", True),
+    ("wo", "self_attn.o_proj.weight", True),
+    ("mlp_norm", "post_attention_layernorm.weight", False),
+    ("router", "block_sparse_moe.gate.weight", True),
+]
+
+
+def params_from_hf_state_dict(
+    state: Mapping[str, np.ndarray],
+    cfg: ModelConfig,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> Dict[str, Any]:
+    """Convert an HF Llama/Mixtral state dict (numpy arrays) to our pytree."""
+
+    def get(name: str) -> np.ndarray:
+        if name not in state:
+            raise ModelLoadError(f"missing weight {name!r}")
+        return np.asarray(state[name])
+
+    def stack(suffix: str, transpose: bool) -> jnp.ndarray:
+        rows = []
+        for i in range(cfg.num_layers):
+            w = get(f"model.layers.{i}.{suffix}")
+            rows.append(w.T if transpose else w)
+        return jnp.asarray(np.stack(rows), dtype=dtype)
+
+    layers: Dict[str, jnp.ndarray] = {}
+    if cfg.is_moe:
+        for ours, suffix, t in _MOE_LAYER_MAP:
+            layers[ours] = stack(suffix, t)
+        for ours, part in (("w_gate", "w1"), ("w_down", "w2"), ("w_up", "w3")):
+            per_layer = []
+            for i in range(cfg.num_layers):
+                experts = [
+                    get(f"model.layers.{i}.block_sparse_moe.experts.{e}.{part}.weight").T
+                    for e in range(cfg.num_experts)
+                ]
+                per_layer.append(np.stack(experts))
+            layers[ours] = jnp.asarray(np.stack(per_layer), dtype=dtype)
+    else:
+        for ours, suffix, t in _LAYER_MAP:
+            layers[ours] = stack(suffix, t)
+
+    params: Dict[str, Any] = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype=dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype=dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype=dtype)
+    return params
+
+
+def config_from_hf_json(obj: Mapping[str, Any], name: str = "hf") -> ModelConfig:
+    """Build a ModelConfig from an HF ``config.json`` dict."""
+    rope_scaling = None
+    rs = obj.get("rope_scaling")
+    if rs and rs.get("rope_type", rs.get("type")) == "llama3":
+        rope_scaling = RopeScaling(
+            factor=float(rs.get("factor", 8.0)),
+            low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+            high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+            original_max_position=int(
+                rs.get("original_max_position_embeddings", 8192)
+            ),
+        )
+    num_heads = int(obj["num_attention_heads"])
+    hidden = int(obj["hidden_size"])
+    return ModelConfig(
+        name=name,
+        vocab_size=int(obj["vocab_size"]),
+        hidden_size=hidden,
+        intermediate_size=int(obj["intermediate_size"]),
+        num_layers=int(obj["num_hidden_layers"]),
+        num_heads=num_heads,
+        num_kv_heads=int(obj.get("num_key_value_heads", num_heads)),
+        head_dim=int(obj.get("head_dim", hidden // num_heads)),
+        rms_norm_eps=float(obj.get("rms_norm_eps", 1e-5)),
+        rope_theta=float(obj.get("rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
+        tie_word_embeddings=bool(obj.get("tie_word_embeddings", False)),
+        max_position_embeddings=int(obj.get("max_position_embeddings", 8192)),
+        num_experts=int(obj.get("num_local_experts", 0)),
+        num_experts_per_tok=int(obj.get("num_experts_per_tok", 2)),
+    )
+
+
+def load_checkpoint(
+    model_dir: str,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> tuple[Dict[str, Any], ModelConfig]:
+    """Load an HF-format checkpoint directory (config.json + *.safetensors)."""
+    cfg_path = os.path.join(model_dir, "config.json")
+    if not os.path.exists(cfg_path):
+        raise ModelLoadError(f"no config.json in {model_dir}")
+    with open(cfg_path) as f:
+        cfg = config_from_hf_json(json.load(f), name=os.path.basename(model_dir))
+
+    try:
+        from safetensors import safe_open
+    except ImportError:
+        raise ModelLoadError("safetensors not available") from None
+
+    shards = sorted(
+        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    if not shards:
+        raise ModelLoadError(f"no *.safetensors files in {model_dir}")
+    state: Dict[str, np.ndarray] = {}
+    for shard in shards:
+        with safe_open(os.path.join(model_dir, shard), framework="numpy") as f:
+            for key in f.keys():
+                state[key] = f.get_tensor(key)
+    return params_from_hf_state_dict(state, cfg, dtype=dtype), cfg

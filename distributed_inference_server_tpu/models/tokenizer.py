@@ -1,0 +1,108 @@
+"""Tokenization for the serving layer.
+
+Two backends behind one interface:
+
+- ``HFTokenizer`` wraps a ``tokenizer.json`` via the ``tokenizers`` library
+  (the real path for Llama/Mixtral checkpoints).
+- ``ByteTokenizer`` is a dependency-free byte-level fallback (ids 0-255 are
+  raw bytes, plus BOS/EOS) used in tests and random-weight smoke runs where
+  no checkpoint exists (the environment has no network egress).
+
+Also provides the chat template (Llama-3 header format) used by the /chat
+endpoint — the reference spec'd chat templating as part of request
+processing (``tasks.md:259-262`` [spec]).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Protocol, Sequence
+
+from distributed_inference_server_tpu.core.models import ChatMessage
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_ids: Sequence[int]
+    vocab_size: int
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]: ...
+
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+    def decode_token(self, token_id: int) -> str: ...
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: id i < 256 is byte i; 256=BOS, 257=EOS."""
+
+    def __init__(self) -> None:
+        self.bos_id = 256
+        self.eos_ids = (257,)
+        self.vocab_size = 258
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def decode_token(self, token_id: int) -> str:
+        return self.decode([token_id])
+
+
+class HFTokenizer:
+    """Wraps a HuggingFace ``tokenizer.json`` (tokenizers library)."""
+
+    def __init__(self, path: str, bos_id: Optional[int] = None,
+                 eos_ids: Optional[Sequence[int]] = None):
+        from tokenizers import Tokenizer as _Tok
+
+        self._tok = _Tok.from_file(path)
+        self.vocab_size = self._tok.get_vocab_size()
+        self.bos_id = (
+            bos_id
+            if bos_id is not None
+            else (self._tok.token_to_id("<|begin_of_text|>") or 0)
+        )
+        if eos_ids is None:
+            candidates = [
+                self._tok.token_to_id(t)
+                for t in ("<|end_of_text|>", "<|eot_id|>", "</s>")
+            ]
+            eos_ids = tuple(c for c in candidates if c is not None) or (0,)
+        self.eos_ids = tuple(eos_ids)
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def decode_token(self, token_id: int) -> str:
+        return self._tok.decode([token_id], skip_special_tokens=True)
+
+
+def load_tokenizer(model_dir: Optional[str]) -> Tokenizer:
+    """Load the checkpoint's tokenizer.json, or fall back to bytes."""
+    if model_dir:
+        path = os.path.join(model_dir, "tokenizer.json")
+        if os.path.exists(path):
+            return HFTokenizer(path)
+    return ByteTokenizer()
+
+
+def apply_chat_template(messages: Sequence[ChatMessage]) -> str:
+    """Llama-3 instruct chat format; the /chat endpoint flattens the
+    conversation through this before tokenizing."""
+    parts = ["<|begin_of_text|>"]
+    for m in messages:
+        parts.append(
+            f"<|start_header_id|>{m.role.value}<|end_header_id|>\n\n"
+            f"{m.content}<|eot_id|>"
+        )
+    parts.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+    return "".join(parts)

@@ -1,0 +1,62 @@
+"""Reference (pure-XLA) grouped-query attention over a contiguous KV cache.
+
+This is the numerics ground truth the Pallas kernels (ops/pallas/) are tested
+against, and the fallback path on the CPU backend. It avoids materializing
+repeated KV heads by folding the GQA group into the einsum, keeps softmax in
+f32, and handles ragged batches with an explicit per-row valid length — the
+same (q_positions, kv_valid_len) contract the paged-attention kernel uses.
+
+Replaces the reference's planned llama.cpp attention (design.md:7 [spec]).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NEG_INF = -1e30  # large-negative instead of -inf so fully-masked rows stay finite
+
+
+def gqa_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    kv_valid_len: jnp.ndarray,
+) -> jnp.ndarray:
+    """Causal GQA attention of new queries against a contiguous KV cache.
+
+    Args:
+      q: [B, T, H, D] new queries (T=1 for decode).
+      k_cache, v_cache: [B, S, KV, D] cache contents (padded to S slots);
+        must already contain the K/V of the new tokens.
+      q_positions: [B, T] absolute position of each query token. Padding
+        queries may hold any in-range value; their outputs are discarded
+        downstream.
+      kv_valid_len: [B] number of valid cache slots per row.
+
+    Returns: [B, T, H, D] attention outputs in q.dtype.
+    """
+    B, T, H, D = q.shape
+    S = k_cache.shape[1]
+    KV = k_cache.shape[2]
+    G = H // KV
+
+    qg = q.reshape(B, T, KV, G, D)
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k_cache, preferred_element_type=jnp.float32
+    )
+    scores = scores * (1.0 / jnp.sqrt(D).astype(jnp.float32))
+
+    kv_pos = jnp.arange(S)
+    causal = kv_pos[None, None, :] <= q_positions[:, :, None]  # [B, T, S]
+    valid = kv_pos[None, None, :] < kv_valid_len[:, None, None]  # [B, 1->T, S]
+    mask = (causal & valid)[:, None, None, :, :]  # [B, 1, 1, T, S]
+
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+
+    out = jnp.einsum(
+        "bkgts,bskd->btkgd", probs, v_cache, preferred_element_type=jnp.float32
+    )
+    return out.reshape(B, T, H, D).astype(q.dtype)

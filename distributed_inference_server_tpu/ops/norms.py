@@ -1,0 +1,21 @@
+"""Normalization ops.
+
+RMSNorm computes the variance in f32 regardless of activation dtype (bf16
+activations lose too much precision in the sum of squares), then casts back.
+XLA fuses this into the surrounding elementwise graph; the Pallas fused
+variant (ops/pallas/) exists for cases where we want it welded to the
+following matmul's prologue.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """y = x / rms(x) * weight, computed in f32."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)

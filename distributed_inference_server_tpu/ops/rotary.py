@@ -1,0 +1,65 @@
+"""Rotary position embeddings (RoPE), Llama-3 style.
+
+TPU notes: frequencies are computed once per call in f32 and applied in the
+activation dtype; the half-split rotation form (not interleaved) matches HF
+Llama so loaded checkpoints are bit-compatible. XLA fuses the sin/cos and
+elementwise rotate into neighbouring ops, so a dedicated Pallas kernel only
+pays off when fused into attention (see ops/pallas/).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from distributed_inference_server_tpu.models.configs import RopeScaling
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float,
+    scaling: Optional[RopeScaling] = None,
+) -> jnp.ndarray:
+    """Inverse frequencies [head_dim//2], with optional Llama-3 scaling."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (theta**exponents)
+    if scaling is None:
+        return inv_freq
+
+    # Llama-3 frequency-dependent scaling: low-frequency components are
+    # slowed by `factor`, high-frequency kept, mid smoothly interpolated.
+    low_wavelen = scaling.original_max_position / scaling.low_freq_factor
+    high_wavelen = scaling.original_max_position / scaling.high_freq_factor
+    wavelen = 2.0 * jnp.pi / inv_freq
+    smooth = (scaling.original_max_position / wavelen - scaling.low_freq_factor) / (
+        scaling.high_freq_factor - scaling.low_freq_factor
+    )
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    scaled = (1.0 - smooth) * inv_freq / scaling.factor + smooth * inv_freq
+    return jnp.where(
+        wavelen > low_wavelen,
+        inv_freq / scaling.factor,
+        jnp.where(wavelen < high_wavelen, inv_freq, scaled),
+    )
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate ``x`` by position-dependent angles.
+
+    x: [..., seq, heads, head_dim]; positions: broadcastable to [..., seq].
+    Uses the half-split convention: (x1, x2) -> (x1*cos - x2*sin,
+    x2*cos + x1*sin) with x1 the first half of head_dim.
+    """
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., seq, hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., seq, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

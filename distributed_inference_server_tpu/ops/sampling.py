@@ -1,0 +1,54 @@
+"""On-device token sampling: greedy, temperature, top-p (nucleus).
+
+The reference spec'd host-side sampling per token (``design.md:666-671``
+[spec]); on TPU that would bounce logits to the host every decode step, so
+sampling is fused into the compiled step: a single jittable function over the
+batch, driven by a threaded PRNG key. Temperature==0 rows degrade to argmax;
+top_p==1 rows skip the nucleus cutoff — all branchless (lax.select) so one
+compiled program covers every request mix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """Sample next tokens for a batch.
+
+    Args:
+      rng: PRNG key.
+      logits: [B, V] f32 final-position logits.
+      temperature: [B] per-request temperature (0 => greedy).
+      top_p: [B] per-request nucleus threshold (1 => disabled).
+
+    Returns: [B] int32 sampled token ids.
+    """
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # temperature scale (guard zero-temp rows; their result is overridden)
+    safe_temp = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / safe_temp
+
+    # top-p: sort descending, keep the smallest prefix with cumprob >= top_p
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+    # keep tokens while the cumulative prob *before* them is < top_p;
+    # the top-1 token is always kept so top_p=0 degrades to greedy
+    keep = (cumprobs - sorted_probs) < top_p[:, None]
+    keep = keep.at[:, 0].set(True)
+    # threshold logit = smallest kept logit per row
+    kept_logits = jnp.where(keep, sorted_logits, jnp.inf)
+    cutoff = jnp.min(kept_logits, axis=-1, keepdims=True)
+    filtered = jnp.where(scaled >= cutoff, scaled, -jnp.inf)
+
+    sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)

@@ -7,10 +7,21 @@ jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override, not setdefault: the environment presets JAX_PLATFORMS=axon
+# (single real TPU chip behind a one-process tunnel); tests must never claim
+# it — they run on the CPU backend with 8 virtual devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon sitecustomize calls jax.config.update("jax_platforms", "axon,cpu")
+# in every interpreter, overriding the env var — so the env override above is
+# not enough: force the config back to cpu-only before any backend
+# initialization (conftest imports before all test modules).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

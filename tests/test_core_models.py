@@ -219,6 +219,26 @@ def test_malformed_json_rejected():
         loads(GenerateRequest, "{not json")
 
 
+def test_response_side_strict_numbers():
+    # malformed numeric fields in responses/events raise InvalidJson, not
+    # bare ValueError (the loads() contract)
+    with pytest.raises(InvalidJson):
+        loads(TokenEvent, '{"type":"token","token":"a","index":"oops"}')
+    with pytest.raises(InvalidJson):
+        loads(
+            GenerateResponse,
+            '{"id":"x","object":"text_completion","created":"now","model":"m",'
+            '"choices":[],"usage":{"prompt_tokens":1,"completion_tokens":1,'
+            '"total_tokens":2}}',
+        )
+    with pytest.raises(InvalidJson):
+        loads(
+            GenerateResponse,
+            '{"id":"x","object":"o","created":1,"model":"m","choices":[],'
+            '"usage":{"prompt_tokens":1.5,"completion_tokens":1,"total_tokens":2}}',
+        )
+
+
 def test_wrong_field_types_rejected():
     # Strict-typed fields: the reference's serde rejects these with 400
     # invalid_json; no truthiness coercion ("false" must not enable streaming).

@@ -1,0 +1,217 @@
+"""Model correctness anchors (SURVEY.md §7.2 M1).
+
+1. Numeric parity of the JAX Llama against a randomly-initialized HF
+   ``LlamaForCausalLM`` on CPU (the ground-truth implementation of the
+   architecture the reference planned to serve via llama.cpp).
+2. Prefill/decode consistency: incremental decode through the KV cache must
+   reproduce full-sequence forward logits.
+3. Ragged batching: a request's logits must not depend on its batch-mates.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY, TINY_MOE
+from distributed_inference_server_tpu.models.generate import generate, greedy_generate
+from distributed_inference_server_tpu.models.loader import (
+    config_from_hf_json,
+    params_from_hf_state_dict,
+)
+
+
+def _forward_full(params, cfg, ids_batch, lens, dtype=jnp.float32):
+    """Single prefill pass over right-padded [B, T] prompts."""
+    B, T = ids_batch.shape
+    cache = llama.KVCache.create(cfg, B, T, dtype=dtype)
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    write_pos = jnp.where(positions < lens[:, None], positions, T)
+    logits, cache = llama.forward(
+        params, cfg, ids_batch, positions, cache, write_pos, lens
+    )
+    return logits, cache
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# 1. Parity vs transformers
+# ---------------------------------------------------------------------------
+
+
+def test_parity_with_transformers(tiny_params):
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=True,
+        max_position_embeddings=512,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = LlamaForCausalLM(hf_cfg).eval()
+
+    state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    cfg = config_from_hf_json(hf_cfg.to_dict(), name="tiny-hf")
+    params = params_from_hf_state_dict(state, cfg, dtype=jnp.float32)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, size=(2, 12))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+
+    lens = jnp.asarray([12, 12], jnp.int32)
+    ours, _ = _forward_full(params, cfg, jnp.asarray(ids, jnp.int32), lens)
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, atol=2e-4, rtol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# 2. Prefill/decode consistency through the KV cache
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_then_decode_matches_full_forward(tiny_params):
+    cfg = TINY
+    params = tiny_params
+    rng = np.random.default_rng(1)
+    total = 10
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(1, total)), jnp.int32)
+
+    full_logits, _ = _forward_full(params, cfg, ids, jnp.asarray([total]))
+
+    # prefill the first 4 tokens, then decode the rest one at a time
+    max_seq = 16
+    cache = llama.KVCache.create(cfg, 1, max_seq, dtype=jnp.float32)
+    prefill_len = 4
+    positions = jnp.arange(prefill_len)[None, :]
+    logits, cache = llama.forward(
+        params, cfg, ids[:, :prefill_len], positions, cache, positions,
+        jnp.asarray([prefill_len]),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(full_logits[0, :prefill_len]),
+        atol=1e-4, rtol=1e-3,
+    )
+
+    for t in range(prefill_len, total):
+        pos = jnp.asarray([[t]], jnp.int32)
+        step_logits, cache = llama.forward(
+            params, cfg, ids[:, t : t + 1], pos, cache, pos, jnp.asarray([t + 1])
+        )
+        np.testing.assert_allclose(
+            np.asarray(step_logits[0, 0]), np.asarray(full_logits[0, t]),
+            atol=1e-4, rtol=1e-3,
+        )
+
+
+# ---------------------------------------------------------------------------
+# 3. Ragged batch isolation
+# ---------------------------------------------------------------------------
+
+
+def test_ragged_batch_matches_single(tiny_params):
+    cfg = TINY
+    params = tiny_params
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, cfg.vocab_size, size=9)
+    b = rng.integers(0, cfg.vocab_size, size=5)
+
+    T = 9
+    batch = np.zeros((2, T), np.int32)
+    batch[0, : len(a)] = a
+    batch[1, : len(b)] = b
+    lens = jnp.asarray([len(a), len(b)], jnp.int32)
+    batched, _ = _forward_full(params, cfg, jnp.asarray(batch), lens)
+
+    solo_a, _ = _forward_full(
+        params, cfg, jnp.asarray(a[None, :], jnp.int32), jnp.asarray([len(a)])
+    )
+    solo_b, _ = _forward_full(
+        params, cfg, jnp.asarray(b[None, :], jnp.int32), jnp.asarray([len(b)])
+    )
+    np.testing.assert_allclose(
+        np.asarray(batched[0, : len(a)]), np.asarray(solo_a[0]), atol=1e-4, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(batched[1, : len(b)]), np.asarray(solo_b[0]), atol=1e-4, rtol=1e-3
+    )
+
+
+# ---------------------------------------------------------------------------
+# 4. Generation loop
+# ---------------------------------------------------------------------------
+
+
+def test_greedy_generate_deterministic(tiny_params):
+    prompt = [1, 2, 3, 4]
+    out1 = greedy_generate(tiny_params, TINY, prompt, max_new_tokens=8, max_seq=32)
+    out2 = greedy_generate(tiny_params, TINY, prompt, max_new_tokens=8, max_seq=32)
+    assert out1 == out2
+    assert len(out1) == 8
+
+
+def test_generate_respects_eos(tiny_params):
+    # Use the greedy first token as a forced EOS: generation must stop at 0.
+    prompt = [1, 2, 3, 4]
+    first = greedy_generate(tiny_params, TINY, prompt, max_new_tokens=1, max_seq=32)[0]
+    out = greedy_generate(
+        tiny_params, TINY, prompt, max_new_tokens=8, max_seq=32, eos_ids=(first,)
+    )
+    assert out == []
+
+
+def test_length_stop_not_reported_as_eos(tiny_params):
+    # cache-full stop (no EOS configured) must NOT set finished_eos
+    cfg = TINY
+    ids = jnp.asarray([[1, 2, 3, 4, 5, 6]], jnp.int32)
+    res = generate(
+        tiny_params, cfg, ids, jnp.asarray([6]), jax.random.PRNGKey(0),
+        jnp.zeros((1,)), jnp.ones((1,)), 8, 8, (),
+    )
+    assert int(res.lengths[0]) == 2  # 8-slot cache, 6-token prompt
+    assert not bool(res.finished_eos[0])
+
+
+def test_generate_batch_ragged(tiny_params):
+    cfg = TINY
+    ids = jnp.asarray([[1, 2, 3, 4], [5, 6, 0, 0]], jnp.int32)
+    lens = jnp.asarray([4, 2], jnp.int32)
+    res = generate(
+        tiny_params, cfg, ids, lens, jax.random.PRNGKey(0),
+        jnp.zeros((2,)), jnp.ones((2,)), 6, 32, (),
+    )
+    assert res.tokens.shape == (2, 6)
+    assert int(res.lengths[0]) == 6 and int(res.lengths[1]) == 6
+    # row 1's output must equal generating it alone (batch isolation)
+    solo = greedy_generate(tiny_params, cfg, [5, 6], max_new_tokens=6, max_seq=32)
+    assert np.asarray(res.tokens[1]).tolist() == solo
+
+
+# ---------------------------------------------------------------------------
+# 5. MoE forward
+# ---------------------------------------------------------------------------
+
+
+def test_moe_forward_runs_and_is_deterministic():
+    cfg = TINY_MOE
+    params = llama.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    ids = jnp.asarray([[1, 2, 3, 4, 5]], jnp.int32)
+    lens = jnp.asarray([5], jnp.int32)
+    l1, _ = _forward_full(params, cfg, ids, lens)
+    l2, _ = _forward_full(params, cfg, ids, lens)
+    assert l1.shape == (1, 5, cfg.vocab_size)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    assert np.all(np.isfinite(np.asarray(l1)))

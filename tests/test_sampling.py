@@ -1,0 +1,61 @@
+"""Sampling op tests: greedy/temperature/top-p semantics on device."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from distributed_inference_server_tpu.ops.sampling import sample_tokens
+
+
+def test_zero_temperature_is_argmax():
+    rng = jax.random.PRNGKey(0)
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(4, 50)), jnp.float32)
+    out = sample_tokens(rng, logits, jnp.zeros((4,)), jnp.ones((4,)))
+    np.testing.assert_array_equal(np.asarray(out), np.argmax(np.asarray(logits), -1))
+
+
+def test_top_p_restricts_support():
+    # one dominant token (prob ~0.97): top_p=0.5 must always pick it
+    logits = jnp.asarray([[10.0, 2.0, 1.0, 0.0]] * 3, jnp.float32)
+    for seed in range(5):
+        out = sample_tokens(
+            jax.random.PRNGKey(seed), logits, jnp.ones((3,)), jnp.full((3,), 0.5)
+        )
+        assert np.all(np.asarray(out) == 0)
+
+
+def test_top_p_one_samples_full_distribution():
+    # uniform logits, top_p=1: over many draws every token should appear
+    logits = jnp.zeros((1, 4), jnp.float32)
+    seen = set()
+    for seed in range(64):
+        out = sample_tokens(
+            jax.random.PRNGKey(seed), logits, jnp.ones((1,)), jnp.ones((1,))
+        )
+        seen.add(int(out[0]))
+    assert seen == {0, 1, 2, 3}
+
+
+def test_top_p_zero_degrades_to_greedy():
+    # top_p=0 is admitted by the validator (min_top_p=0.0); the top-1 token
+    # must always stay in the nucleus
+    logits = jnp.asarray([[1.0, 5.0, 2.0, 0.0]], jnp.float32)
+    for seed in range(5):
+        out = sample_tokens(
+            jax.random.PRNGKey(seed), logits, jnp.ones((1,)), jnp.zeros((1,))
+        )
+        assert int(out[0]) == 1
+
+
+def test_per_row_mixed_settings():
+    logits = jnp.asarray(
+        [[5.0, 0.0, 0.0, 0.0], [0.0, 5.0, 0.0, 0.0]], jnp.float32
+    )
+    out = sample_tokens(
+        jax.random.PRNGKey(1),
+        logits,
+        jnp.asarray([0.0, 1.0]),  # row0 greedy, row1 sampled
+        jnp.asarray([1.0, 0.3]),  # row1 nucleus keeps only token 1
+    )
+    assert int(out[0]) == 0
+    assert int(out[1]) == 1
