@@ -1,0 +1,609 @@
+"""Continuous-batching inference engine over the paged KV cache.
+
+This is the TPU-native realization of the reference's inference-execution
+layer (``InferenceWorker``/``KVCacheManager``/decode loop, stubs at
+``crates/inference/src/worker.rs:1``; spec ``design.md:315-412,660-674``),
+redesigned for XLA's compilation model:
+
+- **Continuous batching at decode-step granularity** replaces the spec's
+  static pad-to-max batches (``design.md:244-248`` [spec]): a fixed pool of
+  ``max_batch`` decode slots; requests join/leave between steps. The 50ms/32
+  windowed batcher survives as the *admission* layer (engine/batcher.py), so
+  the reference's batching properties still hold at the boundary.
+- **Static shapes everywhere**: decode always runs the full [max_batch]
+  program (inactive slots masked by dropping their page writes); prefill
+  lengths snap to a small set of buckets. One compiled program per bucket,
+  warm-compiled at startup, instead of XLA recompiling per request mix.
+- **On-device sampling** fused into the decode step (temperature/top-p per
+  slot) so tokens — not logits — cross the host boundary each step.
+- **Prefix reuse + LRU** via the PageAllocator (Properties 9-11), with
+  on-demand page allocation during decode and preemption (youngest slot
+  returns to the queue, pages released) when the pool runs dry.
+- **Per-request failure isolation** (Property 22, design.md:812-816): host-
+  side processing of each slot is fenced; a poisoned request errors out
+  alone.
+
+Threading: the engine is synchronous and single-owner (one step() caller);
+the serving layer runs it on a dedicated thread and bridges to asyncio.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence as Seq, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_inference_server_tpu.core.errors import CacheFull
+from distributed_inference_server_tpu.core.models import FinishReason, Usage
+from distributed_inference_server_tpu.core.types import RequestId
+from distributed_inference_server_tpu.engine.kv_cache import (
+    PageAllocator,
+    PagedCacheConfig,
+    PagedKVState,
+)
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import ModelConfig
+from distributed_inference_server_tpu.models.tokenizer import Tokenizer
+from distributed_inference_server_tpu.ops.sampling import sample_tokens
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    max_tokens: int = 256
+    temperature: float = 1.0
+    top_p: float = 1.0
+    stop_sequences: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    max_batch: int = 8
+    prefill_buckets: Tuple[int, ...] = (32, 128, 512)
+    paged: PagedCacheConfig = field(default_factory=PagedCacheConfig)
+    seed: int = 0
+
+
+@dataclass
+class StepOutput:
+    """One event emitted by step(): a token delta and/or completion."""
+
+    request_id: RequestId
+    token_id: Optional[int] = None
+    text: str = ""  # detokenized delta safe to emit now
+    token_index: int = 0
+    finished: bool = False
+    finish_reason: Optional[FinishReason] = None
+    usage: Optional[Usage] = None
+    error: Optional[str] = None
+
+
+class _Seq:
+    """Host-side state of one in-flight request."""
+
+    __slots__ = (
+        "request_id", "token_ids", "prompt_len", "block_table", "shared_pages",
+        "seq_len", "next_token", "params", "output_text", "emitted_upto",
+        "emitted_tokens", "preempted",
+    )
+
+    def __init__(self, request_id: RequestId, prompt_ids: List[int],
+                 params: SamplingParams):
+        self.request_id = request_id
+        self.token_ids: List[int] = list(prompt_ids)
+        self.prompt_len = len(prompt_ids)
+        self.block_table: List[int] = []
+        self.shared_pages = 0  # leading pages reused from the prefix cache
+        self.seq_len = 0  # tokens with K/V resident in pages
+        self.next_token: Optional[int] = None  # sampled, not yet decoded
+        self.params = params
+        self.output_text = ""
+        self.emitted_upto = 0
+        self.emitted_tokens = 0
+        self.preempted = False
+
+    def num_output_tokens(self) -> int:
+        return len(self.token_ids) - self.prompt_len
+
+
+class LLMEngine:
+    """Single-model continuous-batching engine (one replica = one "worker"
+    in the reference's terms, ``design.md:335-342`` [spec])."""
+
+    def __init__(
+        self,
+        params: llama.Params,
+        cfg: ModelConfig,
+        tokenizer: Tokenizer,
+        engine_cfg: Optional[EngineConfig] = None,
+        dtype=jnp.bfloat16,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.tok = tokenizer
+        self.ecfg = engine_cfg or EngineConfig()
+        self.pcfg = self.ecfg.paged
+        self.dtype = dtype
+
+        self.state = PagedKVState.create(cfg, self.pcfg, dtype=dtype)
+        self.allocator = PageAllocator(self.pcfg)
+        self.waiting: Deque[_Seq] = deque()
+        self.slots: List[Optional[_Seq]] = [None] * self.ecfg.max_batch
+        self._by_id: Dict[RequestId, _Seq] = {}
+        self._rng = jax.random.PRNGKey(self.ecfg.seed)
+        self._num_slots_flat = self.pcfg.num_pages * self.pcfg.page_size
+        self._smax = self.pcfg.max_pages_per_seq * self.pcfg.page_size
+        self._steps = 0
+
+        # jit caches
+        self._prefill_fns: Dict[int, Callable] = {}
+        self._decode_fn = self._build_decode()
+        self._sample_fn = jax.jit(sample_tokens)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def add_request(
+        self,
+        request_id: RequestId,
+        prompt_ids: List[int],
+        params: SamplingParams,
+    ) -> None:
+        """Queue a tokenized request for execution."""
+        seq = _Seq(request_id, prompt_ids, params)
+        self._by_id[request_id] = seq
+        self.waiting.append(seq)
+
+    def abort(self, request_id: RequestId) -> bool:
+        """Abort a queued or running request (client disconnect,
+        Req 5.4 requirements.md:85). Returns True if found."""
+        seq = self._by_id.pop(request_id, None)
+        if seq is None:
+            return False
+        if seq in self.waiting:
+            self.waiting.remove(seq)
+        for i, s in enumerate(self.slots):
+            if s is seq:
+                self.slots[i] = None
+        self._release_seq(seq)
+        return True
+
+    def has_work(self) -> bool:
+        return bool(self._by_id)
+
+    def num_active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    def step(self) -> List[StepOutput]:
+        """Admit waiting requests into free slots (prefill), then run one
+        decode step for all active slots. Returns emitted events."""
+        outputs: List[StepOutput] = []
+        self._admit(outputs)
+        self._decode(outputs)
+        self._steps += 1
+        return outputs
+
+    def cache_stats(self):
+        return self.allocator.stats()
+
+    # ------------------------------------------------------------------
+    # admission / prefill
+    # ------------------------------------------------------------------
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self, outputs: List[StepOutput]) -> None:
+        while self.waiting:
+            slot = self._free_slot()
+            if slot is None:
+                return
+            seq = self.waiting[0]
+            n = len(seq.token_ids)
+            needed_pages = -(-(n + 1) // self.pcfg.page_size)
+            if n + 1 > self.pcfg.max_seq_len or needed_pages > self.pcfg.num_pages:
+                self.waiting.popleft()
+                self._by_id.pop(seq.request_id, None)
+                outputs.append(StepOutput(
+                    request_id=seq.request_id, finished=True,
+                    error=f"prompt of {n} tokens exceeds the engine "
+                          f"capacity ({self.pcfg.max_seq_len} tokens)",
+                ))
+                continue
+            try:
+                self._prefill_seq(seq, outputs)
+            except CacheFull:
+                return  # no pages; retry next step
+            except Exception as e:  # failure isolation (Property 22)
+                self.waiting.popleft()
+                self._by_id.pop(seq.request_id, None)
+                self._release_seq(seq)
+                outputs.append(StepOutput(
+                    request_id=seq.request_id, finished=True, error=str(e)))
+                continue
+            self.waiting.popleft()
+            if seq.request_id in self._by_id:  # not finished during prefill
+                self.slots[slot] = seq
+
+    def _prefill_seq(self, seq: _Seq, outputs: List[StepOutput]) -> None:
+        ps = self.pcfg.page_size
+        self._release_seq(seq)  # defensive: drop any stale pages
+        prompt = seq.token_ids  # on re-admission after preemption this
+        # includes previously generated tokens; their logits are recomputed
+        # only past the cached prefix.
+        n = len(prompt)
+
+        # prefix reuse (Property 9) — but always leave >= 1 token to compute
+        shared_pages, shared_tokens = self.allocator.match_prefix(prompt)
+        while shared_tokens >= n:
+            self.allocator.release([shared_pages.pop()])
+            shared_tokens -= ps
+        seq.block_table = list(shared_pages)
+        seq.shared_pages = len(shared_pages)
+        seq.seq_len = shared_tokens
+
+        # allocate the remaining pages for the prompt
+        pages_needed = -(-n // ps) - len(shared_pages)
+        if pages_needed > 0:
+            try:
+                seq.block_table.extend(self.allocator.allocate(pages_needed))
+            except CacheFull:
+                self._release_seq(seq)
+                raise
+
+        # prefill the un-cached suffix in bucketed chunks
+        start = shared_tokens
+        last_logits = None
+        while start < n:
+            bucket = self._pick_bucket(n - start)
+            chunk = prompt[start : start + bucket]
+            t = len(chunk)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :t] = chunk
+            positions = np.arange(start, start + bucket, dtype=np.int32)[None, :]
+            write_slots = self._slots_for_positions(seq.block_table, positions, t)
+            gather = self._gather_slots([seq.block_table])
+            fn = self._get_prefill_fn(bucket)
+            logits_last, self.state.k, self.state.v = fn(
+                self.params,
+                jnp.asarray(ids),
+                jnp.asarray(positions),
+                self.state.k,
+                self.state.v,
+                jnp.asarray(write_slots),
+                jnp.asarray(gather),
+                jnp.asarray([min(start + t, n)], np.int32),
+                jnp.asarray([t - 1], np.int32),
+            )
+            last_logits = logits_last
+            start += t
+        seq.seq_len = n
+
+        # sample the first token on-device
+        self._rng, sub = jax.random.split(self._rng)
+        tok = self._sample_fn(
+            sub,
+            last_logits,
+            jnp.asarray([seq.params.temperature], jnp.float32),
+            jnp.asarray([seq.params.top_p], jnp.float32),
+        )
+        self._emit_token(seq, int(tok[0]), outputs)
+
+    def _pick_bucket(self, remaining: int) -> int:
+        for b in self.ecfg.prefill_buckets:
+            if remaining <= b:
+                return b
+        return self.ecfg.prefill_buckets[-1]
+
+    def _get_prefill_fn(self, bucket: int) -> Callable:
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            cfg = self.cfg
+
+            @functools.partial(jax.jit, donate_argnums=(3, 4))
+            def prefill(params, ids, positions, pool_k, pool_v, write_slots,
+                        gather_slots, kv_valid_len, last_idx):
+                logits, k, v = llama.paged_forward(
+                    params, cfg, ids, positions, pool_k, pool_v,
+                    write_slots, gather_slots, kv_valid_len,
+                )
+                return logits[jnp.arange(1), last_idx], k, v
+
+            fn = self._prefill_fns[bucket] = prefill
+        return fn
+
+    # ------------------------------------------------------------------
+    # decode
+    # ------------------------------------------------------------------
+
+    def _build_decode(self) -> Callable:
+        cfg = self.cfg
+
+        @functools.partial(jax.jit, donate_argnums=(2, 3))
+        def decode(params, tokens, pool_k, pool_v, positions, write_slots,
+                   gather_slots, kv_valid_len, temperature, top_p, rng):
+            logits, k, v = llama.paged_forward(
+                params, cfg, tokens, positions, pool_k, pool_v,
+                write_slots, gather_slots, kv_valid_len,
+            )
+            next_tokens = sample_tokens(rng, logits[:, 0], temperature, top_p)
+            return next_tokens, k, v
+
+        return decode
+
+    def _decode(self, outputs: List[StepOutput]) -> None:
+        # Make sure every active row has a page for its next position,
+        # preempting the youngest sequence and restarting the check whenever
+        # the pool runs dry (each preemption removes one active row, so this
+        # terminates). Restarting from a fresh slot snapshot avoids touching
+        # sequences that were just preempted out.
+        while True:
+            active = [(i, s) for i, s in enumerate(self.slots) if s is not None]
+            if not active:
+                return
+            if all(self._ensure_page(seq) for _, seq in active):
+                break
+            self._preempt_youngest(outputs)
+
+        B = self.ecfg.max_batch
+        tokens = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B, 1), np.int32)
+        write_slots = np.full((B, 1), self._num_slots_flat, np.int32)  # drop
+        kv_valid = np.zeros((B,), np.int32)
+        temp = np.ones((B,), np.float32)
+        top_p = np.ones((B,), np.float32)
+        tables: List[List[int]] = [[] for _ in range(B)]
+
+        for i, seq in active:
+            tokens[i, 0] = seq.next_token
+            positions[i, 0] = seq.seq_len
+            write_slots[i, 0] = self._slot_for_position(seq.block_table, seq.seq_len)
+            kv_valid[i] = seq.seq_len + 1
+            temp[i] = seq.params.temperature
+            top_p[i] = seq.params.top_p
+            tables[i] = seq.block_table
+
+        gather = self._gather_slots(tables)
+        self._rng, sub = jax.random.split(self._rng)
+        next_tokens, self.state.k, self.state.v = self._decode_fn(
+            self.params,
+            jnp.asarray(tokens),
+            self.state.k,
+            self.state.v,
+            jnp.asarray(positions),
+            jnp.asarray(write_slots),
+            jnp.asarray(gather),
+            jnp.asarray(kv_valid),
+            jnp.asarray(temp),
+            jnp.asarray(top_p),
+            sub,
+        )
+        next_np = np.asarray(next_tokens)
+
+        for i, seq in active:
+            try:
+                seq.token_ids.append(seq.next_token)
+                seq.seq_len += 1
+                self._emit_token(seq, int(next_np[i]), outputs)
+            except Exception as e:  # failure isolation (Property 22)
+                self.slots[i] = None
+                self._by_id.pop(seq.request_id, None)
+                self._release_seq(seq)
+                outputs.append(StepOutput(
+                    request_id=seq.request_id, finished=True, error=str(e)))
+
+    # ------------------------------------------------------------------
+    # token emission & completion
+    # ------------------------------------------------------------------
+
+    def _emit_token(self, seq: _Seq, token_id: int, outputs: List[StepOutput]) -> None:
+        """Process one sampled token: EOS / length / stop-sequence handling
+        and the streaming text delta with stop-sequence holdback."""
+        p = seq.params
+        if token_id in self.tok.eos_ids:
+            self._finish(seq, FinishReason.STOP, outputs)
+            return
+
+        seq.next_token = token_id
+        seq.emitted_tokens += 1
+        piece = self.tok.decode_token(token_id)
+        seq.output_text += piece
+
+        # stop sequences: scan the un-emitted tail
+        if p.stop_sequences:
+            earliest = -1
+            for stop in p.stop_sequences:
+                idx = seq.output_text.find(stop, max(0, seq.emitted_upto - len(stop)))
+                if idx >= 0 and (earliest < 0 or idx < earliest):
+                    earliest = idx
+            if earliest >= 0:
+                seq.output_text = seq.output_text[:earliest]
+                self._finish(seq, FinishReason.STOP_SEQUENCE, outputs)
+                return
+
+        if (
+            seq.emitted_tokens >= p.max_tokens
+            or seq.seq_len + 1 >= self.pcfg.max_seq_len
+        ):
+            # final token: emit its id, then the completion (which flushes
+            # all held-back text)
+            outputs.append(StepOutput(
+                request_id=seq.request_id,
+                token_id=token_id,
+                text="",
+                token_index=seq.emitted_tokens - 1,
+            ))
+            self._finish(seq, FinishReason.LENGTH, outputs)
+            return
+
+        # emit the delta, holding back a possible stop-sequence prefix
+        hold = max((len(s) for s in p.stop_sequences), default=1) - 1
+        safe_upto = max(seq.emitted_upto, len(seq.output_text) - hold)
+        delta = seq.output_text[seq.emitted_upto : safe_upto]
+        seq.emitted_upto = safe_upto
+        outputs.append(StepOutput(
+            request_id=seq.request_id,
+            token_id=token_id,
+            text=delta,
+            token_index=seq.emitted_tokens - 1,
+        ))
+
+    def _finish(self, seq: _Seq, reason: FinishReason,
+                outputs: List[StepOutput]) -> None:
+        # flush held-back text
+        delta = seq.output_text[seq.emitted_upto :]
+        usage = Usage.of(seq.prompt_len, seq.emitted_tokens)
+        outputs.append(StepOutput(
+            request_id=seq.request_id,
+            text=delta,
+            finished=True,
+            finish_reason=reason,
+            usage=usage,
+        ))
+        for i, s in enumerate(self.slots):
+            if s is seq:
+                self.slots[i] = None
+        self._by_id.pop(seq.request_id, None)
+        # publish full pages for prefix reuse, then drop our references
+        self.allocator.publish(seq.token_ids, seq.block_table)
+        self._release_seq(seq)
+
+    def _release_seq(self, seq: _Seq) -> None:
+        if seq.block_table:
+            self.allocator.release(seq.block_table)
+            seq.block_table = []
+
+    # ------------------------------------------------------------------
+    # paging helpers
+    # ------------------------------------------------------------------
+
+    def _ensure_page(self, seq: _Seq) -> bool:
+        """Guarantee a page exists for position seq.seq_len; allocate on
+        demand. False if the pool is exhausted."""
+        ps = self.pcfg.page_size
+        needed = seq.seq_len // ps + 1
+        if len(seq.block_table) >= needed:
+            return True
+        if len(seq.block_table) >= self.pcfg.max_pages_per_seq:
+            return True  # max-length stop will trigger instead
+        try:
+            seq.block_table.extend(self.allocator.allocate(1))
+            return True
+        except CacheFull:
+            return False
+
+    def _preempt_youngest(self, outputs: List[StepOutput]) -> None:
+        """Release the youngest active sequence back to the waiting queue
+        (its pages freed) to relieve page pressure."""
+        youngest: Optional[_Seq] = None
+        for s in self.slots:
+            if s is not None and (
+                youngest is None or s.num_output_tokens() < youngest.num_output_tokens()
+            ):
+                youngest = s
+        if youngest is not None:
+            self._preempt(youngest, outputs)
+
+    def _preempt(self, seq: _Seq, outputs: List[StepOutput]) -> None:
+        for i, s in enumerate(self.slots):
+            if s is seq:
+                self.slots[i] = None
+        self._release_seq(seq)
+        seq.preempted = True
+        seq.seq_len = 0
+        seq.shared_pages = 0
+        # between steps the sampled-but-undecoded token is never in
+        # token_ids; fold it in so re-prefill resumes exactly where we left
+        if seq.next_token is not None:
+            seq.token_ids.append(seq.next_token)
+            seq.next_token = None
+        self.waiting.appendleft(seq)
+
+    def _slot_for_position(self, table: List[int], pos: int) -> int:
+        ps = self.pcfg.page_size
+        page = pos // ps
+        if page >= len(table):
+            return self._num_slots_flat  # dropped write
+        return table[page] * ps + pos % ps
+
+    def _slots_for_positions(
+        self, table: List[int], positions: np.ndarray, valid: int
+    ) -> np.ndarray:
+        ps = self.pcfg.page_size
+        out = np.full_like(positions, self._num_slots_flat)
+        flat = positions[0]
+        for j in range(valid):
+            pos = int(flat[j])
+            page = pos // ps
+            if page < len(table):
+                out[0, j] = table[page] * ps + pos % ps
+        return out
+
+    def _gather_slots(self, tables: List[List[int]]) -> np.ndarray:
+        """[B, S_max] flat slots covering each row's block table (padded
+        with slot 0; masked by kv_valid_len)."""
+        ps = self.pcfg.page_size
+        B = max(len(tables), 1)
+        out = np.zeros((B, self._smax), np.int32)
+        offs = np.arange(ps, dtype=np.int32)
+        for b, table in enumerate(tables):
+            for p, page in enumerate(table[: self.pcfg.max_pages_per_seq]):
+                out[b, p * ps : (p + 1) * ps] = page * ps + offs
+        return out
+
+    # ------------------------------------------------------------------
+    # embeddings (the /embeddings endpoint's compute)
+    # ------------------------------------------------------------------
+
+    def embed_ids(self, ids_list: List[List[int]]) -> np.ndarray:
+        """Mean-pooled, L2-normalized final hidden states per input.
+
+        Inputs longer than the largest prefill bucket are processed in
+        bucket-sized chunks and pooled with length weighting — no silent
+        truncation."""
+        max_bucket = self.ecfg.prefill_buckets[-1]
+        sums = np.zeros((len(ids_list), self.cfg.hidden_size), np.float32)
+        counts = np.zeros((len(ids_list),), np.float32)
+
+        # (input index, chunk ids) work list
+        work: List[Tuple[int, List[int]]] = []
+        for b, row in enumerate(ids_list):
+            for start in range(0, len(row), max_bucket):
+                work.append((b, row[start : start + max_bucket]))
+
+        for start in range(0, len(work), self.ecfg.max_batch):
+            batch = work[start : start + self.ecfg.max_batch]
+            bucket = self._pick_bucket(max(len(c) for _, c in batch))
+            B = len(batch)
+            ids = np.zeros((B, bucket), np.int32)
+            lens = np.zeros((B,), np.int32)
+            for j, (_, chunk) in enumerate(batch):
+                ids[j, : len(chunk)] = chunk
+                lens[j] = len(chunk)
+            h = llama.hidden_states(
+                self.params,
+                self.cfg,
+                jnp.asarray(ids),
+                jnp.broadcast_to(jnp.arange(bucket), (B, bucket)),
+                jnp.asarray(lens),
+            )
+            h = np.asarray(h)
+            mask = (np.arange(bucket)[None, :] < lens[:, None]).astype(np.float32)
+            for j, (b, _) in enumerate(batch):
+                sums[b] += (h[j] * mask[j][:, None]).sum(0)
+                counts[b] += mask[j].sum()
+
+        pooled = sums / np.maximum(counts, 1.0)[:, None]
+        norms = np.linalg.norm(pooled, axis=-1, keepdims=True)
+        return pooled / np.maximum(norms, 1e-9)

@@ -1,0 +1,367 @@
+"""Paged KV cache: HBM page pool + host-side allocator with prefix reuse.
+
+TPU-native redesign of the reference's spec'd KV cache manager
+(``design.md:369-412`` [spec]): instead of host-side per-request
+``Vec<Vec<f32>>`` tensors keyed by full token sequences, K/V live in a fixed
+pool of HBM pages per layer and sequences hold *block tables* (page-id lists).
+The reference's semantics are preserved on top of paging:
+
+- **Prefix reuse** (Property 9, design.md:734-738): full pages are content-
+  addressed by a hash chain over token blocks; a new request walks the chain
+  and shares every matching page (refcounted, copy-on-write by construction —
+  shared pages are never written, the first divergent token starts a fresh
+  page).
+- **LRU eviction** (Property 10-11, design.md:740-756): pages whose refcount
+  drops to zero stay in the prefix cache with an access clock, and are
+  reclaimed least-recently-used first when the free list runs dry.
+- **Serialize/deserialize** (Property 12): a sequence's pages can be pulled
+  to host as bytes and restored — the host-offload path for HBM pressure.
+
+The device side is deliberately dumb: one flat slot-indexed buffer per layer
+([L, num_pages*page_size, KV, D]); gather/scatter by flat slot indices is the
+pure-XLA reference path, and the Pallas ragged-paged-attention kernel
+(ops/pallas/) consumes the same block tables without the gather.
+"""
+
+from __future__ import annotations
+
+import io
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_inference_server_tpu.core.errors import CacheDeserializationError, CacheFull
+from distributed_inference_server_tpu.models.configs import ModelConfig
+
+
+# ---------------------------------------------------------------------------
+# Device-side page pool
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PagedCacheConfig:
+    num_pages: int = 256
+    page_size: int = 16  # tokens per page
+    max_pages_per_seq: int = 16
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.page_size * self.max_pages_per_seq
+
+
+class PagedKVState:
+    """Device buffers for the paged cache: k, v are
+    [num_layers, num_pages * page_size, num_kv_heads, head_dim]."""
+
+    __slots__ = ("k", "v")
+
+    def __init__(self, k: jnp.ndarray, v: jnp.ndarray):
+        self.k = k
+        self.v = v
+
+    @classmethod
+    def create(
+        cls, cfg: ModelConfig, pcfg: PagedCacheConfig, dtype=jnp.bfloat16
+    ) -> "PagedKVState":
+        shape = (
+            cfg.num_layers,
+            pcfg.num_pages * pcfg.page_size,
+            cfg.num_kv_heads,
+            cfg.head_dim,
+        )
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+def flat_slots(
+    block_tables: jnp.ndarray, positions: jnp.ndarray, page_size: int
+) -> jnp.ndarray:
+    """Map absolute token positions to flat pool slots.
+
+    block_tables: [B, max_pages] page ids; positions: [B, T] absolute
+    positions. Returns [B, T] flat slot indices (garbage where the position
+    exceeds the table — callers mask with out-of-range drops).
+    """
+    page_idx = positions // page_size  # [B, T]
+    offset = positions % page_size
+    rows = jnp.arange(block_tables.shape[0])[:, None]
+    page_ids = block_tables[rows, page_idx]  # [B, T]
+    return page_ids * page_size + offset
+
+
+# ---------------------------------------------------------------------------
+# Host-side page allocator with prefix cache
+# ---------------------------------------------------------------------------
+
+
+def _chunk_hash(prev: int, tokens: Tuple[int, ...]) -> int:
+    """Stable hash chain over token blocks (content address of a full page)."""
+    h = hash((prev,) + tokens)
+    return h & 0x7FFFFFFFFFFFFFFF
+
+
+@dataclass
+class _CachedPage:
+    page_id: int
+    refcount: int = 0
+    last_accessed: float = field(default_factory=time.monotonic)
+
+
+@dataclass(frozen=True)
+class CacheStats:
+    """Hit/miss/eviction counters (reference design.md:404-411 [spec])."""
+
+    hits: int
+    misses: int
+    evictions: int
+    pages_total: int
+    pages_free: int
+    pages_cached: int  # refcount-0 pages retained for prefix reuse
+    memory_used_frac: float
+
+
+class PageAllocator:
+    """Host bookkeeping for the device page pool.
+
+    Pages move between three states: FREE (never cached / evicted), ACTIVE
+    (refcount > 0, held by live or cached prefixes), and CACHED (refcount 0
+    but content-addressed, reclaimable LRU). Matches the reference's cache
+    manager contract (get/get_prefix/put/evict_lru/stats,
+    design.md:393-402 [spec]) reinterpreted over pages.
+    """
+
+    def __init__(self, cfg: PagedCacheConfig):
+        self.cfg = cfg
+        self._free: List[int] = list(range(cfg.num_pages - 1, -1, -1))
+        # content address -> cached page
+        self._by_hash: Dict[int, _CachedPage] = {}
+        # page_id -> (hash, _CachedPage) for pages that are content-addressed
+        self._by_page: Dict[int, Tuple[int, _CachedPage]] = {}
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+
+    # -- queries -----------------------------------------------------------
+
+    def num_free(self) -> int:
+        """Pages allocatable right now (free list + LRU-reclaimable)."""
+        reclaimable = sum(1 for p in self._by_hash.values() if p.refcount == 0)
+        return len(self._free) + reclaimable
+
+    def stats(self) -> CacheStats:
+        cached = sum(1 for p in self._by_hash.values() if p.refcount == 0)
+        used = self.cfg.num_pages - len(self._free) - cached
+        return CacheStats(
+            hits=self._hits,
+            misses=self._misses,
+            evictions=self._evictions,
+            pages_total=self.cfg.num_pages,
+            pages_free=len(self._free),
+            pages_cached=cached,
+            memory_used_frac=1.0 - (len(self._free) + cached) / self.cfg.num_pages,
+        )
+
+    def hit_rate(self) -> float:
+        total = self._hits + self._misses
+        return self._hits / total if total else 0.0
+
+    # -- prefix matching (Property 9) --------------------------------------
+
+    def match_prefix(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest-prefix match over full pages.
+
+        Returns (shared page ids, matched token count). Each returned page's
+        refcount is incremented (caller owns a reference) and its access
+        clock refreshed (Property 11).
+        """
+        ps = self.cfg.page_size
+        shared: List[int] = []
+        h = 0
+        now = time.monotonic()
+        for start in range(0, len(tokens) - ps + 1, ps):
+            chunk = tuple(tokens[start : start + ps])
+            h = _chunk_hash(h, chunk)
+            entry = self._by_hash.get(h)
+            if entry is None:
+                break
+            entry.refcount += 1
+            entry.last_accessed = now
+            shared.append(entry.page_id)
+            self._hits += 1
+        if not shared:
+            self._misses += 1
+        return shared, len(shared) * ps
+
+    # -- allocation --------------------------------------------------------
+
+    def allocate(self, n: int) -> List[int]:
+        """Allocate n fresh pages, reclaiming LRU cached pages if needed.
+        Raises CacheFull when not enough pages exist (Property 10: eviction
+        is LRU over refcount-0 content-addressed pages)."""
+        if self.num_free() < n:
+            raise CacheFull()
+        out: List[int] = []
+        for _ in range(n):
+            if self._free:
+                out.append(self._free.pop())
+            else:
+                out.append(self._evict_lru_one())
+        return out
+
+    def _evict_lru_one(self) -> int:
+        victim_hash = None
+        victim: Optional[_CachedPage] = None
+        for h, page in self._by_hash.items():
+            if page.refcount == 0 and (
+                victim is None or page.last_accessed < victim.last_accessed
+            ):
+                victim_hash, victim = h, page
+        if victim is None:
+            raise CacheFull()
+        del self._by_hash[victim_hash]
+        self._by_page.pop(victim.page_id, None)
+        self._evictions += 1
+        return victim.page_id
+
+    # -- publishing & release ---------------------------------------------
+
+    def publish(self, tokens: Sequence[int], page_ids: Sequence[int]) -> None:
+        """Content-address the full pages of a sequence so future requests
+        can share them (the paged analogue of cache ``put``,
+        design.md:397 [spec]). Caller must hold a reference to every page;
+        publishing adds the content address without changing refcounts,
+        except when an identical page is already published — then the
+        duplicate page is NOT published (the existing one wins).
+        """
+        ps = self.cfg.page_size
+        h = 0
+        now = time.monotonic()
+        for i, start in enumerate(range(0, len(tokens) - ps + 1, ps)):
+            if i >= len(page_ids):
+                break
+            chunk = tuple(tokens[start : start + ps])
+            h = _chunk_hash(h, chunk)
+            entry = self._by_hash.get(h)
+            if entry is None:
+                page_id = page_ids[i]
+                if page_id in self._by_page:
+                    continue  # already addressed under another chain
+                entry = _CachedPage(page_id=page_id, refcount=1, last_accessed=now)
+                self._by_hash[h] = entry
+                self._by_page[page_id] = (h, entry)
+            elif entry.page_id != page_ids[i]:
+                # identical content already cached under a different page;
+                # keep ours unpublished (it will be freed on release)
+                continue
+
+    def retain(self, page_ids: Sequence[int]) -> None:
+        """Increment refcounts for content-addressed pages (e.g. when forking
+        a sequence)."""
+        for pid in page_ids:
+            if pid in self._by_page:
+                self._by_page[pid][1].refcount += 1
+
+    def release(self, page_ids: Sequence[int]) -> None:
+        """Drop one reference per page. Content-addressed pages with zero
+        refs stay CACHED (reclaimable LRU); unaddressed pages return to the
+        free list immediately."""
+        now = time.monotonic()
+        for pid in page_ids:
+            addressed = self._by_page.get(pid)
+            if addressed is None:
+                self._free.append(pid)
+            else:
+                entry = addressed[1]
+                entry.refcount = max(0, entry.refcount - 1)
+                entry.last_accessed = now
+
+    def touch(self, page_ids: Sequence[int]) -> None:
+        """Refresh access clocks (Property 11)."""
+        now = time.monotonic()
+        for pid in page_ids:
+            if pid in self._by_page:
+                self._by_page[pid][1].last_accessed = now
+
+    def evict_below(self, target_frac: float) -> int:
+        """Aggressively reclaim cached pages until memory_used (incl. cached)
+        is below target_frac of the pool — the graceful-degradation hook
+        (design.md:925-943 [spec]). Returns pages reclaimed."""
+        n = 0
+        while (self.cfg.num_pages - len(self._free)) / self.cfg.num_pages > target_frac:
+            try:
+                self._free.append(self._evict_lru_one())
+                n += 1
+            except CacheFull:
+                break
+        return n
+
+
+# ---------------------------------------------------------------------------
+# Serialize / deserialize (Property 12) — host offload of a sequence's pages
+# ---------------------------------------------------------------------------
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including ml_dtypes extensions (bfloat16)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def serialize_kv(
+    state: PagedKVState, page_ids: Sequence[int], page_size: int,
+    token_count: int,
+) -> bytes:
+    """Pull a sequence's K/V pages to host and pack them with metadata.
+    K/V are stored as raw bytes + dtype name because np.savez silently
+    degrades ml_dtypes arrays (bfloat16, the engine default) to void."""
+    slots = np.concatenate(
+        [np.arange(p * page_size, (p + 1) * page_size) for p in page_ids]
+    )
+    k = np.asarray(state.k[:, slots])
+    v = np.asarray(state.v[:, slots])
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        k=np.frombuffer(k.tobytes(), np.uint8),
+        v=np.frombuffer(v.tobytes(), np.uint8),
+        shape=np.asarray(k.shape, np.int64),
+        dtype=np.frombuffer(str(k.dtype).encode(), np.uint8),
+        token_count=np.int64(token_count),
+    )
+    return buf.getvalue()
+
+
+def deserialize_kv(
+    state: PagedKVState, data: bytes, page_ids: Sequence[int], page_size: int
+) -> Tuple[PagedKVState, int]:
+    """Restore serialized pages into freshly-allocated page ids. Returns the
+    updated device state and the token count."""
+    try:
+        with np.load(io.BytesIO(data)) as z:
+            shape = tuple(z["shape"])
+            dtype = _np_dtype(bytes(z["dtype"]).decode())
+            k = np.frombuffer(z["k"].tobytes(), dtype).reshape(shape)
+            v = np.frombuffer(z["v"].tobytes(), dtype).reshape(shape)
+            token_count = int(z["token_count"])
+    except Exception as e:
+        raise CacheDeserializationError(str(e)) from None
+    slots = np.concatenate(
+        [np.arange(p * page_size, (p + 1) * page_size) for p in page_ids]
+    )
+    if k.shape[1] != len(slots):
+        raise CacheDeserializationError(
+            f"page count mismatch: payload {k.shape[1]} slots, target {len(slots)}"
+        )
+    try:
+        new_k = state.k.at[:, slots].set(jnp.asarray(k))
+        new_v = state.v.at[:, slots].set(jnp.asarray(v))
+    except Exception as e:
+        raise CacheDeserializationError(str(e)) from None
+    return PagedKVState(new_k, new_v), token_count

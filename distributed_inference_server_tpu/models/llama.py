@@ -154,12 +154,21 @@ def _run_layers(
     cfg: ModelConfig,
     input_ids: jnp.ndarray,
     positions: jnp.ndarray,
-    cache: KVCache,
-    write_pos: jnp.ndarray,
-    kv_valid_len: jnp.ndarray,
-) -> Tuple[jnp.ndarray, KVCache]:
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    write_fn,
+    attend_fn,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Shared transformer trunk: embed, scan layer blocks, final norm.
-    Returns (normed hidden states [B, T, H], updated cache)."""
+
+    The cache backend is pluggable: ``write_fn(cache_layer, new_kv) ->
+    cache_layer`` scatters the new tokens' K/V into one layer's cache;
+    ``attend_fn(q, k_layer, v_layer) -> out`` runs attention against it.
+    Dense (contiguous) and paged backends both route through here, so the
+    block body exists exactly once.
+
+    Returns (normed hidden [B, T, H], new cache_k, new cache_v).
+    """
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     h = params["embed"][input_ids]  # [B, T, H]
     B, T, H = h.shape
@@ -173,18 +182,23 @@ def _run_layers(
         v = (x @ layer["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        k_layer = _write_kv(k_layer, k, write_pos)
-        v_layer = _write_kv(v_layer, v, write_pos)
-        attn = gqa_attention(q, k_layer, v_layer, positions, kv_valid_len)
+        k_layer = write_fn(k_layer, k)
+        v_layer = write_fn(v_layer, v)
+        attn = attend_fn(q, k_layer, v_layer)
         h = h + attn.reshape(B, T, cfg.q_size) @ layer["wo"]
         # mlp
         x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
         h = h + (_moe_mlp(x, layer, cfg) if cfg.is_moe else _mlp(x, layer))
         return h, (k_layer, v_layer)
 
-    h, (new_k, new_v) = lax.scan(block, h, (params["layers"], cache.k, cache.v))
+    h, (new_k, new_v) = lax.scan(block, h, (params["layers"], cache_k, cache_v))
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-    return h, KVCache(k=new_k, v=new_v)
+    return h, new_k, new_v
+
+
+def _unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
+    unembed = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return jnp.einsum("bth,hv->btv", h, unembed, preferred_element_type=jnp.float32)
 
 
 def forward(
@@ -208,12 +222,55 @@ def forward(
 
     Returns: (logits [B, T, vocab] f32, updated cache).
     """
-    h, cache = _run_layers(
-        params, cfg, input_ids, positions, cache, write_pos, kv_valid_len
+    write_fn = lambda layer, new: _write_kv(layer, new, write_pos)
+    attend_fn = lambda q, k, v: gqa_attention(q, k, v, positions, kv_valid_len)
+    h, new_k, new_v = _run_layers(
+        params, cfg, input_ids, positions, cache.k, cache.v, write_fn, attend_fn
     )
-    unembed = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    logits = jnp.einsum("bth,hv->btv", h, unembed, preferred_element_type=jnp.float32)
-    return logits, cache
+    return _unembed(params, cfg, h), KVCache(k=new_k, v=new_v)
+
+
+def paged_forward(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    write_slots: jnp.ndarray,
+    gather_slots: jnp.ndarray,
+    kv_valid_len: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Forward pass over the paged KV pool (engine/kv_cache.py).
+
+    Args:
+      input_ids, positions: [B, T] new tokens and absolute positions.
+      pool_k, pool_v: [L, num_slots, KV, D] flat page pools (num_slots =
+        num_pages * page_size).
+      write_slots: [B, T] flat pool slot per new token (>= num_slots drops
+        the write — padding / inactive rows).
+      gather_slots: [B, S_max] flat slots covering each row's block table
+        (S_max = max_pages_per_seq * page_size).
+      kv_valid_len: [B] tokens valid in each row's gathered window.
+
+    Returns (logits [B, T, V] f32, new pool_k, new pool_v). This is the
+    pure-XLA reference path (gather-then-dense-attend); the Pallas ragged
+    paged attention kernel replaces attend without the gather.
+    """
+
+    def write_fn(layer, new):
+        # layer: [num_slots, KV, D]; new: [B, T, KV, D]
+        return layer.at[write_slots].set(new, mode="drop")
+
+    def attend_fn(q, k_layer, v_layer):
+        k_seq = k_layer[gather_slots]  # [B, S_max, KV, D]
+        v_seq = v_layer[gather_slots]
+        return gqa_attention(q, k_seq, v_seq, positions, kv_valid_len)
+
+    h, new_k, new_v = _run_layers(
+        params, cfg, input_ids, positions, pool_k, pool_v, write_fn, attend_fn
+    )
+    return _unembed(params, cfg, h), new_k, new_v
 
 
 def hidden_states(
@@ -227,7 +284,9 @@ def hidden_states(
     endpoint: a cache-less full forward. Returns [B, T, H] f32."""
     B, T = input_ids.shape
     cache = KVCache.create(cfg, B, T, dtype=params["embed"].dtype)
-    h, _ = _run_layers(
-        params, cfg, input_ids, positions, cache, positions, kv_valid_len
+    write_fn = lambda layer, new: _write_kv(layer, new, positions)
+    attend_fn = lambda q, k, v: gqa_attention(q, k, v, positions, kv_valid_len)
+    h, _, _ = _run_layers(
+        params, cfg, input_ids, positions, cache.k, cache.v, write_fn, attend_fn
     )
     return h.astype(jnp.float32)

@@ -1,0 +1,262 @@
+"""Continuous-batching engine tests: correctness against the static
+generation path, batching isolation, prefix reuse, preemption recovery,
+stop handling, and failure isolation (Properties 9, 21, 22)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_inference_server_tpu.core.models import FinishReason
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.generate import greedy_generate
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+TOK = ByteTokenizer()
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+
+
+def make_engine(tiny_params, num_pages=32, page_size=4, max_pages_per_seq=8,
+                max_batch=4):
+    return LLMEngine(
+        tiny_params,
+        TINY,
+        TOK,
+        EngineConfig(
+            max_batch=max_batch,
+            prefill_buckets=(8, 32),
+            paged=PagedCacheConfig(
+                num_pages=num_pages,
+                page_size=page_size,
+                max_pages_per_seq=max_pages_per_seq,
+            ),
+        ),
+        dtype=jnp.float32,
+    )
+
+
+def run_to_completion(engine, max_steps=500):
+    """Drive step() until idle; returns per-request aggregated results."""
+    results = {}
+    for _ in range(max_steps):
+        if not engine.has_work():
+            break
+        for out in engine.step():
+            r = results.setdefault(
+                out.request_id,
+                {"text": "", "tokens": [], "finish": None, "error": None,
+                 "usage": None},
+            )
+            r["text"] += out.text
+            if out.token_id is not None:
+                r["tokens"].append(out.token_id)
+            if out.finished:
+                r["finish"] = out.finish_reason
+                r["error"] = out.error
+                r["usage"] = out.usage
+    assert not engine.has_work(), "engine did not drain"
+    return results
+
+
+GREEDY = SamplingParams(max_tokens=8, temperature=0.0)
+
+
+def test_engine_matches_static_generate(tiny_params):
+    engine = make_engine(tiny_params)
+    prompt = TOK.encode("hello")
+    engine.add_request("r1", prompt, GREEDY)
+    results = run_to_completion(engine)
+    expected = greedy_generate(
+        tiny_params, TINY, prompt, max_new_tokens=8, max_seq=32,
+        eos_ids=TOK.eos_ids,
+    )
+    assert results["r1"]["tokens"] == expected
+    assert results["r1"]["finish"] == FinishReason.LENGTH
+    assert results["r1"]["usage"].prompt_tokens == len(prompt)
+    assert results["r1"]["usage"].completion_tokens == 8
+
+
+def test_concurrent_requests_isolated(tiny_params):
+    # batch-mates must not affect each other's tokens (Property 21/22 analog)
+    engine = make_engine(tiny_params)
+    prompts = {f"r{i}": TOK.encode(f"prompt number {i}") for i in range(4)}
+    for rid, ids in prompts.items():
+        engine.add_request(rid, ids, GREEDY)
+    results = run_to_completion(engine)
+    for rid, ids in prompts.items():
+        solo = greedy_generate(
+            tiny_params, TINY, ids, max_new_tokens=8, max_seq=32,
+            eos_ids=TOK.eos_ids,
+        )
+        assert results[rid]["tokens"] == solo, rid
+
+
+def test_more_requests_than_slots(tiny_params):
+    engine = make_engine(tiny_params, max_batch=2)
+    for i in range(5):
+        engine.add_request(f"r{i}", TOK.encode(f"req {i}"), GREEDY)
+    results = run_to_completion(engine)
+    assert len(results) == 5
+    for rid, r in results.items():
+        assert r["finish"] == FinishReason.LENGTH and len(r["tokens"]) == 8
+
+
+def test_prefix_reuse_hits_and_same_output(tiny_params):
+    engine = make_engine(tiny_params)
+    prompt = TOK.encode("shared prefix, reuse")  # 21 ids: > 1 full page
+    engine.add_request("first", prompt, GREEDY)
+    first = run_to_completion(engine)["first"]
+    assert engine.allocator.stats().pages_cached > 0
+
+    engine.add_request("second", prompt, GREEDY)
+    second = run_to_completion(engine)["second"]
+    assert engine.allocator.stats().hits > 0  # shared pages (Property 9)
+    assert second["tokens"] == first["tokens"]  # numerically identical path
+
+
+def test_preemption_under_page_pressure(tiny_params):
+    # tiny pool: 2 concurrent requests cannot both hold their full length
+    engine = make_engine(tiny_params, num_pages=8, page_size=4,
+                        max_pages_per_seq=6, max_batch=2)
+    p1 = TOK.encode("abcdefgh")  # 9 ids incl BOS
+    p2 = TOK.encode("12345678")
+    engine.add_request("a", p1, SamplingParams(max_tokens=10, temperature=0.0))
+    engine.add_request("b", p2, SamplingParams(max_tokens=10, temperature=0.0))
+    results = run_to_completion(engine)
+    for rid, prompt in (("a", p1), ("b", p2)):
+        solo = greedy_generate(
+            tiny_params, TINY, prompt, max_new_tokens=10, max_seq=24,
+            eos_ids=TOK.eos_ids,
+        )
+        assert results[rid]["tokens"] == solo, rid
+        assert results[rid]["error"] is None
+    # preemption must not leak pages (every page free or cached afterwards)
+    s = engine.allocator.stats()
+    assert s.pages_free + s.pages_cached == s.pages_total
+
+
+def test_stop_sequence_truncates_and_finishes(tiny_params):
+    engine = make_engine(tiny_params)
+    prompt = TOK.encode("hello")
+    # discover the greedy text first
+    engine.add_request("probe", prompt, GREEDY)
+    text = run_to_completion(engine)["probe"]["text"]
+    assert len(text) >= 3
+    stop = text[1:3]  # a substring that will occur
+    engine.add_request(
+        "s", prompt,
+        SamplingParams(max_tokens=8, temperature=0.0, stop_sequences=(stop,)),
+    )
+    r = run_to_completion(engine)["s"]
+    assert r["finish"] == FinishReason.STOP_SEQUENCE
+    assert stop not in r["text"]
+    assert r["text"] == text[: text.find(stop)]
+
+
+def test_eos_finishes_with_stop(tiny_params):
+    engine = make_engine(tiny_params)
+    prompt = TOK.encode("hello")
+    engine.add_request("probe", prompt, SamplingParams(max_tokens=1, temperature=0.0))
+    first_tok = run_to_completion(engine)["probe"]["tokens"][0]
+
+    class EosTok(ByteTokenizer):
+        def __init__(self, eos):
+            super().__init__()
+            self.eos_ids = (eos,)
+
+    engine2 = LLMEngine(
+        tiny_params, TINY, EosTok(first_tok),
+        EngineConfig(max_batch=2, prefill_buckets=(8, 32),
+                     paged=PagedCacheConfig(num_pages=32, page_size=4,
+                                            max_pages_per_seq=8)),
+        dtype=jnp.float32,
+    )
+    engine2.add_request("e", prompt, GREEDY)
+    r = run_to_completion(engine2)["e"]
+    assert r["finish"] == FinishReason.STOP
+    assert r["tokens"] == []
+    assert r["usage"].completion_tokens == 0
+
+
+def test_oversized_prompt_rejected_with_error(tiny_params):
+    engine = make_engine(tiny_params, num_pages=8, max_pages_per_seq=2)
+    engine.add_request("big", list(range(1, 40)), GREEDY)
+    r = run_to_completion(engine)["big"]
+    assert r["error"] is not None and "exceeds" in r["error"]
+
+
+def test_abort_releases_resources(tiny_params):
+    engine = make_engine(tiny_params)
+    prompt = TOK.encode("hello world")
+    engine.add_request("gone", prompt, SamplingParams(max_tokens=50, temperature=0.0))
+    engine.step()  # prefill + first decode
+    assert engine.num_active() == 1
+    assert engine.abort("gone")
+    assert engine.num_active() == 0
+    assert not engine.has_work()
+    s = engine.allocator.stats()
+    assert s.pages_free + s.pages_cached == s.pages_total
+
+
+def test_failure_isolation_bad_request(tiny_params):
+    # a request whose processing explodes must not take down batch-mates
+    engine = make_engine(tiny_params)
+    good = TOK.encode("good")
+    engine.add_request("ok", good, GREEDY)
+
+    bad = TOK.encode("bad")
+    engine.add_request("boom", bad, GREEDY)
+    seq = engine._by_id["boom"]
+
+    class Exploding(tuple):
+        def __iter__(self):  # poison the stop-sequence scan
+            raise RuntimeError("injected failure")
+
+    seq.params = SamplingParams(max_tokens=8, temperature=0.0)
+    object.__setattr__(seq.params, "stop_sequences", Exploding(("zzz",)))
+
+    results = run_to_completion(engine)
+    assert results["boom"]["error"] is not None
+    solo = greedy_generate(
+        tiny_params, TINY, good, max_new_tokens=8, max_seq=32,
+        eos_ids=TOK.eos_ids,
+    )
+    assert results["ok"]["tokens"] == solo
+    s = engine.allocator.stats()
+    assert s.pages_free + s.pages_cached == s.pages_total
+
+
+def test_embeddings_path(tiny_params):
+    engine = make_engine(tiny_params)
+    vecs = engine.embed_ids([TOK.encode("alpha"), TOK.encode("beta gamma")])
+    assert vecs.shape == (2, TINY.hidden_size)
+    norms = np.linalg.norm(vecs, axis=-1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+    # deterministic
+    vecs2 = engine.embed_ids([TOK.encode("alpha"), TOK.encode("beta gamma")])
+    np.testing.assert_allclose(vecs, vecs2, atol=1e-6)
+
+
+def test_embeddings_long_input_not_truncated(tiny_params):
+    # longer than the largest prefill bucket (32): chunk-pooled, not cut
+    engine = make_engine(tiny_params)
+    long_ids = [1 + (i % 200) for i in range(75)]
+    vec_full = engine.embed_ids([long_ids])[0]
+    vec_prefix = engine.embed_ids([long_ids[:32]])[0]
+    # the tail must influence the embedding
+    assert not np.allclose(vec_full, vec_prefix, atol=1e-4)
+    # and the chunked pooling must be deterministic
+    np.testing.assert_allclose(
+        vec_full, engine.embed_ids([long_ids])[0], atol=1e-6
+    )
