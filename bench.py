@@ -1,0 +1,140 @@
+"""Benchmark: continuous-batching decode throughput on one chip.
+
+Measures BASELINE.md config 2 (single-chip continuous batching) with a
+Llama-3.2-1B-shaped model (random bf16 weights — the environment has no
+network egress, so no checkpoints; throughput is weight-content-independent).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/s", "vs_baseline": N/2000}
+vs_baseline is against the north-star 2000 output tok/s/chip target
+(BASELINE.json; the reference itself publishes no numbers — BASELINE.md).
+
+Env knobs: BENCH_BATCH (8), BENCH_PROMPT (128), BENCH_NEW (128),
+BENCH_FORCE_CPU=1 (tiny-model smoke mode), BENCH_INIT_TIMEOUT_S (180).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _emit(obj) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def main() -> None:
+    force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
+    new_tokens = int(os.environ.get("BENCH_NEW", "128"))
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "180"))
+
+    # Watchdog: the single real TPU chip sits behind a one-process tunnel;
+    # if another process holds the claim, backend init blocks forever.
+    init_done = threading.Event()
+
+    def _watchdog():
+        if not init_done.wait(init_timeout):
+            _emit({
+                "metric": "decode_tokens_per_sec_llama1b_bf16",
+                "value": 0.0,
+                "unit": "tokens/s",
+                "vs_baseline": 0.0,
+                "error": f"device backend init exceeded {init_timeout}s "
+                         "(TPU tunnel busy?)",
+            })
+            os._exit(2)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    import jax
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    init_done.set()
+    platform = devices[0].platform
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import LLAMA_3_2_1B, TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+    if force_cpu:
+        cfg, dtype = TINY, jnp.float32
+        prompt_len, new_tokens = min(prompt_len, 16), min(new_tokens, 16)
+        paged = PagedCacheConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+        buckets = (32, 64)
+    else:
+        cfg, dtype = LLAMA_3_2_1B, jnp.bfloat16
+        pages_per_seq = -(-(prompt_len + new_tokens + 16) // 16)
+        paged = PagedCacheConfig(
+            num_pages=(batch + 2) * pages_per_seq + 16,
+            page_size=16,
+            max_pages_per_seq=pages_per_seq,
+        )
+        buckets = (prompt_len, max(256, prompt_len))
+
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    jax.block_until_ready(params)
+    engine = LLMEngine(
+        params, cfg, ByteTokenizer(),
+        EngineConfig(max_batch=batch, prefill_buckets=buckets, paged=paged),
+        dtype=dtype,
+    )
+
+    rng = np.random.default_rng(0)
+
+    def add(rid: str, n_new: int):
+        ids = rng.integers(1, min(cfg.vocab_size, 250), size=prompt_len).tolist()
+        engine.add_request(rid, ids, SamplingParams(
+            max_tokens=n_new, temperature=0.0, top_p=1.0))
+
+    def drain():
+        tokens = 0
+        while engine.has_work():
+            for out in engine.step():
+                if out.token_id is not None:
+                    tokens += 1
+        return tokens
+
+    # warm-up: compiles the prefill bucket + decode step
+    add("warmup", 4)
+    drain()
+
+    for i in range(batch):
+        add(f"r{i}", new_tokens)
+    t0 = time.perf_counter()
+    produced = drain()
+    elapsed = time.perf_counter() - t0
+
+    tput = produced / elapsed
+    _emit({
+        "metric": "decode_tokens_per_sec_llama1b_bf16"
+        if not force_cpu else "decode_tokens_per_sec_tiny_cpu",
+        "value": round(tput, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(tput / 2000.0, 4),
+        "platform": platform,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "total_tokens": produced,
+        "elapsed_s": round(elapsed, 3),
+    })
+
+
+if __name__ == "__main__":
+    main()

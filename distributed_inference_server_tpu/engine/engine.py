@@ -32,7 +32,7 @@ from __future__ import annotations
 import functools
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Deque, Dict, List, Optional, Sequence as Seq, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -86,9 +86,9 @@ class _Seq:
     """Host-side state of one in-flight request."""
 
     __slots__ = (
-        "request_id", "token_ids", "prompt_len", "block_table", "shared_pages",
+        "request_id", "token_ids", "prompt_len", "block_table",
         "seq_len", "next_token", "params", "output_text", "emitted_upto",
-        "emitted_tokens", "preempted",
+        "emitted_tokens",
     )
 
     def __init__(self, request_id: RequestId, prompt_ids: List[int],
@@ -97,14 +97,12 @@ class _Seq:
         self.token_ids: List[int] = list(prompt_ids)
         self.prompt_len = len(prompt_ids)
         self.block_table: List[int] = []
-        self.shared_pages = 0  # leading pages reused from the prefix cache
         self.seq_len = 0  # tokens with K/V resident in pages
         self.next_token: Optional[int] = None  # sampled, not yet decoded
         self.params = params
         self.output_text = ""
         self.emitted_upto = 0
         self.emitted_tokens = 0
-        self.preempted = False
 
     def num_output_tokens(self) -> int:
         return len(self.token_ids) - self.prompt_len
@@ -137,7 +135,10 @@ class LLMEngine:
         self._rng = jax.random.PRNGKey(self.ecfg.seed)
         self._num_slots_flat = self.pcfg.num_pages * self.pcfg.page_size
         self._smax = self.pcfg.max_pages_per_seq * self.pcfg.page_size
-        self._steps = 0
+        # per-slot gather rows, maintained incrementally as block tables
+        # grow (a full [B, S_max] rebuild per step is hot-path poison)
+        self._gather_rows = np.zeros((self.ecfg.max_batch, self._smax), np.int32)
+        self._gather_pages = np.zeros((self.ecfg.max_batch,), np.int32)
 
         # jit caches
         self._prefill_fns: Dict[int, Callable] = {}
@@ -188,7 +189,6 @@ class LLMEngine:
         outputs: List[StepOutput] = []
         self._admit(outputs)
         self._decode(outputs)
-        self._steps += 1
         return outputs
 
     def cache_stats(self):
@@ -235,6 +235,7 @@ class LLMEngine:
             self.waiting.popleft()
             if seq.request_id in self._by_id:  # not finished during prefill
                 self.slots[slot] = seq
+                self._refresh_gather_row(slot, seq, from_page=0)
 
     def _prefill_seq(self, seq: _Seq, outputs: List[StepOutput]) -> None:
         ps = self.pcfg.page_size
@@ -250,7 +251,6 @@ class LLMEngine:
             self.allocator.release([shared_pages.pop()])
             shared_tokens -= ps
         seq.block_table = list(shared_pages)
-        seq.shared_pages = len(shared_pages)
         seq.seq_len = shared_tokens
 
         # allocate the remaining pages for the prompt
@@ -355,6 +355,10 @@ class LLMEngine:
             if all(self._ensure_page(seq) for _, seq in active):
                 break
             self._preempt_youngest(outputs)
+        for i, seq in active:
+            if self._gather_pages[i] != len(seq.block_table):
+                self._refresh_gather_row(i, seq,
+                                         from_page=int(self._gather_pages[i]))
 
         B = self.ecfg.max_batch
         tokens = np.zeros((B, 1), np.int32)
@@ -363,7 +367,6 @@ class LLMEngine:
         kv_valid = np.zeros((B,), np.int32)
         temp = np.ones((B,), np.float32)
         top_p = np.ones((B,), np.float32)
-        tables: List[List[int]] = [[] for _ in range(B)]
 
         for i, seq in active:
             tokens[i, 0] = seq.next_token
@@ -372,9 +375,8 @@ class LLMEngine:
             kv_valid[i] = seq.seq_len + 1
             temp[i] = seq.params.temperature
             top_p[i] = seq.params.top_p
-            tables[i] = seq.block_table
 
-        gather = self._gather_slots(tables)
+        gather = self._gather_rows
         self._rng, sub = jax.random.split(self._rng)
         next_tokens, self.state.k, self.state.v = self._decode_fn(
             self.params,
@@ -520,9 +522,7 @@ class LLMEngine:
             if s is seq:
                 self.slots[i] = None
         self._release_seq(seq)
-        seq.preempted = True
         seq.seq_len = 0
-        seq.shared_pages = 0
         # between steps the sampled-but-undecoded token is never in
         # token_ids; fold it in so re-prefill resumes exactly where we left
         if seq.next_token is not None:
@@ -552,7 +552,8 @@ class LLMEngine:
 
     def _gather_slots(self, tables: List[List[int]]) -> np.ndarray:
         """[B, S_max] flat slots covering each row's block table (padded
-        with slot 0; masked by kv_valid_len)."""
+        with slot 0; masked by kv_valid_len). Used once per prefill; decode
+        uses the incrementally-maintained _gather_rows instead."""
         ps = self.pcfg.page_size
         B = max(len(tables), 1)
         out = np.zeros((B, self._smax), np.int32)
@@ -561,6 +562,16 @@ class LLMEngine:
             for p, page in enumerate(table[: self.pcfg.max_pages_per_seq]):
                 out[b, p * ps : (p + 1) * ps] = page * ps + offs
         return out
+
+    def _refresh_gather_row(self, slot: int, seq: _Seq, from_page: int) -> None:
+        """Rewrite the cached gather row for a slot from page index
+        ``from_page`` onward (block tables only grow while seated)."""
+        ps = self.pcfg.page_size
+        offs = np.arange(ps, dtype=np.int32)
+        table = seq.block_table[: self.pcfg.max_pages_per_seq]
+        for p in range(from_page, len(table)):
+            self._gather_rows[slot, p * ps : (p + 1) * ps] = table[p] * ps + offs
+        self._gather_pages[slot] = len(table)
 
     # ------------------------------------------------------------------
     # embeddings (the /embeddings endpoint's compute)

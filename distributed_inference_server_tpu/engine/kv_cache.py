@@ -27,8 +27,9 @@ from __future__ import annotations
 
 import io
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -140,6 +141,9 @@ class PageAllocator:
         self._by_hash: Dict[int, _CachedPage] = {}
         # page_id -> (hash, _CachedPage) for pages that are content-addressed
         self._by_page: Dict[int, Tuple[int, _CachedPage]] = {}
+        # refcount-0 content-addressed pages in LRU order (oldest first):
+        # page_id -> hash. Keeps allocate()/evict O(1) instead of scanning.
+        self._lru: "OrderedDict[int, int]" = OrderedDict()
         self._hits = 0
         self._misses = 0
         self._evictions = 0
@@ -148,12 +152,10 @@ class PageAllocator:
 
     def num_free(self) -> int:
         """Pages allocatable right now (free list + LRU-reclaimable)."""
-        reclaimable = sum(1 for p in self._by_hash.values() if p.refcount == 0)
-        return len(self._free) + reclaimable
+        return len(self._free) + len(self._lru)
 
     def stats(self) -> CacheStats:
-        cached = sum(1 for p in self._by_hash.values() if p.refcount == 0)
-        used = self.cfg.num_pages - len(self._free) - cached
+        cached = len(self._lru)
         return CacheStats(
             hits=self._hits,
             misses=self._misses,
@@ -175,7 +177,9 @@ class PageAllocator:
 
         Returns (shared page ids, matched token count). Each returned page's
         refcount is incremented (caller owns a reference) and its access
-        clock refreshed (Property 11).
+        clock refreshed (Property 11). Hit/miss counters are per page
+        lookup: each matched page is a hit, the lookup that breaks the chain
+        is one miss.
         """
         ps = self.cfg.page_size
         shared: List[int] = []
@@ -186,13 +190,14 @@ class PageAllocator:
             h = _chunk_hash(h, chunk)
             entry = self._by_hash.get(h)
             if entry is None:
+                self._misses += 1
                 break
+            if entry.refcount == 0:
+                self._lru.pop(entry.page_id, None)
             entry.refcount += 1
             entry.last_accessed = now
             shared.append(entry.page_id)
             self._hits += 1
-        if not shared:
-            self._misses += 1
         return shared, len(shared) * ps
 
     # -- allocation --------------------------------------------------------
@@ -212,19 +217,13 @@ class PageAllocator:
         return out
 
     def _evict_lru_one(self) -> int:
-        victim_hash = None
-        victim: Optional[_CachedPage] = None
-        for h, page in self._by_hash.items():
-            if page.refcount == 0 and (
-                victim is None or page.last_accessed < victim.last_accessed
-            ):
-                victim_hash, victim = h, page
-        if victim is None:
+        if not self._lru:
             raise CacheFull()
-        del self._by_hash[victim_hash]
-        self._by_page.pop(victim.page_id, None)
+        page_id, victim_hash = self._lru.popitem(last=False)  # oldest
+        self._by_hash.pop(victim_hash, None)
+        self._by_page.pop(page_id, None)
         self._evictions += 1
-        return victim.page_id
+        return page_id
 
     # -- publishing & release ---------------------------------------------
 
@@ -261,8 +260,11 @@ class PageAllocator:
         """Increment refcounts for content-addressed pages (e.g. when forking
         a sequence)."""
         for pid in page_ids:
-            if pid in self._by_page:
-                self._by_page[pid][1].refcount += 1
+            entry = self._by_page.get(pid)
+            if entry is not None:
+                if entry[1].refcount == 0:
+                    self._lru.pop(pid, None)
+                entry[1].refcount += 1
 
     def release(self, page_ids: Sequence[int]) -> None:
         """Drop one reference per page. Content-addressed pages with zero
@@ -277,13 +279,19 @@ class PageAllocator:
                 entry = addressed[1]
                 entry.refcount = max(0, entry.refcount - 1)
                 entry.last_accessed = now
+                if entry.refcount == 0:
+                    self._lru[pid] = addressed[0]
+                    self._lru.move_to_end(pid)  # most recently used
 
     def touch(self, page_ids: Sequence[int]) -> None:
         """Refresh access clocks (Property 11)."""
         now = time.monotonic()
         for pid in page_ids:
-            if pid in self._by_page:
-                self._by_page[pid][1].last_accessed = now
+            entry = self._by_page.get(pid)
+            if entry is not None:
+                entry[1].last_accessed = now
+                if pid in self._lru:
+                    self._lru.move_to_end(pid)
 
     def evict_below(self, target_frac: float) -> int:
         """Aggressively reclaim cached pages until memory_used (incl. cached)
