@@ -1,0 +1,118 @@
+"""CLI entry point: ``python -m distributed_inference_server_tpu``.
+
+The reference's binary entry (``src/main.rs``, placeholder; startup flow
+``tasks.md:298-312`` [spec], SURVEY.md §3.1): load config (CLI > env >
+file, exiting non-zero on invalid values — Property 27), build the engine
+fleet, serve HTTP until interrupted.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+
+def main(argv=None) -> int:
+    from distributed_inference_server_tpu.core.errors import (
+        ConfigError,
+        ModelLoadError,
+    )
+    from distributed_inference_server_tpu.serving.config import (
+        ConfigWatcher,
+        ServerConfig,
+    )
+
+    try:
+        cfg = ServerConfig.load(cli_args=sys.argv[1:] if argv is None else argv)
+    except ConfigError as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
+
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import get_config
+    from distributed_inference_server_tpu.models.loader import load_checkpoint
+    from distributed_inference_server_tpu.models.tokenizer import load_tokenizer
+    from distributed_inference_server_tpu.serving.server import InferenceServer
+
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+             "float16": jnp.float16}[cfg.get("model", "dtype")]
+    model_dir = cfg.get("model", "model_dir") or None
+    engine_cfg = EngineConfig(
+        max_batch=cfg.get("engine", "max_batch"),
+        prefill_buckets=tuple(cfg.get("engine", "prefill_buckets")),
+        paged=PagedCacheConfig(
+            num_pages=cfg.get("engine", "num_pages"),
+            page_size=cfg.get("engine", "page_size"),
+            max_pages_per_seq=cfg.get("engine", "max_pages_per_seq"),
+        ),
+    )
+    tokenizer = load_tokenizer(model_dir)
+
+    # Clamp the validator's context limit to what the engine can actually
+    # seat (page_size * max_pages_per_seq - 1 for the sampled token), so
+    # over-long prompts are 400s at the validator, not 500s at the engine.
+    engine_prompt_cap = (
+        cfg.get("engine", "page_size") * cfg.get("engine", "max_pages_per_seq") - 1
+    )
+    validator_cfg = cfg.validator_config()
+    if validator_cfg.max_context_tokens > engine_prompt_cap:
+        from dataclasses import replace as _replace
+
+        validator_cfg = _replace(
+            validator_cfg, max_context_tokens=engine_prompt_cap
+        )
+
+    def engine_factory() -> LLMEngine:
+        if model_dir:
+            params, model_cfg = load_checkpoint(model_dir, dtype=dtype)
+        else:
+            import jax
+
+            model_cfg = get_config(cfg.get("model", "model_name"))
+            params = llama.init_params(jax.random.PRNGKey(0), model_cfg,
+                                       dtype=dtype)
+        return LLMEngine(params, model_cfg, tokenizer, engine_cfg, dtype=dtype)
+
+    try:
+        server = InferenceServer(
+            engine_factory,
+            tokenizer,
+            model_name=cfg.get("model", "model_name"),
+            num_engines=cfg.get("server", "num_engines"),
+            strategy=cfg.strategy(),
+            queue_config=cfg.queue_config(),
+            batcher_config=cfg.batcher_config(),
+            validator_config=validator_cfg,
+            auto_restart=cfg.get("server", "auto_restart"),
+            health_check_interval_s=cfg.get("server", "health_check_interval_s"),
+        )
+        server.start()
+    except (ModelLoadError, RuntimeError, TimeoutError) as e:
+        print(f"startup error: {e}", file=sys.stderr)
+        return 1
+
+    watcher = ConfigWatcher(cfg)
+    watcher.subscribe(server.apply_hot_config)
+    watcher.start()
+
+    host, port = cfg.get("server", "host"), cfg.get("server", "port")
+    print(f"serving {cfg.get('model', 'model_name')} on {host}:{port}")
+    try:
+        asyncio.run(server.serve_forever(host, port))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        watcher.stop()
+        server.shutdown(drain_timeout_s=cfg.get("server", "drain_timeout_s"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

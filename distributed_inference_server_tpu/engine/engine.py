@@ -463,12 +463,13 @@ class LLMEngine:
 
     def _finish(self, seq: _Seq, reason: FinishReason,
                 outputs: List[StepOutput]) -> None:
-        # flush held-back text
+        # flush held-back text; index it as the last emitted token's
         delta = seq.output_text[seq.emitted_upto :]
         usage = Usage.of(seq.prompt_len, seq.emitted_tokens)
         outputs.append(StepOutput(
             request_id=seq.request_id,
             text=delta,
+            token_index=max(0, seq.emitted_tokens - 1),
             finished=True,
             finish_reason=reason,
             usage=usage,

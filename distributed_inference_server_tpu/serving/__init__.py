@@ -1,0 +1,55 @@
+"""Serving layer: HTTP transport, admission batching, scheduling, metrics.
+
+The TPU-native counterpart of the reference's ``crates/server`` (stub;
+spec'd ``design.md:139-155,227-307,449-491``) — see SURVEY.md §2.2 S1-S9.
+"""
+
+from distributed_inference_server_tpu.serving.batcher import (
+    AdmissionBatch,
+    AdmissionBatcher,
+    BatcherConfig,
+)
+from distributed_inference_server_tpu.serving.dispatcher import Dispatcher
+from distributed_inference_server_tpu.serving.handler import InferenceHandler
+from distributed_inference_server_tpu.serving.metrics import (
+    EngineStatus,
+    MetricsCollector,
+    MetricsSnapshot,
+)
+from distributed_inference_server_tpu.serving.runner import (
+    EngineRunner,
+    ResultSink,
+    ServerRequest,
+)
+from distributed_inference_server_tpu.serving.scheduler import (
+    AdaptiveScheduler,
+    SchedulingStrategy,
+    choose_engine,
+)
+from distributed_inference_server_tpu.serving.server import InferenceServer
+from distributed_inference_server_tpu.serving.streamer import (
+    CollectingSink,
+    StreamingSink,
+    sse_encode,
+)
+
+__all__ = [
+    "AdmissionBatch",
+    "AdmissionBatcher",
+    "BatcherConfig",
+    "Dispatcher",
+    "InferenceHandler",
+    "EngineStatus",
+    "MetricsCollector",
+    "MetricsSnapshot",
+    "EngineRunner",
+    "ResultSink",
+    "ServerRequest",
+    "AdaptiveScheduler",
+    "SchedulingStrategy",
+    "choose_engine",
+    "InferenceServer",
+    "CollectingSink",
+    "StreamingSink",
+    "sse_encode",
+]

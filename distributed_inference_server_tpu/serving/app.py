@@ -1,0 +1,168 @@
+"""HTTP transport: aiohttp application over the inference handler.
+
+Realizes the reference's spec'd ``ApiServer`` (``design.md:139-145`` [spec];
+endpoints ``requirements.md:32-38,118-119``):
+
+- POST ``/generate`` ``/chat`` — JSON, or SSE when ``stream: true``
+  (Req 1.6); client disconnect mid-stream aborts generation (Req 5.4);
+- POST ``/embeddings``;
+- GET ``/server/stats`` — ``MetricsSnapshot`` JSON;
+- GET ``/metrics`` — Prometheus text;
+- GET ``/health`` — liveness + per-engine health;
+- errors → ``ErrorResponse`` JSON with the reference's status mapping
+  (400/503/408/500, error.rs:39-56 semantics via core.errors.ApiError).
+
+The axum/tower stack maps to aiohttp; SSE framing is hand-rolled (the wire
+format is just ``data: {json}\\n\\n`` frames, streamer.sse_encode).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from distributed_inference_server_tpu.core.errors import ApiError
+from distributed_inference_server_tpu.core.models import ErrorResponse
+from distributed_inference_server_tpu.serving.handler import InferenceHandler
+from distributed_inference_server_tpu.serving.metrics import MetricsCollector
+from distributed_inference_server_tpu.serving.streamer import SSE_DONE, sse_encode
+
+
+def _error_response(err: ApiError) -> web.Response:
+    body = ErrorResponse.of(str(err), err.error_type(), err.code())
+    return web.json_response(
+        body.to_dict(), status=err.status_code(), dumps=json.dumps
+    )
+
+
+def build_app(
+    handler: InferenceHandler,
+    metrics: Optional[MetricsCollector] = None,
+) -> web.Application:
+    app = web.Application()
+    app["handler"] = handler
+    app["metrics"] = metrics
+
+    @web.middleware
+    async def observe(request: web.Request, handler):  # noqa: A002 — aiohttp
+        # requires the parameter name "handler" (shadows the InferenceHandler)
+        t0 = time.monotonic()
+        code = 500
+        try:
+            resp = await handler(request)
+            code = resp.status
+            return resp
+        except ApiError as e:
+            resp = _error_response(e)
+            code = resp.status
+            return resp
+        finally:
+            if metrics and request.method == "POST":
+                metrics.record_request(request.path, code, time.monotonic() - t0)
+
+    app.middlewares.append(observe)
+
+    class ApiErrorJson(ApiError):
+        def __init__(self, msg: str):
+            super().__init__(f"Validation error: {msg}")
+
+        def status_code(self) -> int:
+            return 400
+
+        def error_type(self) -> str:
+            return "invalid_request_error"
+
+        def code(self) -> str:
+            return "invalid_json"
+
+    async def _json_body(request: web.Request) -> dict:
+        try:
+            obj = await request.json()
+        except Exception:  # noqa: BLE001 — malformed body
+            raise ApiErrorJson("request body is not valid JSON") from None
+        if not isinstance(obj, dict):
+            raise ApiErrorJson("request body must be a JSON object")
+        return obj
+
+    async def _stream_response(request: web.Request, request_id, events):
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        try:
+            async for event in events:
+                await resp.write(sse_encode(event))
+            await resp.write(SSE_DONE)
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: abort generation (Req 5.4)
+            handler.dispatcher.abort(request_id)
+            raise
+        await resp.write_eof()
+        return resp
+
+    async def generate(request: web.Request) -> web.StreamResponse:
+        obj = await _json_body(request)
+        if obj.get("stream") is True:
+            request_id, events = await handler.generate_stream(obj)
+            return await _stream_response(request, request_id, events)
+        result = await handler.generate(obj)
+        return web.json_response(result.to_dict())
+
+    async def chat(request: web.Request) -> web.StreamResponse:
+        obj = await _json_body(request)
+        if obj.get("stream") is True:
+            request_id, events = await handler.chat_stream(obj)
+            return await _stream_response(request, request_id, events)
+        result = await handler.chat(obj)
+        return web.json_response(result.to_dict())
+
+    async def embeddings(request: web.Request) -> web.Response:
+        obj = await _json_body(request)
+        result = await handler.embeddings(obj)
+        return web.json_response(result.to_dict())
+
+    async def stats(request: web.Request) -> web.Response:
+        statuses = tuple(handler.dispatcher.scheduler.statuses())
+        if metrics is None:
+            return web.json_response(
+                {"worker_statuses": [s.to_dict() for s in statuses]}
+            )
+        return web.json_response(metrics.snapshot(statuses).to_dict())
+
+    async def prom(request: web.Request) -> web.Response:
+        if metrics is None:
+            return web.Response(status=404, text="metrics disabled")
+        return web.Response(
+            body=metrics.prometheus_text(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
+    async def health(request: web.Request) -> web.Response:
+        statuses = handler.dispatcher.scheduler.statuses()
+        healthy = any(s.healthy for s in statuses)
+        return web.json_response(
+            {
+                "status": "ok" if healthy else "unhealthy",
+                "accepting": handler.dispatcher.is_accepting(),
+                "engines": [s.to_dict() for s in statuses],
+            },
+            status=200 if healthy else 503,
+        )
+
+    app.router.add_post("/generate", generate)
+    app.router.add_post("/chat", chat)
+    app.router.add_post("/embeddings", embeddings)
+    app.router.add_get("/server/stats", stats)
+    app.router.add_get("/metrics", prom)
+    app.router.add_get("/health", health)
+    return app

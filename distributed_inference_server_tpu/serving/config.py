@@ -1,0 +1,400 @@
+"""Server configuration: file < env < CLI precedence, validation, hot-reload.
+
+Realizes the reference's spec'd config system (S8, ``tasks.md:226-240``
+[spec]; behavior ``requirements.md:142-146``):
+
+- **Sources & precedence** (Property 26, design.md:836-840): TOML or YAML
+  file, overridden by ``DIS_TPU_*`` environment variables, overridden by
+  CLI flags — CLI > env > file > defaults.
+- **Validation** (Property 27, design.md:842-846): range checks on load;
+  the CLI entry point exits non-zero on invalid values.
+- **Hot-reload** (requirements.md:146): a watcher thread polls the config
+  file's mtime; on change the *hot-reloadable* subset — batching window and
+  size, queue watermarks, scheduling strategy — is re-applied to the running
+  server via subscriber callbacks. Everything else needs a restart.
+
+Env naming: ``DIS_TPU_<SECTION>__<FIELD>`` (double underscore between
+section and field), e.g. ``DIS_TPU_QUEUE__HIGH_WATERMARK=1500``,
+``DIS_TPU_SERVER__PORT=9000``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from distributed_inference_server_tpu.core.errors import ConfigError
+from distributed_inference_server_tpu.core.queue import QueueConfig
+from distributed_inference_server_tpu.core.validator import ValidatorConfig
+from distributed_inference_server_tpu.serving.batcher import BatcherConfig
+from distributed_inference_server_tpu.serving.scheduler import SchedulingStrategy
+
+ENV_PREFIX = "DIS_TPU_"
+
+# section -> field -> (type, default)
+_SCHEMA: Dict[str, Dict[str, Any]] = {
+    "server": {
+        "host": (str, "0.0.0.0"),
+        "port": (int, 8000),
+        "num_engines": (int, 1),
+        "strategy": (str, "least_loaded"),
+        "auto_restart": (bool, True),
+        "health_check_interval_s": (float, 1.0),
+        "drain_timeout_s": (float, 30.0),
+    },
+    "model": {
+        "model_dir": (str, ""),
+        "model_name": (str, "tiny"),
+        "dtype": (str, "bfloat16"),
+    },
+    "engine": {
+        "max_batch": (int, 8),
+        "prefill_buckets": (list, [32, 128, 512]),
+        "page_size": (int, 16),
+        "num_pages": (int, 512),
+        "max_pages_per_seq": (int, 64),
+    },
+    "queue": {
+        "high_watermark": (int, 1000),
+        "low_watermark": (int, 500),
+        "request_timeout_s": (float, 30.0),
+        "max_queue_size": (int, 2000),
+    },
+    "batcher": {
+        "window_ms": (float, 50.0),
+        "max_batch_size": (int, 32),
+    },
+    "validator": {
+        "max_context_tokens": (int, 8192),
+        "max_output_tokens": (int, 4096),
+    },
+}
+
+# (section, field) pairs that may change at runtime without restart
+HOT_RELOADABLE = {
+    ("batcher", "window_ms"),
+    ("batcher", "max_batch_size"),
+    ("queue", "high_watermark"),
+    ("queue", "low_watermark"),
+    ("queue", "request_timeout_s"),
+    ("server", "strategy"),
+}
+
+
+def _defaults() -> Dict[str, Dict[str, Any]]:
+    return {
+        sec: {k: copy.copy(d) for k, (_, d) in fields.items()}
+        for sec, fields in _SCHEMA.items()
+    }
+
+
+def _coerce(section: str, key: str, value: Any) -> Any:
+    try:
+        typ, _ = _SCHEMA[section][key]
+    except KeyError:
+        raise ConfigError(f"unknown config key: {section}.{key}") from None
+    if typ is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str):
+            low = value.strip().lower()
+            if low in ("1", "true", "yes", "on"):
+                return True
+            if low in ("0", "false", "no", "off"):
+                return False
+        raise ConfigError(f"{section}.{key}: expected boolean, got {value!r}")
+    if typ is list:
+        if isinstance(value, (list, tuple)):
+            return [int(v) for v in value]
+        if isinstance(value, str):
+            return [int(v) for v in value.split(",") if v.strip()]
+        raise ConfigError(f"{section}.{key}: expected list, got {value!r}")
+    try:
+        return typ(value)
+    except (TypeError, ValueError):
+        raise ConfigError(
+            f"{section}.{key}: expected {typ.__name__}, got {value!r}"
+        ) from None
+
+
+def _load_file(path: str) -> Dict[str, Any]:
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        with open(path) as f:
+            obj = yaml.safe_load(f) or {}
+    elif path.endswith(".toml"):
+        import tomllib
+
+        with open(path, "rb") as f:
+            obj = tomllib.load(f)
+    else:
+        raise ConfigError(f"unsupported config format: {path} (use .toml/.yaml)")
+    if not isinstance(obj, dict):
+        raise ConfigError(f"config file {path} must contain a table/mapping")
+    return obj
+
+
+def _env_overrides(environ: Optional[Dict[str, str]] = None) -> Dict[str, Dict[str, Any]]:
+    environ = os.environ if environ is None else environ
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, raw in environ.items():
+        if not name.startswith(ENV_PREFIX):
+            continue
+        rest = name[len(ENV_PREFIX):]
+        if "__" not in rest:
+            continue
+        section, key = rest.split("__", 1)
+        out.setdefault(section.lower(), {})[key.lower()] = raw
+    return out
+
+
+@dataclass
+class ServerConfig:
+    """Typed view over the merged section/field table."""
+
+    raw: Dict[str, Dict[str, Any]] = field(default_factory=_defaults)
+    source_file: Optional[str] = None
+    # kept so hot-reload re-merges with the SAME CLI overrides (Property 26
+    # must survive reloads, not just initial load)
+    cli_args: List[str] = field(default_factory=list)
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def load(
+        cls,
+        file_path: Optional[str] = None,
+        cli_args: Optional[List[str]] = None,
+        environ: Optional[Dict[str, str]] = None,
+    ) -> "ServerConfig":
+        """Merge defaults < file < env < CLI (Property 26), then validate
+        (Property 27)."""
+        merged = _defaults()
+
+        def apply(section: str, key: str, value: Any) -> None:
+            if section not in merged or key not in merged[section]:
+                raise ConfigError(f"unknown config key: {section}.{key}")
+            merged[section][key] = _coerce(section, key, value)
+
+        cli = _parse_cli(cli_args or [])
+        file_path = file_path or cli.pop("_config_file", None)
+
+        if file_path:
+            for section, fields in _load_file(file_path).items():
+                if not isinstance(fields, dict):
+                    raise ConfigError(f"config section {section} must be a table")
+                for key, value in fields.items():
+                    apply(str(section), str(key), value)
+        for section, fields in _env_overrides(environ).items():
+            for key, value in fields.items():
+                apply(section, key, value)
+        for (section, key), value in cli.items():
+            apply(section, key, value)
+
+        cfg = cls(raw=merged, source_file=file_path,
+                  cli_args=list(cli_args or []))
+        cfg.validate()
+        return cfg
+
+    # -- access ------------------------------------------------------------
+
+    def get(self, section: str, key: str) -> Any:
+        return self.raw[section][key]
+
+    def queue_config(self) -> QueueConfig:
+        q = self.raw["queue"]
+        return QueueConfig(
+            high_watermark=q["high_watermark"],
+            low_watermark=q["low_watermark"],
+            request_timeout_s=q["request_timeout_s"],
+            max_queue_size=q["max_queue_size"],
+        )
+
+    def batcher_config(self) -> BatcherConfig:
+        b = self.raw["batcher"]
+        return BatcherConfig(
+            window_ms=b["window_ms"], max_batch_size=b["max_batch_size"]
+        )
+
+    def validator_config(self) -> ValidatorConfig:
+        v = self.raw["validator"]
+        return ValidatorConfig(
+            max_context_tokens=v["max_context_tokens"],
+            max_output_tokens=v["max_output_tokens"],
+        )
+
+    def strategy(self) -> SchedulingStrategy:
+        return SchedulingStrategy.parse(self.raw["server"]["strategy"])
+
+    # -- validation --------------------------------------------------------
+
+    def validate(self) -> None:
+        """Range checks (Property 27); raises ConfigError."""
+        r = self.raw
+
+        def positive(section: str, key: str) -> None:
+            if r[section][key] <= 0:
+                raise ConfigError(f"{section}.{key} must be positive")
+
+        for sec, key in (
+            ("server", "port"), ("server", "num_engines"),
+            ("engine", "max_batch"), ("engine", "page_size"),
+            ("engine", "num_pages"), ("engine", "max_pages_per_seq"),
+            ("queue", "high_watermark"), ("queue", "low_watermark"),
+            ("queue", "request_timeout_s"), ("queue", "max_queue_size"),
+            ("batcher", "max_batch_size"),
+            ("validator", "max_context_tokens"),
+            ("validator", "max_output_tokens"),
+        ):
+            positive(sec, key)
+        if not (0 < r["server"]["port"] < 65536):
+            raise ConfigError("server.port must be in (0, 65536)")
+        if r["queue"]["low_watermark"] >= r["queue"]["high_watermark"]:
+            raise ConfigError(
+                "queue.low_watermark must be below queue.high_watermark"
+            )
+        if r["queue"]["high_watermark"] > r["queue"]["max_queue_size"]:
+            raise ConfigError(
+                "queue.high_watermark must be <= queue.max_queue_size"
+            )
+        if r["batcher"]["window_ms"] < 0:
+            raise ConfigError("batcher.window_ms must be >= 0")
+        if not r["engine"]["prefill_buckets"]:
+            raise ConfigError("engine.prefill_buckets must be non-empty")
+        if sorted(r["engine"]["prefill_buckets"]) != r["engine"]["prefill_buckets"]:
+            raise ConfigError("engine.prefill_buckets must be ascending")
+        try:
+            SchedulingStrategy.parse(r["server"]["strategy"])
+        except ValueError:
+            raise ConfigError(
+                f"server.strategy must be one of "
+                f"{[s.value for s in SchedulingStrategy]}, "
+                f"got {r['server']['strategy']!r}"
+            ) from None
+        if r["model"]["dtype"] not in ("bfloat16", "float32", "float16"):
+            raise ConfigError(
+                f"model.dtype must be bfloat16/float32/float16, "
+                f"got {r['model']['dtype']!r}"
+            )
+
+    def hot_diff(self, other: "ServerConfig") -> Dict[tuple, Any]:
+        """(section, key) -> new value for hot-reloadable keys that differ."""
+        out = {}
+        for section, key in HOT_RELOADABLE:
+            new = other.raw[section][key]
+            if self.raw[section][key] != new:
+                out[(section, key)] = new
+        return out
+
+
+def _parse_cli(argv: List[str]) -> Dict[Any, Any]:
+    """CLI flags: ``--config FILE`` plus ``--<section>-<field>`` per schema
+    entry (clap-equivalent surface, Cargo.toml:45)."""
+    parser = argparse.ArgumentParser(
+        prog="distributed-inference-server-tpu",
+        description="TPU-native LLM inference server",
+    )
+    parser.add_argument("--config", dest="_config_file", default=None,
+                        help="TOML/YAML config file")
+    for section, fields in _SCHEMA.items():
+        for key in fields:
+            parser.add_argument(
+                f"--{section}-{key}".replace("_", "-"),
+                dest=f"{section}.{key}",
+                default=None,
+            )
+    ns = vars(parser.parse_args(argv))
+    out: Dict[Any, Any] = {}
+    cfg_file = ns.pop("_config_file")
+    if cfg_file:
+        out["_config_file"] = cfg_file
+    for dotted, value in ns.items():
+        if value is None:
+            continue
+        section, key = dotted.split(".", 1)
+        out[(section, key)] = value
+    return out
+
+
+class ConfigWatcher:
+    """Polls the config file; publishes hot-reloadable changes to
+    subscribers (requirements.md:146 watch-channel analogue)."""
+
+    def __init__(self, config: ServerConfig, poll_interval_s: float = 1.0):
+        self.current = config
+        self._interval = poll_interval_s
+        self._subs: List[Callable[[Dict[tuple, Any], ServerConfig], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._mtime = self._stat()
+
+    def subscribe(
+        self, callback: Callable[[Dict[tuple, Any], ServerConfig], None]
+    ) -> None:
+        self._subs.append(callback)
+
+    def _stat(self) -> float:
+        path = self.current.source_file
+        if not path:
+            return 0.0
+        try:
+            return os.stat(path).st_mtime
+        except OSError:
+            return 0.0
+
+    def check_once(self) -> bool:
+        """Reload if the file changed; returns True if a reload happened.
+        Invalid new config is rejected (old config stays active)."""
+        path = self.current.source_file
+        if not path:
+            return False
+        mtime = self._stat()
+        if mtime == self._mtime:
+            return False
+        self._mtime = mtime
+        try:
+            # re-merge with the original CLI args so CLI > env > file
+            # precedence survives the reload (Property 26)
+            new = ServerConfig.load(file_path=path,
+                                    cli_args=self.current.cli_args)
+        except Exception:  # noqa: BLE001 — malformed/partial file edits
+            # (yaml/toml parse errors, ENOENT during atomic replace) must
+            # never kill hot-reload; the old config stays active
+            return False
+        diff = self.current.hot_diff(new)
+        self.current = new
+        if diff:
+            for cb in self._subs:
+                try:
+                    cb(diff, new)
+                except Exception:  # noqa: BLE001 — subscriber isolation
+                    pass
+        return True
+
+    def start(self) -> None:
+        if self._thread is not None or not self.current.source_file:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="config-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.check_once()
+            except Exception:  # noqa: BLE001 — watcher must stay alive
+                pass

@@ -1,0 +1,139 @@
+"""Graceful degradation ladder driven by KV-page memory pressure.
+
+Realizes the reference's spec'd degradation strategy (``design.md:925-943``
+[spec]; behavior ``requirements.md:130-134``): as memory pressure rises the
+server sheds load in stages instead of falling over —
+
+    < 0.70  NORMAL                     full service
+    < 0.80  REDUCED_BATCH_SIZE         admission batches halved
+    < 0.90  AGGRESSIVE_CACHE_EVICTION  + cached (refcount-0) prefix pages
+                                         evicted down to the low threshold
+    < 0.95  REJECT_LOW_PRIORITY        + Priority.LOW requests get 503
+    >=0.95  EMERGENCY                  + all new requests get 503
+
+Pressure = max over engines of used_pages/total_pages (each engine owns its
+page pool; the most-pressured replica gates the ladder). Transitions are
+logged and reversible: when pressure drops, restrictions lift in reverse
+order. Pure-logic core (``level_for_pressure``) is separately testable.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+from typing import Optional
+
+from distributed_inference_server_tpu.serving.dispatcher import Dispatcher
+from distributed_inference_server_tpu.serving.scheduler import AdaptiveScheduler
+
+logger = logging.getLogger(__name__)
+
+
+class DegradationLevel(enum.IntEnum):
+    NORMAL = 0
+    REDUCED_BATCH_SIZE = 1
+    AGGRESSIVE_CACHE_EVICTION = 2
+    REJECT_LOW_PRIORITY = 3
+    EMERGENCY = 4
+
+
+#: (upper pressure bound, level) — design.md:934-941 [spec]
+THRESHOLDS = (0.70, 0.80, 0.90, 0.95)
+
+
+def level_for_pressure(pressure: float) -> DegradationLevel:
+    for i, bound in enumerate(THRESHOLDS):
+        if pressure < bound:
+            return DegradationLevel(i)
+    return DegradationLevel.EMERGENCY
+
+
+class DegradationController:
+    """Evaluates pressure and applies/lifts ladder actions."""
+
+    def __init__(
+        self,
+        dispatcher: Dispatcher,
+        scheduler: AdaptiveScheduler,
+        check_interval_s: float = 0.5,
+        evict_target_frac: float = 0.70,
+    ):
+        self.dispatcher = dispatcher
+        self.scheduler = scheduler
+        self.level = DegradationLevel.NORMAL
+        self._interval = check_interval_s
+        self._evict_target = evict_target_frac
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- pressure ----------------------------------------------------------
+
+    def memory_pressure(self) -> float:
+        worst = 0.0
+        for status in self.scheduler.statuses():
+            if status.memory_total_pages:
+                worst = max(
+                    worst, status.memory_used_pages / status.memory_total_pages
+                )
+        return worst
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, pressure: Optional[float] = None) -> DegradationLevel:
+        """One ladder evaluation; applies side effects on level change."""
+        pressure = self.memory_pressure() if pressure is None else pressure
+        new = level_for_pressure(pressure)
+        if new != self.level:
+            logger.warning(
+                "degradation level %s -> %s (memory pressure %.2f)",
+                self.level.name, new.name, pressure,
+            )
+            self._apply(self.level, new)
+            self.level = new
+        elif new >= DegradationLevel.AGGRESSIVE_CACHE_EVICTION:
+            self._evict()  # keep evicting while pressure stays high
+        return self.level
+
+    def _apply(self, old: DegradationLevel, new: DegradationLevel) -> None:
+        # batch-size reduction: owns only the divisor — the config itself
+        # stays owned by hot-reload, so the two compose
+        self.dispatcher.batcher.size_divisor = (
+            2 if new >= DegradationLevel.REDUCED_BATCH_SIZE else 1
+        )
+        # cache eviction
+        if new >= DegradationLevel.AGGRESSIVE_CACHE_EVICTION > old:
+            self._evict()
+        # admission gates
+        self.dispatcher.reject_low_priority = (
+            new >= DegradationLevel.REJECT_LOW_PRIORITY
+        )
+        self.dispatcher.reject_all = new >= DegradationLevel.EMERGENCY
+
+    def _evict(self) -> None:
+        for runner in self.scheduler.engines():
+            runner.evict_cache(self._evict_target)
+
+    # -- background loop ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="degradation", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.evaluate()
+            except Exception:  # noqa: BLE001 — monitoring must not die
+                logger.exception("degradation evaluation failed")

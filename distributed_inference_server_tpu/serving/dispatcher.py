@@ -1,0 +1,174 @@
+"""Dispatcher: the serving spine connecting queue → batcher → scheduler →
+engine runners, plus the timeout sweeper.
+
+This is the reference's spec'd batching/scheduling background task
+(``tasks.md:70-82`` [spec]; hot loop SURVEY.md §3.4) as one dispatch thread:
+
+    loop:
+      sweep expired queued requests → 408 (queue.rs:198-226; Req 3.3)
+      poll admission batcher (50 ms / 32, Properties 4-5)
+      scheduler picks an engine (round-robin / least-loaded / memory-aware)
+      runner admits the batch into its continuous-batching pool
+
+Backpressure (503) surfaces at ``submit()`` via ``QueueFull`` from the
+priority queue's hysteresis (Property 7). Graceful shutdown drains the
+batcher and stops accepting new work (Req 9.5).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from distributed_inference_server_tpu.core.errors import QueueFull
+from distributed_inference_server_tpu.core.queue import (
+    PriorityQueueManager,
+    QueueConfig,
+    QueuedRequest,
+)
+from distributed_inference_server_tpu.core.types import Priority, RequestId
+from distributed_inference_server_tpu.serving.batcher import (
+    AdmissionBatcher,
+    BatcherConfig,
+)
+from distributed_inference_server_tpu.serving.metrics import MetricsCollector
+from distributed_inference_server_tpu.serving.runner import (
+    EngineRunner,
+    ServerRequest,
+)
+from distributed_inference_server_tpu.serving.scheduler import AdaptiveScheduler
+
+
+class Dispatcher:
+    """Owns the queue, batcher, and dispatch/sweep thread."""
+
+    def __init__(
+        self,
+        scheduler: AdaptiveScheduler,
+        queue_config: Optional[QueueConfig] = None,
+        batcher_config: Optional[BatcherConfig] = None,
+        metrics: Optional[MetricsCollector] = None,
+        poll_interval_s: float = 0.002,
+    ):
+        self.scheduler = scheduler
+        self.queue: PriorityQueueManager[ServerRequest] = PriorityQueueManager(
+            queue_config
+        )
+        self.batcher: AdmissionBatcher[ServerRequest] = AdmissionBatcher(
+            self.queue, batcher_config
+        )
+        self.metrics = metrics
+        self._poll_interval = poll_interval_s
+        self._accepting = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._sweep_every_s = 1.0
+        # degradation-ladder gates (serving/degradation.py; design.md:938-941)
+        self.reject_low_priority = False
+        self.reject_all = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._accepting = True
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="dispatcher", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self, drain_timeout_s: float = 30.0) -> None:
+        """Stop accepting, drain in-flight work, stop the thread
+        (graceful shutdown, Req 9.5 requirements.md:134)."""
+        self._accepting = False
+        deadline = time.monotonic() + drain_timeout_s
+        while time.monotonic() < deadline:
+            if (
+                self.queue.is_empty()
+                and self.batcher.pending_count() == 0
+                and not any(
+                    r.active_count() for r in self.scheduler.engines()
+                )
+            ):
+                break
+            time.sleep(0.01)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        # anything still pending after the deadline gets dispatched so the
+        # engines (which keep running until InferenceServer stops them) can
+        # finish it; without this, held requests would hang their clients
+        leftover = self.batcher.flush()
+        if leftover is not None:
+            self._dispatch(leftover.requests)
+
+    def is_accepting(self) -> bool:
+        return self._accepting and self.queue.is_accepting()
+
+    # -- submission (any thread) -------------------------------------------
+
+    def submit(self, request: ServerRequest,
+               priority: Priority = Priority.NORMAL) -> None:
+        """Enqueue; raises QueueFull → 503 when backpressure is active or
+        the server is draining."""
+        if not self._accepting or self.reject_all:
+            raise QueueFull()
+        if self.reject_low_priority and priority is Priority.LOW:
+            raise QueueFull()
+        self.queue.enqueue(
+            QueuedRequest(id=request.request_id, data=request, priority=priority)
+        )
+        if self.metrics:
+            d = self.queue.queue_depth()
+            self.metrics.set_queue_depth(d.high, d.normal, d.low)
+
+    def abort(self, request_id: RequestId) -> None:
+        """Client disconnect: drop from queue if still queued, else tell
+        every engine (only the owner will find it) — Req 5.4."""
+        if self.queue.cancel(request_id) is not None:
+            return
+        for runner in self.scheduler.engines():
+            runner.abort(request_id)
+
+    # -- dispatch thread ---------------------------------------------------
+
+    def _loop(self) -> None:
+        last_sweep = time.monotonic()
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now - last_sweep >= self._sweep_every_s:
+                self._sweep(now)
+                last_sweep = now
+            batch = self.batcher.poll(now)
+            if batch is None and not self._accepting:
+                batch = self.batcher.flush(now)
+            if batch is not None:
+                self._dispatch(batch.requests)
+            else:
+                time.sleep(self._poll_interval)
+
+    def _dispatch(self, queued: List[QueuedRequest[ServerRequest]]) -> None:
+        requests = [q.data for q in queued]
+        if self.metrics:
+            lens = [len(r.prompt_ids) for r in requests]
+            pad = (max(lens) * len(lens) / max(sum(lens), 1) - 1.0) if lens else 0.0
+            self.metrics.record_batch(len(requests), max(0.0, pad))
+        runner = self.scheduler.schedule()
+        if runner is None:
+            # no healthy engine: fail the batch (Property 20 — graceful,
+            # not silent)
+            for r in requests:
+                r.sink.on_error("no healthy inference engine available",
+                                "no_workers")
+            return
+        runner.submit(requests)
+        if self.metrics:
+            d = self.queue.queue_depth()
+            self.metrics.set_queue_depth(d.high, d.normal, d.low)
+
+    def _sweep(self, now: float) -> None:
+        """Expire queued requests older than the timeout → 408
+        (Property 8; Req 3.3 requirements.md:59)."""
+        for q in self.queue.remove_expired(now):
+            q.data.sink.on_error("Request timeout", "request_timeout")

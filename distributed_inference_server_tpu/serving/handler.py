@@ -1,0 +1,294 @@
+"""Inference handler: the endpoint-facing request lifecycle.
+
+Realizes the reference's spec'd ``InferenceHandler`` trait — ``generate``,
+``generate_stream``, ``chat``, ``chat_stream``, ``embeddings``
+(``design.md:147-155`` [spec]) — over the serving spine:
+
+    parse JSON → validate (400) → tokenize → submit to dispatcher
+    (503 on backpressure) → await sink (408 on queue timeout) → build
+    OpenAI-style response (SURVEY.md §3.2-3.3 call stacks)
+
+Transport-agnostic: the aiohttp layer (serving/app.py) only does HTTP/SSE
+framing around these coroutines, so conformance tests drive the handler
+directly without sockets.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import AsyncIterator, List, Optional, Tuple
+
+from distributed_inference_server_tpu.core.errors import (
+    ApiError,
+    InternalApiError,
+    QueueFull,
+    QueueFullApiError,
+    RequestTimeoutApiError,
+    ValidationApiError,
+    ValidationError,
+)
+from distributed_inference_server_tpu.core.models import (
+    ChatMessage,
+    ChatChoice,
+    ChatRequest,
+    ChatResponse,
+    EmbeddingData,
+    EmbeddingsRequest,
+    EmbeddingsResponse,
+    GenerateChoice,
+    GenerateRequest,
+    GenerateResponse,
+    Role,
+    TokenEvent,
+    Usage,
+)
+from distributed_inference_server_tpu.core.types import (
+    Priority,
+    RequestId,
+    new_request_id,
+)
+from distributed_inference_server_tpu.core.validator import RequestValidator
+from distributed_inference_server_tpu.engine.engine import SamplingParams
+from distributed_inference_server_tpu.models.tokenizer import (
+    Tokenizer,
+    apply_chat_template,
+)
+from distributed_inference_server_tpu.serving.dispatcher import Dispatcher
+from distributed_inference_server_tpu.serving.metrics import MetricsCollector
+from distributed_inference_server_tpu.serving.runner import ServerRequest
+from distributed_inference_server_tpu.serving.streamer import (
+    CollectingSink,
+    StreamingSink,
+)
+
+
+def _error_to_api(message: str, code: str) -> ApiError:
+    if code == "request_timeout":
+        return RequestTimeoutApiError()
+    return InternalApiError(message)
+
+
+class InferenceHandler:
+    """Endpoint logic shared by HTTP and test drivers."""
+
+    def __init__(
+        self,
+        dispatcher: Dispatcher,
+        tokenizer: Tokenizer,
+        model_name: str,
+        validator: Optional[RequestValidator] = None,
+        metrics: Optional[MetricsCollector] = None,
+    ):
+        self.dispatcher = dispatcher
+        self.tok = tokenizer
+        self.model_name = model_name
+        self.validator = validator or RequestValidator()
+        self.metrics = metrics
+
+    # -- shared internals --------------------------------------------------
+
+    def _params(self, max_tokens: int, temperature: float, top_p: float,
+                stop_sequences: List[str]) -> SamplingParams:
+        return SamplingParams(
+            max_tokens=max_tokens,
+            temperature=temperature,
+            top_p=top_p,
+            stop_sequences=tuple(stop_sequences),
+        )
+
+    def _submit(
+        self,
+        prompt_ids: List[int],
+        params: SamplingParams,
+        sink,
+        priority: Priority,
+    ) -> RequestId:
+        request_id = new_request_id()
+        req = ServerRequest(request_id, prompt_ids, params, sink)
+        if self.metrics:
+            self.metrics.request_started()
+        try:
+            self.dispatcher.submit(req, priority)
+        except QueueFull:
+            if self.metrics:
+                self.metrics.request_finished()
+            raise QueueFullApiError() from None
+        return request_id
+
+    async def _await_completion(self, sink: CollectingSink, request_id: RequestId):
+        try:
+            text, reason, usage, err, code = await sink.future
+        except asyncio.CancelledError:
+            # client disconnected mid-generation: abort upstream (Req 5.4)
+            self.dispatcher.abort(request_id)
+            raise
+        finally:
+            if self.metrics:
+                self.metrics.request_finished()
+        if err is not None:
+            raise _error_to_api(err, code)
+        return text, reason, usage
+
+    # -- /generate ---------------------------------------------------------
+
+    def parse_generate(self, obj: dict) -> GenerateRequest:
+        try:
+            req = GenerateRequest.from_dict(obj)
+            self.validator.validate_generate(req)
+            return req
+        except ValidationError as e:
+            raise ValidationApiError(e) from None
+
+    async def generate(self, obj: dict) -> GenerateResponse:
+        req = self.parse_generate(obj)
+        loop = asyncio.get_running_loop()
+        sink = CollectingSink(loop)
+        request_id = self._submit(
+            self.tok.encode(req.prompt),
+            self._params(req.max_tokens, req.temperature, req.top_p,
+                         req.stop_sequences),
+            sink,
+            req.priority or Priority.NORMAL,
+        )
+        text, reason, usage = await self._await_completion(sink, request_id)
+        return GenerateResponse(
+            id=f"cmpl-{request_id}",
+            object="text_completion",
+            created=int(time.time()),
+            model=self.model_name,
+            choices=(GenerateChoice(text=text, index=0, finish_reason=reason),),
+            usage=usage,
+        )
+
+    async def generate_stream(
+        self, obj: dict
+    ) -> Tuple[RequestId, AsyncIterator[TokenEvent]]:
+        """Validate + enqueue; returns (request_id, async TokenEvent
+        iterator). Caller aborts via dispatcher on client disconnect
+        (Req 5.4)."""
+        req = self.parse_generate(obj)
+        loop = asyncio.get_running_loop()
+        sink = StreamingSink(loop)
+        request_id = self._submit(
+            self.tok.encode(req.prompt),
+            self._params(req.max_tokens, req.temperature, req.top_p,
+                         req.stop_sequences),
+            sink,
+            req.priority or Priority.NORMAL,
+        )
+        return request_id, self._finalize_stream(sink)
+
+    async def _finalize_stream(self, sink: StreamingSink):
+        try:
+            async for event in sink.events():
+                yield event
+        finally:
+            if self.metrics:
+                self.metrics.request_finished()
+
+    # -- /chat -------------------------------------------------------------
+
+    def parse_chat(self, obj: dict) -> ChatRequest:
+        try:
+            req = ChatRequest.from_dict(obj)
+            self.validator.validate_chat(req)
+            return req
+        except ValidationError as e:
+            raise ValidationApiError(e) from None
+
+    def _chat_ids(self, req: ChatRequest) -> List[int]:
+        # the template carries its own BOS marker text; HF tokenizers encode
+        # it as a literal, so skip the extra BOS id
+        return self.tok.encode(apply_chat_template(req.messages), add_bos=False)
+
+    async def chat(self, obj: dict) -> ChatResponse:
+        req = self.parse_chat(obj)
+        loop = asyncio.get_running_loop()
+        sink = CollectingSink(loop)
+        request_id = self._submit(
+            self._chat_ids(req),
+            self._params(req.max_tokens, req.temperature, req.top_p,
+                         req.stop_sequences),
+            sink,
+            Priority.NORMAL,
+        )
+        text, reason, usage = await self._await_completion(sink, request_id)
+        return ChatResponse(
+            id=f"chatcmpl-{request_id}",
+            object="chat.completion",
+            created=int(time.time()),
+            model=self.model_name,
+            choices=(
+                ChatChoice(
+                    index=0,
+                    message=ChatMessage(role=Role.ASSISTANT, content=text),
+                    finish_reason=reason,
+                ),
+            ),
+            usage=usage,
+        )
+
+    async def chat_stream(
+        self, obj: dict
+    ) -> Tuple[RequestId, AsyncIterator[TokenEvent]]:
+        req = self.parse_chat(obj)
+        loop = asyncio.get_running_loop()
+        sink = StreamingSink(loop)
+        request_id = self._submit(
+            self._chat_ids(req),
+            self._params(req.max_tokens, req.temperature, req.top_p,
+                         req.stop_sequences),
+            sink,
+            Priority.NORMAL,
+        )
+        return request_id, self._finalize_stream(sink)
+
+    # -- /embeddings -------------------------------------------------------
+
+    async def embeddings(self, obj: dict) -> EmbeddingsResponse:
+        try:
+            req = EmbeddingsRequest.from_dict(obj)
+            self.validator.validate_embeddings(req)
+        except ValidationError as e:
+            raise ValidationApiError(e) from None
+
+        inputs = req.input_list()
+        ids_list = [self.tok.encode(text) for text in inputs]
+        runner = self.dispatcher.scheduler.schedule()
+        if runner is None:
+            raise InternalApiError("no healthy inference engine available")
+
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def _on_result(array, error):
+            def _set():
+                if fut.done():
+                    return
+                if error is not None:
+                    fut.set_exception(InternalApiError(error))
+                else:
+                    fut.set_result(array)
+
+            loop.call_soon_threadsafe(_set)
+
+        if self.metrics:
+            self.metrics.request_started()
+        try:
+            runner.submit_embed(ids_list, _on_result)
+            array = await fut
+        finally:
+            if self.metrics:
+                self.metrics.request_finished()
+
+        prompt_tokens = sum(len(ids) for ids in ids_list)
+        return EmbeddingsResponse(
+            object="list",
+            data=tuple(
+                EmbeddingData(object="embedding", embedding=row.tolist(), index=i)
+                for i, row in enumerate(array)
+            ),
+            model=req.model or self.model_name,
+            usage=Usage.of(prompt_tokens, 0),
+        )

@@ -1,0 +1,271 @@
+"""Metrics collection: Prometheus registry + `/server/stats` snapshot.
+
+TPU-native realization of the reference's spec'd ``MetricsCollector`` trait
+and ``MetricsSnapshot`` (``design.md:466-491`` [spec]; behavior
+``requirements.md:118-122``): request latency by endpoint/status, batch size
+and padding ratio, inference token/duration, time-to-first-token, cache hit
+rate, queue depth, and per-engine status, exported both as Prometheus text
+(GET /metrics) and as a JSON snapshot (GET /server/stats).
+
+Thread-safe: the engine-runner thread, dispatcher thread, and asyncio
+handlers all record into the same collector.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+# rolling windows for the snapshot's derived rates
+_TOKEN_WINDOW_S = 10.0
+_LATENCY_WINDOW = 1024
+
+
+@dataclass(frozen=True)
+class EngineStatus:
+    """Health/load of one engine replica (reference ``WorkerStatus``,
+    design.md:283-296 [spec])."""
+
+    engine_id: str
+    healthy: bool
+    active_requests: int
+    waiting_requests: int
+    total_processed: int
+    memory_used_pages: int = 0
+    memory_total_pages: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "engine_id": self.engine_id,
+            "healthy": self.healthy,
+            "active_requests": self.active_requests,
+            "waiting_requests": self.waiting_requests,
+            "total_processed": self.total_processed,
+            "memory_used_pages": self.memory_used_pages,
+            "memory_total_pages": self.memory_total_pages,
+        }
+
+
+@dataclass(frozen=True)
+class MetricsSnapshot:
+    """JSON stats snapshot (reference ``MetricsSnapshot``,
+    design.md:479-491 [spec])."""
+
+    total_requests: int
+    active_requests: int
+    tokens_per_second: float
+    average_ttft_ms: float
+    average_latency_ms: float
+    p99_latency_ms: float
+    average_batch_size: float
+    cache_hit_rate: float
+    queue_depth: int
+    worker_statuses: Tuple[EngineStatus, ...] = ()
+    uptime_seconds: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "total_requests": self.total_requests,
+            "active_requests": self.active_requests,
+            "tokens_per_second": round(self.tokens_per_second, 3),
+            "average_ttft_ms": round(self.average_ttft_ms, 3),
+            "average_latency_ms": round(self.average_latency_ms, 3),
+            "p99_latency_ms": round(self.p99_latency_ms, 3),
+            "average_batch_size": round(self.average_batch_size, 3),
+            "cache_hit_rate": round(self.cache_hit_rate, 4),
+            "queue_depth": self.queue_depth,
+            "worker_statuses": [w.to_dict() for w in self.worker_statuses],
+            "uptime_seconds": round(self.uptime_seconds, 1),
+        }
+
+
+class MetricsCollector:
+    """Records serving metrics; renders Prometheus text and JSON snapshots."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        self._lock = threading.Lock()
+        self._started_at = time.monotonic()
+
+        r = self.registry
+        self.request_latency = Histogram(
+            "request_latency_seconds",
+            "End-to-end request latency",
+            ["endpoint", "status"],
+            registry=r,
+            buckets=(0.005, 0.02, 0.05, 0.1, 0.2, 0.5, 1, 2, 5, 10, 30),
+        )
+        self.batch_size = Histogram(
+            "batch_size",
+            "Requests per dispatched admission batch",
+            registry=r,
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )
+        self.batch_padding_ratio = Histogram(
+            "batch_padding_ratio",
+            "Padding overhead per batch (padded/real - 1)",
+            registry=r,
+            buckets=(0.0, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0),
+        )
+        self.tokens_generated = Counter(
+            "tokens_generated_total", "Output tokens generated", registry=r
+        )
+        self.inference_seconds = Counter(
+            "inference_seconds_total",
+            "Wall-clock seconds spent in engine steps",
+            registry=r,
+        )
+        self.ttft = Histogram(
+            "time_to_first_token_seconds",
+            "Admission to first streamed token",
+            registry=r,
+            buckets=(0.01, 0.05, 0.1, 0.2, 0.5, 1, 2, 5),
+        )
+        self.cache_hits = Counter(
+            "kv_cache_hits_total", "Prefix-cache page hits", registry=r
+        )
+        self.cache_misses = Counter(
+            "kv_cache_misses_total", "Prefix-cache misses", registry=r
+        )
+        self.cache_evictions = Counter(
+            "kv_cache_evictions_total", "LRU page evictions", registry=r
+        )
+        self.queue_depth_g = Gauge(
+            "queue_depth", "Queued requests by priority", ["priority"], registry=r
+        )
+        self.active_requests_g = Gauge(
+            "active_requests", "Requests admitted and not yet finished", registry=r
+        )
+        self.engine_up = Gauge(
+            "engine_up", "1 if the engine replica is healthy", ["engine_id"],
+            registry=r,
+        )
+
+        # snapshot internals
+        self._total_requests = 0
+        self._active_requests = 0
+        self._token_events: Deque[Tuple[float, int]] = deque()
+        self._latencies_ms: Deque[float] = deque(maxlen=_LATENCY_WINDOW)
+        self._ttfts_ms: Deque[float] = deque(maxlen=_LATENCY_WINDOW)
+        self._batch_sizes: Deque[int] = deque(maxlen=_LATENCY_WINDOW)
+        self._cache_hits = 0
+        self._cache_misses = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def record_request(self, endpoint: str, status: int, latency_s: float) -> None:
+        self.request_latency.labels(endpoint=endpoint, status=str(status)).observe(
+            latency_s
+        )
+        with self._lock:
+            self._total_requests += 1
+            self._latencies_ms.append(latency_s * 1000.0)
+
+    def record_batch(self, size: int, padding_ratio: float = 0.0) -> None:
+        self.batch_size.observe(size)
+        self.batch_padding_ratio.observe(padding_ratio)
+        with self._lock:
+            self._batch_sizes.append(size)
+
+    def record_tokens(self, n: int) -> None:
+        if n <= 0:
+            return
+        self.tokens_generated.inc(n)
+        now = time.monotonic()
+        with self._lock:
+            self._token_events.append((now, n))
+            cutoff = now - _TOKEN_WINDOW_S
+            while self._token_events and self._token_events[0][0] < cutoff:
+                self._token_events.popleft()
+
+    def record_inference(self, duration_s: float) -> None:
+        self.inference_seconds.inc(duration_s)
+
+    def record_ttft(self, seconds: float) -> None:
+        self.ttft.observe(seconds)
+        with self._lock:
+            self._ttfts_ms.append(seconds * 1000.0)
+
+    def record_cache(self, hits: int = 0, misses: int = 0, evictions: int = 0) -> None:
+        """Record *deltas* of allocator counters since the last call."""
+        if hits:
+            self.cache_hits.inc(hits)
+        if misses:
+            self.cache_misses.inc(misses)
+        if evictions:
+            self.cache_evictions.inc(evictions)
+        with self._lock:
+            self._cache_hits += hits
+            self._cache_misses += misses
+
+    def set_queue_depth(self, high: int, normal: int, low: int) -> None:
+        self.queue_depth_g.labels(priority="high").set(high)
+        self.queue_depth_g.labels(priority="normal").set(normal)
+        self.queue_depth_g.labels(priority="low").set(low)
+        with self._lock:
+            self._queue_depth = high + normal + low
+
+    def request_started(self) -> None:
+        with self._lock:
+            self._active_requests += 1
+        self.active_requests_g.inc()
+
+    def request_finished(self) -> None:
+        with self._lock:
+            self._active_requests = max(0, self._active_requests - 1)
+        self.active_requests_g.dec()
+
+    def set_engine_up(self, engine_id: str, up: bool) -> None:
+        self.engine_up.labels(engine_id=engine_id).set(1 if up else 0)
+
+    # -- rendering ---------------------------------------------------------
+
+    def prometheus_text(self) -> bytes:
+        return generate_latest(self.registry)
+
+    def snapshot(
+        self, engine_statuses: Tuple[EngineStatus, ...] = ()
+    ) -> MetricsSnapshot:
+        now = time.monotonic()
+        with self._lock:
+            cutoff = now - _TOKEN_WINDOW_S
+            while self._token_events and self._token_events[0][0] < cutoff:
+                self._token_events.popleft()
+            window_tokens = sum(n for _, n in self._token_events)
+            if self._token_events:
+                span = max(now - self._token_events[0][0], 1e-3)
+            else:
+                span = _TOKEN_WINDOW_S
+            lat = sorted(self._latencies_ms)
+            p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat else 0.0
+            total_cache = self._cache_hits + self._cache_misses
+            return MetricsSnapshot(
+                total_requests=self._total_requests,
+                active_requests=self._active_requests,
+                tokens_per_second=window_tokens / span,
+                average_ttft_ms=(
+                    sum(self._ttfts_ms) / len(self._ttfts_ms) if self._ttfts_ms else 0.0
+                ),
+                average_latency_ms=sum(lat) / len(lat) if lat else 0.0,
+                p99_latency_ms=p99,
+                average_batch_size=(
+                    sum(self._batch_sizes) / len(self._batch_sizes)
+                    if self._batch_sizes
+                    else 0.0
+                ),
+                cache_hit_rate=self._cache_hits / total_cache if total_cache else 0.0,
+                queue_depth=getattr(self, "_queue_depth", 0),
+                worker_statuses=engine_statuses,
+                uptime_seconds=now - self._started_at,
+            )

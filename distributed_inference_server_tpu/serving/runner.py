@@ -1,0 +1,357 @@
+"""Engine runner: a dedicated thread owning one ``LLMEngine`` replica.
+
+The reference's ``InferenceWorker`` (``design.md:335-342`` [spec]) maps to
+one runner = one engine replica = one "worker". The engine itself is
+single-owner and synchronous (engine/engine.py); every interaction with it
+— request admission, aborts, embeddings — goes through a thread-safe inbox
+drained on the runner thread between decode steps. Step outputs are fanned
+out to per-request ``ResultSink``s, which the HTTP layer bridges onto the
+asyncio loop.
+
+Failure semantics (``requirements.md:104-110,130-134``):
+- per-request failures surface as ``StepOutput.error`` and poison only that
+  request (Property 22);
+- an unhandled exception in the step loop marks the runner unhealthy and
+  fails all in-flight requests; the scheduler's health checker notices the
+  flag within its check interval (<5 s detection, requirements.md:133) and
+  can ``restart()`` it (worker self-restart, requirements.md:109).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Protocol, Sequence
+
+import numpy as np
+
+from distributed_inference_server_tpu.core.models import FinishReason, Usage
+from distributed_inference_server_tpu.core.types import RequestId
+from distributed_inference_server_tpu.engine.engine import (
+    LLMEngine,
+    SamplingParams,
+    StepOutput,
+)
+from distributed_inference_server_tpu.serving.metrics import (
+    EngineStatus,
+    MetricsCollector,
+)
+
+
+class ResultSink(Protocol):
+    """Receives a request's step outputs. Methods are called on the runner
+    thread and must be non-blocking and exception-free; the HTTP layer's
+    sinks bounce to the asyncio loop via ``call_soon_threadsafe``."""
+
+    def on_token(self, token_id: int, text: str, token_index: int) -> None: ...
+
+    def on_done(self, finish_reason: FinishReason, usage: Usage) -> None: ...
+
+    def on_error(self, message: str, code: str) -> None: ...
+
+
+class ServerRequest:
+    """A validated, tokenized request handed to the serving spine."""
+
+    __slots__ = ("request_id", "prompt_ids", "params", "sink", "submitted_at",
+                 "first_token_at")
+
+    def __init__(
+        self,
+        request_id: RequestId,
+        prompt_ids: List[int],
+        params: SamplingParams,
+        sink: ResultSink,
+    ):
+        self.request_id = request_id
+        self.prompt_ids = prompt_ids
+        self.params = params
+        self.sink = sink
+        self.submitted_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+
+
+class EngineRunner:
+    """Runs one engine on a dedicated thread; thread-safe façade."""
+
+    def __init__(
+        self,
+        engine_id: str,
+        engine_factory: Callable[[], LLMEngine],
+        metrics: Optional[MetricsCollector] = None,
+    ):
+        self.engine_id = engine_id
+        self._factory = engine_factory
+        self.metrics = metrics
+        self._inbox: Deque[Callable[[], None]] = deque()
+        self._inbox_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._healthy = False
+        self._last_error: Optional[str] = None
+        self._total_processed = 0
+        self._inflight: Dict[RequestId, ServerRequest] = {}
+        self._pending_embeds: Dict[int, Callable] = {}
+        self._embed_seq = 0
+        self._engine: Optional[LLMEngine] = None
+        self._thread: Optional[threading.Thread] = None
+        self._cache_seen = {"hits": 0, "misses": 0, "evictions": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, wait_ready: bool = True, timeout: float = 300.0) -> None:
+        """Spawn the runner thread; optionally block until the engine is
+        constructed (model loaded) and the runner reports ready
+        (reference Req 7.2: worker reports ready before serving)."""
+        ready = threading.Event()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, args=(ready,), name=f"engine-{self.engine_id}",
+            daemon=True,
+        )
+        self._thread.start()
+        if wait_ready and not ready.wait(timeout):
+            raise TimeoutError(f"engine {self.engine_id} failed to start in {timeout}s")
+        if wait_ready and not self._healthy:
+            raise RuntimeError(
+                f"engine {self.engine_id} failed to initialize: {self._last_error}"
+            )
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        self._healthy = False
+        if self.metrics:
+            self.metrics.set_engine_up(self.engine_id, False)
+        # anything still in flight will never complete — tell the clients
+        self._fail_all("engine shut down before request completion")
+
+    def restart(self, wait_ready: bool = True, timeout: float = 300.0) -> None:
+        """Tear down and bring the engine back (worker self-restart,
+        requirements.md:109)."""
+        self.shutdown()
+        self._inbox.clear()
+        self._inflight.clear()
+        self.start(wait_ready=wait_ready, timeout=timeout)
+
+    # -- submission (any thread) -------------------------------------------
+
+    def submit(self, requests: Sequence[ServerRequest]) -> None:
+        reqs = list(requests)
+        # register in _inflight immediately (not inside the closure) so a
+        # crash between submit and inbox-drain still fails these sinks
+        for r in reqs:
+            self._inflight[r.request_id] = r
+        if not self._healthy:
+            self._fail_all_of(reqs, self._last_error or "engine unavailable")
+            return
+
+        def _do() -> None:
+            for r in reqs:
+                if r.request_id in self._inflight:  # not aborted meanwhile
+                    self._engine.add_request(r.request_id, r.prompt_ids, r.params)
+
+        self._post(_do)
+
+    def abort(self, request_id: RequestId) -> None:
+        def _do() -> None:
+            self._engine.abort(request_id)
+            self._inflight.pop(request_id, None)
+
+        self._post(_do)
+
+    def evict_cache(self, target_frac: float) -> None:
+        """Evict cached (refcount-0) prefix pages until used/total <=
+        target_frac (degradation ladder, design.md:937 [spec])."""
+
+        def _do() -> None:
+            self._engine.allocator.evict_below(target_frac)
+
+        self._post(_do)
+
+    def submit_embed(
+        self,
+        ids_list: List[List[int]],
+        on_result: Callable[[Optional[np.ndarray], Optional[str]], None],
+    ) -> None:
+        """Queue an embeddings computation; ``on_result(array, error)`` is
+        called exactly once — on the runner thread, or here/at crash time if
+        the engine is (or becomes) unavailable."""
+        if not self._healthy:
+            on_result(None, self._last_error or "engine unavailable")
+            return
+        self._embed_seq += 1
+        token = self._embed_seq
+        self._pending_embeds[token] = on_result
+
+        def _do() -> None:
+            cb = self._pending_embeds.pop(token, None)
+            if cb is None:  # already failed by a crash handler
+                return
+            try:
+                cb(self._engine.embed_ids(ids_list), None)
+            except Exception as e:  # noqa: BLE001 — isolation boundary
+                cb(None, str(e))
+
+        self._post(_do)
+
+    def _post(self, fn: Callable[[], None]) -> None:
+        with self._inbox_lock:
+            self._inbox.append(fn)
+        self._wake.set()
+
+    # -- introspection (any thread) ---------------------------------------
+
+    def is_healthy(self) -> bool:
+        return self._healthy
+
+    def last_error(self) -> Optional[str]:
+        return self._last_error
+
+    def active_count(self) -> int:
+        return len(self._inflight)
+
+    def status(self) -> EngineStatus:
+        eng = self._engine
+        used = total = 0
+        waiting = 0
+        if eng is not None:
+            try:
+                s = eng.cache_stats()
+                used, total = s.pages_total - s.pages_free, s.pages_total
+                waiting = eng.num_waiting()
+            except Exception:  # noqa: BLE001 — status must never raise
+                pass
+        return EngineStatus(
+            engine_id=self.engine_id,
+            healthy=self._healthy,
+            active_requests=len(self._inflight),
+            waiting_requests=waiting,
+            total_processed=self._total_processed,
+            memory_used_pages=used,
+            memory_total_pages=total,
+        )
+
+    # -- runner thread ----------------------------------------------------
+
+    def _run(self, ready: threading.Event) -> None:
+        try:
+            self._engine = self._factory()
+            self._healthy = True
+        except Exception as e:  # noqa: BLE001 — startup failure isolation
+            self._last_error = str(e)
+            self._healthy = False
+            ready.set()
+            return
+        finally:
+            if self.metrics:
+                self.metrics.set_engine_up(self.engine_id, self._healthy)
+        ready.set()
+
+        try:
+            while not self._stop.is_set():
+                self._drain_inbox()
+                if self._engine.has_work():
+                    t0 = time.monotonic()
+                    outputs = self._engine.step()
+                    dt = time.monotonic() - t0
+                    if self.metrics:
+                        self.metrics.record_inference(dt)
+                    self._dispatch(outputs)
+                    self._report_cache_deltas()
+                else:
+                    self._wake.wait(0.005)
+                    self._wake.clear()
+        except Exception as e:  # noqa: BLE001 — engine-level crash
+            self._last_error = str(e)
+            self._healthy = False
+            if self.metrics:
+                self.metrics.set_engine_up(self.engine_id, False)
+            self._fail_all(str(e))
+
+    def _drain_inbox(self) -> None:
+        while True:
+            with self._inbox_lock:
+                if not self._inbox:
+                    return
+                fn = self._inbox.popleft()
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — command isolation
+                self._last_error = str(e)
+
+    def _dispatch(self, outputs: List[StepOutput]) -> None:
+        tokens = 0
+        for out in outputs:
+            req = self._inflight.get(out.request_id)
+            if req is None:
+                continue
+            try:
+                if out.error is not None:
+                    req.sink.on_error(out.error, "inference_failed")
+                elif out.token_id is not None or out.text:
+                    if req.first_token_at is None:
+                        req.first_token_at = time.monotonic()
+                        if self.metrics:
+                            self.metrics.record_ttft(
+                                req.first_token_at - req.submitted_at
+                            )
+                    if out.token_id is not None:
+                        tokens += 1
+                    if not out.finished:
+                        req.sink.on_token(out.token_id, out.text, out.token_index)
+                if out.finished:
+                    if out.error is None:
+                        # flush any final delta carried on the done event
+                        if out.text:
+                            req.sink.on_token(None, out.text, out.token_index)
+                        req.sink.on_done(
+                            out.finish_reason or FinishReason.STOP,
+                            out.usage or Usage(),
+                        )
+                    self._inflight.pop(out.request_id, None)
+                    self._total_processed += 1
+            except Exception as e:  # noqa: BLE001 — sink isolation
+                self._last_error = f"sink error: {e}"
+                self._inflight.pop(out.request_id, None)
+        if self.metrics and tokens:
+            self.metrics.record_tokens(tokens)
+
+    def _report_cache_deltas(self) -> None:
+        if not self.metrics or self._engine is None:
+            return
+        try:
+            s = self._engine.cache_stats()
+        except Exception:  # noqa: BLE001
+            return
+        seen = self._cache_seen
+        self.metrics.record_cache(
+            hits=max(0, s.hits - seen["hits"]),
+            misses=max(0, s.misses - seen["misses"]),
+            evictions=max(0, s.evictions - seen["evictions"]),
+        )
+        self._cache_seen = {
+            "hits": s.hits, "misses": s.misses, "evictions": s.evictions,
+        }
+
+    def _fail_all(self, message: str) -> None:
+        self._fail_all_of(list(self._inflight.values()), message)
+        self._inflight.clear()
+        for token in list(self._pending_embeds):
+            cb = self._pending_embeds.pop(token, None)
+            if cb is not None:
+                try:
+                    cb(None, message)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _fail_all_of(self, reqs: Sequence[ServerRequest], message: str) -> None:
+        for req in reqs:
+            try:
+                req.sink.on_error(message, "worker_failure")
+            except Exception:  # noqa: BLE001
+                pass
+            self._inflight.pop(req.request_id, None)

@@ -1,0 +1,171 @@
+"""Adaptive scheduler: routes admission batches to engine replicas.
+
+TPU-native realization of the reference's spec'd ``Scheduler`` trait and
+strategies (``design.md:269-307`` [spec]; behavior ``requirements.md:92-98``):
+
+- **round-robin** — rotate over healthy engines;
+- **least-loaded** — fewest active+waiting requests (design.md:277);
+- **memory-aware** — most free KV pages, i.e. the estimated batch memory
+  fits where the most page capacity remains (design.md:278-280);
+- runtime strategy switching (``set_strategy``, design.md:306);
+- register/unregister engines at runtime (elastic scaling,
+  requirements.md:110);
+- health checking: unhealthy engines leave the routing set and are
+  reinstated on recovery (requirements.md:97-98; Properties 18-19), with
+  optional automatic restart (requirements.md:109,133).
+
+Pure-logic core (strategy choice over ``EngineStatus`` vectors) is separated
+from the threaded health loop so scheduler properties are testable without
+engines, mirroring the reference's test approach (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from distributed_inference_server_tpu.serving.metrics import EngineStatus
+from distributed_inference_server_tpu.serving.runner import EngineRunner
+
+
+class SchedulingStrategy(str, enum.Enum):
+    ROUND_ROBIN = "round_robin"
+    LEAST_LOADED = "least_loaded"
+    MEMORY_AWARE = "memory_aware"
+
+    @classmethod
+    def parse(cls, value: str) -> "SchedulingStrategy":
+        return cls(value.strip().lower())
+
+
+def choose_engine(
+    strategy: SchedulingStrategy,
+    statuses: Sequence[EngineStatus],
+    rr_counter: int,
+) -> Optional[str]:
+    """Pure strategy core: pick an engine id from healthy statuses.
+
+    Property 16: only healthy engines are eligible. Property 17:
+    least-loaded picks a minimum-load engine. Deterministic given inputs.
+    """
+    healthy = [s for s in statuses if s.healthy]
+    if not healthy:
+        return None
+    if strategy is SchedulingStrategy.ROUND_ROBIN:
+        return healthy[rr_counter % len(healthy)].engine_id
+    if strategy is SchedulingStrategy.LEAST_LOADED:
+        return min(
+            healthy, key=lambda s: (s.active_requests + s.waiting_requests,
+                                    s.engine_id)
+        ).engine_id
+    # memory-aware: most free pages; tie-break on load then id
+    return min(
+        healthy,
+        key=lambda s: (
+            -(s.memory_total_pages - s.memory_used_pages),
+            s.active_requests + s.waiting_requests,
+            s.engine_id,
+        ),
+    ).engine_id
+
+
+class AdaptiveScheduler:
+    """Thread-safe scheduler over registered ``EngineRunner`` replicas."""
+
+    def __init__(
+        self,
+        strategy: SchedulingStrategy = SchedulingStrategy.LEAST_LOADED,
+        health_check_interval_s: float = 1.0,
+        auto_restart: bool = False,
+    ):
+        self._strategy = strategy
+        self._engines: Dict[str, EngineRunner] = {}
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._interval = health_check_interval_s
+        self._auto_restart = auto_restart
+        self._stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        self._restarting: set = set()
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, runner: EngineRunner) -> None:
+        with self._lock:
+            self._engines[runner.engine_id] = runner
+
+    def unregister(self, engine_id: str) -> Optional[EngineRunner]:
+        with self._lock:
+            return self._engines.pop(engine_id, None)
+
+    def engines(self) -> List[EngineRunner]:
+        with self._lock:
+            return list(self._engines.values())
+
+    def get(self, engine_id: str) -> Optional[EngineRunner]:
+        with self._lock:
+            return self._engines.get(engine_id)
+
+    # -- strategy ----------------------------------------------------------
+
+    def strategy(self) -> SchedulingStrategy:
+        return self._strategy
+
+    def set_strategy(self, strategy: SchedulingStrategy) -> None:
+        self._strategy = strategy
+
+    # -- routing -----------------------------------------------------------
+
+    def statuses(self) -> List[EngineStatus]:
+        return [r.status() for r in self.engines()]
+
+    def schedule(self) -> Optional[EngineRunner]:
+        """Pick an engine for the next admission batch, or None if no
+        healthy engine exists (graceful failure, Property 20)."""
+        statuses = self.statuses()
+        with self._lock:
+            engine_id = choose_engine(self._strategy, statuses, self._rr)
+            if engine_id is None:
+                return None
+            self._rr += 1
+            return self._engines.get(engine_id)
+
+    # -- health loop -------------------------------------------------------
+
+    def start_health_loop(self) -> None:
+        if self._health_thread is not None:
+            return
+        self._stop.clear()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="scheduler-health", daemon=True
+        )
+        self._health_thread.start()
+
+    def stop_health_loop(self) -> None:
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(5.0)
+            self._health_thread = None
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            for runner in self.engines():
+                if runner.is_healthy() or not self._auto_restart:
+                    continue
+                if runner.engine_id in self._restarting:
+                    continue
+                self._restarting.add(runner.engine_id)
+                t = threading.Thread(
+                    target=self._restart_one, args=(runner,), daemon=True
+                )
+                t.start()
+
+    def _restart_one(self, runner: EngineRunner) -> None:
+        try:
+            runner.restart(wait_ready=True)
+        except Exception:  # noqa: BLE001 — keep retrying on next sweep
+            pass
+        finally:
+            self._restarting.discard(runner.engine_id)

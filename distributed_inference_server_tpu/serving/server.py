@@ -1,0 +1,174 @@
+"""Server orchestrator: spawn engines, wire the spine, serve HTTP.
+
+Realizes the reference's spec'd ``InferenceServer`` (S9, ``tasks.md:298-312``
+[spec]; behavior ``requirements.md:104-110,130-134``):
+
+- spawn N engine replicas ("workers") and wait until each reports ready;
+- register them with the adaptive scheduler + start health checking;
+- start the dispatcher (queue→batcher→engines) and the HTTP transport;
+- graceful shutdown: stop accepting (503), drain in-flight, stop threads;
+- runtime elastic scaling: ``scale_to(n)`` adds/removes engine replicas
+  without interrupting in-flight requests (requirements.md:110).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, List, Optional
+
+from aiohttp import web
+
+from distributed_inference_server_tpu.core.queue import QueueConfig
+from distributed_inference_server_tpu.core.validator import (
+    RequestValidator,
+    ValidatorConfig,
+)
+from distributed_inference_server_tpu.engine.engine import LLMEngine
+from distributed_inference_server_tpu.models.tokenizer import Tokenizer
+from distributed_inference_server_tpu.serving.app import build_app
+from distributed_inference_server_tpu.serving.batcher import BatcherConfig
+from distributed_inference_server_tpu.serving.dispatcher import Dispatcher
+from distributed_inference_server_tpu.serving.handler import InferenceHandler
+from distributed_inference_server_tpu.serving.metrics import MetricsCollector
+from distributed_inference_server_tpu.serving.runner import EngineRunner
+from distributed_inference_server_tpu.serving.scheduler import (
+    AdaptiveScheduler,
+    SchedulingStrategy,
+)
+
+
+class InferenceServer:
+    """Owns the full serving stack for one model."""
+
+    def __init__(
+        self,
+        engine_factory: Callable[[], LLMEngine],
+        tokenizer: Tokenizer,
+        model_name: str,
+        num_engines: int = 1,
+        strategy: SchedulingStrategy = SchedulingStrategy.LEAST_LOADED,
+        queue_config: Optional[QueueConfig] = None,
+        batcher_config: Optional[BatcherConfig] = None,
+        validator_config: Optional[ValidatorConfig] = None,
+        auto_restart: bool = True,
+        health_check_interval_s: float = 1.0,
+    ):
+        self.engine_factory = engine_factory
+        self.metrics = MetricsCollector()
+        self.scheduler = AdaptiveScheduler(
+            strategy=strategy,
+            health_check_interval_s=health_check_interval_s,
+            auto_restart=auto_restart,
+        )
+        self.dispatcher = Dispatcher(
+            self.scheduler,
+            queue_config=queue_config,
+            batcher_config=batcher_config,
+            metrics=self.metrics,
+        )
+        self.handler = InferenceHandler(
+            self.dispatcher,
+            tokenizer,
+            model_name,
+            validator=RequestValidator(validator_config),
+            metrics=self.metrics,
+        )
+        from distributed_inference_server_tpu.serving.degradation import (
+            DegradationController,
+        )
+
+        self.degradation = DegradationController(self.dispatcher, self.scheduler)
+        self._num_engines = num_engines
+        self._next_engine_idx = 0
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, wait_ready: bool = True) -> None:
+        """Spawn engines (Req 7.1-7.2), start health checks and dispatch."""
+        for _ in range(self._num_engines):
+            self._spawn_engine(wait_ready=wait_ready)
+        self.scheduler.start_health_loop()
+        self.dispatcher.start()
+        self.degradation.start()
+        self._started = True
+
+    def shutdown(self, drain_timeout_s: float = 30.0) -> None:
+        """Graceful: stop accepting, drain, stop engines (Req 9.5)."""
+        self.degradation.stop()
+        self.dispatcher.shutdown(drain_timeout_s)
+        self.scheduler.stop_health_loop()
+        for runner in self.scheduler.engines():
+            runner.shutdown()
+        self._started = False
+
+    # -- elasticity --------------------------------------------------------
+
+    def _spawn_engine(self, wait_ready: bool = True) -> EngineRunner:
+        engine_id = f"engine-{self._next_engine_idx}"
+        self._next_engine_idx += 1
+        runner = EngineRunner(engine_id, self.engine_factory, self.metrics)
+        runner.start(wait_ready=wait_ready)
+        self.scheduler.register(runner)
+        return runner
+
+    def scale_to(self, n: int) -> None:
+        """Add or remove engine replicas at runtime (requirements.md:110).
+        Removal drains: the engine is unregistered (no new batches) and shut
+        down once its in-flight requests finish."""
+        current = self.scheduler.engines()
+        for _ in range(n - len(current)):
+            self._spawn_engine()
+        if n < len(current):
+            # retire the youngest replicas
+            for runner in current[n:]:
+                self.scheduler.unregister(runner.engine_id)
+                self._drain_and_stop(runner)
+
+    def _drain_and_stop(self, runner: EngineRunner) -> None:
+        import threading
+        import time
+
+        def _wait():
+            deadline = time.monotonic() + 60.0
+            while runner.active_count() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            runner.shutdown()
+
+        threading.Thread(target=_wait, daemon=True).start()
+
+    # -- hot-reload --------------------------------------------------------
+
+    def apply_hot_config(self, diff: dict, new_config) -> None:
+        """Apply hot-reloadable config changes (requirements.md:146):
+        batching window/size, queue watermarks/timeout, scheduling
+        strategy. ConfigWatcher subscriber signature."""
+        sections = {section for section, _ in diff}
+        if "batcher" in sections:
+            self.dispatcher.batcher.config = new_config.batcher_config()
+        if "queue" in sections:
+            self.dispatcher.queue.config = new_config.queue_config()
+        if ("server", "strategy") in diff:
+            self.scheduler.set_strategy(new_config.strategy())
+
+    # -- HTTP --------------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        return build_app(self.handler, self.metrics)
+
+    async def serve(self, host: str = "0.0.0.0", port: int = 8000) -> web.AppRunner:
+        """Bind and serve; returns the AppRunner (caller controls lifetime)."""
+        runner = web.AppRunner(self.build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        return runner
+
+    async def serve_forever(self, host: str = "0.0.0.0", port: int = 8000) -> None:
+        runner = await self.serve(host, port)
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        finally:
+            await runner.cleanup()
+            self.shutdown()

@@ -1,0 +1,107 @@
+"""Token streaming: engine-thread → asyncio bridge and SSE encoding.
+
+Realizes the reference's spec'd ``TokenStreamer`` (``design.md:449-458``
+[spec]; behavior ``requirements.md:82-86``) on asyncio:
+
+- per-request channel: the engine runner thread pushes ``TokenEvent``s via
+  ``loop.call_soon_threadsafe`` into an ``asyncio.Queue`` — the tokio
+  ``mpsc`` analogue — so delivery to the HTTP writer happens within the
+  next loop tick (≤10 ms budget, requirements.md:82);
+- ``Done`` event carries finish_reason + usage, ``Error`` then close
+  (``TokenEvent`` wire schema, core/models.py ← models.rs:270-288);
+- client disconnect aborts generation upstream (Req 5.4) — the HTTP layer
+  calls ``Dispatcher.abort``.
+
+Non-streaming requests use ``CollectingSink``, which accumulates text and
+resolves a future.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from distributed_inference_server_tpu.core.models import (
+    FinishReason,
+    TokenEvent,
+    Usage,
+)
+
+
+def sse_encode(event: TokenEvent) -> bytes:
+    """One SSE frame: ``data: {json}\\n\\n`` (Req 1.6)."""
+    return f"data: {json.dumps(event.to_dict())}\n\n".encode()
+
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+class StreamingSink:
+    """ResultSink pushing TokenEvents onto an asyncio.Queue (runner thread →
+    loop). ``None`` terminates the stream."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self.queue: "asyncio.Queue[Optional[TokenEvent]]" = asyncio.Queue()
+        self.finish_reason: Optional[FinishReason] = None
+        self.usage: Optional[Usage] = None
+        self.error: Optional[str] = None
+
+    def _put(self, item: Optional[TokenEvent]) -> None:
+        self._loop.call_soon_threadsafe(self.queue.put_nowait, item)
+
+    # runner-thread callbacks ------------------------------------------------
+
+    def on_token(self, token_id: Optional[int], text: str, token_index: int) -> None:
+        self._put(TokenEvent.token_event(text, token_index))
+
+    def on_done(self, finish_reason: FinishReason, usage: Usage) -> None:
+        self.finish_reason = finish_reason
+        self.usage = usage
+        self._put(TokenEvent.done_event(finish_reason, usage))
+        self._put(None)
+
+    def on_error(self, message: str, code: str) -> None:
+        self.error = message
+        self._put(TokenEvent.error_event(message, code))
+        self._put(None)
+
+    # loop-side consumption --------------------------------------------------
+
+    async def events(self):
+        while True:
+            item = await self.queue.get()
+            if item is None:
+                return
+            yield item
+
+
+class CollectingSink:
+    """ResultSink accumulating the full completion for non-streaming
+    responses; resolves an asyncio future with
+    ``(text, finish_reason, usage)`` or an error tuple."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self.future: asyncio.Future = loop.create_future()
+        self._parts: list = []
+
+    def _resolve(self, value) -> None:
+        def _set() -> None:
+            if not self.future.done():
+                self.future.set_result(value)
+
+        self._loop.call_soon_threadsafe(_set)
+
+    # runner-thread callbacks ------------------------------------------------
+
+    def on_token(self, token_id: Optional[int], text: str, token_index: int) -> None:
+        if text:
+            self._parts.append(text)
+
+    def on_done(self, finish_reason: FinishReason, usage: Usage) -> None:
+        self._resolve(("".join(self._parts), finish_reason, usage, None, None))
+
+    def on_error(self, message: str, code: str) -> None:
+        self._resolve((None, None, None, message, code))

@@ -1,0 +1,113 @@
+"""Graceful-degradation ladder tests (design.md:925-943 [spec];
+requirements.md:130-134): pure threshold logic plus applied side effects
+on dispatcher/batcher, including reversal when pressure drops."""
+
+from __future__ import annotations
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from distributed_inference_server_tpu.core.errors import QueueFull
+from distributed_inference_server_tpu.core.types import Priority
+from distributed_inference_server_tpu.engine.engine import SamplingParams
+from distributed_inference_server_tpu.serving.degradation import (
+    DegradationController,
+    DegradationLevel,
+    level_for_pressure,
+)
+from distributed_inference_server_tpu.serving.dispatcher import Dispatcher
+from distributed_inference_server_tpu.serving.runner import ServerRequest
+from distributed_inference_server_tpu.serving.scheduler import AdaptiveScheduler
+
+
+class _NullSink:
+    def on_token(self, *a): ...
+
+    def on_done(self, *a): ...
+
+    def on_error(self, *a): ...
+
+
+def _req(rid="r"):
+    return ServerRequest(rid, [1], SamplingParams(), _NullSink())
+
+
+def _controller():
+    d = Dispatcher(AdaptiveScheduler())
+    d._accepting = True
+    return DegradationController(d, d.scheduler), d
+
+
+class TestLevelForPressure:
+    @pytest.mark.parametrize(
+        "pressure,expected",
+        [
+            (0.0, DegradationLevel.NORMAL),
+            (0.69, DegradationLevel.NORMAL),
+            (0.70, DegradationLevel.REDUCED_BATCH_SIZE),
+            (0.79, DegradationLevel.REDUCED_BATCH_SIZE),
+            (0.80, DegradationLevel.AGGRESSIVE_CACHE_EVICTION),
+            (0.89, DegradationLevel.AGGRESSIVE_CACHE_EVICTION),
+            (0.90, DegradationLevel.REJECT_LOW_PRIORITY),
+            (0.94, DegradationLevel.REJECT_LOW_PRIORITY),
+            (0.95, DegradationLevel.EMERGENCY),
+            (1.0, DegradationLevel.EMERGENCY),
+        ],
+    )
+    def test_thresholds(self, pressure, expected):
+        assert level_for_pressure(pressure) == expected
+
+    @settings(max_examples=100, deadline=None)
+    @given(p=st.floats(0.0, 1.5))
+    def test_monotone(self, p):
+        """Higher pressure never maps to a lower level."""
+        assert level_for_pressure(p) >= level_for_pressure(max(0.0, p - 0.1))
+
+
+class TestControllerActions:
+    def test_reduced_batch_size_applied_and_reverted(self):
+        c, d = _controller()
+        original = d.batcher.effective_max_batch()
+        c.evaluate(pressure=0.75)
+        assert d.batcher.effective_max_batch() == original // 2
+        c.evaluate(pressure=0.10)
+        assert d.batcher.effective_max_batch() == original
+
+    def test_degradation_composes_with_hot_reload(self):
+        """Hot-reloading batcher config while degraded neither cancels the
+        throttle nor gets reverted on recovery (single-owner divisor)."""
+        from distributed_inference_server_tpu.serving.batcher import BatcherConfig
+
+        c, d = _controller()
+        c.evaluate(pressure=0.75)  # degraded: divisor 2
+        d.batcher.config = BatcherConfig(window_ms=50.0, max_batch_size=64)
+        assert d.batcher.effective_max_batch() == 32  # still halved
+        c.evaluate(pressure=0.10)  # recovered
+        assert d.batcher.effective_max_batch() == 64  # reload preserved
+
+    def test_reject_low_priority(self):
+        c, d = _controller()
+        c.evaluate(pressure=0.92)
+        assert c.level == DegradationLevel.REJECT_LOW_PRIORITY
+        d.submit(_req("normal-ok"), Priority.NORMAL)  # still accepted
+        with pytest.raises(QueueFull):
+            d.submit(_req("low-rejected"), Priority.LOW)
+
+    def test_emergency_rejects_all(self):
+        c, d = _controller()
+        c.evaluate(pressure=0.99)
+        assert c.level == DegradationLevel.EMERGENCY
+        with pytest.raises(QueueFull):
+            d.submit(_req("high-rejected"), Priority.HIGH)
+
+    def test_recovery_lifts_gates(self):
+        c, d = _controller()
+        c.evaluate(pressure=0.99)
+        c.evaluate(pressure=0.30)
+        assert c.level == DegradationLevel.NORMAL
+        d.submit(_req("accepted-again"), Priority.LOW)
+
+    def test_memory_pressure_no_engines_is_zero(self):
+        c, _ = _controller()
+        assert c.memory_pressure() == 0.0

@@ -1,0 +1,350 @@
+"""Unit/property tests for the serving spine's pure logic: admission
+batcher (Properties 4-5), scheduler strategies (Properties 16-20),
+dispatcher sweep/backpressure (Properties 7-8 at the serving boundary),
+and SSE encoding (Properties 13-15 wire format).
+
+Mirrors the reference's test strategy (SURVEY.md §4): property-based where
+the spec gives a property, deterministic clocks everywhere.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from distributed_inference_server_tpu.core.errors import QueueFull
+from distributed_inference_server_tpu.core.models import FinishReason, TokenEvent, Usage
+from distributed_inference_server_tpu.core.queue import (
+    PriorityQueueManager,
+    QueueConfig,
+    QueuedRequest,
+)
+from distributed_inference_server_tpu.core.types import Priority
+from distributed_inference_server_tpu.engine.engine import SamplingParams
+from distributed_inference_server_tpu.serving.batcher import (
+    AdmissionBatcher,
+    BatcherConfig,
+)
+from distributed_inference_server_tpu.serving.dispatcher import Dispatcher
+from distributed_inference_server_tpu.serving.metrics import (
+    EngineStatus,
+    MetricsCollector,
+)
+from distributed_inference_server_tpu.serving.runner import ServerRequest
+from distributed_inference_server_tpu.serving.scheduler import (
+    AdaptiveScheduler,
+    SchedulingStrategy,
+    choose_engine,
+)
+from distributed_inference_server_tpu.serving.streamer import sse_encode
+
+
+class RecordingSink:
+    def __init__(self) -> None:
+        self.tokens: List[str] = []
+        self.done: Optional[FinishReason] = None
+        self.usage: Optional[Usage] = None
+        self.errors: List[tuple] = []
+
+    def on_token(self, token_id, text, token_index) -> None:
+        self.tokens.append(text)
+
+    def on_done(self, finish_reason, usage) -> None:
+        self.done = finish_reason
+        self.usage = usage
+
+    def on_error(self, message, code) -> None:
+        self.errors.append((message, code))
+
+
+def _req(rid: str = "r") -> ServerRequest:
+    return ServerRequest(rid, [1, 2, 3], SamplingParams(), RecordingSink())
+
+
+# ---------------------------------------------------------------------------
+# Admission batcher — Properties 4-5 (design.md:704-714 [spec])
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionBatcher:
+    def _mk(self, window_ms=50.0, max_batch=4):
+        q: PriorityQueueManager = PriorityQueueManager(
+            QueueConfig(high_watermark=10_000, low_watermark=5_000,
+                        max_queue_size=20_000)
+        )
+        b = AdmissionBatcher(q, BatcherConfig(window_ms=window_ms,
+                                              max_batch_size=max_batch))
+        return q, b
+
+    def test_size_trigger_dispatches_immediately(self):
+        q, b = self._mk(window_ms=1e9, max_batch=4)
+        t = 100.0
+        for i in range(4):
+            q.enqueue(QueuedRequest(id=f"r{i}", data=i))
+        batch = b.poll(t)
+        assert batch is not None and len(batch) == 4
+
+    def test_window_trigger(self):
+        q, b = self._mk(window_ms=50.0, max_batch=32)
+        q.enqueue(QueuedRequest(id="r0", data=0))
+        assert b.poll(100.0) is None  # window opens
+        assert b.poll(100.049) is None
+        batch = b.poll(100.051)
+        assert batch is not None and len(batch) == 1
+
+    def test_window_anchored_to_first_request(self):
+        """A late-arriving request does not reset the window (Property 5:
+        max one window of wait)."""
+        q, b = self._mk(window_ms=50.0, max_batch=32)
+        q.enqueue(QueuedRequest(id="r0", data=0))
+        assert b.poll(100.0) is None
+        q.enqueue(QueuedRequest(id="r1", data=1))
+        assert b.poll(100.03) is None
+        batch = b.poll(100.0501)
+        assert batch is not None and len(batch) == 2
+
+    def test_priority_order_within_batch(self):
+        q, b = self._mk(window_ms=0.0, max_batch=10)
+        q.enqueue(QueuedRequest(id="low", data=0, priority=Priority.LOW))
+        q.enqueue(QueuedRequest(id="high", data=1, priority=Priority.HIGH))
+        q.enqueue(QueuedRequest(id="norm", data=2, priority=Priority.NORMAL))
+        batch = b.poll(1.0)
+        assert [r.id for r in batch.requests] == ["high", "norm", "low"]
+
+    @settings(max_examples=100, deadline=None)
+    @given(
+        n=st.integers(min_value=0, max_value=100),
+        max_batch=st.integers(min_value=1, max_value=32),
+    )
+    def test_property4_batch_size_bounds(self, n: int, max_batch: int):
+        """Every dispatched batch has 1 <= size <= max_batch_size."""
+        q, b = self._mk(window_ms=0.0, max_batch=max_batch)
+        for i in range(n):
+            q.enqueue(QueuedRequest(id=f"r{i}", data=i))
+        seen = 0
+        t = 0.0
+        while True:
+            batch = b.poll(t)
+            t += 1.0
+            if batch is None:
+                break
+            assert 1 <= len(batch) <= max_batch
+            seen += len(batch)
+        assert seen == n
+
+    def test_flush_drains_pending(self):
+        q, b = self._mk(window_ms=1e9, max_batch=32)
+        q.enqueue(QueuedRequest(id="r0", data=0))
+        assert b.poll(10.0) is None
+        batch = b.flush(11.0)
+        assert batch is not None and len(batch) == 1
+        assert b.flush(12.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Scheduler strategy core — Properties 16-20 (design.md:776-804 [spec])
+# ---------------------------------------------------------------------------
+
+
+def _status(eid, healthy=True, active=0, waiting=0, used=0, total=100):
+    return EngineStatus(
+        engine_id=eid, healthy=healthy, active_requests=active,
+        waiting_requests=waiting, total_processed=0,
+        memory_used_pages=used, memory_total_pages=total,
+    )
+
+
+_status_strategy = st.builds(
+    _status,
+    eid=st.sampled_from(["e0", "e1", "e2", "e3"]),
+    healthy=st.booleans(),
+    active=st.integers(0, 50),
+    waiting=st.integers(0, 50),
+    used=st.integers(0, 100),
+)
+
+
+class TestChooseEngine:
+    @settings(max_examples=100, deadline=None)
+    @given(
+        statuses=st.lists(
+            _status_strategy, max_size=6, unique_by=lambda s: s.engine_id
+        ),
+        strategy=st.sampled_from(list(SchedulingStrategy)),
+        rr=st.integers(0, 1000),
+    )
+    def test_property16_only_healthy_selected(self, statuses, strategy, rr):
+        chosen = choose_engine(strategy, statuses, rr)
+        if chosen is None:
+            assert not any(s.healthy for s in statuses)
+        else:
+            assert any(s.engine_id == chosen and s.healthy for s in statuses)
+
+    @settings(max_examples=100, deadline=None)
+    @given(
+        statuses=st.lists(
+            _status_strategy, min_size=1, max_size=6,
+            unique_by=lambda s: s.engine_id,
+        ),
+        rr=st.integers(0, 1000),
+    )
+    def test_property17_least_loaded_minimal(self, statuses, rr):
+        chosen = choose_engine(SchedulingStrategy.LEAST_LOADED, statuses, rr)
+        healthy = [s for s in statuses if s.healthy]
+        if healthy:
+            min_load = min(s.active_requests + s.waiting_requests for s in healthy)
+            load = {
+                s.engine_id: s.active_requests + s.waiting_requests
+                for s in healthy
+            }
+            assert load[chosen] == min_load
+
+    def test_round_robin_rotates(self):
+        statuses = [_status("e0"), _status("e1"), _status("e2")]
+        picks = [
+            choose_engine(SchedulingStrategy.ROUND_ROBIN, statuses, i)
+            for i in range(6)
+        ]
+        assert picks == ["e0", "e1", "e2", "e0", "e1", "e2"]
+
+    def test_memory_aware_prefers_free_pages(self):
+        statuses = [
+            _status("full", used=90, total=100),
+            _status("empty", used=10, total=100),
+        ]
+        assert (
+            choose_engine(SchedulingStrategy.MEMORY_AWARE, statuses, 0) == "empty"
+        )
+
+    def test_property20_no_healthy_none(self):
+        statuses = [_status("e0", healthy=False), _status("e1", healthy=False)]
+        for strat in SchedulingStrategy:
+            assert choose_engine(strat, statuses, 0) is None
+
+
+class TestAdaptiveScheduler:
+    def test_runtime_strategy_switch(self):
+        s = AdaptiveScheduler(SchedulingStrategy.ROUND_ROBIN)
+        assert s.strategy() is SchedulingStrategy.ROUND_ROBIN
+        s.set_strategy(SchedulingStrategy.MEMORY_AWARE)
+        assert s.strategy() is SchedulingStrategy.MEMORY_AWARE
+
+    def test_schedule_empty_returns_none(self):
+        assert AdaptiveScheduler().schedule() is None
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher — backpressure (503) and timeout sweep (408)
+# ---------------------------------------------------------------------------
+
+
+class TestDispatcher:
+    def test_backpressure_raises_queue_full(self):
+        d = Dispatcher(
+            AdaptiveScheduler(),
+            queue_config=QueueConfig(high_watermark=2, low_watermark=1,
+                                     max_queue_size=10),
+        )
+        d._accepting = True
+        d.submit(_req("a"))
+        d.submit(_req("b"))
+        d.submit(_req("c"))  # total 3 > high watermark → backpressure on
+        try:
+            d.submit(_req("d"))
+            assert False, "expected QueueFull"
+        except QueueFull:
+            pass
+
+    def test_not_accepting_raises_queue_full(self):
+        d = Dispatcher(AdaptiveScheduler())
+        try:
+            d.submit(_req())
+            assert False, "expected QueueFull"
+        except QueueFull:
+            pass
+
+    def test_sweep_expires_to_408(self):
+        d = Dispatcher(
+            AdaptiveScheduler(),
+            queue_config=QueueConfig(request_timeout_s=5.0),
+        )
+        d._accepting = True
+        r = _req("victim")
+        d.submit(r)
+        d._sweep(time.monotonic() + 10.0)
+        assert r.sink.errors == [("Request timeout", "request_timeout")]
+        assert d.queue.is_empty()
+
+    def test_dispatch_without_engines_fails_batch(self):
+        d = Dispatcher(AdaptiveScheduler(), metrics=MetricsCollector())
+        r = _req()
+        d._dispatch([QueuedRequest(id=r.request_id, data=r)])
+        assert r.sink.errors and r.sink.errors[0][1] == "no_workers"
+
+    def test_abort_cancels_queued(self):
+        d = Dispatcher(AdaptiveScheduler())
+        d._accepting = True
+        r = _req("gone")
+        d.submit(r)
+        d.abort("gone")
+        assert d.queue.is_empty()
+
+
+# ---------------------------------------------------------------------------
+# SSE wire format — Properties 13-15 (design.md:758-774 [spec])
+# ---------------------------------------------------------------------------
+
+
+class TestSse:
+    def test_token_frame(self):
+        frame = sse_encode(TokenEvent.token_event("hi", 3))
+        assert frame == b'data: {"type": "token", "token": "hi", "index": 3}\n\n'
+
+    def test_roundtrip_done(self):
+        import json
+
+        ev = TokenEvent.done_event(FinishReason.LENGTH, Usage.of(5, 7))
+        payload = sse_encode(ev).decode()
+        assert payload.startswith("data: ") and payload.endswith("\n\n")
+        parsed = TokenEvent.from_dict(json.loads(payload[6:-2]))
+        assert parsed == ev
+
+
+# ---------------------------------------------------------------------------
+# Metrics snapshot
+# ---------------------------------------------------------------------------
+
+
+class TestMetrics:
+    def test_snapshot_basic(self):
+        m = MetricsCollector()
+        m.record_request("/generate", 200, 0.1)
+        m.record_request("/generate", 400, 0.3)
+        m.record_batch(4, 0.1)
+        m.record_tokens(100)
+        m.record_ttft(0.05)
+        m.record_cache(hits=3, misses=1)
+        m.set_queue_depth(1, 2, 3)
+        snap = m.snapshot()
+        assert snap.total_requests == 2
+        assert snap.queue_depth == 6
+        assert abs(snap.average_latency_ms - 200.0) < 1e-6
+        assert abs(snap.cache_hit_rate - 0.75) < 1e-9
+        assert snap.average_batch_size == 4.0
+        assert snap.tokens_per_second > 0
+        d = snap.to_dict()
+        assert d["total_requests"] == 2
+
+    def test_prometheus_render(self):
+        m = MetricsCollector()
+        m.record_tokens(5)
+        text = m.prometheus_text().decode()
+        assert "tokens_generated_total 5.0" in text
+
+    def test_active_requests_floor(self):
+        m = MetricsCollector()
+        m.request_finished()
+        assert m.snapshot().active_requests == 0
