@@ -69,7 +69,21 @@ def main(argv=None) -> int:
             validator_cfg, max_context_tokens=engine_prompt_cap
         )
 
-    def engine_factory() -> LLMEngine:
+    tp = cfg.get("engine", "tensor_parallel")
+    num_engines = cfg.get("server", "num_engines")
+    if tp > 1:
+        import jax
+
+        needed = tp * num_engines
+        if needed > len(jax.devices()):
+            print(
+                f"config error: {num_engines} engines x tensor_parallel={tp} "
+                f"needs {needed} devices, have {len(jax.devices())}",
+                file=sys.stderr,
+            )
+            return 2
+
+    def engine_factory(replica_idx: int) -> LLMEngine:
         if model_dir:
             params, model_cfg = load_checkpoint(model_dir, dtype=dtype)
         else:
@@ -78,7 +92,21 @@ def main(argv=None) -> int:
             model_cfg = get_config(cfg.get("model", "model_name"))
             params = llama.init_params(jax.random.PRNGKey(0), model_cfg,
                                        dtype=dtype)
-        return LLMEngine(params, model_cfg, tokenizer, engine_cfg, dtype=dtype)
+        mesh = None
+        if tp > 1:
+            import jax
+
+            from distributed_inference_server_tpu.parallel import (
+                MeshSpec,
+                make_mesh,
+            )
+
+            # each replica gets a DISJOINT device slice: replica i owns
+            # devices [i*tp, (i+1)*tp)
+            devs = jax.devices()[replica_idx * tp : (replica_idx + 1) * tp]
+            mesh = make_mesh(MeshSpec(tensor=tp), devs)
+        return LLMEngine(params, model_cfg, tokenizer, engine_cfg,
+                         dtype=dtype, mesh=mesh)
 
     try:
         server = InferenceServer(

@@ -119,15 +119,32 @@ class LLMEngine:
         tokenizer: Tokenizer,
         engine_cfg: Optional[EngineConfig] = None,
         dtype=jnp.bfloat16,
+        mesh=None,
     ):
+        """``mesh``: optional ``jax.sharding.Mesh`` (parallel/mesh.py) for
+        intra-replica tensor parallelism — weights and the paged KV pool are
+        sharded over the ``tensor`` axis (parallel/tp.py layout) and every
+        jitted step runs SPMD with XLA-inserted ICI collectives. Without a
+        mesh, single-device execution (the reference's worker model)."""
         self.params = params
         self.cfg = cfg
         self.tok = tokenizer
         self.ecfg = engine_cfg or EngineConfig()
         self.pcfg = self.ecfg.paged
         self.dtype = dtype
+        self.mesh = mesh
 
         self.state = PagedKVState.create(cfg, self.pcfg, dtype=dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from distributed_inference_server_tpu.parallel import tp as tp_rules
+
+            tp_rules.validate_tp(cfg, mesh.shape["tensor"])
+            self.params = tp_rules.shard_params(params, mesh, cfg)
+            pool_sharding = NamedSharding(mesh, tp_rules.kv_pool_spec())
+            self.state.k = jax.device_put(self.state.k, pool_sharding)
+            self.state.v = jax.device_put(self.state.v, pool_sharding)
         self.allocator = PageAllocator(self.pcfg)
         self.waiting: Deque[_Seq] = deque()
         self.slots: List[Optional[_Seq]] = [None] * self.ecfg.max_batch

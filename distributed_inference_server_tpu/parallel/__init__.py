@@ -1,0 +1,34 @@
+"""Parallel execution: device meshes, TP/EP/PP/SP sharding rules.
+
+The reference has no parallelism (SURVEY.md §2.3 absence audit); this
+package is the TPU-native scale-out layer: explicit meshes + GSPMD
+shardings compiled by pjit, collectives over ICI/DCN inserted by XLA.
+"""
+
+from distributed_inference_server_tpu.parallel.mesh import (
+    AXES,
+    MeshSpec,
+    largest_tp,
+    make_mesh,
+    sharding,
+    tp_mesh,
+)
+from distributed_inference_server_tpu.parallel.tp import (
+    kv_pool_spec,
+    llama_param_specs,
+    shard_params,
+    validate_tp,
+)
+
+__all__ = [
+    "AXES",
+    "MeshSpec",
+    "largest_tp",
+    "make_mesh",
+    "sharding",
+    "tp_mesh",
+    "kv_pool_spec",
+    "llama_param_specs",
+    "shard_params",
+    "validate_tp",
+]

@@ -1,0 +1,100 @@
+"""Device-mesh construction for TP/DP/EP/PP/SP execution.
+
+The reference has no parallelism of any kind (SURVEY.md §2.3 — "workers"
+are whole-model replicas in one process, ``types.rs:10``); this module is
+the TPU-native foundation it lacked: an explicit ``jax.sharding.Mesh`` with
+named axes, over which pjit/GSPMD lays out weights and inserts ICI
+collectives (AllReduce/AllGather/AllToAll/CollectivePermute).
+
+Axis vocabulary (SURVEY.md §7.1):
+
+- ``data``   — batch rows (replica-level DP *within* one engine; across
+  engines, DP is scheduler-level replica routing, as in the reference);
+- ``tensor`` — attention heads + MLP intermediate (TP; north star TP=8 on
+  v5e-8 ICI);
+- ``expert`` — MoE experts (EP; Mixtral on v5e-16);
+- ``stage``  — pipeline stages (PP; 70B TP×PP on v5p-64);
+- ``seq``    — sequence/context parallelism (ring-attention prefill).
+
+Meshes are built over whatever devices exist — the single real TPU chip, a
+multi-chip slice, or virtual CPU devices
+(``--xla_force_host_platform_device_count``) for tests (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXES = ("data", "tensor", "expert", "stage", "seq")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Sizes per mesh axis; 1 = axis unused. ``data=0`` means "absorb all
+    remaining devices" (exactly one axis may be 0)."""
+
+    data: int = 1
+    tensor: int = 1
+    expert: int = 1
+    stage: int = 1
+    seq: int = 1
+
+    def sizes(self) -> Tuple[int, ...]:
+        return (self.data, self.tensor, self.expert, self.stage, self.seq)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        """Fill a single 0 axis with the remaining device count."""
+        sizes = list(self.sizes())
+        zeros = [i for i, s in enumerate(sizes) if s == 0]
+        if len(zeros) > 1:
+            raise ValueError("at most one mesh axis may be 0 (auto)")
+        fixed = math.prod(s for s in sizes if s > 0)
+        if zeros:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            sizes[zeros[0]] = n_devices // fixed
+            return MeshSpec(*sizes)
+        if fixed > n_devices:
+            raise ValueError(
+                f"mesh needs {fixed} devices, only {n_devices} available"
+            )
+        return self
+
+
+def make_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh with the canonical axis names. Axes of size 1 are kept
+    (GSPMD treats them as replicated), so PartitionSpecs are portable
+    across mesh shapes."""
+    devices = list(devices if devices is not None else jax.devices())
+    spec = (spec or MeshSpec()).resolve(len(devices))
+    n = math.prod(spec.sizes())
+    grid = np.array(devices[:n]).reshape(spec.sizes())
+    return Mesh(grid, axis_names=AXES)
+
+
+def tp_mesh(
+    tensor: int, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Tensor-parallel-only mesh (the engine's intra-replica layout)."""
+    return make_mesh(MeshSpec(tensor=tensor), devices)
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def largest_tp(n_devices: int, num_kv_heads: int) -> int:
+    """Largest tensor-axis size that divides both the device count and the
+    KV-head count (KV heads are the binding constraint for GQA TP)."""
+    return math.gcd(n_devices, num_kv_heads)

@@ -1,0 +1,103 @@
+"""Tensor parallelism for the Llama family: sharding rules + helpers.
+
+Megatron-style layout expressed as GSPMD annotations (no manual collectives
+— XLA inserts AllReduce over ICI where a contraction dimension is sharded):
+
+- ``wq``/``wk``/``wv``: column-parallel — output features (heads) split on
+  ``tensor``; each shard computes its own heads' q/k/v.
+- ``wo``: row-parallel — input features split; the matmul produces partial
+  sums that XLA AllReduces into the residual stream.
+- ``w_gate``/``w_up``: column-parallel on the intermediate dim;
+  ``w_down``: row-parallel (second AllReduce per block).
+- Embedding/unembedding + norms: replicated (vocab-parallel unembedding is
+  a later optimization; logits are [B, V] once per step).
+- Paged KV pool: sharded on the KV-head dim — each shard holds its own
+  heads' pages, so cache writes and the attention gather are fully local;
+  per-shard GQA groups stay intact (num_heads/num_kv_heads q heads per KV
+  head per shard).
+
+TP size must divide ``num_kv_heads`` (and thereby ``num_heads`` and
+``intermediate_size`` for any real config); ``validate_tp`` checks this.
+
+The reference has no equivalent (SURVEY.md §2.3: TP "No"); the north-star
+configuration is TP=8 for Llama-3-8B on a v5e-8 (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_inference_server_tpu.models.configs import ModelConfig
+from distributed_inference_server_tpu.models.llama import Params
+
+
+def validate_tp(cfg: ModelConfig, tp: int) -> None:
+    if tp <= 0:
+        raise ValueError(f"tensor parallel size must be positive, got {tp}")
+    for dim_name, dim in (
+        ("num_kv_heads", cfg.num_kv_heads),
+        ("num_heads", cfg.num_heads),
+        ("intermediate_size", cfg.intermediate_size),
+    ):
+        if dim % tp:
+            raise ValueError(
+                f"tensor parallel size {tp} does not divide {dim_name}={dim}"
+            )
+
+
+def llama_param_specs(cfg: ModelConfig) -> Dict[str, Any]:
+    """PartitionSpec pytree matching ``llama.init_params`` exactly.
+
+    Layer weights are stacked [L, in, out]: axis 0 is the scan axis (never
+    sharded), so column-parallel = spec on axis 2, row-parallel = axis 1.
+    """
+    layers: Dict[str, Any] = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tensor"),
+        "wk": P(None, None, "tensor"),
+        "wv": P(None, None, "tensor"),
+        "wo": P(None, "tensor", None),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.is_moe:
+        layers.update(
+            router=P(None, None, None),
+            # [L, E, in, out]: experts on "expert", features on "tensor"
+            w_gate=P(None, "expert", None, "tensor"),
+            w_up=P(None, "expert", None, "tensor"),
+            w_down=P(None, "expert", "tensor", None),
+        )
+    else:
+        layers.update(
+            w_gate=P(None, None, "tensor"),
+            w_up=P(None, None, "tensor"),
+            w_down=P(None, "tensor", None),
+        )
+    specs: Dict[str, Any] = {
+        "embed": P(None, None),
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, None)
+    return specs
+
+
+def kv_pool_spec() -> P:
+    """Paged KV pool [L, num_slots, KV_heads, D]: KV heads on 'tensor'."""
+    return P(None, None, "tensor", None)
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
+    """Place parameters onto the mesh per the TP layout (the weight-loading
+    "restore" path — SURVEY.md §5 checkpoint/resume equivalent: safetensors
+    → host → sharded device buffers)."""
+    specs = llama_param_specs(cfg)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(params, shardings)

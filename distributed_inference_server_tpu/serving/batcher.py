@@ -20,6 +20,7 @@ Deterministic for tests: ``poll(now)`` takes an explicit clock.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Generic, List, Optional, TypeVar
@@ -72,9 +73,24 @@ class AdmissionBatcher(Generic[T]):
         self.size_divisor = 1
         self._pending: List[QueuedRequest[T]] = []
         self._window_opened: Optional[float] = None
+        # poll/flush run on the dispatch thread; cancel() arrives from the
+        # event loop on client disconnect
+        self._lock = threading.Lock()
 
     def pending_count(self) -> int:
         return len(self._pending)
+
+    def cancel(self, request_id) -> Optional[QueuedRequest[T]]:
+        """Remove a request still waiting in the batching window (client
+        disconnected between dequeue and dispatch, Req 5.4)."""
+        with self._lock:
+            for i, req in enumerate(self._pending):
+                if req.id == request_id:
+                    removed = self._pending.pop(i)
+                    if not self._pending:
+                        self._window_opened = None
+                    return removed
+        return None
 
     def effective_max_batch(self) -> int:
         return max(1, self.config.max_batch_size // max(1, self.size_divisor))
@@ -85,37 +101,39 @@ class AdmissionBatcher(Generic[T]):
         batch has 1 <= len <= max_batch_size; Property 5: a request waits at
         most one window before dispatch while capacity allows)."""
         now = time.monotonic() if now is None else now
-        cap = self.effective_max_batch()
-        room = cap - len(self._pending)
-        if room > 0:
-            pulled = self.queue.dequeue_batch(room)
-            if pulled and self._window_opened is None:
-                self._window_opened = now
-            self._pending.extend(pulled)
+        with self._lock:
+            cap = self.effective_max_batch()
+            room = cap - len(self._pending)
+            if room > 0:
+                pulled = self.queue.dequeue_batch(room)
+                if pulled and self._window_opened is None:
+                    self._window_opened = now
+                self._pending.extend(pulled)
 
-        if not self._pending:
-            return None
-        window_expired = (
-            self._window_opened is not None
-            and (now - self._window_opened) * 1000.0 >= self.config.window_ms
-        )
-        if len(self._pending) >= cap or window_expired:
-            batch = AdmissionBatch(
-                batch_id=new_batch_id(),
-                requests=self._pending,
-                created_at=now,
+            if not self._pending:
+                return None
+            window_expired = (
+                self._window_opened is not None
+                and (now - self._window_opened) * 1000.0 >= self.config.window_ms
             )
-            self._pending = []
-            self._window_opened = None
-            return batch
-        return None
+            if len(self._pending) >= cap or window_expired:
+                batch = AdmissionBatch(
+                    batch_id=new_batch_id(),
+                    requests=self._pending,
+                    created_at=now,
+                )
+                self._pending = []
+                self._window_opened = None
+                return batch
+            return None
 
     def flush(self, now: Optional[float] = None) -> Optional[AdmissionBatch[T]]:
         """Dispatch whatever is pending immediately (shutdown drain)."""
         now = time.monotonic() if now is None else now
-        if not self._pending:
-            return None
-        batch = AdmissionBatch(new_batch_id(), self._pending, now)
-        self._pending = []
-        self._window_opened = None
-        return batch
+        with self._lock:
+            if not self._pending:
+                return None
+            batch = AdmissionBatch(new_batch_id(), self._pending, now)
+            self._pending = []
+            self._window_opened = None
+            return batch

@@ -53,6 +53,7 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "dtype": (str, "bfloat16"),
     },
     "engine": {
+        "tensor_parallel": (int, 1),
         "max_batch": (int, 8),
         "prefill_buckets": (list, [32, 128, 512]),
         "page_size": (int, 16),
@@ -183,7 +184,11 @@ class ServerConfig:
             merged[section][key] = _coerce(section, key, value)
 
         cli = _parse_cli(cli_args or [])
-        file_path = file_path or cli.pop("_config_file", None)
+        # always pop the file key — the apply loop must see only
+        # (section, key) tuples, even when file_path was passed directly
+        # (hot-reload re-merges with the original --config in cli_args)
+        cli_file = cli.pop("_config_file", None)
+        file_path = file_path or cli_file
 
         if file_path:
             for section, fields in _load_file(file_path).items():
@@ -244,6 +249,7 @@ class ServerConfig:
 
         for sec, key in (
             ("server", "port"), ("server", "num_engines"),
+            ("engine", "tensor_parallel"),
             ("engine", "max_batch"), ("engine", "page_size"),
             ("engine", "num_pages"), ("engine", "max_pages_per_seq"),
             ("queue", "high_watermark"), ("queue", "low_watermark"),

@@ -124,9 +124,12 @@ class Dispatcher:
             self.metrics.set_queue_depth(d.high, d.normal, d.low)
 
     def abort(self, request_id: RequestId) -> None:
-        """Client disconnect: drop from queue if still queued, else tell
-        every engine (only the owner will find it) — Req 5.4."""
+        """Client disconnect: drop from queue or the batching window if not
+        yet dispatched, else tell every engine (only the owner will find
+        it) — Req 5.4."""
         if self.queue.cancel(request_id) is not None:
+            return
+        if self.batcher.cancel(request_id) is not None:
             return
         for runner in self.scheduler.engines():
             runner.abort(request_id)

@@ -180,12 +180,17 @@ class EngineRunner:
         """Queue an embeddings computation; ``on_result(array, error)`` is
         called exactly once — on the runner thread, or here/at crash time if
         the engine is (or becomes) unavailable."""
-        if not self._healthy:
-            on_result(None, self._last_error or "engine unavailable")
-            return
+        # register BEFORE the health check (same crash-safe ordering as
+        # submit): a crash between check and registration would otherwise
+        # strand the callback un-called forever
         self._embed_seq += 1
         token = self._embed_seq
         self._pending_embeds[token] = on_result
+        if not self._healthy:
+            cb = self._pending_embeds.pop(token, None)
+            if cb is not None:
+                cb(None, self._last_error or "engine unavailable")
+            return
 
         def _do() -> None:
             cb = self._pending_embeds.pop(token, None)

@@ -105,9 +105,20 @@ class InferenceServer:
     # -- elasticity --------------------------------------------------------
 
     def _spawn_engine(self, wait_ready: bool = True) -> EngineRunner:
-        engine_id = f"engine-{self._next_engine_idx}"
+        idx = self._next_engine_idx
+        engine_id = f"engine-{idx}"
         self._next_engine_idx += 1
-        runner = EngineRunner(engine_id, self.engine_factory, self.metrics)
+        factory = self.engine_factory
+        # index-aware factories (def factory(replica_idx)) let multi-replica
+        # TP deployments give each replica a disjoint device slice
+        import inspect
+
+        try:
+            takes_index = bool(inspect.signature(factory).parameters)
+        except (TypeError, ValueError):
+            takes_index = False
+        bound = (lambda: factory(idx)) if takes_index else factory
+        runner = EngineRunner(engine_id, bound, self.metrics)
         runner.start(wait_ready=wait_ready)
         self.scheduler.register(runner)
         return runner
@@ -142,12 +153,21 @@ class InferenceServer:
     def apply_hot_config(self, diff: dict, new_config) -> None:
         """Apply hot-reloadable config changes (requirements.md:146):
         batching window/size, queue watermarks/timeout, scheduling
-        strategy. ConfigWatcher subscriber signature."""
-        sections = {section for section, _ in diff}
-        if "batcher" in sections:
-            self.dispatcher.batcher.config = new_config.batcher_config()
-        if "queue" in sections:
-            self.dispatcher.queue.config = new_config.queue_config()
+        strategy. Only the *diffed hot keys* are applied — a non-hot key
+        (e.g. queue.max_queue_size) changing in the same edit must NOT leak
+        onto the live server. ConfigWatcher subscriber signature."""
+        from dataclasses import replace
+
+        batcher_updates = {k: v for (sec, k), v in diff.items() if sec == "batcher"}
+        if batcher_updates:
+            self.dispatcher.batcher.config = replace(
+                self.dispatcher.batcher.config, **batcher_updates
+            )
+        queue_updates = {k: v for (sec, k), v in diff.items() if sec == "queue"}
+        if queue_updates:
+            self.dispatcher.queue.config = replace(
+                self.dispatcher.queue.config, **queue_updates
+            )
         if ("server", "strategy") in diff:
             self.scheduler.set_strategy(new_config.strategy())
 

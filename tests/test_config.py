@@ -146,6 +146,25 @@ class TestHotReload:
         assert seen == [{("batcher", "max_batch_size"): 8}]
         assert watcher.current.get("batcher", "max_batch_size") == 8
 
+    def test_reload_preserves_cli_overrides(self, tmp_path):
+        """Property 26 must survive hot-reload: a file edit does not revert
+        CLI-set keys, and passing --config inside cli_args is handled."""
+        path = _write(tmp_path, "c.toml", "[queue]\nrequest_timeout_s = 10.0\n")
+        cfg = ServerConfig.load(
+            cli_args=["--config", path, "--batcher-window-ms", "10"]
+        )
+        assert cfg.get("batcher", "window_ms") == 10.0
+        watcher = ConfigWatcher(cfg)
+
+        import os
+
+        _write(tmp_path, "c.toml", "[queue]\nrequest_timeout_s = 20.0\n")
+        os.utime(path, (0, 0))
+        assert watcher.check_once() is True
+        # file change applied, CLI override NOT reverted
+        assert watcher.current.get("queue", "request_timeout_s") == 20.0
+        assert watcher.current.get("batcher", "window_ms") == 10.0
+
     def test_watcher_rejects_invalid_new_config(self, tmp_path):
         path = _write(tmp_path, "c.toml", "[batcher]\nmax_batch_size = 32\n")
         cfg = ServerConfig.load(file_path=path)
@@ -183,3 +202,27 @@ class TestHotReload:
         assert srv.dispatcher.batcher.config.max_batch_size == 4
         assert srv.dispatcher.queue.config.high_watermark == 50
         assert srv.scheduler.strategy() is SchedulingStrategy.MEMORY_AWARE
+
+    def test_non_hot_keys_do_not_leak_through_hot_apply(self):
+        """A non-hot-reloadable key (queue.max_queue_size) changing alongside
+        a hot key must not be applied to the live queue config."""
+        from distributed_inference_server_tpu.serving.dispatcher import Dispatcher
+        from distributed_inference_server_tpu.serving.scheduler import (
+            AdaptiveScheduler,
+        )
+        from distributed_inference_server_tpu.serving.server import InferenceServer
+
+        srv = InferenceServer.__new__(InferenceServer)
+        srv.scheduler = AdaptiveScheduler()
+        srv.dispatcher = Dispatcher(srv.scheduler)
+        old_cap = srv.dispatcher.queue.config.max_queue_size
+        new = ServerConfig.load(
+            environ={
+                "DIS_TPU_QUEUE__REQUEST_TIMEOUT_S": "5",
+                "DIS_TPU_QUEUE__MAX_QUEUE_SIZE": "5000",
+            }
+        )
+        diff = ServerConfig.load().hot_diff(new)
+        srv.apply_hot_config(diff, new)
+        assert srv.dispatcher.queue.config.request_timeout_s == 5
+        assert srv.dispatcher.queue.config.max_queue_size == old_cap

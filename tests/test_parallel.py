@@ -1,0 +1,157 @@
+"""Tensor-parallel tests on the 8-virtual-device CPU mesh (SURVEY.md §4.3):
+mesh construction, sharding-rule structure, TP-vs-single-device numerical
+equivalence of the forward pass, and a TP engine generating end-to-end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+from distributed_inference_server_tpu.parallel import (
+    MeshSpec,
+    largest_tp,
+    llama_param_specs,
+    make_mesh,
+    shard_params,
+    tp_mesh,
+    validate_tp,
+)
+
+
+class TestMesh:
+    def test_make_mesh_axes(self):
+        mesh = make_mesh(MeshSpec(tensor=4, data=2))
+        assert mesh.shape["tensor"] == 4
+        assert mesh.shape["data"] == 2
+        assert mesh.shape["expert"] == 1
+
+    def test_auto_axis(self):
+        mesh = make_mesh(MeshSpec(tensor=4, data=0))
+        assert mesh.shape["data"] == 2  # 8 devices / 4
+
+    def test_two_auto_axes_rejected(self):
+        with pytest.raises(ValueError):
+            make_mesh(MeshSpec(tensor=0, data=0))
+
+    def test_too_many_devices_rejected(self):
+        with pytest.raises(ValueError):
+            make_mesh(MeshSpec(tensor=16))
+
+    def test_largest_tp(self):
+        assert largest_tp(8, 4) == 4
+        assert largest_tp(8, 8) == 8
+        assert largest_tp(4, 8) == 4
+        assert largest_tp(3, 8) == 1
+
+    def test_validate_tp(self):
+        validate_tp(TINY, 2)
+        with pytest.raises(ValueError):
+            validate_tp(TINY, 16)  # doesn't divide kv heads
+        with pytest.raises(ValueError):
+            validate_tp(TINY, 0)
+
+
+class TestParamSpecs:
+    def test_spec_tree_matches_param_tree(self):
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, jnp.float32)
+        specs = llama_param_specs(TINY)
+        from jax.sharding import PartitionSpec
+
+        pt = jax.tree_util.tree_structure(params)
+        st = jax.tree_util.tree_structure(
+            specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+        )
+        assert pt == st
+
+    def test_shard_params_places_on_mesh(self):
+        mesh = tp_mesh(2)
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, jnp.float32)
+        sharded = shard_params(params, mesh, TINY)
+        wq = sharded["layers"]["wq"]
+        # column-parallel: last dim split over 2 devices
+        assert wq.sharding.shard_shape(wq.shape)[-1] == wq.shape[-1] // 2
+
+
+class TestTPEquivalence:
+    def test_paged_forward_matches_single_device(self):
+        """TP=2 logits == unsharded logits (same weights, f32)."""
+        cfg = TINY
+        params = llama.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+        B, T = 2, 8
+        num_slots, smax = 64, 16
+        pool = jnp.zeros((cfg.num_layers, num_slots, cfg.num_kv_heads,
+                          cfg.head_dim), jnp.float32)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0,
+                                 cfg.vocab_size)
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T)).astype(jnp.int32)
+        # row b owns slots [16b, 16b+16)
+        write_slots = (positions + 16 * jnp.arange(B)[:, None]).astype(jnp.int32)
+        gather = (jnp.arange(smax)[None, :] + 16 * jnp.arange(B)[:, None]
+                  ).astype(jnp.int32)
+        kv_valid = jnp.full((B,), T, jnp.int32)
+
+        ref_logits, ref_k, _ = llama.paged_forward(
+            params, cfg, ids, positions, pool, pool, write_slots, gather,
+            kv_valid,
+        )
+
+        mesh = tp_mesh(2)
+        sharded_params = shard_params(params, mesh, cfg)
+        from jax.sharding import NamedSharding
+
+        from distributed_inference_server_tpu.parallel import kv_pool_spec
+
+        pool_sh = NamedSharding(mesh, kv_pool_spec())
+        pool_tp = jax.device_put(pool, pool_sh)
+
+        tp_logits, tp_k, _ = jax.jit(
+            lambda p, pk, pv: llama.paged_forward(
+                p, cfg, ids, positions, pk, pv, write_slots, gather, kv_valid
+            )
+        )(sharded_params, pool_tp, pool_tp)
+
+        np.testing.assert_allclose(
+            np.asarray(ref_logits), np.asarray(tp_logits), rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref_k), np.asarray(tp_k), rtol=2e-4, atol=2e-4
+        )
+
+
+class TestTPEngine:
+    def test_tp_engine_matches_unsharded_greedy(self):
+        cfg = TINY
+        paged = PagedCacheConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+        ecfg = EngineConfig(max_batch=2, prefill_buckets=(16,), paged=paged)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+        tok = ByteTokenizer()
+
+        def generate(engine):
+            engine.add_request(
+                "r", tok.encode("parallel!"),
+                SamplingParams(max_tokens=8, temperature=0.0),
+            )
+            text = []
+            while engine.has_work():
+                for out in engine.step():
+                    text.append(out.text)
+            return "".join(text)
+
+        plain = generate(LLMEngine(params, cfg, tok, ecfg, dtype=jnp.float32))
+        tp = generate(
+            LLMEngine(params, cfg, tok, ecfg, dtype=jnp.float32,
+                      mesh=tp_mesh(2))
+        )
+        assert plain == tp

@@ -292,6 +292,22 @@ class TestDispatcher:
         d.abort("gone")
         assert d.queue.is_empty()
 
+    def test_abort_cancels_batcher_pending(self):
+        """A request already pulled into the batching window is still
+        abortable (Req 5.4 between dequeue and dispatch)."""
+        d = Dispatcher(
+            AdaptiveScheduler(),
+            batcher_config=BatcherConfig(window_ms=1e9, max_batch_size=32),
+        )
+        d._accepting = True
+        r = _req("windowed")
+        d.submit(r)
+        assert d.batcher.poll(time.monotonic()) is None  # pulled, window open
+        assert d.batcher.pending_count() == 1
+        d.abort("windowed")
+        assert d.batcher.pending_count() == 0
+        assert d.batcher.flush() is None
+
 
 # ---------------------------------------------------------------------------
 # SSE wire format — Properties 13-15 (design.md:758-774 [spec])
