@@ -66,6 +66,9 @@ class EngineConfig:
     prefill_buckets: Tuple[int, ...] = (32, 128, 512)
     paged: PagedCacheConfig = field(default_factory=PagedCacheConfig)
     seed: int = 0
+    # decode attention: "auto" = Pallas ragged paged-attention kernel on
+    # TPU, XLA gather path elsewhere; or force "pallas" / "xla"
+    attention_impl: str = "auto"
 
 
 @dataclass
@@ -346,6 +349,15 @@ class LLMEngine:
 
     def _build_decode(self) -> Callable:
         cfg = self.cfg
+        impl = self.ecfg.attention_impl
+        if impl not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"attention_impl must be 'auto', 'pallas' or 'xla', "
+                f"got {impl!r}"
+            )
+        if impl == "auto":
+            impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        page_size = self.pcfg.page_size
 
         @functools.partial(jax.jit, donate_argnums=(2, 3))
         def decode(params, tokens, pool_k, pool_v, positions, write_slots,
@@ -353,6 +365,7 @@ class LLMEngine:
             logits, k, v = llama.paged_forward(
                 params, cfg, tokens, positions, pool_k, pool_v,
                 write_slots, gather_slots, kv_valid_len,
+                attention_impl=impl, page_size=page_size,
             )
             next_tokens = sample_tokens(rng, logits[:, 0], temperature, top_p)
             return next_tokens, k, v

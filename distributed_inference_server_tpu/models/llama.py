@@ -240,6 +240,8 @@ def paged_forward(
     write_slots: jnp.ndarray,
     gather_slots: jnp.ndarray,
     kv_valid_len: jnp.ndarray,
+    attention_impl: str = "xla",
+    page_size: int = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forward pass over the paged KV pool (engine/kv_cache.py).
 
@@ -252,17 +254,35 @@ def paged_forward(
       gather_slots: [B, S_max] flat slots covering each row's block table
         (S_max = max_pages_per_seq * page_size).
       kv_valid_len: [B] tokens valid in each row's gathered window.
+      attention_impl: "xla" (gather-then-dense-attend, the reference path)
+        or "pallas" (ragged paged-attention kernel reading pages straight
+        from the pool — decode only, requires T == 1 and ``page_size``).
+      page_size: tokens per page; required for the Pallas path.
 
-    Returns (logits [B, T, V] f32, new pool_k, new pool_v). This is the
-    pure-XLA reference path (gather-then-dense-attend); the Pallas ragged
-    paged attention kernel replaces attend without the gather.
+    Returns (logits [B, T, V] f32, new pool_k, new pool_v).
     """
+    use_pallas = attention_impl == "pallas" and input_ids.shape[1] == 1
+    if use_pallas:
+        from distributed_inference_server_tpu.ops.pallas import (
+            paged_attention_decode,
+        )
+
+        if page_size <= 0:
+            raise ValueError("attention_impl='pallas' requires page_size")
+        # gather_slots rows are table[p]*page_size + offset by construction
+        page_tables = gather_slots[:, ::page_size] // page_size
 
     def write_fn(layer, new):
         # layer: [num_slots, KV, D]; new: [B, T, KV, D]
         return layer.at[write_slots].set(new, mode="drop")
 
     def attend_fn(q, k_layer, v_layer):
+        if use_pallas:
+            out = paged_attention_decode(
+                q[:, 0], k_layer, v_layer, page_tables, kv_valid_len,
+                page_size=page_size,
+            )
+            return out[:, None]
         k_seq = k_layer[gather_slots]  # [B, S_max, KV, D]
         v_seq = v_layer[gather_slots]
         return gqa_attention(q, k_seq, v_seq, positions, kv_valid_len)

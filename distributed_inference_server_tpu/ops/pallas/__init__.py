@@ -1,0 +1,14 @@
+"""Pallas/Mosaic TPU kernels — the framework's native-kernel tier.
+
+The reference planned to reach native compute through llama.cpp's C++
+kernels over FFI (``design.md:7``, ``tasks.md:196-200`` [spec]); on TPU the
+equivalent tier is Pallas kernels lowered through Mosaic. Every kernel here
+has a pure-XLA reference implementation (ops/attention.py et al.) it is
+tested against, and runs in interpret mode on the CPU backend.
+"""
+
+from distributed_inference_server_tpu.ops.pallas.paged_attention import (
+    paged_attention_decode,
+)
+
+__all__ = ["paged_attention_decode"]

@@ -1,0 +1,186 @@
+"""Ragged paged-attention decode kernel (Pallas / Mosaic TPU).
+
+The serving hot loop's attention: one new query token per sequence attends
+to that sequence's KV pages scattered through the HBM page pool. The
+pure-XLA path (``models/llama.py:paged_forward``) first gathers every
+sequence's pages into a dense ``[B, S_max, KV, D]`` buffer and then runs
+dense attention — materializing S_max slots per row in HBM each step. This
+kernel reads pages straight from the pool instead: the block-table entry is
+a *scalar-prefetch* argument, so Pallas pipelines the page DMAs
+(HBM → VMEM) chosen by the table while the MXU works on the previous page,
+and nothing is materialized beyond one page per grid step.
+
+Online-softmax accumulation over pages (flash-attention style), f32
+accumulators, causal masking implied by the ragged ``kv_valid_len`` (the
+query IS the last valid token — decode only). Each grid step loads one
+whole page ([page_size, KV, D] — Mosaic requires the trailing two block
+dims to match the array, so the KV-head loop is unrolled inside the kernel
+rather than gridded).
+
+Replaces the reference's planned llama.cpp attention (design.md:7 [spec])
+as the native tier; same contract as ops/attention.py:gqa_attention.
+Kernel shape follows the ragged-paged-attention recipe (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128  # VPU lane width; scratch statistics are broadcast across lanes
+
+
+def _decode_kernel(
+    # scalar-prefetch refs
+    tables_ref,  # [B, P] page id per (row, page-slot)
+    valid_ref,  # [B] valid token count per row
+    # tensor refs
+    q_ref,  # [1, KV, G, D] this row's query tile, grouped by kv head
+    k_ref,  # [1, page_size, KV, D] this grid step's K page
+    v_ref,  # [1, page_size, KV, D] this grid step's V page
+    out_ref,  # [1, KV, G, D]
+    # scratch
+    m_ref,  # [KV*G, LANES] f32 running max (broadcast across lanes)
+    l_ref,  # [KV*G, LANES] f32 running denominator
+    acc_ref,  # [KV*G, D] f32 running numerator
+    *,
+    page_size: int,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    num_pages_per_seq = pl.num_programs(1)
+    num_kv = q_ref.shape[1]
+    G = q_ref.shape[2]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    valid = valid_ref[b]
+    start = p * page_size
+
+    @pl.when(start < valid)
+    def _accumulate():
+        # static unroll over the (small) kv-head count; each head is a
+        # plain 2D MXU matmul — Mosaic has no batched dot_general
+        for kv in range(num_kv):
+            q = q_ref[0, kv].astype(jnp.float32)  # [G, D]
+            k = k_ref[0, :, kv, :].astype(jnp.float32)  # [S_p, D]
+            v = v_ref[0, :, kv, :].astype(jnp.float32)  # [S_p, D]
+            d = q.shape[-1]
+            rows = slice(kv * G, (kv + 1) * G)
+
+            # [G, S_p] scores on the MXU, f32 accumulation
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * (1.0 / (d**0.5))
+
+            token_ids = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(token_ids < valid, s, _NEG_INF)
+
+            m_prev = m_ref[rows, :1]  # [G, 1]
+            l_prev = l_ref[rows, :1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            probs = jnp.exp(s - m_new)  # [G, S_p]
+            l_new = l_prev * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+            acc_ref[rows] = acc_ref[rows] * alpha + jax.lax.dot_general(
+                probs, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[rows] = jnp.broadcast_to(m_new, (G, m_ref.shape[1]))
+            l_ref[rows] = jnp.broadcast_to(l_new, (G, l_ref.shape[1]))
+
+    @pl.when(p == num_pages_per_seq - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)  # rows with valid=0 emit zeros
+        out = acc_ref[:] / l  # [KV*G, D]
+        out_ref[0] = out.reshape(num_kv, G, -1).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def paged_attention_decode(
+    q: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    page_tables: jnp.ndarray,
+    kv_valid_len: jnp.ndarray,
+    *,
+    page_size: int,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Decode-step paged GQA attention against the flat page pool.
+
+    Args:
+      q: [B, H, D] one query per row (the token being decoded).
+      pool_k, pool_v: [num_slots, KV, D] one layer's flat page pool
+        (num_slots = num_pages * page_size — engine/kv_cache.py layout).
+      page_tables: [B, P] page ids per row (entries past the row's last
+        page may be any in-range id; they are masked, and are clamped
+        defensively to the pool).
+      kv_valid_len: [B] valid tokens per row, INCLUDING the just-written
+        query token (the query is causal-last by construction).
+      page_size: tokens per page.
+      interpret: force Pallas interpret mode; defaults to True off-TPU so
+        tests run on the CPU backend.
+
+    Returns: [B, H, D] attention outputs in q.dtype.
+    """
+    B, H, D = q.shape
+    num_slots, KV, _ = pool_k.shape
+    G = H // KV
+    num_pages = num_slots // page_size
+    P = page_tables.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qg = q.reshape(B, KV, G, D)
+    k_pages = pool_k.reshape(num_pages, page_size, KV, D)
+    v_pages = pool_v.reshape(num_pages, page_size, KV, D)
+    tables = jnp.clip(page_tables.astype(jnp.int32), 0, num_pages - 1)
+
+    def table_page(b, p, tables_ref, valid_ref):
+        return (tables_ref[b, p], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, KV, G, D), lambda b, p, t, vl: (b, 0, 0, 0)),
+            pl.BlockSpec((1, page_size, KV, D), table_page),
+            pl.BlockSpec((1, page_size, KV, D), table_page),
+        ],
+        out_specs=pl.BlockSpec((1, KV, G, D), lambda b, p, t, vl: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KV * G, _LANES), jnp.float32),
+            pltpu.VMEM((KV * G, _LANES), jnp.float32),
+            pltpu.VMEM((KV * G, D), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, page_size=page_size),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, D), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            # the batch grid dim is independent — scratch state only spans
+            # the innermost page dim — so let megacore split it
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * P * page_size * D,
+            bytes_accessed=2 * B * KV * P * page_size * D * pool_k.dtype.itemsize,
+            transcendentals=B * H * P * page_size,
+        ),
+    )(tables, kv_valid_len.astype(jnp.int32), qg, k_pages, v_pages)
+    return out.reshape(B, H, D)

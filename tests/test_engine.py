@@ -260,3 +260,30 @@ def test_embeddings_long_input_not_truncated(tiny_params):
     np.testing.assert_allclose(
         vec_full, engine.embed_ids([long_ids])[0], atol=1e-6
     )
+
+
+def test_engine_pallas_attention_matches_xla(tiny_params):
+    """End-to-end decode with the Pallas ragged paged-attention kernel
+    (interpret mode on CPU) produces the same greedy tokens as the XLA
+    gather path."""
+    prompt = TOK.encode("pallas")
+    results = {}
+    for impl in ("xla", "pallas"):
+        engine = LLMEngine(
+            tiny_params,
+            TINY,
+            TOK,
+            EngineConfig(
+                max_batch=2,
+                prefill_buckets=(8, 32),
+                paged=PagedCacheConfig(
+                    num_pages=32, page_size=4, max_pages_per_seq=8
+                ),
+                attention_impl=impl,
+            ),
+            dtype=jnp.float32,
+        )
+        engine.add_request("r1", prompt, GREEDY)
+        results[impl] = run_to_completion(engine)["r1"]
+    assert results["pallas"]["tokens"] == results["xla"]["tokens"]
+    assert results["pallas"]["finish"] == results["xla"]["finish"]

@@ -1,0 +1,145 @@
+"""Pallas ragged paged-attention decode kernel vs. the pure-XLA reference.
+
+The XLA path (gather pages → dense gqa_attention) is the numerics ground
+truth (ops/attention.py docstring); the kernel must match it bitwise-close
+on ragged batches with shared/unordered page tables. Runs in Pallas
+interpret mode on the CPU backend (conftest pins JAX_PLATFORMS=cpu).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import ModelConfig
+from distributed_inference_server_tpu.ops.attention import gqa_attention
+from distributed_inference_server_tpu.ops.pallas import paged_attention_decode
+
+PAGE = 8
+
+
+def _make_case(rng, B, H, KV, D, num_pages, P, ragged=True):
+    """Random pool + per-row block tables with distinct pages and ragged
+    valid lengths (>=1: decode rows always contain the just-written token)."""
+    ks = list(jax.random.split(rng, 4))
+    pool_k = jax.random.normal(ks[0], (num_pages * PAGE, KV, D), jnp.float32)
+    pool_v = jax.random.normal(ks[1], (num_pages * PAGE, KV, D), jnp.float32)
+    q = jax.random.normal(ks[2], (B, H, D), jnp.float32)
+    perm = np.asarray(
+        jax.random.permutation(ks[3], num_pages)[: B * P]
+    ).reshape(B, P)
+    if ragged:
+        valid = np.asarray(
+            jax.random.randint(ks[3], (B,), 1, P * PAGE + 1)
+        )
+    else:
+        valid = np.full((B,), P * PAGE)
+    return q, pool_k, pool_v, jnp.asarray(perm), jnp.asarray(valid)
+
+
+def _reference(q, pool_k, pool_v, tables, valid):
+    B, P = tables.shape
+    slots = (tables[:, :, None] * PAGE + jnp.arange(PAGE)[None, None, :]).reshape(
+        B, P * PAGE
+    )
+    k_seq = pool_k[slots]
+    v_seq = pool_v[slots]
+    positions = (valid - 1)[:, None]  # decode: query is the last valid token
+    return gqa_attention(q[:, None], k_seq, v_seq, positions, valid)[:, 0]
+
+
+@pytest.mark.parametrize(
+    "B,H,KV,D,P",
+    [
+        (4, 8, 4, 16, 4),  # GQA, ragged
+        (2, 4, 4, 32, 3),  # MHA (G=1)
+        (1, 16, 2, 64, 2),  # heavy grouping
+    ],
+)
+def test_kernel_matches_xla_reference(B, H, KV, D, P):
+    rng = jax.random.PRNGKey(B * 1000 + H)
+    q, pk, pv, tables, valid = _make_case(rng, B, H, KV, D, num_pages=16, P=P)
+    got = paged_attention_decode(
+        q, pk, pv, tables, valid, page_size=PAGE, interpret=True
+    )
+    want = _reference(q, pk, pv, tables, valid)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_full_pages_no_mask_edge():
+    rng = jax.random.PRNGKey(7)
+    q, pk, pv, tables, valid = _make_case(
+        rng, 3, 8, 4, 16, num_pages=16, P=4, ragged=False
+    )
+    got = paged_attention_decode(
+        q, pk, pv, tables, valid, page_size=PAGE, interpret=True
+    )
+    want = _reference(q, pk, pv, tables, valid)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_bf16_io():
+    rng = jax.random.PRNGKey(11)
+    q, pk, pv, tables, valid = _make_case(rng, 2, 8, 4, 16, num_pages=8, P=2)
+    got = paged_attention_decode(
+        q.astype(jnp.bfloat16),
+        pk.astype(jnp.bfloat16),
+        pv.astype(jnp.bfloat16),
+        tables,
+        valid,
+        page_size=PAGE,
+        interpret=True,
+    )
+    want = _reference(q, pk, pv, tables, valid)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), rtol=5e-2, atol=5e-2
+    )
+
+
+def test_paged_forward_pallas_matches_xla():
+    """Full paged decode step through the model with both attention impls."""
+    cfg = ModelConfig(
+        name="t",
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=8,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    B, P, num_pages = 2, 2, 8
+    smax = P * PAGE
+    pool_shape = (cfg.num_layers, num_pages * PAGE, cfg.num_kv_heads, cfg.head_dim)
+    pool_k = jax.random.normal(jax.random.PRNGKey(1), pool_shape, jnp.float32)
+    pool_v = jax.random.normal(jax.random.PRNGKey(2), pool_shape, jnp.float32)
+    tables = np.array([[3, 5], [0, 7]])
+    seq_len = 5  # tokens already resident; decoding token 6
+    tokens = jnp.array([[7], [9]], jnp.int32)
+    positions = jnp.full((B, 1), seq_len, jnp.int32)
+    write_slots = jnp.asarray(
+        tables[:, seq_len // PAGE] * PAGE + seq_len % PAGE
+    )[:, None]
+    gather = jnp.asarray(
+        (tables[:, :, None] * PAGE + np.arange(PAGE)[None, None, :])
+        .reshape(B, smax)
+        .astype(np.int32)
+    )
+    valid = jnp.full((B,), seq_len + 1, jnp.int32)
+
+    logits_x, kx, vx = llama.paged_forward(
+        params, cfg, tokens, positions, pool_k, pool_v, write_slots, gather,
+        valid, attention_impl="xla",
+    )
+    logits_p, kp, vp = llama.paged_forward(
+        params, cfg, tokens, positions, pool_k, pool_v, write_slots, gather,
+        valid, attention_impl="pallas", page_size=PAGE,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_x), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(np.asarray(kp), np.asarray(kx), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vp), np.asarray(vx), rtol=1e-6, atol=1e-6)
