@@ -326,10 +326,37 @@ class LLMEngine:
                 return b
         return self.ecfg.prefill_buckets[-1]
 
+    def _with_mesh(self, fn: Callable) -> Callable:
+        """Run a jitted step inside the mesh context (PartitionSpec-based
+        sharding constraints, e.g. the MoE all-to-all boundary, need it)."""
+        if self.mesh is None:
+            return fn
+        mesh = self.mesh
+
+        def wrapped(*args):
+            with mesh:
+                return fn(*args)
+
+        return wrapped
+
+    def _moe_impl(self) -> str:
+        """MoE execution path: capacity-based EP dispatch (ops/moe.py) when
+        an expert mesh axis exists — the Mixtral-scale path; dense-compute
+        otherwise (exact, no capacity drops — right for single-device
+        test-scale models, where the E/k FLOP overhead is irrelevant)."""
+        if (
+            self.cfg.is_moe
+            and self.mesh is not None
+            and self.mesh.shape.get("expert", 1) > 1
+        ):
+            return "ep"
+        return "dense"
+
     def _get_prefill_fn(self, bucket: int) -> Callable:
         fn = self._prefill_fns.get(bucket)
         if fn is None:
             cfg = self.cfg
+            moe_impl = self._moe_impl()
 
             @functools.partial(jax.jit, donate_argnums=(3, 4))
             def prefill(params, ids, positions, pool_k, pool_v, write_slots,
@@ -337,10 +364,11 @@ class LLMEngine:
                 logits, k, v = llama.paged_forward(
                     params, cfg, ids, positions, pool_k, pool_v,
                     write_slots, gather_slots, kv_valid_len,
+                    moe_impl=moe_impl,
                 )
                 return logits[jnp.arange(1), last_idx], k, v
 
-            fn = self._prefill_fns[bucket] = prefill
+            fn = self._prefill_fns[bucket] = self._with_mesh(prefill)
         return fn
 
     # ------------------------------------------------------------------
@@ -358,6 +386,8 @@ class LLMEngine:
         if impl == "auto":
             impl = "pallas" if jax.default_backend() == "tpu" else "xla"
         page_size = self.pcfg.page_size
+        moe_impl = self._moe_impl()
+        mesh = self.mesh
 
         @functools.partial(jax.jit, donate_argnums=(2, 3))
         def decode(params, tokens, pool_k, pool_v, positions, write_slots,
@@ -365,12 +395,13 @@ class LLMEngine:
             logits, k, v = llama.paged_forward(
                 params, cfg, tokens, positions, pool_k, pool_v,
                 write_slots, gather_slots, kv_valid_len,
-                attention_impl=impl, page_size=page_size,
+                attention_impl=impl, page_size=page_size, moe_impl=moe_impl,
+                mesh=mesh,
             )
             next_tokens = sample_tokens(rng, logits[:, 0], temperature, top_p)
             return next_tokens, k, v
 
-        return decode
+        return self._with_mesh(decode)
 
     def _decode(self, outputs: List[StepOutput]) -> None:
         # Make sure every active row has a page for its next position,

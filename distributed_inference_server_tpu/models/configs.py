@@ -45,6 +45,8 @@ class ModelConfig:
     # MoE (Mixtral-style); num_experts == 0 means dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # per-expert buffer headroom for the capacity-based dispatch (ops/moe.py)
+    moe_capacity_factor: float = 1.25
 
     @property
     def q_size(self) -> int:

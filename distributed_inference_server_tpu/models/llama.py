@@ -132,7 +132,7 @@ def _mlp(h: jnp.ndarray, layer: Dict[str, jnp.ndarray]) -> jnp.ndarray:
 def _moe_mlp(h: jnp.ndarray, layer: Dict[str, jnp.ndarray], cfg: ModelConfig):
     """Mixtral-style sparse MoE, dense-compute form: softmax(top-k) routing
     with every expert evaluated and combined by weight. Efficient enough at
-    test scale; parallel/expert.py provides the all-to-all sharded version."""
+    test scale; ops/moe.py provides the capacity-based sharded dispatch."""
     B, T, H = h.shape
     x = h.reshape(-1, H)  # [N, H]
     router_logits = (x @ layer["router"]).astype(jnp.float32)  # [N, E]
@@ -149,6 +149,31 @@ def _moe_mlp(h: jnp.ndarray, layer: Dict[str, jnp.ndarray], cfg: ModelConfig):
     return out.reshape(B, T, H)
 
 
+def _moe(h: jnp.ndarray, layer: Dict[str, jnp.ndarray], cfg: ModelConfig,
+         moe_impl: str, valid_tokens: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Route to the dense-compute MoE or the capacity-based dispatch
+    (ops/moe.py; sharding constraints make GSPMD emit the all-to-all when
+    the expert weights are mesh-sharded). ``valid_tokens`` keeps bucket
+    padding / inactive decode slots from consuming expert capacity."""
+    if moe_impl == "dense":
+        return _moe_mlp(h, layer, cfg)
+    from distributed_inference_server_tpu.ops.moe import (
+        expert_capacity,
+        moe_mlp_ep,
+    )
+
+    B, T, _ = h.shape
+    cap = expert_capacity(
+        B * T, cfg.num_experts, cfg.num_experts_per_tok,
+        cfg.moe_capacity_factor,
+    )
+    return moe_mlp_ep(
+        h, layer, cfg.num_experts, cfg.num_experts_per_tok,
+        capacity=cap, shard_experts=(moe_impl == "ep"),
+        valid_tokens=valid_tokens,
+    )
+
+
 def _run_layers(
     params: Params,
     cfg: ModelConfig,
@@ -158,6 +183,8 @@ def _run_layers(
     cache_v: jnp.ndarray,
     write_fn,
     attend_fn,
+    moe_impl: str = "dense",
+    valid_tokens: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Shared transformer trunk: embed, scan layer blocks, final norm.
 
@@ -188,7 +215,11 @@ def _run_layers(
         h = h + attn.reshape(B, T, cfg.q_size) @ layer["wo"]
         # mlp
         x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + (_moe_mlp(x, layer, cfg) if cfg.is_moe else _mlp(x, layer))
+        h = h + (
+            _moe(x, layer, cfg, moe_impl, valid_tokens)
+            if cfg.is_moe
+            else _mlp(x, layer)
+        )
         return h, (k_layer, v_layer)
 
     h, (new_k, new_v) = lax.scan(block, h, (params["layers"], cache_k, cache_v))
@@ -209,6 +240,7 @@ def forward(
     cache: KVCache,
     write_pos: jnp.ndarray,
     kv_valid_len: jnp.ndarray,
+    moe_impl: str = "dense",
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run the transformer over new tokens, updating the dense KV cache.
 
@@ -225,7 +257,9 @@ def forward(
     write_fn = lambda layer, new: _write_kv(layer, new, write_pos)
     attend_fn = lambda q, k, v: gqa_attention(q, k, v, positions, kv_valid_len)
     h, new_k, new_v = _run_layers(
-        params, cfg, input_ids, positions, cache.k, cache.v, write_fn, attend_fn
+        params, cfg, input_ids, positions, cache.k, cache.v, write_fn,
+        attend_fn, moe_impl=moe_impl,
+        valid_tokens=write_pos < cache.k.shape[2],
     )
     return _unembed(params, cfg, h), KVCache(k=new_k, v=new_v)
 
@@ -242,6 +276,8 @@ def paged_forward(
     kv_valid_len: jnp.ndarray,
     attention_impl: str = "xla",
     page_size: int = 0,
+    moe_impl: str = "dense",
+    mesh=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forward pass over the paged KV pool (engine/kv_cache.py).
 
@@ -258,6 +294,10 @@ def paged_forward(
         or "pallas" (ragged paged-attention kernel reading pages straight
         from the pool — decode only, requires T == 1 and ``page_size``).
       page_size: tokens per page; required for the Pallas path.
+      mesh: the device mesh when running tensor-parallel. GSPMD cannot
+        partition an opaque kernel, so under TP the Pallas call is wrapped
+        in shard_map over the ``tensor`` axis — each shard runs the kernel
+        on its own KV heads' pages, fully local, no collectives.
 
     Returns (logits [B, T, V] f32, new pool_k, new pool_v).
     """
@@ -272,15 +312,37 @@ def paged_forward(
         # gather_slots rows are table[p]*page_size + offset by construction
         page_tables = gather_slots[:, ::page_size] // page_size
 
+        def _attend_pallas(q3, k_layer, v_layer, tables, valid):
+            return paged_attention_decode(
+                q3, k_layer, v_layer, tables, valid, page_size=page_size
+            )
+
+        if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            _attend_pallas = shard_map(
+                _attend_pallas,
+                mesh=mesh,
+                in_specs=(
+                    P("data", "tensor", None),  # q [B, H, D]
+                    P(None, "tensor", None),  # pool layer [slots, KV, D]
+                    P(None, "tensor", None),
+                    P("data", None),  # page tables [B, P]
+                    P("data"),  # kv_valid_len [B]
+                ),
+                out_specs=P("data", "tensor", None),
+                check_vma=False,
+            )
+
     def write_fn(layer, new):
         # layer: [num_slots, KV, D]; new: [B, T, KV, D]
         return layer.at[write_slots].set(new, mode="drop")
 
     def attend_fn(q, k_layer, v_layer):
         if use_pallas:
-            out = paged_attention_decode(
-                q[:, 0], k_layer, v_layer, page_tables, kv_valid_len,
-                page_size=page_size,
+            out = _attend_pallas(
+                q[:, 0], k_layer, v_layer, page_tables, kv_valid_len
             )
             return out[:, None]
         k_seq = k_layer[gather_slots]  # [B, S_max, KV, D]
@@ -288,7 +350,10 @@ def paged_forward(
         return gqa_attention(q, k_seq, v_seq, positions, kv_valid_len)
 
     h, new_k, new_v = _run_layers(
-        params, cfg, input_ids, positions, pool_k, pool_v, write_fn, attend_fn
+        params, cfg, input_ids, positions, pool_k, pool_v, write_fn,
+        attend_fn, moe_impl=moe_impl,
+        # real tokens have in-range write slots; padding is dropped
+        valid_tokens=write_slots < pool_k.shape[1],
     )
     return _unembed(params, cfg, h), new_k, new_v
 

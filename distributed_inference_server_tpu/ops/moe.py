@@ -1,0 +1,112 @@
+"""Capacity-based sparse MoE dispatch (GShard/Switch-style) for TPU.
+
+The dense-compute MoE in ``models/llama.py:_moe_mlp`` evaluates every
+expert on every token — fine at test scale, E/k-times wasted FLOPs at
+Mixtral scale. This module is the expert-parallel execution path
+(SURVEY.md §2.3: EP "No" in the reference; north star Mixtral-8x7B EP on
+v5e-16): tokens are routed into fixed-capacity per-expert buffers with
+one-hot dispatch/combine tensors, so the whole layer is einsums with
+static shapes — exactly the form GSPMD partitions well. With expert
+weights sharded on the ``expert`` mesh axis (parallel/tp.py:
+``llama_param_specs``) and the dispatched buffer constrained to
+``P('expert', None, None)``, XLA inserts the all-to-all dispatch/combine
+over ICI; no manual collectives.
+
+Capacity semantics: each expert processes at most C tokens per step;
+assignments beyond C are dropped (the token keeps its residual stream,
+standard GShard behavior). Choice-major priority — every token's first
+choice is buffered before any token's second choice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def expert_capacity(
+    num_tokens: int, num_experts: int, k: int, capacity_factor: float
+) -> int:
+    """Static per-expert buffer size: ceil(tokens*k/E) * factor, floored at
+    k so a single-token batch always fits."""
+    base = -(-num_tokens * k // num_experts)
+    return max(k, int(base * capacity_factor))
+
+
+def moe_mlp_ep(
+    x: jnp.ndarray,
+    layer: Dict[str, jnp.ndarray],
+    num_experts: int,
+    num_experts_per_tok: int,
+    *,
+    capacity: int,
+    shard_experts: bool = False,
+    valid_tokens: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Sparse-dispatch SwiGLU MoE over ``layer``'s stacked expert weights.
+
+    Args:
+      x: [B, T, H] activations.
+      layer: dict with ``router`` [H, E], ``w_gate``/``w_up`` [E, H, I],
+        ``w_down`` [E, I, H] (one scan layer of ``llama.init_params``).
+      capacity: per-expert token buffer size (see ``expert_capacity``).
+      shard_experts: add a ``P('expert', ...)`` sharding constraint on the
+        dispatched buffer so GSPMD materializes the all-to-all when running
+        inside a mesh context (no-op semantics otherwise).
+      valid_tokens: optional [B, T] bool; False rows (bucket padding,
+        inactive decode slots) are excluded from routing so garbage tokens
+        never consume expert capacity and crowd out live ones. Their
+        output rows are zero (callers already discard them).
+
+    Returns [B, T, H], same routing math as the dense path (softmax over
+    the top-k logits), so the two agree exactly when nothing is dropped.
+    """
+    B, T, H = x.shape
+    E, k, C = num_experts, num_experts_per_tok, capacity
+    N = B * T
+    xf = x.reshape(N, H)
+
+    router_logits = (xf @ layer["router"]).astype(jnp.float32)  # [N, E]
+    top_logits, top_idx = lax.top_k(router_logits, k)
+    gates = jax.nn.softmax(top_logits, axis=-1)  # [N, k]
+
+    # Choice-major queue positions: all first choices rank before any
+    # second choice, FIFO within a choice.
+    onehot = jax.nn.one_hot(top_idx, E, dtype=jnp.int32)  # [N, k, E]
+    if valid_tokens is not None:
+        onehot = onehot * valid_tokens.reshape(N, 1, 1).astype(jnp.int32)
+    flat = onehot.transpose(1, 0, 2).reshape(k * N, E)  # [kN, E]
+    pos = jnp.cumsum(flat, axis=0) - flat  # rank within expert queue
+    keep = (pos < C) & (flat > 0)  # [kN, E]
+
+    slot = jax.nn.one_hot(jnp.clip(pos, 0, C - 1), C, dtype=jnp.float32)
+    d_flat = keep[..., None] * slot  # [kN, E, C]
+    dispatch = d_flat.reshape(k, N, E, C).sum(0)  # [N, E, C] 0/1
+    combine = (
+        (gates.T.reshape(k * N, 1, 1) * d_flat).reshape(k, N, E, C).sum(0)
+    )  # [N, E, C]
+
+    # dispatch → expert buffers (the all-to-all boundary under EP)
+    expert_in = jnp.einsum(
+        "nec,nh->ech", dispatch.astype(x.dtype), xf
+    )  # [E, C, H]
+    if shard_experts:
+        expert_in = lax.with_sharding_constraint(
+            expert_in, P("expert", None, None)
+        )
+    gate = jax.nn.silu(jnp.einsum("ech,ehi->eci", expert_in, layer["w_gate"]))
+    up = jnp.einsum("ech,ehi->eci", expert_in, layer["w_up"])
+    expert_out = jnp.einsum("eci,eih->ech", gate * up, layer["w_down"])
+    if shard_experts:
+        expert_out = lax.with_sharding_constraint(
+            expert_out, P("expert", None, None)
+        )
+
+    out = jnp.einsum(
+        "ech,nec->nh", expert_out, combine.astype(expert_out.dtype)
+    )
+    return out.reshape(B, T, H)

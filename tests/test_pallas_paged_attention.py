@@ -143,3 +143,45 @@ def test_paged_forward_pallas_matches_xla():
     )
     np.testing.assert_allclose(np.asarray(kp), np.asarray(kx), rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(vp), np.asarray(vx), rtol=1e-6, atol=1e-6)
+
+
+def test_engine_pallas_with_tp_mesh():
+    """Pallas decode attention under a tensor=2 mesh (shard_map over KV
+    heads) matches the meshless XLA path end-to-end through the engine."""
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.parallel import MeshSpec, make_mesh
+
+    params = llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+    tok = ByteTokenizer()
+    prompt = tok.encode("tp+pallas")
+    results = {}
+    for name, mesh, impl in (
+        ("xla", None, "xla"),
+        ("pallas_tp", make_mesh(MeshSpec(tensor=2)), "pallas"),
+    ):
+        eng = LLMEngine(
+            params, TINY, tok,
+            EngineConfig(
+                max_batch=2, prefill_buckets=(16, 32),
+                paged=PagedCacheConfig(num_pages=32, page_size=4,
+                                       max_pages_per_seq=8),
+                attention_impl=impl,
+            ),
+            dtype=jnp.float32, mesh=mesh,
+        )
+        eng.add_request("r", prompt, SamplingParams(max_tokens=8, temperature=0.0))
+        toks = []
+        while eng.has_work():
+            for o in eng.step():
+                if o.token_id is not None:
+                    toks.append(o.token_id)
+        results[name] = toks
+    assert len(results["xla"]) == 8
+    assert results["pallas_tp"] == results["xla"]
