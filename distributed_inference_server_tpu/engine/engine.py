@@ -148,6 +148,16 @@ class LLMEngine:
             pool_sharding = NamedSharding(mesh, tp_rules.kv_pool_spec())
             self.state.k = jax.device_put(self.state.k, pool_sharding)
             self.state.v = jax.device_put(self.state.v, pool_sharding)
+        if self._moe_impl() == "ep":
+            # Serving is drop-free: per-expert load never exceeds N (top-k
+            # experts are distinct per token), so a capacity factor of E/k
+            # guarantees no assignment is dropped — unlike the training-
+            # oriented 1.25 default, which silently zeroes overflow tokens.
+            dropless = self.cfg.num_experts / self.cfg.num_experts_per_tok
+            if self.cfg.moe_capacity_factor < dropless:
+                self.cfg = self.cfg.with_overrides(
+                    moe_capacity_factor=dropless
+                )
         self.allocator = PageAllocator(self.pcfg)
         self.waiting: Deque[_Seq] = deque()
         self.slots: List[Optional[_Seq]] = [None] * self.ecfg.max_batch

@@ -198,33 +198,53 @@ def _run_layers(
     """
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     h = params["embed"][input_ids]  # [B, T, H]
-    B, T, H = h.shape
 
     def block(h, xs):
         layer, k_layer, v_layer = xs
-        # attention
-        x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
-        q = (x @ layer["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
-        k = (x @ layer["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        v = (x @ layer["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        k_layer = write_fn(k_layer, k)
-        v_layer = write_fn(v_layer, v)
-        attn = attend_fn(q, k_layer, v_layer)
-        h = h + attn.reshape(B, T, cfg.q_size) @ layer["wo"]
-        # mlp
-        x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + (
-            _moe(x, layer, cfg, moe_impl, valid_tokens)
-            if cfg.is_moe
-            else _mlp(x, layer)
+        return layer_block(
+            cfg, layer, h, positions, k_layer, v_layer, write_fn, attend_fn,
+            inv_freq, moe_impl, valid_tokens,
         )
-        return h, (k_layer, v_layer)
 
     h, (new_k, new_v) = lax.scan(block, h, (params["layers"], cache_k, cache_v))
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     return h, new_k, new_v
+
+
+def layer_block(
+    cfg: ModelConfig,
+    layer: Dict[str, jnp.ndarray],
+    h: jnp.ndarray,
+    positions: jnp.ndarray,
+    k_layer: jnp.ndarray,
+    v_layer: jnp.ndarray,
+    write_fn,
+    attend_fn,
+    inv_freq: jnp.ndarray,
+    moe_impl: str = "dense",
+    valid_tokens: Optional[jnp.ndarray] = None,
+):
+    """One transformer block (attention + MLP/MoE) against one layer's
+    cache — the scan body of ``_run_layers``, exposed so the pipeline-
+    parallel runner (parallel/pp.py) can drive per-stage layer stacks."""
+    B, T, _ = h.shape
+    x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+    q = (x @ layer["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = (x @ layer["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = (x @ layer["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    k_layer = write_fn(k_layer, k)
+    v_layer = write_fn(v_layer, v)
+    attn = attend_fn(q, k_layer, v_layer)
+    h = h + attn.reshape(B, T, cfg.q_size) @ layer["wo"]
+    x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+    h = h + (
+        _moe(x, layer, cfg, moe_impl, valid_tokens)
+        if cfg.is_moe
+        else _mlp(x, layer)
+    )
+    return h, (k_layer, v_layer)
 
 
 def _unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
@@ -301,7 +321,12 @@ def paged_forward(
 
     Returns (logits [B, T, V] f32, new pool_k, new pool_v).
     """
-    use_pallas = attention_impl == "pallas" and input_ids.shape[1] == 1
+    if attention_impl == "pallas" and input_ids.shape[1] != 1:
+        raise ValueError(
+            "attention_impl='pallas' is decode-only (T == 1); prefill goes "
+            f"through the XLA path, got T={input_ids.shape[1]}"
+        )
+    use_pallas = attention_impl == "pallas"
     if use_pallas:
         from distributed_inference_server_tpu.ops.pallas import (
             paged_attention_decode,

@@ -19,8 +19,14 @@ from distributed_inference_server_tpu.parallel.tp import (
     shard_params,
     validate_tp,
 )
+from distributed_inference_server_tpu.parallel.cp import (
+    cp_prefill,
+    cp_shardings,
+)
 
 __all__ = [
+    "cp_prefill",
+    "cp_shardings",
     "AXES",
     "MeshSpec",
     "largest_tp",

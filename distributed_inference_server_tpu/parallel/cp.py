@@ -1,0 +1,84 @@
+"""Context-parallel prefill: long prompts sharded over the `seq` mesh axis.
+
+The reference capped context at 8192 tokens and had no sequence scaling
+(``validator.rs:20``; SURVEY.md §5). Here a long prompt's prefill spans
+chips: token ids/positions/activations are sharded over ``seq`` (GSPMD
+keeps every elementwise/matmul op local to its chunk), and attention runs
+as ring attention (ops/ring_attention.py) — KV chunks rotating over ICI
+via collective-permute while each chip accumulates blockwise softmax for
+its queries. Composes with tensor parallelism (heads sharded over
+``tensor`` inside the ring) and data parallelism (batch over ``data``).
+
+This is the prefill path for prompts too long for one chip's HBM or too
+slow for one chip's MXU; decode afterwards proceeds on the paged cache
+(the KV produced here lands in cache layout [B, S, KV, D] with slot ==
+position, ready to be scattered into pool pages).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import ModelConfig
+from distributed_inference_server_tpu.ops.ring_attention import (
+    ring_attention_sharded,
+)
+
+
+def cp_prefill(
+    params: llama.Params,
+    cfg: ModelConfig,
+    mesh,
+    input_ids: jnp.ndarray,
+    valid_len: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Context-parallel prefill of a ragged batch of prompts.
+
+    Args:
+      input_ids: [B, T] token ids, right-padded; T must divide by the
+        ``seq`` axis size.
+      valid_len: [B] prompt lengths.
+
+    Returns (last_logits [B, V] f32, k, v) where k, v are
+    [L, B, T, KV, D] caches with slot == position (padding slots hold
+    zeros) — the dense-cache layout decode starts from.
+    """
+    B, T = input_ids.shape
+    seq = mesh.shape.get("seq", 1)
+    if T % seq:
+        raise ValueError(f"prompt buffer {T} not divisible by seq axis {seq}")
+
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    positions = jnp.where(pos < valid_len[:, None], pos, -1)
+    # padding writes are dropped (slot T is out of range for the cache)
+    write_pos = jnp.where(positions >= 0, positions, T)
+
+    def attend(q, k_layer, v_layer):
+        return ring_attention_sharded(
+            mesh, q, k_layer, v_layer, positions, positions
+        )
+
+    cache = llama.KVCache.create(cfg, B, T, dtype=params["embed"].dtype)
+    h, new_k, new_v = llama._run_layers(
+        params, cfg, input_ids, positions, cache.k, cache.v,
+        lambda layer, new: llama._write_kv(layer, new, write_pos),
+        attend,
+    )
+    last = jnp.take_along_axis(
+        h, (valid_len - 1)[:, None, None].astype(jnp.int32), axis=1
+    )  # [B, 1, H]
+    logits = llama._unembed(params, cfg, last)[:, 0]
+    return logits, new_k, new_v
+
+
+def cp_shardings(mesh):
+    """(ids, valid) input shardings for jitting ``cp_prefill``."""
+    return (
+        NamedSharding(mesh, P("data", "seq")),
+        NamedSharding(mesh, P("data")),
+    )

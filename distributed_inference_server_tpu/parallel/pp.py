@@ -1,0 +1,211 @@
+"""Pipeline parallelism: layer stages over the `stage` mesh axis.
+
+For models too big for one chip/slice even under TP (north star: Llama-3
+70B TP×PP on v5p-64, BASELINE.md), layers are split into contiguous
+stages. TPU-idiomatic formulation: one SPMD program via ``shard_map`` over
+``stage`` — every device runs the same tick loop on its own layer slice,
+activations hop stage→stage through ``lax.ppermute`` over ICI/DCN, and
+GPipe fill-drain microbatching keeps stages busy (M microbatches, M+S-1
+ticks, bubble fraction (S-1)/(M+S-1)).
+
+Key layout choices:
+- Layer-stacked params keep their standard [L, ...] layout; shard_map's
+  in_specs split the layer axis, so stage s holds layers [s*L/S, (s+1)*L/S)
+  — no host-side re-packing.
+- Each stage's dense KV cache lives on that stage (cache sharded over the
+  layer axis too): cache HBM scales down 1/S per device.
+- The shard_map is *partial-manual* (``axis_names={'stage'}``): the
+  ``tensor`` axis stays GSPMD-managed inside the body, so TP composes with
+  PP without manual collectives (weights keep their tp.py shardings).
+- Embedding/final-norm/unembedding are replicated compute on every stage
+  (cheap relative to the stacks; vocab-parallel unembed is a later
+  optimization).
+
+The reference has no PP (SURVEY.md §2.3 absence audit).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import ModelConfig
+from distributed_inference_server_tpu.ops.attention import gqa_attention
+from distributed_inference_server_tpu.ops.norms import rms_norm
+from distributed_inference_server_tpu.ops.rotary import rope_frequencies
+
+
+def validate_pp(cfg: ModelConfig, stages: int, batch: int,
+                num_microbatches: int) -> None:
+    if cfg.num_layers % stages:
+        raise ValueError(
+            f"{stages} stages do not divide num_layers={cfg.num_layers}"
+        )
+    if batch % num_microbatches:
+        raise ValueError(
+            f"{num_microbatches} microbatches do not divide batch={batch}"
+        )
+
+
+def pp_forward(
+    mesh,
+    params: llama.Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    write_pos: jnp.ndarray,
+    kv_valid_len: jnp.ndarray,
+    num_microbatches: int = 1,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pipeline-parallel forward over the dense KV cache.
+
+    Same contract as ``llama.forward`` (prefill: T = prompt chunk; decode:
+    T = 1), executed over the mesh's ``stage`` axis. Returns
+    (logits [B, T, V] f32, new cache_k, new cache_v) with caches sharded
+    over the layer axis by stage.
+    """
+    S = mesh.shape.get("stage", 1)
+    B, T = input_ids.shape
+    M = num_microbatches
+    validate_pp(cfg, S, B, M)
+    B_mb = B // M
+    Smax = cache_k.shape[2]
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+
+    def body(layers, embed, final_norm, unembed, ids, pos, ck, cv, wp, kvv):
+        # local views: layers/ck/cv hold this stage's L/S layers
+        stage = lax.axis_index("stage")
+
+        def run_stage(h_mb, pos_mb, ck_mb, cv_mb, wp_mb, kvv_mb):
+            write_fn = lambda layer, new: llama._write_kv(layer, new, wp_mb)
+            attend_fn = lambda q, k, v: gqa_attention(q, k, v, pos_mb, kvv_mb)
+
+            def blk(h, xs):
+                layer, k_l, v_l = xs
+                return llama.layer_block(
+                    cfg, layer, h, pos_mb, k_l, v_l, write_fn, attend_fn,
+                    inv_freq,
+                )
+
+            h_mb, (nk, nv) = lax.scan(blk, h_mb, (layers, ck_mb, cv_mb))
+            return h_mb, nk, nv
+
+        def tick(t, carry):
+            state, ck, cv, out = carry
+            mb = t - stage
+            valid = (mb >= 0) & (mb < M)
+            row = jnp.clip(mb, 0, M - 1) * B_mb
+            ids_mb = lax.dynamic_slice_in_dim(ids, row, B_mb, 0)
+            pos_mb = lax.dynamic_slice_in_dim(pos, row, B_mb, 0)
+            wp_mb = lax.dynamic_slice_in_dim(wp, row, B_mb, 0)
+            kvv_mb = lax.dynamic_slice_in_dim(kvv, row, B_mb, 0)
+            ck_mb = lax.dynamic_slice_in_dim(ck, row, B_mb, 1)
+            cv_mb = lax.dynamic_slice_in_dim(cv, row, B_mb, 1)
+            # invalid ticks (pipeline bubble) must not mutate the cache
+            wp_eff = jnp.where(valid, wp_mb, Smax)
+
+            h_in = jnp.where(stage == 0, embed[ids_mb], state)
+            h_out, nk, nv = run_stage(h_in, pos_mb, ck_mb, cv_mb, wp_eff,
+                                      kvv_mb)
+            ck = lax.dynamic_update_slice_in_dim(ck, nk, row, 1)
+            cv = lax.dynamic_update_slice_in_dim(cv, nv, row, 1)
+
+            out_upd = lax.dynamic_update_slice_in_dim(out, h_out, row, 0)
+            out = jnp.where(valid & (stage == S - 1), out_upd, out)
+
+            # hand activations to the next stage (stage 0 always injects,
+            # so the non-circular permute's zero-fill there is harmless)
+            state = lax.ppermute(
+                h_out, "stage", [(i, i + 1) for i in range(S - 1)]
+            )
+            return state, ck, cv, out
+
+        # carries start stage-varying (vma tracking needs the promotion)
+        state0 = lax.pcast(
+            jnp.zeros((B_mb, T, cfg.hidden_size), embed.dtype),
+            "stage", to="varying",
+        )
+        out0 = lax.pcast(
+            jnp.zeros((B, T, cfg.hidden_size), embed.dtype),
+            "stage", to="varying",
+        )
+        state, ck, cv, out = lax.fori_loop(
+            0, M + S - 1, tick, (state0, ck, cv, out0)
+        )
+
+        out = lax.psum(out, "stage")  # only the last stage wrote; broadcast
+        h = rms_norm(out, final_norm, cfg.rms_norm_eps)
+        logits = jnp.einsum(
+            "bth,hv->btv", h, unembed, preferred_element_type=jnp.float32
+        )
+        return logits, ck, cv
+
+    unembed = (
+        params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    )
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        axis_names={"stage"},  # tensor/data stay GSPMD-managed inside
+        in_specs=(
+            P("stage"),  # layer stacks [L, ...] -> local [L/S, ...]
+            P(),  # embed
+            P(),  # final_norm
+            P(),  # unembed
+            P(),  # ids
+            P(),  # positions
+            P("stage"),  # cache_k [L, B, Smax, KV, D]
+            P("stage"),  # cache_v
+            P(),  # write_pos
+            P(),  # kv_valid_len
+        ),
+        out_specs=(P(), P("stage"), P("stage")),
+    )
+    return fn(
+        params["layers"], params["embed"],
+        params["final_norm"], unembed,
+        input_ids, positions, cache_k, cache_v, write_pos, kv_valid_len,
+    )
+
+
+def pp_greedy_generate(
+    mesh,
+    params: llama.Params,
+    cfg: ModelConfig,
+    prompt_ids: jnp.ndarray,
+    max_new_tokens: int,
+    max_seq: int,
+    num_microbatches: int = 1,
+) -> jnp.ndarray:
+    """Greedy generation through the pipeline: prefill then per-token
+    decode steps, all over the stage axis. prompt_ids: [B, T0] (no
+    padding). Returns [B, max_new_tokens]."""
+    B, T0 = prompt_ids.shape
+    cache = llama.KVCache.create(cfg, B, max_seq, dtype=params["embed"].dtype)
+    positions = jnp.broadcast_to(jnp.arange(T0)[None], (B, T0))
+    step = functools.partial(pp_forward, mesh, params, cfg,
+                             num_microbatches=num_microbatches)
+    with mesh:
+        logits, ck, cv = step(
+            prompt_ids, positions, cache.k, cache.v, positions,
+            jnp.full((B,), T0, jnp.int32),
+        )
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        outs = [tok]
+        for i in range(1, max_new_tokens):
+            pos = jnp.full((B, 1), T0 + i - 1, jnp.int32)
+            logits, ck, cv = step(
+                tok[:, None], pos, ck, cv, pos,
+                jnp.full((B,), T0 + i, jnp.int32),
+            )
+            tok = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            outs.append(tok)
+    return jnp.stack(outs, axis=1)
