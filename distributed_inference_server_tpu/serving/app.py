@@ -42,7 +42,11 @@ def _error_response(err: ApiError) -> web.Response:
 def build_app(
     handler: InferenceHandler,
     metrics: Optional[MetricsCollector] = None,
+    swap_fn=None,
 ) -> web.Application:
+    """``swap_fn(model_name) -> (ok, error)`` enables the admin model-swap
+    endpoint (Req 13.1: admin-API-triggered); blocking — it is run in the
+    default executor."""
     app = web.Application()
     app["handler"] = handler
     app["metrics"] = metrics
@@ -159,6 +163,34 @@ def build_app(
             status=200 if healthy else 503,
         )
 
+    async def model_swap(request: web.Request) -> web.Response:
+        if swap_fn is None:
+            return web.json_response(
+                {"error": {"message": "model swap not configured",
+                           "error_type": "invalid_request_error",
+                           "code": "swap_unavailable"}},
+                status=501,
+            )
+        obj = await _json_body(request)
+        name = obj.get("model")
+        if not isinstance(name, str) or not name:
+            return web.json_response(
+                {"error": {"message": "body must contain 'model'",
+                           "error_type": "invalid_request_error",
+                           "code": "invalid_body"}},
+                status=400,
+            )
+        loop = asyncio.get_running_loop()
+        ok, err = await loop.run_in_executor(None, swap_fn, name)
+        if not ok:
+            return web.json_response(
+                {"error": {"message": err, "error_type": "server_error",
+                           "code": "swap_failed"}},
+                status=500,
+            )
+        return web.json_response({"status": "ok", "model": name})
+
+    app.router.add_post("/admin/model-swap", model_swap)
     app.router.add_post("/generate", generate)
     app.router.add_post("/chat", chat)
     app.router.add_post("/embeddings", embeddings)

@@ -97,6 +97,9 @@ class EngineRunner:
         self._engine: Optional[LLMEngine] = None
         self._thread: Optional[threading.Thread] = None
         self._cache_seen = {"hits": 0, "misses": 0, "evictions": 0}
+        # old engines still finishing their in-flight requests after a
+        # model hot-swap (Req 13.3: in-flight completes on the old model)
+        self._draining: List[LLMEngine] = []
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -158,7 +161,10 @@ class EngineRunner:
 
     def abort(self, request_id: RequestId) -> None:
         def _do() -> None:
-            self._engine.abort(request_id)
+            if not self._engine.abort(request_id):
+                for eng in self._draining:
+                    if eng.abort(request_id):
+                        break
             self._inflight.pop(request_id, None)
 
         self._post(_do)
@@ -207,6 +213,56 @@ class EngineRunner:
         with self._inbox_lock:
             self._inbox.append(fn)
         self._wake.set()
+
+    # -- model hot-swap (Req 13, requirements.md:178-182) ------------------
+
+    def swap_model(
+        self,
+        factory: Callable[[], LLMEngine],
+        on_done: Optional[Callable[[bool, Optional[str]], None]] = None,
+        cancelled: Optional[threading.Event] = None,
+    ) -> None:
+        """Hot-swap the model: build the new engine on a background thread
+        (serving continues on the old model, Req 13.1-13.2), then switch
+        atomically at an inbox-drain point — new requests hit the new
+        engine, in-flight ones finish on the old (Req 13.3). On load
+        failure the old model stays (Req 13.4). The new engine starts with
+        an empty KV cache and fresh cache stats (Req 13.5).
+
+        ``cancelled`` (checked right before the switch, on the runner
+        thread) lets an orchestrator abandon a swap that exceeded its
+        deadline without a late install sneaking in afterwards."""
+
+        def _build() -> None:
+            try:
+                eng = factory()
+            except Exception as e:  # noqa: BLE001 — keep old model
+                self._last_error = f"model swap failed: {e}"
+                if on_done:
+                    on_done(False, str(e))
+                return
+
+            def _install() -> None:
+                if cancelled is not None and cancelled.is_set():
+                    if on_done:
+                        on_done(False, "swap cancelled")
+                    return
+                old = self._engine
+                self._engine = eng
+                # restarts must come back on the swapped model
+                self._factory = factory
+                if old is not None and old.has_work():
+                    self._draining.append(old)
+                # fresh stats baseline for the new model (Req 13.5)
+                self._cache_seen = {"hits": 0, "misses": 0, "evictions": 0}
+                if on_done:
+                    on_done(True, None)
+
+            self._post(_install)
+
+        threading.Thread(
+            target=_build, name=f"swap-{self.engine_id}", daemon=True
+        ).start()
 
     # -- introspection (any thread) ---------------------------------------
 
@@ -259,7 +315,9 @@ class EngineRunner:
         try:
             while not self._stop.is_set():
                 self._drain_inbox()
+                worked = False
                 if self._engine.has_work():
+                    worked = True
                     t0 = time.monotonic()
                     outputs = self._engine.step()
                     dt = time.monotonic() - t0
@@ -267,7 +325,8 @@ class EngineRunner:
                         self.metrics.record_inference(dt)
                     self._dispatch(outputs)
                     self._report_cache_deltas()
-                else:
+                worked |= self._step_draining()
+                if not worked:
                     self._wake.wait(0.005)
                     self._wake.clear()
         except Exception as e:  # noqa: BLE001 — engine-level crash
@@ -276,6 +335,28 @@ class EngineRunner:
             if self.metrics:
                 self.metrics.set_engine_up(self.engine_id, False)
             self._fail_all(str(e))
+
+    def _step_draining(self) -> bool:
+        """Step old engines still finishing in-flight work after a swap.
+        A crash in a draining engine fails only its own requests — the new
+        engine keeps serving."""
+        worked = False
+        for eng in list(self._draining):
+            if not eng.has_work():
+                self._draining.remove(eng)
+                continue
+            worked = True
+            try:
+                self._dispatch(eng.step())
+            except Exception as e:  # noqa: BLE001 — old-model isolation
+                ids = list(getattr(eng, "_by_id", {}).keys())
+                self._fail_all_of(
+                    [r for r in self._inflight.values()
+                     if r.request_id in ids],
+                    f"old model failed during drain: {e}",
+                )
+                self._draining.remove(eng)
+        return worked
 
     def _drain_inbox(self) -> None:
         while True:

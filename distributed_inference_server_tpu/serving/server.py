@@ -37,6 +37,19 @@ from distributed_inference_server_tpu.serving.scheduler import (
 )
 
 
+def _bind_factory(factory: Callable, idx: int) -> Callable[[], LLMEngine]:
+    """Bind a replica index: index-aware factories (``def factory(i)``) let
+    multi-replica TP deployments give each replica a disjoint device
+    slice; zero-arg factories pass through."""
+    import inspect
+
+    try:
+        takes_index = bool(inspect.signature(factory).parameters)
+    except (TypeError, ValueError):
+        takes_index = False
+    return (lambda: factory(idx)) if takes_index else factory
+
+
 class InferenceServer:
     """Owns the full serving stack for one model."""
 
@@ -52,8 +65,12 @@ class InferenceServer:
         validator_config: Optional[ValidatorConfig] = None,
         auto_restart: bool = True,
         health_check_interval_s: float = 1.0,
+        model_resolver: Optional[Callable[[str], Callable[[], LLMEngine]]] = None,
     ):
+        """``model_resolver(name) -> engine_factory`` enables the admin
+        model-swap endpoint (Req 13); None leaves it unconfigured (501)."""
         self.engine_factory = engine_factory
+        self.model_resolver = model_resolver
         self.metrics = MetricsCollector()
         self.scheduler = AdaptiveScheduler(
             strategy=strategy,
@@ -108,17 +125,9 @@ class InferenceServer:
         idx = self._next_engine_idx
         engine_id = f"engine-{idx}"
         self._next_engine_idx += 1
-        factory = self.engine_factory
-        # index-aware factories (def factory(replica_idx)) let multi-replica
-        # TP deployments give each replica a disjoint device slice
-        import inspect
-
-        try:
-            takes_index = bool(inspect.signature(factory).parameters)
-        except (TypeError, ValueError):
-            takes_index = False
-        bound = (lambda: factory(idx)) if takes_index else factory
-        runner = EngineRunner(engine_id, bound, self.metrics)
+        runner = EngineRunner(
+            engine_id, _bind_factory(self.engine_factory, idx), self.metrics
+        )
         runner.start(wait_ready=wait_ready)
         self.scheduler.register(runner)
         return runner
@@ -148,6 +157,52 @@ class InferenceServer:
 
         threading.Thread(target=_wait, daemon=True).start()
 
+    # -- model hot-swap (Req 13) ------------------------------------------
+
+    def swap_model(
+        self,
+        engine_factory: Callable[[], LLMEngine],
+        model_name: Optional[str] = None,
+        timeout_s: float = 600.0,
+    ) -> tuple:
+        """Swap every replica to a new model (requirements.md:178-182):
+        background load per runner, atomic per-runner switch, in-flight
+        requests finish on the old model. Returns (ok, error). On any
+        replica's load failure that replica keeps the old model and the
+        call reports failure (Req 13.4); replicas are independent, so a
+        partial swap is visible in /server/stats until retried. Stragglers
+        past the deadline are cancelled — they never install late."""
+        import threading as _t
+        import time as _time
+
+        runners = self.scheduler.engines()
+        results: dict = {}
+        events = []
+        cancelled = _t.Event()
+        for idx, runner in enumerate(runners):
+            ev = _t.Event()
+            events.append(ev)
+
+            def _cb(ok, err, eid=runner.engine_id, ev=ev):
+                results[eid] = (ok, err)
+                ev.set()
+
+            runner.swap_model(
+                _bind_factory(engine_factory, idx), _cb, cancelled=cancelled
+            )
+        deadline = _time.monotonic() + timeout_s
+        for ev in events:
+            if not ev.wait(max(0.0, deadline - _time.monotonic())):
+                cancelled.set()
+                return False, f"model swap timed out after {timeout_s}s"
+        failed = {e: err for e, (ok, err) in results.items() if not ok}
+        if failed:
+            return False, f"swap failed on {failed}"
+        self.engine_factory = engine_factory
+        if model_name is not None:
+            self.handler.model_name = model_name
+        return True, None
+
     # -- hot-reload --------------------------------------------------------
 
     def apply_hot_config(self, diff: dict, new_config) -> None:
@@ -174,7 +229,16 @@ class InferenceServer:
     # -- HTTP --------------------------------------------------------------
 
     def build_app(self) -> web.Application:
-        return build_app(self.handler, self.metrics)
+        swap_fn = None
+        if self.model_resolver is not None:
+            def swap_fn(name: str):  # noqa: F811 — deliberate rebind
+                try:
+                    factory = self.model_resolver(name)
+                except Exception as e:  # noqa: BLE001 — unknown model etc.
+                    return False, str(e)
+                return self.swap_model(factory, model_name=name)
+
+        return build_app(self.handler, self.metrics, swap_fn=swap_fn)
 
     async def serve(self, host: str = "0.0.0.0", port: int = 8000) -> web.AppRunner:
         """Bind and serve; returns the AppRunner (caller controls lifetime)."""
