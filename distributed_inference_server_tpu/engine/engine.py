@@ -52,6 +52,25 @@ from distributed_inference_server_tpu.models.tokenizer import Tokenizer
 from distributed_inference_server_tpu.ops.sampling import sample_tokens
 
 
+def _make_allocator(pcfg: PagedCacheConfig, force: Optional[bool]):
+    """Pick the page-allocator tier: the native C++ implementation
+    (native/allocator.cpp — the reference's serving layer is native, ours
+    matches) when available, the canonical Python one otherwise."""
+    if force is not False:
+        try:
+            from distributed_inference_server_tpu import native
+
+            if native.available():
+                return native.NativePageAllocator(pcfg)
+        except Exception:  # noqa: BLE001 — toolchain missing etc.
+            pass
+        if force is True:
+            raise RuntimeError(
+                "native_allocator=True but the native library is unavailable"
+            )
+    return PageAllocator(pcfg)
+
+
 @dataclass(frozen=True)
 class SamplingParams:
     max_tokens: int = 256
@@ -69,6 +88,9 @@ class EngineConfig:
     # decode attention: "auto" = Pallas ragged paged-attention kernel on
     # TPU, XLA gather path elsewhere; or force "pallas" / "xla"
     attention_impl: str = "auto"
+    # host-side page allocator: None = native C++ (native/allocator.cpp)
+    # when the library builds, Python fallback otherwise; True/False force
+    native_allocator: Optional[bool] = None
 
 
 @dataclass
@@ -158,7 +180,7 @@ class LLMEngine:
                 self.cfg = self.cfg.with_overrides(
                     moe_capacity_factor=dropless
                 )
-        self.allocator = PageAllocator(self.pcfg)
+        self.allocator = _make_allocator(self.pcfg, self.ecfg.native_allocator)
         self.waiting: Deque[_Seq] = deque()
         self.slots: List[Optional[_Seq]] = [None] * self.ecfg.max_batch
         self._by_id: Dict[RequestId, _Seq] = {}

@@ -1,0 +1,292 @@
+"""Native C++ serving-layer components, reached over a C ABI via ctypes.
+
+The reference's serving layer is entirely native (Rust; SURVEY.md §2
+language note) — this package is the counterpart tier in our design: the
+host-side hot paths (request queue, page allocator) implemented in C++
+(native/pqueue.cpp, native/allocator.cpp) behind Python wrappers with the
+exact contracts of ``core/queue.py`` and ``engine/kv_cache.py``. The
+Python implementations remain the canonical semantics; differential tests
+(tests/test_native.py) drive both with the same operation sequences.
+
+The shared library builds on demand with ``make`` (g++, no deps); when a
+toolchain is unavailable, ``available()`` is False and callers fall back
+to the Python tier.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libdis_tpu_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        # always run make: its dependency tracking rebuilds a stale .so
+        # after source edits (a no-op when up to date)
+        try:
+            subprocess.run(
+                ["make", "-C", _DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            if not os.path.exists(_LIB_PATH):
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        _declare(lib)
+        _lib = lib
+        return lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    intp = ctypes.POINTER(ctypes.c_int)
+    lib.pq_create.restype = ctypes.c_void_p
+    lib.pq_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_double,
+                              ctypes.c_int]
+    lib.pq_destroy.argtypes = [ctypes.c_void_p]
+    lib.pq_set_config.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_double, ctypes.c_int]
+    lib.pq_enqueue.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+                               ctypes.c_double]
+    lib.pq_dequeue_batch.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int]
+    lib.pq_dequeue_one.argtypes = [ctypes.c_void_p, u64p]
+    lib.pq_depth.argtypes = [ctypes.c_void_p, intp]
+    lib.pq_is_accepting.argtypes = [ctypes.c_void_p]
+    lib.pq_remove_expired.argtypes = [ctypes.c_void_p, ctypes.c_double, u64p,
+                                      ctypes.c_int]
+    lib.pq_cancel.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+
+    lib.pa_create.restype = ctypes.c_void_p
+    lib.pa_create.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.pa_destroy.argtypes = [ctypes.c_void_p]
+    lib.pa_num_free.argtypes = [ctypes.c_void_p]
+    lib.pa_match_prefix.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int, i32p]
+    lib.pa_allocate.argtypes = [ctypes.c_void_p, ctypes.c_int, i32p]
+    lib.pa_publish.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int, i32p,
+                               ctypes.c_int]
+    lib.pa_retain.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int]
+    lib.pa_release.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int]
+    lib.pa_touch.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int]
+    lib.pa_evict_below.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    lib.pa_stats.argtypes = [ctypes.c_void_p, i64p]
+
+
+def available() -> bool:
+    """True when the native library is built (builds on first call)."""
+    return _load() is not None
+
+
+def _i32arr(vals: Sequence[int]):
+    return (ctypes.c_int32 * max(len(vals), 1))(*vals)
+
+
+class NativePriorityQueue:
+    """ctypes façade over native/pqueue.cpp with the exact contract of
+    ``core.queue.PriorityQueueManager`` (drop-in for the dispatcher)."""
+
+    def __init__(self, config=None):
+        from distributed_inference_server_tpu.core.queue import QueueConfig
+
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._config = config or QueueConfig()
+        self._ptr = lib.pq_create(
+            self._config.high_watermark,
+            self._config.low_watermark,
+            ctypes.c_double(self._config.request_timeout_s),
+            self._config.max_queue_size,
+        )
+        self._next_handle = 1
+        self._by_handle: Dict[int, object] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def config(self):
+        return self._config
+
+    @config.setter
+    def config(self, cfg) -> None:
+        """Hot-reload (requirements.md:146): pushes the new watermarks/
+        timeout/cap down to the native side."""
+        self._config = cfg
+        self._lib.pq_set_config(
+            self._ptr, cfg.high_watermark, cfg.low_watermark,
+            ctypes.c_double(cfg.request_timeout_s), cfg.max_queue_size,
+        )
+
+    def __del__(self):
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.pq_destroy(ptr)
+            self._ptr = None
+
+    # -- contract ----------------------------------------------------------
+
+    def enqueue(self, request) -> None:
+        from distributed_inference_server_tpu.core.errors import QueueFull
+
+        with self._lock:
+            handle = self._next_handle
+            # Priority is LOW=0..HIGH=2 (types.py); the native queue
+            # indexes level 0 = High .. 2 = Low
+            rc = self._lib.pq_enqueue(
+                self._ptr, handle, 2 - int(request.priority),
+                ctypes.c_double(request.enqueued_at),
+            )
+            if rc != 0:
+                raise QueueFull()
+            self._next_handle += 1
+            self._by_handle[handle] = request
+
+    def dequeue_batch(self, max_count: int) -> List:
+        out = (ctypes.c_uint64 * max(max_count, 1))()
+        with self._lock:
+            n = self._lib.pq_dequeue_batch(self._ptr, out, max_count)
+            return [self._by_handle.pop(out[i]) for i in range(n)]
+
+    def dequeue_one(self):
+        got = self.dequeue_batch(1)
+        return got[0] if got else None
+
+    def queue_depth(self):
+        from distributed_inference_server_tpu.core.queue import QueueDepth
+
+        out = (ctypes.c_int * 3)()
+        self._lib.pq_depth(self._ptr, out)
+        return QueueDepth(high=out[0], normal=out[1], low=out[2],
+                          total=out[0] + out[1] + out[2])
+
+    def is_accepting(self) -> bool:
+        return bool(self._lib.pq_is_accepting(self._ptr))
+
+    def total_depth(self) -> int:
+        return self.queue_depth().total
+
+    def is_empty(self) -> bool:
+        return self.total_depth() == 0
+
+    def remove_expired(self, now: Optional[float] = None) -> List:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            cap = len(self._by_handle) or 1
+            out = (ctypes.c_uint64 * cap)()
+            n = self._lib.pq_remove_expired(
+                self._ptr, ctypes.c_double(now), out, cap
+            )
+            return [self._by_handle.pop(out[i]) for i in range(min(n, cap))]
+
+    def cancel(self, request_id):
+        with self._lock:
+            for handle, req in self._by_handle.items():
+                if req.id == request_id:
+                    if self._lib.pq_cancel(self._ptr, handle):
+                        self._by_handle.pop(handle)
+                        return req
+                    return None
+            return None
+
+
+class NativePageAllocator:
+    """ctypes façade over native/allocator.cpp with the contract of
+    ``engine.kv_cache.PageAllocator`` (drop-in for the engine)."""
+
+    def __init__(self, cfg):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.cfg = cfg
+        self._ptr = lib.pa_create(cfg.num_pages, cfg.page_size)
+
+    def __del__(self):
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.pa_destroy(ptr)
+            self._ptr = None
+
+    def num_free(self) -> int:
+        return self._lib.pa_num_free(self._ptr)
+
+    def match_prefix(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        max_pages = len(tokens) // self.cfg.page_size
+        out = (ctypes.c_int32 * max(max_pages, 1))()
+        n = self._lib.pa_match_prefix(
+            self._ptr, _i32arr(list(tokens)), len(tokens), out
+        )
+        return [out[i] for i in range(n)], n * self.cfg.page_size
+
+    def allocate(self, n: int) -> List[int]:
+        from distributed_inference_server_tpu.core.errors import CacheFull
+
+        out = (ctypes.c_int32 * max(n, 1))()
+        if self._lib.pa_allocate(self._ptr, n, out) != 0:
+            raise CacheFull()
+        return [out[i] for i in range(n)]
+
+    def publish(self, tokens: Sequence[int], page_ids: Sequence[int]) -> None:
+        self._lib.pa_publish(
+            self._ptr, _i32arr(list(tokens)), len(tokens),
+            _i32arr(list(page_ids)), len(page_ids),
+        )
+
+    def retain(self, page_ids: Sequence[int]) -> None:
+        self._lib.pa_retain(self._ptr, _i32arr(list(page_ids)), len(page_ids))
+
+    def release(self, page_ids: Sequence[int]) -> None:
+        self._lib.pa_release(self._ptr, _i32arr(list(page_ids)), len(page_ids))
+
+    def touch(self, page_ids: Sequence[int]) -> None:
+        self._lib.pa_touch(self._ptr, _i32arr(list(page_ids)), len(page_ids))
+
+    def evict_below(self, target_frac: float) -> int:
+        return self._lib.pa_evict_below(self._ptr,
+                                        ctypes.c_double(target_frac))
+
+    def stats(self):
+        from distributed_inference_server_tpu.engine.kv_cache import CacheStats
+
+        out = (ctypes.c_int64 * 6)()
+        self._lib.pa_stats(self._ptr, out)
+        hits, misses, evictions, total, free, cached = (
+            out[0], out[1], out[2], out[3], out[4], out[5],
+        )
+        return CacheStats(
+            hits=int(hits), misses=int(misses), evictions=int(evictions),
+            pages_total=int(total), pages_free=int(free),
+            pages_cached=int(cached),
+            memory_used_frac=1.0 - (free + cached) / total if total else 0.0,
+        )
+
+    def hit_rate(self) -> float:
+        s = self.stats()
+        total = s.hits + s.misses
+        return s.hits / total if total else 0.0
+
+
+__all__ = ["available", "NativePriorityQueue", "NativePageAllocator"]

@@ -40,6 +40,29 @@ from distributed_inference_server_tpu.serving.runner import (
 from distributed_inference_server_tpu.serving.scheduler import AdaptiveScheduler
 
 
+def _make_queue(queue_config, force: Optional[bool] = None):
+    """Pick the queue tier (contracts identical; differential tests in
+    tests/test_native.py): the native C++ queue (native/pqueue.cpp) when
+    built — the admission hot path runs native, as in the reference's Rust
+    serving layer — the Python tier otherwise. ``force``: None = auto,
+    True = native or raise, False = Python. The chosen tier is logged."""
+    import logging
+
+    log = logging.getLogger(__name__)
+    if force is not False:
+        from distributed_inference_server_tpu import native
+
+        if native.available():
+            log.info("request queue: native C++ tier")
+            return native.NativePriorityQueue(queue_config)
+        if force is True:
+            raise RuntimeError(
+                "native_queue=True but the native library is unavailable"
+            )
+    log.info("request queue: Python tier")
+    return PriorityQueueManager(queue_config)
+
+
 class Dispatcher:
     """Owns the queue, batcher, and dispatch/sweep thread."""
 
@@ -50,10 +73,11 @@ class Dispatcher:
         batcher_config: Optional[BatcherConfig] = None,
         metrics: Optional[MetricsCollector] = None,
         poll_interval_s: float = 0.002,
+        native_queue: Optional[bool] = None,
     ):
         self.scheduler = scheduler
-        self.queue: PriorityQueueManager[ServerRequest] = PriorityQueueManager(
-            queue_config
+        self.queue: PriorityQueueManager[ServerRequest] = _make_queue(
+            queue_config, native_queue
         )
         self.batcher: AdmissionBatcher[ServerRequest] = AdmissionBatcher(
             self.queue, batcher_config

@@ -1,0 +1,205 @@
+"""Differential tests: native C++ components vs the canonical Python tier.
+
+The same randomized operation sequences drive both implementations; every
+observable (returned values, depths, stats, backpressure state) must be
+identical. This is the conformance story for the native serving layer —
+the Python modules carry the reference-derived property tests, and these
+prove the C++ twins behave identically."""
+
+import random
+
+import pytest
+
+from distributed_inference_server_tpu import native
+from distributed_inference_server_tpu.core.errors import CacheFull, QueueFull
+from distributed_inference_server_tpu.core.queue import (
+    PriorityQueueManager,
+    QueueConfig,
+    QueuedRequest,
+)
+from distributed_inference_server_tpu.core.types import Priority
+from distributed_inference_server_tpu.engine.kv_cache import (
+    PageAllocator,
+    PagedCacheConfig,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def _req(i: int, prio: Priority, t: float):
+    return QueuedRequest(id=f"r{i}", data=i, priority=prio, enqueued_at=t)
+
+
+def test_queue_differential_random_ops():
+    cfg = QueueConfig(high_watermark=30, low_watermark=15,
+                      request_timeout_s=10.0, max_queue_size=60)
+    py = PriorityQueueManager(cfg)
+    cc = native.NativePriorityQueue(cfg)
+    rnd = random.Random(0)
+    now = 0.0
+    seq = 0
+    for _ in range(3000):
+        op = rnd.random()
+        now += rnd.random() * 0.5
+        if op < 0.45:
+            seq += 1
+            prio = rnd.choice(list(Priority))
+            r1 = _req(seq, prio, now)
+            r2 = _req(seq, prio, now)
+            outcomes = []
+            for q, r in ((py, r1), (cc, r2)):
+                try:
+                    q.enqueue(r)
+                    outcomes.append("ok")
+                except QueueFull:
+                    outcomes.append("full")
+            assert outcomes[0] == outcomes[1], f"enqueue diverged at {seq}"
+        elif op < 0.7:
+            n = rnd.randint(1, 8)
+            a = [r.id for r in py.dequeue_batch(n)]
+            b = [r.id for r in cc.dequeue_batch(n)]
+            assert a == b
+        elif op < 0.8:
+            a = py.dequeue_one()
+            b = cc.dequeue_one()
+            assert (a.id if a else None) == (b.id if b else None)
+        elif op < 0.9:
+            a = sorted(r.id for r in py.remove_expired(now))
+            b = sorted(r.id for r in cc.remove_expired(now))
+            assert a == b
+        else:
+            victim = f"r{rnd.randint(max(1, seq - 20), seq + 1)}"
+            a = py.cancel(victim)
+            b = cc.cancel(victim)
+            assert (a.id if a else None) == (b.id if b else None)
+        assert py.queue_depth() == cc.queue_depth()
+        assert py.is_accepting() == cc.is_accepting()
+
+
+def test_queue_backpressure_hysteresis_native():
+    """Property 7 directly against the native queue."""
+    cfg = QueueConfig(high_watermark=10, low_watermark=5,
+                      request_timeout_s=30.0, max_queue_size=100)
+    q = native.NativePriorityQueue(cfg)
+    for i in range(10):
+        q.enqueue(_req(i, Priority.NORMAL, 0.0))
+    assert q.is_accepting()  # at watermark, not above
+    q.enqueue(_req(99, Priority.NORMAL, 0.0))  # 11 > 10
+    assert not q.is_accepting()  # crossed high watermark
+    with pytest.raises(QueueFull):
+        q.enqueue(_req(100, Priority.NORMAL, 0.0))
+    while q.total_depth() >= 5:
+        q.dequeue_one()
+    assert q.is_accepting()  # released below low watermark
+
+
+def test_allocator_differential_random_ops():
+    cfg = PagedCacheConfig(num_pages=24, page_size=4, max_pages_per_seq=8)
+    py = PageAllocator(cfg)
+    cc = native.NativePageAllocator(cfg)
+    rnd = random.Random(1)
+    # sequences: token list + page ids currently held, mirrored across impls
+    held_py = []  # list of (tokens, pages)
+    held_cc = []
+    for step in range(2000):
+        op = rnd.random()
+        if op < 0.35:  # admit a sequence: match prefix then allocate rest
+            n_tokens = rnd.randint(1, 28)
+            tokens = [rnd.randint(0, 5) for _ in range(n_tokens)]
+            res = []
+            for impl, held in ((py, held_py), (cc, held_cc)):
+                shared, matched = impl.match_prefix(tokens)
+                needed = -(-(n_tokens) // cfg.page_size) - len(shared)
+                try:
+                    fresh = impl.allocate(needed)
+                    impl.publish(tokens, shared + fresh)
+                    held.append((tokens, shared + fresh))
+                    res.append(("ok", shared, matched, fresh))
+                except CacheFull:
+                    impl.release(shared)
+                    res.append(("full", shared, matched, None))
+            assert res[0] == res[1], f"admit diverged at step {step}"
+        elif op < 0.75 and held_py:  # finish a sequence
+            i = rnd.randrange(len(held_py))
+            _, pages_py = held_py.pop(i)
+            _, pages_cc = held_cc.pop(i)
+            py.release(pages_py)
+            cc.release(pages_cc)
+        elif op < 0.85 and held_py:  # touch
+            i = rnd.randrange(len(held_py))
+            py.touch(held_py[i][1])
+            cc.touch(held_cc[i][1])
+        elif op < 0.95:
+            frac = rnd.random()
+            assert py.evict_below(frac) == cc.evict_below(frac)
+        else:
+            assert py.num_free() == cc.num_free()
+        s_py, s_cc = py.stats(), cc.stats()
+        assert (s_py.hits, s_py.misses, s_py.evictions, s_py.pages_free,
+                s_py.pages_cached) == (
+            s_cc.hits, s_cc.misses, s_cc.evictions, s_cc.pages_free,
+            s_cc.pages_cached,
+        ), f"stats diverged at step {step}"
+
+
+def test_allocator_prefix_reuse_native():
+    """Property 9 against the native allocator: identical prompts share
+    full pages."""
+    cfg = PagedCacheConfig(num_pages=16, page_size=4, max_pages_per_seq=8)
+    a = native.NativePageAllocator(cfg)
+    tokens = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    shared, matched = a.match_prefix(tokens)
+    assert (shared, matched) == ([], 0)
+    fresh = a.allocate(3)
+    a.publish(tokens, fresh)
+    shared2, matched2 = a.match_prefix(tokens)
+    assert shared2 == fresh[:2]  # two FULL pages (8 of 9 tokens)
+    assert matched2 == 8
+    a.release(shared2)
+    a.release(fresh)
+    # all pages released -> cached, reclaimable
+    assert a.num_free() == cfg.num_pages
+
+
+def test_engine_runs_on_native_allocator():
+    """End-to-end: the continuous-batching engine with the native page
+    allocator produces the same tokens as with the Python allocator."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+    params = llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+    tok = ByteTokenizer()
+    results = {}
+    for use_native in (False, True):
+        eng = LLMEngine(
+            params, TINY, tok,
+            EngineConfig(
+                max_batch=2, prefill_buckets=(8, 32),
+                paged=PagedCacheConfig(num_pages=32, page_size=4,
+                                       max_pages_per_seq=8),
+                native_allocator=use_native,
+            ),
+            dtype=jnp.float32,
+        )
+        assert ("Native" in type(eng.allocator).__name__) == use_native
+        eng.add_request("r", tok.encode("native!"),
+                        SamplingParams(max_tokens=8, temperature=0.0))
+        toks = []
+        while eng.has_work():
+            for o in eng.step():
+                if o.token_id is not None:
+                    toks.append(o.token_id)
+        results[use_native] = toks
+    assert results[True] == results[False]
+    assert len(results[True]) == 8
