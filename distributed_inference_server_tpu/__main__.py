@@ -92,6 +92,13 @@ def main(argv=None) -> int:
             model_cfg = get_config(cfg.get("model", "model_name"))
             params = llama.init_params(jax.random.PRNGKey(0), model_cfg,
                                        dtype=dtype)
+        quant = cfg.get("model", "quantization")
+        if quant != "none":
+            from distributed_inference_server_tpu.ops.quant import (
+                quantize_params,
+            )
+
+            params = quantize_params(params, quant)
         mesh = None
         if tp > 1:
             import jax

@@ -122,11 +122,27 @@ def _write_kv(
 # ---------------------------------------------------------------------------
 
 
+def _mm(x: jnp.ndarray, w) -> jnp.ndarray:
+    """x @ w with transparent weight-only quantization (ops/quant.py):
+    quantized weights dequantize on the fly — XLA fuses the convert+scale
+    into the matmul, so HBM traffic stays int8/int4."""
+    from distributed_inference_server_tpu.ops.quant import dense_view
+
+    return x @ dense_view(w, x.dtype)
+
+
+def _dq(w, dtype):
+    """Dense view of a possibly-quantized weight (einsum call sites)."""
+    from distributed_inference_server_tpu.ops.quant import dense_view
+
+    return dense_view(w, dtype)
+
+
 def _mlp(h: jnp.ndarray, layer: Dict[str, jnp.ndarray]) -> jnp.ndarray:
     """SwiGLU MLP: down( silu(gate(x)) * up(x) )."""
-    gate = jax.nn.silu(h @ layer["w_gate"])
-    up = h @ layer["w_up"]
-    return (gate * up) @ layer["w_down"]
+    gate = jax.nn.silu(_mm(h, layer["w_gate"]))
+    up = _mm(h, layer["w_up"])
+    return _mm(gate * up, layer["w_down"])
 
 
 def _moe_mlp(h: jnp.ndarray, layer: Dict[str, jnp.ndarray], cfg: ModelConfig):
@@ -142,9 +158,13 @@ def _moe_mlp(h: jnp.ndarray, layer: Dict[str, jnp.ndarray], cfg: ModelConfig):
     combine = jnp.zeros_like(router_logits)
     combine = combine.at[jnp.arange(x.shape[0])[:, None], idx].set(weights)
     # every expert on every token: [E, N, H] -> weighted sum
-    gate = jax.nn.silu(jnp.einsum("nh,ehi->eni", x, layer["w_gate"]))
-    up = jnp.einsum("nh,ehi->eni", x, layer["w_up"])
-    expert_out = jnp.einsum("eni,eih->enh", gate * up, layer["w_down"])
+    gate = jax.nn.silu(
+        jnp.einsum("nh,ehi->eni", x, _dq(layer["w_gate"], x.dtype))
+    )
+    up = jnp.einsum("nh,ehi->eni", x, _dq(layer["w_up"], x.dtype))
+    expert_out = jnp.einsum(
+        "eni,eih->enh", gate * up, _dq(layer["w_down"], x.dtype)
+    )
     out = jnp.einsum("enh,ne->nh", expert_out, combine.astype(expert_out.dtype))
     return out.reshape(B, T, H)
 
@@ -229,15 +249,15 @@ def layer_block(
     parallel runner (parallel/pp.py) can drive per-stage layer stacks."""
     B, T, _ = h.shape
     x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
-    q = (x @ layer["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
-    k = (x @ layer["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-    v = (x @ layer["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    q = _mm(x, layer["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = _mm(x, layer["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = _mm(x, layer["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
     k_layer = write_fn(k_layer, k)
     v_layer = write_fn(v_layer, v)
     attn = attend_fn(q, k_layer, v_layer)
-    h = h + attn.reshape(B, T, cfg.q_size) @ layer["wo"]
+    h = h + _mm(attn.reshape(B, T, cfg.q_size), layer["wo"])
     x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
     h = h + (
         _moe(x, layer, cfg, moe_impl, valid_tokens)

@@ -98,9 +98,19 @@ def moe_mlp_ep(
         expert_in = lax.with_sharding_constraint(
             expert_in, P("expert", None, None)
         )
-    gate = jax.nn.silu(jnp.einsum("ech,ehi->eci", expert_in, layer["w_gate"]))
-    up = jnp.einsum("ech,ehi->eci", expert_in, layer["w_up"])
-    expert_out = jnp.einsum("eci,eih->ech", gate * up, layer["w_down"])
+    from distributed_inference_server_tpu.ops.quant import dense_view
+
+    gate = jax.nn.silu(
+        jnp.einsum(
+            "ech,ehi->eci", expert_in, dense_view(layer["w_gate"], x.dtype)
+        )
+    )
+    up = jnp.einsum(
+        "ech,ehi->eci", expert_in, dense_view(layer["w_up"], x.dtype)
+    )
+    expert_out = jnp.einsum(
+        "eci,eih->ech", gate * up, dense_view(layer["w_down"], x.dtype)
+    )
     if shard_experts:
         expert_out = lax.with_sharding_constraint(
             expert_out, P("expert", None, None)

@@ -1,0 +1,145 @@
+"""Weight quantization: symmetric per-group int8 and packed int4.
+
+The reference planned quantized inference through llama.cpp's GGUF levels
+(F32/F16/Q8_0/Q4_0/Q4_K_M — design.md:324-332 [spec]). The TPU-native
+equivalents are weight-only int8 ("Q8_0"-class) and group-wise packed
+int4 ("Q4_0"-class): weights live in HBM at 1/2 or 1/4 the bytes — decode
+is HBM-bandwidth-bound, so weight bytes ≈ step time — and are dequantized
+on the fly; XLA fuses the convert+scale into the matmul's operand read,
+so nothing dense is materialized in HBM.
+
+Representation: ``Q8Tensor``/``Q4Tensor`` NamedTuples (valid JAX pytrees,
+so they ride through ``lax.scan`` layer stacking, ``jax.jit``, and
+``shard_params`` unchanged). Scales are per (input-group, out-column),
+group size along the input (contraction) axis. int4 packs two values per
+byte along the input axis.
+
+``quantize_params`` converts a Llama/Mixtral parameter tree's seven
+linear families; embeddings/norms/unembedding stay full precision (they
+are small and accuracy-critical).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Union
+
+import jax.numpy as jnp
+
+_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+class Q8Tensor(NamedTuple):
+    """int8 weight [..., in, out] + f32 scales [..., in/G, out]."""
+
+    q: jnp.ndarray
+    s: jnp.ndarray
+
+
+class Q4Tensor(NamedTuple):
+    """packed uint8 weight [..., in/2, out] (two int4 along the input
+    axis) + f32 scales [..., in/G, out]."""
+
+    q: jnp.ndarray
+    s: jnp.ndarray
+
+
+QuantTensor = Union[Q8Tensor, Q4Tensor]
+
+
+def _group_scales(w: jnp.ndarray, group_size: int, qmax: int) -> jnp.ndarray:
+    *lead, d_in, d_out = w.shape
+    g = w.reshape(*lead, d_in // group_size, group_size, d_out)
+    absmax = jnp.max(jnp.abs(g.astype(jnp.float32)), axis=-2)
+    return jnp.maximum(absmax, 1e-8) / qmax  # [..., G, out]
+
+
+def quantize_int8(w: jnp.ndarray, group_size: int = 128) -> Q8Tensor:
+    """Symmetric int8 over input-axis groups. w: [..., in, out]."""
+    *lead, d_in, d_out = w.shape
+    gs = min(group_size, d_in)
+    if d_in % gs:
+        raise ValueError(f"group_size {gs} does not divide in-dim {d_in}")
+    s = _group_scales(w, gs, 127)
+    g = w.astype(jnp.float32).reshape(*lead, d_in // gs, gs, d_out)
+    q = jnp.clip(jnp.round(g / s[..., None, :]), -127, 127).astype(jnp.int8)
+    return Q8Tensor(q=q.reshape(*lead, d_in, d_out), s=s)
+
+
+def quantize_int4(w: jnp.ndarray, group_size: int = 64) -> Q4Tensor:
+    """Symmetric int4 (range [-7, 7]) over input-axis groups, packed two
+    values per byte along the input axis. w: [..., in, out], in even."""
+    *lead, d_in, d_out = w.shape
+    gs = min(group_size, d_in)
+    if d_in % gs or d_in % 2:
+        raise ValueError(
+            f"int4 needs even in-dim divisible by group {gs}, got {d_in}"
+        )
+    s = _group_scales(w, gs, 7)
+    g = w.astype(jnp.float32).reshape(*lead, d_in // gs, gs, d_out)
+    q = jnp.clip(jnp.round(g / s[..., None, :]), -7, 7).astype(jnp.int8)
+    q = q.reshape(*lead, d_in, d_out)
+    # pack adjacent input rows: low nibble = even row, high nibble = odd
+    even = q[..., 0::2, :].astype(jnp.uint8) & 0xF
+    odd = q[..., 1::2, :].astype(jnp.uint8) & 0xF
+    return Q4Tensor(q=(odd << 4) | even, s=s)
+
+
+def dequantize(w: QuantTensor, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Dense [..., in, out] weight; under jit XLA fuses this into the
+    consuming matmul (the HBM read stays int8/int4)."""
+    if isinstance(w, Q4Tensor):
+        packed = w.q
+        low = (packed & 0xF).astype(jnp.int8)
+        high = (packed >> 4).astype(jnp.int8)
+        # sign-extend nibbles: values were clipped to [-7, 7]
+        low = jnp.where(low > 7, low - 16, low)
+        high = jnp.where(high > 7, high - 16, high)
+        *lead, half, d_out = packed.shape
+        q = jnp.stack([low, high], axis=-2)  # [..., half, 2, out]
+        q = q.reshape(*lead, half * 2, d_out)
+    elif isinstance(w, Q8Tensor):
+        q = w.q
+    else:
+        return w.astype(dtype) if w.dtype != dtype else w
+    *lead, d_in, d_out = q.shape
+    groups = w.s.shape[-2]
+    gs = d_in // groups
+    deq = (
+        q.astype(jnp.float32).reshape(*lead, groups, gs, d_out)
+        * w.s[..., None, :]
+    )
+    return deq.reshape(*lead, d_in, d_out).astype(dtype)
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, (Q8Tensor, Q4Tensor))
+
+
+def dense_view(w: Any, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Dense array for a possibly-quantized weight (pass-through for plain
+    arrays) — the single dispatch point for matmul/einsum call sites."""
+    return dequantize(w, dtype) if is_quantized(w) else w
+
+
+def quantize_params(
+    params: Dict[str, Any], mode: str, group_size: int = 0
+) -> Dict[str, Any]:
+    """Quantize a Llama/Mixtral parameter tree's linear weights.
+
+    mode: "int8" | "int4" | "none". Stacked layouts ([L, in, out] and MoE
+    [L, E, in, out]) quantize directly — groups run along the input axis.
+    """
+    if mode == "none":
+        return params
+    if mode == "int8":
+        fn = lambda w: quantize_int8(w, group_size or 128)
+    elif mode == "int4":
+        fn = lambda w: quantize_int4(w, group_size or 64)
+    else:
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    out = dict(params)
+    out["layers"] = {
+        k: (fn(v) if k in _QUANT_KEYS else v)
+        for k, v in params["layers"].items()
+    }
+    return out

@@ -94,10 +94,28 @@ def kv_pool_spec() -> P:
 def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
     """Place parameters onto the mesh per the TP layout (the weight-loading
     "restore" path — SURVEY.md §5 checkpoint/resume equivalent: safetensors
-    → host → sharded device buffers)."""
+    → host → sharded device buffers). Quantized weights (ops/quant.py)
+    shard q and scales with the same spec: both are [..., in-ish, out], so
+    column/row-parallel axes line up."""
+    from distributed_inference_server_tpu.ops.quant import is_quantized
+
     specs = llama_param_specs(cfg)
-    shardings = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), specs,
-        is_leaf=lambda x: isinstance(x, P),
+
+    def place(spec, leaf):
+        sh = NamedSharding(mesh, spec)
+        if is_quantized(leaf):
+            # scales are [..., groups, out]: the group axis replaces the
+            # weight's input axis and its count (in/group_size) need not
+            # divide tp — replicate that axis, keep the rest of the spec
+            # (scales are tiny; replication is free)
+            parts = list(spec) + [None] * (leaf.s.ndim - len(spec))
+            parts[-2] = None
+            s_sh = NamedSharding(mesh, P(*parts))
+            return type(leaf)(
+                q=jax.device_put(leaf.q, sh), s=jax.device_put(leaf.s, s_sh)
+            )
+        return jax.device_put(leaf, sh)
+
+    return jax.tree_util.tree_map(
+        place, specs, params, is_leaf=lambda x: isinstance(x, P)
     )
-    return jax.device_put(params, shardings)

@@ -51,6 +51,9 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "model_dir": (str, ""),
         "model_name": (str, "tiny"),
         "dtype": (str, "bfloat16"),
+        # weight-only quantization: none | int8 | int4 (ops/quant.py; the
+        # reference's GGUF quantization levels, design.md:324-332 [spec])
+        "quantization": (str, "none"),
     },
     "engine": {
         "tensor_parallel": (int, 1),
@@ -287,6 +290,11 @@ class ServerConfig:
             raise ConfigError(
                 f"model.dtype must be bfloat16/float32/float16, "
                 f"got {r['model']['dtype']!r}"
+            )
+        if r["model"]["quantization"] not in ("none", "int8", "int4"):
+            raise ConfigError(
+                f"model.quantization must be none/int8/int4, "
+                f"got {r['model']['quantization']!r}"
             )
 
     def hot_diff(self, other: "ServerConfig") -> Dict[tuple, Any]:
