@@ -190,6 +190,17 @@ def build_app(
             )
         return web.json_response({"status": "ok", "model": name})
 
+    async def trace(request: web.Request) -> web.Response:
+        tracer = getattr(handler, "tracer", None)
+        if tracer is None:
+            return web.json_response({"spans": []})
+        n = int(request.query.get("n", "100"))
+        trace_id = request.query.get("trace_id")
+        return web.json_response(
+            {"spans": [s.to_dict() for s in tracer.recent(n, trace_id)]}
+        )
+
+    app.router.add_get("/server/trace", trace)
     app.router.add_post("/admin/model-swap", model_swap)
     app.router.add_post("/generate", generate)
     app.router.add_post("/chat", chat)

@@ -74,8 +74,10 @@ class Dispatcher:
         metrics: Optional[MetricsCollector] = None,
         poll_interval_s: float = 0.002,
         native_queue: Optional[bool] = None,
+        tracer=None,
     ):
         self.scheduler = scheduler
+        self.tracer = tracer
         self.queue: PriorityQueueManager[ServerRequest] = _make_queue(
             queue_config, native_queue
         )
@@ -182,6 +184,18 @@ class Dispatcher:
             pad = (max(lens) * len(lens) / max(sum(lens), 1) - 1.0) if lens else 0.0
             self.metrics.record_batch(len(requests), max(0.0, pad))
         runner = self.scheduler.schedule()
+        if self.tracer:
+            # batching-phase span (S12): one per admission batch
+            with self.tracer.span(
+                "batch.dispatch",
+                size=len(requests),
+                engine_id=runner.engine_id if runner else None,
+                request_ids=[str(r.request_id) for r in requests],
+            ):
+                pass
+            for r in requests:
+                if r.span is not None:
+                    r.span.event("dispatched")
         if runner is None:
             # no healthy engine: fail the batch (Property 20 — graceful,
             # not silent)

@@ -79,12 +79,15 @@ class InferenceHandler:
         model_name: str,
         validator: Optional[RequestValidator] = None,
         metrics: Optional[MetricsCollector] = None,
+        tracer=None,
     ):
         self.dispatcher = dispatcher
         self.tok = tokenizer
         self.model_name = model_name
         self.validator = validator or RequestValidator()
         self.metrics = metrics
+        self.tracer = tracer
+        self._spans_by_request = {}
 
     # -- shared internals --------------------------------------------------
 
@@ -103,18 +106,40 @@ class InferenceHandler:
         params: SamplingParams,
         sink,
         priority: Priority,
+        endpoint: str = "generate",
     ) -> RequestId:
         request_id = new_request_id()
-        req = ServerRequest(request_id, prompt_ids, params, sink)
+        span = None
+        if self.tracer:
+            # request-lifecycle root span (S12, requirements.md:122);
+            # finished by _finish_span at completion/stream end
+            span = self.tracer.start(
+                f"request.{endpoint}", request_id=str(request_id),
+                prompt_tokens=len(prompt_ids), priority=priority.name,
+            )
+        req = ServerRequest(request_id, prompt_ids, params, sink, span=span)
         if self.metrics:
             self.metrics.request_started()
         try:
             self.dispatcher.submit(req, priority)
+            if span is not None:
+                span.event("queued")
         except QueueFull:
             if self.metrics:
                 self.metrics.request_finished()
+            if span is not None:
+                self.tracer.finish(span, status="rejected")
             raise QueueFullApiError() from None
+        if span is not None:
+            self._spans_by_request[request_id] = span
         return request_id
+
+    def _finish_span(self, request_id: RequestId, status: str) -> None:
+        if not self.tracer:
+            return
+        span = self._spans_by_request.pop(request_id, None)
+        if span is not None:
+            self.tracer.finish(span, status=status)
 
     async def _await_completion(self, sink: CollectingSink, request_id: RequestId):
         try:
@@ -122,10 +147,12 @@ class InferenceHandler:
         except asyncio.CancelledError:
             # client disconnected mid-generation: abort upstream (Req 5.4)
             self.dispatcher.abort(request_id)
+            self._finish_span(request_id, "cancelled")
             raise
         finally:
             if self.metrics:
                 self.metrics.request_finished()
+        self._finish_span(request_id, "ok" if err is None else "error")
         if err is not None:
             raise _error_to_api(err, code)
         return text, reason, usage
@@ -177,15 +204,21 @@ class InferenceHandler:
             sink,
             req.priority or Priority.NORMAL,
         )
-        return request_id, self._finalize_stream(sink)
+        return request_id, self._finalize_stream(sink, request_id)
 
-    async def _finalize_stream(self, sink: StreamingSink):
+    async def _finalize_stream(self, sink: StreamingSink,
+                               request_id: RequestId):
+        status = "ok"
         try:
             async for event in sink.events():
                 yield event
+        except BaseException:
+            status = "error"
+            raise
         finally:
             if self.metrics:
                 self.metrics.request_finished()
+            self._finish_span(request_id, status)
 
     # -- /chat -------------------------------------------------------------
 
@@ -242,7 +275,7 @@ class InferenceHandler:
             sink,
             Priority.NORMAL,
         )
-        return request_id, self._finalize_stream(sink)
+        return request_id, self._finalize_stream(sink, request_id)
 
     # -- /embeddings -------------------------------------------------------
 

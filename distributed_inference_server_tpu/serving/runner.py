@@ -55,7 +55,7 @@ class ServerRequest:
     """A validated, tokenized request handed to the serving spine."""
 
     __slots__ = ("request_id", "prompt_ids", "params", "sink", "submitted_at",
-                 "first_token_at")
+                 "first_token_at", "span", "engine_span")
 
     def __init__(
         self,
@@ -63,6 +63,7 @@ class ServerRequest:
         prompt_ids: List[int],
         params: SamplingParams,
         sink: ResultSink,
+        span=None,
     ):
         self.request_id = request_id
         self.prompt_ids = prompt_ids
@@ -70,6 +71,10 @@ class ServerRequest:
         self.sink = sink
         self.submitted_at = time.monotonic()
         self.first_token_at: Optional[float] = None
+        # request-lifecycle tracing (S12): root span owned by the handler,
+        # engine child span owned by the runner
+        self.span = span
+        self.engine_span = None
 
 
 class EngineRunner:
@@ -80,10 +85,12 @@ class EngineRunner:
         engine_id: str,
         engine_factory: Callable[[], LLMEngine],
         metrics: Optional[MetricsCollector] = None,
+        tracer=None,
     ):
         self.engine_id = engine_id
         self._factory = engine_factory
         self.metrics = metrics
+        self.tracer = tracer
         self._inbox: Deque[Callable[[], None]] = deque()
         self._inbox_lock = threading.Lock()
         self._wake = threading.Event()
@@ -155,6 +162,12 @@ class EngineRunner:
         def _do() -> None:
             for r in reqs:
                 if r.request_id in self._inflight:  # not aborted meanwhile
+                    if self.tracer and r.span is not None:
+                        r.engine_span = self.tracer.start(
+                            "engine.infer", parent=r.span.context(),
+                            engine_id=self.engine_id,
+                            prompt_tokens=len(r.prompt_ids),
+                        )
                     self._engine.add_request(r.request_id, r.prompt_ids, r.params)
 
         self._post(_do)
@@ -385,6 +398,8 @@ class EngineRunner:
                             self.metrics.record_ttft(
                                 req.first_token_at - req.submitted_at
                             )
+                        if req.engine_span is not None:
+                            req.engine_span.event("first_token")
                     if out.token_id is not None:
                         tokens += 1
                     if not out.finished:
@@ -397,6 +412,15 @@ class EngineRunner:
                         req.sink.on_done(
                             out.finish_reason or FinishReason.STOP,
                             out.usage or Usage(),
+                        )
+                    if self.tracer and req.engine_span is not None:
+                        if out.usage is not None:
+                            req.engine_span.set(
+                                completion_tokens=out.usage.completion_tokens
+                            )
+                        self.tracer.finish(
+                            req.engine_span,
+                            status="ok" if out.error is None else "error",
                         )
                     self._inflight.pop(out.request_id, None)
                     self._total_processed += 1
@@ -440,4 +464,7 @@ class EngineRunner:
                 req.sink.on_error(message, "worker_failure")
             except Exception:  # noqa: BLE001
                 pass
+            if self.tracer and req.engine_span is not None:
+                self.tracer.finish(req.engine_span, status="error")
+                req.engine_span = None
             self._inflight.pop(req.request_id, None)

@@ -69,9 +69,12 @@ class InferenceServer:
     ):
         """``model_resolver(name) -> engine_factory`` enables the admin
         model-swap endpoint (Req 13); None leaves it unconfigured (501)."""
+        from distributed_inference_server_tpu.utils.tracing import Tracer
+
         self.engine_factory = engine_factory
         self.model_resolver = model_resolver
         self.metrics = MetricsCollector()
+        self.tracer = Tracer()
         self.scheduler = AdaptiveScheduler(
             strategy=strategy,
             health_check_interval_s=health_check_interval_s,
@@ -82,6 +85,7 @@ class InferenceServer:
             queue_config=queue_config,
             batcher_config=batcher_config,
             metrics=self.metrics,
+            tracer=self.tracer,
         )
         self.handler = InferenceHandler(
             self.dispatcher,
@@ -89,6 +93,7 @@ class InferenceServer:
             model_name,
             validator=RequestValidator(validator_config),
             metrics=self.metrics,
+            tracer=self.tracer,
         )
         from distributed_inference_server_tpu.serving.degradation import (
             DegradationController,
@@ -126,7 +131,8 @@ class InferenceServer:
         engine_id = f"engine-{idx}"
         self._next_engine_idx += 1
         runner = EngineRunner(
-            engine_id, _bind_factory(self.engine_factory, idx), self.metrics
+            engine_id, _bind_factory(self.engine_factory, idx), self.metrics,
+            tracer=self.tracer,
         )
         runner.start(wait_ready=wait_ready)
         self.scheduler.register(runner)

@@ -1,0 +1,154 @@
+"""Request-lifecycle tracing: OTel-style spans on the stdlib.
+
+The reference spec'd OpenTelemetry spans for request lifecycle, batching,
+inference, and streaming phases (S12; ``requirements.md:122``,
+``tasks.md:285-288`` [spec]). The opentelemetry SDK is not in this image,
+so this module provides the same span model — trace_id/span_id/parent,
+monotonic start/end, attributes, events — with two sinks: a bounded
+in-memory ring (introspection via ``/server/trace``) and optional logging.
+If an OTel SDK is present at runtime it can be bridged by replacing the
+exporter (``Tracer.exporters``), keeping call sites unchanged.
+
+Cross-thread propagation is explicit: the serving spine hands a span's
+``context()`` across thread boundaries (HTTP asyncio -> dispatcher ->
+runner) instead of relying on contextvars, because requests hop threads.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import secrets
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, Iterator, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start_ns: int
+    end_ns: int = 0
+    attributes: Dict[str, object] = field(default_factory=dict)
+    events: List[Tuple[int, str]] = field(default_factory=list)
+    status: str = "ok"
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6 if self.end_ns else 0.0
+
+    def set(self, **attrs) -> "Span":
+        self.attributes.update(attrs)
+        return self
+
+    def event(self, name: str) -> None:
+        self.events.append((time.monotonic_ns(), name))
+
+    def context(self) -> Tuple[str, str]:
+        """(trace_id, span_id) to parent a child span on another thread."""
+        return (self.trace_id, self.span_id)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_ns": self.start_ns,
+            "duration_ms": self.duration_ms,
+            "attributes": self.attributes,
+            "events": [
+                {"offset_ms": (t - self.start_ns) / 1e6, "name": n}
+                for t, n in self.events
+            ],
+            "status": self.status,
+        }
+
+
+class Tracer:
+    """Span factory + bounded ring of finished spans."""
+
+    def __init__(self, capacity: int = 2048, log_spans: bool = False):
+        self._ring: Deque[Span] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.exporters: List[Callable[[Span], None]] = [self._to_ring]
+        if log_spans:
+            self.exporters.append(self._to_log)
+
+    # -- span lifecycle ----------------------------------------------------
+
+    def start(
+        self,
+        name: str,
+        parent: Optional[Tuple[str, str]] = None,
+        **attributes,
+    ) -> Span:
+        """Start a span; ``parent`` is a ``Span.context()`` tuple (or None
+        to begin a new trace)."""
+        trace_id = parent[0] if parent else secrets.token_hex(8)
+        return Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=secrets.token_hex(8),
+            parent_id=parent[1] if parent else None,
+            start_ns=time.monotonic_ns(),
+            attributes=dict(attributes),
+        )
+
+    def finish(self, span: Span, status: str = "ok") -> None:
+        span.end_ns = time.monotonic_ns()
+        span.status = status
+        for export in self.exporters:
+            try:
+                export(span)
+            except Exception:  # noqa: BLE001 — tracing must never break serving
+                pass
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        parent: Optional[Tuple[str, str]] = None,
+        **attributes,
+    ) -> Iterator[Span]:
+        s = self.start(name, parent=parent, **attributes)
+        try:
+            yield s
+        except BaseException:
+            self.finish(s, status="error")
+            raise
+        self.finish(s)
+
+    # -- sinks -------------------------------------------------------------
+
+    def _to_ring(self, span: Span) -> None:
+        with self._lock:
+            self._ring.append(span)
+
+    @staticmethod
+    def _to_log(span: Span) -> None:
+        log.info(
+            "span %s trace=%s %.2fms %s",
+            span.name, span.trace_id, span.duration_ms, span.attributes,
+        )
+
+    # -- introspection -----------------------------------------------------
+
+    def recent(self, n: int = 100,
+               trace_id: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            spans = list(self._ring)
+        if trace_id is not None:
+            spans = [s for s in spans if s.trace_id == trace_id]
+        return spans[-n:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()

@@ -1,0 +1,119 @@
+"""Request-lifecycle tracing (S12, requirements.md:122 [spec]): span
+model, ring sink, and end-to-end span trees through the serving spine."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from distributed_inference_server_tpu.utils.tracing import Tracer
+
+
+def test_span_parenting_and_ring():
+    t = Tracer(capacity=8)
+    with t.span("request", request_id="r1") as root:
+        root.event("queued")
+        with t.span("engine.infer", parent=root.context()) as child:
+            child.set(tokens=5)
+    spans = t.recent()
+    assert [s.name for s in spans] == ["engine.infer", "request"]
+    child, root = spans
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert root.parent_id is None
+    assert root.duration_ms >= child.duration_ms >= 0
+    assert root.events and root.events[0][1] == "queued"
+
+
+def test_span_error_status_and_capacity():
+    t = Tracer(capacity=3)
+    with pytest.raises(ValueError):
+        with t.span("boom"):
+            raise ValueError("x")
+    assert t.recent()[-1].status == "error"
+    for i in range(5):
+        with t.span(f"s{i}"):
+            pass
+    assert len(t.recent()) == 3  # bounded ring
+
+
+def test_trace_filter():
+    t = Tracer()
+    with t.span("a") as a:
+        pass
+    with t.span("b"):
+        pass
+    only_a = t.recent(trace_id=a.trace_id)
+    assert [s.name for s in only_a] == ["a"]
+
+
+@pytest.fixture(scope="module")
+def server():
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.server import InferenceServer
+
+    def factory():
+        params = llama.init_params(jax.random.PRNGKey(0), TINY,
+                                   dtype=jnp.float32)
+        return LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(max_batch=4, prefill_buckets=(16, 64),
+                         paged=PagedCacheConfig(num_pages=64, page_size=8,
+                                                max_pages_per_seq=16)),
+            dtype=jnp.float32,
+        )
+
+    srv = InferenceServer(factory, ByteTokenizer(), model_name="tiny",
+                          num_engines=1, auto_restart=False)
+    srv.start()
+    yield srv
+    srv.shutdown(drain_timeout_s=5.0)
+
+
+def _run(server, coro_fn):
+    async def main():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(main())
+
+
+def test_request_produces_span_tree(server):
+    async def go(client):
+        resp = await client.post(
+            "/generate",
+            json={"prompt": "trace me", "max_tokens": 4, "temperature": 0.0},
+        )
+        assert resp.status == 200
+        tr = await (await client.get("/server/trace?n=50")).json()
+        return tr["spans"]
+
+    spans = _run(server, go)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    assert "request.generate" in by_name
+    assert "engine.infer" in by_name
+    assert "batch.dispatch" in by_name
+    root = by_name["request.generate"][-1]
+    engine = by_name["engine.infer"][-1]
+    assert engine["trace_id"] == root["trace_id"]
+    assert engine["parent_id"] == root["span_id"]
+    assert root["status"] == "ok"
+    assert any(e["name"] == "queued" for e in root["events"])
+    assert any(e["name"] == "dispatched" for e in root["events"])
+    assert any(e["name"] == "first_token" for e in engine["events"])
+    assert engine["attributes"]["completion_tokens"] == 4
