@@ -9,7 +9,9 @@ Prints ONE JSON line:
 vs_baseline is against the north-star 2000 output tok/s/chip target
 (BASELINE.json; the reference itself publishes no numbers — BASELINE.md).
 
-Env knobs: BENCH_BATCH (8), BENCH_PROMPT (128), BENCH_NEW (128),
+Env knobs: BENCH_BATCH (32), BENCH_PROMPT (128), BENCH_NEW (128),
+BENCH_BLOCK (16, decode steps per device block), BENCH_PIPELINE (1,
+blocks in flight), BENCH_IMPL (auto|pallas|xla decode attention),
 BENCH_FORCE_CPU=1 (tiny-model smoke mode), BENCH_INIT_TIMEOUT_S (180).
 """
 
@@ -28,9 +30,12 @@ def _emit(obj) -> None:
 
 def main() -> None:
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
-    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
     prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
     new_tokens = int(os.environ.get("BENCH_NEW", "128"))
+    block = int(os.environ.get("BENCH_BLOCK", "16"))
+    pipeline = int(os.environ.get("BENCH_PIPELINE", "1"))
+    impl = os.environ.get("BENCH_IMPL", "auto")
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "180"))
 
     # Watchdog: the single real TPU chip sits behind a one-process tunnel;
@@ -91,7 +96,11 @@ def main() -> None:
     jax.block_until_ready(params)
     engine = LLMEngine(
         params, cfg, ByteTokenizer(),
-        EngineConfig(max_batch=batch, prefill_buckets=buckets, paged=paged),
+        EngineConfig(
+            max_batch=batch, prefill_buckets=buckets, paged=paged,
+            attention_impl=impl, decode_block_size=block,
+            pipeline_depth=pipeline,
+        ),
         dtype=dtype,
     )
 
@@ -102,25 +111,32 @@ def main() -> None:
         engine.add_request(rid, ids, SamplingParams(
             max_tokens=n_new, temperature=0.0, top_p=1.0))
 
-    def drain():
+    def drain(t_start=None, first_token_at=None):
         tokens = 0
         while engine.has_work():
             for out in engine.step():
                 if out.token_id is not None:
                     tokens += 1
+                    if first_token_at is not None and \
+                            out.request_id not in first_token_at:
+                        first_token_at[out.request_id] = (
+                            time.perf_counter() - t_start)
         return tokens
 
-    # warm-up: compiles the prefill bucket + decode step
-    add("warmup", 4)
+    # warm-up: compiles the prefill bucket + decode block
+    add("warmup", max(4, block + 1))
     drain()
 
     for i in range(batch):
         add(f"r{i}", new_tokens)
+    ttfts = {}
     t0 = time.perf_counter()
-    produced = drain()
+    produced = drain(t0, ttfts)
     elapsed = time.perf_counter() - t0
 
     tput = produced / elapsed
+    ttft_sorted = sorted(ttfts.values())
+    p50_ttft = ttft_sorted[len(ttft_sorted) // 2] if ttft_sorted else 0.0
     _emit({
         "metric": "decode_tokens_per_sec_llama1b_bf16"
         if not force_cpu else "decode_tokens_per_sec_tiny_cpu",
@@ -131,8 +147,16 @@ def main() -> None:
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
+        "decode_block": block,
+        "pipeline_depth": pipeline,
+        "attention_impl": impl,
         "total_tokens": produced,
         "elapsed_s": round(elapsed, 3),
+        "p50_ttft_s": round(p50_ttft, 3),
+        "p99_ttft_s": round(
+            ttft_sorted[min(len(ttft_sorted) - 1, int(0.99 * len(ttft_sorted)))],
+            3,
+        ) if ttft_sorted else 0.0,
     })
 
 

@@ -16,6 +16,11 @@ redesigned for XLA's compilation model:
   warm-compiled at startup, instead of XLA recompiling per request mix.
 - **On-device sampling** fused into the decode step (temperature/top-p per
   slot) so tokens — not logits — cross the host boundary each step.
+- **Block decode + pipelining**: decode runs as compiled K-step blocks
+  (``lax.scan`` with on-device EOS/length masking and carried device state)
+  and the host consumes block N-1's tokens while block N executes — one
+  [K, B] token download per block instead of the per-token blocking sync
+  the reference's host-driven loop implies (design.md:660-674 [spec]).
 - **Prefix reuse + LRU** via the PageAllocator (Properties 9-11), with
   on-demand page allocation during decode and preemption (youngest slot
   returns to the queue, pages released) when the pool runs dry.
@@ -37,6 +42,7 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from distributed_inference_server_tpu.core.errors import CacheFull
 from distributed_inference_server_tpu.core.models import FinishReason, Usage
@@ -91,6 +97,17 @@ class EngineConfig:
     # host-side page allocator: None = native C++ (native/allocator.cpp)
     # when the library builds, Python fallback otherwise; True/False force
     native_allocator: Optional[bool] = None
+    # decode steps per compiled block: the host pays one device round-trip
+    # per block, not per token (the reference's per-token host loop,
+    # design.md:660-674 [spec], is hot-path poison on TPU — each sync costs
+    # a full host<->device round trip). EOS/length stopping is masked
+    # on-device inside the block.
+    decode_block_size: int = 8
+    # blocks kept in flight beyond the one being processed: with depth 1
+    # the host consumes block N-1's tokens while the device runs block N,
+    # hiding the round-trip entirely. 0 = synchronous (fetch each block
+    # right after launch).
+    pipeline_depth: int = 1
 
 
 @dataclass
@@ -113,7 +130,7 @@ class _Seq:
     __slots__ = (
         "request_id", "token_ids", "prompt_len", "block_table",
         "seq_len", "next_token", "params", "output_text", "emitted_upto",
-        "emitted_tokens",
+        "emitted_tokens", "dev_pos", "dev_steps_left",
     )
 
     def __init__(self, request_id: RequestId, prompt_ids: List[int],
@@ -128,6 +145,10 @@ class _Seq:
         self.output_text = ""
         self.emitted_upto = 0
         self.emitted_tokens = 0
+        # device-side projections (host view lags by the in-flight blocks):
+        # upper bound on the device row's position, and launch budget left
+        self.dev_pos = 0
+        self.dev_steps_left = 0
 
     def num_output_tokens(self) -> int:
         return len(self.token_ids) - self.prompt_len
@@ -187,14 +208,29 @@ class LLMEngine:
         self._rng = jax.random.PRNGKey(self.ecfg.seed)
         self._num_slots_flat = self.pcfg.num_pages * self.pcfg.page_size
         self._smax = self.pcfg.max_pages_per_seq * self.pcfg.page_size
-        # per-slot gather rows, maintained incrementally as block tables
-        # grow (a full [B, S_max] rebuild per step is hot-path poison)
-        self._gather_rows = np.zeros((self.ecfg.max_batch, self._smax), np.int32)
-        self._gather_pages = np.zeros((self.ecfg.max_batch,), np.int32)
+
+        # --- decode-block state ---
+        # Host mirror of per-slot block tables / sampling params, uploaded
+        # at each block launch (tiny arrays; uploads are async, unlike the
+        # per-token download the r1 loop blocked on).
+        B = self.ecfg.max_batch
+        self._bt = np.zeros((B, self.pcfg.max_pages_per_seq), np.int32)
+        self._bt_pages = np.zeros((B,), np.int32)
+        self._temp = np.ones((B,), np.float32)
+        self._topp = np.ones((B,), np.float32)
+        # slot -> (active, token, position, steps) overrides merged into the
+        # device carry at the next launch (admissions and deactivations)
+        self._slot_updates: Dict[int, Tuple[bool, int, int, int]] = {}
+        # device-carried decode state: (tokens, positions, steps_left,
+        # active, rng) — created at first launch, never fetched to host
+        self._carry = None
+        # launched-but-unprocessed blocks: (out_tokens [K, B] device array,
+        # [(slot, seq)] snapshot at launch)
+        self._pending: Deque[Tuple[jnp.ndarray, List[Tuple[int, _Seq]]]] = deque()
 
         # jit caches
         self._prefill_fns: Dict[int, Callable] = {}
-        self._decode_fn = self._build_decode()
+        self._block_fn = self._build_decode_block()
         self._sample_fn = jax.jit(sample_tokens)
 
     # ------------------------------------------------------------------
@@ -214,7 +250,12 @@ class LLMEngine:
 
     def abort(self, request_id: RequestId) -> bool:
         """Abort a queued or running request (client disconnect,
-        Req 5.4 requirements.md:85). Returns True if found."""
+        Req 5.4 requirements.md:85). Returns True if found.
+
+        Pages are released immediately; an in-flight decode block may still
+        write into them, but that is safe: a reader only ever gathers slots
+        its own sequence has already written (positions < kv_valid), and
+        the new owner's prefill is enqueued after the in-flight block."""
         seq = self._by_id.pop(request_id, None)
         if seq is None:
             return False
@@ -223,6 +264,7 @@ class LLMEngine:
         for i, s in enumerate(self.slots):
             if s is seq:
                 self.slots[i] = None
+                self._deact_slot(i)
         self._release_seq(seq)
         return True
 
@@ -236,11 +278,19 @@ class LLMEngine:
         return len(self.waiting)
 
     def step(self) -> List[StepOutput]:
-        """Admit waiting requests into free slots (prefill), then run one
-        decode step for all active slots. Returns emitted events."""
+        """One engine iteration: admit waiting requests into free slots
+        (prefill + first sampled token), launch a decode block (K on-device
+        steps, async), and consume the oldest pending block's tokens once
+        the pipeline is full (or nothing new was launched). Token events
+        therefore arrive in bursts of up to ``decode_block_size`` per
+        sequence, ``pipeline_depth`` blocks behind the device."""
         outputs: List[StepOutput] = []
         self._admit(outputs)
-        self._decode(outputs)
+        launched = self._maybe_launch(outputs)
+        if self._pending and (
+            len(self._pending) > self.ecfg.pipeline_depth or not launched
+        ):
+            self._process_block(outputs)
         return outputs
 
     def cache_stats(self):
@@ -287,7 +337,7 @@ class LLMEngine:
             self.waiting.popleft()
             if seq.request_id in self._by_id:  # not finished during prefill
                 self.slots[slot] = seq
-                self._refresh_gather_row(slot, seq, from_page=0)
+                self._stage_seat(slot, seq)
 
     def _prefill_seq(self, seq: _Seq, outputs: List[StepOutput]) -> None:
         ps = self.pcfg.page_size
@@ -407,7 +457,16 @@ class LLMEngine:
     # decode
     # ------------------------------------------------------------------
 
-    def _build_decode(self) -> Callable:
+    def _build_decode_block(self) -> Callable:
+        """Compile the K-step decode block.
+
+        The whole continuous-batching decode inner loop lives on device: a
+        ``lax.scan`` of K model steps with on-device sampling, EOS masking,
+        per-row length budgets, and block-table slot arithmetic. The host
+        contributes only tiny async uploads (block tables, sampling params,
+        admission injections) and one token download of [K, B] ids per
+        block — the r1 design's per-step blocking ``np.asarray`` (measured
+        at 72-107 ms/step of pure host sync on the real chip) is gone."""
         cfg = self.cfg
         impl = self.ecfg.attention_impl
         if impl not in ("auto", "pallas", "xla"):
@@ -415,84 +474,229 @@ class LLMEngine:
                 f"attention_impl must be 'auto', 'pallas' or 'xla', "
                 f"got {impl!r}"
             )
+        if self.ecfg.decode_block_size < 1:
+            raise ValueError(
+                f"decode_block_size must be >= 1, got "
+                f"{self.ecfg.decode_block_size}"
+            )
+        if self.ecfg.pipeline_depth < 0:
+            raise ValueError(
+                f"pipeline_depth must be >= 0, got "
+                f"{self.ecfg.pipeline_depth}"
+            )
         if impl == "auto":
             impl = "pallas" if jax.default_backend() == "tpu" else "xla"
-        page_size = self.pcfg.page_size
+        ps = self.pcfg.page_size
+        K = self.ecfg.decode_block_size
+        smax = self._smax
+        num_slots = self._num_slots_flat
         moe_impl = self._moe_impl()
         mesh = self.mesh
+        eos = jnp.asarray(sorted(self.tok.eos_ids), jnp.int32)
 
-        @functools.partial(jax.jit, donate_argnums=(2, 3))
-        def decode(params, tokens, pool_k, pool_v, positions, write_slots,
-                   gather_slots, kv_valid_len, temperature, top_p, rng):
-            logits, k, v = llama.paged_forward(
-                params, cfg, tokens, positions, pool_k, pool_v,
-                write_slots, gather_slots, kv_valid_len,
-                attention_impl=impl, page_size=page_size, moe_impl=moe_impl,
-                mesh=mesh,
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6, 10))
+        def block(params, pool_k, pool_v, tokens, positions, steps_left,
+                  active, block_tables, temp, top_p, rng,
+                  set_mask, set_active, set_tokens, set_positions, set_steps):
+            # merge host overrides (admissions / deactivations) into carry
+            tokens = jnp.where(set_mask, set_tokens, tokens)
+            positions = jnp.where(set_mask, set_positions, positions)
+            steps_left = jnp.where(set_mask, set_steps, steps_left)
+            active = jnp.where(set_mask, set_active, active)
+
+            # gather rows from the block tables — tables are frozen for the
+            # duration of the block (pages pre-allocated at launch)
+            offs = jnp.arange(smax, dtype=jnp.int32)
+            gather = block_tables[:, offs // ps] * ps + offs % ps  # [B, smax]
+            rows = jnp.arange(block_tables.shape[0])
+
+            def one_step(carry, _):
+                tokens, positions, steps_left, active, pool_k, pool_v, rng = carry
+                page = block_tables[rows, positions // ps]
+                write = jnp.where(
+                    active, page * ps + positions % ps, num_slots
+                )[:, None]
+                kv_valid = jnp.where(active, positions + 1, 0)
+                logits, pool_k, pool_v = llama.paged_forward(
+                    params, cfg, tokens[:, None], positions[:, None],
+                    pool_k, pool_v, write, gather, kv_valid,
+                    attention_impl=impl, page_size=ps, moe_impl=moe_impl,
+                    mesh=mesh,
+                )
+                rng, sub = jax.random.split(rng)
+                nxt = sample_tokens(sub, logits[:, 0], temp, top_p)
+                out = jnp.where(active, nxt, -1)
+                is_eos = (
+                    (nxt[:, None] == eos[None, :]).any(-1)
+                    if eos.size
+                    else jnp.zeros_like(active)
+                )
+                positions = jnp.where(active, positions + 1, positions)
+                steps_left = jnp.where(active, steps_left - 1, steps_left)
+                tokens = jnp.where(active, nxt, tokens)
+                active = active & ~is_eos & (steps_left > 0)
+                return (tokens, positions, steps_left, active,
+                        pool_k, pool_v, rng), out
+
+            carry, outs = lax.scan(
+                one_step,
+                (tokens, positions, steps_left, active, pool_k, pool_v, rng),
+                None, length=K,
             )
-            next_tokens = sample_tokens(rng, logits[:, 0], temperature, top_p)
-            return next_tokens, k, v
+            tokens, positions, steps_left, active, pool_k, pool_v, rng = carry
+            return (outs, tokens, positions, steps_left, active,
+                    pool_k, pool_v, rng)
 
-        return self._with_mesh(decode)
+        return self._with_mesh(block)
 
-    def _decode(self, outputs: List[StepOutput]) -> None:
-        # Make sure every active row has a page for its next position,
-        # preempting the youngest sequence and restarting the check whenever
-        # the pool runs dry (each preemption removes one active row, so this
-        # terminates). Restarting from a fresh slot snapshot avoids touching
-        # sequences that were just preempted out.
+    def _stage_seat(self, slot: int, seq: _Seq) -> None:
+        """Stage a freshly prefetched sequence into a decode slot: its first
+        sampled token, position, and on-device step budget are injected into
+        the carry at the next block launch."""
+        budget = max(0, min(
+            seq.params.max_tokens - seq.emitted_tokens,
+            self.pcfg.max_seq_len - 1 - seq.seq_len,
+        ))
+        seq.dev_pos = seq.seq_len
+        seq.dev_steps_left = budget
+        self._slot_updates[slot] = (True, int(seq.next_token), seq.seq_len,
+                                    budget)
+        self._temp[slot] = seq.params.temperature
+        self._topp[slot] = seq.params.top_p
+        self._bt_pages[slot] = 0
+        self._refresh_bt_row(slot, seq)
+
+    def _deact_slot(self, slot: int) -> None:
+        self._slot_updates[slot] = (False, 0, 0, 0)
+
+    def _refresh_bt_row(self, slot: int, seq: _Seq) -> None:
+        table = seq.block_table[: self.pcfg.max_pages_per_seq]
+        start = int(self._bt_pages[slot])
+        if start > len(table):
+            start = 0
+        for p in range(start, len(table)):
+            self._bt[slot, p] = table[p]
+        self._bt_pages[slot] = len(table)
+
+    def _ensure_block_pages(self, seq: _Seq) -> None:
+        """Pre-allocate pages covering the next block's writes for this
+        sequence (positions dev_pos .. dev_pos+steps-1). Raises CacheFull."""
+        steps = min(self.ecfg.decode_block_size, seq.dev_steps_left)
+        if steps <= 0:
+            return
+        needed = (seq.dev_pos + steps - 1) // self.pcfg.page_size + 1
+        missing = min(needed, self.pcfg.max_pages_per_seq) - len(seq.block_table)
+        if missing > 0:
+            seq.block_table.extend(self.allocator.allocate(missing))
+
+    def _maybe_launch(self, outputs: List[StepOutput]) -> bool:
+        """Launch one decode block if any seated row has budget left or a
+        host override is staged. Handles page pressure by draining the
+        pipeline (finished rows release pages) and then preempting the
+        youngest sequence, exactly once per launch attempt."""
         while True:
-            active = [(i, s) for i, s in enumerate(self.slots) if s is not None]
-            if not active:
-                return
-            if all(self._ensure_page(seq) for _, seq in active):
-                break
-            self._preempt_youngest(outputs)
-        for i, seq in active:
-            if self._gather_pages[i] != len(seq.block_table):
-                self._refresh_gather_row(i, seq,
-                                         from_page=int(self._gather_pages[i]))
-
-        B = self.ecfg.max_batch
-        tokens = np.zeros((B, 1), np.int32)
-        positions = np.zeros((B, 1), np.int32)
-        write_slots = np.full((B, 1), self._num_slots_flat, np.int32)  # drop
-        kv_valid = np.zeros((B,), np.int32)
-        temp = np.ones((B,), np.float32)
-        top_p = np.ones((B,), np.float32)
-
-        for i, seq in active:
-            tokens[i, 0] = seq.next_token
-            positions[i, 0] = seq.seq_len
-            write_slots[i, 0] = self._slot_for_position(seq.block_table, seq.seq_len)
-            kv_valid[i] = seq.seq_len + 1
-            temp[i] = seq.params.temperature
-            top_p[i] = seq.params.top_p
-
-        gather = self._gather_rows
-        self._rng, sub = jax.random.split(self._rng)
-        next_tokens, self.state.k, self.state.v = self._decode_fn(
-            self.params,
-            jnp.asarray(tokens),
-            self.state.k,
-            self.state.v,
-            jnp.asarray(positions),
-            jnp.asarray(write_slots),
-            jnp.asarray(gather),
-            jnp.asarray(kv_valid),
-            jnp.asarray(temp),
-            jnp.asarray(top_p),
-            sub,
-        )
-        next_np = np.asarray(next_tokens)
-
-        for i, seq in active:
+            seated = [(i, s) for i, s in enumerate(self.slots)
+                      if s is not None]
+            # launch only if some row will actually decode; deact-only
+            # updates stay staged until the next real launch
+            if not any(u[0] for u in self._slot_updates.values()) and not any(
+                s.dev_steps_left > 0 for _, s in seated
+            ):
+                return False
             try:
-                seq.token_ids.append(seq.next_token)
-                seq.seq_len += 1
-                self._emit_token(seq, int(next_np[i]), outputs)
+                for _, s in seated:
+                    self._ensure_block_pages(s)
+                break
+            except CacheFull:
+                if self._pending:
+                    self._drain_pending(outputs)
+                    continue  # finished rows may have released pages
+                if seated:
+                    self._preempt_youngest(outputs)
+                    continue
+                return False
+        for i, s in seated:
+            if self._bt_pages[i] != len(s.block_table):
+                self._refresh_bt_row(i, s)
+        self._launch(seated)
+        for _, s in seated:
+            adv = min(self.ecfg.decode_block_size, s.dev_steps_left)
+            s.dev_pos += adv
+            s.dev_steps_left -= adv
+        return True
+
+    def _launch(self, seated: List[Tuple[int, _Seq]]) -> None:
+        B = self.ecfg.max_batch
+        set_mask = np.zeros((B,), bool)
+        set_active = np.zeros((B,), bool)
+        set_tokens = np.zeros((B,), np.int32)
+        set_pos = np.zeros((B,), np.int32)
+        set_steps = np.zeros((B,), np.int32)
+        for slot, (act, tok, pos, steps) in self._slot_updates.items():
+            set_mask[slot] = True
+            set_active[slot] = act
+            set_tokens[slot] = tok
+            set_pos[slot] = pos
+            set_steps[slot] = steps
+        self._slot_updates.clear()
+
+        if self._carry is None:
+            self._carry = (
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), bool),
+                jax.random.PRNGKey(self.ecfg.seed + 1),
+            )
+        tokens, positions, steps_left, active, rng = self._carry
+        (outs, tokens, positions, steps_left, active,
+         self.state.k, self.state.v, rng) = self._block_fn(
+            self.params, self.state.k, self.state.v,
+            tokens, positions, steps_left, active,
+            jnp.asarray(self._bt), jnp.asarray(self._temp),
+            jnp.asarray(self._topp), rng,
+            jnp.asarray(set_mask), jnp.asarray(set_active),
+            jnp.asarray(set_tokens), jnp.asarray(set_pos),
+            jnp.asarray(set_steps),
+        )
+        self._carry = (tokens, positions, steps_left, active, rng)
+        self._pending.append((outs, list(seated)))
+
+    def _drain_pending(self, outputs: List[StepOutput]) -> None:
+        """Process every in-flight block. Afterwards the host view is exact
+        (device position == seq.seq_len, carry token == seq.next_token for
+        every live row), which preemption requires."""
+        while self._pending:
+            self._process_block(outputs)
+
+    def _process_block(self, outputs: List[StepOutput]) -> None:
+        """Consume the oldest pending block: walk each row's sampled tokens
+        through the same emission path as r1's per-step loop (EOS / stop-
+        sequence / length finishing, streaming deltas, failure isolation)."""
+        outs, snapshot = self._pending.popleft()
+        toks = np.asarray(outs)  # the only blocking device read per block
+        K = toks.shape[0]
+        for slot, seq in snapshot:
+            if self._by_id.get(seq.request_id) is not seq:
+                continue  # finished or aborted while the block was in flight
+            try:
+                for k in range(K):
+                    t = int(toks[k, slot])
+                    if t < 0:
+                        break  # row was frozen on-device before this step
+                    seq.token_ids.append(seq.next_token)
+                    seq.seq_len += 1
+                    self._emit_token(seq, t, outputs)
+                    if self._by_id.get(seq.request_id) is not seq:
+                        # finished (EOS/stop/length): the device row may
+                        # still be live (stop sequences are host-only) —
+                        # deactivate it at the next launch
+                        self._deact_slot(slot)
+                        break
             except Exception as e:  # failure isolation (Property 22)
-                self.slots[i] = None
+                if self.slots[slot] is seq:
+                    self.slots[slot] = None
+                self._deact_slot(slot)
                 self._by_id.pop(seq.request_id, None)
                 self._release_seq(seq)
                 outputs.append(StepOutput(
@@ -584,21 +788,6 @@ class LLMEngine:
     # paging helpers
     # ------------------------------------------------------------------
 
-    def _ensure_page(self, seq: _Seq) -> bool:
-        """Guarantee a page exists for position seq.seq_len; allocate on
-        demand. False if the pool is exhausted."""
-        ps = self.pcfg.page_size
-        needed = seq.seq_len // ps + 1
-        if len(seq.block_table) >= needed:
-            return True
-        if len(seq.block_table) >= self.pcfg.max_pages_per_seq:
-            return True  # max-length stop will trigger instead
-        try:
-            seq.block_table.extend(self.allocator.allocate(1))
-            return True
-        except CacheFull:
-            return False
-
     def _preempt_youngest(self, outputs: List[StepOutput]) -> None:
         """Release the youngest active sequence back to the waiting queue
         (its pages freed) to relieve page pressure."""
@@ -612,24 +801,22 @@ class LLMEngine:
             self._preempt(youngest, outputs)
 
     def _preempt(self, seq: _Seq, outputs: List[StepOutput]) -> None:
+        # only called with the pipeline drained (_maybe_launch), so the host
+        # state below is exact, not a lagging projection
         for i, s in enumerate(self.slots):
             if s is seq:
                 self.slots[i] = None
+                self._deact_slot(i)
         self._release_seq(seq)
         seq.seq_len = 0
+        seq.dev_pos = 0
+        seq.dev_steps_left = 0
         # between steps the sampled-but-undecoded token is never in
         # token_ids; fold it in so re-prefill resumes exactly where we left
         if seq.next_token is not None:
             seq.token_ids.append(seq.next_token)
             seq.next_token = None
         self.waiting.appendleft(seq)
-
-    def _slot_for_position(self, table: List[int], pos: int) -> int:
-        ps = self.pcfg.page_size
-        page = pos // ps
-        if page >= len(table):
-            return self._num_slots_flat  # dropped write
-        return table[page] * ps + pos % ps
 
     def _slots_for_positions(
         self, table: List[int], positions: np.ndarray, valid: int
@@ -656,16 +843,6 @@ class LLMEngine:
             for p, page in enumerate(table[: self.pcfg.max_pages_per_seq]):
                 out[b, p * ps : (p + 1) * ps] = page * ps + offs
         return out
-
-    def _refresh_gather_row(self, slot: int, seq: _Seq, from_page: int) -> None:
-        """Rewrite the cached gather row for a slot from page index
-        ``from_page`` onward (block tables only grow while seated)."""
-        ps = self.pcfg.page_size
-        offs = np.arange(ps, dtype=np.int32)
-        table = seq.block_table[: self.pcfg.max_pages_per_seq]
-        for p in range(from_page, len(table)):
-            self._gather_rows[slot, p * ps : (p + 1) * ps] = table[p] * ps + offs
-        self._gather_pages[slot] = len(table)
 
     # ------------------------------------------------------------------
     # embeddings (the /embeddings endpoint's compute)
