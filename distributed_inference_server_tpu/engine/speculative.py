@@ -120,10 +120,14 @@ def spec_round(
     temperature: jnp.ndarray,  # [B]
     rng: jax.Array,
     gamma: int,
+    live: jnp.ndarray | None = None,  # [B] rows still generating
 ):
     """One speculative round. Returns (tokens [B, gamma+1], num_emitted
-    [B] in [1, gamma+1], new caches, new_seq_len). Row r's valid output is
-    tokens[r, :num_emitted[r]]."""
+    [B] in [0, gamma+1], new caches, new_seq_len). Row r's valid output is
+    tokens[r, :num_emitted[r]]. Rows with ``live``=False emit nothing and
+    their seq_len is frozen (their compute still runs — the batch is
+    static under SPMD — but they can't overshoot capacity or pollute
+    acceptance statistics)."""
     B = last_token.shape[0]
     max_seq = cache.k.shape[2]
     rngs = jax.random.split(rng, gamma + 3)
@@ -198,6 +202,8 @@ def spec_round(
         jnp.where(idx == num_accepted[:, None], extra[:, None], 0),
     )
     num_emitted = num_accepted + 1
+    if live is not None:
+        num_emitted = jnp.where(live, num_emitted, 0)
     new_seq_len = seq_len + num_emitted
     return (
         tokens, num_emitted, num_accepted, draft_cache, cache, new_seq_len
@@ -263,17 +269,27 @@ def speculative_generate(
     gamma = spec.num_draft_tokens
     while min(len(o) for o in out) < max_new_tokens:
         use_gamma = gamma if (tracker is None or tracker.enabled) else 1
+        # rows that already reached max_new_tokens are masked out of the
+        # round: no seq_len growth, no emissions, no tracker pollution
+        live_np = np.asarray([len(o) < max_new_tokens for o in out])
+        live = jnp.asarray(live_np)
         rng, k = jax.random.split(rng)
         tokens, emitted, accepted, dcache, cache, seq_len = spec_round(
             draft_params, draft_cfg, dcache, params, cfg, cache,
-            last, seq_len, temp, k, use_gamma,
+            last, seq_len, temp, k, use_gamma, live,
         )
         tok_np = np.asarray(tokens)
         em_np = np.asarray(emitted)
         for b in range(B):
             out[b].extend(tok_np[b, : em_np[b]].tolist())
-        last = tokens[jnp.arange(B), emitted - 1]
-        if tracker is not None and use_gamma > 1:
-            tracker.update(int(np.sum(np.asarray(accepted))),
-                           int(B * use_gamma), rows=B)
+        # dead rows emit nothing; keep their last token unchanged
+        last = jnp.where(
+            live, tokens[jnp.arange(B), jnp.maximum(emitted, 1) - 1], last
+        )
+        if tracker is not None and use_gamma > 1 and live_np.any():
+            n_live = int(live_np.sum())
+            tracker.update(
+                int(np.sum(np.asarray(accepted)[live_np])),
+                int(n_live * use_gamma), rows=n_live,
+            )
     return np.asarray([o[:max_new_tokens] for o in out])

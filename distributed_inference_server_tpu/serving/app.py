@@ -194,7 +194,15 @@ def build_app(
         tracer = getattr(handler, "tracer", None)
         if tracer is None:
             return web.json_response({"spans": []})
-        n = int(request.query.get("n", "100"))
+        try:
+            n = max(0, int(request.query.get("n", "100")))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "query parameter 'n' must be an "
+                           "integer", "error_type": "invalid_request_error",
+                           "code": "invalid_parameter"}},
+                status=400,
+            )
         trace_id = request.query.get("trace_id")
         return web.json_response(
             {"spans": [s.to_dict() for s in tracer.recent(n, trace_id)]}

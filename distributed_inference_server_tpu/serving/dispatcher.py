@@ -184,25 +184,29 @@ class Dispatcher:
             pad = (max(lens) * len(lens) / max(sum(lens), 1) - 1.0) if lens else 0.0
             self.metrics.record_batch(len(requests), max(0.0, pad))
         runner = self.scheduler.schedule()
-        if self.tracer:
-            # batching-phase span (S12): one per admission batch
-            with self.tracer.span(
-                "batch.dispatch",
-                size=len(requests),
-                engine_id=runner.engine_id if runner else None,
-                request_ids=[str(r.request_id) for r in requests],
-            ):
-                pass
-            for r in requests:
-                if r.span is not None:
-                    r.span.event("dispatched")
         if runner is None:
             # no healthy engine: fail the batch (Property 20 — graceful,
             # not silent)
+            if self.tracer:
+                for r in requests:
+                    if r.span is not None:
+                        r.span.event("dispatch_failed", reason="no_workers")
             for r in requests:
                 r.sink.on_error("no healthy inference engine available",
                                 "no_workers")
             return
+        if self.tracer:
+            # batching-phase event (S12): one per admission batch; recorded
+            # only for batches that actually reach an engine
+            with self.tracer.span(
+                "batch.dispatch",
+                size=len(requests),
+                engine_id=runner.engine_id,
+                request_ids=[str(r.request_id) for r in requests],
+            ):
+                for r in requests:
+                    if r.span is not None:
+                        r.span.event("dispatched")
         runner.submit(requests)
         if self.metrics:
             d = self.queue.queue_depth()

@@ -87,7 +87,11 @@ class InferenceHandler:
         self.validator = validator or RequestValidator()
         self.metrics = metrics
         self.tracer = tracer
+        # request_id -> (span, monotonic insert time). Entries are popped on
+        # completion; the TTL sweep in _submit covers streaming generators
+        # that are created but never iterated (their finally never runs).
         self._spans_by_request = {}
+        self._span_ttl_s = 3600.0
 
     # -- shared internals --------------------------------------------------
 
@@ -131,15 +135,27 @@ class InferenceHandler:
                 self.tracer.finish(span, status="rejected")
             raise QueueFullApiError() from None
         if span is not None:
-            self._spans_by_request[request_id] = span
+            self._sweep_stale_spans()
+            self._spans_by_request[request_id] = (span, time.monotonic())
         return request_id
+
+    def _sweep_stale_spans(self) -> None:
+        """Finish spans whose request outlived the TTL (e.g. a streaming
+        generator that was created but never iterated — its finally block
+        never runs, so the span would otherwise leak forever)."""
+        cutoff = time.monotonic() - self._span_ttl_s
+        stale = [rid for rid, (_, t) in self._spans_by_request.items()
+                 if t < cutoff]
+        for rid in stale:
+            span, _ = self._spans_by_request.pop(rid)
+            self.tracer.finish(span, status="orphaned")
 
     def _finish_span(self, request_id: RequestId, status: str) -> None:
         if not self.tracer:
             return
-        span = self._spans_by_request.pop(request_id, None)
-        if span is not None:
-            self.tracer.finish(span, status=status)
+        entry = self._spans_by_request.pop(request_id, None)
+        if entry is not None:
+            self.tracer.finish(entry[0], status=status)
 
     async def _await_completion(self, sink: CollectingSink, request_id: RequestId):
         try:

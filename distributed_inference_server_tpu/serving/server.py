@@ -200,7 +200,17 @@ class InferenceServer:
         for ev in events:
             if not ev.wait(max(0.0, deadline - _time.monotonic())):
                 cancelled.set()
-                return False, f"model swap timed out after {timeout_s}s"
+                # report which replicas already installed the new model so
+                # the operator can see the divergence and retry (matching
+                # the failure path's per-replica reporting)
+                installed = sorted(
+                    e for e, (ok, _) in results.items() if ok
+                )
+                return False, (
+                    f"model swap timed out after {timeout_s}s; replicas "
+                    f"already on the new model: {installed or 'none'} — "
+                    "retry the swap to converge"
+                )
         failed = {e: err for e, (ok, err) in results.items() if not ok}
         if failed:
             return False, f"swap failed on {failed}"
