@@ -108,6 +108,15 @@ class EngineConfig:
     # hiding the round-trip entirely. 0 = synchronous (fetch each block
     # right after launch).
     pipeline_depth: int = 1
+    # prompts prefill in one batched program per bucket instead of
+    # sequential B=1 calls: up to prefill_batch waiting rows share a chunk
+    # forward (padded rows' writes are dropped)
+    prefill_batch: int = 4
+    # max prefill tokens (batch rows x bucket) processed per engine step:
+    # long prompts prefill in budgeted quanta interleaved with decode
+    # blocks, so seated sequences keep decoding while a long prompt loads
+    # (at least one chunk always runs, so progress is guaranteed)
+    prefill_token_budget: int = 2048
 
 
 @dataclass
@@ -286,6 +295,7 @@ class LLMEngine:
         sequence, ``pipeline_depth`` blocks behind the device."""
         outputs: List[StepOutput] = []
         self._admit(outputs)
+        self._prefill_quantum(outputs)
         launched = self._maybe_launch(outputs)
         if self._pending and (
             len(self._pending) > self.ecfg.pipeline_depth or not launched
@@ -324,7 +334,7 @@ class LLMEngine:
                 ))
                 continue
             try:
-                self._prefill_seq(seq, outputs)
+                self._start_prefill(seq)
             except CacheFull:
                 return  # no pages; retry next step
             except Exception as e:  # failure isolation (Property 22)
@@ -335,11 +345,12 @@ class LLMEngine:
                     request_id=seq.request_id, finished=True, error=str(e)))
                 continue
             self.waiting.popleft()
-            if seq.request_id in self._by_id:  # not finished during prefill
-                self.slots[slot] = seq
-                self._stage_seat(slot, seq)
+            self.slots[slot] = seq  # seated, prefilling (next_token None)
 
-    def _prefill_seq(self, seq: _Seq, outputs: List[StepOutput]) -> None:
+    def _start_prefill(self, seq: _Seq) -> None:
+        """Claim pages for the whole prompt (prefix-shared where possible)
+        and mark the sequence as prefilling. The actual compute happens in
+        budgeted quanta (_prefill_quantum) so decode is never starved."""
         ps = self.pcfg.page_size
         self._release_seq(seq)  # defensive: drop any stale pages
         prompt = seq.token_ids  # on re-admission after preemption this
@@ -354,6 +365,7 @@ class LLMEngine:
             shared_tokens -= ps
         seq.block_table = list(shared_pages)
         seq.seq_len = shared_tokens
+        seq.next_token = None
 
         # allocate the remaining pages for the prompt
         pages_needed = -(-n // ps) - len(shared_pages)
@@ -364,20 +376,52 @@ class LLMEngine:
                 self._release_seq(seq)
                 raise
 
-        # prefill the un-cached suffix in bucketed chunks
-        start = shared_tokens
-        last_logits = None
-        while start < n:
-            bucket = self._pick_bucket(n - start)
-            chunk = prompt[start : start + bucket]
-            t = len(chunk)
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :t] = chunk
-            positions = np.arange(start, start + bucket, dtype=np.int32)[None, :]
-            write_slots = self._slots_for_positions(seq.block_table, positions, t)
-            gather = self._gather_slots([seq.block_table])
-            fn = self._get_prefill_fn(bucket)
-            logits_last, self.state.k, self.state.v = fn(
+    def _prefill_quantum(self, outputs: List[StepOutput]) -> None:
+        """Run up to ``prefill_token_budget`` prefill tokens: waiting chunks
+        of up to ``prefill_batch`` sequences share one compiled program per
+        length bucket (the spec's pad-to-batch-max batching, design.md:
+        244-246 [spec], applied to prefill). Sequences whose prompts
+        complete sample their first token (batched, on-device) and are
+        staged into the decode carry."""
+        budget = self.ecfg.prefill_token_budget
+        Bp = self.ecfg.prefill_batch
+        while budget > 0:
+            group = [
+                (i, s) for i, s in enumerate(self.slots)
+                if s is not None and s.next_token is None
+            ][:Bp]
+            if not group:
+                return
+            bucket = self._pick_bucket(max(
+                len(s.token_ids) - s.seq_len for _, s in group
+            ))
+            ids = np.zeros((Bp, bucket), np.int32)
+            positions = np.zeros((Bp, bucket), np.int32)
+            write_slots = np.full((Bp, bucket), self._num_slots_flat, np.int32)
+            gather = np.zeros((Bp, self._smax), np.int32)
+            kv_valid = np.zeros((Bp,), np.int32)
+            last_idx = np.zeros((Bp,), np.int32)
+            temp = np.ones((Bp,), np.float32)
+            top_p = np.ones((Bp,), np.float32)
+            chunk_lens: List[int] = []
+            for j, (_, s) in enumerate(group):
+                start = s.seq_len
+                t = min(len(s.token_ids) - start, bucket)
+                chunk_lens.append(t)
+                ids[j, :t] = s.token_ids[start : start + t]
+                positions[j] = np.arange(start, start + bucket, dtype=np.int32)
+                write_slots[j] = self._slots_for_positions(
+                    s.block_table, positions[j : j + 1], t
+                )[0]
+                gather[j] = self._gather_slots([s.block_table])[0]
+                kv_valid[j] = start + t
+                last_idx[j] = t - 1
+                temp[j] = s.params.temperature
+                top_p[j] = s.params.top_p
+
+            fn = self._get_prefill_fn(Bp, bucket)
+            self._rng, sub = jax.random.split(self._rng)
+            toks, self.state.k, self.state.v = fn(
                 self.params,
                 jnp.asarray(ids),
                 jnp.asarray(positions),
@@ -385,22 +429,33 @@ class LLMEngine:
                 self.state.v,
                 jnp.asarray(write_slots),
                 jnp.asarray(gather),
-                jnp.asarray([min(start + t, n)], np.int32),
-                jnp.asarray([t - 1], np.int32),
+                jnp.asarray(kv_valid),
+                jnp.asarray(last_idx),
+                jnp.asarray(temp),
+                jnp.asarray(top_p),
+                sub,
             )
-            last_logits = logits_last
-            start += t
-        seq.seq_len = n
-
-        # sample the first token on-device
-        self._rng, sub = jax.random.split(self._rng)
-        tok = self._sample_fn(
-            sub,
-            last_logits,
-            jnp.asarray([seq.params.temperature], jnp.float32),
-            jnp.asarray([seq.params.top_p], jnp.float32),
-        )
-        self._emit_token(seq, int(tok[0]), outputs)
+            budget -= Bp * bucket
+            toks_np: Optional[np.ndarray] = None
+            for j, (slot, s) in enumerate(group):
+                s.seq_len += chunk_lens[j]
+                if s.seq_len < len(s.token_ids):
+                    continue  # more chunks to go
+                if toks_np is None:
+                    toks_np = np.asarray(toks)
+                try:
+                    self._emit_token(s, int(toks_np[j]), outputs)
+                except Exception as e:  # failure isolation (Property 22)
+                    self.slots[slot] = None
+                    self._by_id.pop(s.request_id, None)
+                    self._release_seq(s)
+                    outputs.append(StepOutput(
+                        request_id=s.request_id, finished=True, error=str(e)))
+                    continue
+                if self._by_id.get(s.request_id) is s:
+                    self._stage_seat(slot, s)
+                # else: finished during its very first token (EOS or
+                # max_tokens=1) — _finish already cleared the slot
 
     def _pick_bucket(self, remaining: int) -> int:
         for b in self.ecfg.prefill_buckets:
@@ -434,23 +489,31 @@ class LLMEngine:
             return "ep"
         return "dense"
 
-    def _get_prefill_fn(self, bucket: int) -> Callable:
-        fn = self._prefill_fns.get(bucket)
+    def _get_prefill_fn(self, batch: int, bucket: int) -> Callable:
+        """Compiled batched-prefill chunk program keyed on (rows, bucket):
+        one paged forward over [batch, bucket] new tokens with per-row
+        positions/write-slots, plus fused first-token sampling at each
+        row's last valid index."""
+        key = (batch, bucket)
+        fn = self._prefill_fns.get(key)
         if fn is None:
             cfg = self.cfg
             moe_impl = self._moe_impl()
 
             @functools.partial(jax.jit, donate_argnums=(3, 4))
             def prefill(params, ids, positions, pool_k, pool_v, write_slots,
-                        gather_slots, kv_valid_len, last_idx):
+                        gather_slots, kv_valid_len, last_idx, temp, top_p,
+                        rng):
                 logits, k, v = llama.paged_forward(
                     params, cfg, ids, positions, pool_k, pool_v,
                     write_slots, gather_slots, kv_valid_len,
                     moe_impl=moe_impl,
                 )
-                return logits[jnp.arange(1), last_idx], k, v
+                last = logits[jnp.arange(ids.shape[0]), last_idx]
+                toks = sample_tokens(rng, last, temp, top_p)
+                return toks, k, v
 
-            fn = self._prefill_fns[bucket] = self._with_mesh(prefill)
+            fn = self._prefill_fns[key] = self._with_mesh(prefill)
         return fn
 
     # ------------------------------------------------------------------

@@ -262,6 +262,60 @@ def test_embeddings_long_input_not_truncated(tiny_params):
     )
 
 
+def test_chunked_prefill_interleaves_with_decode(tiny_params):
+    """A long prompt prefilling in budgeted quanta must not starve decode:
+    seated sequences keep emitting tokens in steps where the long prompt is
+    still prefilling, and the long prompt's output is unaffected."""
+    engine = LLMEngine(
+        tiny_params, TINY, TOK,
+        EngineConfig(
+            max_batch=2,
+            prefill_buckets=(8, 32),
+            paged=PagedCacheConfig(num_pages=64, page_size=4,
+                                   max_pages_per_seq=16),
+            decode_block_size=2,
+            prefill_batch=2,
+            prefill_token_budget=8,  # one 8-token chunk per step
+        ),
+        dtype=jnp.float32,
+    )
+    short = TOK.encode("hi")
+    engine.add_request("short", short,
+                       SamplingParams(max_tokens=40, temperature=0.0))
+    results = {}
+    for out in engine.step():  # seat + prefill short, start decoding
+        results.setdefault(out.request_id, {"tokens": [], "finish": None})[
+            "tokens"].append(out.token_id)
+    long_ids = [1 + (i % 200) for i in range(40)]  # 5 chunks of 8
+    engine.add_request("long", long_ids, GREEDY)
+
+    interleaved = False
+    for _ in range(300):
+        if not engine.has_work():
+            break
+        outs = engine.step()
+        long_seq = engine._by_id.get("long")
+        long_prefilling = long_seq is not None and long_seq.next_token is None
+        for out in outs:
+            r = results.setdefault(out.request_id,
+                                   {"tokens": [], "finish": None})
+            if out.token_id is not None:
+                r["tokens"].append(out.token_id)
+                if out.request_id == "short" and long_prefilling:
+                    interleaved = True
+            if out.finished:
+                r["finish"] = out.finish_reason
+    assert not engine.has_work()
+    assert interleaved, "short request made no progress during long prefill"
+    # chunked, budget-limited prefill must not change the long prompt's output
+    solo = greedy_generate(
+        tiny_params, TINY, long_ids, max_new_tokens=8, max_seq=64,
+        eos_ids=TOK.eos_ids,
+    )
+    assert results["long"]["tokens"] == solo
+    assert len(results["short"]["tokens"]) == 40
+
+
 def test_engine_pallas_attention_matches_xla(tiny_params):
     """End-to-end decode with the Pallas ragged paged-attention kernel
     (interpret mode on CPU) produces the same greedy tokens as the XLA
