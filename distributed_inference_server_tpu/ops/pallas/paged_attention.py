@@ -1,21 +1,32 @@
-"""Ragged paged-attention decode kernel (Pallas / Mosaic TPU).
+"""Ragged paged-attention decode kernel (Pallas / Mosaic TPU), v2.
 
 The serving hot loop's attention: one new query token per sequence attends
 to that sequence's KV pages scattered through the HBM page pool. The
 pure-XLA path (``models/llama.py:paged_forward``) first gathers every
 sequence's pages into a dense ``[B, S_max, KV, D]`` buffer and then runs
-dense attention — materializing S_max slots per row in HBM each step. This
-kernel reads pages straight from the pool instead: the block-table entry is
-a *scalar-prefetch* argument, so Pallas pipelines the page DMAs
-(HBM → VMEM) chosen by the table while the MXU works on the previous page,
-and nothing is materialized beyond one page per grid step.
+dense attention — materializing S_max slots per row in HBM each step and
+paying the write+read round trip. This kernel reads pages straight from
+the pool instead.
 
-Online-softmax accumulation over pages (flash-attention style), f32
-accumulators, causal masking implied by the ragged ``kv_valid_len`` (the
-query IS the last valid token — decode only). Each grid step loads one
-whole page ([page_size, KV, D] — Mosaic requires the trailing two block
-dims to match the array, so the KV-head loop is unrolled inside the kernel
-rather than gridded).
+v2 design (replaces the one-page-per-grid-step v1, which drowned in grid
+overhead at serving shapes — B x P grid steps of one 16-token page each):
+
+- **Grid = (B,)**: one grid step per sequence; the page loop runs inside
+  the kernel as a ``fori_loop`` with a *dynamic* trip count covering only
+  the row's valid pages — rows attend exactly as far as they are long
+  (the ragged contract), and short rows cost proportionally less.
+- **Manual double-buffered DMA**: the page pools stay in HBM
+  (``memory_space=ANY``); each loop iteration copies a *block* of
+  ``pages_per_block`` pages (chosen by the scalar-prefetched block table)
+  into one of two VMEM buffers with ``make_async_copy`` while the MXU
+  works on the previous block — the classic overlap pattern, with
+  per-page semaphores because the pages are scattered.
+- **bf16 on the MXU**: q/k/v enter the dots in their native dtype with
+  ``preferred_element_type=f32`` accumulation (v1 pre-converted to f32,
+  halving MXU rate for bf16 pools).
+- Online-softmax accumulation (flash-attention style) across blocks in
+  f32 VMEM scratch; causal masking implied by the ragged ``kv_valid_len``
+  (the query IS the last valid token — decode only).
 
 Replaces the reference's planned llama.cpp attention (design.md:7 [spec])
 as the native tier; same contract as ops/attention.py:gqa_attention.
@@ -28,6 +39,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -36,78 +48,128 @@ _LANES = 128  # VPU lane width; scratch statistics are broadcast across lanes
 
 
 def _decode_kernel(
-    # scalar-prefetch refs
+    # scalar-prefetch refs (SMEM)
     tables_ref,  # [B, P] page id per (row, page-slot)
     valid_ref,  # [B] valid token count per row
     # tensor refs
-    q_ref,  # [1, KV, G, D] this row's query tile, grouped by kv head
-    k_ref,  # [1, page_size, KV, D] this grid step's K page
-    v_ref,  # [1, page_size, KV, D] this grid step's V page
-    out_ref,  # [1, KV, G, D]
+    q_ref,  # [1, KV, G, D] this row's query tile (VMEM)
+    k_hbm,  # [num_pages, page_size, KV, D] full K pool (HBM)
+    v_hbm,  # [num_pages, page_size, KV, D] full V pool (HBM)
+    out_ref,  # [1, KV, G, D] (VMEM)
     # scratch
-    m_ref,  # [KV*G, LANES] f32 running max (broadcast across lanes)
+    k_buf,  # [2, PB, page_size, KV, D] double-buffered K pages
+    v_buf,  # [2, PB, page_size, KV, D]
+    sem_k,  # DMA semaphores [2, PB]
+    sem_v,  # [2, PB]
+    m_ref,  # [KV*G, LANES] f32 running max
     l_ref,  # [KV*G, LANES] f32 running denominator
     acc_ref,  # [KV*G, D] f32 running numerator
     *,
     page_size: int,
+    pages_per_block: int,
+    num_page_slots: int,
 ):
     b = pl.program_id(0)
-    p = pl.program_id(1)
-    num_pages_per_seq = pl.num_programs(1)
     num_kv = q_ref.shape[1]
     G = q_ref.shape[2]
-
-    @pl.when(p == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+    PB = pages_per_block
+    blk_tokens = PB * page_size
 
     valid = valid_ref[b]
-    start = p * page_size
+    num_blocks = lax.div(valid + blk_tokens - 1, blk_tokens)
 
-    @pl.when(start < valid)
-    def _accumulate():
-        # static unroll over the (small) kv-head count; each head is a
-        # plain 2D MXU matmul — Mosaic has no batched dot_general
-        for kv in range(num_kv):
-            q = q_ref[0, kv].astype(jnp.float32)  # [G, D]
-            k = k_ref[0, :, kv, :].astype(jnp.float32)  # [S_p, D]
-            v = v_ref[0, :, kv, :].astype(jnp.float32)  # [S_p, D]
-            d = q.shape[-1]
-            rows = slice(kv * G, (kv + 1) * G)
+    m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
 
-            # [G, S_p] scores on the MXU, f32 accumulation
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * (1.0 / (d**0.5))
-
-            token_ids = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(token_ids < valid, s, _NEG_INF)
-
-            m_prev = m_ref[rows, :1]  # [G, 1]
-            l_prev = l_ref[rows, :1]
-            m_cur = jnp.max(s, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_new)
-            probs = jnp.exp(s - m_new)  # [G, S_p]
-            l_new = l_prev * alpha + jnp.sum(probs, axis=-1, keepdims=True)
-            acc_ref[rows] = acc_ref[rows] * alpha + jax.lax.dot_general(
-                probs, v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
+    def start_block(slot, blk):
+        # PB scattered pages -> PB independent DMAs into adjacent buffer
+        # rows; page ids come from the scalar-prefetched table (clamped by
+        # the driver, so entries past the row's last page are in-range and
+        # merely masked at compute time)
+        for i in range(PB):
+            page_idx = jnp.minimum(
+                blk * PB + i, num_page_slots - 1
             )
-            m_ref[rows] = jnp.broadcast_to(m_new, (G, m_ref.shape[1]))
-            l_ref[rows] = jnp.broadcast_to(l_new, (G, l_ref.shape[1]))
+            page = tables_ref[b, page_idx]
+            pltpu.make_async_copy(
+                k_hbm.at[page], k_buf.at[slot, i], sem_k.at[slot, i]
+            ).start()
+            pltpu.make_async_copy(
+                v_hbm.at[page], v_buf.at[slot, i], sem_v.at[slot, i]
+            ).start()
 
-    @pl.when(p == num_pages_per_seq - 1)
-    def _finalize():
-        l = jnp.maximum(l_ref[:, :1], 1e-30)  # rows with valid=0 emit zeros
-        out = acc_ref[:] / l  # [KV*G, D]
-        out_ref[0] = out.reshape(num_kv, G, -1).astype(out_ref.dtype)
+    def wait_block(slot, blk):
+        for i in range(PB):
+            page = tables_ref[b, jnp.minimum(blk * PB + i,
+                                             num_page_slots - 1)]
+            pltpu.make_async_copy(
+                k_hbm.at[page], k_buf.at[slot, i], sem_k.at[slot, i]
+            ).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[page], v_buf.at[slot, i], sem_v.at[slot, i]
+            ).wait()
+
+    @pl.when(num_blocks > 0)
+    def _run():
+        start_block(0, 0)
+
+        def loop(blk, _):
+            slot = lax.rem(blk, 2)
+
+            @pl.when(blk + 1 < num_blocks)
+            def _prefetch():
+                start_block(lax.rem(blk + 1, 2), blk + 1)
+
+            wait_block(slot, blk)
+            start = blk * blk_tokens
+
+            # static unroll over the (small) kv-head count; each head is
+            # a plain 2D MXU matmul in the pool's native dtype with f32
+            # accumulation
+            for kv in range(num_kv):
+                q = q_ref[0, kv]  # [G, D]
+                k = k_buf[slot, :, :, kv, :].reshape(blk_tokens, -1)
+                v = v_buf[slot, :, :, kv, :].reshape(blk_tokens, -1)
+                d = q.shape[-1]
+                rows = slice(kv * G, (kv + 1) * G)
+
+                # [G, blk_tokens] scores on the MXU
+                s = lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * (1.0 / (d**0.5))
+                token_ids = start + lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1
+                )
+                s = jnp.where(token_ids < valid, s, _NEG_INF)
+
+                m_prev = m_ref[rows, :1]  # [G, 1]
+                l_prev = l_ref[rows, :1]
+                m_cur = jnp.max(s, axis=-1, keepdims=True)
+                m_new = jnp.maximum(m_prev, m_cur)
+                alpha = jnp.exp(m_prev - m_new)
+                probs = jnp.exp(s - m_new)  # [G, blk_tokens] f32
+                l_new = l_prev * alpha + jnp.sum(probs, -1, keepdims=True)
+                acc_ref[rows] = acc_ref[rows] * alpha + lax.dot_general(
+                    probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                m_ref[rows] = jnp.broadcast_to(m_new, (G, m_ref.shape[1]))
+                l_ref[rows] = jnp.broadcast_to(l_new, (G, l_ref.shape[1]))
+            return 0
+
+        lax.fori_loop(0, num_blocks, loop, 0)
+
+    l = jnp.maximum(l_ref[:, :1], 1e-30)  # rows with valid=0 emit zeros
+    out = acc_ref[:] / l  # [KV*G, D]
+    out_ref[0] = out.reshape(num_kv, G, -1).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "pages_per_block", "interpret"),
+)
 def paged_attention_decode(
     q: jnp.ndarray,
     pool_k: jnp.ndarray,
@@ -116,6 +178,7 @@ def paged_attention_decode(
     kv_valid_len: jnp.ndarray,
     *,
     page_size: int,
+    pages_per_block: int = 8,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Decode-step paged GQA attention against the flat page pool.
@@ -125,11 +188,12 @@ def paged_attention_decode(
       pool_k, pool_v: [num_slots, KV, D] one layer's flat page pool
         (num_slots = num_pages * page_size — engine/kv_cache.py layout).
       page_tables: [B, P] page ids per row (entries past the row's last
-        page may be any in-range id; they are masked, and are clamped
-        defensively to the pool).
+        page may be any value; they are clamped to the pool and masked).
       kv_valid_len: [B] valid tokens per row, INCLUDING the just-written
         query token (the query is causal-last by construction).
       page_size: tokens per page.
+      pages_per_block: pages DMA'd and processed per inner-loop step (the
+        double-buffered block size; tune for DMA/compute overlap).
       interpret: force Pallas interpret mode; defaults to True off-TPU so
         tests run on the CPU backend.
 
@@ -140,6 +204,7 @@ def paged_attention_decode(
     G = H // KV
     num_pages = num_slots // page_size
     P = page_tables.shape[1]
+    PB = min(pages_per_block, P)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -148,19 +213,20 @@ def paged_attention_decode(
     v_pages = pool_v.reshape(num_pages, page_size, KV, D)
     tables = jnp.clip(page_tables.astype(jnp.int32), 0, num_pages - 1)
 
-    def table_page(b, p, tables_ref, valid_ref):
-        return (tables_ref[b, p], 0, 0, 0)
-
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, P),
+        grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, KV, G, D), lambda b, p, t, vl: (b, 0, 0, 0)),
-            pl.BlockSpec((1, page_size, KV, D), table_page),
-            pl.BlockSpec((1, page_size, KV, D), table_page),
+            pl.BlockSpec((1, KV, G, D), lambda b, t, vl: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # K pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # V pool stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, KV, G, D), lambda b, p, t, vl: (b, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, KV, G, D), lambda b, t, vl: (b, 0, 0, 0)),
         scratch_shapes=[
+            pltpu.VMEM((2, PB, page_size, KV, D), pool_k.dtype),
+            pltpu.VMEM((2, PB, page_size, KV, D), pool_v.dtype),
+            pltpu.SemaphoreType.DMA((2, PB)),
+            pltpu.SemaphoreType.DMA((2, PB)),
             pltpu.VMEM((KV * G, _LANES), jnp.float32),
             pltpu.VMEM((KV * G, _LANES), jnp.float32),
             pltpu.VMEM((KV * G, D), jnp.float32),
@@ -168,18 +234,24 @@ def paged_attention_decode(
     )
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, page_size=page_size),
+        functools.partial(
+            _decode_kernel,
+            page_size=page_size,
+            pages_per_block=PB,
+            num_page_slots=P,
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, G, D), q.dtype),
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
-            # the batch grid dim is independent — scratch state only spans
-            # the innermost page dim — so let megacore split it
-            dimension_semantics=("parallel", "arbitrary"),
+            # rows are independent — scratch state is reset per grid step
+            # — so let megacore split the batch
+            dimension_semantics=("parallel",),
         ),
         cost_estimate=pl.CostEstimate(
             flops=4 * B * H * P * page_size * D,
-            bytes_accessed=2 * B * KV * P * page_size * D * pool_k.dtype.itemsize,
+            bytes_accessed=2 * B * KV * P * page_size * D
+            * pool_k.dtype.itemsize,
             transcendentals=B * H * P * page_size,
         ),
     )(tables, kv_valid_len.astype(jnp.int32), qg, k_pages, v_pages)
