@@ -52,6 +52,10 @@ def main(argv=None) -> int:
             page_size=cfg.get("engine", "page_size"),
             max_pages_per_seq=cfg.get("engine", "max_pages_per_seq"),
         ),
+        decode_block_size=cfg.get("engine", "decode_block_size"),
+        pipeline_depth=cfg.get("engine", "pipeline_depth"),
+        prefill_batch=cfg.get("engine", "prefill_batch"),
+        prefill_token_budget=cfg.get("engine", "prefill_token_budget"),
     )
     tokenizer = load_tokenizer(model_dir)
 
@@ -112,8 +116,35 @@ def main(argv=None) -> int:
             # devices [i*tp, (i+1)*tp)
             devs = jax.devices()[replica_idx * tp : (replica_idx + 1) * tp]
             mesh = make_mesh(MeshSpec(tensor=tp), devs)
+        # speculative decoding (Req 12.1): a draft model configured on the
+        # server enables speculation inside the continuous-batching engine
+        draft_params = draft_cfg_m = spec = None
+        draft_dir = cfg.get("model", "draft_model_dir") or None
+        draft_name = cfg.get("model", "draft_model_name") or None
+        if draft_dir or draft_name:
+            from distributed_inference_server_tpu.engine.speculative import (
+                SpecConfig,
+            )
+
+            if draft_dir:
+                draft_params, draft_cfg_m = load_checkpoint(
+                    draft_dir, dtype=dtype
+                )
+            else:
+                import jax
+
+                draft_cfg_m = get_config(draft_name)
+                draft_params = llama.init_params(
+                    jax.random.PRNGKey(1), draft_cfg_m, dtype=dtype
+                )
+            spec = SpecConfig(
+                num_draft_tokens=cfg.get("engine", "num_draft_tokens"),
+                disable_threshold=cfg.get("engine",
+                                          "spec_disable_threshold"),
+            )
         return LLMEngine(params, model_cfg, tokenizer, engine_cfg,
-                         dtype=dtype, mesh=mesh)
+                         dtype=dtype, mesh=mesh, draft_params=draft_params,
+                         draft_cfg=draft_cfg_m, spec=spec)
 
     try:
         server = InferenceServer(

@@ -52,10 +52,18 @@ from distributed_inference_server_tpu.engine.kv_cache import (
     PagedCacheConfig,
     PagedKVState,
 )
+from distributed_inference_server_tpu.engine.speculative import (
+    AcceptanceTracker,
+    SpecConfig,
+    _probs as spec_probs,
+)
 from distributed_inference_server_tpu.models import llama
 from distributed_inference_server_tpu.models.configs import ModelConfig
 from distributed_inference_server_tpu.models.tokenizer import Tokenizer
-from distributed_inference_server_tpu.ops.sampling import sample_tokens
+from distributed_inference_server_tpu.ops.sampling import (
+    sample_tokens,
+    top_p_filter_probs,
+)
 
 
 def _make_allocator(pcfg: PagedCacheConfig, force: Optional[bool]):
@@ -117,6 +125,10 @@ class EngineConfig:
     # blocks, so seated sequences keep decoding while a long prompt loads
     # (at least one chunk always runs, so progress is guaranteed)
     prefill_token_budget: int = 2048
+    # GPipe microbatches per forward when the mesh has a stage axis
+    # (pipeline parallelism, parallel/pp.py); must divide max_batch and
+    # prefill_batch
+    pp_microbatches: int = 1
 
 
 @dataclass
@@ -175,12 +187,27 @@ class LLMEngine:
         engine_cfg: Optional[EngineConfig] = None,
         dtype=jnp.bfloat16,
         mesh=None,
+        draft_params: Optional[llama.Params] = None,
+        draft_cfg: Optional[ModelConfig] = None,
+        spec: Optional[SpecConfig] = None,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` (parallel/mesh.py) for
         intra-replica tensor parallelism — weights and the paged KV pool are
         sharded over the ``tensor`` axis (parallel/tp.py layout) and every
         jitted step runs SPMD with XLA-inserted ICI collectives. Without a
-        mesh, single-device execution (the reference's worker model)."""
+        mesh, single-device execution (the reference's worker model).
+
+        ``draft_params``/``draft_cfg``: optional draft model enabling
+        speculative decoding inside the continuous-batching step (Req 12,
+        requirements.md:164-170 [spec]): the draft gets its own page pool
+        addressed by the SAME block tables as the target (pages are
+        allocated once and hold both models' K/V for the same tokens, so
+        prefix-cache sharing carries the draft cache along for free), and
+        decode blocks run speculative rounds — draft proposes gamma
+        tokens, target verifies them in one T=gamma+1 forward, rejection
+        sampling accepts a prefix. Acceptance is tracked and speculation
+        auto-disables below ``spec.disable_threshold`` (Req 12.5), falling
+        back to plain decode blocks."""
         self.params = params
         self.cfg = cfg
         self.tok = tokenizer
@@ -188,6 +215,17 @@ class LLMEngine:
         self.pcfg = self.ecfg.paged
         self.dtype = dtype
         self.mesh = mesh
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec = spec or SpecConfig()
+        self.spec_tracker = (
+            AcceptanceTracker(self.spec) if draft_params is not None else None
+        )
+        self.draft_state = (
+            PagedKVState.create(draft_cfg, self.pcfg, dtype=dtype)
+            if draft_params is not None
+            else None
+        )
 
         self.state = PagedKVState.create(cfg, self.pcfg, dtype=dtype)
         if mesh is not None:
@@ -195,11 +233,41 @@ class LLMEngine:
 
             from distributed_inference_server_tpu.parallel import tp as tp_rules
 
-            tp_rules.validate_tp(cfg, mesh.shape["tensor"])
-            self.params = tp_rules.shard_params(params, mesh, cfg)
-            pool_sharding = NamedSharding(mesh, tp_rules.kv_pool_spec())
+            pp = mesh.shape.get("stage", 1)
+            stage_axis = "stage" if pp > 1 else None
+            tp_rules.validate_tp(cfg, mesh.shape.get("tensor", 1))
+            if stage_axis is not None:
+                from distributed_inference_server_tpu.parallel.pp import (
+                    validate_pp,
+                )
+
+                validate_pp(cfg, pp, self.ecfg.max_batch,
+                            self.ecfg.pp_microbatches)
+                validate_pp(cfg, pp, self.ecfg.prefill_batch,
+                            self.ecfg.pp_microbatches)
+                if draft_params is not None:
+                    raise NotImplementedError(
+                        "speculative decoding under pipeline parallelism "
+                        "is not supported yet"
+                    )
+            self.params = tp_rules.shard_params(params, mesh, cfg,
+                                                stage_axis=stage_axis)
+            pool_sharding = NamedSharding(
+                mesh, tp_rules.kv_pool_spec(stage_axis)
+            )
             self.state.k = jax.device_put(self.state.k, pool_sharding)
             self.state.v = jax.device_put(self.state.v, pool_sharding)
+            if self.draft_params is not None:
+                tp_rules.validate_tp(draft_cfg, mesh.shape.get("tensor", 1))
+                self.draft_params = tp_rules.shard_params(
+                    self.draft_params, mesh, draft_cfg
+                )
+                self.draft_state.k = jax.device_put(
+                    self.draft_state.k, pool_sharding
+                )
+                self.draft_state.v = jax.device_put(
+                    self.draft_state.v, pool_sharding
+                )
         if self._moe_impl() == "ep":
             # Serving is drop-free: per-expert load never exceeds N (top-k
             # experts are distinct per token), so a capacity factor of E/k
@@ -238,8 +306,12 @@ class LLMEngine:
         self._pending: Deque[Tuple[jnp.ndarray, List[Tuple[int, _Seq]]]] = deque()
 
         # jit caches
-        self._prefill_fns: Dict[int, Callable] = {}
+        self._fwd = self._make_fwd()
+        self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
         self._block_fn = self._build_decode_block()
+        self._spec_block_fn = (
+            self._build_spec_block() if draft_params is not None else None
+        )
         self._sample_fn = jax.jit(sample_tokens)
 
     # ------------------------------------------------------------------
@@ -421,8 +493,7 @@ class LLMEngine:
 
             fn = self._get_prefill_fn(Bp, bucket)
             self._rng, sub = jax.random.split(self._rng)
-            toks, self.state.k, self.state.v = fn(
-                self.params,
+            args = (
                 jnp.asarray(ids),
                 jnp.asarray(positions),
                 self.state.k,
@@ -435,6 +506,17 @@ class LLMEngine:
                 jnp.asarray(top_p),
                 sub,
             )
+            if self.draft_params is not None:
+                # the draft model prefills the same chunk into its own
+                # pool (same slots) so speculative rounds can attend the
+                # full prompt
+                (toks, self.state.k, self.state.v,
+                 self.draft_state.k, self.draft_state.v) = fn(
+                    self.params, self.draft_params,
+                    self.draft_state.k, self.draft_state.v, *args,
+                )
+            else:
+                toks, self.state.k, self.state.v = fn(self.params, *args)
             budget -= Bp * bucket
             toks_np: Optional[np.ndarray] = None
             for j, (slot, s) in enumerate(group):
@@ -476,6 +558,41 @@ class LLMEngine:
 
         return wrapped
 
+    def _make_fwd(self) -> Callable:
+        """Central paged-forward router for every compiled program (decode
+        blocks, speculative rounds, prefill chunks): single-device / TP
+        execution via ``llama.paged_forward``, or the stage-axis pipeline
+        (``parallel/pp.py:pp_paged_forward``) when the mesh has one — the
+        70B TP x PP serving path over the SAME paged pool and host
+        machinery."""
+        mesh = self.mesh
+        ps = self.pcfg.page_size
+        if mesh is not None and mesh.shape.get("stage", 1) > 1:
+            from distributed_inference_server_tpu.parallel.pp import (
+                pp_paged_forward,
+            )
+
+            M = self.ecfg.pp_microbatches
+
+            def fwd(params, cfg, ids, positions, pk, pv, ws, gs, kvv,
+                    impl, moe_impl):
+                return pp_paged_forward(
+                    mesh, params, cfg, ids, positions, pk, pv, ws, gs,
+                    kvv, num_microbatches=M,
+                )
+
+            return fwd
+
+        def fwd(params, cfg, ids, positions, pk, pv, ws, gs, kvv, impl,
+                moe_impl):
+            return llama.paged_forward(
+                params, cfg, ids, positions, pk, pv, ws, gs, kvv,
+                attention_impl=impl, page_size=ps, moe_impl=moe_impl,
+                mesh=mesh,
+            )
+
+        return fwd
+
     def _moe_impl(self) -> str:
         """MoE execution path: capacity-based EP dispatch (ops/moe.py) when
         an expert mesh axis exists — the Mixtral-scale path; dense-compute
@@ -499,15 +616,40 @@ class LLMEngine:
         if fn is None:
             cfg = self.cfg
             moe_impl = self._moe_impl()
+            fwd = self._fwd
+
+            if self.draft_params is not None:
+                dcfg = self.draft_cfg
+
+                @functools.partial(jax.jit, donate_argnums=(2, 3, 6, 7))
+                def prefill_spec(params, dparams, dpool_k, dpool_v, ids,
+                                 positions, pool_k, pool_v, write_slots,
+                                 gather_slots, kv_valid_len, last_idx,
+                                 temp, top_p, rng):
+                    logits, k, v = fwd(
+                        params, cfg, ids, positions, pool_k, pool_v,
+                        write_slots, gather_slots, kv_valid_len,
+                        "xla", moe_impl,
+                    )
+                    _, dk, dv = fwd(
+                        dparams, dcfg, ids, positions, dpool_k, dpool_v,
+                        write_slots, gather_slots, kv_valid_len,
+                        "xla", "dense",
+                    )
+                    last = logits[jnp.arange(ids.shape[0]), last_idx]
+                    toks = sample_tokens(rng, last, temp, top_p)
+                    return toks, k, v, dk, dv
+
+                fn = self._prefill_fns[key] = self._with_mesh(prefill_spec)
+                return fn
 
             @functools.partial(jax.jit, donate_argnums=(3, 4))
             def prefill(params, ids, positions, pool_k, pool_v, write_slots,
                         gather_slots, kv_valid_len, last_idx, temp, top_p,
                         rng):
-                logits, k, v = llama.paged_forward(
+                logits, k, v = fwd(
                     params, cfg, ids, positions, pool_k, pool_v,
-                    write_slots, gather_slots, kv_valid_len,
-                    moe_impl=moe_impl,
+                    write_slots, gather_slots, kv_valid_len, "xla", moe_impl,
                 )
                 last = logits[jnp.arange(ids.shape[0]), last_idx]
                 toks = sample_tokens(rng, last, temp, top_p)
@@ -554,7 +696,7 @@ class LLMEngine:
         smax = self._smax
         num_slots = self._num_slots_flat
         moe_impl = self._moe_impl()
-        mesh = self.mesh
+        fwd = self._fwd
         eos = jnp.asarray(sorted(self.tok.eos_ids), jnp.int32)
 
         @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6, 10))
@@ -580,11 +722,9 @@ class LLMEngine:
                     active, page * ps + positions % ps, num_slots
                 )[:, None]
                 kv_valid = jnp.where(active, positions + 1, 0)
-                logits, pool_k, pool_v = llama.paged_forward(
+                logits, pool_k, pool_v = fwd(
                     params, cfg, tokens[:, None], positions[:, None],
-                    pool_k, pool_v, write, gather, kv_valid,
-                    attention_impl=impl, page_size=ps, moe_impl=moe_impl,
-                    mesh=mesh,
+                    pool_k, pool_v, write, gather, kv_valid, impl, moe_impl,
                 )
                 rng, sub = jax.random.split(rng)
                 nxt = sample_tokens(sub, logits[:, 0], temp, top_p)
@@ -611,6 +751,212 @@ class LLMEngine:
                     pool_k, pool_v, rng)
 
         return self._with_mesh(block)
+
+    def _build_spec_block(self) -> Callable:
+        """Compile the speculative decode block (Req 12): R rounds of
+        (draft proposes gamma tokens over its own page pool -> target
+        verifies all of them in ONE T=gamma+1 paged forward -> rejection
+        sampling accepts a prefix + resamples/bonus), all on-device in one
+        program. Per round a row emits 1..gamma+1 tokens.
+
+        Temperature-0 rows accept by exact greedy match (bit-identical to
+        plain decoding, tested); top-p rows cannot be verified exactly, so
+        they ride along with forced rejection at position 0 and their
+        resample distribution top-p filtered — one exact top-p token per
+        round. EOS truncates a row's emissions and freezes it on-device.
+        Writes past the row's capacity are dropped (speculative overshoot
+        near max_seq_len)."""
+        cfg, dcfg = self.cfg, self.draft_cfg
+        impl = self.ecfg.attention_impl
+        if impl == "auto":
+            impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        ps = self.pcfg.page_size
+        R = self.ecfg.decode_block_size
+        gamma = self.spec.num_draft_tokens
+        W = gamma + 1
+        smax = self._smax
+        num_slots = self._num_slots_flat
+        moe_impl = self._moe_impl()
+        fwd = self._fwd
+        eos = jnp.asarray(sorted(self.tok.eos_ids), jnp.int32)
+
+        @functools.partial(
+            jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 13)
+        )
+        def block(params, dparams, pool_k, pool_v, dpool_k, dpool_v,
+                  tokens, positions, steps_left, active, block_tables,
+                  temp, top_p, rng,
+                  set_mask, set_active, set_tokens, set_positions,
+                  set_steps):
+            tokens = jnp.where(set_mask, set_tokens, tokens)
+            positions = jnp.where(set_mask, set_positions, positions)
+            steps_left = jnp.where(set_mask, set_steps, steps_left)
+            active = jnp.where(set_mask, set_active, active)
+
+            B = tokens.shape[0]
+            offs = jnp.arange(smax, dtype=jnp.int32)
+            gather = block_tables[:, offs // ps] * ps + offs % ps
+            rows = jnp.arange(B)
+            max_pages = block_tables.shape[1]
+
+            def flat_slot(pos):  # [B] absolute positions -> flat slots
+                page = block_tables[
+                    rows, jnp.minimum(pos // ps, max_pages - 1)
+                ]
+                return page * ps + pos % ps
+
+            def one_round(carry, keys):
+                (tokens, positions, steps_left, active,
+                 pool_k, pool_v, dpool_k, dpool_v) = carry
+
+                # ---- draft: gamma+1 sequential T=1 proposals (the last
+                # step ingests the final proposal's K/V; its sample is
+                # discarded) over the draft page pool ----
+                def dstep(c, key):
+                    dpk, dpv, tok, pos = c
+                    ok = active & (pos < smax)
+                    write = jnp.where(ok, flat_slot(pos), num_slots)[:, None]
+                    kv_valid = jnp.where(active, pos + 1, 0)
+                    logits, dpk, dpv = fwd(
+                        dparams, dcfg, tok[:, None], pos[:, None],
+                        dpk, dpv, write, gather, kv_valid, impl, "dense",
+                    )
+                    q = spec_probs(logits[:, 0], temp)
+                    nxt = jax.random.categorical(
+                        key, jnp.log(q + 1e-30), axis=-1
+                    ).astype(jnp.int32)
+                    return (dpk, dpv, nxt, pos + 1), (nxt, q)
+
+                (dpool_k, dpool_v, _, _), (dtoks, dqs) = lax.scan(
+                    dstep, (dpool_k, dpool_v, tokens, positions),
+                    keys[: gamma + 1],
+                )
+                dtoks = dtoks.T[:, :gamma]  # [B, gamma]
+                dqs = jnp.moveaxis(dqs, 0, 1)[:, :gamma]  # [B, gamma, V]
+
+                # ---- target: one verify forward over [last, d_1..d_g] ----
+                ver_tokens = jnp.concatenate([tokens[:, None], dtoks], 1)
+                ver_pos = positions[:, None] + jnp.arange(W)[None]
+                ok = active[:, None] & (ver_pos < smax)
+                vpage = block_tables[
+                    rows[:, None], jnp.minimum(ver_pos // ps, max_pages - 1)
+                ]
+                write = jnp.where(ok, vpage * ps + ver_pos % ps, num_slots)
+                kv_valid = jnp.where(active, positions + W, 0)
+                logits, pool_k, pool_v = fwd(
+                    params, cfg, ver_tokens, ver_pos, pool_k, pool_v,
+                    write, gather, kv_valid, "xla", moe_impl,
+                )
+                tps = spec_probs(logits, temp[:, None])  # [B, W, V]
+
+                # ---- rejection sampling (speculative.py math) ----
+                p_at = jnp.take_along_axis(
+                    tps[:, :gamma], dtoks[..., None], axis=-1
+                )[..., 0]
+                q_at = jnp.take_along_axis(
+                    dqs, dtoks[..., None], axis=-1
+                )[..., 0]
+                u = jax.random.uniform(keys[gamma + 1], (B, gamma))
+                accept = u < jnp.minimum(
+                    1.0, p_at / jnp.maximum(q_at, 1e-30)
+                )
+                num_accepted = jnp.sum(
+                    jnp.cumprod(accept.astype(jnp.int32), 1), 1
+                )
+                # top-p rows can't be verified exactly: force rejection at
+                # 0 and top-p filter the resample distribution — exactly
+                # one correctly-sampled token per round
+                spec_ok = top_p >= 1.0
+                num_accepted = jnp.where(spec_ok, num_accepted, 0)
+                p_rej = tps[rows, num_accepted]
+                q_rej = jnp.where(
+                    ((num_accepted < gamma) & spec_ok)[:, None],
+                    dqs[rows, jnp.minimum(num_accepted, gamma - 1)],
+                    jnp.zeros_like(p_rej),
+                )
+                p_rej = jnp.where(
+                    spec_ok[:, None], p_rej,
+                    top_p_filter_probs(p_rej, top_p),
+                )
+                resid = jnp.maximum(p_rej - q_rej, 0.0)
+                resid_sum = jnp.sum(resid, axis=-1, keepdims=True)
+                resid = jnp.where(resid_sum > 1e-30, resid, p_rej)
+                extra = jax.random.categorical(
+                    keys[gamma + 2], jnp.log(resid + 1e-30), axis=-1
+                ).astype(jnp.int32)
+
+                idx = jnp.arange(W)[None]
+                toks_out = jnp.where(
+                    idx < num_accepted[:, None],
+                    jnp.pad(dtoks, ((0, 0), (0, 1))),
+                    jnp.where(idx == num_accepted[:, None],
+                              extra[:, None], 0),
+                )
+                base = num_accepted + 1
+                is_eos = (
+                    (toks_out[..., None] == eos[None, None, :]).any(-1)
+                    if eos.size
+                    else jnp.zeros(toks_out.shape, bool)
+                ) & (idx < base[:, None])
+                has_eos = is_eos.any(-1)
+                first_eos = jnp.argmax(is_eos, axis=-1)
+                emitted = jnp.where(
+                    has_eos, jnp.minimum(base, first_eos + 1), base
+                )
+                emitted = jnp.where(active, emitted, 0)
+                acc_out = jnp.where(active & spec_ok, num_accepted, 0)
+                prop_out = jnp.where(active & spec_ok, gamma, 0)
+                toks_out = jnp.where(
+                    (idx < emitted[:, None]) & active[:, None], toks_out, -1
+                )
+                new_last = toks_out[rows, jnp.maximum(emitted, 1) - 1]
+                tokens = jnp.where(active & (emitted > 0), new_last, tokens)
+                positions = positions + emitted
+                steps_left = steps_left - emitted
+                active = active & ~has_eos & (steps_left > 0)
+                return (
+                    (tokens, positions, steps_left, active,
+                     pool_k, pool_v, dpool_k, dpool_v),
+                    (toks_out, emitted, acc_out, prop_out),
+                )
+
+            rng, sub = jax.random.split(rng)
+            keys = jax.random.split(sub, R * (gamma + 3))
+            keys = keys.reshape((R, gamma + 3) + keys.shape[1:])
+            carry, (toks, counts, acc, prop) = lax.scan(
+                one_round,
+                (tokens, positions, steps_left, active,
+                 pool_k, pool_v, dpool_k, dpool_v),
+                keys,
+            )
+            (tokens, positions, steps_left, active,
+             pool_k, pool_v, dpool_k, dpool_v) = carry
+            return (toks, counts, acc, prop, tokens, positions, steps_left,
+                    active, pool_k, pool_v, dpool_k, dpool_v, rng)
+
+        return self._with_mesh(block)
+
+    def _spec_on(self) -> bool:
+        """Speculate this launch? Requires a draft model and the tracker
+        not auto-disabled (Req 12.5)."""
+        return (
+            self.draft_params is not None
+            and self.spec_tracker is not None
+            and self.spec_tracker.enabled
+        )
+
+    def spec_stats(self) -> Optional[dict]:
+        """Speculation metrics for /server/stats and /metrics (Req 12.4);
+        None when no draft model is configured."""
+        if self.spec_tracker is None:
+            return None
+        t = self.spec_tracker
+        return {
+            "acceptance_rate": round(t.rate(), 4),
+            "estimated_speedup": round(t.speedup(), 4),
+            "enabled": t.enabled,
+            "num_draft_tokens": self.spec.num_draft_tokens,
+        }
 
     def _stage_seat(self, slot: int, seq: _Seq) -> None:
         """Stage a freshly prefetched sequence into a decode slot: its first
@@ -641,10 +987,22 @@ class LLMEngine:
             self._bt[slot, p] = table[p]
         self._bt_pages[slot] = len(table)
 
-    def _ensure_block_pages(self, seq: _Seq) -> None:
+    def _assumed_adv(self, seq: _Seq, use_spec: bool) -> int:
+        """Upper bound on tokens this sequence can emit in one block: the
+        page-preallocation and budget-projection unit. Speculative rounds
+        may overshoot the budget by up to gamma tokens before the device
+        freeze triggers."""
+        if seq.dev_steps_left <= 0:
+            return 0
+        if use_spec:
+            gamma = self.spec.num_draft_tokens
+            return min(self.ecfg.decode_block_size * (gamma + 1),
+                       seq.dev_steps_left + gamma)
+        return min(self.ecfg.decode_block_size, seq.dev_steps_left)
+
+    def _ensure_block_pages(self, seq: _Seq, steps: int) -> None:
         """Pre-allocate pages covering the next block's writes for this
         sequence (positions dev_pos .. dev_pos+steps-1). Raises CacheFull."""
-        steps = min(self.ecfg.decode_block_size, seq.dev_steps_left)
         if steps <= 0:
             return
         needed = (seq.dev_pos + steps - 1) // self.pcfg.page_size + 1
@@ -657,6 +1015,7 @@ class LLMEngine:
         host override is staged. Handles page pressure by draining the
         pipeline (finished rows release pages) and then preempting the
         youngest sequence, exactly once per launch attempt."""
+        use_spec = False
         while True:
             seated = [(i, s) for i, s in enumerate(self.slots)
                       if s is not None]
@@ -666,9 +1025,11 @@ class LLMEngine:
                 s.dev_steps_left > 0 for _, s in seated
             ):
                 return False
+            use_spec = self._spec_on()
+            advs = {id(s): self._assumed_adv(s, use_spec) for _, s in seated}
             try:
                 for _, s in seated:
-                    self._ensure_block_pages(s)
+                    self._ensure_block_pages(s, advs[id(s)])
                 break
             except CacheFull:
                 if self._pending:
@@ -681,14 +1042,16 @@ class LLMEngine:
         for i, s in seated:
             if self._bt_pages[i] != len(s.block_table):
                 self._refresh_bt_row(i, s)
-        self._launch(seated)
+        self._launch(seated, advs, use_spec)
         for _, s in seated:
-            adv = min(self.ecfg.decode_block_size, s.dev_steps_left)
+            adv = advs[id(s)]
+            # no floor: negatives reconcile exactly when blocks complete
             s.dev_pos += adv
             s.dev_steps_left -= adv
         return True
 
-    def _launch(self, seated: List[Tuple[int, _Seq]]) -> None:
+    def _launch(self, seated: List[Tuple[int, _Seq]],
+                advs: Dict[int, int], use_spec: bool) -> None:
         B = self.ecfg.max_batch
         set_mask = np.zeros((B,), bool)
         set_active = np.zeros((B,), bool)
@@ -712,18 +1075,37 @@ class LLMEngine:
                 jax.random.PRNGKey(self.ecfg.seed + 1),
             )
         tokens, positions, steps_left, active, rng = self._carry
-        (outs, tokens, positions, steps_left, active,
-         self.state.k, self.state.v, rng) = self._block_fn(
-            self.params, self.state.k, self.state.v,
-            tokens, positions, steps_left, active,
-            jnp.asarray(self._bt), jnp.asarray(self._temp),
-            jnp.asarray(self._topp), rng,
+        injects = (
             jnp.asarray(set_mask), jnp.asarray(set_active),
             jnp.asarray(set_tokens), jnp.asarray(set_pos),
             jnp.asarray(set_steps),
         )
+        uploads = (
+            jnp.asarray(self._bt), jnp.asarray(self._temp),
+            jnp.asarray(self._topp),
+        )
+        snapshot = [(i, s, advs[id(s)]) for i, s in seated]
+        if use_spec:
+            (toks, counts, acc, prop, tokens, positions, steps_left, active,
+             self.state.k, self.state.v,
+             self.draft_state.k, self.draft_state.v,
+             rng) = self._spec_block_fn(
+                self.params, self.draft_params,
+                self.state.k, self.state.v,
+                self.draft_state.k, self.draft_state.v,
+                tokens, positions, steps_left, active,
+                *uploads, rng, *injects,
+            )
+            self._pending.append((toks, counts, acc, prop, snapshot))
+        else:
+            (outs, tokens, positions, steps_left, active,
+             self.state.k, self.state.v, rng) = self._block_fn(
+                self.params, self.state.k, self.state.v,
+                tokens, positions, steps_left, active,
+                *uploads, rng, *injects,
+            )
+            self._pending.append((outs, None, None, None, snapshot))
         self._carry = (tokens, positions, steps_left, active, rng)
-        self._pending.append((outs, list(seated)))
 
     def _drain_pending(self, outputs: List[StepOutput]) -> None:
         """Process every in-flight block. Afterwards the host view is exact
@@ -735,26 +1117,56 @@ class LLMEngine:
     def _process_block(self, outputs: List[StepOutput]) -> None:
         """Consume the oldest pending block: walk each row's sampled tokens
         through the same emission path as r1's per-step loop (EOS / stop-
-        sequence / length finishing, streaming deltas, failure isolation)."""
-        outs, snapshot = self._pending.popleft()
-        toks = np.asarray(outs)  # the only blocking device read per block
-        K = toks.shape[0]
-        for slot, seq in snapshot:
+        sequence / length finishing, streaming deltas, failure isolation).
+
+        Normal blocks carry [K, B] tokens with -1 freeze sentinels;
+        speculative blocks carry [R, B, W] tokens plus per-round emission
+        counts and acceptance stats. Live sequences reconcile the launch's
+        assumed advance against what was actually emitted (speculative
+        rounds emit a variable number of tokens)."""
+        toks_d, counts_d, acc_d, prop_d, snapshot = self._pending.popleft()
+        toks = np.asarray(toks_d)  # the only blocking device read per block
+        if counts_d is None:
+            toks3 = toks[:, :, None]
+            counts = (toks >= 0).astype(np.int32)
+        else:
+            toks3 = toks
+            counts = np.asarray(counts_d)
+            if self.spec_tracker is not None:
+                prop_arr = np.asarray(prop_d)
+                proposed = int(prop_arr.sum())
+                if proposed > 0:
+                    self.spec_tracker.update(
+                        int(np.asarray(acc_d).sum()), proposed,
+                        rows=int((prop_arr > 0).sum()),
+                    )
+        R = toks3.shape[0]
+        for slot, seq, assumed in snapshot:
             if self._by_id.get(seq.request_id) is not seq:
                 continue  # finished or aborted while the block was in flight
+            emitted_here = 0
             try:
-                for k in range(K):
-                    t = int(toks[k, slot])
-                    if t < 0:
-                        break  # row was frozen on-device before this step
-                    seq.token_ids.append(seq.next_token)
-                    seq.seq_len += 1
-                    self._emit_token(seq, t, outputs)
-                    if self._by_id.get(seq.request_id) is not seq:
-                        # finished (EOS/stop/length): the device row may
-                        # still be live (stop sequences are host-only) —
-                        # deactivate it at the next launch
-                        self._deact_slot(slot)
+                done = False
+                for k in range(R):
+                    c = int(counts[k, slot])
+                    if c <= 0:
+                        break  # row was frozen on-device before this round
+                    for w in range(c):
+                        t = int(toks3[k, slot, w])
+                        if t < 0:
+                            break
+                        seq.token_ids.append(seq.next_token)
+                        seq.seq_len += 1
+                        emitted_here += 1
+                        self._emit_token(seq, t, outputs)
+                        if self._by_id.get(seq.request_id) is not seq:
+                            # finished (EOS/stop/length): the device row
+                            # may still be live (stop sequences are host-
+                            # only) — deactivate it at the next launch
+                            self._deact_slot(slot)
+                            done = True
+                            break
+                    if done:
                         break
             except Exception as e:  # failure isolation (Property 22)
                 if self.slots[slot] is seq:
@@ -764,6 +1176,11 @@ class LLMEngine:
                 self._release_seq(seq)
                 outputs.append(StepOutput(
                     request_id=seq.request_id, finished=True, error=str(e)))
+                continue
+            if self._by_id.get(seq.request_id) is seq:
+                delta = assumed - emitted_here
+                seq.dev_pos -= delta
+                seq.dev_steps_left += delta
 
     # ------------------------------------------------------------------
     # token emission & completion

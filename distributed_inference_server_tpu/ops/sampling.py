@@ -52,3 +52,24 @@ def sample_tokens(
 
     sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
+
+
+def top_p_filter_probs(probs: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Zero out probabilities outside the top-p nucleus (per row), keeping
+    at least the most-probable token; the result is unnormalized (callers
+    sample via ``categorical(log(probs))``, which is scale-invariant).
+
+    Args:
+      probs: [B, V] probability rows.
+      top_p: [B] nucleus thresholds (1 => unfiltered).
+
+    Returns: [B, V] filtered (unnormalized) probabilities.
+    """
+    sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
+    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+    keep_sorted = (cumprobs - sorted_probs) < top_p[:, None]
+    keep_sorted = keep_sorted.at[:, 0].set(True)
+    # smallest kept probability per row is the cutoff
+    kept = jnp.where(keep_sorted, sorted_probs, jnp.inf)
+    cutoff = jnp.min(kept, axis=-1, keepdims=True)
+    return jnp.where(probs >= cutoff, probs, 0.0)

@@ -176,6 +176,139 @@ def pp_forward(
     )
 
 
+def pp_paged_forward(
+    mesh,
+    params: llama.Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    write_slots: jnp.ndarray,
+    gather_slots: jnp.ndarray,
+    kv_valid_len: jnp.ndarray,
+    num_microbatches: int = 1,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pipeline-parallel forward over the PAGED KV pool — the serving
+    engine's hot path under a ``stage`` mesh axis (the 70B TP x PP north
+    star, BASELINE.md config 5).
+
+    Same contract as ``llama.paged_forward`` (XLA gather attention path):
+    pools are [L, num_slots, KV, D] and sharded over ``stage`` on the
+    layer axis, so each stage holds its own layers' pages; write slots and
+    gather rows are position-indexed and microbatch-sliced on the batch
+    axis. The ``tensor`` axis (if present) stays GSPMD-managed inside the
+    shard_map body, so TP composes without manual collectives. Unlike the
+    dense ``pp_forward``, the pool is carried whole through the tick loop:
+    microbatches write disjoint slots (their own rows' pages), and bubble
+    ticks write to the drop sentinel.
+    """
+    S = mesh.shape.get("stage", 1)
+    B, T = input_ids.shape
+    M = num_microbatches
+    validate_pp(cfg, S, B, M)
+    B_mb = B // M
+    num_slots = pool_k.shape[1]
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+
+    def body(layers, embed, final_norm, unembed, ids, pos, pk, pv, ws, gs,
+             kvv):
+        stage = lax.axis_index("stage")
+
+        def run_stage(h_mb, pos_mb, pk, pv, ws_mb, gs_mb, kvv_mb):
+            write_fn = lambda layer, new: layer.at[ws_mb].set(
+                new, mode="drop"
+            )
+
+            def attend_fn(q, k_layer, v_layer):
+                k_seq = k_layer[gs_mb]
+                v_seq = v_layer[gs_mb]
+                return gqa_attention(q, k_seq, v_seq, pos_mb, kvv_mb)
+
+            def blk(h, xs):
+                layer, k_l, v_l = xs
+                return llama.layer_block(
+                    cfg, layer, h, pos_mb, k_l, v_l, write_fn, attend_fn,
+                    inv_freq,
+                )
+
+            h_mb, (nk, nv) = lax.scan(blk, h_mb, (layers, pk, pv))
+            return h_mb, nk, nv
+
+        def tick(t, carry):
+            state, pk, pv, out = carry
+            mb = t - stage
+            valid = (mb >= 0) & (mb < M)
+            row = jnp.clip(mb, 0, M - 1) * B_mb
+            ids_mb = lax.dynamic_slice_in_dim(ids, row, B_mb, 0)
+            pos_mb = lax.dynamic_slice_in_dim(pos, row, B_mb, 0)
+            ws_mb = lax.dynamic_slice_in_dim(ws, row, B_mb, 0)
+            gs_mb = lax.dynamic_slice_in_dim(gs, row, B_mb, 0)
+            kvv_mb = lax.dynamic_slice_in_dim(kvv, row, B_mb, 0)
+            # bubble ticks must not mutate the pool
+            ws_eff = jnp.where(valid, ws_mb, num_slots)
+
+            h_in = jnp.where(stage == 0, embed[ids_mb], state)
+            h_out, pk, pv = run_stage(h_in, pos_mb, pk, pv, ws_eff, gs_mb,
+                                      kvv_mb)
+
+            out_upd = lax.dynamic_update_slice_in_dim(out, h_out, row, 0)
+            out = jnp.where(valid & (stage == S - 1), out_upd, out)
+
+            state = lax.ppermute(
+                h_out, "stage", [(i, i + 1) for i in range(S - 1)]
+            )
+            return state, pk, pv, out
+
+        state0 = lax.pcast(
+            jnp.zeros((B_mb, T, cfg.hidden_size), embed.dtype),
+            "stage", to="varying",
+        )
+        out0 = lax.pcast(
+            jnp.zeros((B, T, cfg.hidden_size), embed.dtype),
+            "stage", to="varying",
+        )
+        state, pk, pv, out = lax.fori_loop(
+            0, M + S - 1, tick, (state0, pk, pv, out0)
+        )
+
+        out = lax.psum(out, "stage")  # only the last stage wrote; broadcast
+        h = rms_norm(out, final_norm, cfg.rms_norm_eps)
+        logits = jnp.einsum(
+            "bth,hv->btv", h, unembed, preferred_element_type=jnp.float32
+        )
+        return logits, pk, pv
+
+    unembed = (
+        params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    )
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        axis_names={"stage"},  # tensor/data stay GSPMD-managed inside
+        in_specs=(
+            P("stage"),  # layer stacks [L, ...] -> local [L/S, ...]
+            P(),  # embed
+            P(),  # final_norm
+            P(),  # unembed
+            P(),  # ids
+            P(),  # positions
+            P("stage"),  # pool_k [L, num_slots, KV, D]
+            P("stage"),  # pool_v
+            P(),  # write_slots
+            P(),  # gather_slots
+            P(),  # kv_valid_len
+        ),
+        out_specs=(P(), P("stage"), P("stage")),
+    )
+    return fn(
+        params["layers"], params["embed"],
+        params["final_norm"], unembed,
+        input_ids, positions, pool_k, pool_v, write_slots, gather_slots,
+        kv_valid_len,
+    )
+
+
 def pp_greedy_generate(
     mesh,
     params: llama.Params,

@@ -48,33 +48,39 @@ def validate_tp(cfg: ModelConfig, tp: int) -> None:
             )
 
 
-def llama_param_specs(cfg: ModelConfig) -> Dict[str, Any]:
+def llama_param_specs(
+    cfg: ModelConfig, stage_axis: str | None = None
+) -> Dict[str, Any]:
     """PartitionSpec pytree matching ``llama.init_params`` exactly.
 
-    Layer weights are stacked [L, in, out]: axis 0 is the scan axis (never
-    sharded), so column-parallel = spec on axis 2, row-parallel = axis 1.
+    Layer weights are stacked [L, in, out]: axis 0 is the scan axis —
+    unsharded under pure TP, or split over ``stage_axis`` when pipeline
+    parallelism is active (each stage holds its contiguous layer slice,
+    parallel/pp.py). Column-parallel = spec on axis 2, row-parallel =
+    axis 1.
     """
+    st = stage_axis
     layers: Dict[str, Any] = {
-        "attn_norm": P(None, None),
-        "wq": P(None, None, "tensor"),
-        "wk": P(None, None, "tensor"),
-        "wv": P(None, None, "tensor"),
-        "wo": P(None, "tensor", None),
-        "mlp_norm": P(None, None),
+        "attn_norm": P(st, None),
+        "wq": P(st, None, "tensor"),
+        "wk": P(st, None, "tensor"),
+        "wv": P(st, None, "tensor"),
+        "wo": P(st, "tensor", None),
+        "mlp_norm": P(st, None),
     }
     if cfg.is_moe:
         layers.update(
-            router=P(None, None, None),
+            router=P(st, None, None),
             # [L, E, in, out]: experts on "expert", features on "tensor"
-            w_gate=P(None, "expert", None, "tensor"),
-            w_up=P(None, "expert", None, "tensor"),
-            w_down=P(None, "expert", "tensor", None),
+            w_gate=P(st, "expert", None, "tensor"),
+            w_up=P(st, "expert", None, "tensor"),
+            w_down=P(st, "expert", "tensor", None),
         )
     else:
         layers.update(
-            w_gate=P(None, None, "tensor"),
-            w_up=P(None, None, "tensor"),
-            w_down=P(None, "tensor", None),
+            w_gate=P(st, None, "tensor"),
+            w_up=P(st, None, "tensor"),
+            w_down=P(st, "tensor", None),
         )
     specs: Dict[str, Any] = {
         "embed": P(None, None),
@@ -86,12 +92,16 @@ def llama_param_specs(cfg: ModelConfig) -> Dict[str, Any]:
     return specs
 
 
-def kv_pool_spec() -> P:
-    """Paged KV pool [L, num_slots, KV_heads, D]: KV heads on 'tensor'."""
-    return P(None, None, "tensor", None)
+def kv_pool_spec(stage_axis: str | None = None) -> P:
+    """Paged KV pool [L, num_slots, KV_heads, D]: KV heads on 'tensor';
+    layers on ``stage_axis`` under pipeline parallelism."""
+    return P(stage_axis, None, "tensor", None)
 
 
-def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
+def shard_params(
+    params: Params, mesh: Mesh, cfg: ModelConfig,
+    stage_axis: str | None = None,
+) -> Params:
     """Place parameters onto the mesh per the TP layout (the weight-loading
     "restore" path — SURVEY.md §5 checkpoint/resume equivalent: safetensors
     → host → sharded device buffers). Quantized weights (ops/quant.py)
@@ -99,7 +109,7 @@ def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
     column/row-parallel axes line up."""
     from distributed_inference_server_tpu.ops.quant import is_quantized
 
-    specs = llama_param_specs(cfg)
+    specs = llama_param_specs(cfg, stage_axis=stage_axis)
 
     def place(spec, leaf):
         sh = NamedSharding(mesh, spec)

@@ -54,6 +54,10 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # weight-only quantization: none | int8 | int4 (ops/quant.py; the
         # reference's GGUF quantization levels, design.md:324-332 [spec])
         "quantization": (str, "none"),
+        # speculative decoding (Req 12): a draft model configured on the
+        # server enables speculation inside the serving engine
+        "draft_model_name": (str, ""),
+        "draft_model_dir": (str, ""),
     },
     "engine": {
         "tensor_parallel": (int, 1),
@@ -62,6 +66,15 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "page_size": (int, 16),
         "num_pages": (int, 512),
         "max_pages_per_seq": (int, 64),
+        # decode-block pipelining (engine/engine.py): device steps (or
+        # speculative rounds) per compiled block, and blocks in flight
+        "decode_block_size": (int, 8),
+        "pipeline_depth": (int, 1),
+        "prefill_batch": (int, 4),
+        "prefill_token_budget": (int, 2048),
+        # speculative decoding knobs (Req 12.3-12.5)
+        "num_draft_tokens": (int, 4),
+        "spec_disable_threshold": (float, 0.5),
     },
     "queue": {
         "high_watermark": (int, 1000),

@@ -44,9 +44,13 @@ class EngineStatus:
     total_processed: int
     memory_used_pages: int = 0
     memory_total_pages: int = 0
+    # speculative-decoding stats (Req 12.4): acceptance_rate,
+    # estimated_speedup, enabled, num_draft_tokens — None when no draft
+    # model is configured
+    speculation: Any = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "engine_id": self.engine_id,
             "healthy": self.healthy,
             "active_requests": self.active_requests,
@@ -55,6 +59,9 @@ class EngineStatus:
             "memory_used_pages": self.memory_used_pages,
             "memory_total_pages": self.memory_total_pages,
         }
+        if self.speculation is not None:
+            d["speculation"] = self.speculation
+        return d
 
 
 @dataclass(frozen=True)
@@ -147,6 +154,22 @@ class MetricsCollector:
         self.active_requests_g = Gauge(
             "active_requests", "Requests admitted and not yet finished", registry=r
         )
+        self.spec_acceptance = Gauge(
+            "speculation_acceptance_rate",
+            "Rolling draft-token acceptance rate (Req 12.3)", ["engine_id"],
+            registry=r,
+        )
+        self.spec_speedup = Gauge(
+            "speculation_estimated_speedup",
+            "Tokens emitted per target forward (>= 1)", ["engine_id"],
+            registry=r,
+        )
+        self.spec_enabled = Gauge(
+            "speculation_enabled",
+            "1 while speculation is active (auto-disables below threshold, "
+            "Req 12.5)", ["engine_id"],
+            registry=r,
+        )
         self.engine_up = Gauge(
             "engine_up", "1 if the engine replica is healthy", ["engine_id"],
             registry=r,
@@ -228,6 +251,18 @@ class MetricsCollector:
 
     def set_engine_up(self, engine_id: str, up: bool) -> None:
         self.engine_up.labels(engine_id=engine_id).set(1 if up else 0)
+
+    def set_speculation(self, engine_id: str, stats: Dict[str, Any]) -> None:
+        """Export speculative-decoding gauges (Req 12.4)."""
+        self.spec_acceptance.labels(engine_id=engine_id).set(
+            stats.get("acceptance_rate", 0.0)
+        )
+        self.spec_speedup.labels(engine_id=engine_id).set(
+            stats.get("estimated_speedup", 1.0)
+        )
+        self.spec_enabled.labels(engine_id=engine_id).set(
+            1 if stats.get("enabled") else 0
+        )
 
     # -- rendering ---------------------------------------------------------
 

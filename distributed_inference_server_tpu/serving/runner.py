@@ -292,11 +292,15 @@ class EngineRunner:
         eng = self._engine
         used = total = 0
         waiting = 0
+        speculation = None
         if eng is not None:
             try:
                 s = eng.cache_stats()
                 used, total = s.pages_total - s.pages_free, s.pages_total
                 waiting = eng.num_waiting()
+                speculation = eng.spec_stats()
+                if speculation is not None and self.metrics:
+                    self.metrics.set_speculation(self.engine_id, speculation)
             except Exception:  # noqa: BLE001 — status must never raise
                 pass
         return EngineStatus(
@@ -307,6 +311,7 @@ class EngineRunner:
             total_processed=self._total_processed,
             memory_used_pages=used,
             memory_total_pages=total,
+            speculation=speculation,
         )
 
     # -- runner thread ----------------------------------------------------

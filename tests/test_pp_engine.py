@@ -1,0 +1,103 @@
+"""Pipeline parallelism driven by the serving engine over the paged KV
+cache (the 70B TP x PP north-star structure, BASELINE.md config 5): an
+LLMEngine on a (stage, tensor) mesh must produce exactly the single-device
+engine's greedy tokens, through admission, batched prefill, decode blocks,
+and prefix reuse."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+from distributed_inference_server_tpu.parallel import MeshSpec, make_mesh
+
+TOK = ByteTokenizer()
+
+ECFG = EngineConfig(
+    max_batch=2,
+    prefill_buckets=(8, 32),
+    paged=PagedCacheConfig(num_pages=32, page_size=4, max_pages_per_seq=8),
+    decode_block_size=4,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+
+
+def run(engine, max_steps=400):
+    results = {}
+    for _ in range(max_steps):
+        if not engine.has_work():
+            break
+        for out in engine.step():
+            r = results.setdefault(out.request_id,
+                                   {"tokens": [], "error": None})
+            if out.token_id is not None:
+                r["tokens"].append(out.token_id)
+            if out.finished:
+                r["error"] = out.error
+    assert not engine.has_work()
+    return results
+
+
+GREEDY = SamplingParams(max_tokens=10, temperature=0.0)
+
+
+@pytest.mark.parametrize("spec", [
+    MeshSpec(stage=2),              # pure PP
+    MeshSpec(stage=2, tensor=2),    # PP x TP composition
+])
+def test_engine_pp_matches_single_device(tiny_params, spec):
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 virtual devices")
+    plain = LLMEngine(tiny_params, TINY, TOK, ECFG, dtype=jnp.float32)
+    pp = LLMEngine(tiny_params, TINY, TOK, ECFG, dtype=jnp.float32,
+                   mesh=make_mesh(spec))
+    prompts = {f"r{i}": TOK.encode(f"pp prompt {i}") for i in range(3)}
+    for rid, ids in prompts.items():
+        plain.add_request(rid, ids, GREEDY)
+        pp.add_request(rid, ids, GREEDY)
+    expected = run(plain)
+    got = run(pp)
+    for rid in prompts:
+        assert got[rid]["error"] is None
+        assert got[rid]["tokens"] == expected[rid]["tokens"], rid
+
+
+def test_engine_pp_microbatched(tiny_params):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 virtual devices")
+    ecfg = EngineConfig(
+        max_batch=2,
+        prefill_buckets=(8, 32),
+        paged=PagedCacheConfig(num_pages=32, page_size=4,
+                               max_pages_per_seq=8),
+        decode_block_size=3,
+        pp_microbatches=2,
+        prefill_batch=2,
+    )
+    plain = LLMEngine(tiny_params, TINY, TOK, ECFG, dtype=jnp.float32)
+    pp = LLMEngine(tiny_params, TINY, TOK, ecfg, dtype=jnp.float32,
+                   mesh=make_mesh(MeshSpec(stage=2)))
+    prompt = TOK.encode("microbatch")
+    plain.add_request("r", prompt, GREEDY)
+    pp.add_request("r", prompt, GREEDY)
+    assert run(pp)["r"]["tokens"] == run(plain)["r"]["tokens"]
+
+
+def test_engine_pp_validates_layer_divisibility(tiny_params):
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 virtual devices")
+    with pytest.raises(ValueError, match="stages do not divide"):
+        LLMEngine(tiny_params, TINY, TOK, ECFG, dtype=jnp.float32,
+                  mesh=make_mesh(MeshSpec(stage=4)))

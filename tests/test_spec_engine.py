@@ -1,0 +1,174 @@
+"""Speculative decoding integrated in the continuous-batching engine
+(Req 12, requirements.md:164-170): greedy bit-exactness vs the plain
+decode path, acceptance tracking, auto-disable fallback, and top-p rows
+riding along with forced rejection."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_inference_server_tpu.core.models import FinishReason
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+from distributed_inference_server_tpu.engine.speculative import SpecConfig
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.generate import greedy_generate
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+TOK = ByteTokenizer()
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    # a *different* tiny model as the draft: realistic partial acceptance
+    return llama.init_params(jax.random.PRNGKey(7), TINY, dtype=jnp.float32)
+
+
+def make_engine(tiny_params, draft=None, spec=None, max_batch=2, rounds=3):
+    return LLMEngine(
+        tiny_params, TINY, TOK,
+        EngineConfig(
+            max_batch=max_batch,
+            prefill_buckets=(8, 32),
+            paged=PagedCacheConfig(num_pages=64, page_size=4,
+                                   max_pages_per_seq=16),
+            decode_block_size=rounds,
+        ),
+        dtype=jnp.float32,
+        draft_params=draft,
+        draft_cfg=TINY if draft is not None else None,
+        spec=spec,
+    )
+
+
+def run(engine, max_steps=500):
+    results = {}
+    for _ in range(max_steps):
+        if not engine.has_work():
+            break
+        for out in engine.step():
+            r = results.setdefault(
+                out.request_id,
+                {"text": "", "tokens": [], "finish": None, "error": None},
+            )
+            r["text"] += out.text
+            if out.token_id is not None:
+                r["tokens"].append(out.token_id)
+            if out.finished:
+                r["finish"] = out.finish_reason
+                r["error"] = out.error
+    assert not engine.has_work(), "engine did not drain"
+    return results
+
+
+GREEDY = SamplingParams(max_tokens=12, temperature=0.0)
+
+
+def test_spec_greedy_bit_exact_same_draft(tiny_params):
+    """Draft == target: every proposal accepted, output still must be the
+    plain greedy sequence."""
+    engine = make_engine(tiny_params, draft=tiny_params,
+                         spec=SpecConfig(num_draft_tokens=3))
+    prompt = TOK.encode("hello spec")
+    engine.add_request("r", prompt, GREEDY)
+    out = run(engine)["r"]
+    expected = greedy_generate(
+        tiny_params, TINY, prompt, max_new_tokens=12, max_seq=64,
+        eos_ids=TOK.eos_ids,
+    )
+    assert out["tokens"] == expected
+    assert out["finish"] == FinishReason.LENGTH
+    stats = engine.spec_stats()
+    assert stats is not None and stats["enabled"]
+    assert stats["acceptance_rate"] == 1.0  # greedy, identical models
+
+
+def test_spec_greedy_bit_exact_different_draft(tiny_params, draft_params):
+    """Speculative decoding is exact regardless of the draft: greedy
+    output matches the plain engine token-for-token."""
+    spec = make_engine(tiny_params, draft=draft_params,
+                       spec=SpecConfig(num_draft_tokens=4))
+    plain = make_engine(tiny_params)
+    prompts = {f"r{i}": TOK.encode(f"prompt {i} xyz") for i in range(3)}
+    for rid, ids in prompts.items():
+        spec.add_request(rid, ids, GREEDY)
+        plain.add_request(rid, ids, GREEDY)
+    spec_out = run(spec)
+    plain_out = run(plain)
+    for rid in prompts:
+        assert spec_out[rid]["tokens"] == plain_out[rid]["tokens"], rid
+    stats = spec.spec_stats()
+    assert stats is not None
+    assert 0.0 <= stats["acceptance_rate"] <= 1.0
+    assert stats["estimated_speedup"] >= 1.0
+
+
+def test_spec_auto_disable_falls_back(tiny_params, draft_params):
+    """A disabled tracker must fall back to plain decode blocks and still
+    produce the exact greedy output (Req 12.5)."""
+    engine = make_engine(tiny_params, draft=draft_params,
+                         spec=SpecConfig(num_draft_tokens=3))
+    engine.spec_tracker._disabled = True
+    prompt = TOK.encode("fallback")
+    engine.add_request("r", prompt, GREEDY)
+    out = run(engine)["r"]
+    expected = greedy_generate(
+        tiny_params, TINY, prompt, max_new_tokens=12, max_seq=64,
+        eos_ids=TOK.eos_ids,
+    )
+    assert out["tokens"] == expected
+    assert engine.spec_stats()["enabled"] is False
+
+
+def test_spec_topp_rows_ride_along(tiny_params, draft_params):
+    """top-p rows can't be verified exactly; they emit one filtered token
+    per round (forced rejection) while greedy batch-mates speculate —
+    both must finish correctly."""
+    engine = make_engine(tiny_params, draft=draft_params,
+                         spec=SpecConfig(num_draft_tokens=3))
+    engine.add_request("greedy", TOK.encode("aaa"), GREEDY)
+    engine.add_request(
+        "topp", TOK.encode("bbb"),
+        SamplingParams(max_tokens=6, temperature=0.8, top_p=0.9),
+    )
+    out = run(engine)
+    expected = greedy_generate(
+        tiny_params, TINY, TOK.encode("aaa"), max_new_tokens=12, max_seq=64,
+        eos_ids=TOK.eos_ids,
+    )
+    assert out["greedy"]["tokens"] == expected
+    assert out["topp"]["error"] is None
+    assert len(out["topp"]["tokens"]) <= 6
+    assert out["topp"]["finish"] is not None
+
+
+def test_spec_stop_sequence_and_page_accounting(tiny_params, draft_params):
+    """Stop sequences (host-side) truncate speculative bursts; no page
+    leaks afterwards."""
+    engine = make_engine(tiny_params, draft=draft_params,
+                         spec=SpecConfig(num_draft_tokens=3))
+    prompt = TOK.encode("hello")
+    engine.add_request("probe", prompt, GREEDY)
+    text = run(engine)["probe"]["text"]
+    assert len(text) >= 3
+    stop = text[1:3]
+    engine.add_request(
+        "s", prompt,
+        SamplingParams(max_tokens=12, temperature=0.0,
+                       stop_sequences=(stop,)),
+    )
+    r = run(engine)["s"]
+    assert r["finish"] == FinishReason.STOP_SEQUENCE
+    assert stop not in r["text"]
+    s = engine.allocator.stats()
+    assert s.pages_free + s.pages_cached == s.pages_total
