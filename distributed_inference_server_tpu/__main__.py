@@ -56,6 +56,8 @@ def main(argv=None) -> int:
         pipeline_depth=cfg.get("engine", "pipeline_depth"),
         prefill_batch=cfg.get("engine", "prefill_batch"),
         prefill_token_budget=cfg.get("engine", "prefill_token_budget"),
+        pp_microbatches=cfg.get("engine", "pp_microbatches"),
+        cp_min_tokens=cfg.get("engine", "cp_min_tokens") or None,
     )
     tokenizer = load_tokenizer(model_dir)
 
@@ -74,14 +76,37 @@ def main(argv=None) -> int:
         )
 
     tp = cfg.get("engine", "tensor_parallel")
+    pp = cfg.get("engine", "pipeline_parallel")
+    cp = cfg.get("engine", "context_parallel")
+    per_replica = tp * pp * cp
     num_engines = cfg.get("server", "num_engines")
-    if tp > 1:
+    # combinations the engine rejects must fail here as a config error
+    # (Property 27: exit non-zero on invalid config), not per-replica at
+    # construction time with every engine marked unhealthy
+    if cp > 1 and pp > 1:
+        print(
+            "config error: engine.context_parallel > 1 with "
+            "engine.pipeline_parallel > 1 is not supported",
+            file=sys.stderr,
+        )
+        return 2
+    has_draft = bool(cfg.get("model", "draft_model_dir")
+                     or cfg.get("model", "draft_model_name"))
+    if has_draft and pp > 1:
+        print(
+            "config error: speculative decoding (model.draft_model_*) "
+            "with engine.pipeline_parallel > 1 is not supported",
+            file=sys.stderr,
+        )
+        return 2
+    if per_replica > 1:
         import jax
 
-        needed = tp * num_engines
+        needed = per_replica * num_engines
         if needed > len(jax.devices()):
             print(
-                f"config error: {num_engines} engines x tensor_parallel={tp} "
+                f"config error: {num_engines} engines x (tensor_parallel="
+                f"{tp} x pipeline_parallel={pp} x context_parallel={cp}) "
                 f"needs {needed} devices, have {len(jax.devices())}",
                 file=sys.stderr,
             )
@@ -104,7 +129,7 @@ def main(argv=None) -> int:
 
             params = quantize_params(params, quant)
         mesh = None
-        if tp > 1:
+        if per_replica > 1:
             import jax
 
             from distributed_inference_server_tpu.parallel import (
@@ -113,9 +138,11 @@ def main(argv=None) -> int:
             )
 
             # each replica gets a DISJOINT device slice: replica i owns
-            # devices [i*tp, (i+1)*tp)
-            devs = jax.devices()[replica_idx * tp : (replica_idx + 1) * tp]
-            mesh = make_mesh(MeshSpec(tensor=tp), devs)
+            # devices [i*per_replica, (i+1)*per_replica)
+            devs = jax.devices()[
+                replica_idx * per_replica : (replica_idx + 1) * per_replica
+            ]
+            mesh = make_mesh(MeshSpec(tensor=tp, stage=pp, seq=cp), devs)
         # speculative decoding (Req 12.1): a draft model configured on the
         # server enables speculation inside the continuous-batching engine
         draft_params = draft_cfg_m = spec = None

@@ -46,9 +46,9 @@ from distributed_inference_server_tpu.models.configs import ModelConfig
 
 @dataclass(frozen=True)
 class PagedCacheConfig:
-    num_pages: int = 256
+    num_pages: int = 1024
     page_size: int = 16  # tokens per page
-    max_pages_per_seq: int = 16
+    max_pages_per_seq: int = 128  # 2048-token default context per sequence
 
     @property
     def max_seq_len(self) -> int:

@@ -103,6 +103,83 @@ def _probs(logits: jnp.ndarray, temperature: jnp.ndarray) -> jnp.ndarray:
     return jnp.where((temperature <= 0.0)[..., None], greedy, sampled)
 
 
+def accept_and_resample(
+    target_ps: jnp.ndarray,  # [B, gamma+1, V] target distributions
+    draft_toks: jnp.ndarray,  # [B, gamma] draft proposals
+    draft_qs: jnp.ndarray,  # [B, gamma, V] draft distributions
+    u_key: jax.Array,
+    resample_key: jax.Array,
+    spec_ok: jnp.ndarray | None = None,  # [B] rows verifiable exactly
+    top_p: jnp.ndarray | None = None,  # [B] filter for spec_ok=False rows
+):
+    """Shared rejection-sampling core of one speculative round — the
+    accept/resample math used by BOTH the dense-cache ``spec_round`` and
+    the engine's paged speculative block (engine.py ``_build_spec_block``),
+    so fixes to this subtle probability code apply everywhere.
+
+    Per row: accept the longest prefix of draft tokens where
+    u < min(1, p/q); sample the next token from norm(max(p - q, 0)) at the
+    first rejection (from the target's bonus distribution when everything
+    is accepted — then q := 0). ``spec_ok``=False rows (top-p requests,
+    which cannot be verified exactly) force rejection at position 0 and
+    draw their single token from the ``top_p``-filtered target
+    distribution — one exactly-sampled token per round.
+
+    Returns (tokens [B, gamma+1] where row r's valid prefix is
+    tokens[r, :num_accepted[r]+1], num_accepted [B] in [0, gamma]).
+    """
+    B, gamma = draft_toks.shape
+    rows = jnp.arange(B)
+    p_at = jnp.take_along_axis(
+        target_ps[:, :gamma], draft_toks[..., None], axis=-1
+    )[..., 0]  # [B, gamma] p_i(d_i)
+    q_at = jnp.take_along_axis(
+        draft_qs, draft_toks[..., None], axis=-1
+    )[..., 0]
+    u = jax.random.uniform(u_key, (B, gamma))
+    accept = u < jnp.minimum(1.0, p_at / jnp.maximum(q_at, 1e-30))
+    # accepted prefix length: first False position (gamma if none)
+    num_accepted = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), 1), 1)
+    if spec_ok is not None:
+        num_accepted = jnp.where(spec_ok, num_accepted, 0)
+
+    # distribution at the first rejection: norm(max(p - q, 0)); when all
+    # accepted, the bonus comes from the target's gamma-th distribution
+    rejected = num_accepted < gamma
+    if spec_ok is not None:
+        rejected = rejected & spec_ok
+    p_rej = target_ps[rows, num_accepted]  # [B, V]
+    q_rej = jnp.where(
+        rejected[:, None],
+        draft_qs[rows, jnp.minimum(num_accepted, gamma - 1)],
+        jnp.zeros_like(p_rej),
+    )
+    if top_p is not None and spec_ok is not None:
+        from distributed_inference_server_tpu.ops.sampling import (
+            top_p_filter_probs,
+        )
+
+        p_rej = jnp.where(
+            spec_ok[:, None], p_rej, top_p_filter_probs(p_rej, top_p)
+        )
+    resid = jnp.maximum(p_rej - q_rej, 0.0)
+    resid_sum = jnp.sum(resid, axis=-1, keepdims=True)
+    # numerical corner (p == q exactly): fall back to the target dist
+    resid = jnp.where(resid_sum > 1e-30, resid, p_rej)
+    extra = jax.random.categorical(
+        resample_key, jnp.log(resid + 1e-30), axis=-1
+    ).astype(jnp.int32)  # [B]
+
+    # tokens emitted this round: accepted draft prefix + extra token
+    idx = jnp.arange(gamma + 1)[None]
+    tokens = jnp.where(
+        idx < num_accepted[:, None],
+        jnp.pad(draft_toks, ((0, 0), (0, 1))),
+        jnp.where(idx == num_accepted[:, None], extra[:, None], 0),
+    )
+    return tokens, num_accepted
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("draft_cfg", "cfg", "gamma"),
@@ -165,41 +242,9 @@ def spec_round(
     )
     target_ps = _probs(logits, temperature[:, None])  # [B, g+1, V]
 
-    # ---- rejection sampling ---------------------------------------------
-    rows = jnp.arange(B)
-    p_at = jnp.take_along_axis(
-        target_ps[:, :gamma], draft_toks[..., None], axis=-1
-    )[..., 0]  # [B, gamma] p_i(d_i)
-    q_at = jnp.take_along_axis(
-        draft_qs, draft_toks[..., None], axis=-1
-    )[..., 0]
-    u = jax.random.uniform(rngs[gamma + 1], (B, gamma))
-    accept = u < jnp.minimum(1.0, p_at / jnp.maximum(q_at, 1e-30))
-    # accepted prefix length: first False position (gamma if none)
-    num_accepted = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), 1), 1)
-
-    # distribution at the first rejection: norm(max(p - q, 0)); when all
-    # accepted, the bonus comes from the target's gamma-th distribution
-    p_rej = target_ps[rows, num_accepted]  # [B, V]
-    q_rej = jnp.where(
-        (num_accepted < gamma)[:, None],
-        draft_qs[rows, jnp.minimum(num_accepted, gamma - 1)],
-        jnp.zeros_like(p_rej),
-    )
-    resid = jnp.maximum(p_rej - q_rej, 0.0)
-    resid_sum = jnp.sum(resid, axis=-1, keepdims=True)
-    # numerical corner (p == q exactly): fall back to the target dist
-    resid = jnp.where(resid_sum > 1e-30, resid, p_rej)
-    extra = jax.random.categorical(
-        rngs[gamma + 2], jnp.log(resid + 1e-30), axis=-1
-    )  # [B]
-
-    # tokens emitted this round: accepted draft prefix + extra token
-    idx = jnp.arange(gamma + 1)[None]
-    tokens = jnp.where(
-        idx < num_accepted[:, None],
-        jnp.pad(draft_toks, ((0, 0), (0, 1))),
-        jnp.where(idx == num_accepted[:, None], extra[:, None], 0),
+    # ---- rejection sampling (shared core) -------------------------------
+    tokens, num_accepted = accept_and_resample(
+        target_ps, draft_toks, draft_qs, rngs[gamma + 1], rngs[gamma + 2]
     )
     num_emitted = num_accepted + 1
     if live is not None:

@@ -76,6 +76,36 @@ def cp_prefill(
     return logits, new_k, new_v
 
 
+def cp_paged_prefill(
+    params: llama.Params,
+    cfg: ModelConfig,
+    mesh,
+    input_ids: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    write_slots: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Ring prefill that lands in the paged pool — the dense-KV→pages
+    hand-off the engine's long-prompt admission path uses (the reference
+    had no long-context path at all; context hard-capped at 8192,
+    ``validator.rs:20``).
+
+    Runs ``cp_prefill`` (sequence sharded over the ``seq`` mesh axis,
+    ring attention over ICI), then scatters the position-ordered dense
+    K/V into the flat page pools at per-token ``write_slots`` ([B, T]
+    flat slot per position, >= num_slots drops the write — padding).
+    After this the prompt decodes from pages like any other sequence.
+
+    Returns (last_logits [B, V] f32, new pool_k, new pool_v).
+    """
+    logits, k, v = cp_prefill(params, cfg, mesh, input_ids, valid_len)
+    # k, v: [L, B, T, KV, D] slot==position; pool: [L, num_slots, KV, D]
+    pool_k = pool_k.at[:, write_slots].set(k.astype(pool_k.dtype), mode="drop")
+    pool_v = pool_v.at[:, write_slots].set(v.astype(pool_v.dtype), mode="drop")
+    return logits, pool_k, pool_v
+
+
 def cp_shardings(mesh):
     """(ids, valid) input shardings for jitting ``cp_prefill``."""
     return (

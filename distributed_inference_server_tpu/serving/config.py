@@ -61,11 +61,20 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
     },
     "engine": {
         "tensor_parallel": (int, 1),
+        # pipeline stages (parallel/pp.py) and context-parallel ring-
+        # prefill width (parallel/cp.py) — per-replica mesh axes alongside
+        # tensor_parallel; a replica owns tensor*stage*seq devices
+        "pipeline_parallel": (int, 1),
+        "pp_microbatches": (int, 1),
+        "context_parallel": (int, 1),
+        # prompts at least this long take the ring-prefill path when
+        # context_parallel > 1 (0 = auto: one past the largest bucket)
+        "cp_min_tokens": (int, 0),
         "max_batch": (int, 8),
         "prefill_buckets": (list, [32, 128, 512]),
         "page_size": (int, 16),
-        "num_pages": (int, 512),
-        "max_pages_per_seq": (int, 64),
+        "num_pages": (int, 2048),
+        "max_pages_per_seq": (int, 512),
         # decode-block pipelining (engine/engine.py): device steps (or
         # speculative rounds) per compiled block, and blocks in flight
         "decode_block_size": (int, 8),
@@ -266,6 +275,8 @@ class ServerConfig:
         for sec, key in (
             ("server", "port"), ("server", "num_engines"),
             ("engine", "tensor_parallel"),
+            ("engine", "pipeline_parallel"), ("engine", "pp_microbatches"),
+            ("engine", "context_parallel"),
             ("engine", "max_batch"), ("engine", "page_size"),
             ("engine", "num_pages"), ("engine", "max_pages_per_seq"),
             ("queue", "high_watermark"), ("queue", "low_watermark"),

@@ -172,3 +172,51 @@ def test_spec_stop_sequence_and_page_accounting(tiny_params, draft_params):
     assert stop not in r["text"]
     s = engine.allocator.stats()
     assert s.pages_free + s.pages_cached == s.pages_total
+
+
+class TestSpecPageCoverage:
+    """Regression: with blocks in flight, the projected dev_steps_left is
+    only a LOWER bound on the device row's remaining steps (speculative
+    rounds emit fewer tokens than assumed when acceptance < 100%), so page
+    pre-allocation must keep covering the conserved end dev_pos +
+    dev_steps_left + gamma — a projection <= 0 must NOT zero the coverage
+    while a block is pending, or the device writes K/V through stale
+    block-table entries into other sequences' pages."""
+
+    def test_assumed_adv_covers_conserved_end_with_pending(
+        self, tiny_params, draft_params
+    ):
+        eng = make_engine(tiny_params, draft=draft_params,
+                          spec=SpecConfig(num_draft_tokens=3), rounds=2)
+        gamma = eng.spec.num_draft_tokens
+
+        class FakeSeq:
+            dev_pos = 40
+            dev_steps_left = -2  # projection after an assumed-8 launch
+
+        eng._pending.append(object())  # a block is in flight
+        # conserved end = dev_pos + dsl + gamma - 1 = 41: one more slot
+        assert eng._assumed_adv(FakeSeq(), True) == 1
+        eng._pending.clear()
+        # host view exact: the row is genuinely frozen
+        assert eng._assumed_adv(FakeSeq(), True) == 0
+
+    def test_partial_acceptance_under_pipelining_is_correct(
+        self, tiny_params, draft_params
+    ):
+        # draft != target => partial acceptance; pipeline_depth=1 keeps a
+        # block in flight at every launch. Output must still be greedy-
+        # bit-exact (corrupted KV would flip tokens).
+        eng = make_engine(tiny_params, draft=draft_params,
+                          spec=SpecConfig(num_draft_tokens=3), rounds=2)
+        ids = TOK.encode("speculate under pipelining")
+        eng.add_request("r", ids, SamplingParams(
+            max_tokens=24, temperature=0.0))
+        got = []
+        while eng.has_work():
+            for out in eng.step():
+                assert out.error is None, out.error
+                if out.token_id is not None:
+                    got.append(out.token_id)
+        ref = list(greedy_generate(tiny_params, TINY, ids, 24))
+        assert got == ref[: len(got)] and len(got) == 24
