@@ -313,6 +313,12 @@ class LLMEngine:
         # [(slot, seq)] snapshot at launch)
         self._pending: Deque[Tuple[jnp.ndarray, List[Tuple[int, _Seq]]]] = deque()
 
+        # step-scoped device-trace capture (utils/profiler.py):
+        # (n, base_dir, event, holder) armed by profile_steps(); active
+        # capture is [steps_left, TraceSession, event, holder]
+        self._prof_req = None
+        self._prof_active = None
+
         # jit caches
         self._fwd = self._make_fwd()
         self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
@@ -375,6 +381,7 @@ class LLMEngine:
         therefore arrive in bursts of up to ``decode_block_size`` per
         sequence, ``pipeline_depth`` blocks behind the device."""
         outputs: List[StepOutput] = []
+        self._prof_begin()
         self._admit(outputs)
         self._prefill_quantum(outputs)
         launched = self._maybe_launch(outputs)
@@ -382,7 +389,61 @@ class LLMEngine:
             len(self._pending) > self.ecfg.pipeline_depth or not launched
         ):
             self._process_block(outputs)
+        self._prof_end_step()
         return outputs
+
+    def profile_steps(self, n: int, base_dir: Optional[str] = None):
+        """Arm a device-trace capture (utils/profiler.py) spanning the next
+        ``n`` engine steps — the SURVEY §5 "trace per decode step" bar.
+        Returns (event, holder): the event is set when the capture
+        finalizes and ``holder`` then carries the trace summary (or an
+        ``error`` key). Capture begins at the next step() call, so an idle
+        engine captures nothing until work arrives."""
+        import threading as _threading
+
+        ev = _threading.Event()
+        holder: Dict[str, object] = {}
+        self._prof_req = (max(1, int(n)), base_dir, ev, holder)
+        return ev, holder
+
+    def cancel_profile(self, holder) -> None:
+        """Disarm a not-yet-started capture (timed-out waiter): a trace
+        nobody consumes must not start later and hold the global profiler
+        lock. Already-active captures run to completion."""
+        if self._prof_req is not None and self._prof_req[3] is holder:
+            self._prof_req = None
+
+    def _prof_begin(self) -> None:
+        if self._prof_req is None or self._prof_active is not None:
+            return
+        n, base_dir, ev, holder = self._prof_req
+        self._prof_req = None
+        try:
+            from distributed_inference_server_tpu.utils.profiler import (
+                TraceSession,
+            )
+
+            session = TraceSession(base_dir)
+        except Exception as e:  # noqa: BLE001 — e.g. capture in progress
+            holder["error"] = str(e)
+            ev.set()
+            return
+        self._prof_active = [n, session, ev, holder]
+
+    def _prof_end_step(self) -> None:
+        if self._prof_active is None:
+            return
+        self._prof_active[0] -= 1
+        if self._prof_active[0] > 0:
+            return
+        _, session, ev, holder = self._prof_active
+        self._prof_active = None
+        try:
+            holder.update(session.stop())
+            holder["mode"] = "steps"
+        except Exception as e:  # noqa: BLE001 — profiler teardown failure
+            holder["error"] = str(e)
+        ev.set()
 
     def cache_stats(self):
         return self.allocator.stats()

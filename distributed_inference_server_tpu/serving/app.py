@@ -208,6 +208,65 @@ def build_app(
             {"spans": [s.to_dict() for s in tracer.recent(n, trace_id)]}
         )
 
+    async def profile(request: web.Request) -> web.Response:
+        """Device-trace capture (SURVEY §5 device-tracing bar;
+        utils/profiler.py). Body: {"steps": N} traces the next N engine
+        steps on one replica (optional "engine_id"), or
+        {"duration_ms": M} traces a wall-clock window process-wide.
+        Returns the TensorBoard trace directory."""
+        obj = await _json_body(request)
+        loop = asyncio.get_running_loop()
+        if "steps" in obj:
+            steps = obj.get("steps")
+            if not isinstance(steps, int) or not 1 <= steps <= 1000:
+                return web.json_response(
+                    {"error": {"message": "'steps' must be an integer "
+                               "in [1, 1000]",
+                               "error_type": "invalid_request_error",
+                               "code": "invalid_body"}},
+                    status=400,
+                )
+            runners = handler.dispatcher.scheduler.engines()
+            engine_id = obj.get("engine_id")
+            if engine_id is not None:
+                runners = [r for r in runners if r.engine_id == engine_id]
+            if not runners:
+                return web.json_response(
+                    {"error": {"message": "no such engine",
+                               "error_type": "invalid_request_error",
+                               "code": "invalid_body"}},
+                    status=400,
+                )
+            timeout_s = float(obj.get("timeout_s", 30.0))
+            result = await loop.run_in_executor(
+                None, runners[0].profile_steps, steps, timeout_s
+            )
+            result.setdefault("engine_id", runners[0].engine_id)
+        else:
+            ms = obj.get("duration_ms", 500)
+            if not isinstance(ms, (int, float)) or not 0 < ms <= 60_000:
+                return web.json_response(
+                    {"error": {"message": "'duration_ms' must be in "
+                               "(0, 60000]",
+                               "error_type": "invalid_request_error",
+                               "code": "invalid_body"}},
+                    status=400,
+                )
+            from distributed_inference_server_tpu.utils.profiler import (
+                capture_duration,
+            )
+
+            def _cap():
+                try:
+                    return capture_duration(ms / 1000.0)
+                except Exception as e:  # noqa: BLE001 — capture busy etc.
+                    return {"error": str(e)}
+
+            result = await loop.run_in_executor(None, _cap)
+        status = 409 if "error" in result else 200
+        return web.json_response(result, status=status)
+
+    app.router.add_post("/server/profile", profile)
     app.router.add_get("/server/trace", trace)
     app.router.add_post("/admin/model-swap", model_swap)
     app.router.add_post("/generate", generate)

@@ -222,6 +222,32 @@ class EngineRunner:
 
         self._post(_do)
 
+    def profile_steps(self, n: int, timeout_s: float = 30.0) -> dict:
+        """Capture a device trace over the next ``n`` engine steps
+        (utils/profiler.py; SURVEY §5 device-tracing bar). Blocks up to
+        ``timeout_s`` for the capture to finish — an idle engine only
+        captures once work arrives. Returns the trace summary dict, or a
+        dict with an ``error`` key."""
+        if not self._healthy:
+            return {"error": self._last_error or "engine unavailable"}
+        box: dict = {}
+        armed = threading.Event()
+
+        def _do() -> None:
+            box["ev"], box["holder"] = self._engine.profile_steps(n)
+            armed.set()
+
+        self._post(_do)
+        if not armed.wait(timeout_s):
+            return {"error": "engine thread did not arm the capture in time"}
+        if not box["ev"].wait(timeout_s):
+            self._post(lambda: self._engine.cancel_profile(box["holder"]))
+            return {
+                "error": f"capture did not complete within {timeout_s}s "
+                "(engine idle? send traffic while profiling)"
+            }
+        return dict(box["holder"])
+
     def _post(self, fn: Callable[[], None]) -> None:
         with self._inbox_lock:
             self._inbox.append(fn)

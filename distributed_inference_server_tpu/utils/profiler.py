@@ -1,0 +1,92 @@
+"""Device-side profiling: on-demand ``jax.profiler`` trace capture.
+
+SURVEY.md §5 sets the tracing bar beyond request spans (utils/tracing.py):
+device-level visibility — per-decode-step XLA execution, fusion, and
+collective timing. The reference had no profiler integration at all
+(SURVEY §5: "tracing dep wired, not built", ``Cargo.toml:29-30``); here
+capture is a first-class admin action: ``POST /server/profile`` triggers a
+trace over a wall-clock window or over the next N engine decode steps
+(engine.profile_steps), written in TensorBoard trace-viewer format
+(``tensorboard --logdir <dir>`` → Profile tab, or the `xprof` tools).
+
+Captures are process-global (the JAX profiler traces every device the
+process touches), so one capture covers all engine replicas in-process.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+
+class ProfileInProgress(RuntimeError):
+    """Only one device trace may be active per process."""
+
+
+_GLOBAL_LOCK = threading.Lock()
+
+
+def default_trace_dir() -> str:
+    return os.path.join(tempfile.gettempdir(), "dis_tpu_traces")
+
+
+def _trace_files(trace_dir: str) -> List[str]:
+    out: List[str] = []
+    for root, _, files in os.walk(trace_dir):
+        for f in files:
+            out.append(os.path.relpath(os.path.join(root, f), trace_dir))
+    return sorted(out)
+
+
+class TraceSession:
+    """One active capture: start_trace has run; stop() finalizes and
+    returns the summary dict. Used by the engine's step-scoped capture."""
+
+    def __init__(self, base_dir: Optional[str] = None):
+        if not _GLOBAL_LOCK.acquire(blocking=False):
+            raise ProfileInProgress("a device trace is already active")
+        try:
+            import jax
+
+            self.trace_dir = os.path.join(
+                base_dir or default_trace_dir(),
+                time.strftime("%Y%m%d-%H%M%S-") + uuid.uuid4().hex[:6],
+            )
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+        except BaseException:
+            _GLOBAL_LOCK.release()
+            raise
+        self._t0 = time.perf_counter()
+        self._done = False
+
+    def stop(self) -> Dict:
+        if self._done:
+            raise RuntimeError("trace already stopped")
+        self._done = True
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        finally:
+            _GLOBAL_LOCK.release()
+        return {
+            "trace_dir": self.trace_dir,
+            "wall_s": round(time.perf_counter() - self._t0, 4),
+            "files": _trace_files(self.trace_dir),
+        }
+
+
+def capture_duration(duration_s: float, base_dir: Optional[str] = None) -> Dict:
+    """Capture a device trace over a wall-clock window (the in-flight
+    serving work — decode blocks, prefills, collectives — lands in it).
+    Blocking; call from an executor thread, not the event loop."""
+    session = TraceSession(base_dir)
+    time.sleep(max(0.0, duration_s))
+    out = session.stop()
+    out["mode"] = "duration"
+    return out
