@@ -58,6 +58,7 @@ def main(argv=None) -> int:
         prefill_token_budget=cfg.get("engine", "prefill_token_budget"),
         pp_microbatches=cfg.get("engine", "pp_microbatches"),
         cp_min_tokens=cfg.get("engine", "cp_min_tokens") or None,
+        sp_impl=cfg.get("engine", "sp_impl"),
     )
     tokenizer = load_tokenizer(model_dir)
 

@@ -128,10 +128,15 @@ class EngineConfig:
     # prefill_batch
     pp_microbatches: int = 1
     # context-parallel prefill (parallel/cp.py): when the mesh has a
-    # ``seq`` axis, prompts at least this long prefill via ring attention
-    # sharded over it, landing straight in the page pool. None = auto
-    # (one past the largest prefill bucket). Ignored without a seq axis.
+    # ``seq`` axis, prompts at least this long prefill via sequence-
+    # parallel attention sharded over it, landing straight in the page
+    # pool. None = auto (one past the largest prefill bucket). Ignored
+    # without a seq axis.
     cp_min_tokens: Optional[int] = None
+    # sequence-parallel attention flavor for that path: "ring" (KV
+    # rotation over ICI, any axis size) or "ulysses" (all-to-all head
+    # scatter, axis must divide the query- and KV-head counts)
+    sp_impl: str = "ring"
 
 
 @dataclass
@@ -243,6 +248,24 @@ class LLMEngine:
                     "context-parallel prefill (seq axis) under pipeline "
                     "parallelism (stage axis) is not supported yet"
                 )
+            if self.ecfg.sp_impl not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"sp_impl must be 'ring' or 'ulysses', got "
+                    f"{self.ecfg.sp_impl!r}"
+                )
+            sp_ax = mesh.shape.get("seq", 1)
+            if sp_ax > 1 and self.ecfg.sp_impl == "ulysses":
+                tp_sz = mesh.shape.get("tensor", 1)
+                if (cfg.num_heads // tp_sz) % sp_ax or (
+                    cfg.num_kv_heads // tp_sz
+                ) % sp_ax:
+                    raise ValueError(
+                        f"Ulysses seq axis {sp_ax} must divide the per-"
+                        f"tensor-shard head counts "
+                        f"({cfg.num_heads // tp_sz} q / "
+                        f"{cfg.num_kv_heads // tp_sz} kv); use sp_impl="
+                        "'ring' for larger axes"
+                    )
             tp_rules.validate_tp(cfg, mesh.shape.get("tensor", 1))
             if stage_axis is not None:
                 from distributed_inference_server_tpu.parallel.pp import (
@@ -667,10 +690,11 @@ class LLMEngine:
         return min(b, max(cap, -(-n // seq_ax) * seq_ax))
 
     def _get_cp_fn(self, T: int) -> Callable:
-        """Compiled ring-prefill program keyed on the prompt-buffer length:
-        cp_paged_prefill (ring attention over ``seq``, K/V scattered into
-        the page pool) fused with first-token sampling. With a draft model,
-        the draft's pool is prefilled in the same program (same slots) so
+        """Compiled sequence-parallel prefill program keyed on the
+        prompt-buffer length: cp_paged_prefill (ring or Ulysses attention
+        over ``seq`` per EngineConfig.sp_impl, K/V scattered into the page
+        pool) fused with first-token sampling. With a draft model, the
+        draft's pool is prefilled in the same program (same slots) so
         speculative rounds can attend the full prompt."""
         fn = self._cp_fns.get(T)
         if fn is None:
@@ -679,6 +703,7 @@ class LLMEngine:
             )
 
             cfg, mesh = self.cfg, self.mesh
+            sp = self.ecfg.sp_impl
             if self.draft_params is not None:
                 dcfg = self.draft_cfg
 
@@ -687,11 +712,11 @@ class LLMEngine:
                             pool_k, pool_v, write_slots, temp, top_p, rng):
                     logits, pool_k, pool_v = cp_paged_prefill(
                         params, cfg, mesh, ids, valid, pool_k, pool_v,
-                        write_slots,
+                        write_slots, sp_impl=sp,
                     )
                     _, dpool_k, dpool_v = cp_paged_prefill(
                         dparams, dcfg, mesh, ids, valid, dpool_k, dpool_v,
-                        write_slots,
+                        write_slots, sp_impl=sp,
                     )
                     toks = sample_tokens(rng, logits, temp, top_p)
                     return toks, pool_k, pool_v, dpool_k, dpool_v
@@ -704,7 +729,7 @@ class LLMEngine:
                        temp, top_p, rng):
                     logits, pool_k, pool_v = cp_paged_prefill(
                         params, cfg, mesh, ids, valid, pool_k, pool_v,
-                        write_slots,
+                        write_slots, sp_impl=sp,
                     )
                     toks = sample_tokens(rng, logits, temp, top_p)
                     return toks, pool_k, pool_v

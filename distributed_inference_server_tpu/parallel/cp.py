@@ -36,6 +36,7 @@ def cp_prefill(
     mesh,
     input_ids: jnp.ndarray,
     valid_len: jnp.ndarray,
+    sp_impl: str = "ring",
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Context-parallel prefill of a ragged batch of prompts.
 
@@ -43,6 +44,9 @@ def cp_prefill(
       input_ids: [B, T] token ids, right-padded; T must divide by the
         ``seq`` axis size.
       valid_len: [B] prompt lengths.
+      sp_impl: "ring" (KV chunks rotate over ICI, ops/ring_attention.py)
+        or "ulysses" (all-to-all head scatter, ops/ulysses.py — axis size
+        must divide the query- and KV-head counts).
 
     Returns (last_logits [B, V] f32, k, v) where k, v are
     [L, B, T, KV, D] caches with slot == position (padding slots hold
@@ -52,16 +56,29 @@ def cp_prefill(
     seq = mesh.shape.get("seq", 1)
     if T % seq:
         raise ValueError(f"prompt buffer {T} not divisible by seq axis {seq}")
+    if sp_impl not in ("ring", "ulysses"):
+        raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got {sp_impl!r}")
 
     pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
     positions = jnp.where(pos < valid_len[:, None], pos, -1)
     # padding writes are dropped (slot T is out of range for the cache)
     write_pos = jnp.where(positions >= 0, positions, T)
 
-    def attend(q, k_layer, v_layer):
-        return ring_attention_sharded(
-            mesh, q, k_layer, v_layer, positions, positions
+    if sp_impl == "ulysses":
+        from distributed_inference_server_tpu.ops.ulysses import (
+            ulysses_attention_sharded,
         )
+
+        def attend(q, k_layer, v_layer):
+            return ulysses_attention_sharded(
+                mesh, q, k_layer, v_layer, positions, valid_len
+            )
+    else:
+
+        def attend(q, k_layer, v_layer):
+            return ring_attention_sharded(
+                mesh, q, k_layer, v_layer, positions, positions
+            )
 
     cache = llama.KVCache.create(cfg, B, T, dtype=params["embed"].dtype)
     h, new_k, new_v = llama._run_layers(
@@ -85,21 +102,25 @@ def cp_paged_prefill(
     pool_k: jnp.ndarray,
     pool_v: jnp.ndarray,
     write_slots: jnp.ndarray,
+    sp_impl: str = "ring",
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Ring prefill that lands in the paged pool — the dense-KV→pages
-    hand-off the engine's long-prompt admission path uses (the reference
-    had no long-context path at all; context hard-capped at 8192,
-    ``validator.rs:20``).
+    """Sequence-parallel prefill that lands in the paged pool — the
+    dense-KV→pages hand-off the engine's long-prompt admission path uses
+    (the reference had no long-context path at all; context hard-capped
+    at 8192, ``validator.rs:20``).
 
-    Runs ``cp_prefill`` (sequence sharded over the ``seq`` mesh axis,
-    ring attention over ICI), then scatters the position-ordered dense
-    K/V into the flat page pools at per-token ``write_slots`` ([B, T]
-    flat slot per position, >= num_slots drops the write — padding).
-    After this the prompt decodes from pages like any other sequence.
+    Runs ``cp_prefill`` (sequence sharded over the ``seq`` mesh axis;
+    ``sp_impl`` picks ring attention or Ulysses all-to-all), then
+    scatters the position-ordered dense K/V into the flat page pools at
+    per-token ``write_slots`` ([B, T] flat slot per position, >=
+    num_slots drops the write — padding). After this the prompt decodes
+    from pages like any other sequence.
 
     Returns (last_logits [B, V] f32, new pool_k, new pool_v).
     """
-    logits, k, v = cp_prefill(params, cfg, mesh, input_ids, valid_len)
+    logits, k, v = cp_prefill(
+        params, cfg, mesh, input_ids, valid_len, sp_impl=sp_impl
+    )
     # k, v: [L, B, T, KV, D] slot==position; pool: [L, num_slots, KV, D]
     pool_k = pool_k.at[:, write_slots].set(k.astype(pool_k.dtype), mode="drop")
     pool_v = pool_v.at[:, write_slots].set(v.astype(pool_v.dtype), mode="drop")

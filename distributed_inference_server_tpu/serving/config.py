@@ -70,6 +70,8 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # prompts at least this long take the ring-prefill path when
         # context_parallel > 1 (0 = auto: one past the largest bucket)
         "cp_min_tokens": (int, 0),
+        # sequence-parallel attention flavor: ring | ulysses
+        "sp_impl": (str, "ring"),
         "max_batch": (int, 8),
         "prefill_buckets": (list, [32, 128, 512]),
         "page_size": (int, 16),
@@ -314,6 +316,11 @@ class ServerConfig:
             raise ConfigError(
                 f"model.dtype must be bfloat16/float32/float16, "
                 f"got {r['model']['dtype']!r}"
+            )
+        if r["engine"]["sp_impl"] not in ("ring", "ulysses"):
+            raise ConfigError(
+                f"engine.sp_impl must be ring/ulysses, "
+                f"got {r['engine']['sp_impl']!r}"
             )
         if r["model"]["quantization"] not in ("none", "int8", "int4"):
             raise ConfigError(

@@ -1,0 +1,147 @@
+"""Ulysses all-to-all sequence parallelism (SURVEY §2.3 named ring AND
+Ulysses; VERDICT r1 flagged Ulysses absent): op-level numerics vs the
+dense reference, cp_prefill flavor equivalence, and the engine's
+long-prompt path with sp_impl='ulysses'."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+from distributed_inference_server_tpu.ops.attention import gqa_attention
+from distributed_inference_server_tpu.ops.ulysses import (
+    ulysses_attention_sharded,
+)
+from distributed_inference_server_tpu.parallel import MeshSpec, make_mesh
+from distributed_inference_server_tpu.parallel.cp import cp_prefill
+
+
+class TestUlyssesOp:
+    def _case(self, B=2, T=32, H=4, KV=2, D=16, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, T, KV, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, T, KV, D), jnp.float32)
+        valid = jnp.asarray([T, T - 5], jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        pos = jnp.where(pos < valid[:, None], pos, -1)
+        return q, k, v, pos, valid
+
+    def test_matches_dense_reference(self):
+        q, k, v, pos, valid = self._case()
+        mesh = make_mesh(MeshSpec(seq=2))
+        got = ulysses_attention_sharded(mesh, q, k, v, pos, valid)
+        want = gqa_attention(
+            q, k, v, jnp.broadcast_to(jnp.arange(q.shape[1])[None],
+                                      pos.shape), valid
+        )
+        # compare only valid rows/positions (padding outputs are garbage
+        # by contract)
+        for b in range(q.shape[0]):
+            n = int(valid[b])
+            np.testing.assert_allclose(
+                np.asarray(got)[b, :n], np.asarray(want)[b, :n],
+                rtol=2e-5, atol=2e-5,
+            )
+
+    def test_composes_with_tp(self):
+        # tensor=2 halves the local head counts; seq=2 must divide the
+        # per-shard 4 q / 2 kv heads
+        q, k, v, pos, valid = self._case(H=8, KV=4)
+        mesh = make_mesh(MeshSpec(seq=2, tensor=2))
+        got = ulysses_attention_sharded(mesh, q, k, v, pos, valid)
+        want = gqa_attention(
+            q, k, v, jnp.broadcast_to(jnp.arange(q.shape[1])[None],
+                                      pos.shape), valid
+        )
+        n = int(valid[1])
+        np.testing.assert_allclose(
+            np.asarray(got)[1, :n], np.asarray(want)[1, :n],
+            rtol=2e-5, atol=2e-5,
+        )
+
+    def test_indivisible_heads_rejected(self):
+        q, k, v, pos, valid = self._case()  # KV=2 heads
+        mesh = make_mesh(MeshSpec(seq=4))  # 4 does not divide KV=2
+        with pytest.raises(ValueError, match="Ulysses"):
+            ulysses_attention_sharded(mesh, q, k, v, pos, valid)
+
+
+class TestUlyssesPrefill:
+    def test_cp_prefill_flavors_agree(self):
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, jnp.float32)
+        mesh = make_mesh(MeshSpec(seq=2))
+        B, T = 2, 32
+        ids = jax.random.randint(jax.random.PRNGKey(1), (B, T), 1, 250)
+        valid = jnp.asarray([T, T - 7], jnp.int32)
+        with mesh:
+            lr, kr, vr = cp_prefill(params, TINY, mesh, ids, valid,
+                                    sp_impl="ring")
+            lu, ku, vu = cp_prefill(params, TINY, mesh, ids, valid,
+                                    sp_impl="ulysses")
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lu),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(kr), np.asarray(ku),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(vr), np.asarray(vu),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_bad_impl_rejected(self):
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, jnp.float32)
+        mesh = make_mesh(MeshSpec(seq=2))
+        ids = jnp.ones((1, 16), jnp.int32)
+        with pytest.raises(ValueError, match="sp_impl"):
+            cp_prefill(params, TINY, mesh, ids,
+                       jnp.asarray([16], jnp.int32), sp_impl="nope")
+
+
+class TestUlyssesEngine:
+    PROMPT = "ulysses scatters heads across the interconnect!"  # 48 toks
+
+    def _generate(self, mesh=None, **kw):
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, jnp.float32)
+        tok = ByteTokenizer()
+        eng = LLMEngine(
+            params, TINY, tok,
+            EngineConfig(
+                max_batch=2, prefill_buckets=(16,),
+                paged=PagedCacheConfig(num_pages=64, page_size=8,
+                                       max_pages_per_seq=8),
+                **kw,
+            ),
+            dtype=jnp.float32, mesh=mesh,
+        )
+        eng.add_request("r", tok.encode(self.PROMPT),
+                        SamplingParams(max_tokens=8, temperature=0.0))
+        text = []
+        while eng.has_work():
+            for out in eng.step():
+                assert out.error is None, out.error
+                text.append(out.text)
+        return "".join(text)
+
+    def test_engine_ulysses_matches_plain(self):
+        plain = self._generate()
+        uly = self._generate(mesh=make_mesh(MeshSpec(seq=2)),
+                             sp_impl="ulysses")
+        assert plain == uly
+
+    def test_engine_rejects_indivisible_ulysses(self):
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, jnp.float32)
+        with pytest.raises(ValueError, match="Ulysses"):
+            LLMEngine(
+                params, TINY, ByteTokenizer(),
+                EngineConfig(sp_impl="ulysses"),
+                dtype=jnp.float32, mesh=make_mesh(MeshSpec(seq=4)),
+            )
