@@ -28,6 +28,21 @@ def main(argv=None) -> int:
         print(f"config error: {e}", file=sys.stderr)
         return 2
 
+    # multi-host data plane: connect to the fleet BEFORE any backend
+    # touches devices (parallel/distributed.py; SURVEY §5 two-plane design)
+    nproc = cfg.get("distributed", "num_processes")
+    if nproc > 1:
+        from distributed_inference_server_tpu.parallel.distributed import (
+            DistributedConfig,
+            initialize,
+        )
+
+        initialize(DistributedConfig(
+            coordinator_address=cfg.get("distributed", "coordinator_address"),
+            num_processes=nproc,
+            process_id=cfg.get("distributed", "process_id"),
+        ))
+
     import jax.numpy as jnp
 
     from distributed_inference_server_tpu.engine.engine import (

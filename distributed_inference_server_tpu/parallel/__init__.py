@@ -23,10 +23,18 @@ from distributed_inference_server_tpu.parallel.cp import (
     cp_prefill,
     cp_shardings,
 )
+from distributed_inference_server_tpu.parallel.distributed import (
+    DistributedConfig,
+    hybrid_mesh,
+    initialize as initialize_distributed,
+)
 
 __all__ = [
     "cp_prefill",
     "cp_shardings",
+    "DistributedConfig",
+    "hybrid_mesh",
+    "initialize_distributed",
     "AXES",
     "MeshSpec",
     "largest_tp",

@@ -1,0 +1,153 @@
+"""Multi-host distributed backend: DCN + ICI two-plane communication.
+
+SURVEY.md §5 sets the bar the reference never attempted (its "workers"
+were in-process tokio channels, ``design.md:264-265`` [spec]; WorkerId
+"local to a single server instance", ``types.rs:10``):
+
+- **data plane** — the JAX distributed runtime: every host runs the same
+  program, ``jax.distributed.initialize`` connects them through the
+  coordinator, ``jax.devices()`` becomes the GLOBAL device set, and GSPMD
+  emits DCN collectives for mesh axes that cross hosts and ICI
+  collectives for axes within a slice. ``hybrid_mesh`` builds the
+  canonical layout: slow axes (data/stage) outermost over DCN, fast axes
+  (tensor/seq/expert) innermost over ICI — collectives ride the right
+  fabric by construction.
+- **control plane** — serving/router.py: request routing between hosts
+  stays at the HTTP boundary (the reference's scheduler shape, one
+  process per host), so the data plane never carries request traffic.
+
+Single-host processes (num_processes == 1) skip initialization entirely —
+the same binary serves laptop CPU, one chip, or a pod slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+from distributed_inference_server_tpu.parallel.mesh import AXES, MeshSpec
+
+_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """jax.distributed settings for one process of a multi-host fleet.
+
+    coordinator_address: "host:port" of process 0 (every process passes
+    the same value). num_processes: world size. process_id: this
+    process's rank; -1 = let the TPU platform infer it (metadata-based
+    auto-detection on Cloud TPU VMs).
+    """
+
+    coordinator_address: str = ""
+    num_processes: int = 1
+    process_id: int = -1
+    local_device_ids: Optional[Tuple[int, ...]] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_processes > 1
+
+
+def initialize(cfg: DistributedConfig) -> bool:
+    """Connect this process to the fleet (idempotent). Returns True when
+    the distributed runtime was (or already is) live, False for
+    single-process configs. Must run before any backend touches devices."""
+    global _initialized
+    if not cfg.enabled:
+        return False
+    if _initialized:
+        return True
+    import jax
+
+    kwargs = {
+        "coordinator_address": cfg.coordinator_address or None,
+        "num_processes": cfg.num_processes,
+    }
+    if cfg.process_id >= 0:
+        kwargs["process_id"] = cfg.process_id
+    if cfg.local_device_ids is not None:
+        kwargs["local_device_ids"] = list(cfg.local_device_ids)
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    return True
+
+
+def hybrid_mesh(
+    spec: MeshSpec,
+    dcn_spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Mesh over a multi-host fleet with DCN-aware device placement.
+
+    ``spec`` sizes the per-slice (ICI) extent of each axis; ``dcn_spec``
+    sizes the cross-slice (DCN) extent (default: replicate nothing across
+    DCN except the data axis, absorbed from the process count). The
+    resulting global axis size is ici * dcn per axis, laid out so that
+    consecutive devices along a DCN-extended axis stay within a slice —
+    jax.experimental.mesh_utils.create_hybrid_device_mesh's contract —
+    and GSPMD therefore lowers intra-slice hops to ICI collectives and
+    only the outer strides to DCN.
+
+    Falls back to the dense mesh (mesh.py:make_mesh) when the runtime is
+    not distributed (tests, single host): same axis names, same specs,
+    so PartitionSpecs are portable between the two.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if dcn_spec is None:
+        # data-parallel across hosts by default
+        dcn_spec = MeshSpec(data=n_slices) if n_slices > 1 else MeshSpec()
+    elif 0 in dcn_spec.sizes():
+        dcn_spec = dcn_spec.resolve(n_slices)
+    ici = spec.resolve(len(devices) // max(1, _prod(dcn_spec.sizes())))
+    if n_slices <= 1:
+        # single slice: collapse to the dense mesh (DCN extents fold in)
+        merged = MeshSpec(*[a * b for a, b in
+                            zip(ici.sizes(), dcn_spec.sizes())])
+        from distributed_inference_server_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(merged, devices)
+    from jax.experimental import mesh_utils
+
+    grid = mesh_utils.create_hybrid_device_mesh(
+        ici.sizes(), dcn_spec.sizes(), devices=devices,
+        allow_split_physical_axes=True,
+    )
+    return Mesh(grid, axis_names=AXES)
+
+
+def _prod(xs) -> int:
+    out = 1
+    for x in xs:
+        out *= x
+    return out
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+def global_batch_shard(batch: int) -> Tuple[int, int]:
+    """(this process's shard size, offset) of a global batch laid out
+    contiguously over processes — the serving layer's unit of cross-host
+    data parallelism when one logical engine spans hosts."""
+    import jax
+
+    n, i = jax.process_count(), jax.process_index()
+    base, rem = divmod(batch, n)
+    size = base + (1 if i < rem else 0)
+    offset = i * base + min(i, rem)
+    return size, offset

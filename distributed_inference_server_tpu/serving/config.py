@@ -87,6 +87,14 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "num_draft_tokens": (int, 4),
         "spec_disable_threshold": (float, 0.5),
     },
+    "distributed": {
+        # multi-host data plane (parallel/distributed.py): every process
+        # of the fleet runs the same config with its own process_id
+        # (-1 = platform auto-detection); num_processes 1 = single host
+        "coordinator_address": (str, ""),
+        "num_processes": (int, 1),
+        "process_id": (int, -1),
+    },
     "queue": {
         "high_watermark": (int, 1000),
         "low_watermark": (int, 500),

@@ -1,0 +1,302 @@
+"""Cross-host serving router: the control plane of the multi-host backend.
+
+SURVEY.md §5's two-plane design keeps request traffic off the data plane:
+XLA/DCN collectives move tensors between hosts (parallel/distributed.py);
+requests move between hosts HERE, at the HTTP boundary — preserving the
+reference's scheduler/front-end shape (``design.md:274-307`` [spec]) while
+replacing its single-process assumption (``types.rs:10``: WorkerId "local
+to a single server instance").
+
+One router process fronts N worker hosts (each running the normal
+``python -m distributed_inference_server_tpu`` server on its own
+chips/slice). The router:
+
+- routes /generate /chat /embeddings to a backend — round-robin or
+  least-loaded (in-flight count through this router), the reference's
+  scheduler strategies (``requirements.md:92-98``) applied cross-host;
+- passes SSE streams through unbuffered (token latency stays intact);
+- health-checks every backend on an interval, evicts unhealthy ones,
+  reinstates on recovery, and retries a failed dispatch on the next
+  healthy backend (failure detection <5s, Req 7.1-7.3 cross-host);
+- aggregates /health and /server/stats across the fleet.
+
+Run: ``python -m distributed_inference_server_tpu.serving.router
+--backends http://host-a:8000,http://host-b:8000 --port 8080``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+
+@dataclass
+class BackendState:
+    base_url: str
+    healthy: bool = True
+    active: int = 0  # in-flight requests routed through this router
+    total: int = 0
+    last_error: Optional[str] = None
+    last_check: float = 0.0
+
+    def to_dict(self) -> Dict:
+        return {
+            "backend": self.base_url,
+            "healthy": self.healthy,
+            "active_requests": self.active,
+            "total_routed": self.total,
+            "last_error": self.last_error,
+        }
+
+
+@dataclass
+class RouterConfig:
+    backends: List[str] = field(default_factory=list)
+    strategy: str = "least_loaded"  # or "round_robin"
+    health_check_interval_s: float = 1.0
+    request_timeout_s: float = 300.0
+    connect_timeout_s: float = 5.0
+
+
+class Router:
+    """Owns backend state, the health loop, and backend selection."""
+
+    def __init__(self, cfg: RouterConfig):
+        if not cfg.backends:
+            raise ValueError("router needs at least one backend")
+        if cfg.strategy not in ("least_loaded", "round_robin"):
+            raise ValueError(
+                f"strategy must be least_loaded/round_robin, "
+                f"got {cfg.strategy!r}"
+            )
+        self.cfg = cfg
+        self.backends = [
+            BackendState(b.rstrip("/")) for b in cfg.backends
+        ]
+        self._rr = itertools.count()
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._health_task: Optional[asyncio.Task] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(
+                total=self.cfg.request_timeout_s,
+                connect=self.cfg.connect_timeout_s,
+            )
+        )
+        self._health_task = asyncio.create_task(self._health_loop())
+
+    async def close(self) -> None:
+        if self._health_task:
+            self._health_task.cancel()
+            try:
+                await self._health_task
+            except asyncio.CancelledError:
+                pass
+        if self._session:
+            await self._session.close()
+
+    # -- health ---------------------------------------------------------
+
+    async def _health_loop(self) -> None:
+        while True:
+            await asyncio.gather(*(self._check(b) for b in self.backends))
+            await asyncio.sleep(self.cfg.health_check_interval_s)
+
+    async def _check(self, b: BackendState) -> None:
+        try:
+            async with self._session.get(
+                b.base_url + "/health",
+                timeout=aiohttp.ClientTimeout(total=self.cfg.connect_timeout_s),
+            ) as resp:
+                body = await resp.json()
+                b.healthy = resp.status == 200 and body.get("status") == "ok"
+                b.last_error = None if b.healthy else f"status {resp.status}"
+        except Exception as e:  # noqa: BLE001 — network failure = unhealthy
+            b.healthy = False
+            b.last_error = str(e)
+        b.last_check = time.monotonic()
+
+    # -- selection ------------------------------------------------------
+
+    def pick(self, exclude: Optional[set] = None) -> Optional[BackendState]:
+        pool = [
+            b for b in self.backends
+            if b.healthy and (not exclude or b.base_url not in exclude)
+        ]
+        if not pool:
+            return None
+        if self.cfg.strategy == "round_robin":
+            return pool[next(self._rr) % len(pool)]
+        return min(pool, key=lambda b: b.active)
+
+    @property
+    def session(self) -> aiohttp.ClientSession:
+        assert self._session is not None, "router not started"
+        return self._session
+
+
+def build_router_app(router: Router) -> web.Application:
+    app = web.Application()
+
+    async def _on_startup(app):
+        await router.start()
+
+    async def _on_cleanup(app):
+        await router.close()
+
+    app.on_startup.append(_on_startup)
+    app.on_cleanup.append(_on_cleanup)
+
+    def _unavailable() -> web.Response:
+        return web.json_response(
+            {"error": {"message": "no healthy backend available",
+                       "error_type": "service_unavailable_error",
+                       "code": "no_backend"}},
+            status=503,
+        )
+
+    async def _proxy(request: web.Request, path: str) -> web.StreamResponse:
+        try:
+            raw = await request.read()
+        except Exception:  # noqa: BLE001 — client went away early
+            raise web.HTTPBadRequest() from None
+        streaming = False
+        try:
+            streaming = json.loads(raw or b"{}").get("stream") is True
+        except Exception:  # noqa: BLE001 — backend will 400 it
+            pass
+        tried: set = set()
+        while True:
+            backend = router.pick(exclude=tried)
+            if backend is None:
+                return _unavailable()
+            tried.add(backend.base_url)
+            backend.active += 1
+            backend.total += 1
+            try:
+                resp = await router.session.post(
+                    backend.base_url + path,
+                    data=raw,
+                    headers={"Content-Type": "application/json"},
+                )
+            except Exception as e:  # noqa: BLE001 — connect/dispatch error
+                backend.active -= 1
+                backend.healthy = False
+                backend.last_error = str(e)
+                continue  # retry on the next healthy backend
+            try:
+                if streaming and resp.status == 200:
+                    out = web.StreamResponse(
+                        status=200,
+                        headers={
+                            "Content-Type": "text/event-stream",
+                            "Cache-Control": "no-cache",
+                        },
+                    )
+                    await out.prepare(request)
+                    async for chunk in resp.content.iter_any():
+                        await out.write(chunk)
+                    await out.write_eof()
+                    return out
+                body = await resp.read()
+                return web.Response(
+                    body=body, status=resp.status,
+                    content_type=resp.content_type,
+                )
+            finally:
+                backend.active -= 1
+                resp.release()
+
+    async def generate(request):
+        return await _proxy(request, "/generate")
+
+    async def chat(request):
+        return await _proxy(request, "/chat")
+
+    async def embeddings(request):
+        return await _proxy(request, "/embeddings")
+
+    async def health(request: web.Request) -> web.Response:
+        healthy = any(b.healthy for b in router.backends)
+        return web.json_response(
+            {
+                "status": "ok" if healthy else "unhealthy",
+                "backends": [b.to_dict() for b in router.backends],
+            },
+            status=200 if healthy else 503,
+        )
+
+    async def stats(request: web.Request) -> web.Response:
+        async def one(b: BackendState):
+            try:
+                async with router.session.get(
+                    b.base_url + "/server/stats",
+                    timeout=aiohttp.ClientTimeout(total=5.0),
+                ) as resp:
+                    return b.base_url, await resp.json()
+            except Exception as e:  # noqa: BLE001 — partial aggregation
+                return b.base_url, {"error": str(e)}
+
+        results = dict(await asyncio.gather(
+            *(one(b) for b in router.backends)
+        ))
+        return web.json_response({
+            "router": [b.to_dict() for b in router.backends],
+            "backends": results,
+        })
+
+    app.router.add_post("/generate", generate)
+    app.router.add_post("/chat", chat)
+    app.router.add_post("/embeddings", embeddings)
+    app.router.add_get("/health", health)
+    app.router.add_get("/server/stats", stats)
+    return app
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="distributed-inference-server-tpu-router",
+        description="Cross-host request router for the TPU serving fleet",
+    )
+    parser.add_argument(
+        "--backends", required=True,
+        help="comma-separated backend base URLs "
+             "(http://host-a:8000,http://host-b:8000)",
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument(
+        "--strategy", default="least_loaded",
+        choices=("least_loaded", "round_robin"),
+    )
+    parser.add_argument("--health-interval", type=float, default=1.0)
+    args = parser.parse_args(argv)
+
+    try:
+        router = Router(RouterConfig(
+            backends=[b for b in args.backends.split(",") if b],
+            strategy=args.strategy,
+            health_check_interval_s=args.health_interval,
+        ))
+    except ValueError as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
+    app = build_router_app(router)
+    web.run_app(app, host=args.host, port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,218 @@
+"""Multi-host backend tests (SURVEY §5 two-plane design; VERDICT r1:
+"DCN / multi-host absent entirely"): the jax.distributed wrapper + hybrid
+DCN x ICI mesh (data plane) and the cross-host HTTP router (control
+plane), driven against two real in-process backend servers."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import jax.numpy as jnp
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from distributed_inference_server_tpu.engine.engine import EngineConfig
+from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+from distributed_inference_server_tpu.parallel import MeshSpec
+from distributed_inference_server_tpu.parallel.distributed import (
+    DistributedConfig,
+    global_batch_shard,
+    hybrid_mesh,
+    initialize,
+)
+from distributed_inference_server_tpu.serving.router import (
+    Router,
+    RouterConfig,
+    build_router_app,
+)
+from distributed_inference_server_tpu.serving.server import InferenceServer
+
+
+class TestDataPlane:
+    def test_single_process_skips_initialize(self):
+        assert initialize(DistributedConfig()) is False
+        assert not DistributedConfig().enabled
+        assert DistributedConfig(num_processes=4,
+                                 coordinator_address="h:1234").enabled
+
+    def test_hybrid_mesh_single_slice_collapses(self):
+        mesh = hybrid_mesh(MeshSpec(tensor=2), dcn_spec=MeshSpec(data=4))
+        assert mesh.shape["data"] == 4
+        assert mesh.shape["tensor"] == 2
+        assert mesh.shape["expert"] == 1
+
+    def test_hybrid_mesh_defaults(self):
+        mesh = hybrid_mesh(MeshSpec(tensor=4, data=2))
+        assert mesh.shape["tensor"] == 4
+        assert mesh.shape["data"] == 2
+
+    def test_global_batch_shard_single(self):
+        assert global_batch_shard(7) == (7, 0)
+
+
+_PAGED = PagedCacheConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
+
+
+def _factory():
+    import jax
+
+    from distributed_inference_server_tpu.engine.engine import LLMEngine
+
+    params = llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+    return LLMEngine(
+        params, TINY, ByteTokenizer(),
+        EngineConfig(max_batch=2, prefill_buckets=(16,), paged=_PAGED),
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def backends():
+    servers = []
+    for name in ("host-a", "host-b"):
+        srv = InferenceServer(
+            _factory, ByteTokenizer(), model_name=name,
+            num_engines=1, auto_restart=False,
+        )
+        srv.start()
+        servers.append(srv)
+    yield servers
+    for srv in servers:
+        srv.shutdown(drain_timeout_s=5.0)
+
+
+def _run_router(backends, coro_fn, **router_kw):
+    async def main():
+        # two real backend HTTP servers on localhost ports
+        test_servers = [TestServer(s.build_app()) for s in backends]
+        for ts in test_servers:
+            await ts.start_server()
+        urls = [str(ts.make_url("/")).rstrip("/") for ts in test_servers]
+        router = Router(RouterConfig(
+            backends=urls,
+            health_check_interval_s=0.2,
+            **router_kw,
+        ))
+        client = TestClient(TestServer(build_router_app(router)))
+        await client.start_server()
+        try:
+            return await coro_fn(client, router, urls)
+        finally:
+            await client.close()
+            for ts in test_servers:
+                await ts.close()
+
+    return asyncio.run(main())
+
+
+class TestRouter:
+    def test_generate_via_router(self, backends):
+        async def go(client, router, urls):
+            resp = await client.post("/generate", json={
+                "prompt": "hello fleet", "max_tokens": 6,
+                "temperature": 0.0,
+            })
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["usage"]["completion_tokens"] == 6
+            assert sum(b.total for b in router.backends) == 1
+        _run_router(backends, go)
+
+    def test_round_robin_spreads_load(self, backends):
+        async def go(client, router, urls):
+            for _ in range(4):
+                resp = await client.post("/generate", json={
+                    "prompt": "spread", "max_tokens": 2,
+                    "temperature": 0.0,
+                })
+                assert resp.status == 200
+            counts = sorted(b.total for b in router.backends)
+            assert counts == [2, 2]
+        _run_router(backends, go, strategy="round_robin")
+
+    def test_sse_stream_passthrough(self, backends):
+        async def go(client, router, urls):
+            resp = await client.post("/generate", json={
+                "prompt": "stream me", "max_tokens": 4,
+                "temperature": 0.0, "stream": True,
+            })
+            assert resp.status == 200
+            assert resp.content_type == "text/event-stream"
+            events = []
+            async for line in resp.content:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    events.append(json.loads(line[6:]))
+            kinds = [e["type"] for e in events]
+            # 4 generated tokens arrive as >= 4 token events (the final
+            # token is emitted as id + held-back-text flush, same as the
+            # direct backend stream) followed by done
+            assert kinds.count("token") >= 4
+            assert kinds[-1] == "done"
+            assert events[-1]["usage"]["completion_tokens"] == 4
+        _run_router(backends, go)
+
+    def test_dead_backend_failover(self, backends):
+        async def go(client, router, urls):
+            # poison one backend with an unreachable address
+            router.backends[0].base_url = "http://127.0.0.1:1"
+            resp = await client.post("/generate", json={
+                "prompt": "failover", "max_tokens": 3,
+                "temperature": 0.0,
+            })
+            assert resp.status == 200  # retried on the healthy backend
+            assert not router.backends[0].healthy
+            assert router.backends[0].last_error
+        _run_router(backends, go)
+
+    def test_all_dead_returns_503(self, backends):
+        async def go(client, router, urls):
+            for b in router.backends:
+                b.healthy = False
+            resp = await client.post("/generate", json={
+                "prompt": "nope", "max_tokens": 1,
+            })
+            assert resp.status == 503
+            body = await resp.json()
+            assert body["error"]["code"] == "no_backend"
+        _run_router(backends, go)
+
+    def test_health_aggregation_and_recovery(self, backends):
+        async def go(client, router, urls):
+            resp = await client.get("/health")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["status"] == "ok"
+            assert len(body["backends"]) == 2
+            # mark one unhealthy; the health loop reinstates it
+            router.backends[0].healthy = False
+            await asyncio.sleep(0.5)
+            assert router.backends[0].healthy  # recovered by the loop
+        _run_router(backends, go)
+
+    def test_stats_aggregation(self, backends):
+        async def go(client, router, urls):
+            resp = await client.get("/server/stats")
+            assert resp.status == 200
+            body = await resp.json()
+            assert set(body["backends"]) == set(urls)
+            assert len(body["router"]) == 2
+        _run_router(backends, go)
+
+    def test_validation_errors_pass_through(self, backends):
+        async def go(client, router, urls):
+            resp = await client.post("/generate", json={"max_tokens": 1})
+            assert resp.status == 400  # backend's validator error
+            body = await resp.json()
+            assert body["error"]["error_type"] == "invalid_request_error"
+        _run_router(backends, go)
+
+    def test_router_config_validation(self):
+        with pytest.raises(ValueError):
+            Router(RouterConfig(backends=[]))
+        with pytest.raises(ValueError):
+            Router(RouterConfig(backends=["http://x"], strategy="nope"))
