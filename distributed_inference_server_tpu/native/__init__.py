@@ -94,6 +94,19 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pa_evict_below.argtypes = [ctypes.c_void_p, ctypes.c_double]
     lib.pa_stats.argtypes = [ctypes.c_void_p, i64p]
 
+    lib.batcher_create.restype = ctypes.c_void_p
+    lib.batcher_create.argtypes = [ctypes.c_void_p, ctypes.c_double,
+                                   ctypes.c_int]
+    lib.batcher_destroy.argtypes = [ctypes.c_void_p]
+    lib.batcher_set_config.argtypes = [ctypes.c_void_p, ctypes.c_double,
+                                       ctypes.c_int]
+    lib.batcher_set_divisor.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.batcher_pending.argtypes = [ctypes.c_void_p]
+    lib.batcher_cancel.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.batcher_poll.argtypes = [ctypes.c_void_p, ctypes.c_double, u64p,
+                                 ctypes.c_int]
+    lib.batcher_flush.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int]
+
 
 def available() -> bool:
     """True when the native library is built (builds on first call)."""
@@ -290,3 +303,114 @@ class NativePageAllocator:
 
 
 __all__ = ["available", "NativePriorityQueue", "NativePageAllocator"]
+
+class NativeAdmissionBatcher:
+    """ctypes façade over native/batcher.cpp with the contract of
+    ``serving.batcher.AdmissionBatcher`` (drop-in for the dispatcher).
+    Requires a ``NativePriorityQueue`` — one native batcher_poll call
+    drains the native queue and manages the window with no Python in the
+    per-request path; handles resolve back to payloads through the
+    queue's handle map only when a batch actually dispatches."""
+
+    def __init__(self, queue: "NativePriorityQueue", config=None):
+        from distributed_inference_server_tpu.serving.batcher import (
+            BatcherConfig,
+        )
+
+        if not isinstance(queue, NativePriorityQueue):
+            raise TypeError(
+                "NativeAdmissionBatcher requires a NativePriorityQueue"
+            )
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.queue = queue
+        self._config = config or BatcherConfig()
+        self._divisor = 1
+        self._ptr = lib.batcher_create(
+            queue._ptr, ctypes.c_double(self._config.window_ms),
+            self._config.max_batch_size,
+        )
+
+    def __del__(self):
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.batcher_destroy(ptr)
+            self._ptr = None
+
+    # -- contract ----------------------------------------------------------
+
+    @property
+    def config(self):
+        return self._config
+
+    @config.setter
+    def config(self, cfg) -> None:
+        """Hot-reload (requirements.md:146): window/max apply natively
+        from the next poll."""
+        self._config = cfg
+        self._lib.batcher_set_config(
+            self._ptr, ctypes.c_double(cfg.window_ms), cfg.max_batch_size
+        )
+
+    @property
+    def size_divisor(self) -> int:
+        return self._divisor
+
+    @size_divisor.setter
+    def size_divisor(self, d: int) -> None:
+        self._divisor = d
+        self._lib.batcher_set_divisor(self._ptr, int(d))
+
+    def effective_max_batch(self) -> int:
+        return max(1, self._config.max_batch_size // max(1, self._divisor))
+
+    def pending_count(self) -> int:
+        return self._lib.batcher_pending(self._ptr)
+
+    def cancel(self, request_id):
+        """Remove a request still waiting in the batching window
+        (Req 5.4). Returns the removed request or None."""
+        with self.queue._lock:
+            for handle, req in self.queue._by_handle.items():
+                if req.id == request_id:
+                    if self._lib.batcher_cancel(self._ptr, handle):
+                        self.queue._by_handle.pop(handle)
+                        return req
+                    return None
+        return None
+
+    def _resolve(self, out, n):
+        with self.queue._lock:
+            return [self.queue._by_handle.pop(out[i]) for i in range(n)]
+
+    def poll(self, now: Optional[float] = None):
+        from distributed_inference_server_tpu.serving.batcher import (
+            AdmissionBatch,
+        )
+        from distributed_inference_server_tpu.core.types import new_batch_id
+
+        now = time.monotonic() if now is None else now
+        cap = max(1, self.effective_max_batch())
+        out = (ctypes.c_uint64 * cap)()
+        n = self._lib.batcher_poll(
+            self._ptr, ctypes.c_double(now), out, cap
+        )
+        if n <= 0:
+            return None
+        return AdmissionBatch(new_batch_id(), self._resolve(out, n), now)
+
+    def flush(self, now: Optional[float] = None):
+        from distributed_inference_server_tpu.serving.batcher import (
+            AdmissionBatch,
+        )
+        from distributed_inference_server_tpu.core.types import new_batch_id
+
+        now = time.monotonic() if now is None else now
+        cap = max(1, self.pending_count())
+        out = (ctypes.c_uint64 * cap)()
+        n = self._lib.batcher_flush(self._ptr, out, cap)
+        if n <= 0:
+            return None
+        return AdmissionBatch(new_batch_id(), self._resolve(out, n), now)

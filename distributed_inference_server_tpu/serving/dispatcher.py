@@ -63,6 +63,18 @@ def _make_queue(queue_config, force: Optional[bool] = None):
     return PriorityQueueManager(queue_config)
 
 
+def _make_batcher(queue, batcher_config):
+    """Pick the admission-batcher tier to match the queue: when the queue
+    is native, the batcher is too (native/batcher.cpp — one native
+    batcher_poll drains the queue and manages the window, no Python in
+    the per-request admission path); the Python batcher otherwise."""
+    from distributed_inference_server_tpu import native
+
+    if isinstance(queue, getattr(native, "NativePriorityQueue", ())):
+        return native.NativeAdmissionBatcher(queue, batcher_config)
+    return AdmissionBatcher(queue, batcher_config)
+
+
 class Dispatcher:
     """Owns the queue, batcher, and dispatch/sweep thread."""
 
@@ -81,7 +93,7 @@ class Dispatcher:
         self.queue: PriorityQueueManager[ServerRequest] = _make_queue(
             queue_config, native_queue
         )
-        self.batcher: AdmissionBatcher[ServerRequest] = AdmissionBatcher(
+        self.batcher: AdmissionBatcher[ServerRequest] = _make_batcher(
             self.queue, batcher_config
         )
         self.metrics = metrics

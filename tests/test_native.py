@@ -203,3 +203,108 @@ def test_engine_runs_on_native_allocator():
         results[use_native] = toks
     assert results[True] == results[False]
     assert len(results[True]) == 8
+
+
+# ---------------------------------------------------------------------------
+# admission batcher (native/batcher.cpp vs serving/batcher.py)
+# ---------------------------------------------------------------------------
+
+
+def _batcher_pair(window_ms=50.0, max_batch=4, qcfg=None):
+    from distributed_inference_server_tpu.serving.batcher import (
+        AdmissionBatcher,
+        BatcherConfig,
+    )
+
+    qcfg = qcfg or QueueConfig(high_watermark=100, low_watermark=50,
+                               request_timeout_s=60.0, max_queue_size=200)
+    bcfg = BatcherConfig(window_ms=window_ms, max_batch_size=max_batch)
+    pyq = PriorityQueueManager(qcfg)
+    ccq = native.NativePriorityQueue(qcfg)
+    return (
+        (pyq, AdmissionBatcher(pyq, bcfg)),
+        (ccq, native.NativeAdmissionBatcher(ccq, bcfg)),
+    )
+
+
+def _ids(batch):
+    return [r.id for r in batch.requests] if batch else None
+
+
+def test_batcher_differential_random_ops():
+    (pyq, pyb), (ccq, ccb) = _batcher_pair()
+    rnd = random.Random(7)
+    now = 0.0
+    seq = 0
+    for _ in range(2000):
+        op = rnd.random()
+        now += rnd.random() * 0.02
+        if op < 0.5:
+            seq += 1
+            prio = rnd.choice(list(Priority))
+            pyq.enqueue(_req(seq, prio, now))
+            ccq.enqueue(_req(seq, prio, now))
+        elif op < 0.85:
+            assert _ids(pyb.poll(now)) == _ids(ccb.poll(now)), \
+                f"poll diverged at step {seq}"
+            assert pyb.pending_count() == ccb.pending_count()
+        elif op < 0.95 and seq:
+            rid = f"r{rnd.randint(max(1, seq - 5), seq)}"
+            got = (pyb.cancel(rid) is not None,
+                   ccb.cancel(rid) is not None)
+            assert got[0] == got[1], f"cancel diverged on {rid}"
+        else:
+            assert _ids(pyb.flush(now)) == _ids(ccb.flush(now))
+    assert _ids(pyb.flush(now)) == _ids(ccb.flush(now))
+
+
+def test_batcher_window_expiry_native():
+    (_, _), (ccq, ccb) = _batcher_pair(window_ms=50.0, max_batch=8)
+    ccq.enqueue(_req(1, Priority.NORMAL, 0.0))
+    assert ccb.poll(0.0) is None  # window opens, not expired
+    assert ccb.pending_count() == 1
+    assert ccb.poll(0.049) is None
+    batch = ccb.poll(0.051)  # 51ms >= 50ms window
+    assert _ids(batch) == ["r1"]
+    assert ccb.pending_count() == 0
+
+
+def test_batcher_size_dispatch_and_priority_order_native():
+    (_, _), (ccq, ccb) = _batcher_pair(max_batch=3)
+    ccq.enqueue(_req(1, Priority.LOW, 0.0))
+    ccq.enqueue(_req(2, Priority.HIGH, 0.0))
+    ccq.enqueue(_req(3, Priority.NORMAL, 0.0))
+    batch = ccb.poll(0.0)  # size cap reached -> immediate dispatch
+    assert _ids(batch) == ["r2", "r3", "r1"]  # strict priority order
+
+
+def test_batcher_divisor_and_hot_reload_native():
+    from distributed_inference_server_tpu.serving.batcher import BatcherConfig
+
+    (_, _), (ccq, ccb) = _batcher_pair(max_batch=4)
+    ccb.size_divisor = 2  # degradation ladder: effective cap 2
+    for i in range(1, 4):
+        ccq.enqueue(_req(i, Priority.NORMAL, 0.0))
+    assert _ids(ccb.poll(0.0)) == ["r1", "r2"]
+    ccb.size_divisor = 1
+    ccb.config = BatcherConfig(window_ms=1.0, max_batch_size=4)
+    assert ccb.poll(0.0) is None  # r3 pending, window reopened
+    assert _ids(ccb.poll(0.01)) == ["r3"]  # 10ms >= 1ms window
+
+
+def test_dispatcher_uses_native_batcher_with_native_queue():
+    from distributed_inference_server_tpu.serving.dispatcher import (
+        _make_batcher,
+        _make_queue,
+    )
+
+    q = _make_queue(None, True)
+    b = _make_batcher(q, None)
+    assert isinstance(b, native.NativeAdmissionBatcher)
+    q2 = _make_queue(None, False)
+    b2 = _make_batcher(q2, None)
+    from distributed_inference_server_tpu.serving.batcher import (
+        AdmissionBatcher,
+    )
+
+    assert isinstance(b2, AdmissionBatcher)
