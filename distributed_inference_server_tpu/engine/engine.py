@@ -836,16 +836,27 @@ class LLMEngine:
             return "ep"
         return "dense"
 
+    def _resolved_impl(self) -> str:
+        """The decode/prefill attention implementation after "auto"
+        resolution: the Pallas paged-attention kernels on TPU, the XLA
+        gather path elsewhere."""
+        impl = self.ecfg.attention_impl
+        if impl == "auto":
+            return "pallas" if jax.default_backend() == "tpu" else "xla"
+        return impl
+
     def _get_prefill_fn(self, batch: int, bucket: int) -> Callable:
         """Compiled batched-prefill chunk program keyed on (rows, bucket):
         one paged forward over [batch, bucket] new tokens with per-row
         positions/write-slots, plus fused first-token sampling at each
-        row's last valid index."""
+        row's last valid index. Chunk positions are contiguous per row, so
+        the Pallas chunked-prefill kernel applies when selected."""
         key = (batch, bucket)
         fn = self._prefill_fns.get(key)
         if fn is None:
             cfg = self.cfg
             moe_impl = self._moe_impl()
+            impl = self._resolved_impl()
             fwd = self._fwd
 
             if self.draft_params is not None:
@@ -859,12 +870,12 @@ class LLMEngine:
                     logits, k, v = fwd(
                         params, cfg, ids, positions, pool_k, pool_v,
                         write_slots, gather_slots, kv_valid_len,
-                        "xla", moe_impl,
+                        impl, moe_impl,
                     )
                     _, dk, dv = fwd(
                         dparams, dcfg, ids, positions, dpool_k, dpool_v,
                         write_slots, gather_slots, kv_valid_len,
-                        "xla", "dense",
+                        impl, "dense",
                     )
                     last = logits[jnp.arange(ids.shape[0]), last_idx]
                     toks = sample_tokens(rng, last, temp, top_p)
@@ -879,7 +890,7 @@ class LLMEngine:
                         rng):
                 logits, k, v = fwd(
                     params, cfg, ids, positions, pool_k, pool_v,
-                    write_slots, gather_slots, kv_valid_len, "xla", moe_impl,
+                    write_slots, gather_slots, kv_valid_len, impl, moe_impl,
                 )
                 last = logits[jnp.arange(ids.shape[0]), last_idx]
                 toks = sample_tokens(rng, last, temp, top_p)
@@ -919,8 +930,7 @@ class LLMEngine:
                 f"pipeline_depth must be >= 0, got "
                 f"{self.ecfg.pipeline_depth}"
             )
-        if impl == "auto":
-            impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        impl = self._resolved_impl()
         ps = self.pcfg.page_size
         K = self.ecfg.decode_block_size
         smax = self._smax
@@ -997,9 +1007,7 @@ class LLMEngine:
         Writes past the row's capacity are dropped (speculative overshoot
         near max_seq_len)."""
         cfg, dcfg = self.cfg, self.draft_cfg
-        impl = self.ecfg.attention_impl
-        if impl == "auto":
-            impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        impl = self._resolved_impl()
         ps = self.pcfg.page_size
         R = self.ecfg.decode_block_size
         gamma = self.spec.num_draft_tokens
@@ -1065,6 +1073,8 @@ class LLMEngine:
                 dqs = jnp.moveaxis(dqs, 0, 1)[:, :gamma]  # [B, gamma, V]
 
                 # ---- target: one verify forward over [last, d_1..d_g] ----
+                # (positions are contiguous per row, so the Pallas
+                # chunked-prefill kernel applies when selected)
                 ver_tokens = jnp.concatenate([tokens[:, None], dtoks], 1)
                 ver_pos = positions[:, None] + jnp.arange(W)[None]
                 ok = active[:, None] & (ver_pos < smax)
@@ -1075,7 +1085,7 @@ class LLMEngine:
                 kv_valid = jnp.where(active, positions + W, 0)
                 logits, pool_k, pool_v = fwd(
                     params, cfg, ver_tokens, ver_pos, pool_k, pool_v,
-                    write, gather, kv_valid, "xla", moe_impl,
+                    write, gather, kv_valid, impl, moe_impl,
                 )
                 tps = spec_probs(logits, temp[:, None])  # [B, W, V]
 

@@ -331,8 +331,11 @@ def paged_forward(
         (S_max = max_pages_per_seq * page_size).
       kv_valid_len: [B] tokens valid in each row's gathered window.
       attention_impl: "xla" (gather-then-dense-attend, the reference path)
-        or "pallas" (ragged paged-attention kernel reading pages straight
-        from the pool — decode only, requires T == 1 and ``page_size``).
+        or "pallas" (ragged paged-attention kernels reading pages straight
+        from the pool — the decode kernel for T == 1, the chunked-prefill
+        kernel for T > 1; requires ``page_size``, and for T > 1 each
+        row's positions must be a contiguous run starting at
+        positions[:, 0] — the engine's prefill-chunk layout).
       page_size: tokens per page; required for the Pallas path.
       mesh: the device mesh when running tensor-parallel. GSPMD cannot
         partition an opaque kernel, so under TP the Pallas call is wrapped
@@ -341,42 +344,54 @@ def paged_forward(
 
     Returns (logits [B, T, V] f32, new pool_k, new pool_v).
     """
-    if attention_impl == "pallas" and input_ids.shape[1] != 1:
-        raise ValueError(
-            "attention_impl='pallas' is decode-only (T == 1); prefill goes "
-            f"through the XLA path, got T={input_ids.shape[1]}"
-        )
     use_pallas = attention_impl == "pallas"
     if use_pallas:
         from distributed_inference_server_tpu.ops.pallas import (
             paged_attention_decode,
+            paged_attention_prefill,
         )
 
         if page_size <= 0:
             raise ValueError("attention_impl='pallas' requires page_size")
+        decode_step = input_ids.shape[1] == 1
         # gather_slots rows are table[p]*page_size + offset by construction
         page_tables = gather_slots[:, ::page_size] // page_size
 
-        def _attend_pallas(q3, k_layer, v_layer, tables, valid):
-            return paged_attention_decode(
-                q3, k_layer, v_layer, tables, valid, page_size=page_size
-            )
+        if decode_step:
+
+            def _attend_pallas(q3, k_layer, v_layer, tables, valid):
+                return paged_attention_decode(
+                    q3, k_layer, v_layer, tables, valid,
+                    page_size=page_size,
+                )
+        else:
+            q_start = positions[:, 0]
+
+            def _attend_pallas(q4, k_layer, v_layer, tables, valid):
+                return paged_attention_prefill(
+                    q4, k_layer, v_layer, tables, q_start, valid,
+                    page_size=page_size,
+                )
 
         if mesh is not None and mesh.shape.get("tensor", 1) > 1:
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
+            q_spec = (
+                P("data", "tensor", None) if decode_step
+                else P("data", None, "tensor", None)
+            )
             _attend_pallas = shard_map(
                 _attend_pallas,
                 mesh=mesh,
                 in_specs=(
-                    P("data", "tensor", None),  # q [B, H, D]
+                    q_spec,  # q [B, H, D] / [B, T, H, D]
                     P(None, "tensor", None),  # pool layer [slots, KV, D]
                     P(None, "tensor", None),
                     P("data", None),  # page tables [B, P]
                     P("data"),  # kv_valid_len [B]
                 ),
-                out_specs=P("data", "tensor", None),
+                out_specs=q_spec,
                 check_vma=False,
             )
 
@@ -386,10 +401,14 @@ def paged_forward(
 
     def attend_fn(q, k_layer, v_layer):
         if use_pallas:
-            out = _attend_pallas(
-                q[:, 0], k_layer, v_layer, page_tables, kv_valid_len
+            if decode_step:
+                out = _attend_pallas(
+                    q[:, 0], k_layer, v_layer, page_tables, kv_valid_len
+                )
+                return out[:, None]
+            return _attend_pallas(
+                q, k_layer, v_layer, page_tables, kv_valid_len
             )
-            return out[:, None]
         k_seq = k_layer[gather_slots]  # [B, S_max, KV, D]
         v_seq = v_layer[gather_slots]
         return gqa_attention(q, k_seq, v_seq, positions, kv_valid_len)

@@ -9,6 +9,7 @@ tested against, and runs in interpret mode on the CPU backend.
 
 from distributed_inference_server_tpu.ops.pallas.paged_attention import (
     paged_attention_decode,
+    paged_attention_prefill,
 )
 
-__all__ = ["paged_attention_decode"]
+__all__ = ["paged_attention_decode", "paged_attention_prefill"]
