@@ -72,7 +72,9 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "cp_min_tokens": (int, 0),
         # sequence-parallel attention flavor: ring | ulysses
         "sp_impl": (str, "ring"),
-        "max_batch": (int, 8),
+        # continuous-batching decode slots per replica (the north star
+        # needs 64-256; 32 is the conservative single-chip default)
+        "max_batch": (int, 32),
         "prefill_buckets": (list, [32, 128, 512]),
         "page_size": (int, 16),
         "num_pages": (int, 2048),

@@ -101,6 +101,9 @@ class EngineRunner:
         self._inflight: Dict[RequestId, ServerRequest] = {}
         self._pending_embeds: Dict[int, Callable] = {}
         self._embed_seq = 0
+        # incremental embeddings jobs, advanced one device batch per
+        # runner-loop iteration (owned by the engine thread)
+        self._embed_jobs: Deque[dict] = deque()
         self._engine: Optional[LLMEngine] = None
         self._thread: Optional[threading.Thread] = None
         self._cache_seen = {"hits": 0, "misses": 0, "evictions": 0}
@@ -198,7 +201,12 @@ class EngineRunner:
     ) -> None:
         """Queue an embeddings computation; ``on_result(array, error)`` is
         called exactly once — on the runner thread, or here/at crash time if
-        the engine is (or becomes) unavailable."""
+        the engine is (or becomes) unavailable.
+
+        The computation runs as an incremental job: the runner loop
+        processes ONE device batch per iteration between decode steps
+        (engine.embed_step), so a large embeddings request never stalls
+        the in-flight generations on this replica."""
         # register BEFORE the health check (same crash-safe ordering as
         # submit): a crash between check and registration would otherwise
         # strand the callback un-called forever
@@ -211,16 +219,39 @@ class EngineRunner:
                 cb(None, self._last_error or "engine unavailable")
             return
 
-        def _do() -> None:
-            cb = self._pending_embeds.pop(token, None)
-            if cb is None:  # already failed by a crash handler
-                return
-            try:
-                cb(self._engine.embed_ids(ids_list), None)
-            except Exception as e:  # noqa: BLE001 — isolation boundary
-                cb(None, str(e))
+        def _enqueue() -> None:
+            # bind the CURRENT engine: a hot-swap mid-job must not mix
+            # two models' hidden states in one accumulator
+            engine = self._engine
+            self._embed_jobs.append(
+                {"token": token, "engine": engine,
+                 "state": engine.embed_start(ids_list)}
+            )
 
-        self._post(_do)
+        self._post(_enqueue)
+
+    def _embed_quantum(self) -> bool:
+        """Advance the oldest embeddings job by one device batch (runner
+        loop calls this between decode steps). Returns True if it did
+        work."""
+        if not self._embed_jobs:
+            return False
+        job = self._embed_jobs[0]
+        if job["token"] not in self._pending_embeds:
+            self._embed_jobs.popleft()  # failed by a crash handler
+            return True
+        result = error = None
+        try:
+            if job["engine"].embed_step(job["state"]):
+                result = job["engine"].embed_finish(job["state"])
+        except Exception as e:  # noqa: BLE001 — isolation boundary
+            error = str(e)
+        if result is not None or error is not None:
+            self._embed_jobs.popleft()
+            cb = self._pending_embeds.pop(job["token"], None)
+            if cb is not None:
+                cb(result, error)
+        return True
 
     def profile_steps(self, n: int, timeout_s: float = 30.0) -> dict:
         """Capture a device trace over the next ``n`` engine steps
@@ -370,6 +401,7 @@ class EngineRunner:
                     self._dispatch(outputs)
                     self._report_cache_deltas()
                 worked |= self._step_draining()
+                worked |= self._embed_quantum()
                 if not worked:
                     self._wake.wait(0.005)
                     self._wake.clear()

@@ -308,3 +308,38 @@ def test_dispatcher_uses_native_batcher_with_native_queue():
     )
 
     assert isinstance(b2, AdmissionBatcher)
+
+
+# ---------------------------------------------------------------------------
+# race detection (SURVEY §5): TSan-instrumented native stress harness
+# ---------------------------------------------------------------------------
+
+
+def _run_stress(target: str, env_extra=None):
+    import os
+    import subprocess
+
+    d = os.path.dirname(os.path.abspath(native.__file__))
+    build = subprocess.run(["make", "-C", d, target],
+                           capture_output=True, timeout=300)
+    if build.returncode != 0:
+        pytest.skip(f"{target} build unavailable: "
+                    f"{build.stderr.decode()[-200:]}")
+    env = dict(os.environ, **(env_extra or {}))
+    run = subprocess.run([os.path.join(d, target)], capture_output=True,
+                         timeout=600, env=env)
+    assert run.returncode == 0, (
+        f"{target} failed:\n{run.stdout.decode()[-1000:]}\n"
+        f"{run.stderr.decode()[-3000:]}"
+    )
+    assert b"stress OK" in run.stdout
+
+
+def test_native_stress_tsan():
+    """The whole native tier (queue + batcher + allocator) hammered from
+    concurrent threads under ThreadSanitizer; any data race aborts."""
+    _run_stress("stress_tsan", {"TSAN_OPTIONS": "halt_on_error=1"})
+
+
+def test_native_stress_plain():
+    _run_stress("stress_plain")
