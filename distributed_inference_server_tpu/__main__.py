@@ -201,6 +201,8 @@ def main(argv=None) -> int:
             validator_config=validator_cfg,
             auto_restart=cfg.get("server", "auto_restart"),
             health_check_interval_s=cfg.get("server", "health_check_interval_s"),
+            otlp_endpoint=cfg.get("tracing", "otlp_endpoint"),
+            otlp_service_name=cfg.get("tracing", "service_name"),
         )
         server.start()
     except (ModelLoadError, RuntimeError, TimeoutError) as e:

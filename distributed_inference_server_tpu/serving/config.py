@@ -89,6 +89,12 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "num_draft_tokens": (int, 4),
         "spec_disable_threshold": (float, 0.5),
     },
+    "tracing": {
+        # OTLP/HTTP collector URL for span export (utils/otlp.py), e.g.
+        # http://collector:4318/v1/traces; empty = in-memory ring only
+        "otlp_endpoint": (str, ""),
+        "service_name": (str, "distributed-inference-server-tpu"),
+    },
     "distributed": {
         # multi-host data plane (parallel/distributed.py): every process
         # of the fleet runs the same config with its own process_id

@@ -66,15 +66,29 @@ class InferenceServer:
         auto_restart: bool = True,
         health_check_interval_s: float = 1.0,
         model_resolver: Optional[Callable[[str], Callable[[], LLMEngine]]] = None,
+        otlp_endpoint: str = "",
+        otlp_service_name: str = "distributed-inference-server-tpu",
     ):
         """``model_resolver(name) -> engine_factory`` enables the admin
-        model-swap endpoint (Req 13); None leaves it unconfigured (501)."""
+        model-swap endpoint (Req 13); None leaves it unconfigured (501).
+        ``otlp_endpoint`` (a collector's /v1/traces URL) turns on the
+        OTLP/HTTP exporter (utils/otlp.py) — real OpenTelemetry export,
+        S12 — alongside the in-memory ring."""
         from distributed_inference_server_tpu.utils.tracing import Tracer
 
         self.engine_factory = engine_factory
         self.model_resolver = model_resolver
         self.metrics = MetricsCollector()
         self.tracer = Tracer()
+        self.otlp = None
+        if otlp_endpoint:
+            from distributed_inference_server_tpu.utils.otlp import (
+                OTLPExporter,
+            )
+
+            self.otlp = OTLPExporter(
+                otlp_endpoint, service_name=otlp_service_name
+            ).attach(self.tracer)
         self.scheduler = AdaptiveScheduler(
             strategy=strategy,
             health_check_interval_s=health_check_interval_s,
@@ -122,6 +136,8 @@ class InferenceServer:
         self.scheduler.stop_health_loop()
         for runner in self.scheduler.engines():
             runner.shutdown()
+        if self.otlp is not None:
+            self.otlp.shutdown()
         self._started = False
 
     # -- elasticity --------------------------------------------------------

@@ -1,0 +1,164 @@
+"""OTLP/HTTP trace exporter — OpenTelemetry wire format on the stdlib.
+
+S12 (``requirements.md:122`` [spec]) asks for OpenTelemetry tracing; the
+opentelemetry SDK is not in this image, so this module speaks the OTLP
+protocol directly: finished spans (utils/tracing.py model) are converted
+to OTLP JSON (``ExportTraceServiceRequest``) and POSTed to a collector's
+``/v1/traces`` endpoint from a background thread — batched, bounded, and
+fail-open (a dead collector drops spans and counts them; serving never
+blocks on telemetry).
+
+Attach to a tracer with ``exporter.attach(tracer)`` or pass
+``tracer.exporters.append(exporter.export)``. Configure via the
+``[tracing]`` server-config section (otlp_endpoint / service_name).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from distributed_inference_server_tpu.utils.tracing import Span, Tracer
+
+
+def _attr_value(v: object) -> Dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def _attrs(d: Dict[str, object]) -> List[Dict]:
+    return [{"key": k, "value": _attr_value(v)} for k, v in d.items()]
+
+
+class OTLPExporter:
+    """Batched OTLP/HTTP JSON trace exporter."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        service_name: str = "distributed-inference-server-tpu",
+        headers: Optional[Dict[str, str]] = None,
+        batch_size: int = 128,
+        flush_interval_s: float = 2.0,
+        queue_capacity: int = 4096,
+        timeout_s: float = 5.0,
+    ):
+        self.endpoint = endpoint
+        self.service_name = service_name
+        self.headers = dict(headers or {})
+        self.batch_size = batch_size
+        self.flush_interval_s = flush_interval_s
+        self.timeout_s = timeout_s
+        self._queue: Deque[Span] = deque(maxlen=queue_capacity)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # monotonic -> epoch conversion (span timestamps are monotonic)
+        self._epoch_offset_ns = time.time_ns() - time.monotonic_ns()
+        self.exported = 0
+        self.dropped = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def attach(self, tracer: Tracer) -> "OTLPExporter":
+        tracer.exporters.append(self.export)
+        self.start()
+        return self
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="otlp-exporter", daemon=True
+            )
+            self._thread.start()
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+        self._flush()  # final drain on the caller's thread
+
+    # -- tracer sink --------------------------------------------------------
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            if len(self._queue) == self._queue.maxlen:
+                self.dropped += 1
+            self._queue.append(span)
+            n = len(self._queue)
+        if n >= self.batch_size:
+            self._wake.set()
+
+    # -- background flush ---------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.flush_interval_s)
+            self._wake.clear()
+            self._flush()
+
+    def _flush(self) -> None:
+        with self._lock:
+            if not self._queue:
+                return
+            spans = list(self._queue)
+            self._queue.clear()
+        try:
+            body = json.dumps(self._encode(spans)).encode()
+            req = urllib.request.Request(
+                self.endpoint,
+                data=body,
+                headers={"Content-Type": "application/json", **self.headers},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+            self.exported += len(spans)
+        except Exception:  # noqa: BLE001 — telemetry is fail-open
+            self.dropped += len(spans)
+
+    # -- OTLP encoding ------------------------------------------------------
+
+    def _encode(self, spans: List[Span]) -> Dict:
+        off = self._epoch_offset_ns
+        out = []
+        for s in spans:
+            out.append({
+                # OTLP ids: 16-byte trace, 8-byte span (hex); the tracer
+                # mints 8-byte trace ids — zero-pad to the wire width
+                "traceId": s.trace_id.rjust(32, "0"),
+                "spanId": s.span_id[:16],
+                "parentSpanId": (s.parent_id or "")[:16],
+                "name": s.name,
+                "kind": 1,  # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": str(s.start_ns + off),
+                "endTimeUnixNano": str((s.end_ns or s.start_ns) + off),
+                "attributes": _attrs(s.attributes),
+                "events": [
+                    {"timeUnixNano": str(t + off), "name": n}
+                    for t, n in s.events
+                ],
+                "status": {"code": 1 if s.status == "ok" else 2},
+            })
+        return {
+            "resourceSpans": [{
+                "resource": {"attributes": _attrs(
+                    {"service.name": self.service_name}
+                )},
+                "scopeSpans": [{
+                    "scope": {"name": "distributed_inference_server_tpu"},
+                    "spans": out,
+                }],
+            }]
+        }
