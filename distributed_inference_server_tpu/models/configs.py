@@ -47,6 +47,11 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     # per-expert buffer headroom for the capacity-based dispatch (ops/moe.py)
     moe_capacity_factor: float = 1.25
+    # Mistral-style sliding-window attention: each token attends the last
+    # `sliding_window` positions only; None = full causal
+    sliding_window: Optional[int] = None
+    # Qwen2-style additive bias on the q/k/v projections
+    attention_bias: bool = False
 
     @property
     def q_size(self) -> int:
@@ -122,6 +127,38 @@ MIXTRAL_8X7B = ModelConfig(
     num_experts_per_tok=2,
 )
 
+MISTRAL_7B = ModelConfig(
+    name="mistral-7b",
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rms_norm_eps=1e-5,
+    rope_theta=10000.0,
+    tie_word_embeddings=False,
+    max_position_embeddings=32768,
+    sliding_window=4096,
+)
+
+QWEN2_7B = ModelConfig(
+    name="qwen2-7b",
+    vocab_size=152064,
+    hidden_size=3584,
+    intermediate_size=18944,
+    num_layers=28,
+    num_heads=28,
+    num_kv_heads=4,
+    head_dim=128,
+    rms_norm_eps=1e-6,
+    rope_theta=1e6,
+    tie_word_embeddings=False,
+    max_position_embeddings=131072,
+    attention_bias=True,
+)
+
 # Tiny configs for tests: small enough to run on the CPU backend in ms.
 TINY = ModelConfig(
     name="tiny",
@@ -138,10 +175,13 @@ TINY = ModelConfig(
 )
 
 TINY_MOE = TINY.with_overrides(name="tiny-moe", num_experts=4, num_experts_per_tok=2)
+TINY_SWA = TINY.with_overrides(name="tiny-swa", sliding_window=8)
+TINY_BIAS = TINY.with_overrides(name="tiny-bias", attention_bias=True)
 
 PRESETS = {
     c.name: c
-    for c in (LLAMA_3_2_1B, LLAMA_3_8B, LLAMA_3_70B, MIXTRAL_8X7B, TINY, TINY_MOE)
+    for c in (LLAMA_3_2_1B, LLAMA_3_8B, LLAMA_3_70B, MIXTRAL_8X7B,
+              MISTRAL_7B, QWEN2_7B, TINY, TINY_MOE, TINY_SWA, TINY_BIAS)
 }
 
 

@@ -62,6 +62,12 @@ def init_params(
         "wo": w(keys[3], (L, cfg.q_size, H)),
         "mlp_norm": jnp.ones((L, H), dtype),
     }
+    if cfg.attention_bias:  # Qwen2-style q/k/v projection bias
+        layers.update(
+            bq=w(keys[10], (L, cfg.q_size)),
+            bk=w(keys[11], (L, cfg.kv_size)),
+            bv=w(keys[12], (L, cfg.kv_size)),
+        )
     if cfg.is_moe:
         E = cfg.num_experts
         layers.update(
@@ -249,9 +255,12 @@ def layer_block(
     parallel runner (parallel/pp.py) can drive per-stage layer stacks."""
     B, T, _ = h.shape
     x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
-    q = _mm(x, layer["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
-    k = _mm(x, layer["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-    v = _mm(x, layer["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    q, k, v = _mm(x, layer["wq"]), _mm(x, layer["wk"]), _mm(x, layer["wv"])
+    if cfg.attention_bias:
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
     k_layer = write_fn(k_layer, k)
@@ -295,7 +304,8 @@ def forward(
     Returns: (logits [B, T, vocab] f32, updated cache).
     """
     write_fn = lambda layer, new: _write_kv(layer, new, write_pos)
-    attend_fn = lambda q, k, v: gqa_attention(q, k, v, positions, kv_valid_len)
+    attend_fn = lambda q, k, v: gqa_attention(
+        q, k, v, positions, kv_valid_len, cfg.sliding_window)
     h, new_k, new_v = _run_layers(
         params, cfg, input_ids, positions, cache.k, cache.v, write_fn,
         attend_fn, moe_impl=moe_impl,
@@ -354,6 +364,7 @@ def paged_forward(
         if page_size <= 0:
             raise ValueError("attention_impl='pallas' requires page_size")
         decode_step = input_ids.shape[1] == 1
+        window = cfg.sliding_window or 0
         # gather_slots rows are table[p]*page_size + offset by construction
         page_tables = gather_slots[:, ::page_size] // page_size
 
@@ -362,7 +373,7 @@ def paged_forward(
             def _attend_pallas(q3, k_layer, v_layer, tables, valid):
                 return paged_attention_decode(
                     q3, k_layer, v_layer, tables, valid,
-                    page_size=page_size,
+                    page_size=page_size, sliding_window=window,
                 )
         else:
             q_start = positions[:, 0]
@@ -370,7 +381,7 @@ def paged_forward(
             def _attend_pallas(q4, k_layer, v_layer, tables, valid):
                 return paged_attention_prefill(
                     q4, k_layer, v_layer, tables, q_start, valid,
-                    page_size=page_size,
+                    page_size=page_size, sliding_window=window,
                 )
 
         if mesh is not None and mesh.shape.get("tensor", 1) > 1:
@@ -411,7 +422,8 @@ def paged_forward(
             )
         k_seq = k_layer[gather_slots]  # [B, S_max, KV, D]
         v_seq = v_layer[gather_slots]
-        return gqa_attention(q, k_seq, v_seq, positions, kv_valid_len)
+        return gqa_attention(q, k_seq, v_seq, positions, kv_valid_len,
+                             cfg.sliding_window)
 
     h, new_k, new_v = _run_layers(
         params, cfg, input_ids, positions, pool_k, pool_v, write_fn,
@@ -434,7 +446,8 @@ def hidden_states(
     B, T = input_ids.shape
     cache = KVCache.create(cfg, B, T, dtype=params["embed"].dtype)
     write_fn = lambda layer, new: _write_kv(layer, new, positions)
-    attend_fn = lambda q, k, v: gqa_attention(q, k, v, positions, kv_valid_len)
+    attend_fn = lambda q, k, v: gqa_attention(
+        q, k, v, positions, kv_valid_len, cfg.sliding_window)
     h, _, _ = _run_layers(
         params, cfg, input_ids, positions, cache.k, cache.v, write_fn, attend_fn
     )

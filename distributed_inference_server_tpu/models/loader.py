@@ -67,6 +67,13 @@ def params_from_hf_state_dict(
         return jnp.asarray(np.stack(rows), dtype=dtype)
 
     layers: Dict[str, jnp.ndarray] = {}
+    if cfg.attention_bias:  # Qwen2-style q/k/v bias
+        for ours, suffix in (
+            ("bq", "self_attn.q_proj.bias"),
+            ("bk", "self_attn.k_proj.bias"),
+            ("bv", "self_attn.v_proj.bias"),
+        ):
+            layers[ours] = stack(suffix, False)
     if cfg.is_moe:
         for ours, suffix, t in _MOE_LAYER_MAP:
             layers[ours] = stack(suffix, t)
@@ -124,6 +131,18 @@ def config_from_hf_json(obj: Mapping[str, Any], name: str = "hf") -> ModelConfig
         max_position_embeddings=int(obj.get("max_position_embeddings", 8192)),
         num_experts=int(obj.get("num_local_experts", 0)),
         num_experts_per_tok=int(obj.get("num_experts_per_tok", 2)),
+        # Mistral-style window (qwen2 gates it behind use_sliding_window)
+        sliding_window=(
+            int(obj["sliding_window"])
+            if obj.get("sliding_window")
+            and obj.get("use_sliding_window", True) else None
+        ),
+        # Qwen2 sets q/k/v bias (its config spells it qkv_bias or relies
+        # on the architecture default)
+        attention_bias=bool(
+            obj.get("attention_bias", obj.get("qkv_bias",
+                    obj.get("model_type") == "qwen2"))
+        ),
     )
 
 

@@ -11,6 +11,8 @@ Replaces the reference's planned llama.cpp attention (design.md:7 [spec]).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 
 _NEG_INF = -1e30  # large-negative instead of -inf so fully-masked rows stay finite
@@ -22,6 +24,7 @@ def gqa_attention(
     v_cache: jnp.ndarray,
     q_positions: jnp.ndarray,
     kv_valid_len: jnp.ndarray,
+    sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Causal GQA attention of new queries against a contiguous KV cache.
 
@@ -33,6 +36,8 @@ def gqa_attention(
         queries may hold any in-range value; their outputs are discarded
         downstream.
       kv_valid_len: [B] number of valid cache slots per row.
+      sliding_window: Mistral-style window — each query attends only the
+        last ``sliding_window`` positions (None = full causal).
 
     Returns: [B, T, H, D] attention outputs in q.dtype.
     """
@@ -50,7 +55,11 @@ def gqa_attention(
     kv_pos = jnp.arange(S)
     causal = kv_pos[None, None, :] <= q_positions[:, :, None]  # [B, T, S]
     valid = kv_pos[None, None, :] < kv_valid_len[:, None, None]  # [B, 1->T, S]
-    mask = (causal & valid)[:, None, None, :, :]  # [B, 1, 1, T, S]
+    window_ok = causal if sliding_window is None else (
+        causal & (kv_pos[None, None, :]
+                  > q_positions[:, :, None] - sliding_window)
+    )
+    mask = (window_ok & valid)[:, None, None, :, :]  # [B, 1, 1, T, S]
 
     scores = jnp.where(mask, scores, _NEG_INF)
     probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))

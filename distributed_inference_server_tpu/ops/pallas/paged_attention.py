@@ -68,6 +68,7 @@ def _decode_kernel(
     page_size: int,
     pages_per_block: int,
     num_page_slots: int,
+    sliding_window: int = 0,
 ):
     b = pl.program_id(0)
     num_kv = q_ref.shape[1]
@@ -77,6 +78,10 @@ def _decode_kernel(
 
     valid = valid_ref[b]
     num_blocks = lax.div(valid + blk_tokens - 1, blk_tokens)
+    # sliding window: the decode query sits at position valid-1, so only
+    # tokens >= valid - window are attended; skip whole blocks below it
+    win_lo = jnp.maximum(valid - sliding_window, 0) if sliding_window else 0
+    first_block = lax.div(win_lo, blk_tokens) if sliding_window else 0
 
     m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
     l_ref[:] = jnp.zeros_like(l_ref)
@@ -110,9 +115,9 @@ def _decode_kernel(
                 v_hbm.at[page], v_buf.at[slot, i], sem_v.at[slot, i]
             ).wait()
 
-    @pl.when(num_blocks > 0)
+    @pl.when(num_blocks > first_block)
     def _run():
-        start_block(0, 0)
+        start_block(lax.rem(first_block, 2), first_block)
 
         def loop(blk, _):
             slot = lax.rem(blk, 2)
@@ -142,7 +147,10 @@ def _decode_kernel(
                 token_ids = start + lax.broadcasted_iota(
                     jnp.int32, s.shape, 1
                 )
-                s = jnp.where(token_ids < valid, s, _NEG_INF)
+                ok = token_ids < valid
+                if sliding_window:
+                    ok &= token_ids >= win_lo
+                s = jnp.where(ok, s, _NEG_INF)
 
                 m_prev = m_ref[rows, :1]  # [G, 1]
                 l_prev = l_ref[rows, :1]
@@ -159,7 +167,7 @@ def _decode_kernel(
                 l_ref[rows] = jnp.broadcast_to(l_new, (G, l_ref.shape[1]))
             return 0
 
-        lax.fori_loop(0, num_blocks, loop, 0)
+        lax.fori_loop(first_block, num_blocks, loop, 0)
 
     l = jnp.maximum(l_ref[:, :1], 1e-30)  # rows with valid=0 emit zeros
     out = acc_ref[:] / l  # [KV*G, D]
@@ -185,6 +193,7 @@ def _prefill_kernel(
     page_size: int,
     pages_per_block: int,
     num_page_slots: int,
+    sliding_window: int = 0,
 ):
     b = pl.program_id(0)
     qb = pl.program_id(1)
@@ -200,6 +209,12 @@ def _prefill_kernel(
     # clamped by the row's valid length — the KV loop never reads past it
     kv_upper = jnp.minimum(valid, q_base + TQ)
     num_blocks = lax.div(kv_upper + blk_tokens - 1, blk_tokens)
+    # sliding window: no query in this tile sees anything before
+    # q_base - window + 1, so whole blocks below it are skipped
+    first_block = (
+        lax.div(jnp.maximum(q_base - sliding_window + 1, 0), blk_tokens)
+        if sliding_window else 0
+    )
 
     def start_block(slot, blk):
         for i in range(PB):
@@ -245,6 +260,8 @@ def _prefill_kernel(
             jnp.int32, (rows, blk_tokens), 1
         )
         mask = (kv_idx <= q_pos) & (kv_idx < valid)
+        if sliding_window:
+            mask &= kv_idx > q_pos - sliding_window
 
         ms, ls, accs = [], [], []
         # static unroll over the (small) kv-head count; each head is one
@@ -277,11 +294,11 @@ def _prefill_kernel(
         return (jnp.stack(ms), jnp.stack(ls), jnp.stack(accs))
 
     def run():
-        start_block(0, 0)
-        return lax.fori_loop(0, num_blocks, loop, (m0, l0, acc0))
+        start_block(lax.rem(first_block, 2), first_block)
+        return lax.fori_loop(first_block, num_blocks, loop, (m0, l0, acc0))
 
     m, l, acc = lax.cond(
-        num_blocks > 0, run, lambda: (m0, l0, acc0)
+        num_blocks > first_block, run, lambda: (m0, l0, acc0)
     )
     out = acc / jnp.maximum(l, 1e-30)  # [KV, TQ*G, D]
     out_ref[0] = (
@@ -293,7 +310,8 @@ def _prefill_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("page_size", "q_block", "pages_per_block", "interpret"),
+    static_argnames=("page_size", "q_block", "pages_per_block", "interpret",
+                     "sliding_window"),
 )
 def paged_attention_prefill(
     q: jnp.ndarray,
@@ -307,6 +325,7 @@ def paged_attention_prefill(
     q_block: int = 128,
     pages_per_block: int = 8,
     interpret: bool | None = None,
+    sliding_window: int = 0,
 ) -> jnp.ndarray:
     """Chunked-prefill paged GQA attention against the flat page pool.
 
@@ -378,6 +397,7 @@ def paged_attention_prefill(
             page_size=page_size,
             pages_per_block=PB,
             num_page_slots=P,
+            sliding_window=sliding_window,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, T, KV, G, D), q.dtype),
@@ -400,7 +420,8 @@ def paged_attention_prefill(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("page_size", "pages_per_block", "interpret"),
+    static_argnames=("page_size", "pages_per_block", "interpret",
+                     "sliding_window"),
 )
 def paged_attention_decode(
     q: jnp.ndarray,
@@ -412,6 +433,7 @@ def paged_attention_decode(
     page_size: int,
     pages_per_block: int = 8,
     interpret: bool | None = None,
+    sliding_window: int = 0,
 ) -> jnp.ndarray:
     """Decode-step paged GQA attention against the flat page pool.
 
@@ -471,6 +493,7 @@ def paged_attention_decode(
             page_size=page_size,
             pages_per_block=PB,
             num_page_slots=P,
+            sliding_window=sliding_window,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, G, D), q.dtype),

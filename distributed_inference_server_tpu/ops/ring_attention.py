@@ -35,6 +35,7 @@ def ring_attention(
     q_positions: jnp.ndarray,
     kv_positions: jnp.ndarray,
     axis_name: str = "seq",
+    sliding_window: int | None = None,
 ) -> jnp.ndarray:
     """Per-shard ring attention body (must run inside shard_map/pmap).
 
@@ -64,6 +65,10 @@ def ring_attention(
             "btkgd,bskd->bkgts", qg, k_blk.astype(jnp.float32)
         ) * scale
         causal = pos_kv[:, None, :] <= q_positions[:, :, None]  # [B, Tl, S]
+        if sliding_window is not None:
+            causal &= (
+                pos_kv[:, None, :] > q_positions[:, :, None] - sliding_window
+            )
         valid = (pos_kv >= 0)[:, None, :] & (q_positions >= 0)[:, :, None]
         mask = (causal & valid)[:, None, None, :, :]
         return jnp.where(mask, s, _NEG_INF)
@@ -118,12 +123,14 @@ def ring_attention_sharded(
     q_positions: jnp.ndarray,
     kv_positions: jnp.ndarray,
     axis_name: str = "seq",
+    sliding_window: int | None = None,
 ) -> jnp.ndarray:
     """shard_map wrapper: sequence dim sharded over ``axis_name``, heads
     over ``tensor`` (ring attention composes with TP: each tensor shard
     rings its own heads)."""
     fn = jax.shard_map(
-        lambda *a: ring_attention(*a, axis_name=axis_name),
+        lambda *a: ring_attention(*a, axis_name=axis_name,
+                                  sliding_window=sliding_window),
         mesh=mesh,
         in_specs=(
             P("data", axis_name, "tensor", None),

@@ -34,6 +34,7 @@ def ulysses_attention(
     q_positions: jnp.ndarray,
     kv_valid_len: jnp.ndarray,
     axis_name: str = "seq",
+    sliding_window: int | None = None,
 ) -> jnp.ndarray:
     """Per-shard Ulysses attention body (must run inside shard_map).
 
@@ -61,7 +62,7 @@ def ulysses_attention(
     pos = lax.all_gather(q_positions, axis_name, axis=1, tiled=True)  # [B, T]
     # full-sequence causal attention for this device's head group; padding
     # keys sit at positions >= kv_valid_len (right-padded) and are masked
-    out = gqa_attention(qh, kh, vh, pos, kv_valid_len)
+    out = gqa_attention(qh, kh, vh, pos, kv_valid_len, sliding_window)
     # gather heads / scatter sequence back: [B, T, H/s, D] -> [B, Tl, H, D]
     return lax.all_to_all(
         out, axis_name, split_axis=1, concat_axis=2, tiled=True
@@ -76,12 +77,14 @@ def ulysses_attention_sharded(
     q_positions: jnp.ndarray,
     kv_valid_len: jnp.ndarray,
     axis_name: str = "seq",
+    sliding_window: int | None = None,
 ) -> jnp.ndarray:
     """shard_map wrapper: sequence over ``axis_name``, heads over
     ``tensor`` (Ulysses composes with TP: the all-to-all re-shards each
     tensor shard's own heads)."""
     fn = jax.shard_map(
-        lambda *a: ulysses_attention(*a, axis_name=axis_name),
+        lambda *a: ulysses_attention(*a, axis_name=axis_name,
+                                     sliding_window=sliding_window),
         mesh=mesh,
         in_specs=(
             P("data", axis_name, "tensor", None),

@@ -71,13 +71,15 @@ def cp_prefill(
 
         def attend(q, k_layer, v_layer):
             return ulysses_attention_sharded(
-                mesh, q, k_layer, v_layer, positions, valid_len
+                mesh, q, k_layer, v_layer, positions, valid_len,
+                sliding_window=cfg.sliding_window,
             )
     else:
 
         def attend(q, k_layer, v_layer):
             return ring_attention_sharded(
-                mesh, q, k_layer, v_layer, positions, positions
+                mesh, q, k_layer, v_layer, positions, positions,
+                sliding_window=cfg.sliding_window,
             )
 
     cache = llama.KVCache.create(cfg, B, T, dtype=params["embed"].dtype)

@@ -68,6 +68,14 @@ def llama_param_specs(
         "wo": P(st, "tensor", None),
         "mlp_norm": P(st, None),
     }
+    if cfg.attention_bias:
+        # biases follow their column-parallel projections: [L, out] with
+        # the output features (heads) split on "tensor"
+        layers.update(
+            bq=P(st, "tensor"),
+            bk=P(st, "tensor"),
+            bv=P(st, "tensor"),
+        )
     if cfg.is_moe:
         layers.update(
             router=P(st, None, None),
