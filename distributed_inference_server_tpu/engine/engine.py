@@ -159,7 +159,7 @@ class _Seq:
     __slots__ = (
         "request_id", "token_ids", "prompt_len", "block_table",
         "seq_len", "next_token", "params", "output_text", "emitted_upto",
-        "emitted_tokens", "dev_pos", "dev_steps_left",
+        "emitted_tokens", "dev_pos", "dev_steps_left", "freed_upto",
     )
 
     def __init__(self, request_id: RequestId, prompt_ids: List[int],
@@ -178,6 +178,9 @@ class _Seq:
         # upper bound on the device row's position, and launch budget left
         self.dev_pos = 0
         self.dev_steps_left = 0
+        # sliding-window reclaim watermark: table entries below this are
+        # freed (sentinel) — pages fully behind the attention window
+        self.freed_upto = 0
 
     def num_output_tokens(self) -> int:
         return len(self.token_ids) - self.prompt_len
@@ -1262,6 +1265,8 @@ class LLMEngine:
             ):
                 return False
             use_spec = self._spec_on()
+            for _, s in seated:
+                self._reclaim_window_pages(s)
             advs = {id(s): self._assumed_adv(s, use_spec) for _, s in seated}
             try:
                 for _, s in seated:
@@ -1491,14 +1496,48 @@ class LLMEngine:
             if s is seq:
                 self.slots[i] = None
         self._by_id.pop(seq.request_id, None)
-        # publish full pages for prefix reuse, then drop our references
-        self.allocator.publish(seq.token_ids, seq.block_table)
+        # publish full pages for prefix reuse, then drop our references;
+        # window-reclaimed tables hold sentinels (K/V gone) — not reusable
+        if seq.freed_upto == 0:
+            self.allocator.publish(seq.token_ids, seq.block_table)
         self._release_seq(seq)
 
     def _release_seq(self, seq: _Seq) -> None:
         if seq.block_table:
-            self.allocator.release(seq.block_table)
+            sentinel = self.pcfg.num_pages
+            live = [p for p in seq.block_table if p != sentinel]
+            if live:
+                self.allocator.release(live)
             seq.block_table = []
+            seq.freed_upto = 0
+
+    def _reclaim_window_pages(self, seq: _Seq) -> None:
+        """Sliding-window KV reclaim: pages whose positions are entirely
+        behind every future query's window (position <= seq_len - W, with
+        seq_len the exact resident count — a lower bound on the device
+        position) are released and their table entries set to the
+        out-of-range sentinel. Freed slots are never attended again: the
+        Pallas kernels skip whole blocks below the window, and the XLA
+        gather clamps + masks. Re-prefill after preemption never writes
+        through a sentinel (flat slot lands out of range -> dropped).
+        Turns per-sequence KV from O(length) into O(window)."""
+        W = self.cfg.sliding_window
+        if not W or not seq.block_table:
+            return
+        ps = self.pcfg.page_size
+        sentinel = self.pcfg.num_pages
+        limit = seq.seq_len - W + 1  # positions < limit are dead
+        freed: List[int] = []
+        j = seq.freed_upto
+        while j < len(seq.block_table) and (j + 1) * ps <= limit:
+            page = seq.block_table[j]
+            if page != sentinel:
+                freed.append(page)
+                seq.block_table[j] = sentinel
+            j += 1
+        seq.freed_upto = j
+        if freed:
+            self.allocator.release(freed)
 
     # ------------------------------------------------------------------
     # paging helpers
