@@ -74,6 +74,7 @@ def main(argv=None) -> int:
         pp_microbatches=cfg.get("engine", "pp_microbatches"),
         cp_min_tokens=cfg.get("engine", "cp_min_tokens") or None,
         sp_impl=cfg.get("engine", "sp_impl"),
+        warmup_compile=cfg.get("engine", "warmup_compile"),
     )
     tokenizer = load_tokenizer(model_dir)
 

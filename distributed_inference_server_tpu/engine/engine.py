@@ -137,6 +137,11 @@ class EngineConfig:
     # rotation over ICI, any axis size) or "ulysses" (all-to-all head
     # scatter, axis must divide the query- and KV-head counts)
     sp_impl: str = "ring"
+    # compile every serving program at startup (engine.warmup()) so the
+    # first real request doesn't pay tracing + XLA compile (~20-40s on
+    # TPU). Off by default — tests build many engines; the server and
+    # hot-swap paths turn it on (serving config engine.warmup_compile).
+    warmup_compile: bool = False
 
 
 @dataclass
@@ -486,6 +491,39 @@ class LLMEngine:
 
     def cache_stats(self):
         return self.allocator.stats()
+
+    def warmup(self) -> None:
+        """Compile every serving program before traffic arrives: one
+        throwaway request per prefill bucket (compiles that bucket's
+        batched-prefill program), decoded through at least one full block
+        (compiles the decode — or speculative — block), plus the ring-
+        prefill program when a seq axis is configured. Without this the
+        first real request pays tracing + XLA compile (~20-40s on TPU)
+        inside its TTFT."""
+        steps = self.ecfg.decode_block_size + 1
+        lengths = [
+            min(b, self.pcfg.max_seq_len - steps - 2)
+            for b in self.ecfg.prefill_buckets
+        ]
+        thr = self._cp_threshold()
+        if thr is not None:
+            lengths.append(min(self._cp_bucket(thr),
+                               self.pcfg.max_seq_len - steps - 2))
+        for i, n in enumerate(lengths):
+            if n < 1:
+                continue
+            # distinct leading token per warmup: prefix reuse against an
+            # earlier warmup would shrink the chunk into a smaller
+            # bucket's program and leave this one cold
+            tok_id = 1 + i % max(1, self.cfg.vocab_size - 1)
+            self.add_request(
+                f"__warmup_{i}", [tok_id] * n,
+                SamplingParams(max_tokens=steps, temperature=0.0),
+            )
+            # drain one at a time: co-seated warmups would share the
+            # largest bucket's program and leave the others cold
+            while self.has_work():
+                self.step()  # outputs discarded
 
     # ------------------------------------------------------------------
     # admission / prefill

@@ -88,6 +88,8 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # speculative decoding knobs (Req 12.3-12.5)
         "num_draft_tokens": (int, 4),
         "spec_disable_threshold": (float, 0.5),
+        # compile all serving programs before a replica reports ready
+        "warmup_compile": (bool, True),
     },
     "tracing": {
         # OTLP/HTTP collector URL for span export (utils/otlp.py), e.g.

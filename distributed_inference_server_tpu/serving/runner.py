@@ -306,6 +306,9 @@ class EngineRunner:
         def _build() -> None:
             try:
                 eng = factory()
+                if eng.ecfg.warmup_compile:
+                    # the new model must not serve cold after the switch
+                    eng.warmup()
             except Exception as e:  # noqa: BLE001 — keep old model
                 self._last_error = f"model swap failed: {e}"
                 if on_done:
@@ -376,6 +379,10 @@ class EngineRunner:
     def _run(self, ready: threading.Event) -> None:
         try:
             self._engine = self._factory()
+            if self._engine.ecfg.warmup_compile:
+                # compile all serving programs before reporting ready
+                # (first-request TTFT must not pay XLA compile)
+                self._engine.warmup()
             self._healthy = True
         except Exception as e:  # noqa: BLE001 — startup failure isolation
             self._last_error = str(e)

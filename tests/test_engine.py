@@ -341,3 +341,74 @@ def test_engine_pallas_attention_matches_xla(tiny_params):
         results[impl] = run_to_completion(engine)["r1"]
     assert results["pallas"]["tokens"] == results["xla"]["tokens"]
     assert results["pallas"]["finish"] == results["xla"]["finish"]
+
+
+class TestWarmup:
+    """Startup warm-compilation (engine.warmup): every serving program
+    compiles before the first real request, so first-request TTFT never
+    pays tracing + XLA compile."""
+
+    def test_warmup_compiles_all_buckets_and_decode(self):
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_inference_server_tpu.models import llama as _llama
+        from distributed_inference_server_tpu.models.configs import TINY
+        from distributed_inference_server_tpu.models.tokenizer import (
+            ByteTokenizer,
+        )
+
+        params = _llama.init_params(jax.random.PRNGKey(0), TINY, jnp.float32)
+        eng = LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(
+                max_batch=2, prefill_buckets=(8, 16),
+                paged=PagedCacheConfig(num_pages=64, page_size=8,
+                                       max_pages_per_seq=8),
+                warmup_compile=True,
+            ),
+            dtype=jnp.float32,
+        )
+        eng.warmup()
+        assert not eng.has_work()  # warmup requests fully drained
+        # every bucket's prefill program is compiled and cached
+        assert {k[1] for k in eng._prefill_fns} == {8, 16}
+        # the decode-block carry exists => the block program ran
+        assert eng._carry is not None
+        # and real serving still works afterwards
+        tok = ByteTokenizer()
+        eng.add_request("r", tok.encode("after warmup"),
+                        SamplingParams(max_tokens=4, temperature=0.0))
+        n = 0
+        while eng.has_work():
+            for o in eng.step():
+                assert o.error is None, o.error
+                n += o.token_id is not None
+        assert n == 4
+
+    def test_warmup_covers_cp_program(self):
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_inference_server_tpu.models import llama as _llama
+        from distributed_inference_server_tpu.models.configs import TINY
+        from distributed_inference_server_tpu.models.tokenizer import (
+            ByteTokenizer,
+        )
+        from distributed_inference_server_tpu.parallel import (
+            MeshSpec,
+            make_mesh,
+        )
+
+        params = _llama.init_params(jax.random.PRNGKey(0), TINY, jnp.float32)
+        eng = LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(
+                max_batch=2, prefill_buckets=(16,),
+                paged=PagedCacheConfig(num_pages=64, page_size=8,
+                                       max_pages_per_seq=8),
+            ),
+            dtype=jnp.float32, mesh=make_mesh(MeshSpec(seq=4)),
+        )
+        eng.warmup()
+        assert eng._cp_fns  # ring-prefill program compiled
