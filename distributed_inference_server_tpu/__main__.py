@@ -215,9 +215,11 @@ def main(argv=None) -> int:
     watcher.start()
 
     host, port = cfg.get("server", "host"), cfg.get("server", "port")
-    print(f"serving {cfg.get('model', 'model_name')} on {host}:{port}")
+    grpc_port = cfg.get("server", "grpc_port")
+    print(f"serving {cfg.get('model', 'model_name')} on {host}:{port}"
+          + (f" (grpc :{grpc_port})" if grpc_port else ""))
     try:
-        asyncio.run(server.serve_forever(host, port))
+        asyncio.run(server.serve_forever(host, port, grpc_port=grpc_port))
     except KeyboardInterrupt:
         pass
     finally:

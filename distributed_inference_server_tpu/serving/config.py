@@ -41,6 +41,8 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
     "server": {
         "host": (str, "0.0.0.0"),
         "port": (int, 8000),
+        # gRPC transport next to HTTP (serving/grpc_server.py); 0 = off
+        "grpc_port": (int, 0),
         "num_engines": (int, 1),
         "strategy": (str, "least_loaded"),
         "auto_restart": (bool, True),

@@ -1,0 +1,211 @@
+"""gRPC transport: the reference's spec'd optional second API surface.
+
+S1 lists "optional gRPC (Tonic)" next to the HTTP server
+(``design.md:139-155`` [spec]; SURVEY.md §2.2). This realizes it with
+``grpc.aio`` over the SAME InferenceHandler the HTTP app uses — one
+request-processing spine, two transports.
+
+Wire contract: JSON-encoded messages on generic method handlers (this
+image ships grpcio but no protoc gRPC codegen plugin, and the JSON bodies
+keep bit-for-bit schema parity with the HTTP endpoints — a client holding
+the HTTP schema can speak the gRPC surface unchanged):
+
+  dis.tpu.InferenceService/Generate        unary    (GenerateRequest JSON)
+  dis.tpu.InferenceService/GenerateStream  s-stream (TokenEvent JSON frames)
+  dis.tpu.InferenceService/Chat            unary
+  dis.tpu.InferenceService/ChatStream      s-stream
+  dis.tpu.InferenceService/Embeddings      unary
+  dis.tpu.InferenceService/Health          unary    (same JSON as /health)
+
+Errors map to canonical gRPC status codes (the reference's HTTP mapping,
+error.rs:39-56 semantics): 400 -> INVALID_ARGUMENT, 408 ->
+DEADLINE_EXCEEDED, 503 -> UNAVAILABLE, else INTERNAL; details carry the
+ErrorResponse JSON. Client disconnect mid-stream aborts generation
+(Req 5.4), matching the SSE path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+import grpc
+import grpc.aio
+
+from distributed_inference_server_tpu.core.errors import ApiError
+from distributed_inference_server_tpu.core.models import ErrorResponse
+from distributed_inference_server_tpu.serving.handler import InferenceHandler
+
+SERVICE = "dis.tpu.InferenceService"
+
+_STATUS = {
+    400: grpc.StatusCode.INVALID_ARGUMENT,
+    408: grpc.StatusCode.DEADLINE_EXCEEDED,
+    429: grpc.StatusCode.RESOURCE_EXHAUSTED,
+    503: grpc.StatusCode.UNAVAILABLE,
+}
+
+
+def _json_out(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _json_in(data: bytes):
+    try:
+        obj = json.loads(data or b"{}")
+    except Exception:  # noqa: BLE001 — malformed payload
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+async def _abort_api_error(context, err: ApiError) -> None:
+    body = ErrorResponse.of(str(err), err.error_type(), err.code())
+    await context.abort(
+        _STATUS.get(err.status_code(), grpc.StatusCode.INTERNAL),
+        json.dumps(body.to_dict()),
+    )
+
+
+async def _abort_bad_json(context) -> None:
+    await context.abort(
+        grpc.StatusCode.INVALID_ARGUMENT,
+        json.dumps({"error": {
+            "message": "request payload is not a JSON object",
+            "error_type": "invalid_request_error",
+            "code": "invalid_json",
+        }}),
+    )
+
+
+def build_grpc_server(
+    handler: InferenceHandler,
+    address: str = "127.0.0.1:0",
+) -> grpc.aio.Server:
+    """Build (not start) the aio server; ``server.add_insecure_port`` has
+    already bound ``address`` — read the chosen port from the return of
+    this function's ``bound_port`` attribute."""
+
+    def unary(fn):
+        async def method(request_bytes, context):
+            obj = _json_in(request_bytes)
+            if obj is None:
+                await _abort_bad_json(context)
+            try:
+                result = await fn(obj)
+            except ApiError as e:
+                await _abort_api_error(context, e)
+            return result.to_dict()
+
+        return grpc.unary_unary_rpc_method_handler(
+            method,
+            request_deserializer=lambda b: b,
+            response_serializer=_json_out,
+        )
+
+    def stream(fn):
+        async def method(request_bytes, context):
+            obj = _json_in(request_bytes)
+            if obj is None:
+                await _abort_bad_json(context)
+            try:
+                request_id, events = await fn(obj)
+            except ApiError as e:
+                await _abort_api_error(context, e)
+                return
+            try:
+                async for event in events:
+                    yield event.to_dict()
+            except asyncio.CancelledError:
+                # client went away mid-stream: abort generation (Req 5.4)
+                handler.dispatcher.abort(request_id)
+                raise
+
+        return grpc.unary_stream_rpc_method_handler(
+            method,
+            request_deserializer=lambda b: b,
+            response_serializer=_json_out,
+        )
+
+    async def health(obj):
+        statuses = handler.dispatcher.scheduler.statuses()
+        healthy = any(s.healthy for s in statuses)
+
+        class _Result:
+            @staticmethod
+            def to_dict():
+                return {
+                    "status": "ok" if healthy else "unhealthy",
+                    "accepting": handler.dispatcher.is_accepting(),
+                    "engines": [s.to_dict() for s in statuses],
+                }
+
+        return _Result
+
+    handlers = grpc.method_handlers_generic_handler(SERVICE, {
+        "Generate": unary(handler.generate),
+        "Chat": unary(handler.chat),
+        "Embeddings": unary(handler.embeddings),
+        "Health": unary(health),
+        "GenerateStream": stream(handler.generate_stream),
+        "ChatStream": stream(handler.chat_stream),
+    })
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers((handlers,))
+    server.bound_port = server.add_insecure_port(address)
+    return server
+
+
+class GrpcClient:
+    """Minimal JSON-over-gRPC client for the service above (used by tests
+    and as the reference client implementation)."""
+
+    def __init__(self, target: str):
+        self._channel = grpc.aio.insecure_channel(target)
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+    def _unary(self, method: str):
+        return self._channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=_json_out,
+            response_deserializer=lambda b: json.loads(b),
+        )
+
+    def _stream(self, method: str):
+        return self._channel.unary_stream(
+            f"/{SERVICE}/{method}",
+            request_serializer=_json_out,
+            response_deserializer=lambda b: json.loads(b),
+        )
+
+    async def generate(self, obj: dict) -> dict:
+        return await self._unary("Generate")(obj)
+
+    async def chat(self, obj: dict) -> dict:
+        return await self._unary("Chat")(obj)
+
+    async def embeddings(self, obj: dict) -> dict:
+        return await self._unary("Embeddings")(obj)
+
+    async def health(self) -> dict:
+        return await self._unary("Health")({})
+
+    def generate_stream(self, obj: dict):
+        return self._stream("GenerateStream")(obj)
+
+    def chat_stream(self, obj: dict):
+        return self._stream("ChatStream")(obj)
+
+
+async def serve_grpc(
+    handler: InferenceHandler,
+    host: str = "0.0.0.0",
+    port: int = 50051,
+) -> grpc.aio.Server:
+    """Start the gRPC transport next to the HTTP app (both share the
+    handler and therefore the queue/batcher/scheduler/engines)."""
+    server = build_grpc_server(handler, f"{host}:{port}")
+    await server.start()
+    return server

@@ -280,11 +280,28 @@ class InferenceServer:
         await site.start()
         return runner
 
-    async def serve_forever(self, host: str = "0.0.0.0", port: int = 8000) -> None:
+    async def serve_forever(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        grpc_port: int = 0,
+    ) -> None:
+        """Serve HTTP (and, with ``grpc_port`` > 0, the gRPC transport —
+        S1's optional second surface, serving/grpc_server.py — sharing
+        this server's handler/queue/engines) until cancelled."""
         runner = await self.serve(host, port)
+        grpc_srv = None
+        if grpc_port:
+            from distributed_inference_server_tpu.serving.grpc_server import (
+                serve_grpc,
+            )
+
+            grpc_srv = await serve_grpc(self.handler, host, grpc_port)
         try:
             while True:
                 await asyncio.sleep(3600)
         finally:
+            if grpc_srv is not None:
+                await grpc_srv.stop(grace=5.0)
             await runner.cleanup()
             self.shutdown()

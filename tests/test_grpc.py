@@ -1,0 +1,151 @@
+"""gRPC transport tests (S1's optional second API surface): the JSON-
+over-gRPC service shares the HTTP spine's handler, so generation,
+streaming, chat, embeddings, health, and the error-status mapping are
+exercised end-to-end over a real insecure channel."""
+
+from __future__ import annotations
+
+import asyncio
+
+import grpc
+import jax.numpy as jnp
+import pytest
+
+from distributed_inference_server_tpu.engine.engine import EngineConfig
+from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+from distributed_inference_server_tpu.serving.grpc_server import (
+    GrpcClient,
+    build_grpc_server,
+)
+from distributed_inference_server_tpu.serving.server import InferenceServer
+
+_PAGED = PagedCacheConfig(num_pages=192, page_size=8, max_pages_per_seq=32)
+
+
+def _factory():
+    import jax
+
+    from distributed_inference_server_tpu.engine.engine import LLMEngine
+
+    params = llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+    return LLMEngine(
+        params, TINY, ByteTokenizer(),
+        EngineConfig(max_batch=4, prefill_buckets=(16, 64), paged=_PAGED),
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = InferenceServer(
+        _factory, ByteTokenizer(), model_name="tiny-grpc",
+        num_engines=1, auto_restart=False,
+    )
+    srv.start()
+    yield srv
+    srv.shutdown(drain_timeout_s=5.0)
+
+
+def _run(server, coro_fn):
+    async def main():
+        gsrv = build_grpc_server(server.handler)
+        await gsrv.start()
+        client = GrpcClient(f"127.0.0.1:{gsrv.bound_port}")
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+            await gsrv.stop(grace=1.0)
+
+    return asyncio.run(main())
+
+
+def test_generate_unary(server):
+    async def go(client):
+        resp = await client.generate(
+            {"prompt": "hello grpc", "max_tokens": 6, "temperature": 0.0}
+        )
+        assert resp["object"] == "text_completion"
+        assert resp["usage"]["completion_tokens"] == 6
+        assert resp["choices"][0]["finish_reason"] == "length"
+    _run(server, go)
+
+
+def test_generate_stream(server):
+    async def go(client):
+        events = []
+        async for e in client.generate_stream(
+            {"prompt": "stream over grpc", "max_tokens": 5,
+             "temperature": 0.0}
+        ):
+            events.append(e)
+        kinds = [e["type"] for e in events]
+        assert kinds.count("token") >= 5
+        assert kinds[-1] == "done"
+        assert events[-1]["usage"]["completion_tokens"] == 5
+    _run(server, go)
+
+
+def test_chat_and_embeddings(server):
+    async def go(client):
+        chat = await client.chat({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "temperature": 0.0,
+        })
+        assert chat["object"] == "chat.completion"
+        emb = await client.embeddings({"input": ["one", "two"]})
+        assert len(emb["data"]) == 2
+        assert len(emb["data"][0]["embedding"]) == TINY.hidden_size
+    _run(server, go)
+
+
+def test_health(server):
+    async def go(client):
+        h = await client.health()
+        assert h["status"] == "ok"
+        assert h["engines"][0]["healthy"]
+    _run(server, go)
+
+
+def test_validation_error_maps_to_invalid_argument(server):
+    async def go(client):
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await client.generate({"max_tokens": 4})  # no prompt
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "invalid_request_error" in exc.value.details()
+    _run(server, go)
+
+
+def test_malformed_payload_rejected(server):
+    async def go(client):
+        raw = client._channel.unary_unary(
+            "/dis.tpu.InferenceService/Generate",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await raw(b"not json")
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    _run(server, go)
+
+
+def test_stream_cancel_aborts_generation(server):
+    async def go(client):
+        call = client.generate_stream(
+            {"prompt": "cancel me", "max_tokens": 4000,
+             "temperature": 0.0}
+        )
+        got = 0
+        async for _ in call:
+            got += 1
+            if got >= 2:
+                call.cancel()
+                break
+        await asyncio.sleep(0.3)
+        # the request is no longer in flight on any engine
+        statuses = server.handler.dispatcher.scheduler.statuses()
+        assert sum(s.active_requests for s in statuses) == 0
+    _run(server, go)
