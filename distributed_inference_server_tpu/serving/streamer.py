@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import threading
 from typing import Optional
 
 from distributed_inference_server_tpu.core.models import (
@@ -39,7 +40,13 @@ SSE_DONE = b"data: [DONE]\n\n"
 
 class StreamingSink:
     """ResultSink pushing TokenEvents onto an asyncio.Queue (runner thread →
-    loop). ``None`` terminates the stream."""
+    loop). ``None`` terminates the stream.
+
+    Cross-thread wakeups are coalesced: events buffer on the runner side
+    and one ``call_soon_threadsafe`` flush drains them to the queue — the
+    engine emits tokens in decode-block bursts, so this is one loop wakeup
+    per (request, block) instead of per token, while delivery still lands
+    on the next loop tick (the ≤10 ms budget, requirements.md:82)."""
 
     def __init__(self, loop: asyncio.AbstractEventLoop):
         self._loop = loop
@@ -47,9 +54,21 @@ class StreamingSink:
         self.finish_reason: Optional[FinishReason] = None
         self.usage: Optional[Usage] = None
         self.error: Optional[str] = None
+        self._pending: list = []
+        self._plock = threading.Lock()
 
     def _put(self, item: Optional[TokenEvent]) -> None:
-        self._loop.call_soon_threadsafe(self.queue.put_nowait, item)
+        with self._plock:
+            self._pending.append(item)
+            if len(self._pending) > 1:
+                return  # a flush is already scheduled for this burst
+        self._loop.call_soon_threadsafe(self._flush)
+
+    def _flush(self) -> None:
+        with self._plock:
+            items, self._pending = self._pending, []
+        for item in items:
+            self.queue.put_nowait(item)
 
     # runner-thread callbacks ------------------------------------------------
 

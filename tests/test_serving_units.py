@@ -470,3 +470,50 @@ class TestEmbedInterleaving:
                                        atol=1e-5)
         finally:
             runner.shutdown()
+
+
+class TestStreamingSinkCoalescing:
+    """Cross-thread wakeup coalescing: a burst of tokens pushed from the
+    runner thread drains to the loop in order with one scheduled flush."""
+
+    def test_burst_order_and_termination(self):
+        import asyncio
+        import threading
+
+        from distributed_inference_server_tpu.core.models import (
+            FinishReason,
+            Usage,
+        )
+        from distributed_inference_server_tpu.serving.streamer import (
+            StreamingSink,
+        )
+
+        async def main():
+            loop = asyncio.get_running_loop()
+            sink = StreamingSink(loop)
+            flushes = []
+            orig = sink._flush
+
+            def counted_flush():
+                flushes.append(1)
+                orig()
+
+            sink._flush = counted_flush
+
+            def producer():
+                for i in range(6):
+                    sink.on_token(i, f"t{i}", i)
+                sink.on_done(FinishReason.LENGTH, Usage.of(3, 6))
+
+            t = threading.Thread(target=producer)
+            t.start()
+            t.join()  # whole burst lands before the loop runs once
+            events = [e async for e in sink.events()]
+            assert [e.token for e in events[:6]] == [
+                f"t{i}" for i in range(6)]
+            assert events[-1].type == "done"
+            # 8 items (6 tokens + done + None) in far fewer flushes
+            assert 1 <= len(flushes) <= 2
+            assert sink.finish_reason == FinishReason.LENGTH
+
+        asyncio.run(main())
