@@ -43,9 +43,12 @@ def build_app(
     handler: InferenceHandler,
     metrics: Optional[MetricsCollector] = None,
     swap_fn=None,
+    scale_fn=None,
 ) -> web.Application:
     """``swap_fn(model_name) -> (ok, error)`` enables the admin model-swap
-    endpoint (Req 13.1: admin-API-triggered); blocking — it is run in the
+    endpoint (Req 13.1: admin-API-triggered); ``scale_fn(n) -> (ok,
+    error)`` enables the admin replica-scaling endpoint (runtime scale
+    up/down, requirements.md:110). Both are blocking — they run in the
     default executor."""
     app = web.Application()
     app["handler"] = handler
@@ -266,6 +269,42 @@ def build_app(
         status = 409 if "error" in result else 200
         return web.json_response(result, status=status)
 
+    async def scale(request: web.Request) -> web.Response:
+        """Runtime replica scaling (requirements.md:110): body
+        {"num_engines": N}; removal drains in-flight work."""
+        if scale_fn is None:
+            return web.json_response(
+                {"error": {"message": "scaling not configured",
+                           "error_type": "invalid_request_error",
+                           "code": "scale_unavailable"}},
+                status=501,
+            )
+        obj = await _json_body(request)
+        n = obj.get("num_engines")
+        if not isinstance(n, int) or not 1 <= n <= 64:
+            return web.json_response(
+                {"error": {"message": "'num_engines' must be an integer "
+                           "in [1, 64]",
+                           "error_type": "invalid_request_error",
+                           "code": "invalid_body"}},
+                status=400,
+            )
+        loop = asyncio.get_running_loop()
+        ok, err = await loop.run_in_executor(None, scale_fn, n)
+        if not ok:
+            return web.json_response(
+                {"error": {"message": err, "error_type": "server_error",
+                           "code": "scale_failed"}},
+                status=500,
+            )
+        statuses = handler.dispatcher.scheduler.statuses()
+        return web.json_response({
+            "status": "ok",
+            "num_engines": len(statuses),
+            "engines": [s.to_dict() for s in statuses],
+        })
+
+    app.router.add_post("/admin/scale", scale)
     app.router.add_post("/server/profile", profile)
     app.router.add_get("/server/trace", trace)
     app.router.add_post("/admin/model-swap", model_swap)

@@ -43,6 +43,11 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "port": (int, 8000),
         # gRPC transport next to HTTP (serving/grpc_server.py); 0 = off
         "grpc_port": (int, 0),
+        # persistent XLA compilation cache: restarts (and hot-swaps back
+        # to a previously-served model) skip the 20-40s compiles. "" = off
+        "compile_cache_dir": (
+            str, "~/.cache/distributed-inference-server-tpu/xla"
+        ),
         "num_engines": (int, 1),
         "strategy": (str, "least_loaded"),
         "auto_restart": (bool, True),

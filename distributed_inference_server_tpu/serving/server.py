@@ -270,7 +270,15 @@ class InferenceServer:
                     return False, str(e)
                 return self.swap_model(factory, model_name=name)
 
-        return build_app(self.handler, self.metrics, swap_fn=swap_fn)
+        def scale_fn(n: int):
+            try:
+                self.scale_to(n)
+            except Exception as e:  # noqa: BLE001 — spawn failure etc.
+                return False, str(e)
+            return True, None
+
+        return build_app(self.handler, self.metrics, swap_fn=swap_fn,
+                         scale_fn=scale_fn)
 
     async def serve(self, host: str = "0.0.0.0", port: int = 8000) -> web.AppRunner:
         """Bind and serve; returns the AppRunner (caller controls lifetime)."""

@@ -291,3 +291,24 @@ def test_greedy_determinism(server):
         assert first == second
 
     _run(server, go)
+
+
+def test_admin_scale_endpoint(server):
+    async def go(client):
+        # scale 1 -> 2 replicas
+        resp = await client.post("/admin/scale", json={"num_engines": 2})
+        body = await resp.json()
+        assert resp.status == 200, body
+        assert body["num_engines"] == 2
+        # generation still works across the scaled fleet
+        r = await client.post("/generate", json={
+            "prompt": "scaled", "max_tokens": 3, "temperature": 0.0})
+        assert r.status == 200
+        # scale back down (drains)
+        resp = await client.post("/admin/scale", json={"num_engines": 1})
+        body = await resp.json()
+        assert resp.status == 200 and body["num_engines"] == 1
+        # validation
+        bad = await client.post("/admin/scale", json={"num_engines": 0})
+        assert bad.status == 400
+    _run(server, go)
