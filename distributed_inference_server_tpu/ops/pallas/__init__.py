@@ -5,6 +5,18 @@ kernels over FFI (``design.md:7``, ``tasks.md:196-200`` [spec]); on TPU the
 equivalent tier is Pallas kernels lowered through Mosaic. Every kernel here
 has a pure-XLA reference implementation (ops/attention.py et al.) it is
 tested against, and runs in interpret mode on the CPU backend.
+
+Scope is deliberate: kernels exist where XLA's compilation model cannot
+express the access pattern — paged attention reads scattered KV pages
+straight from the HBM pool with manual double-buffered DMA, which the
+XLA alternative can only approximate by materializing a dense
+``[B, S_max]`` gather per layer per step. RMSNorm, RoPE, sampling, and
+on-the-fly dequantization intentionally stay XLA: they are elementwise
+chains adjacent to matmuls, exactly what XLA fuses into operand
+reads/writes on its own, and a hand kernel there starts from parity at
+best (SURVEY §7.1 planned four kernels; measurement on the chip — the
+r1 lesson that an unproven kernel can ship slower than the fusion it
+replaces — set this boundary instead).
 """
 
 from distributed_inference_server_tpu.ops.pallas.paged_attention import (
