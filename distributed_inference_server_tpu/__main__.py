@@ -198,6 +198,8 @@ def main(argv=None) -> int:
                 num_draft_tokens=cfg.get("engine", "num_draft_tokens"),
                 disable_threshold=cfg.get("engine",
                                           "spec_disable_threshold"),
+                reenable_after_s=cfg.get("engine",
+                                         "spec_reenable_after_s"),
             )
         return LLMEngine(params, model_cfg, tokenizer, engine_cfg,
                          dtype=dtype, mesh=mesh, draft_params=draft_params,

@@ -48,16 +48,25 @@ class SpecConfig:
     num_draft_tokens: int = 4  # gamma
     disable_threshold: float = 0.5  # Req 12.5: auto-disable below this
     window: int = 64  # rounds in the rolling acceptance window
+    # probation: after an auto-disable, re-enable and re-measure once this
+    # much time passes — the "per request pattern" semantics of Req 12.5
+    # (traffic changes; a pattern that speculated badly an hour ago says
+    # nothing about the current one). <= 0 disables permanently until an
+    # explicit reset (admin surface / hot-swap).
+    reenable_after_s: float = 30.0
 
 
 class AcceptanceTracker:
-    """Rolling acceptance-rate / speedup tracking with auto-disable
-    (Req 12.3-12.5)."""
+    """Rolling acceptance-rate / speedup tracking with auto-disable and
+    probation-based re-enable (Req 12.3-12.5)."""
 
-    def __init__(self, cfg: SpecConfig):
+    def __init__(self, cfg: SpecConfig, clock=None):
+        import time as _time
+
         self.cfg = cfg
+        self._clock = clock or _time.monotonic
         self._events: Deque[Tuple[int, int]] = deque(maxlen=cfg.window)
-        self._disabled = False
+        self._disabled_at: float | None = None
 
     def update(self, accepted: int, proposed: int, rows: int = 1) -> None:
         """Record one round: ``accepted``/``proposed`` are summed over the
@@ -67,7 +76,7 @@ class AcceptanceTracker:
             len(self._events) == self.cfg.window
             and self.rate() < self.cfg.disable_threshold
         ):
-            self._disabled = True
+            self._disabled_at = self._clock()
 
     def rate(self) -> float:
         acc = sum(a for a, _, _ in self._events)
@@ -85,11 +94,19 @@ class AcceptanceTracker:
 
     @property
     def enabled(self) -> bool:
-        return not self._disabled
+        if self._disabled_at is None:
+            return True
+        cooldown = self.cfg.reenable_after_s
+        if cooldown > 0 and self._clock() - self._disabled_at >= cooldown:
+            # probation: re-enable with a fresh window; a still-bad
+            # pattern re-disables within one window of rounds
+            self.reset()
+            return True
+        return False
 
     def reset(self) -> None:
         self._events.clear()
-        self._disabled = False
+        self._disabled_at = None
 
 
 def _probs(logits: jnp.ndarray, temperature: jnp.ndarray) -> jnp.ndarray:

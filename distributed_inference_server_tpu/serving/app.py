@@ -304,6 +304,28 @@ def build_app(
             "engines": [s.to_dict() for s in statuses],
         })
 
+    async def speculation(request: web.Request) -> web.Response:
+        """Speculation control (Req 12.5): {"action": "reset"} clears the
+        acceptance trackers fleet-wide — explicit operator signal that
+        the request pattern changed (the automatic probation re-enable
+        handles the common case)."""
+        obj = await _json_body(request)
+        if obj.get("action") != "reset":
+            return web.json_response(
+                {"error": {"message": "'action' must be 'reset'",
+                           "error_type": "invalid_request_error",
+                           "code": "invalid_body"}},
+                status=400,
+            )
+        runners = handler.dispatcher.scheduler.engines()
+        n = 0
+        for r in runners:
+            if hasattr(r, "reset_speculation"):
+                r.reset_speculation()
+                n += 1
+        return web.json_response({"status": "ok", "engines_reset": n})
+
+    app.router.add_post("/admin/speculation", speculation)
     app.router.add_post("/admin/scale", scale)
     app.router.add_post("/server/profile", profile)
     app.router.add_get("/server/trace", trace)

@@ -95,6 +95,9 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # speculative decoding knobs (Req 12.3-12.5)
         "num_draft_tokens": (int, 4),
         "spec_disable_threshold": (float, 0.5),
+        # probation re-enable after auto-disable (Req 12.5 "per request
+        # pattern"); <= 0 = stay disabled until an explicit reset
+        "spec_reenable_after_s": (float, 30.0),
         # compile all serving programs before a replica reports ready
         "warmup_compile": (bool, True),
     },

@@ -253,6 +253,17 @@ class EngineRunner:
                 cb(result, error)
         return True
 
+    def reset_speculation(self) -> None:
+        """Clear the acceptance tracker (Req 12.5 explicit reset — e.g.
+        the operator knows the request pattern changed); re-enables
+        speculation immediately with a fresh measurement window."""
+
+        def _do() -> None:
+            if self._engine.spec_tracker is not None:
+                self._engine.spec_tracker.reset()
+
+        self._post(_do)
+
     def profile_steps(self, n: int, timeout_s: float = 30.0) -> dict:
         """Capture a device trace over the next ``n`` engine steps
         (utils/profiler.py; SURVEY §5 device-tracing bar). Blocks up to

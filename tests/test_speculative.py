@@ -115,3 +115,45 @@ def test_sampled_spec_preserves_support(target_params, bad_draft_params):
     )
     assert got.shape == (2, 12)
     assert (got >= 0).all() and (got < TINY.vocab_size).all()
+
+
+class TestProbationReenable:
+    """Req 12.5 'per request pattern': after auto-disable, the tracker
+    re-enables on a cooldown with a fresh window — a traffic pattern
+    that speculates well again stays enabled; a still-bad one
+    re-disables within one window."""
+
+    def _bad_rounds(self, t, n):
+        for _ in range(n):
+            t.update(0, 4)  # 0% acceptance
+
+    def test_disable_then_probation_reenable(self):
+        clock = {"t": 0.0}
+        t = AcceptanceTracker(
+            SpecConfig(window=8, disable_threshold=0.5,
+                       reenable_after_s=10.0),
+            clock=lambda: clock["t"],
+        )
+        self._bad_rounds(t, 8)
+        assert not t.enabled
+        clock["t"] = 5.0
+        assert not t.enabled  # cooldown not elapsed
+        clock["t"] = 10.0
+        assert t.enabled  # probation: fresh window
+        assert t.rate() == 1.0  # window cleared
+        # still-bad pattern re-disables within one window
+        self._bad_rounds(t, 8)
+        assert not t.enabled
+
+    def test_zero_cooldown_stays_disabled_until_reset(self):
+        clock = {"t": 0.0}
+        t = AcceptanceTracker(
+            SpecConfig(window=4, disable_threshold=0.5,
+                       reenable_after_s=0.0),
+            clock=lambda: clock["t"],
+        )
+        self._bad_rounds(t, 4)
+        clock["t"] = 1e9
+        assert not t.enabled
+        t.reset()
+        assert t.enabled
