@@ -118,7 +118,7 @@ def test_spec_auto_disable_falls_back(tiny_params, draft_params):
     produce the exact greedy output (Req 12.5)."""
     engine = make_engine(tiny_params, draft=draft_params,
                          spec=SpecConfig(num_draft_tokens=3))
-    engine.spec_tracker._disabled = True
+    engine.spec_tracker._disabled_at = engine.spec_tracker._clock()
     prompt = TOK.encode("fallback")
     engine.add_request("r", prompt, GREEDY)
     out = run(engine)["r"]
