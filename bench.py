@@ -38,6 +38,33 @@ def main() -> None:
     impl = os.environ.get("BENCH_IMPL", "auto")
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "180"))
 
+    # Fail fast when the tunnel is not even listening (dead relay): the
+    # axon backend dials localhost relay ports; refused connections mean
+    # no chip this boot — report immediately instead of hanging the
+    # watchdog out.
+    if not force_cpu and os.environ.get("JAX_PLATFORMS", "") == "axon":
+        import socket
+
+        relay_ports = (8082, 8083, 8087, 8092)
+        alive = False
+        for p in relay_ports:
+            try:
+                socket.create_connection(("127.0.0.1", p), timeout=2).close()
+                alive = True
+                break
+            except OSError:
+                continue
+        if not alive:
+            _emit({
+                "metric": "decode_tokens_per_sec_llama1b_bf16",
+                "value": 0.0,
+                "unit": "tokens/s",
+                "vs_baseline": 0.0,
+                "error": "TPU tunnel down (relay ports refused "
+                         f"{relay_ports}); no device this boot",
+            })
+            sys.exit(2)
+
     # Watchdog: the single real TPU chip sits behind a one-process tunnel;
     # if another process holds the claim, backend init blocks forever.
     init_done = threading.Event()

@@ -298,10 +298,10 @@ class LLMEngine:
                 validate_pp(cfg, pp, self.ecfg.prefill_batch,
                             self.ecfg.pp_microbatches)
                 if draft_params is not None:
-                    raise NotImplementedError(
-                        "speculative decoding under pipeline parallelism "
-                        "is not supported yet"
-                    )
+                    # the draft pipelines over the same stage axis: its
+                    # layer stack must split the same way
+                    validate_pp(draft_cfg, pp, self.ecfg.max_batch,
+                                self.ecfg.pp_microbatches)
             self.params = tp_rules.shard_params(params, mesh, cfg,
                                                 stage_axis=stage_axis)
             pool_sharding = NamedSharding(
@@ -312,7 +312,8 @@ class LLMEngine:
             if self.draft_params is not None:
                 tp_rules.validate_tp(draft_cfg, mesh.shape.get("tensor", 1))
                 self.draft_params = tp_rules.shard_params(
-                    self.draft_params, mesh, draft_cfg
+                    self.draft_params, mesh, draft_cfg,
+                    stage_axis=stage_axis,
                 )
                 self.draft_state.k = jax.device_put(
                     self.draft_state.k, pool_sharding

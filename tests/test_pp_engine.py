@@ -101,3 +101,33 @@ def test_engine_pp_validates_layer_divisibility(tiny_params):
     with pytest.raises(ValueError, match="stages do not divide"):
         LLMEngine(tiny_params, TINY, TOK, ECFG, dtype=jnp.float32,
                   mesh=make_mesh(MeshSpec(stage=4)))
+
+
+def test_engine_pp_with_speculative_draft(tiny_params):
+    """Speculative decoding composes with pipeline parallelism: draft and
+    target both pipeline over the stage axis, and greedy output stays
+    bit-identical to the plain engine."""
+    from distributed_inference_server_tpu.engine.speculative import (
+        SpecConfig,
+    )
+
+    draft = llama.init_params(jax.random.PRNGKey(7), TINY,
+                              dtype=jnp.float32)
+    plain = LLMEngine(tiny_params, TINY, TOK, ECFG, dtype=jnp.float32)
+    pp_spec = LLMEngine(
+        tiny_params, TINY, TOK, ECFG, dtype=jnp.float32,
+        mesh=make_mesh(MeshSpec(stage=2)),
+        draft_params=draft, draft_cfg=TINY,
+        spec=SpecConfig(num_draft_tokens=3),
+    )
+    prompts = {f"r{i}": TOK.encode(f"pp+spec {i}") for i in range(2)}
+    for rid, ids in prompts.items():
+        plain.add_request(rid, ids, GREEDY)
+        pp_spec.add_request(rid, ids, GREEDY)
+    expected = run(plain)
+    got = run(pp_spec)
+    for rid in prompts:
+        assert got[rid]["error"] is None
+        assert got[rid]["tokens"] == expected[rid]["tokens"], rid
+    stats = pp_spec.spec_stats()
+    assert stats is not None and stats["estimated_speedup"] >= 1.0
