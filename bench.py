@@ -12,7 +12,9 @@ vs_baseline is against the north-star 2000 output tok/s/chip target
 Env knobs: BENCH_BATCH (32), BENCH_PROMPT (128), BENCH_NEW (128),
 BENCH_BLOCK (16, decode steps per device block), BENCH_PIPELINE (1,
 blocks in flight), BENCH_IMPL (auto|pallas|xla decode attention),
-BENCH_FORCE_CPU=1 (tiny-model smoke mode), BENCH_INIT_TIMEOUT_S (180).
+BENCH_COMPARE=1 (measure BOTH attention impls, report the better with
+both numbers in the line), BENCH_FORCE_CPU=1 (tiny-model smoke mode),
+BENCH_INIT_TIMEOUT_S (180).
 """
 
 from __future__ import annotations
@@ -121,49 +123,78 @@ def main() -> None:
 
     params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     jax.block_until_ready(params)
-    engine = LLMEngine(
-        params, cfg, ByteTokenizer(),
-        EngineConfig(
-            max_batch=batch, prefill_buckets=buckets, paged=paged,
-            attention_impl=impl, decode_block_size=block,
-            pipeline_depth=pipeline,
-        ),
-        dtype=dtype,
-    )
-
     rng = np.random.default_rng(0)
 
-    def add(rid: str, n_new: int):
-        ids = rng.integers(1, min(cfg.vocab_size, 250), size=prompt_len).tolist()
-        engine.add_request(rid, ids, SamplingParams(
-            max_tokens=n_new, temperature=0.0, top_p=1.0))
+    def run_once(use_impl: str) -> dict:
+        engine = LLMEngine(
+            params, cfg, ByteTokenizer(),
+            EngineConfig(
+                max_batch=batch, prefill_buckets=buckets, paged=paged,
+                attention_impl=use_impl, decode_block_size=block,
+                pipeline_depth=pipeline,
+            ),
+            dtype=dtype,
+        )
 
-    def drain(t_start=None, first_token_at=None):
-        tokens = 0
-        while engine.has_work():
-            for out in engine.step():
-                if out.token_id is not None:
-                    tokens += 1
-                    if first_token_at is not None and \
-                            out.request_id not in first_token_at:
-                        first_token_at[out.request_id] = (
-                            time.perf_counter() - t_start)
-        return tokens
+        def add(rid: str, n_new: int):
+            ids = rng.integers(
+                1, min(cfg.vocab_size, 250), size=prompt_len
+            ).tolist()
+            engine.add_request(rid, ids, SamplingParams(
+                max_tokens=n_new, temperature=0.0, top_p=1.0))
 
-    # warm-up: compiles the prefill bucket + decode block
-    add("warmup", max(4, block + 1))
-    drain()
+        def drain(t_start=None, first_token_at=None):
+            tokens = 0
+            while engine.has_work():
+                for out in engine.step():
+                    if out.token_id is not None:
+                        tokens += 1
+                        if first_token_at is not None and \
+                                out.request_id not in first_token_at:
+                            first_token_at[out.request_id] = (
+                                time.perf_counter() - t_start)
+            return tokens
 
-    for i in range(batch):
-        add(f"r{i}", new_tokens)
-    ttfts = {}
-    t0 = time.perf_counter()
-    produced = drain(t0, ttfts)
-    elapsed = time.perf_counter() - t0
+        # warm-up: compiles the prefill bucket + decode block
+        add("warmup", max(4, block + 1))
+        drain()
 
-    tput = produced / elapsed
-    ttft_sorted = sorted(ttfts.values())
-    p50_ttft = ttft_sorted[len(ttft_sorted) // 2] if ttft_sorted else 0.0
+        for i in range(batch):
+            add(f"r{i}", new_tokens)
+        ttfts = {}
+        t0 = time.perf_counter()
+        produced = drain(t0, ttfts)
+        elapsed = time.perf_counter() - t0
+        ttft_sorted = sorted(ttfts.values())
+        return {
+            "tput": produced / elapsed,
+            "total_tokens": produced,
+            "elapsed_s": round(elapsed, 3),
+            "p50_ttft_s": round(
+                ttft_sorted[len(ttft_sorted) // 2], 3
+            ) if ttft_sorted else 0.0,
+            "p99_ttft_s": round(
+                ttft_sorted[min(len(ttft_sorted) - 1,
+                                int(0.99 * len(ttft_sorted)))], 3,
+            ) if ttft_sorted else 0.0,
+        }
+
+    extra = {}
+    if os.environ.get("BENCH_COMPARE") == "1":
+        # measure BOTH attention impls; report the better one and carry
+        # the comparison in the same line (VERDICT r1: "auto" must be
+        # justified by a number)
+        results = {i: run_once(i) for i in ("xla", "pallas")}
+        impl = max(results, key=lambda i: results[i]["tput"])
+        r = results[impl]
+        extra = {
+            "xla_tokens_per_sec": round(results["xla"]["tput"], 2),
+            "pallas_tokens_per_sec": round(results["pallas"]["tput"], 2),
+        }
+    else:
+        r = run_once(impl)
+
+    tput = r["tput"]
     _emit({
         "metric": "decode_tokens_per_sec_llama1b_bf16"
         if not force_cpu else "decode_tokens_per_sec_tiny_cpu",
@@ -177,13 +208,11 @@ def main() -> None:
         "decode_block": block,
         "pipeline_depth": pipeline,
         "attention_impl": impl,
-        "total_tokens": produced,
-        "elapsed_s": round(elapsed, 3),
-        "p50_ttft_s": round(p50_ttft, 3),
-        "p99_ttft_s": round(
-            ttft_sorted[min(len(ttft_sorted) - 1, int(0.99 * len(ttft_sorted)))],
-            3,
-        ) if ttft_sorted else 0.0,
+        "total_tokens": r["total_tokens"],
+        "elapsed_s": r["elapsed_s"],
+        "p50_ttft_s": r["p50_ttft_s"],
+        "p99_ttft_s": r["p99_ttft_s"],
+        **extra,
     })
 
 
