@@ -129,15 +129,26 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    if per_replica > 1:
+    # Under the multi-host runtime each HOST serves its own replicas on
+    # its own chips (the two-plane design: the router is the cross-host
+    # control plane, serving/router.py) — meshes must be built from
+    # LOCAL devices, never global slices (a single logical engine
+    # spanning hosts requires every host to run the same SPMD program,
+    # which an independent per-host request stream cannot guarantee).
+    def _devices():
         import jax
 
+        return jax.local_devices() if nproc > 1 else jax.devices()
+
+    if per_replica > 1:
+        n_avail = len(_devices())
         needed = per_replica * num_engines
-        if needed > len(jax.devices()):
+        if needed > n_avail:
             print(
                 f"config error: {num_engines} engines x (tensor_parallel="
                 f"{tp} x pipeline_parallel={pp} x context_parallel={cp}) "
-                f"needs {needed} devices, have {len(jax.devices())}",
+                f"needs {needed} devices, have {n_avail}"
+                + (" on this host" if nproc > 1 else ""),
                 file=sys.stderr,
             )
             return 2
@@ -160,16 +171,14 @@ def main(argv=None) -> int:
             params = quantize_params(params, quant)
         mesh = None
         if per_replica > 1:
-            import jax
-
             from distributed_inference_server_tpu.parallel import (
                 MeshSpec,
                 make_mesh,
             )
 
-            # each replica gets a DISJOINT device slice: replica i owns
-            # devices [i*per_replica, (i+1)*per_replica)
-            devs = jax.devices()[
+            # each replica gets a DISJOINT slice of THIS HOST's devices:
+            # replica i owns devices [i*per_replica, (i+1)*per_replica)
+            devs = _devices()[
                 replica_idx * per_replica : (replica_idx + 1) * per_replica
             ]
             mesh = make_mesh(MeshSpec(tensor=tp, stage=pp, seq=cp), devs)

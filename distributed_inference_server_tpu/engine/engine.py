@@ -1200,11 +1200,13 @@ class LLMEngine:
 
     def _spec_on(self) -> bool:
         """Speculate this launch? Requires a draft model and the tracker
-        not auto-disabled (Req 12.5)."""
+        not auto-disabled (Req 12.5). Runs on the engine thread, so it
+        owns the probation re-enable (stats readers see the pure
+        ``enabled`` view)."""
         return (
             self.draft_params is not None
             and self.spec_tracker is not None
-            and self.spec_tracker.enabled
+            and self.spec_tracker.consume_probation()
         )
 
     def spec_stats(self) -> Optional[dict]:

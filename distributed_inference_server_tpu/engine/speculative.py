@@ -94,15 +94,22 @@ class AcceptanceTracker:
 
     @property
     def enabled(self) -> bool:
+        """Pure read (safe from stats/metrics threads): True when never
+        disabled, or once the probation cooldown has elapsed."""
         if self._disabled_at is None:
             return True
         cooldown = self.cfg.reenable_after_s
-        if cooldown > 0 and self._clock() - self._disabled_at >= cooldown:
-            # probation: re-enable with a fresh window; a still-bad
-            # pattern re-disables within one window of rounds
+        return cooldown > 0 and self._clock() - self._disabled_at >= cooldown
+
+    def consume_probation(self) -> bool:
+        """Engine-thread-only enabled check: when the cooldown has
+        elapsed, actually re-enable with a fresh measurement window (a
+        still-bad pattern re-disables within one window). Kept separate
+        from the pure ``enabled`` getter so concurrent stats readers
+        never mutate tracker state under the engine thread's update()."""
+        if self._disabled_at is not None and self.enabled:
             self.reset()
-            return True
-        return False
+        return self._disabled_at is None
 
     def reset(self) -> None:
         self._events.clear()

@@ -223,9 +223,15 @@ class EngineRunner:
             # bind the CURRENT engine: a hot-swap mid-job must not mix
             # two models' hidden states in one accumulator
             engine = self._engine
+            try:
+                state = engine.embed_start(ids_list)
+            except Exception as e:  # noqa: BLE001 — called-exactly-once
+                cb = self._pending_embeds.pop(token, None)
+                if cb is not None:
+                    cb(None, str(e))
+                return
             self._embed_jobs.append(
-                {"token": token, "engine": engine,
-                 "state": engine.embed_start(ids_list)}
+                {"token": token, "engine": engine, "state": state}
             )
 
         self._post(_enqueue)

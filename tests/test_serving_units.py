@@ -517,3 +517,67 @@ class TestStreamingSinkCoalescing:
             assert sink.finish_reason == FinishReason.LENGTH
 
         asyncio.run(main())
+
+
+class TestEmbedStartFailure:
+    """Regression (r2 review): a failure in embed_start on the engine
+    thread must still resolve the callback exactly once with the error —
+    not strand the /embeddings future forever."""
+
+    def test_embed_start_error_reaches_callback(self):
+        import threading
+
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_inference_server_tpu.engine.engine import (
+            EngineConfig,
+            LLMEngine,
+        )
+        from distributed_inference_server_tpu.engine.kv_cache import (
+            PagedCacheConfig,
+        )
+        from distributed_inference_server_tpu.models import llama
+        from distributed_inference_server_tpu.models.configs import TINY
+        from distributed_inference_server_tpu.models.tokenizer import (
+            ByteTokenizer,
+        )
+        from distributed_inference_server_tpu.serving.runner import (
+            EngineRunner,
+        )
+
+        params = llama.init_params(jax.random.PRNGKey(0), TINY,
+                                   dtype=jnp.float32)
+
+        def factory():
+            eng = LLMEngine(
+                params, TINY, ByteTokenizer(),
+                EngineConfig(max_batch=2, prefill_buckets=(16,),
+                             paged=PagedCacheConfig(
+                                 num_pages=32, page_size=8,
+                                 max_pages_per_seq=4)),
+                dtype=jnp.float32,
+            )
+
+            def boom(ids_list):
+                raise RuntimeError("embed_start exploded")
+
+            eng.embed_start = boom
+            return eng
+
+        runner = EngineRunner("e0", factory)
+        runner.start()
+        try:
+            got = {}
+            ev = threading.Event()
+
+            def cb(arr, err):
+                got["arr"], got["err"] = arr, err
+                ev.set()
+
+            runner.submit_embed([[1, 2, 3]], cb)
+            assert ev.wait(30), "callback never fired"
+            assert got["arr"] is None
+            assert "embed_start exploded" in got["err"]
+        finally:
+            runner.shutdown()

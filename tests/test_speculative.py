@@ -136,14 +136,19 @@ class TestProbationReenable:
         )
         self._bad_rounds(t, 8)
         assert not t.enabled
+        assert not t.consume_probation()
         clock["t"] = 5.0
         assert not t.enabled  # cooldown not elapsed
         clock["t"] = 10.0
-        assert t.enabled  # probation: fresh window
-        assert t.rate() == 1.0  # window cleared
+        # the pure getter reports re-enabled without mutating state...
+        assert t.enabled
+        assert t.rate() == 0.0  # window NOT cleared by the read
+        # ...the engine-thread consume performs the actual reset
+        assert t.consume_probation()
+        assert t.rate() == 1.0  # fresh window
         # still-bad pattern re-disables within one window
         self._bad_rounds(t, 8)
-        assert not t.enabled
+        assert not t.enabled and not t.consume_probation()
 
     def test_zero_cooldown_stays_disabled_until_reset(self):
         clock = {"t": 0.0}
