@@ -373,7 +373,14 @@ class EngineRunner:
         if eng is not None:
             try:
                 s = eng.cache_stats()
-                used, total = s.pages_total - s.pages_free, s.pages_total
+                # LIVE usage: pages pinned by in-flight sequences. Cached
+                # (refcount-0 prefix) pages are effectively free capacity
+                # — allocate() reclaims them LRU on demand — so counting
+                # them as used would drive the degradation ladder to
+                # EMERGENCY (reject-all) on a pool merely FULL OF CACHE,
+                # and would mislead memory-aware scheduling the same way.
+                total = s.pages_total
+                used = total - s.pages_free - s.pages_cached
                 waiting = eng.num_waiting()
                 speculation = eng.spec_stats()
                 if speculation is not None and self.metrics:

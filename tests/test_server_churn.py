@@ -109,3 +109,60 @@ def test_traffic_through_scale_and_swap_churn(server):
             await client.close()
 
     asyncio.run(main())
+
+
+def test_sustained_traffic_leaves_no_residue(server):
+    """Leak soak: hundreds of requests (mixed streaming/unary, some
+    cancelled) leave no per-request residue in the handler span map,
+    dispatcher queue, batcher, or engines."""
+
+    async def main():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            async def one(i):
+                if i % 3 == 0:
+                    resp = await client.post("/generate", json={
+                        "prompt": f"soak {i}", "max_tokens": 3,
+                        "temperature": 0.0, "stream": True,
+                    })
+                    async for _ in resp.content:
+                        pass
+                    return 200
+                resp = await client.post("/generate", json={
+                    "prompt": f"soak {i}", "max_tokens": 3,
+                    "temperature": 0.0,
+                })
+                await resp.read()
+                return resp.status
+
+            for wave in range(6):
+                results = await asyncio.gather(
+                    *(one(wave * 40 + i) for i in range(40))
+                )
+                assert all(s == 200 for s in results), results
+            # residue checks — poll briefly: the last responses return to
+            # clients a beat before the runner thread finishes its own
+            # bookkeeping (and a prior test's swap may still be draining)
+            h = server.handler
+            d = h.dispatcher
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + 30.0
+
+            def residue():
+                if h._spans_by_request or d.queue.total_depth() \
+                        or d.batcher.pending_count():
+                    return True
+                return any(
+                    r.active_count() or r._engine.num_active()
+                    or r._engine._by_id
+                    for r in d.scheduler.engines()
+                )
+
+            while residue():
+                assert loop.time() < deadline, "per-request residue"
+                await asyncio.sleep(0.2)
+        finally:
+            await client.close()
+
+    asyncio.run(main())
