@@ -166,3 +166,74 @@ def test_sustained_traffic_leaves_no_residue(server):
             await client.close()
 
     asyncio.run(main())
+
+
+def test_degradation_engages_on_live_pressure_and_recovers():
+    """E2E pressure cycle: live sequences pin most of a tiny pool, the
+    ladder rises and sheds load; when they finish, it lifts and normal
+    service resumes. Cached-prefix pages must NOT trigger the ladder
+    (they are reclaimable on demand)."""
+    from distributed_inference_server_tpu.serving.degradation import (
+        DegradationLevel,
+    )
+
+    tiny_pool = PagedCacheConfig(num_pages=12, page_size=4,
+                                 max_pages_per_seq=12)
+
+    def factory():
+        if 0 not in _PARAMS:
+            _PARAMS[0] = llama.init_params(
+                jax.random.PRNGKey(0), TINY, dtype=jnp.float32
+            )
+        return LLMEngine(
+            _PARAMS[0], TINY, ByteTokenizer(),
+            EngineConfig(max_batch=2, prefill_buckets=(16,),
+                         paged=tiny_pool, decode_block_size=2),
+            dtype=jnp.float32,
+        )
+
+    srv = InferenceServer(
+        factory, ByteTokenizer(), model_name="tiny-pressure",
+        num_engines=1, auto_restart=False,
+    )
+    srv.start()
+    try:
+        async def main():
+            client = TestClient(TestServer(srv.build_app()))
+            await client.start_server()
+            try:
+                # two long generations pin ~10 of 12 pages for a while
+                tasks = [asyncio.create_task(client.post(
+                    "/generate", json={
+                        "prompt": "p" * 14, "max_tokens": 24,
+                        "temperature": 0.0,
+                    })) for _ in range(2)]
+                peak = DegradationLevel.NORMAL
+                deadline = asyncio.get_running_loop().time() + 60
+                while asyncio.get_running_loop().time() < deadline:
+                    peak = max(peak, srv.degradation.level)
+                    if all(t.done() for t in tasks):
+                        break
+                    await asyncio.sleep(0.1)
+                for t in tasks:
+                    resp = await t
+                    assert resp.status == 200
+                assert peak > DegradationLevel.NORMAL, (
+                    "ladder never engaged under live pressure")
+                # pressure gone: ladder lifts within a few intervals
+                deadline = asyncio.get_running_loop().time() + 20
+                while srv.degradation.level != DegradationLevel.NORMAL:
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        f"stuck at {srv.degradation.level}")
+                    await asyncio.sleep(0.2)
+                # and service is normal again
+                r = await client.post("/generate", json={
+                    "prompt": "after", "max_tokens": 2,
+                    "temperature": 0.0})
+                assert r.status == 200
+            finally:
+                await client.close()
+
+        asyncio.run(main())
+    finally:
+        srv.shutdown(drain_timeout_s=10.0)
