@@ -123,7 +123,8 @@ def config_from_hf_json(obj: Mapping[str, Any], name: str = "hf") -> ModelConfig
         num_layers=int(obj["num_hidden_layers"]),
         num_heads=num_heads,
         num_kv_heads=int(obj.get("num_key_value_heads", num_heads)),
-        head_dim=int(obj.get("head_dim", hidden // num_heads)),
+        # some configs carry an explicit head_dim: None (e.g. Mistral)
+        head_dim=int(obj.get("head_dim") or hidden // num_heads),
         rms_norm_eps=float(obj.get("rms_norm_eps", 1e-5)),
         rope_theta=float(obj.get("rope_theta", 10000.0)),
         rope_scaling=rope_scaling,

@@ -326,3 +326,60 @@ class TestWindowKVReclaim:
         # 2 seqs x ~74 positions = 37 pages > 24 in the pool: only
         # window reclaim makes both finish
         assert done["a"] >= 64 and done["b"] >= 64
+
+
+class TestTransformersParity:
+    """Numerics parity vs HuggingFace eager implementations for the new
+    families — sliding-window masking (Mistral) and q/k/v bias (Qwen2)
+    verified against the upstream reference model, random weights."""
+
+    def _parity(self, hf_cfg, hf_model_cls, T=12):
+        import numpy as _np
+
+        torch = pytest.importorskip("torch")
+        torch.manual_seed(0)
+        hf_model = hf_model_cls(hf_cfg).eval()
+        state = {k: v.detach().numpy()
+                 for k, v in hf_model.state_dict().items()}
+        cfg = config_from_hf_json(hf_cfg.to_dict(), name="hf-parity")
+        params = params_from_hf_state_dict(state, cfg, dtype=jnp.float32)
+        rng = _np.random.default_rng(0)
+        ids = rng.integers(0, hf_cfg.vocab_size, size=(2, T))
+        with torch.no_grad():
+            hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+        B = ids.shape[0]
+        cache = llama.KVCache.create(cfg, B, T, dtype=jnp.float32)
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+        lens = jnp.full((B,), T, jnp.int32)
+        ours, _ = llama.forward(
+            params, cfg, jnp.asarray(ids, jnp.int32), positions, cache,
+            positions, lens,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours), hf_logits, atol=3e-4, rtol=3e-3
+        )
+        return cfg
+
+    def test_mistral_sliding_window_parity(self):
+        from transformers import MistralConfig, MistralForCausalLM
+
+        cfg = self._parity(MistralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, rms_norm_eps=1e-5, rope_theta=10000.0,
+            sliding_window=4,  # < T: the window masking is live
+            max_position_embeddings=512, attn_implementation="eager",
+        ), MistralForCausalLM)
+        assert cfg.sliding_window == 4
+
+    def test_qwen2_bias_parity(self):
+        from transformers import Qwen2Config, Qwen2ForCausalLM
+
+        cfg = self._parity(Qwen2Config(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, rms_norm_eps=1e-6, rope_theta=10000.0,
+            use_sliding_window=False, max_position_embeddings=512,
+            attn_implementation="eager",
+        ), Qwen2ForCausalLM)
+        assert cfg.attention_bias
