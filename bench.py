@@ -9,9 +9,11 @@ Prints ONE JSON line:
 vs_baseline is against the north-star 2000 output tok/s/chip target
 (BASELINE.json; the reference itself publishes no numbers — BASELINE.md).
 
-Env knobs: BENCH_BATCH (32), BENCH_PROMPT (128), BENCH_NEW (128),
+Env knobs: BENCH_BATCH (64), BENCH_PROMPT (128), BENCH_NEW (128),
 BENCH_BLOCK (16, decode steps per device block), BENCH_PIPELINE (1,
-blocks in flight), BENCH_IMPL (auto|pallas|xla decode attention),
+blocks in flight), BENCH_PREFILL_BATCH (16, rows per batched prefill
+program), BENCH_PREFILL_BUDGET (8192, prefill tokens per engine step),
+BENCH_IMPL (auto|pallas|xla decode attention),
 BENCH_COMPARE=1 (measure BOTH attention impls, report the better with
 both numbers in the line), BENCH_FORCE_CPU=1 (tiny-model smoke mode),
 BENCH_INIT_TIMEOUT_S (180).
@@ -32,11 +34,13 @@ def _emit(obj) -> None:
 
 def main() -> None:
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
     prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
     new_tokens = int(os.environ.get("BENCH_NEW", "128"))
     block = int(os.environ.get("BENCH_BLOCK", "16"))
     pipeline = int(os.environ.get("BENCH_PIPELINE", "1"))
+    prefill_batch = int(os.environ.get("BENCH_PREFILL_BATCH", "16"))
+    prefill_budget = int(os.environ.get("BENCH_PREFILL_BUDGET", "8192"))
     impl = os.environ.get("BENCH_IMPL", "auto")
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "180"))
 
@@ -131,7 +135,8 @@ def main() -> None:
             EngineConfig(
                 max_batch=batch, prefill_buckets=buckets, paged=paged,
                 attention_impl=use_impl, decode_block_size=block,
-                pipeline_depth=pipeline,
+                pipeline_depth=pipeline, prefill_batch=prefill_batch,
+                prefill_token_budget=prefill_budget,
             ),
             dtype=dtype,
         )

@@ -35,6 +35,7 @@ the serving layer runs it on a dedicated thread and bridges to asyncio.
 from __future__ import annotations
 
 import functools
+import logging
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Tuple
@@ -62,6 +63,8 @@ from distributed_inference_server_tpu.models import llama
 from distributed_inference_server_tpu.models.configs import ModelConfig
 from distributed_inference_server_tpu.models.tokenizer import Tokenizer
 from distributed_inference_server_tpu.ops.sampling import sample_tokens
+
+logger = logging.getLogger(__name__)
 
 
 def _make_allocator(pcfg: PagedCacheConfig, force: Optional[bool]):
@@ -365,6 +368,8 @@ class LLMEngine:
         self._prof_active = None
 
         # jit caches
+        # "auto" probe result: (decode_impl, prefill_impl) once resolved
+        self._auto_impl: Optional[Tuple[str, str]] = None
         self._fwd = self._make_fwd()
         self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
         self._cp_fns: Dict[int, Callable] = {}
@@ -891,14 +896,122 @@ class LLMEngine:
             return "ep"
         return "dense"
 
-    def _resolved_impl(self) -> str:
+    def _resolved_impl(self):
         """The decode/prefill attention implementation after "auto"
-        resolution: the Pallas paged-attention kernels on TPU, the XLA
-        gather path elsewhere."""
+        resolution: a ``(decode_impl, prefill_impl)`` pair consumed by
+        ``llama.paged_forward`` per call site — the Pallas paged-attention
+        kernels on TPU when they compile for this model's geometry, the
+        XLA gather path otherwise.
+
+        Mosaic's tiling/alignment rules vary with head_dim, head counts,
+        and toolchain version, so "auto" PROBES each kernel with an AOT
+        compile at this engine's real per-shard shapes the first time it
+        resolves (cached; the persistent XLA compile cache makes repeats
+        cheap). A rejected kernel downgrades to the XLA path with a
+        warning instead of poisoning every serving program (round-1
+        verdict: "auto" must never ship a slower-or-broken path) — and
+        independently per kernel, so a prefill-only rejection keeps the
+        decode hot loop on Pallas."""
         impl = self.ecfg.attention_impl
-        if impl == "auto":
-            return "pallas" if jax.default_backend() == "tpu" else "xla"
-        return impl
+        if impl != "auto":
+            return impl
+        if self._auto_impl is None:
+            if jax.default_backend() != "tpu":
+                self._auto_impl = ("xla", "xla")
+            else:
+                ok_decode, ok_prefill = self._probe_pallas()
+                self._auto_impl = (
+                    "pallas" if ok_decode else "xla",
+                    "pallas" if ok_prefill else "xla",
+                )
+        return self._auto_impl
+
+    def _probe_pallas(self) -> Tuple[bool, bool]:
+        """AOT-compile the Pallas paged-attention kernels (decode, chunked
+        prefill) at every geometry this engine will actually launch them
+        at — target AND draft model head shapes, every prefill bucket,
+        and the speculative verify width (gamma+1) — returning per-kernel
+        success. Runs on the real backend so Mosaic itself is the judge;
+        one never-probed shape crashing at first launch is exactly the
+        failure mode this probe exists to prevent."""
+        from distributed_inference_server_tpu.ops.pallas import (
+            paged_attention_decode,
+            paged_attention_prefill,
+        )
+
+        pcfg = self.pcfg
+        tp = self.mesh.shape.get("tensor", 1) if self.mesh is not None else 1
+        dp = self.mesh.shape.get("data", 1) if self.mesh is not None else 1
+        Bd = max(1, self.ecfg.max_batch // dp)  # decode / spec-verify rows
+        Bp = max(1, self.ecfg.prefill_batch)  # batched-prefill rows
+        P = pcfg.max_pages_per_seq
+        slots = pcfg.num_pages * pcfg.page_size
+        geometries = [self.cfg]
+        if self.draft_cfg is not None:
+            geometries.append(self.draft_cfg)
+        # (rows, chunk width) of every prefill-kernel launch site: bucketed
+        # admission chunks at prefill_batch rows, plus the speculative
+        # verify forward (gamma+1 wide) over the full decode batch
+        launches = [(Bp, T) for T in sorted(set(self.ecfg.prefill_buckets))]
+        if self.draft_params is not None:
+            launches.append((Bd, self.spec.num_draft_tokens + 1))
+
+        def try_compile(name, lower_thunk):
+            # the thunk runs BOTH lowering and compile inside the try:
+            # Mosaic rejects misaligned kernels at lowering time too
+            try:
+                lower_thunk().compile()
+                return True
+            except Exception as e:  # Mosaic rejection or backend failure
+                logger.warning(
+                    "Pallas %s kernel unavailable for this geometry "
+                    "(auto -> xla gather path): %s",
+                    name, str(e).split("\n")[0],
+                )
+                return False
+
+        def tv(B):
+            return (
+                jax.ShapeDtypeStruct((B, P), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+            )
+
+        ok_decode = ok_prefill = True
+        for cfg in geometries:
+            kv = max(1, cfg.num_kv_heads // tp)
+            heads = max(1, cfg.num_heads // tp)
+            window = cfg.sliding_window or 0
+            pool = jax.ShapeDtypeStruct(
+                (slots, kv, cfg.head_dim), self.dtype
+            )
+            tables, valid = tv(Bd)
+            ok_decode = ok_decode and try_compile(
+                "paged-decode",
+                lambda: paged_attention_decode.lower(
+                    jax.ShapeDtypeStruct(
+                        (Bd, heads, cfg.head_dim), self.dtype
+                    ),
+                    pool, pool, tables, valid,
+                    page_size=pcfg.page_size, sliding_window=window,
+                    interpret=False,
+                ),
+            )
+            for B, T in launches:
+                tables, valid = tv(B)
+                ok_prefill = ok_prefill and try_compile(
+                    "chunked-prefill",
+                    lambda: paged_attention_prefill.lower(
+                        jax.ShapeDtypeStruct(
+                            (B, T, heads, cfg.head_dim), self.dtype
+                        ),
+                        pool, pool, tables, valid, valid,
+                        page_size=pcfg.page_size, sliding_window=window,
+                        interpret=False,
+                    ),
+                )
+                if not ok_prefill:
+                    break
+        return ok_decode, ok_prefill
 
     def _get_prefill_fn(self, batch: int, bucket: int) -> Callable:
         """Compiled batched-prefill chunk program keyed on (rows, bucket):

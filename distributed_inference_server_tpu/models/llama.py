@@ -354,6 +354,10 @@ def paged_forward(
 
     Returns (logits [B, T, V] f32, new pool_k, new pool_v).
     """
+    if not isinstance(attention_impl, str):
+        # (decode_impl, prefill_impl) pair from the engine's per-kernel
+        # "auto" probe — pick by this call's token count
+        attention_impl = attention_impl[0 if input_ids.shape[1] == 1 else 1]
     use_pallas = attention_impl == "pallas"
     if use_pallas:
         from distributed_inference_server_tpu.ops.pallas import (

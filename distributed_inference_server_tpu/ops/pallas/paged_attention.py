@@ -8,8 +8,10 @@ dense attention — materializing S_max slots per row in HBM each step and
 paying the write+read round trip. This kernel reads pages straight from
 the pool instead.
 
-v2 design (replaces the one-page-per-grid-step v1, which drowned in grid
-overhead at serving shapes — B x P grid steps of one 16-token page each):
+Decode kernel v3 design (v1 drowned in grid overhead — B x P grid steps
+of one page each; v2 blocked the DMA but sliced 64-wide per-head lane
+windows, which Mosaic rejects for head_dim-64 models — "slice shape must
+be aligned to tiling (128)"):
 
 - **Grid = (B,)**: one grid step per sequence; the page loop runs inside
   the kernel as a ``fori_loop`` with a *dynamic* trip count covering only
@@ -21,9 +23,17 @@ overhead at serving shapes — B x P grid steps of one 16-token page each):
   into one of two VMEM buffers with ``make_async_copy`` while the MXU
   works on the previous block — the classic overlap pattern, with
   per-page semaphores because the pages are scattered.
+- **Block-diagonal GQA**: pages are DMA'd with heads folded into lanes
+  ([page_size, KV*D] — always 128-aligned for serving geometries), and
+  the query enters pre-expanded to a block-diagonal [H, KV*D] so the
+  whole batch of heads is TWO aligned MXU dots per KV block: scores
+  [H,KV*D]x[T,KV*D]^T and values [H,T]x[T,KV*D]. No per-head slicing
+  anywhere in the kernel; the wrapper extracts each head's diagonal
+  lane block afterwards. The KV-fold multiplies attention FLOPs by KV,
+  which is free in practice: decode attention is HBM-DMA-bound and the
+  tiny per-head matmuls of v2 were far below MXU tile size anyway.
 - **bf16 on the MXU**: q/k/v enter the dots in their native dtype with
-  ``preferred_element_type=f32`` accumulation (v1 pre-converted to f32,
-  halving MXU rate for bf16 pools).
+  ``preferred_element_type=f32`` accumulation.
 - Online-softmax accumulation (flash-attention style) across blocks in
   f32 VMEM scratch; causal masking implied by the ragged ``kv_valid_len``
   (the query IS the last valid token — decode only).
@@ -52,27 +62,36 @@ def _decode_kernel(
     tables_ref,  # [B, P] page id per (row, page-slot)
     valid_ref,  # [B] valid token count per row
     # tensor refs
-    q_ref,  # [1, KV, G, D] this row's query tile (VMEM)
-    k_hbm,  # [num_pages, page_size, KV, D] full K pool (HBM)
-    v_hbm,  # [num_pages, page_size, KV, D] full V pool (HBM)
-    out_ref,  # [1, KV, G, D] (VMEM)
+    qbd_ref,  # [1, H, KV*D] this row's BLOCK-DIAGONAL query (VMEM)
+    k_hbm,  # [num_pages, page_size, KV*D] full K pool (HBM)
+    v_hbm,  # [num_pages, page_size, KV*D] full V pool (HBM)
+    out_ref,  # [1, H, KV*D] (VMEM; per-head diagonal lanes valid)
     # scratch
-    k_buf,  # [2, PB, page_size, KV, D] double-buffered K pages
-    v_buf,  # [2, PB, page_size, KV, D]
+    k_buf,  # [2, PB, page_size, KV*D] double-buffered K pages
+    v_buf,  # [2, PB, page_size, KV*D]
     sem_k,  # DMA semaphores [2, PB]
     sem_v,  # [2, PB]
-    m_ref,  # [KV*G, LANES] f32 running max
-    l_ref,  # [KV*G, LANES] f32 running denominator
-    acc_ref,  # [KV*G, D] f32 running numerator
+    m_ref,  # [H, LANES] f32 running max
+    l_ref,  # [H, LANES] f32 running denominator
+    acc_ref,  # [H, KV*D] f32 running numerator
     *,
     page_size: int,
     pages_per_block: int,
     num_page_slots: int,
+    head_dim: int,
     sliding_window: int = 0,
 ):
+    """v3 body: block-diagonal GQA — every shape Mosaic-tile-aligned.
+
+    The query arrives pre-expanded (host XLA) to [H, KV*D], row h = kv*G+g
+    holding q_h in lanes [kv*D, (kv+1)*D) and zeros elsewhere. One
+    [H, KV*D] x [KV*D, T] MXU dot then yields exactly the per-head scores
+    (zero lanes null the cross-head terms) without slicing the KV/head
+    dimension anywhere — the per-head lane slices of v2 were 64-wide for
+    head_dim-64 models, which Mosaic rejects (tiling is 128). The extra
+    FLOPs (contraction over KV*D instead of D) are irrelevant: decode
+    attention is DMA-bound, the MXU idles either way."""
     b = pl.program_id(0)
-    num_kv = q_ref.shape[1]
-    G = q_ref.shape[2]
     PB = pages_per_block
     blk_tokens = PB * page_size
 
@@ -93,10 +112,8 @@ def _decode_kernel(
         # the driver, so entries past the row's last page are in-range and
         # merely masked at compute time)
         for i in range(PB):
-            page_idx = jnp.minimum(
-                blk * PB + i, num_page_slots - 1
-            )
-            page = tables_ref[b, page_idx]
+            page = tables_ref[b, jnp.minimum(blk * PB + i,
+                                             num_page_slots - 1)]
             pltpu.make_async_copy(
                 k_hbm.at[page], k_buf.at[slot, i], sem_k.at[slot, i]
             ).start()
@@ -117,6 +134,7 @@ def _decode_kernel(
 
     @pl.when(num_blocks > first_block)
     def _run():
+        qbd = qbd_ref[0] * (1.0 / (head_dim**0.5))  # [H, KV*D]
         start_block(lax.rem(first_block, 2), first_block)
 
         def loop(blk, _):
@@ -129,49 +147,43 @@ def _decode_kernel(
             wait_block(slot, blk)
             start = blk * blk_tokens
 
-            # static unroll over the (small) kv-head count; each head is
-            # a plain 2D MXU matmul in the pool's native dtype with f32
-            # accumulation
-            for kv in range(num_kv):
-                q = q_ref[0, kv]  # [G, D]
-                k = k_buf[slot, :, :, kv, :].reshape(blk_tokens, -1)
-                v = v_buf[slot, :, :, kv, :].reshape(blk_tokens, -1)
-                d = q.shape[-1]
-                rows = slice(kv * G, (kv + 1) * G)
+            k = k_buf[slot].reshape(blk_tokens, -1)  # [T, KV*D]
+            v = v_buf[slot].reshape(blk_tokens, -1)
 
-                # [G, blk_tokens] scores on the MXU
-                s = lax.dot_general(
-                    q, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                ) * (1.0 / (d**0.5))
-                token_ids = start + lax.broadcasted_iota(
-                    jnp.int32, s.shape, 1
-                )
-                ok = token_ids < valid
-                if sliding_window:
-                    ok &= token_ids >= win_lo
-                s = jnp.where(ok, s, _NEG_INF)
+            # [H, T] scores in ONE MXU dot; block-diagonal q rows contract
+            # only their own head's lanes
+            s = lax.dot_general(
+                qbd.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            token_ids = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            ok = token_ids < valid
+            if sliding_window:
+                ok &= token_ids >= win_lo
+            s = jnp.where(ok, s, _NEG_INF)
 
-                m_prev = m_ref[rows, :1]  # [G, 1]
-                l_prev = l_ref[rows, :1]
-                m_cur = jnp.max(s, axis=-1, keepdims=True)
-                m_new = jnp.maximum(m_prev, m_cur)
-                alpha = jnp.exp(m_prev - m_new)
-                probs = jnp.exp(s - m_new)  # [G, blk_tokens] f32
-                l_new = l_prev * alpha + jnp.sum(probs, -1, keepdims=True)
-                acc_ref[rows] = acc_ref[rows] * alpha + lax.dot_general(
-                    probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-                m_ref[rows] = jnp.broadcast_to(m_new, (G, m_ref.shape[1]))
-                l_ref[rows] = jnp.broadcast_to(l_new, (G, l_ref.shape[1]))
+            m_prev = m_ref[:, :1]  # [H, 1]
+            l_prev = l_ref[:, :1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            probs = jnp.exp(s - m_new)  # [H, T] f32
+            l_new = l_prev * alpha + jnp.sum(probs, -1, keepdims=True)
+            # [H, KV*D]: row h accumulates its own head's V in the diagonal
+            # lane block (other lanes carry cross-head garbage the wrapper
+            # discards)
+            acc_ref[:] = acc_ref[:] * alpha + lax.dot_general(
+                probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
             return 0
 
         lax.fori_loop(first_block, num_blocks, loop, 0)
 
     l = jnp.maximum(l_ref[:, :1], 1e-30)  # rows with valid=0 emit zeros
-    out = acc_ref[:] / l  # [KV*G, D]
-    out_ref[0] = out.reshape(num_kv, G, -1).astype(out_ref.dtype)
+    out_ref[0] = (acc_ref[:] / l).astype(out_ref.dtype)
 
 
 def _prefill_kernel(
@@ -456,47 +468,56 @@ def paged_attention_decode(
     B, H, D = q.shape
     num_slots, KV, _ = pool_k.shape
     G = H // KV
+    CD = KV * D
     num_pages = num_slots // page_size
     P = page_tables.shape[1]
     PB = min(pages_per_block, P)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    qg = q.reshape(B, KV, G, D)
-    k_pages = pool_k.reshape(num_pages, page_size, KV, D)
-    v_pages = pool_v.reshape(num_pages, page_size, KV, D)
+    # Block-diagonal query expansion (plain XLA — no Mosaic layout rules):
+    # qbd[b, kv*G+g, kv*D+d] = q[b, kv*G+g, d], zeros off the diagonal.
+    # This is what lets the kernel contract [H, KV*D] x [T, KV*D] in one
+    # aligned MXU dot instead of slicing 64-wide per-head lane windows.
+    eye = jnp.eye(KV, dtype=q.dtype)
+    qbd = jnp.einsum(
+        "bkgd,kj->bkgjd", q.reshape(B, KV, G, D), eye
+    ).reshape(B, H, CD)
+    k_pages = pool_k.reshape(num_pages, page_size, CD)
+    v_pages = pool_v.reshape(num_pages, page_size, CD)
     tables = jnp.clip(page_tables.astype(jnp.int32), 0, num_pages - 1)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, KV, G, D), lambda b, t, vl: (b, 0, 0, 0)),
+            pl.BlockSpec((1, H, CD), lambda b, t, vl: (b, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),  # K pool stays in HBM
             pl.BlockSpec(memory_space=pl.ANY),  # V pool stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, KV, G, D), lambda b, t, vl: (b, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, H, CD), lambda b, t, vl: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, PB, page_size, KV, D), pool_k.dtype),
-            pltpu.VMEM((2, PB, page_size, KV, D), pool_v.dtype),
+            pltpu.VMEM((2, PB, page_size, CD), pool_k.dtype),
+            pltpu.VMEM((2, PB, page_size, CD), pool_v.dtype),
             pltpu.SemaphoreType.DMA((2, PB)),
             pltpu.SemaphoreType.DMA((2, PB)),
-            pltpu.VMEM((KV * G, _LANES), jnp.float32),
-            pltpu.VMEM((KV * G, _LANES), jnp.float32),
-            pltpu.VMEM((KV * G, D), jnp.float32),
+            pltpu.VMEM((H, _LANES), jnp.float32),
+            pltpu.VMEM((H, _LANES), jnp.float32),
+            pltpu.VMEM((H, CD), jnp.float32),
         ],
     )
 
-    out = pl.pallas_call(
+    out_big = pl.pallas_call(
         functools.partial(
             _decode_kernel,
             page_size=page_size,
             pages_per_block=PB,
             num_page_slots=P,
+            head_dim=D,
             sliding_window=sliding_window,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, KV, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, CD), q.dtype),
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             # rows are independent — scratch state is reset per grid step
@@ -504,10 +525,15 @@ def paged_attention_decode(
             dimension_semantics=("parallel",),
         ),
         cost_estimate=pl.CostEstimate(
-            flops=4 * B * H * P * page_size * D,
+            flops=4 * B * H * P * page_size * CD,
             bytes_accessed=2 * B * KV * P * page_size * D
             * pool_k.dtype.itemsize,
             transcendentals=B * H * P * page_size,
         ),
-    )(tables, kv_valid_len.astype(jnp.int32), qg, k_pages, v_pages)
+    )(tables, kv_valid_len.astype(jnp.int32), qbd, k_pages, v_pages)
+    # extract each head's diagonal lane block (the rest is cross-head
+    # garbage by construction)
+    out = jnp.einsum(
+        "bkgjd,kj->bkgd", out_big.reshape(B, KV, G, KV, D), eye
+    )
     return out.reshape(B, H, D)

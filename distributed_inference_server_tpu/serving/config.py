@@ -80,8 +80,8 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # sequence-parallel attention flavor: ring | ulysses
         "sp_impl": (str, "ring"),
         # continuous-batching decode slots per replica (the north star
-        # needs 64-256; 32 is the conservative single-chip default)
-        "max_batch": (int, 32),
+        # needs 64-256; 64 measured best on one v5e chip, BENCH r2)
+        "max_batch": (int, 64),
         "prefill_buckets": (list, [32, 128, 512]),
         "page_size": (int, 16),
         "num_pages": (int, 2048),
@@ -90,8 +90,8 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # speculative rounds) per compiled block, and blocks in flight
         "decode_block_size": (int, 8),
         "pipeline_depth": (int, 1),
-        "prefill_batch": (int, 4),
-        "prefill_token_budget": (int, 2048),
+        "prefill_batch": (int, 16),
+        "prefill_token_budget": (int, 8192),
         # speculative decoding knobs (Req 12.3-12.5)
         "num_draft_tokens": (int, 4),
         "spec_disable_threshold": (float, 0.5),

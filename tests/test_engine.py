@@ -343,6 +343,18 @@ def test_engine_pallas_attention_matches_xla(tiny_params):
     assert results["pallas"]["finish"] == results["xla"]["finish"]
 
 
+def test_auto_impl_probe_downgrades_gracefully(tiny_params):
+    """"auto" resolution never crashes the engine: on backends where the
+    Pallas kernels cannot compile (Mosaic is TPU-only — interpret=False on
+    the CPU backend is such a rejection), the probe catches the failure
+    and downgrades to the XLA gather path per kernel."""
+    engine = make_engine(tiny_params)
+    # CPU backend short-circuits without probing
+    assert engine._resolved_impl() == ("xla", "xla")
+    # the probe itself must swallow lowering/compile failures, not raise
+    assert engine._probe_pallas() == (False, False)
+
+
 class TestWarmup:
     """Startup warm-compilation (engine.warmup): every serving program
     compiles before the first real request, so first-request TTFT never
