@@ -632,13 +632,20 @@ class LLMEngine:
                             error=str(e)))
                     budget = 0
                     break
+        # Phase 1 — dispatch: launch every chunk program in the quantum
+        # back-to-back WITHOUT touching device results; the first-token
+        # fetch of group N would otherwise serialize group N+1's upload
+        # behind a full host<->device round trip (the r1 per-step sync
+        # bug in miniature, one per prefill group).
+        dispatched: List[Tuple[object, List[Tuple[int, _Seq]], List[int]]] = []
         while budget > 0:
             group = [
                 (i, s) for i, s in enumerate(self.slots)
                 if s is not None and s.next_token is None
+                and s.seq_len < len(s.token_ids)  # not yet reaped below
             ][:Bp]
             if not group:
-                return
+                break
             bucket = self._pick_bucket(max(
                 len(s.token_ids) - s.seq_len for _, s in group
             ))
@@ -693,11 +700,25 @@ class LLMEngine:
             else:
                 toks, self.state.k, self.state.v = fn(self.params, *args)
             budget -= Bp * bucket
+            done: List[bool] = []
+            for j, (_, s) in enumerate(group):
+                s.seq_len += chunk_lens[j]  # host view advances now so the
+                # next while-iteration groups the remaining chunks
+                done.append(s.seq_len >= len(s.token_ids))
+            dispatched.append((toks, list(group), done))
+
+        # Phase 2 — reap: fetch each group's first-token batch (the device
+        # has been crunching the later groups meanwhile) and seat finished
+        # prompts into the decode carry. ``done`` marks rows whose FINAL
+        # prompt chunk ran in that group — only there is toks[j] the real
+        # first sampled token.
+        for toks, group, done in dispatched:
             toks_np: Optional[np.ndarray] = None
             for j, (slot, s) in enumerate(group):
-                s.seq_len += chunk_lens[j]
-                if s.seq_len < len(s.token_ids):
-                    continue  # more chunks to go
+                if not done[j]:
+                    continue  # mid-prompt chunk (or finished elsewhere)
+                if self._by_id.get(s.request_id) is not s:
+                    continue  # aborted between dispatch and reap
                 if toks_np is None:
                     toks_np = np.asarray(toks)
                 try:
@@ -943,18 +964,21 @@ class LLMEngine:
         tp = self.mesh.shape.get("tensor", 1) if self.mesh is not None else 1
         dp = self.mesh.shape.get("data", 1) if self.mesh is not None else 1
         Bd = max(1, self.ecfg.max_batch // dp)  # decode / spec-verify rows
-        Bp = max(1, self.ecfg.prefill_batch)  # batched-prefill rows
+        Bp = max(1, self.ecfg.prefill_batch // dp)  # batched-prefill rows
         P = pcfg.max_pages_per_seq
         slots = pcfg.num_pages * pcfg.page_size
-        geometries = [self.cfg]
+        # per-geometry (rows, chunk width) prefill-kernel launch sites:
+        # bucketed admission chunks run for BOTH models (the draft
+        # prefills the same chunks into its own pool), but the gamma+1
+        # speculative verify forward exists only for the TARGET — probing
+        # a never-launched draft shape could spuriously demote everything
+        buckets = [
+            (Bp, T) for T in sorted(set(self.ecfg.prefill_buckets))
+        ]
+        geometries = [(self.cfg, list(buckets))]
         if self.draft_cfg is not None:
-            geometries.append(self.draft_cfg)
-        # (rows, chunk width) of every prefill-kernel launch site: bucketed
-        # admission chunks at prefill_batch rows, plus the speculative
-        # verify forward (gamma+1 wide) over the full decode batch
-        launches = [(Bp, T) for T in sorted(set(self.ecfg.prefill_buckets))]
-        if self.draft_params is not None:
-            launches.append((Bd, self.spec.num_draft_tokens + 1))
+            geometries[0][1].append((Bd, self.spec.num_draft_tokens + 1))
+            geometries.append((self.draft_cfg, list(buckets)))
 
         def try_compile(name, lower_thunk):
             # the thunk runs BOTH lowering and compile inside the try:
@@ -977,7 +1001,7 @@ class LLMEngine:
             )
 
         ok_decode = ok_prefill = True
-        for cfg in geometries:
+        for cfg, launches in geometries:
             kv = max(1, cfg.num_kv_heads // tp)
             heads = max(1, cfg.num_heads // tp)
             window = cfg.sliding_window or 0
