@@ -192,12 +192,13 @@ def _prefill_kernel(
     valid_ref,  # [B] valid token count per row (incl. this chunk)
     qstart_ref,  # [B] global position of the chunk's first query
     # tensor refs
-    q_ref,  # [1, TQ, KV, G, D] this (row, q-block)'s query tile (VMEM)
-    k_hbm,  # [num_pages, page_size, KV, D] full K pool (HBM)
-    v_hbm,  # [num_pages, page_size, KV, D] full V pool (HBM)
-    out_ref,  # [1, TQ, KV, G, D] (VMEM)
+    qbd_ref,  # [1, 1, R, CD] this (row, head-chunk, q-block)'s
+    #           block-diagonal query tile (VMEM); R = TQ*C*G
+    k_hbm,  # [num_pages, page_size, KV*D] full K pool (HBM)
+    v_hbm,  # [num_pages, page_size, KV*D] full V pool (HBM)
+    out_ref,  # [1, 1, R, CD] (VMEM; per-head diagonal lanes valid)
     # scratch
-    k_buf,  # [2, PB, page_size, KV, D] double-buffered K pages
+    k_buf,  # [2, PB, page_size, CD] double-buffered K page lane-chunks
     v_buf,
     sem_k,  # DMA semaphores [2, PB]
     sem_v,
@@ -205,12 +206,23 @@ def _prefill_kernel(
     page_size: int,
     pages_per_block: int,
     num_page_slots: int,
+    heads_per_chunk: int,
+    groups: int,
+    head_dim: int,
     sliding_window: int = 0,
 ):
+    """v3 body: like the decode kernel, every shape is tile-aligned by
+    folding heads into 128-lane chunks (C = 128/D heads per chunk; C = 1
+    for head_dim >= 128). Grid = (B, KV/C, T/TQ); each step DMAs only its
+    chunk's lane window of each page (128-aligned dynamic lane slice) and
+    runs the whole chunk as two MXU dots over block-diagonal queries —
+    the per-head 64-wide lane slices Mosaic rejects never appear."""
     b = pl.program_id(0)
-    qb = pl.program_id(1)
-    TQ, num_kv, G = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
-    D = q_ref.shape[4]
+    c = pl.program_id(1)
+    qb = pl.program_id(2)
+    R, CD = qbd_ref.shape[2], qbd_ref.shape[3]
+    C, G, D = heads_per_chunk, groups, head_dim
+    TQ = R // (C * G)
     PB = pages_per_block
     blk_tokens = PB * page_size
 
@@ -228,15 +240,19 @@ def _prefill_kernel(
         if sliding_window else 0
     )
 
+    lane_lo = c * CD  # this head-chunk's 128-aligned lane window
+
     def start_block(slot, blk):
         for i in range(PB):
             page = tables_ref[b, jnp.minimum(blk * PB + i,
                                              num_page_slots - 1)]
             pltpu.make_async_copy(
-                k_hbm.at[page], k_buf.at[slot, i], sem_k.at[slot, i]
+                k_hbm.at[page, :, pl.ds(lane_lo, CD)],
+                k_buf.at[slot, i], sem_k.at[slot, i]
             ).start()
             pltpu.make_async_copy(
-                v_hbm.at[page], v_buf.at[slot, i], sem_v.at[slot, i]
+                v_hbm.at[page, :, pl.ds(lane_lo, CD)],
+                v_buf.at[slot, i], sem_v.at[slot, i]
             ).start()
 
     def wait_block(slot, blk):
@@ -244,19 +260,23 @@ def _prefill_kernel(
             page = tables_ref[b, jnp.minimum(blk * PB + i,
                                              num_page_slots - 1)]
             pltpu.make_async_copy(
-                k_hbm.at[page], k_buf.at[slot, i], sem_k.at[slot, i]
+                k_hbm.at[page, :, pl.ds(lane_lo, CD)],
+                k_buf.at[slot, i], sem_k.at[slot, i]
             ).wait()
             pltpu.make_async_copy(
-                v_hbm.at[page], v_buf.at[slot, i], sem_v.at[slot, i]
+                v_hbm.at[page, :, pl.ds(lane_lo, CD)],
+                v_buf.at[slot, i], sem_v.at[slot, i]
             ).wait()
 
-    rows = TQ * G  # row r = query t * G + group g
-    # per-row global query position, shared by every kv head
-    q_pos = q_base + lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // G
+    # per-row global query position: row r = (t*C + cl)*G + g
+    q_pos = q_base + lax.broadcasted_iota(
+        jnp.int32, (R, 1), 0
+    ) // (C * G)
 
-    m0 = jnp.full((num_kv, rows, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((num_kv, rows, 1), jnp.float32)
-    acc0 = jnp.zeros((num_kv, rows, D), jnp.float32)
+    m0 = jnp.full((R, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((R, 1), jnp.float32)
+    acc0 = jnp.zeros((R, CD), jnp.float32)
+    qbd = qbd_ref[0, 0] * (1.0 / (D**0.5))  # [R, CD]
 
     def loop(blk, carry):
         m, l, acc = carry
@@ -269,41 +289,34 @@ def _prefill_kernel(
         wait_block(slot, blk)
         start = blk * blk_tokens
         kv_idx = start + lax.broadcasted_iota(
-            jnp.int32, (rows, blk_tokens), 1
+            jnp.int32, (R, blk_tokens), 1
         )
         mask = (kv_idx <= q_pos) & (kv_idx < valid)
         if sliding_window:
             mask &= kv_idx > q_pos - sliding_window
 
-        ms, ls, accs = [], [], []
-        # static unroll over the (small) kv-head count; each head is one
-        # [TQ*G, D] x [D, blk_tokens] MXU matmul in the pool's dtype
-        for kv in range(num_kv):
-            q2 = q_ref[0, :, kv].reshape(rows, D)
-            k = k_buf[slot, :, :, kv, :].reshape(blk_tokens, D)
-            v = v_buf[slot, :, :, kv, :].reshape(blk_tokens, D)
-            s = lax.dot_general(
-                q2, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * (1.0 / (D**0.5))
-            s = jnp.where(mask, s, _NEG_INF)
+        k = k_buf[slot].reshape(blk_tokens, CD)
+        v = v_buf[slot].reshape(blk_tokens, CD)
+        # [R, T] scores in ONE MXU dot; block-diagonal q rows contract
+        # only their own head's lanes
+        s = lax.dot_general(
+            qbd.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        s = jnp.where(mask, s, _NEG_INF)
 
-            m_prev, l_prev, a_prev = m[kv], l[kv], acc[kv]
-            m_cur = jnp.max(s, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_new)
-            # masked-everything rows: exp(s - m_new) with m_new still
-            # -inf would be exp(0); force explicit zeros
-            probs = jnp.where(
-                s > _NEG_INF * 0.5, jnp.exp(s - m_new), 0.0
-            )
-            ms.append(m_new)
-            ls.append(l_prev * alpha + jnp.sum(probs, -1, keepdims=True))
-            accs.append(a_prev * alpha + lax.dot_general(
-                probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ))
-        return (jnp.stack(ms), jnp.stack(ls), jnp.stack(accs))
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        # masked-everything rows: exp(s - m_new) with m_new still -inf
+        # would be exp(0); force explicit zeros
+        probs = jnp.where(s > _NEG_INF * 0.5, jnp.exp(s - m_new), 0.0)
+        l_new = l * alpha + jnp.sum(probs, -1, keepdims=True)
+        acc_new = acc * alpha + lax.dot_general(
+            probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, acc_new)
 
     def run():
         start_block(lax.rem(first_block, 2), first_block)
@@ -312,12 +325,7 @@ def _prefill_kernel(
     m, l, acc = lax.cond(
         num_blocks > first_block, run, lambda: (m0, l0, acc0)
     )
-    out = acc / jnp.maximum(l, 1e-30)  # [KV, TQ*G, D]
-    out_ref[0] = (
-        out.reshape(num_kv, TQ, G, D)
-        .transpose(1, 0, 2, 3)
-        .astype(out_ref.dtype)
-    )
+    out_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
 
 
 @functools.partial(
@@ -379,53 +387,81 @@ def paged_attention_prefill(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    qg = q.reshape(B, T, KV, G, D)
-    k_pages = pool_k.reshape(num_pages, page_size, KV, D)
-    v_pages = pool_v.reshape(num_pages, page_size, KV, D)
+    # heads per 128-lane chunk: pack small heads (D=64) in pairs so every
+    # DMA lane window and MXU operand is tile-aligned; D >= 128 chunks are
+    # a single head (no block-diagonal FLOP overhead at all). For
+    # geometries that cannot align (tiny test models, odd head counts) we
+    # still build the kernel — interpret mode runs anything, and on real
+    # TPU the engine's "auto" probe rejects what Mosaic rejects.
+    C = max(1, min(_LANES // D, KV))
+    while KV % C:
+        C -= 1
+    KVc = KV // C
+    CD = C * D
+    R = TQ * C * G  # rows per tile: (query t, chunk-local head cl, group g)
+
+    # Block-diagonal query expansion within each head chunk (plain XLA):
+    # row (t, cl, g) carries q[t, c*C+cl, g] in lanes [cl*D, (cl+1)*D).
+    eye = jnp.eye(C, dtype=q.dtype)
+    qbd = jnp.einsum(
+        "btkugd,uj->btkugjd",
+        q.reshape(B, T, KVc, C, G, D), eye,
+    )  # [B, T, KVc, C, G, C, D]
+    qbd = qbd.transpose(0, 2, 1, 3, 4, 5, 6).reshape(B, KVc, T * C * G, CD)
+    k_pages = pool_k.reshape(num_pages, page_size, KV * D)
+    v_pages = pool_v.reshape(num_pages, page_size, KV * D)
     tables = jnp.clip(page_tables.astype(jnp.int32), 0, num_pages - 1)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(B, T // TQ),
+        grid=(B, KVc, T // TQ),
         in_specs=[
-            pl.BlockSpec((1, TQ, KV, G, D),
-                         lambda b, qb, t, vl, qs: (b, qb, 0, 0, 0)),
+            pl.BlockSpec((1, 1, R, CD),
+                         lambda b, c, qb, t, vl, qs: (b, c, qb, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, TQ, KV, G, D),
-                               lambda b, qb, t, vl, qs: (b, qb, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, R, CD),
+                               lambda b, c, qb, t, vl, qs: (b, c, qb, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, PB, page_size, KV, D), pool_k.dtype),
-            pltpu.VMEM((2, PB, page_size, KV, D), pool_v.dtype),
+            pltpu.VMEM((2, PB, page_size, CD), pool_k.dtype),
+            pltpu.VMEM((2, PB, page_size, CD), pool_v.dtype),
             pltpu.SemaphoreType.DMA((2, PB)),
             pltpu.SemaphoreType.DMA((2, PB)),
         ],
     )
 
-    out = pl.pallas_call(
+    out_big = pl.pallas_call(
         functools.partial(
             _prefill_kernel,
             page_size=page_size,
             pages_per_block=PB,
             num_page_slots=P,
+            heads_per_chunk=C,
+            groups=G,
+            head_dim=D,
             sliding_window=sliding_window,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, T, KV, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KVc, T * C * G, CD), q.dtype),
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
-            flops=2 * B * H * T * P * page_size * D,
+            flops=2 * B * H * T * P * page_size * CD,
             bytes_accessed=2 * B * KV * P * page_size * D
             * pool_k.dtype.itemsize,
             transcendentals=B * H * T * P * page_size,
         ),
     )(
         tables, kv_valid_len.astype(jnp.int32), q_start.astype(jnp.int32),
-        qg, k_pages, v_pages,
+        qbd, k_pages, v_pages,
+    )
+    # extract each head's diagonal lane block
+    out = jnp.einsum(
+        "bktugjd,uj->btkugd",
+        out_big.reshape(B, KVc, T, C, G, C, D), eye,
     )
     return out.reshape(B, T, H, D)
 

@@ -261,6 +261,29 @@ class TestPrefillKernel:
         self._check(np.array([7, 3], np.int32),
                     np.array([8, 4], np.int32), T=1, q_block=1)
 
+    @pytest.mark.parametrize(
+        "H,KV,D",
+        [
+            (8, 4, 64),  # C=2 heads/chunk -> KVc=2: paired-head lanes
+            (8, 4, 128),  # C=1 -> KVc=4: one head per 128-lane chunk
+            (6, 3, 64),  # odd KV: C falls back to 1, KVc=3
+        ],
+    )
+    def test_multi_head_chunk_grid(self, H, KV, D):
+        """KVc > 1 exercises the (B, KVc, T/TQ) grid's chunk dimension —
+        the 128-aligned dynamic lane-window DMA and per-chunk qbd
+        expansion/extraction — which the default KV=2/D=16 cases (C=KV,
+        KVc=1, lane_lo always 0) never touch. This IS the production
+        geometry: head_dim-128 models run one head per chunk."""
+        self._check(np.array([0, 4], np.int32),
+                    np.array([32, 20], np.int32), H=H, KV=KV, D=D)
+
+    def test_multi_head_chunk_multi_tile(self):
+        # chunk grid x q-tile grid together (KVc=2, T/TQ=4)
+        self._check(np.array([8, 0], np.int32),
+                    np.array([40, 24], np.int32),
+                    q_block=8, H=8, KV=4, D=64)
+
     def test_paged_forward_prefill_pallas_matches_xla(self):
         # through the model layer: full prefill forward, both impls
         cfg = TINY
