@@ -322,7 +322,7 @@ def _prefill_kernel(
         start_block(lax.rem(first_block, 2), first_block)
         return lax.fori_loop(first_block, num_blocks, loop, (m0, l0, acc0))
 
-    m, l, acc = lax.cond(
+    _, l, acc = lax.cond(
         num_blocks > first_block, run, lambda: (m0, l0, acc0)
     )
     out_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
