@@ -751,6 +751,12 @@ class LLMEngine:
         the engine, not a standalone demo). None = CP unavailable."""
         if self.mesh is None or self.mesh.shape.get("seq", 1) <= 1:
             return None
+        if self.cfg.sliding_window_pattern or self.cfg.attn_logit_softcap:
+            # Gemma-2-class models skip CP: the CP attends would apply one
+            # uniform window to every layer (wrong for alternating
+            # local/global schedules) and have no score soft-capping —
+            # long prompts take the chunked-prefill path instead
+            return None
         if self.ecfg.cp_min_tokens is not None:
             return self.ecfg.cp_min_tokens
         return self.ecfg.prefill_buckets[-1] + 1
@@ -1005,6 +1011,7 @@ class LLMEngine:
             kv = max(1, cfg.num_kv_heads // tp)
             heads = max(1, cfg.num_heads // tp)
             window = cfg.sliding_window or 0
+            softcap = cfg.attn_logit_softcap or 0.0
             pool = jax.ShapeDtypeStruct(
                 (slots, kv, cfg.head_dim), self.dtype
             )
@@ -1017,7 +1024,7 @@ class LLMEngine:
                     ),
                     pool, pool, tables, valid,
                     page_size=pcfg.page_size, sliding_window=window,
-                    interpret=False,
+                    attn_softcap=softcap, interpret=False,
                 ),
             )
             for B, T in launches:
@@ -1030,7 +1037,7 @@ class LLMEngine:
                         ),
                         pool, pool, tables, valid, valid,
                         page_size=pcfg.page_size, sliding_window=window,
-                        interpret=False,
+                        attn_softcap=softcap, interpret=False,
                     ),
                 )
                 if not ok_prefill:
@@ -1701,6 +1708,10 @@ class LLMEngine:
         Turns per-sequence KV from O(length) into O(window)."""
         W = self.cfg.sliding_window
         if not W or not seq.block_table:
+            return
+        if self.cfg.sliding_window_pattern:
+            # Gemma-2-style alternating layers: the GLOBAL layers still
+            # attend the full history, so no page is ever dead
             return
         ps = self.pcfg.page_size
         sentinel = self.pcfg.num_pages

@@ -50,8 +50,42 @@ class ModelConfig:
     # Mistral-style sliding-window attention: each token attends the last
     # `sliding_window` positions only; None = full causal
     sliding_window: Optional[int] = None
+    # Gemma-2-style alternating attention: when set (e.g. 2), only layers
+    # with index % pattern == 0 use the sliding window; the rest are full
+    # causal. None = the window (if any) applies to every layer.
+    sliding_window_pattern: Optional[int] = None
     # Qwen2-style additive bias on the q/k/v projections
     attention_bias: bool = False
+    # MLP activation: "silu" (Llama SwiGLU) or "gelu_tanh" (Gemma GeGLU)
+    activation: str = "silu"
+    # Gemma-2 sandwich norms: extra RMSNorms on the attention and MLP
+    # OUTPUTS (post_attention / post_feedforward), alongside the usual
+    # pre-norms
+    sandwich_norms: bool = False
+    # Gemma logit soft-capping: logits = tanh(x / cap) * cap
+    final_logit_softcap: Optional[float] = None
+    # ... and the same applied to attention scores pre-softmax
+    attn_logit_softcap: Optional[float] = None
+    # Gemma attention-scale override: scores scale by
+    # 1/sqrt(query_pre_attn_scalar) instead of 1/sqrt(head_dim)
+    query_pre_attn_scalar: Optional[float] = None
+    # Gemma scales embeddings by sqrt(hidden_size) on input
+    scale_embeddings: bool = False
+
+    def layer_windows(self) -> Tuple[int, ...]:
+        """Per-layer sliding windows (0 = full causal) — the alternating
+        local/global schedule of Gemma-2 under `sliding_window_pattern`,
+        uniform otherwise. HF convention: sliding layers are those with
+        index % pattern == 0 (Gemma-2: even layers slide)."""
+        if not self.sliding_window:
+            return (0,) * self.num_layers
+        if not self.sliding_window_pattern:
+            return (self.sliding_window,) * self.num_layers
+        p = self.sliding_window_pattern
+        return tuple(
+            self.sliding_window if i % p == 0 else 0
+            for i in range(self.num_layers)
+        )
 
     @property
     def q_size(self) -> int:
@@ -159,6 +193,29 @@ QWEN2_7B = ModelConfig(
     attention_bias=True,
 )
 
+GEMMA2_9B = ModelConfig(
+    name="gemma2-9b",
+    vocab_size=256000,
+    hidden_size=3584,
+    intermediate_size=14336,
+    num_layers=42,
+    num_heads=16,
+    num_kv_heads=8,
+    head_dim=256,
+    rms_norm_eps=1e-6,
+    rope_theta=10000.0,
+    tie_word_embeddings=True,
+    max_position_embeddings=8192,
+    sliding_window=4096,
+    sliding_window_pattern=2,
+    activation="gelu_tanh",
+    sandwich_norms=True,
+    final_logit_softcap=30.0,
+    attn_logit_softcap=50.0,
+    query_pre_attn_scalar=256.0,
+    scale_embeddings=True,
+)
+
 # Tiny configs for tests: small enough to run on the CPU backend in ms.
 TINY = ModelConfig(
     name="tiny",
@@ -177,11 +234,23 @@ TINY = ModelConfig(
 TINY_MOE = TINY.with_overrides(name="tiny-moe", num_experts=4, num_experts_per_tok=2)
 TINY_SWA = TINY.with_overrides(name="tiny-swa", sliding_window=8)
 TINY_BIAS = TINY.with_overrides(name="tiny-bias", attention_bias=True)
+TINY_GEMMA2 = TINY.with_overrides(
+    name="tiny-gemma2",
+    sliding_window=8,
+    sliding_window_pattern=2,
+    activation="gelu_tanh",
+    sandwich_norms=True,
+    final_logit_softcap=30.0,
+    attn_logit_softcap=50.0,
+    query_pre_attn_scalar=24.0,  # deliberately != head_dim
+    scale_embeddings=True,
+)
 
 PRESETS = {
     c.name: c
     for c in (LLAMA_3_2_1B, LLAMA_3_8B, LLAMA_3_70B, MIXTRAL_8X7B,
-              MISTRAL_7B, QWEN2_7B, TINY, TINY_MOE, TINY_SWA, TINY_BIAS)
+              MISTRAL_7B, QWEN2_7B, GEMMA2_9B, TINY, TINY_MOE, TINY_SWA,
+              TINY_BIAS, TINY_GEMMA2)
 }
 
 

@@ -62,6 +62,11 @@ def init_params(
         "wo": w(keys[3], (L, cfg.q_size, H)),
         "mlp_norm": jnp.ones((L, H), dtype),
     }
+    if cfg.sandwich_norms:  # Gemma-2 post-attention / post-MLP norms
+        layers.update(
+            post_attn_norm=jnp.ones((L, H), dtype),
+            post_mlp_norm=jnp.ones((L, H), dtype),
+        )
     if cfg.attention_bias:  # Qwen2-style q/k/v projection bias
         layers.update(
             bq=w(keys[10], (L, cfg.q_size)),
@@ -144,9 +149,16 @@ def _dq(w, dtype):
     return dense_view(w, dtype)
 
 
-def _mlp(h: jnp.ndarray, layer: Dict[str, jnp.ndarray]) -> jnp.ndarray:
-    """SwiGLU MLP: down( silu(gate(x)) * up(x) )."""
-    gate = jax.nn.silu(_mm(h, layer["w_gate"]))
+def _act(x: jnp.ndarray, activation: str) -> jnp.ndarray:
+    if activation == "gelu_tanh":  # Gemma GeGLU (HF gelu_pytorch_tanh)
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _mlp(h: jnp.ndarray, layer: Dict[str, jnp.ndarray],
+         activation: str = "silu") -> jnp.ndarray:
+    """Gated MLP: down( act(gate(x)) * up(x) ) — SwiGLU or GeGLU."""
+    gate = _act(_mm(h, layer["w_gate"]), activation)
     up = _mm(h, layer["w_up"])
     return _mm(gate * up, layer["w_down"])
 
@@ -216,7 +228,8 @@ def _run_layers(
 
     The cache backend is pluggable: ``write_fn(cache_layer, new_kv) ->
     cache_layer`` scatters the new tokens' K/V into one layer's cache;
-    ``attend_fn(q, k_layer, v_layer) -> out`` runs attention against it.
+    ``attend_fn(q, k_layer, v_layer, window) -> out`` runs attention
+    against it (``window`` = the layer's sliding window, 0 = full causal).
     Dense (contiguous) and paged backends both route through here, so the
     block body exists exactly once.
 
@@ -224,15 +237,23 @@ def _run_layers(
     """
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     h = params["embed"][input_ids]  # [B, T, H]
+    if cfg.scale_embeddings:  # Gemma: embeddings scale by sqrt(hidden)
+        h = h * jnp.asarray(cfg.hidden_size**0.5, h.dtype)
+    # per-layer sliding windows ride the scan as data (0 = full causal),
+    # so Gemma-2's alternating local/global layers share ONE compiled
+    # block body — no per-layer recompilation, no unrolled scan
+    windows = jnp.asarray(cfg.layer_windows(), jnp.int32)
 
     def block(h, xs):
-        layer, k_layer, v_layer = xs
+        layer, k_layer, v_layer, window = xs
         return layer_block(
             cfg, layer, h, positions, k_layer, v_layer, write_fn, attend_fn,
-            inv_freq, moe_impl, valid_tokens,
+            inv_freq, moe_impl, valid_tokens, window=window,
         )
 
-    h, (new_k, new_v) = lax.scan(block, h, (params["layers"], cache_k, cache_v))
+    h, (new_k, new_v) = lax.scan(
+        block, h, (params["layers"], cache_k, cache_v, windows)
+    )
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     return h, new_k, new_v
 
@@ -249,10 +270,15 @@ def layer_block(
     inv_freq: jnp.ndarray,
     moe_impl: str = "dense",
     valid_tokens: Optional[jnp.ndarray] = None,
+    window=0,
 ):
     """One transformer block (attention + MLP/MoE) against one layer's
     cache — the scan body of ``_run_layers``, exposed so the pipeline-
-    parallel runner (parallel/pp.py) can drive per-stage layer stacks."""
+    parallel runner (parallel/pp.py) can drive per-stage layer stacks.
+
+    ``window`` is this layer's sliding window (0 = full causal; may be a
+    traced scalar riding the layer scan) and is handed to ``attend_fn``
+    as its fourth argument."""
     B, T, _ = h.shape
     x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
     q, k, v = _mm(x, layer["wq"]), _mm(x, layer["wk"]), _mm(x, layer["wv"])
@@ -263,22 +289,42 @@ def layer_block(
     v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
+    if cfg.query_pre_attn_scalar is not None:
+        # Gemma attention-scale override: backends scale by 1/sqrt(D), so
+        # pre-scaling q by sqrt(D/scalar) nets 1/sqrt(query_pre_attn_scalar)
+        q = q * jnp.asarray(
+            (cfg.head_dim / cfg.query_pre_attn_scalar) ** 0.5, q.dtype
+        )
     k_layer = write_fn(k_layer, k)
     v_layer = write_fn(v_layer, v)
-    attn = attend_fn(q, k_layer, v_layer)
-    h = h + _mm(attn.reshape(B, T, cfg.q_size), layer["wo"])
+    attn = attend_fn(q, k_layer, v_layer, window)
+    attn_out = _mm(attn.reshape(B, T, cfg.q_size), layer["wo"])
+    if cfg.sandwich_norms:
+        attn_out = rms_norm(
+            attn_out, layer["post_attn_norm"], cfg.rms_norm_eps
+        )
+    h = h + attn_out
     x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-    h = h + (
+    mlp_out = (
         _moe(x, layer, cfg, moe_impl, valid_tokens)
         if cfg.is_moe
-        else _mlp(x, layer)
+        else _mlp(x, layer, cfg.activation)
     )
+    if cfg.sandwich_norms:
+        mlp_out = rms_norm(mlp_out, layer["post_mlp_norm"], cfg.rms_norm_eps)
+    h = h + mlp_out
     return h, (k_layer, v_layer)
 
 
 def _unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
     unembed = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return jnp.einsum("bth,hv->btv", h, unembed, preferred_element_type=jnp.float32)
+    logits = jnp.einsum(
+        "bth,hv->btv", h, unembed, preferred_element_type=jnp.float32
+    )
+    if cfg.final_logit_softcap is not None:  # Gemma logit soft-capping
+        cap = cfg.final_logit_softcap
+        logits = jnp.tanh(logits / cap) * cap
+    return logits
 
 
 def forward(
@@ -304,8 +350,8 @@ def forward(
     Returns: (logits [B, T, vocab] f32, updated cache).
     """
     write_fn = lambda layer, new: _write_kv(layer, new, write_pos)
-    attend_fn = lambda q, k, v: gqa_attention(
-        q, k, v, positions, kv_valid_len, cfg.sliding_window)
+    attend_fn = lambda q, k, v, w: gqa_attention(
+        q, k, v, positions, kv_valid_len, w, cfg.attn_logit_softcap)
     h, new_k, new_v = _run_layers(
         params, cfg, input_ids, positions, cache.k, cache.v, write_fn,
         attend_fn, moe_impl=moe_impl,
@@ -368,24 +414,26 @@ def paged_forward(
         if page_size <= 0:
             raise ValueError("attention_impl='pallas' requires page_size")
         decode_step = input_ids.shape[1] == 1
-        window = cfg.sliding_window or 0
+        softcap = cfg.attn_logit_softcap or 0.0
         # gather_slots rows are table[p]*page_size + offset by construction
         page_tables = gather_slots[:, ::page_size] // page_size
 
         if decode_step:
 
-            def _attend_pallas(q3, k_layer, v_layer, tables, valid):
+            def _attend_pallas(q3, k_layer, v_layer, tables, valid, w):
                 return paged_attention_decode(
                     q3, k_layer, v_layer, tables, valid,
-                    page_size=page_size, sliding_window=window,
+                    page_size=page_size, sliding_window=w,
+                    attn_softcap=softcap,
                 )
         else:
             q_start = positions[:, 0]
 
-            def _attend_pallas(q4, k_layer, v_layer, tables, valid):
+            def _attend_pallas(q4, k_layer, v_layer, tables, valid, w):
                 return paged_attention_prefill(
                     q4, k_layer, v_layer, tables, q_start, valid,
-                    page_size=page_size, sliding_window=window,
+                    page_size=page_size, sliding_window=w,
+                    attn_softcap=softcap,
                 )
 
         if mesh is not None and mesh.shape.get("tensor", 1) > 1:
@@ -405,6 +453,7 @@ def paged_forward(
                     P(None, "tensor", None),
                     P("data", None),  # page tables [B, P]
                     P("data"),  # kv_valid_len [B]
+                    P(),  # this layer's sliding window (replicated scalar)
                 ),
                 out_specs=q_spec,
                 check_vma=False,
@@ -414,20 +463,21 @@ def paged_forward(
         # layer: [num_slots, KV, D]; new: [B, T, KV, D]
         return layer.at[write_slots].set(new, mode="drop")
 
-    def attend_fn(q, k_layer, v_layer):
+    def attend_fn(q, k_layer, v_layer, window):
         if use_pallas:
             if decode_step:
                 out = _attend_pallas(
-                    q[:, 0], k_layer, v_layer, page_tables, kv_valid_len
+                    q[:, 0], k_layer, v_layer, page_tables, kv_valid_len,
+                    window,
                 )
                 return out[:, None]
             return _attend_pallas(
-                q, k_layer, v_layer, page_tables, kv_valid_len
+                q, k_layer, v_layer, page_tables, kv_valid_len, window
             )
         k_seq = k_layer[gather_slots]  # [B, S_max, KV, D]
         v_seq = v_layer[gather_slots]
         return gqa_attention(q, k_seq, v_seq, positions, kv_valid_len,
-                             cfg.sliding_window)
+                             window, cfg.attn_logit_softcap)
 
     h, new_k, new_v = _run_layers(
         params, cfg, input_ids, positions, pool_k, pool_v, write_fn,
@@ -450,8 +500,8 @@ def hidden_states(
     B, T = input_ids.shape
     cache = KVCache.create(cfg, B, T, dtype=params["embed"].dtype)
     write_fn = lambda layer, new: _write_kv(layer, new, positions)
-    attend_fn = lambda q, k, v: gqa_attention(
-        q, k, v, positions, kv_valid_len, cfg.sliding_window)
+    attend_fn = lambda q, k, v, w: gqa_attention(
+        q, k, v, positions, kv_valid_len, w, cfg.attn_logit_softcap)
     h, _, _ = _run_layers(
         params, cfg, input_ids, positions, cache.k, cache.v, write_fn, attend_fn
     )

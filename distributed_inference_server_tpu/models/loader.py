@@ -66,6 +66,14 @@ def params_from_hf_state_dict(
             rows.append(w.T if transpose else w)
         return jnp.asarray(np.stack(rows), dtype=dtype)
 
+    # Gemma stores RMSNorm weights as offsets from 1 (applied as
+    # x_norm * (1 + w)); our rms_norm multiplies by the weight directly,
+    # so unit-offset checkpoints get +1 folded in at load time.
+    unit_offset = cfg.sandwich_norms
+
+    def norm(x: np.ndarray) -> np.ndarray:
+        return x + 1.0 if unit_offset else x
+
     layers: Dict[str, jnp.ndarray] = {}
     if cfg.attention_bias:  # Qwen2-style q/k/v bias
         for ours, suffix in (
@@ -89,11 +97,32 @@ def params_from_hf_state_dict(
     else:
         for ours, suffix, t in _LAYER_MAP:
             layers[ours] = stack(suffix, t)
+    if cfg.sandwich_norms:  # Gemma-2: pre/post norms around both sublayers
+        # HF Gemma-2 names: input_layernorm (pre-attn, already mapped to
+        # attn_norm), post_attention_layernorm (attn OUTPUT norm),
+        # pre_feedforward_layernorm (pre-MLP), post_feedforward_layernorm
+        # (MLP output norm) — remap mlp_norm to the pre-MLP one.
+        layers["post_attn_norm"] = stack(
+            "post_attention_layernorm.weight", False
+        )
+        layers["mlp_norm"] = stack("pre_feedforward_layernorm.weight", False)
+        layers["post_mlp_norm"] = stack(
+            "post_feedforward_layernorm.weight", False
+        )
+    if unit_offset:
+        for k in ("attn_norm", "mlp_norm", "post_attn_norm",
+                  "post_mlp_norm"):
+            if k in layers:
+                layers[k] = jnp.asarray(
+                    norm(np.asarray(layers[k], np.float32)), dtype=dtype
+                )
 
     params: Dict[str, Any] = {
         "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype=dtype),
         "layers": layers,
-        "final_norm": jnp.asarray(get("model.norm.weight"), dtype=dtype),
+        "final_norm": jnp.asarray(
+            norm(get("model.norm.weight").astype(np.float32)), dtype=dtype
+        ),
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype=dtype)
@@ -102,6 +131,13 @@ def params_from_hf_state_dict(
 
 def config_from_hf_json(obj: Mapping[str, Any], name: str = "hf") -> ModelConfig:
     """Build a ModelConfig from an HF ``config.json`` dict."""
+    if obj.get("model_type") == "gemma":
+        # Gemma-1 stores unit-offset norm weights like Gemma-2 but the
+        # loader keys the +1 fold on sandwich_norms (gemma2-only); refuse
+        # loudly rather than produce silently wrong weights
+        raise ModelLoadError(
+            "Gemma-1 checkpoints are not supported (Gemma-2 is)"
+        )
     rope_scaling = None
     rs = obj.get("rope_scaling")
     if rs and rs.get("rope_type", rs.get("type")) == "llama3":
@@ -144,6 +180,30 @@ def config_from_hf_json(obj: Mapping[str, Any], name: str = "hf") -> ModelConfig
             obj.get("attention_bias", obj.get("qkv_bias",
                     obj.get("model_type") == "qwen2"))
         ),
+        # Gemma-2 architecture switches
+        sliding_window_pattern=(
+            2 if obj.get("model_type") == "gemma2"
+            and obj.get("sliding_window") else None
+        ),
+        activation=(
+            "gelu_tanh"
+            if obj.get("hidden_activation", obj.get("hidden_act"))
+            in ("gelu_pytorch_tanh", "gelu_tanh") else "silu"
+        ),
+        sandwich_norms=obj.get("model_type") == "gemma2",
+        final_logit_softcap=(
+            float(obj["final_logit_softcapping"])
+            if obj.get("final_logit_softcapping") else None
+        ),
+        attn_logit_softcap=(
+            float(obj["attn_logit_softcapping"])
+            if obj.get("attn_logit_softcapping") else None
+        ),
+        query_pre_attn_scalar=(
+            float(obj["query_pre_attn_scalar"])
+            if obj.get("query_pre_attn_scalar") else None
+        ),
+        scale_embeddings=obj.get("model_type") == "gemma2",
     )
 
 

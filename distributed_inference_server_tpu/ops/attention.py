@@ -24,7 +24,8 @@ def gqa_attention(
     v_cache: jnp.ndarray,
     q_positions: jnp.ndarray,
     kv_valid_len: jnp.ndarray,
-    sliding_window: Optional[int] = None,
+    sliding_window=None,
+    attn_softcap: Optional[float] = None,
 ) -> jnp.ndarray:
     """Causal GQA attention of new queries against a contiguous KV cache.
 
@@ -37,7 +38,11 @@ def gqa_attention(
         downstream.
       kv_valid_len: [B] number of valid cache slots per row.
       sliding_window: Mistral-style window — each query attends only the
-        last ``sliding_window`` positions (None = full causal).
+        last ``sliding_window`` positions. None = full causal. May be a
+        TRACED int scalar (Gemma-2 per-layer windows flow through the
+        layer scan), where <= 0 means full causal.
+      attn_softcap: Gemma-2 score soft-capping — scores pass through
+        ``tanh(s / cap) * cap`` before masking (None = off; static).
 
     Returns: [B, T, H, D] attention outputs in q.dtype.
     """
@@ -51,14 +56,20 @@ def gqa_attention(
         "btkgd,bskd->bkgts", qg, k_cache, preferred_element_type=jnp.float32
     )
     scores = scores * (1.0 / jnp.sqrt(D).astype(jnp.float32))
+    if attn_softcap is not None:
+        scores = jnp.tanh(scores / attn_softcap) * attn_softcap
 
     kv_pos = jnp.arange(S)
     causal = kv_pos[None, None, :] <= q_positions[:, :, None]  # [B, T, S]
     valid = kv_pos[None, None, :] < kv_valid_len[:, None, None]  # [B, 1->T, S]
-    window_ok = causal if sliding_window is None else (
-        causal & (kv_pos[None, None, :]
-                  > q_positions[:, :, None] - sliding_window)
-    )
+    if sliding_window is None:
+        window_ok = causal
+    else:
+        w = jnp.asarray(sliding_window, jnp.int32)
+        window_ok = causal & (
+            (w <= 0)
+            | (kv_pos[None, None, :] > q_positions[:, :, None] - w)
+        )
     mask = (window_ok & valid)[:, None, None, :, :]  # [B, 1, 1, T, S]
 
     scores = jnp.where(mask, scores, _NEG_INF)

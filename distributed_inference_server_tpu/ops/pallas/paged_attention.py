@@ -61,6 +61,8 @@ def _decode_kernel(
     # scalar-prefetch refs (SMEM)
     tables_ref,  # [B, P] page id per (row, page-slot)
     valid_ref,  # [B] valid token count per row
+    window_ref,  # [1] sliding window (0 = full causal; runtime so Gemma-2
+    #              per-layer windows flow through one compiled program)
     # tensor refs
     qbd_ref,  # [1, H, KV*D] this row's BLOCK-DIAGONAL query (VMEM)
     k_hbm,  # [num_pages, page_size, KV*D] full K pool (HBM)
@@ -79,7 +81,7 @@ def _decode_kernel(
     pages_per_block: int,
     num_page_slots: int,
     head_dim: int,
-    sliding_window: int = 0,
+    attn_softcap: float = 0.0,
 ):
     """v3 body: block-diagonal GQA — every shape Mosaic-tile-aligned.
 
@@ -98,9 +100,11 @@ def _decode_kernel(
     valid = valid_ref[b]
     num_blocks = lax.div(valid + blk_tokens - 1, blk_tokens)
     # sliding window: the decode query sits at position valid-1, so only
-    # tokens >= valid - window are attended; skip whole blocks below it
-    win_lo = jnp.maximum(valid - sliding_window, 0) if sliding_window else 0
-    first_block = lax.div(win_lo, blk_tokens) if sliding_window else 0
+    # tokens >= valid - window are attended; skip whole blocks below it.
+    # win_lo stays 0 for full-causal layers, making the mask a no-op.
+    w = window_ref[0]
+    win_lo = jnp.where(w > 0, jnp.maximum(valid - w, 0), 0)
+    first_block = lax.div(win_lo, blk_tokens)
 
     m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
     l_ref[:] = jnp.zeros_like(l_ref)
@@ -156,10 +160,10 @@ def _decode_kernel(
                 qbd.astype(k.dtype), k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            if attn_softcap:
+                s = jnp.tanh(s * (1.0 / attn_softcap)) * attn_softcap
             token_ids = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            ok = token_ids < valid
-            if sliding_window:
-                ok &= token_ids >= win_lo
+            ok = (token_ids < valid) & (token_ids >= win_lo)
             s = jnp.where(ok, s, _NEG_INF)
 
             m_prev = m_ref[:, :1]  # [H, 1]
@@ -191,6 +195,7 @@ def _prefill_kernel(
     tables_ref,  # [B, P] page id per (row, page-slot)
     valid_ref,  # [B] valid token count per row (incl. this chunk)
     qstart_ref,  # [B] global position of the chunk's first query
+    window_ref,  # [1] sliding window (0 = full causal; runtime scalar)
     # tensor refs
     qbd_ref,  # [1, 1, R, CD] this (row, head-chunk, q-block)'s
     #           block-diagonal query tile (VMEM); R = TQ*C*G
@@ -209,7 +214,7 @@ def _prefill_kernel(
     heads_per_chunk: int,
     groups: int,
     head_dim: int,
-    sliding_window: int = 0,
+    attn_softcap: float = 0.0,
 ):
     """v3 body: like the decode kernel, every shape is tile-aligned by
     folding heads into 128-lane chunks (C = 128/D heads per chunk; C = 1
@@ -234,11 +239,14 @@ def _prefill_kernel(
     kv_upper = jnp.minimum(valid, q_base + TQ)
     num_blocks = lax.div(kv_upper + blk_tokens - 1, blk_tokens)
     # sliding window: no query in this tile sees anything before
-    # q_base - window + 1, so whole blocks below it are skipped
-    first_block = (
-        lax.div(jnp.maximum(q_base - sliding_window + 1, 0), blk_tokens)
-        if sliding_window else 0
+    # q_base - window + 1, so whole blocks below it are skipped. The
+    # window is a runtime scalar (0 = full causal -> first_block 0 and an
+    # effectively-infinite mask window).
+    w = window_ref[0]
+    first_block = lax.div(
+        jnp.where(w > 0, jnp.maximum(q_base - w + 1, 0), 0), blk_tokens
     )
+    eff_w = jnp.where(w > 0, w, jnp.int32(2**30))
 
     lane_lo = c * CD  # this head-chunk's 128-aligned lane window
 
@@ -292,8 +300,7 @@ def _prefill_kernel(
             jnp.int32, (R, blk_tokens), 1
         )
         mask = (kv_idx <= q_pos) & (kv_idx < valid)
-        if sliding_window:
-            mask &= kv_idx > q_pos - sliding_window
+        mask &= kv_idx > q_pos - eff_w
 
         k = k_buf[slot].reshape(blk_tokens, CD)
         v = v_buf[slot].reshape(blk_tokens, CD)
@@ -303,6 +310,8 @@ def _prefill_kernel(
             qbd.astype(k.dtype), k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if attn_softcap:
+            s = jnp.tanh(s * (1.0 / attn_softcap)) * attn_softcap
         s = jnp.where(mask, s, _NEG_INF)
 
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -331,7 +340,7 @@ def _prefill_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=("page_size", "q_block", "pages_per_block", "interpret",
-                     "sliding_window"),
+                     "attn_softcap"),
 )
 def paged_attention_prefill(
     q: jnp.ndarray,
@@ -345,7 +354,8 @@ def paged_attention_prefill(
     q_block: int = 128,
     pages_per_block: int = 8,
     interpret: bool | None = None,
-    sliding_window: int = 0,
+    sliding_window=0,
+    attn_softcap: float = 0.0,
 ) -> jnp.ndarray:
     """Chunked-prefill paged GQA attention against the flat page pool.
 
@@ -413,16 +423,16 @@ def paged_attention_prefill(
     tables = jnp.clip(page_tables.astype(jnp.int32), 0, num_pages - 1)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B, KVc, T // TQ),
         in_specs=[
             pl.BlockSpec((1, 1, R, CD),
-                         lambda b, c, qb, t, vl, qs: (b, c, qb, 0)),
+                         lambda b, c, qb, t, vl, qs, w: (b, c, qb, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, 1, R, CD),
-                               lambda b, c, qb, t, vl, qs: (b, c, qb, 0)),
+                               lambda b, c, qb, t, vl, qs, w: (b, c, qb, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, PB, page_size, CD), pool_k.dtype),
             pltpu.VMEM((2, PB, page_size, CD), pool_v.dtype),
@@ -440,7 +450,7 @@ def paged_attention_prefill(
             heads_per_chunk=C,
             groups=G,
             head_dim=D,
-            sliding_window=sliding_window,
+            attn_softcap=attn_softcap,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KVc, T * C * G, CD), q.dtype),
@@ -456,6 +466,7 @@ def paged_attention_prefill(
         ),
     )(
         tables, kv_valid_len.astype(jnp.int32), q_start.astype(jnp.int32),
+        jnp.asarray(sliding_window, jnp.int32).reshape(1),
         qbd, k_pages, v_pages,
     )
     # extract each head's diagonal lane block
@@ -469,7 +480,7 @@ def paged_attention_prefill(
 @functools.partial(
     jax.jit,
     static_argnames=("page_size", "pages_per_block", "interpret",
-                     "sliding_window"),
+                     "attn_softcap"),
 )
 def paged_attention_decode(
     q: jnp.ndarray,
@@ -481,7 +492,8 @@ def paged_attention_decode(
     page_size: int,
     pages_per_block: int = 8,
     interpret: bool | None = None,
-    sliding_window: int = 0,
+    sliding_window=0,
+    attn_softcap: float = 0.0,
 ) -> jnp.ndarray:
     """Decode-step paged GQA attention against the flat page pool.
 
@@ -498,6 +510,10 @@ def paged_attention_decode(
         double-buffered block size; tune for DMA/compute overlap).
       interpret: force Pallas interpret mode; defaults to True off-TPU so
         tests run on the CPU backend.
+      sliding_window: attend only the last N positions (0 = full causal).
+        May be a TRACED scalar — Gemma-2's per-layer windows flow through
+        one compiled program via scalar prefetch.
+      attn_softcap: Gemma-2 score soft-capping tanh(s/cap)*cap (0 = off).
 
     Returns: [B, H, D] attention outputs in q.dtype.
     """
@@ -524,14 +540,14 @@ def paged_attention_decode(
     tables = jnp.clip(page_tables.astype(jnp.int32), 0, num_pages - 1)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, H, CD), lambda b, t, vl: (b, 0, 0)),
+            pl.BlockSpec((1, H, CD), lambda b, t, vl, w: (b, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),  # K pool stays in HBM
             pl.BlockSpec(memory_space=pl.ANY),  # V pool stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, H, CD), lambda b, t, vl: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, H, CD), lambda b, t, vl, w: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, PB, page_size, CD), pool_k.dtype),
             pltpu.VMEM((2, PB, page_size, CD), pool_v.dtype),
@@ -550,7 +566,7 @@ def paged_attention_decode(
             pages_per_block=PB,
             num_page_slots=P,
             head_dim=D,
-            sliding_window=sliding_window,
+            attn_softcap=attn_softcap,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, CD), q.dtype),
@@ -566,7 +582,9 @@ def paged_attention_decode(
             * pool_k.dtype.itemsize,
             transcendentals=B * H * P * page_size,
         ),
-    )(tables, kv_valid_len.astype(jnp.int32), qbd, k_pages, v_pages)
+    )(tables, kv_valid_len.astype(jnp.int32),
+      jnp.asarray(sliding_window, jnp.int32).reshape(1),
+      qbd, k_pages, v_pages)
     # extract each head's diagonal lane block (the rest is cross-head
     # garbage by construction)
     out = jnp.einsum(

@@ -69,14 +69,17 @@ def cp_prefill(
             ulysses_attention_sharded,
         )
 
-        def attend(q, k_layer, v_layer):
+        def attend(q, k_layer, v_layer, w):
+            # uniform-window models only: the engine gates alternating-
+            # window (pattern) models off the CP path, so cfg.sliding_window
+            # is the per-layer truth here (w is the same value, traced)
             return ulysses_attention_sharded(
                 mesh, q, k_layer, v_layer, positions, valid_len,
                 sliding_window=cfg.sliding_window,
             )
     else:
 
-        def attend(q, k_layer, v_layer):
+        def attend(q, k_layer, v_layer, w):
             return ring_attention_sharded(
                 mesh, q, k_layer, v_layer, positions, positions,
                 sliding_window=cfg.sliding_window,

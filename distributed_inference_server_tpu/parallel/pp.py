@@ -84,18 +84,29 @@ def pp_forward(
         # local views: layers/ck/cv hold this stage's L/S layers
         stage = lax.axis_index("stage")
 
+        # this stage's slice of the per-layer sliding windows (0 = full
+        # causal) — Gemma-2-style alternating layers keep their schedule
+        # across stage boundaries
+        L_stage = layers["attn_norm"].shape[0]
+        win_stage = jnp.asarray(cfg.layer_windows(), jnp.int32).reshape(
+            -1, L_stage
+        )[stage]
+
         def run_stage(h_mb, pos_mb, ck_mb, cv_mb, wp_mb, kvv_mb):
             write_fn = lambda layer, new: llama._write_kv(layer, new, wp_mb)
-            attend_fn = lambda q, k, v: gqa_attention(q, k, v, pos_mb, kvv_mb)
+            attend_fn = lambda q, k, v, w: gqa_attention(
+                q, k, v, pos_mb, kvv_mb, w, cfg.attn_logit_softcap)
 
             def blk(h, xs):
-                layer, k_l, v_l = xs
+                layer, k_l, v_l, w = xs
                 return llama.layer_block(
                     cfg, layer, h, pos_mb, k_l, v_l, write_fn, attend_fn,
-                    inv_freq,
+                    inv_freq, window=w,
                 )
 
-            h_mb, (nk, nv) = lax.scan(blk, h_mb, (layers, ck_mb, cv_mb))
+            h_mb, (nk, nv) = lax.scan(
+                blk, h_mb, (layers, ck_mb, cv_mb, win_stage)
+            )
             return h_mb, nk, nv
 
         def tick(t, carry):
@@ -112,7 +123,12 @@ def pp_forward(
             # invalid ticks (pipeline bubble) must not mutate the cache
             wp_eff = jnp.where(valid, wp_mb, Smax)
 
-            h_in = jnp.where(stage == 0, embed[ids_mb], state)
+            h_emb = embed[ids_mb]
+            if cfg.scale_embeddings:  # Gemma: sqrt(hidden) on input
+                h_emb = h_emb * jnp.asarray(
+                    cfg.hidden_size**0.5, h_emb.dtype
+                )
+            h_in = jnp.where(stage == 0, h_emb, state)
             h_out, nk, nv = run_stage(h_in, pos_mb, ck_mb, cv_mb, wp_eff,
                                       kvv_mb)
             ck = lax.dynamic_update_slice_in_dim(ck, nk, row, 1)
@@ -146,6 +162,9 @@ def pp_forward(
         logits = jnp.einsum(
             "bth,hv->btv", h, unembed, preferred_element_type=jnp.float32
         )
+        if cfg.final_logit_softcap is not None:  # Gemma soft-capping
+            cap = cfg.final_logit_softcap
+            logits = jnp.tanh(logits / cap) * cap
         return logits, ck, cv
 
     unembed = (
@@ -215,24 +234,32 @@ def pp_paged_forward(
              kvv):
         stage = lax.axis_index("stage")
 
+        L_stage = layers["attn_norm"].shape[0]
+        win_stage = jnp.asarray(cfg.layer_windows(), jnp.int32).reshape(
+            -1, L_stage
+        )[stage]
+
         def run_stage(h_mb, pos_mb, pk, pv, ws_mb, gs_mb, kvv_mb):
             write_fn = lambda layer, new: layer.at[ws_mb].set(
                 new, mode="drop"
             )
 
-            def attend_fn(q, k_layer, v_layer):
+            def attend_fn(q, k_layer, v_layer, w):
                 k_seq = k_layer[gs_mb]
                 v_seq = v_layer[gs_mb]
-                return gqa_attention(q, k_seq, v_seq, pos_mb, kvv_mb)
+                return gqa_attention(q, k_seq, v_seq, pos_mb, kvv_mb, w,
+                                     cfg.attn_logit_softcap)
 
             def blk(h, xs):
-                layer, k_l, v_l = xs
+                layer, k_l, v_l, w = xs
                 return llama.layer_block(
                     cfg, layer, h, pos_mb, k_l, v_l, write_fn, attend_fn,
-                    inv_freq,
+                    inv_freq, window=w,
                 )
 
-            h_mb, (nk, nv) = lax.scan(blk, h_mb, (layers, pk, pv))
+            h_mb, (nk, nv) = lax.scan(
+                blk, h_mb, (layers, pk, pv, win_stage)
+            )
             return h_mb, nk, nv
 
         def tick(t, carry):
@@ -248,7 +275,12 @@ def pp_paged_forward(
             # bubble ticks must not mutate the pool
             ws_eff = jnp.where(valid, ws_mb, num_slots)
 
-            h_in = jnp.where(stage == 0, embed[ids_mb], state)
+            h_emb = embed[ids_mb]
+            if cfg.scale_embeddings:  # Gemma: sqrt(hidden) on input
+                h_emb = h_emb * jnp.asarray(
+                    cfg.hidden_size**0.5, h_emb.dtype
+                )
+            h_in = jnp.where(stage == 0, h_emb, state)
             h_out, pk, pv = run_stage(h_in, pos_mb, pk, pv, ws_eff, gs_mb,
                                       kvv_mb)
 
@@ -277,6 +309,9 @@ def pp_paged_forward(
         logits = jnp.einsum(
             "bth,hv->btv", h, unembed, preferred_element_type=jnp.float32
         )
+        if cfg.final_logit_softcap is not None:  # Gemma soft-capping
+            cap = cfg.final_logit_softcap
+            logits = jnp.tanh(logits / cap) * cap
         return logits, pk, pv
 
     unembed = (

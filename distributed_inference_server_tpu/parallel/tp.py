@@ -68,6 +68,12 @@ def llama_param_specs(
         "wo": P(st, "tensor", None),
         "mlp_norm": P(st, None),
     }
+    if cfg.sandwich_norms:
+        # Gemma-2 output norms: [L, H] replicated like the pre-norms
+        layers.update(
+            post_attn_norm=P(st, None),
+            post_mlp_norm=P(st, None),
+        )
     if cfg.attention_bias:
         # biases follow their column-parallel projections: [L, out] with
         # the output features (heads) split on "tensor"
