@@ -107,6 +107,21 @@ def _declare(lib: ctypes.CDLL) -> None:
                                  ctypes.c_int]
     lib.batcher_flush.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int]
 
+    u8pp = ctypes.POINTER(ctypes.c_char_p)
+    lib.val_token_count.restype = ctypes.c_int64
+    lib.val_token_count.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.val_generate.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_double,
+        ctypes.c_double, ctypes.c_void_p, i64p,
+    ]
+    lib.val_chat.argtypes = [
+        u8pp, i64p, ctypes.c_int, ctypes.c_int64, ctypes.c_double,
+        ctypes.c_double, ctypes.c_void_p, i64p,
+    ]
+    lib.val_embeddings.argtypes = [
+        u8pp, i64p, ctypes.c_int, ctypes.c_void_p, i64p, intp,
+    ]
+
 
 def available() -> bool:
     """True when the native library is built (builds on first call)."""
@@ -414,3 +429,136 @@ class NativeAdmissionBatcher:
         if n <= 0:
             return None
         return AdmissionBatch(new_batch_id(), self._resolve(out, n), now)
+
+
+class _ValLimits(ctypes.Structure):
+    _fields_ = [
+        ("max_context_tokens", ctypes.c_int64),
+        ("max_output_tokens", ctypes.c_int64),
+        ("min_temperature", ctypes.c_double),
+        ("max_temperature", ctypes.c_double),
+        ("min_top_p", ctypes.c_double),
+        ("max_top_p", ctypes.c_double),
+    ]
+
+
+class NativeRequestValidator:
+    """C++ request validator (native/validator.cpp) with the exact
+    decision semantics of ``core/validator.py`` — same check order, same
+    ceil(codepoints/4) token estimate, same Unicode-whitespace blank
+    rule. The native side handles the hot path (byte scanning + range
+    checks on accepted requests); ANY rejection — and any input the C ABI
+    cannot represent (lone surrogates, out-of-int64 params) — delegates
+    to the Python reference validator, so the raised exceptions are
+    identical by construction (differential-tested in
+    tests/test_native.py)."""
+
+    def __init__(self, config=None):
+        from distributed_inference_server_tpu.core.validator import (
+            RequestValidator,
+            ValidatorConfig,
+        )
+
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.config = config or ValidatorConfig()
+        self._py = RequestValidator(self.config)
+        c = self.config
+        self._lim = _ValLimits(
+            c.max_context_tokens, c.max_output_tokens,
+            c.min_temperature, c.max_temperature,
+            c.min_top_p, c.max_top_p,
+        )
+
+    @staticmethod
+    def _clamp64(v: int) -> int:
+        # c_int64 marshalling WRAPS out-of-range Python ints (no
+        # OverflowError), which could wrap a huge max_tokens into range;
+        # clamp so over-limit stays over-limit (rejection path is exact:
+        # it re-runs the Python validator on the original value)
+        return max(-(2**62), min(int(v), 2**62))
+
+    def token_count(self, text: str) -> int:
+        try:
+            b = text.encode("utf-8")
+        except UnicodeEncodeError:
+            return self._py.token_count(text)
+        return int(self._lib.val_token_count(b, len(b)))
+
+    def validate_generate(self, request):
+        from distributed_inference_server_tpu.core.validator import Validated
+
+        try:
+            b = request.prompt.encode("utf-8")
+        except UnicodeEncodeError:  # lone surrogates: C ABI can't carry them
+            return self._py.validate_generate(request)
+        toks = ctypes.c_int64(0)
+        rc = self._lib.val_generate(
+            b, len(b), self._clamp64(request.max_tokens),
+            float(request.temperature), float(request.top_p),
+            ctypes.byref(self._lim), ctypes.byref(toks),
+        )
+        if rc == 0:
+            return Validated(request)
+        # rejection is the cold path: the Python tier raises the
+        # authoritative exception (type AND message) for this request
+        return self._py.validate_generate(request)
+
+    def validate_chat(self, request):
+        from distributed_inference_server_tpu.core.validator import Validated
+
+        try:
+            contents = [m.content.encode("utf-8") for m in request.messages]
+        except UnicodeEncodeError:
+            return self._py.validate_chat(request)
+        n = len(contents)
+        arr = (ctypes.c_char_p * max(1, n))(*contents)
+        lens = (ctypes.c_int64 * max(1, n))(*[len(c) for c in contents])
+        toks = ctypes.c_int64(0)
+        rc = self._lib.val_chat(
+            arr, lens, n, self._clamp64(request.max_tokens),
+            float(request.temperature), float(request.top_p),
+            ctypes.byref(self._lim), ctypes.byref(toks),
+        )
+        if rc == 0:
+            return Validated(request)
+        return self._py.validate_chat(request)
+
+    def validate_embeddings(self, request):
+        from distributed_inference_server_tpu.core.validator import Validated
+
+        try:
+            inputs = [t.encode("utf-8") for t in request.input_list()]
+        except UnicodeEncodeError:
+            return self._py.validate_embeddings(request)
+        n = len(inputs)
+        arr = (ctypes.c_char_p * max(1, n))(*inputs)
+        lens = (ctypes.c_int64 * max(1, n))(*[len(c) for c in inputs])
+        toks = ctypes.c_int64(0)
+        idx = ctypes.c_int(0)
+        rc = self._lib.val_embeddings(
+            arr, lens, n, ctypes.byref(self._lim), ctypes.byref(toks),
+            ctypes.byref(idx),
+        )
+        if rc == 0:
+            return Validated(request)
+        return self._py.validate_embeddings(request)
+
+
+def make_validator(config=None, native: Optional[bool] = None):
+    """Pick the validator tier like ``engine._make_allocator``: native C++
+    when the library builds (or ``native=True`` forces it), the Python
+    reference implementation otherwise."""
+    from distributed_inference_server_tpu.core.validator import (
+        RequestValidator,
+    )
+
+    if native is False:
+        return RequestValidator(config)
+    if available():
+        return NativeRequestValidator(config)
+    if native is True:
+        raise RuntimeError("native validator forced but library unavailable")
+    return RequestValidator(config)

@@ -19,10 +19,7 @@ from typing import Callable, List, Optional
 from aiohttp import web
 
 from distributed_inference_server_tpu.core.queue import QueueConfig
-from distributed_inference_server_tpu.core.validator import (
-    RequestValidator,
-    ValidatorConfig,
-)
+from distributed_inference_server_tpu.core.validator import ValidatorConfig
 from distributed_inference_server_tpu.engine.engine import LLMEngine
 from distributed_inference_server_tpu.models.tokenizer import Tokenizer
 from distributed_inference_server_tpu.serving.app import build_app
@@ -101,11 +98,16 @@ class InferenceServer:
             metrics=self.metrics,
             tracer=self.tracer,
         )
+        from distributed_inference_server_tpu.native import make_validator
+
         self.handler = InferenceHandler(
             self.dispatcher,
             tokenizer,
             model_name,
-            validator=RequestValidator(validator_config),
+            # native C++ validator when the library builds; the Python
+            # reference tier otherwise (identical contract, differential-
+            # tested in tests/test_native.py)
+            validator=make_validator(validator_config),
             metrics=self.metrics,
             tracer=self.tracer,
         )

@@ -343,3 +343,120 @@ def test_native_stress_tsan():
 
 def test_native_stress_plain():
     _run_stress("stress_plain")
+
+
+# ---------------------------------------------------------------------------
+# validator (native/validator.cpp vs core/validator.py)
+# ---------------------------------------------------------------------------
+
+
+def _validators():
+    from distributed_inference_server_tpu.core.validator import (
+        RequestValidator,
+        ValidatorConfig,
+    )
+
+    cfg = ValidatorConfig(max_context_tokens=64, max_output_tokens=32)
+    return RequestValidator(cfg), native.NativeRequestValidator(cfg)
+
+
+def _outcome(fn, req):
+    try:
+        return ("ok", type(fn(req).into_inner()).__name__)
+    except Exception as e:  # compared by type AND message
+        return (type(e).__name__, str(e))
+
+
+def test_validator_differential_generate():
+    from distributed_inference_server_tpu.core.models import GenerateRequest
+
+    py, nat = _validators()
+    rng = random.Random(7)
+    texts = [
+        "", " ", "\t\n", "ok", "x" * 255, "x" * 256, "x" * 257, "x" * 1000,
+        "héllo wörld", "　", "    ", "a b", "🙂" * 70,
+        "mixed 🙂 ascii and ünïcode",
+    ]
+    for _ in range(300):
+        req = GenerateRequest(
+            prompt=rng.choice(texts),
+            max_tokens=rng.choice([-1, 0, 1, 32, 33, 4096]),
+            temperature=rng.choice([-0.1, 0.0, 1.0, 2.0, 2.1]),
+            top_p=rng.choice([-0.1, 0.0, 0.5, 1.0, 1.01]),
+        )
+        assert _outcome(py.validate_generate, req) == _outcome(
+            nat.validate_generate, req
+        ), req
+
+
+def test_validator_differential_chat_and_embeddings():
+    from distributed_inference_server_tpu.core.models import (
+        ChatMessage,
+        ChatRequest,
+        EmbeddingsRequest,
+        Role,
+    )
+
+    py, nat = _validators()
+    rng = random.Random(11)
+    contents = ["", "  ", "hello", "x" * 200, "ü" * 100, "　 "]
+    for _ in range(200):
+        msgs = [
+            ChatMessage(role=Role.USER, content=rng.choice(contents))
+            for _ in range(rng.randint(0, 4))
+        ]
+        req = ChatRequest(
+            messages=msgs,
+            max_tokens=rng.choice([1, 32, 64]),
+            temperature=rng.choice([0.0, 1.0, 3.0]),
+            top_p=1.0,
+        )
+        assert _outcome(py.validate_chat, req) == _outcome(
+            nat.validate_chat, req
+        ), req
+    for _ in range(200):
+        n = rng.randint(0, 4)
+        inputs = [rng.choice(contents) for _ in range(n)]
+        req = EmbeddingsRequest(input=inputs if n != 1 else inputs[0])
+        assert _outcome(py.validate_embeddings, req) == _outcome(
+            nat.validate_embeddings, req
+        ), req
+
+
+def test_validator_token_count_parity_unicode():
+    py, nat = _validators()
+    for s in ["", "a", "abc", "abcd", "abcde", "héllo", "🙂" * 9,
+              "　" * 7, "mixed 🙂 text"]:
+        assert py.token_count(s) == nat.token_count(s), s
+
+
+def test_server_uses_native_validator_when_available():
+    from distributed_inference_server_tpu.native import make_validator
+
+    v = make_validator()
+    assert type(v).__name__ == "NativeRequestValidator"
+
+
+def test_validator_huge_max_tokens_not_wrapped():
+    """ctypes c_int64 wraps out-of-range ints silently; a 2^64+32
+    max_tokens must still be rejected exactly like the Python tier."""
+    from distributed_inference_server_tpu.core.models import GenerateRequest
+
+    py, nat = _validators()
+    req = GenerateRequest(prompt="ok", max_tokens=2**64 + 32)
+    assert _outcome(py.validate_generate, req) == _outcome(
+        nat.validate_generate, req
+    )
+
+
+def test_validator_lone_surrogate_delegates():
+    """json.loads produces lone-surrogate strings; UTF-8 encoding fails,
+    so the native tier must delegate instead of raising
+    UnicodeEncodeError (which the HTTP error middleware can't map)."""
+    from distributed_inference_server_tpu.core.models import GenerateRequest
+
+    py, nat = _validators()
+    req = GenerateRequest(prompt="\ud800 hello", max_tokens=4)
+    assert _outcome(py.validate_generate, req) == _outcome(
+        nat.validate_generate, req
+    )
