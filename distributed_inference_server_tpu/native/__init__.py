@@ -51,10 +51,13 @@ def _load() -> Optional[ctypes.CDLL]:
                 return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
+            _declare(lib)
+        except (OSError, AttributeError):
+            # unloadable OR stale .so missing newer symbols (make failed
+            # after a source update): fall back to the Python tier rather
+            # than crash every native-capable caller
             _build_failed = True
             return None
-        _declare(lib)
         _lib = lib
         return lib
 
@@ -481,11 +484,10 @@ class NativeRequestValidator:
         return max(-(2**62), min(int(v), 2**62))
 
     def token_count(self, text: str) -> int:
-        try:
-            b = text.encode("utf-8")
-        except UnicodeEncodeError:
-            return self._py.token_count(text)
-        return int(self._lib.val_token_count(b, len(b)))
+        # Python str length IS the codepoint count, so the reference
+        # tier's ceil(len/4) is O(1); the native scan only pays off where
+        # the blank check rides along (validate_*)
+        return self._py.token_count(text)
 
     def validate_generate(self, request):
         from distributed_inference_server_tpu.core.validator import Validated
