@@ -164,8 +164,10 @@ def main() -> None:
                                 time.perf_counter() - t_start)
             return tokens
 
-        # warm-up: compiles the prefill bucket + decode block
-        add("warmup", max(4, block + 1))
+        # warm-up at FULL length: decode gather windows are bucketed by
+        # live page count, so a full-length generation walks (and
+        # compiles) every bucket the timed run will hit
+        add("warmup", new_tokens)
         drain()
 
         ttfts = {}

@@ -505,7 +505,12 @@ class LLMEngine:
         (compiles the decode — or speculative — block), plus the ring-
         prefill program when a seq axis is configured. Without this the
         first real request pays tracing + XLA compile (~20-40s on TPU)
-        inside its TTFT."""
+        inside its TTFT.
+
+        Decode gather windows are bucketed by live page count
+        (_pages_bucket), so contexts growing past the warmed lengths
+        still pay one compile per new power-of-two bucket — amortized by
+        the persistent XLA compile cache across restarts."""
         steps = self.ecfg.decode_block_size + 1
         lengths = [
             min(b, self.pcfg.max_seq_len - steps - 2)
@@ -652,7 +657,12 @@ class LLMEngine:
             ids = np.zeros((Bp, bucket), np.int32)
             positions = np.zeros((Bp, bucket), np.int32)
             write_slots = np.full((Bp, bucket), self._num_slots_flat, np.int32)
-            gather = np.zeros((Bp, self._smax), np.int32)
+            # gather width tracks the group's LIVE page bucket, not the
+            # configured capacity (same scaling rationale as _pages_bucket)
+            gpages = self._gather_pages(max(
+                (len(s.block_table) for _, s in group), default=1
+            ), prefill=True)
+            gather = np.zeros((Bp, gpages * self.pcfg.page_size), np.int32)
             kv_valid = np.zeros((Bp,), np.int32)
             last_idx = np.zeros((Bp,), np.int32)
             temp = np.ones((Bp,), np.float32)
@@ -667,7 +677,7 @@ class LLMEngine:
                 write_slots[j] = self._slots_for_positions(
                     s.block_table, positions[j : j + 1], t
                 )[0]
-                gather[j] = self._gather_slots([s.block_table])[0]
+                gather[j] = self._gather_slots([s.block_table], gpages)[0]
                 kv_valid[j] = start + t
                 last_idx[j] = t - 1
                 temp[j] = s.params.temperature
@@ -1132,7 +1142,6 @@ class LLMEngine:
         impl = self._resolved_impl()
         ps = self.pcfg.page_size
         K = self.ecfg.decode_block_size
-        smax = self._smax
         num_slots = self._num_slots_flat
         moe_impl = self._moe_impl()
         fwd = self._fwd
@@ -1148,10 +1157,12 @@ class LLMEngine:
             steps_left = jnp.where(set_mask, set_steps, steps_left)
             active = jnp.where(set_mask, set_active, active)
 
-            # gather rows from the block tables — tables are frozen for the
-            # duration of the block (pages pre-allocated at launch)
-            offs = jnp.arange(smax, dtype=jnp.int32)
-            gather = block_tables[:, offs // ps] * ps + offs % ps  # [B, smax]
+            # gather rows from the block tables — tables are frozen for
+            # the duration of the block (pages pre-allocated at launch).
+            # The width comes from the UPLOADED table shape: the launcher
+            # slices to the live bucket, and jit specializes per bucket.
+            offs = jnp.arange(block_tables.shape[1] * ps, dtype=jnp.int32)
+            gather = block_tables[:, offs // ps] * ps + offs % ps
             rows = jnp.arange(block_tables.shape[0])
 
             def one_step(carry, _):
@@ -1231,7 +1242,9 @@ class LLMEngine:
             active = jnp.where(set_mask, set_active, active)
 
             B = tokens.shape[0]
-            offs = jnp.arange(smax, dtype=jnp.int32)
+            # gather width = uploaded (bucketed) table shape; smax stays
+            # the full CAPACITY bound for the write-drop checks below
+            offs = jnp.arange(block_tables.shape[1] * ps, dtype=jnp.int32)
             gather = block_tables[:, offs // ps] * ps + offs % ps
             rows = jnp.arange(B)
             max_pages = block_tables.shape[1]
@@ -1506,8 +1519,13 @@ class LLMEngine:
             jnp.asarray(set_tokens), jnp.asarray(set_pos),
             jnp.asarray(set_steps),
         )
+        live_pages = max(
+            [len(s.block_table) for _, s in seated], default=1
+        )
+        bucket = self._gather_pages(live_pages, prefill=False)
         uploads = (
-            jnp.asarray(self._bt), jnp.asarray(self._temp),
+            jnp.asarray(np.ascontiguousarray(self._bt[:, :bucket])),
+            jnp.asarray(self._temp),
             jnp.asarray(self._topp),
         )
         snapshot = [(i, s, advs[id(s)]) for i, s in seated]
@@ -1775,18 +1793,51 @@ class LLMEngine:
                 out[0, j] = table[page] * ps + pos % ps
         return out
 
-    def _gather_slots(self, tables: List[List[int]]) -> np.ndarray:
-        """[B, S_max] flat slots covering each row's block table (padded
-        with slot 0; masked by kv_valid_len). Used once per prefill; decode
-        uses the incrementally-maintained _gather_rows instead."""
+    def _gather_slots(
+        self, tables: List[List[int]], width_pages: Optional[int] = None
+    ) -> np.ndarray:
+        """[B, width_pages * page_size] flat slots covering each row's
+        block table (padded with slot 0; masked by kv_valid_len).
+        ``width_pages`` defaults to the full per-sequence capacity; the
+        prefill quantum passes the live bucket instead so short contexts
+        never gather (or pay attention HBM traffic for) S_max slots."""
         ps = self.pcfg.page_size
         B = max(len(tables), 1)
-        out = np.zeros((B, self._smax), np.int32)
+        W = width_pages or self.pcfg.max_pages_per_seq
+        out = np.zeros((B, W * ps), np.int32)
         offs = np.arange(ps, dtype=np.int32)
         for b, table in enumerate(tables):
-            for p, page in enumerate(table[: self.pcfg.max_pages_per_seq]):
+            for p, page in enumerate(table[:W]):
                 out[b, p * ps : (p + 1) * ps] = page * ps + offs
         return out
+
+    def _pages_bucket(self, pages: int) -> int:
+        """Power-of-two page-count bucket (min 8) for the gather width:
+        compiled programs are keyed on the bucketed block-table shape, so
+        growth costs at most log2(max_pages_per_seq) compiles while the
+        per-step gather/attention window tracks the LIVE maximum context
+        instead of the configured capacity (8192 slots at serving
+        defaults — paying that per decode step regardless of actual
+        lengths was the XLA path's scalability flaw)."""
+        cap = self.pcfg.max_pages_per_seq
+        b = 8
+        while b < pages:
+            b *= 2
+        return min(b, cap)
+
+    def _gather_pages(self, live_pages: int, prefill: bool) -> int:
+        """Block-table width to upload for a launch. Bucketing only pays
+        on the XLA gather path (it bounds the dense [B, S] materialization
+        + attention window); the Pallas kernels read exactly the valid
+        pages whatever the table width, and the "auto" probe validates
+        them ONLY at full capacity — so Pallas launches keep the probed
+        full-width shape and XLA launches track the live bucket."""
+        impl = self._resolved_impl()
+        if not isinstance(impl, str):
+            impl = impl[1 if prefill else 0]
+        if impl == "pallas":
+            return self.pcfg.max_pages_per_seq
+        return self._pages_bucket(live_pages)
 
     # ------------------------------------------------------------------
     # embeddings (the /embeddings endpoint's compute)

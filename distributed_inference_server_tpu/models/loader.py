@@ -334,17 +334,22 @@ def hf_state_dict_from_params(
     else:
         norm_map += [("mlp_norm", "post_attention_layernorm.weight")]
 
+    # projections come from the SAME name/transpose maps the load path
+    # uses (_LAYER_MAP/_MOE_LAYER_MAP), so the two directions cannot
+    # drift; norms are handled separately above (unit offset + the
+    # Gemma-2 pre/post remap), experts below (per-expert fan-out)
+    proj_map = [
+        (ours, suffix, t)
+        for ours, suffix, t in (_MOE_LAYER_MAP if cfg.is_moe else _LAYER_MAP)
+        if ours not in ("attn_norm", "mlp_norm")
+    ]
     for i in range(cfg.num_layers):
         pre = f"model.layers.{i}."
         for ours, suffix in norm_map:
             state[pre + suffix] = norm_out(dn(layers[ours][i]))
-        for ours, suffix in (
-            ("wq", "self_attn.q_proj.weight"),
-            ("wk", "self_attn.k_proj.weight"),
-            ("wv", "self_attn.v_proj.weight"),
-            ("wo", "self_attn.o_proj.weight"),
-        ):
-            state[pre + suffix] = dn(layers[ours][i]).T
+        for ours, suffix, t in proj_map:
+            arr = dn(layers[ours][i])
+            state[pre + suffix] = arr.T if t else arr
         if cfg.attention_bias:
             for ours, suffix in (
                 ("bq", "self_attn.q_proj.bias"),
@@ -353,9 +358,6 @@ def hf_state_dict_from_params(
             ):
                 state[pre + suffix] = dn(layers[ours][i])
         if cfg.is_moe:
-            state[pre + "block_sparse_moe.gate.weight"] = dn(
-                layers["router"][i]
-            ).T
             for ours, part in (
                 ("w_gate", "w1"), ("w_down", "w2"), ("w_up", "w3"),
             ):
@@ -363,13 +365,6 @@ def hf_state_dict_from_params(
                     state[
                         pre + f"block_sparse_moe.experts.{e}.{part}.weight"
                     ] = dn(layers[ours][i][e]).T
-        else:
-            for ours, suffix in (
-                ("w_gate", "mlp.gate_proj.weight"),
-                ("w_up", "mlp.up_proj.weight"),
-                ("w_down", "mlp.down_proj.weight"),
-            ):
-                state[pre + suffix] = dn(layers[ours][i]).T
     return state
 
 

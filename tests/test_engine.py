@@ -424,3 +424,50 @@ class TestWarmup:
         )
         eng.warmup()
         assert eng._cp_fns  # ring-prefill program compiled
+
+
+class TestGatherBucketing:
+    """Decode/prefill gather windows track the LIVE page bucket, not the
+    configured capacity — a huge max_pages_per_seq must neither change
+    outputs nor widen the per-step gather beyond the next bucket."""
+
+    def test_bucket_math(self, tiny_params):
+        eng = make_engine(tiny_params, num_pages=80, max_pages_per_seq=64)
+        assert eng._pages_bucket(1) == 8
+        assert eng._pages_bucket(8) == 8
+        assert eng._pages_bucket(9) == 16
+        assert eng._pages_bucket(33) == 64
+        # capped at the configured capacity
+        eng2 = make_engine(tiny_params, max_pages_per_seq=6)
+        assert eng2._pages_bucket(100) == 6
+
+    def test_outputs_identical_with_oversized_capacity(self, tiny_params):
+        prompt = TOK.encode("bucketed gather windows")
+        results = {}
+        for cap in (8, 64):  # 64 pages >> needed (~3)
+            eng = make_engine(tiny_params, num_pages=80, page_size=4,
+                              max_pages_per_seq=cap)
+            eng.add_request("r", prompt, GREEDY)
+            results[cap] = run_to_completion(eng)["r"]["tokens"]
+        assert results[8] == results[64]
+
+    def test_bucket_growth_across_boundary(self, tiny_params):
+        # prompt + output spans > 8 pages (page_size 4): the engine must
+        # cross the 8->16 bucket boundary mid-generation and stay exact
+        prompt = TOK.encode("x" * 30)
+        eng = make_engine(tiny_params, num_pages=64, page_size=4,
+                          max_pages_per_seq=16)
+        eng.add_request("r", prompt, SamplingParams(max_tokens=24,
+                                                    temperature=0.0))
+        out = run_to_completion(eng)["r"]
+        assert len(out["tokens"]) == 24
+
+        from distributed_inference_server_tpu.models.generate import (
+            greedy_generate,
+        )
+
+        want = greedy_generate(
+            tiny_params, TINY, prompt, max_new_tokens=24, max_seq=64,
+            eos_ids=TOK.eos_ids,
+        )
+        assert out["tokens"] == list(want)

@@ -278,8 +278,9 @@ class TestCheckpointSaveRoundTrip:
     def test_saved_checkpoint_loads_in_transformers(self, tmp_path):
         """The written checkpoint is genuinely HF-format: transformers'
         AutoModelForCausalLM restores it and produces matching logits."""
-        import torch
-        from transformers import AutoModelForCausalLM
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        AutoModelForCausalLM = transformers.AutoModelForCausalLM
 
         from distributed_inference_server_tpu.models.configs import TINY
         from distributed_inference_server_tpu.models.loader import (
