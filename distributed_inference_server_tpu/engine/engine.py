@@ -67,6 +67,15 @@ from distributed_inference_server_tpu.ops.sampling import sample_tokens
 logger = logging.getLogger(__name__)
 
 
+def _chosen_logprob(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """log softmax(logits)[token] per row: [B, V] x [B] -> [B] f32 (the
+    model-distribution log-probability of each sampled token)."""
+    lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(
+        lsm, jnp.maximum(tokens, 0)[:, None], axis=-1
+    )[:, 0]
+
+
 def _make_allocator(pcfg: PagedCacheConfig, force: Optional[bool]):
     """Pick the page-allocator tier: the native C++ implementation
     (native/allocator.cpp — the reference's serving layer is native, ours
@@ -155,6 +164,10 @@ class StepOutput:
     token_id: Optional[int] = None
     text: str = ""  # detokenized delta safe to emit now
     token_index: int = 0
+    # log-probability of token_id under the model distribution (raw-logit
+    # log-softmax; temperature/top-p-independent — matches the reference's
+    # optional TokenEvent logprob, models.rs:272-277)
+    logprob: Optional[float] = None
     finished: bool = False
     finish_reason: Optional[FinishReason] = None
     usage: Optional[Usage] = None
@@ -516,6 +529,12 @@ class LLMEngine:
             min(b, self.pcfg.max_seq_len - steps - 2)
             for b in self.ecfg.prefill_buckets
         ]
+        # one max-length request walks decode up to the CAP gather bucket
+        # (intermediate power-of-two buckets still compile lazily, at most
+        # log2(max_pages_per_seq) times over a server's lifetime)
+        full = self.pcfg.max_seq_len - steps - 2
+        if full > max(lengths, default=0):
+            lengths.append(full)
         thr = self._cp_threshold()
         if thr is not None:
             lengths.append(min(self._cp_bucket(thr),
@@ -642,7 +661,9 @@ class LLMEngine:
         # fetch of group N would otherwise serialize group N+1's upload
         # behind a full host<->device round trip (the r1 per-step sync
         # bug in miniature, one per prefill group).
-        dispatched: List[Tuple[object, List[Tuple[int, _Seq]], List[int]]] = []
+        dispatched: List[
+            Tuple[object, object, List[Tuple[int, _Seq]], List[bool]]
+        ] = []
         while budget > 0:
             group = [
                 (i, s) for i, s in enumerate(self.slots)
@@ -702,27 +723,29 @@ class LLMEngine:
                 # the draft model prefills the same chunk into its own
                 # pool (same slots) so speculative rounds can attend the
                 # full prompt
-                (toks, self.state.k, self.state.v,
+                (toks, lps, self.state.k, self.state.v,
                  self.draft_state.k, self.draft_state.v) = fn(
                     self.params, self.draft_params,
                     self.draft_state.k, self.draft_state.v, *args,
                 )
             else:
-                toks, self.state.k, self.state.v = fn(self.params, *args)
+                toks, lps, self.state.k, self.state.v = fn(
+                    self.params, *args
+                )
             budget -= Bp * bucket
             done: List[bool] = []
             for j, (_, s) in enumerate(group):
                 s.seq_len += chunk_lens[j]  # host view advances now so the
                 # next while-iteration groups the remaining chunks
                 done.append(s.seq_len >= len(s.token_ids))
-            dispatched.append((toks, list(group), done))
+            dispatched.append((toks, lps, list(group), done))
 
         # Phase 2 — reap: fetch each group's first-token batch (the device
         # has been crunching the later groups meanwhile) and seat finished
         # prompts into the decode carry. ``done`` marks rows whose FINAL
         # prompt chunk ran in that group — only there is toks[j] the real
         # first sampled token.
-        for toks, group, done in dispatched:
+        for toks, lps, group, done in dispatched:
             toks_np: Optional[np.ndarray] = None
             for j, (slot, s) in enumerate(group):
                 if not done[j]:
@@ -731,8 +754,10 @@ class LLMEngine:
                     continue  # aborted between dispatch and reap
                 if toks_np is None:
                     toks_np = np.asarray(toks)
+                    lps_np = np.asarray(lps)
                 try:
-                    self._emit_token(s, int(toks_np[j]), outputs)
+                    self._emit_token(s, int(toks_np[j]), outputs,
+                                     float(lps_np[j]))
                 except Exception as e:  # failure isolation (Property 22)
                     self.slots[slot] = None
                     self._by_id.pop(s.request_id, None)
@@ -816,7 +841,8 @@ class LLMEngine:
                         write_slots, sp_impl=sp,
                     )
                     toks = sample_tokens(rng, logits, temp, top_p)
-                    return toks, pool_k, pool_v, dpool_k, dpool_v
+                    return (toks, _chosen_logprob(logits, toks),
+                            pool_k, pool_v, dpool_k, dpool_v)
 
                 fn = self._cp_fns[T] = self._with_mesh(cp_spec)
             else:
@@ -829,7 +855,7 @@ class LLMEngine:
                         write_slots, sp_impl=sp,
                     )
                     toks = sample_tokens(rng, logits, temp, top_p)
-                    return toks, pool_k, pool_v
+                    return toks, _chosen_logprob(logits, toks), pool_k, pool_v
 
                 fn = self._cp_fns[T] = self._with_mesh(cp)
         return fn
@@ -853,7 +879,7 @@ class LLMEngine:
         topp = np.array([s.params.top_p], np.float32)
         valid = np.array([n], np.int32)
         if self.draft_params is not None:
-            (toks, self.state.k, self.state.v,
+            (toks, lps, self.state.k, self.state.v,
              self.draft_state.k, self.draft_state.v) = fn(
                 self.params, self.draft_params,
                 self.draft_state.k, self.draft_state.v,
@@ -862,13 +888,14 @@ class LLMEngine:
                 jnp.asarray(temp), jnp.asarray(topp), sub,
             )
         else:
-            toks, self.state.k, self.state.v = fn(
+            toks, lps, self.state.k, self.state.v = fn(
                 self.params, jnp.asarray(ids), jnp.asarray(valid),
                 self.state.k, self.state.v, jnp.asarray(write_slots),
                 jnp.asarray(temp), jnp.asarray(topp), sub,
             )
         s.seq_len = n
-        self._emit_token(s, int(np.asarray(toks)[0]), outputs)
+        self._emit_token(s, int(np.asarray(toks)[0]), outputs,
+                         float(np.asarray(lps)[0]))
         if self._by_id.get(s.request_id) is s:
             self._stage_seat(slot, s)
 
@@ -1088,7 +1115,7 @@ class LLMEngine:
                     )
                     last = logits[jnp.arange(ids.shape[0]), last_idx]
                     toks = sample_tokens(rng, last, temp, top_p)
-                    return toks, k, v, dk, dv
+                    return toks, _chosen_logprob(last, toks), k, v, dk, dv
 
                 fn = self._prefill_fns[key] = self._with_mesh(prefill_spec)
                 return fn
@@ -1103,7 +1130,7 @@ class LLMEngine:
                 )
                 last = logits[jnp.arange(ids.shape[0]), last_idx]
                 toks = sample_tokens(rng, last, temp, top_p)
-                return toks, k, v
+                return toks, _chosen_logprob(last, toks), k, v
 
             fn = self._prefill_fns[key] = self._with_mesh(prefill)
         return fn
@@ -1178,6 +1205,7 @@ class LLMEngine:
                 )
                 rng, sub = jax.random.split(rng)
                 nxt = sample_tokens(sub, logits[:, 0], temp, top_p)
+                lp = _chosen_logprob(logits[:, 0], nxt)
                 out = jnp.where(active, nxt, -1)
                 is_eos = (
                     (nxt[:, None] == eos[None, :]).any(-1)
@@ -1189,15 +1217,15 @@ class LLMEngine:
                 tokens = jnp.where(active, nxt, tokens)
                 active = active & ~is_eos & (steps_left > 0)
                 return (tokens, positions, steps_left, active,
-                        pool_k, pool_v, rng), out
+                        pool_k, pool_v, rng), (out, lp)
 
-            carry, outs = lax.scan(
+            carry, (outs, lps) = lax.scan(
                 one_step,
                 (tokens, positions, steps_left, active, pool_k, pool_v, rng),
                 None, length=K,
             )
             tokens, positions, steps_left, active, pool_k, pool_v, rng = carry
-            return (outs, tokens, positions, steps_left, active,
+            return (outs, lps, tokens, positions, steps_left, active,
                     pool_k, pool_v, rng)
 
         return self._with_mesh(block)
@@ -1300,6 +1328,11 @@ class LLMEngine:
                     write, gather, kv_valid, impl, moe_impl,
                 )
                 tps = spec_probs(logits, temp[:, None])  # [B, W, V]
+                # model-distribution logprobs of whatever gets emitted
+                # (raw logits, matching the plain decode path)
+                lraw = jax.nn.log_softmax(
+                    logits.astype(jnp.float32), axis=-1
+                )
 
                 # ---- rejection sampling (shared speculative.py core) ----
                 # top-p rows can't be verified exactly: force rejection at
@@ -1328,6 +1361,9 @@ class LLMEngine:
                 toks_out = jnp.where(
                     (idx < emitted[:, None]) & active[:, None], toks_out, -1
                 )
+                lp_out = jnp.take_along_axis(
+                    lraw, jnp.maximum(toks_out, 0)[..., None], axis=-1
+                )[..., 0]
                 new_last = toks_out[rows, jnp.maximum(emitted, 1) - 1]
                 tokens = jnp.where(active & (emitted > 0), new_last, tokens)
                 positions = positions + emitted
@@ -1336,13 +1372,13 @@ class LLMEngine:
                 return (
                     (tokens, positions, steps_left, active,
                      pool_k, pool_v, dpool_k, dpool_v),
-                    (toks_out, emitted, acc_out, prop_out),
+                    (toks_out, lp_out, emitted, acc_out, prop_out),
                 )
 
             rng, sub = jax.random.split(rng)
             keys = jax.random.split(sub, R * (gamma + 3))
             keys = keys.reshape((R, gamma + 3) + keys.shape[1:])
-            carry, (toks, counts, acc, prop) = lax.scan(
+            carry, (toks, lps, counts, acc, prop) = lax.scan(
                 one_round,
                 (tokens, positions, steps_left, active,
                  pool_k, pool_v, dpool_k, dpool_v),
@@ -1350,8 +1386,9 @@ class LLMEngine:
             )
             (tokens, positions, steps_left, active,
              pool_k, pool_v, dpool_k, dpool_v) = carry
-            return (toks, counts, acc, prop, tokens, positions, steps_left,
-                    active, pool_k, pool_v, dpool_k, dpool_v, rng)
+            return (toks, lps, counts, acc, prop, tokens, positions,
+                    steps_left, active, pool_k, pool_v, dpool_k, dpool_v,
+                    rng)
 
         return self._with_mesh(block)
 
@@ -1530,8 +1567,8 @@ class LLMEngine:
         )
         snapshot = [(i, s, advs[id(s)]) for i, s in seated]
         if use_spec:
-            (toks, counts, acc, prop, tokens, positions, steps_left, active,
-             self.state.k, self.state.v,
+            (toks, lps, counts, acc, prop, tokens, positions, steps_left,
+             active, self.state.k, self.state.v,
              self.draft_state.k, self.draft_state.v,
              rng) = self._spec_block_fn(
                 self.params, self.draft_params,
@@ -1540,15 +1577,15 @@ class LLMEngine:
                 tokens, positions, steps_left, active,
                 *uploads, rng, *injects,
             )
-            self._pending.append((toks, counts, acc, prop, snapshot))
+            self._pending.append((toks, lps, counts, acc, prop, snapshot))
         else:
-            (outs, tokens, positions, steps_left, active,
+            (outs, lps, tokens, positions, steps_left, active,
              self.state.k, self.state.v, rng) = self._block_fn(
                 self.params, self.state.k, self.state.v,
                 tokens, positions, steps_left, active,
                 *uploads, rng, *injects,
             )
-            self._pending.append((outs, None, None, None, snapshot))
+            self._pending.append((outs, lps, None, None, None, snapshot))
         self._carry = (tokens, positions, steps_left, active, rng)
 
     def _drain_pending(self, outputs: List[StepOutput]) -> None:
@@ -1568,13 +1605,21 @@ class LLMEngine:
         counts and acceptance stats. Live sequences reconcile the launch's
         assumed advance against what was actually emitted (speculative
         rounds emit a variable number of tokens)."""
-        toks_d, counts_d, acc_d, prop_d, snapshot = self._pending.popleft()
-        toks = np.asarray(toks_d)  # the only blocking device read per block
+        (toks_d, lps_d, counts_d, acc_d, prop_d,
+         snapshot) = self._pending.popleft()
+        # the block's two blocking device reads (token ids + their
+        # logprobs; the logprob tensor is [K, B] f32 — trivial next to
+        # the step compute, and computed on-device by one fused
+        # log-softmax over logits the step already produced)
+        toks = np.asarray(toks_d)
+        lps = np.asarray(lps_d)
         if counts_d is None:
             toks3 = toks[:, :, None]
+            lps3 = lps[:, :, None]
             counts = (toks >= 0).astype(np.int32)
         else:
             toks3 = toks
+            lps3 = lps
             counts = np.asarray(counts_d)
             if self.spec_tracker is not None:
                 prop_arr = np.asarray(prop_d)
@@ -1602,7 +1647,8 @@ class LLMEngine:
                         seq.token_ids.append(seq.next_token)
                         seq.seq_len += 1
                         emitted_here += 1
-                        self._emit_token(seq, t, outputs)
+                        self._emit_token(seq, t, outputs,
+                                         float(lps3[k, slot, w]))
                         if self._by_id.get(seq.request_id) is not seq:
                             # finished (EOS/stop/length): the device row
                             # may still be live (stop sequences are host-
@@ -1630,7 +1676,9 @@ class LLMEngine:
     # token emission & completion
     # ------------------------------------------------------------------
 
-    def _emit_token(self, seq: _Seq, token_id: int, outputs: List[StepOutput]) -> None:
+    def _emit_token(self, seq: _Seq, token_id: int,
+                    outputs: List[StepOutput],
+                    logprob: Optional[float] = None) -> None:
         """Process one sampled token: EOS / length / stop-sequence handling
         and the streaming text delta with stop-sequence holdback."""
         p = seq.params
@@ -1666,6 +1714,7 @@ class LLMEngine:
                 token_id=token_id,
                 text="",
                 token_index=seq.emitted_tokens - 1,
+                logprob=logprob,
             ))
             self._finish(seq, FinishReason.LENGTH, outputs)
             return
@@ -1680,6 +1729,7 @@ class LLMEngine:
             token_id=token_id,
             text=delta,
             token_index=seq.emitted_tokens - 1,
+            logprob=logprob,
         ))
 
     def _finish(self, seq: _Seq, reason: FinishReason,
@@ -1830,12 +1880,19 @@ class LLMEngine:
         on the XLA gather path (it bounds the dense [B, S] materialization
         + attention window); the Pallas kernels read exactly the valid
         pages whatever the table width, and the "auto" probe validates
-        them ONLY at full capacity — so Pallas launches keep the probed
-        full-width shape and XLA launches track the live bucket."""
+        them ONLY at full capacity — so any launch that can reach a
+        Pallas kernel keeps the probed full-width shape. That includes
+        decode launches under a MIXED resolution (decode=xla,
+        prefill=pallas): the speculative block's gamma+1 verify forward
+        inside a decode launch dispatches by T to the prefill kernel.
+        Prefill launches also stay full width: their gather materializes
+        once per admitted chunk (not per decode step), and a single
+        shape keeps warmup coverage exact."""
+        if prefill:
+            return self.pcfg.max_pages_per_seq
         impl = self._resolved_impl()
-        if not isinstance(impl, str):
-            impl = impl[1 if prefill else 0]
-        if impl == "pallas":
+        impls = (impl,) if isinstance(impl, str) else impl
+        if "pallas" in impls:
             return self.pcfg.max_pages_per_seq
         return self._pages_bucket(live_pages)
 

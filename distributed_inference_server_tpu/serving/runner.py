@@ -44,7 +44,8 @@ class ResultSink(Protocol):
     thread and must be non-blocking and exception-free; the HTTP layer's
     sinks bounce to the asyncio loop via ``call_soon_threadsafe``."""
 
-    def on_token(self, token_id: int, text: str, token_index: int) -> None: ...
+    def on_token(self, token_id: int, text: str, token_index: int,
+                 logprob=None) -> None: ...
 
     def on_done(self, finish_reason: FinishReason, usage: Usage) -> None: ...
 
@@ -497,7 +498,8 @@ class EngineRunner:
                     if out.token_id is not None:
                         tokens += 1
                     if not out.finished:
-                        req.sink.on_token(out.token_id, out.text, out.token_index)
+                        req.sink.on_token(out.token_id, out.text,
+                                          out.token_index, out.logprob)
                 if out.finished:
                     if out.error is None:
                         # flush any final delta carried on the done event
@@ -520,6 +522,14 @@ class EngineRunner:
                     self._total_processed += 1
             except Exception as e:  # noqa: BLE001 — sink isolation
                 self._last_error = f"sink error: {e}"
+                # best-effort: resolve the waiter before dropping, or the
+                # client's future waits forever on a request the runner
+                # no longer tracks (on_error is a different method — it
+                # may well work even when on_token just raised)
+                try:
+                    req.sink.on_error(f"sink failure: {e}", "server_error")
+                except Exception:  # noqa: BLE001
+                    pass
                 self._inflight.pop(out.request_id, None)
         if self.metrics and tokens:
             self.metrics.record_tokens(tokens)

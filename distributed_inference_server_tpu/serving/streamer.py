@@ -72,8 +72,9 @@ class StreamingSink:
 
     # runner-thread callbacks ------------------------------------------------
 
-    def on_token(self, token_id: Optional[int], text: str, token_index: int) -> None:
-        self._put(TokenEvent.token_event(text, token_index))
+    def on_token(self, token_id: Optional[int], text: str,
+                 token_index: int, logprob: Optional[float] = None) -> None:
+        self._put(TokenEvent.token_event(text, token_index, logprob))
 
     def on_done(self, finish_reason: FinishReason, usage: Usage) -> None:
         self.finish_reason = finish_reason
@@ -115,7 +116,8 @@ class CollectingSink:
 
     # runner-thread callbacks ------------------------------------------------
 
-    def on_token(self, token_id: Optional[int], text: str, token_index: int) -> None:
+    def on_token(self, token_id: Optional[int], text: str,
+                 token_index: int, logprob: Optional[float] = None) -> None:
         if text:
             self._parts.append(text)
 

@@ -471,3 +471,68 @@ class TestGatherBucketing:
             eos_ids=TOK.eos_ids,
         )
         assert out["tokens"] == list(want)
+
+
+class TestLogprobs:
+    """Streaming logprob emission (the reference's optional TokenEvent
+    logprob, models.rs:272-277): every emitted token carries the model-
+    distribution log-probability of the sampled id — raw-logit
+    log-softmax, temperature/top-p independent."""
+
+    def test_greedy_logprobs_match_reference_forward(self, tiny_params):
+        engine = make_engine(tiny_params)
+        prompt = TOK.encode("logprobs!")
+        engine.add_request("r", prompt, GREEDY)
+        events = []
+        while engine.has_work():
+            for o in engine.step():
+                if o.token_id is not None:
+                    events.append((o.token_id, o.logprob))
+        assert len(events) == 8
+        assert all(lp is not None and lp <= 0.0 for _, lp in events)
+
+        # reference: teacher-forced forward over prompt+output
+        ids = prompt + [t for t, _ in events]
+        T = len(ids)
+        cache = llama.KVCache.create(TINY, 1, T, dtype=jnp.float32)
+        pos = jnp.arange(T)[None]
+        logits, _ = llama.forward(
+            tiny_params, TINY, jnp.asarray([ids], jnp.int32), pos, cache,
+            pos, jnp.full((1,), T, jnp.int32),
+        )
+        lsm = jax.nn.log_softmax(np.asarray(logits)[0], axis=-1)
+        for i, (tok, lp) in enumerate(events):
+            want = float(lsm[len(prompt) - 1 + i, tok])
+            assert abs(lp - want) < 1e-4, (i, lp, want)
+
+    def test_spec_logprobs_match_plain_decode(self, tiny_params):
+        draft = llama.init_params(jax.random.PRNGKey(9), TINY,
+                                  dtype=jnp.float32)
+        from distributed_inference_server_tpu.engine.speculative import (
+            SpecConfig,
+        )
+
+        def run(spec):
+            eng = LLMEngine(
+                tiny_params, TINY, TOK,
+                EngineConfig(max_batch=2, prefill_buckets=(8, 32),
+                             paged=PagedCacheConfig(num_pages=64,
+                                                    page_size=4,
+                                                    max_pages_per_seq=16)),
+                dtype=jnp.float32,
+                draft_params=draft if spec else None,
+                draft_cfg=TINY if spec else None,
+                spec=SpecConfig(num_draft_tokens=3) if spec else None,
+            )
+            eng.add_request("r", TOK.encode("spec lp"), GREEDY)
+            out = []
+            while eng.has_work():
+                for o in eng.step():
+                    if o.token_id is not None:
+                        out.append((o.token_id, o.logprob))
+            return out
+
+        spec, plain = run(True), run(False)
+        assert [t for t, _ in spec] == [t for t, _ in plain]
+        for (_, a), (_, b) in zip(spec, plain):
+            assert abs(a - b) < 1e-4, (a, b)

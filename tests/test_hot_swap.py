@@ -178,7 +178,7 @@ def test_runner_swap_drains_old_engine_directly():
         def __init__(self, out, ev):
             self.out, self.ev = out, ev
 
-        def on_token(self, token_id, text, token_index):
+        def on_token(self, token_id, text, token_index, logprob=None):
             self.out.append(token_id)
 
         def on_done(self, finish_reason, usage):

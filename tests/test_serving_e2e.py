@@ -115,6 +115,12 @@ def test_generate_streaming_sse(server):
         assert events[-1].usage.completion_tokens <= 6
         token_events = [e for e in events[:-1] if e.type == "token"]
         assert all(e.index is not None for e in token_events)
+        # every real token event carries the model logprob on the wire
+        # (models.rs:272-277's optional field, populated by the engine);
+        # held-back text flushes (token_id None) ride without one
+        with_lp = [e for e in token_events if e.logprob is not None]
+        assert with_lp, "no logprobs streamed"
+        assert all(e.logprob <= 0.0 for e in with_lp)
 
     _run(server, go)
 

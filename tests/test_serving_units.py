@@ -49,7 +49,7 @@ class RecordingSink:
         self.usage: Optional[Usage] = None
         self.errors: List[tuple] = []
 
-    def on_token(self, token_id, text, token_index) -> None:
+    def on_token(self, token_id, text, token_index, logprob=None) -> None:
         self.tokens.append(text)
 
     def on_done(self, finish_reason, usage) -> None:
@@ -434,7 +434,7 @@ class TestEmbedInterleaving:
                     self.tokens = []
                     self.done = threading.Event()
 
-                def on_token(self, token_id, text, token_index):
+                def on_token(self, token_id, text, token_index, logprob=None):
                     self.tokens.append(token_id)
 
                 def on_done(self, finish_reason, usage):
