@@ -179,3 +179,26 @@ class TestEngineFuzz:
         )
         # prompts up to 64 tokens: many take the ring-prefill path
         _fuzz(eng, seed=4, n_requests=8, prompt_max=64)
+
+    def test_gemma2_alternating_windows_under_churn(self):
+        """Gemma-2 engine (alternating local/global layers, no page
+        reclaim, softcaps) holds the same invariants under randomized
+        churn — the traced per-layer window path at fuzz pressure."""
+        from distributed_inference_server_tpu.models.configs import (
+            TINY_GEMMA2,
+        )
+
+        gparams = llama.init_params(
+            jax.random.PRNGKey(0), TINY_GEMMA2, dtype=jnp.float32
+        )
+        eng = LLMEngine(
+            gparams, TINY_GEMMA2, TOK,
+            EngineConfig(
+                max_batch=3, prefill_buckets=(8, 32),
+                paged=PagedCacheConfig(num_pages=24, page_size=4,
+                                       max_pages_per_seq=16),
+                decode_block_size=3,
+            ),
+            dtype=jnp.float32,
+        )
+        _fuzz(eng, seed=5, n_requests=10)
