@@ -684,6 +684,9 @@ class LLMEngine:
                 (len(s.block_table) for _, s in group), default=1
             ), prefill=True)
             gather = np.zeros((Bp, gpages * self.pcfg.page_size), np.int32)
+            gather[: len(group)] = self._gather_slots(
+                [s.block_table for _, s in group], gpages
+            )
             kv_valid = np.zeros((Bp,), np.int32)
             last_idx = np.zeros((Bp,), np.int32)
             temp = np.ones((Bp,), np.float32)
@@ -698,7 +701,6 @@ class LLMEngine:
                 write_slots[j] = self._slots_for_positions(
                     s.block_table, positions[j : j + 1], t
                 )[0]
-                gather[j] = self._gather_slots([s.block_table], gpages)[0]
                 kv_valid[j] = start + t
                 last_idx[j] = t - 1
                 temp[j] = s.params.temperature

@@ -476,6 +476,14 @@ class NativeRequestValidator:
         )
 
     @staticmethod
+    def _carr(items):
+        """(char**, int64*, n) marshalling for a list of UTF-8 strings."""
+        n = len(items)
+        arr = (ctypes.c_char_p * max(1, n))(*items)
+        lens = (ctypes.c_int64 * max(1, n))(*[len(c) for c in items])
+        return arr, lens, n
+
+    @staticmethod
     def _clamp64(v: int) -> int:
         # c_int64 marshalling WRAPS out-of-range Python ints (no
         # OverflowError), which could wrap a huge max_tokens into range;
@@ -515,9 +523,7 @@ class NativeRequestValidator:
             contents = [m.content.encode("utf-8") for m in request.messages]
         except UnicodeEncodeError:
             return self._py.validate_chat(request)
-        n = len(contents)
-        arr = (ctypes.c_char_p * max(1, n))(*contents)
-        lens = (ctypes.c_int64 * max(1, n))(*[len(c) for c in contents])
+        arr, lens, n = self._carr(contents)
         toks = ctypes.c_int64(0)
         rc = self._lib.val_chat(
             arr, lens, n, self._clamp64(request.max_tokens),
@@ -535,9 +541,7 @@ class NativeRequestValidator:
             inputs = [t.encode("utf-8") for t in request.input_list()]
         except UnicodeEncodeError:
             return self._py.validate_embeddings(request)
-        n = len(inputs)
-        arr = (ctypes.c_char_p * max(1, n))(*inputs)
-        lens = (ctypes.c_int64 * max(1, n))(*[len(c) for c in inputs])
+        arr, lens, n = self._carr(inputs)
         toks = ctypes.c_int64(0)
         idx = ctypes.c_int(0)
         rc = self._lib.val_embeddings(
