@@ -195,6 +195,16 @@ class TestBiasFamily:
         tp = _generate(TINY_BIAS, "xla", mesh=make_mesh(MeshSpec(tensor=2)))
         assert plain == tp
 
+    def test_bias_model_under_pp(self):
+        from distributed_inference_server_tpu.parallel import (
+            MeshSpec,
+            make_mesh,
+        )
+
+        plain = _generate(TINY_BIAS, "xla")
+        pp = _generate(TINY_BIAS, "xla", mesh=make_mesh(MeshSpec(stage=2)))
+        assert plain == pp
+
     def test_loader_round_trip_with_bias(self):
         cfg = TINY_BIAS
         ref = llama.init_params(jax.random.PRNGKey(1), cfg, jnp.float32)
