@@ -1,0 +1,171 @@
+"""Pallas kernel probe + microbenchmark for the real chip.
+
+One command for the kernel iteration loop (docs/DESIGN.md §6 round-3
+task 1): AOT-compile both v3 paged-attention kernels at serving
+geometry, print any Mosaic rejection VERBATIM (the error text is the
+iteration signal), and — when they compile — time kernel vs XLA-gather
+attention at bench shapes, enqueue-only and blocking.
+
+Usage (tunnel must be up; run alone in the foreground):
+    python tools/kernel_probe.py                  # Llama-1B geometry
+    KP_HEADS=16 KP_KV=8 KP_D=256 python tools/kernel_probe.py  # custom
+
+Prints one JSON line per (kernel, impl) with compile status and
+timings. Exit 0 if both kernels compile, 2 if the tunnel is down,
+1 otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import time
+
+
+def _emit(obj) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def main() -> int:
+    relay_ports = (8082, 8083, 8087, 8092)  # same set bench.py probes
+    for port in relay_ports:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=2).close()
+            break
+        except OSError:
+            continue
+    else:
+        _emit({"error": f"TPU tunnel down (relay ports refused "
+                        f"{relay_ports})"})
+        return 2
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_inference_server_tpu.ops.attention import gqa_attention
+    from distributed_inference_server_tpu.ops.pallas import (
+        paged_attention_decode,
+        paged_attention_prefill,
+    )
+
+    B = int(os.environ.get("KP_BATCH", "64"))
+    H = int(os.environ.get("KP_HEADS", "32"))
+    KV = int(os.environ.get("KP_KV", "8"))
+    D = int(os.environ.get("KP_D", "64"))
+    ps = int(os.environ.get("KP_PAGE", "16"))
+    P = int(os.environ.get("KP_PAGES_PER_SEQ", "17"))  # bench shape
+    T = int(os.environ.get("KP_PREFILL_T", "128"))
+    ctx = int(os.environ.get("KP_CTX", "192"))  # mean live tokens/row
+    num_pages = B * P + 8
+    dtype = jnp.bfloat16
+
+    rng = np.random.default_rng(0)
+    pool_k = jnp.asarray(
+        rng.standard_normal((num_pages * ps, KV, D), np.float32), dtype
+    )
+    pool_v = jnp.asarray(
+        rng.standard_normal((num_pages * ps, KV, D), np.float32), dtype
+    )
+    tables = jnp.asarray(
+        rng.permutation(num_pages)[: B * P].reshape(B, P).astype(np.int32)
+    )
+    valid = jnp.full((B,), min(ctx, P * ps), jnp.int32)
+    q1 = jnp.asarray(rng.standard_normal((B, H, D), np.float32), dtype)
+    qT = jnp.asarray(
+        rng.standard_normal((B, T, H, D), np.float32), dtype
+    )
+    qstart = jnp.maximum(valid - T, 0)
+
+    def timeit(fn, n=30):
+        out = fn()
+        jax.block_until_ready(out)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn()
+        enq = (time.perf_counter() - t0) / n
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            jax.block_until_ready(fn())
+        blk = (time.perf_counter() - t0) / n
+        return enq * 1e3, blk * 1e3
+
+    ok = True
+    for name, kernel_fn, xla_fn in (
+        (
+            "decode",
+            lambda: paged_attention_decode(
+                q1, pool_k, pool_v, tables, valid, page_size=ps,
+                interpret=False,
+            ),
+            # jitted like the kernel wrappers, so the comparison is the
+            # fused program the production XLA path actually runs
+            jax.jit(lambda: _xla_decode(
+                jnp, gqa_attention, q1, pool_k, pool_v, tables, valid, ps
+            )),
+        ),
+        (
+            "prefill",
+            lambda: paged_attention_prefill(
+                qT, pool_k, pool_v, tables, qstart, valid, page_size=ps,
+                interpret=False,
+            ),
+            jax.jit(lambda: _xla_prefill(
+                jnp, gqa_attention, qT, pool_k, pool_v, tables, qstart,
+                valid, ps
+            )),
+        ),
+    ):
+        rec = {"kernel": name, "B": B, "H": H, "KV": KV, "D": D,
+               "page_size": ps, "pages_per_seq": P}
+        try:
+            enq, blk = timeit(kernel_fn)
+            rec.update(pallas_enqueue_ms=round(enq, 3),
+                       pallas_blocking_ms=round(blk, 3), compiled=True)
+        except Exception as e:
+            ok = False
+            rec.update(compiled=False, mosaic_error=str(e))
+            _emit(rec)
+            continue
+        try:
+            enq, blk = timeit(xla_fn)
+        except Exception as e:  # e.g. dense-gather OOM at big shapes
+            rec["xla_error"] = str(e).split("\n")[0][:300]
+            _emit(rec)
+            continue
+        rec.update(xla_enqueue_ms=round(enq, 3),
+                   xla_blocking_ms=round(blk, 3))
+        rec["pallas_speedup_blocking"] = round(
+            rec["xla_blocking_ms"] / max(rec["pallas_blocking_ms"], 1e-9), 3
+        )
+        _emit(rec)
+    return 0 if ok else 1
+
+
+def _xla_decode(jnp, gqa_attention, q1, pool_k, pool_v, tables, valid, ps):
+    B, P = tables.shape
+    slots = (tables[:, :, None] * ps + jnp.arange(ps)[None, None, :]).reshape(
+        B, P * ps
+    )
+    k_seq, v_seq = pool_k[slots], pool_v[slots]
+    return gqa_attention(q1[:, None], k_seq, v_seq, (valid - 1)[:, None],
+                         valid)[:, 0]
+
+
+def _xla_prefill(jnp, gqa_attention, qT, pool_k, pool_v, tables, qstart,
+                 valid, ps):
+    B, P = tables.shape
+    T = qT.shape[1]
+    slots = (tables[:, :, None] * ps + jnp.arange(ps)[None, None, :]).reshape(
+        B, P * ps
+    )
+    k_seq, v_seq = pool_k[slots], pool_v[slots]
+    positions = qstart[:, None] + jnp.arange(T)[None]
+    return gqa_attention(qT, k_seq, v_seq, positions, valid)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
