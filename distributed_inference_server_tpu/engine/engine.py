@@ -678,11 +678,9 @@ class LLMEngine:
             ids = np.zeros((Bp, bucket), np.int32)
             positions = np.zeros((Bp, bucket), np.int32)
             write_slots = np.full((Bp, bucket), self._num_slots_flat, np.int32)
-            # gather width tracks the group's LIVE page bucket, not the
-            # configured capacity (same scaling rationale as _pages_bucket)
-            gpages = self._gather_pages(max(
-                (len(s.block_table) for _, s in group), default=1
-            ), prefill=True)
+            # prefill gathers are always full width — see _gather_pages
+            # for why (one shape per admitted chunk, exact warmup cover)
+            gpages = self._gather_pages(0, prefill=True)
             gather = np.zeros((Bp, gpages * self.pcfg.page_size), np.int32)
             gather[: len(group)] = self._gather_slots(
                 [s.block_table for _, s in group], gpages
@@ -999,10 +997,12 @@ class LLMEngine:
         and the speculative verify width (gamma+1) — returning per-kernel
         success. Runs on the real backend so Mosaic itself is the judge;
         one never-probed shape crashing at first launch is exactly the
-        failure mode this probe exists to prevent."""
-        from distributed_inference_server_tpu.ops.pallas import (
-            paged_attention_decode,
-            paged_attention_prefill,
+        failure mode this probe exists to prevent. The probed callables
+        come from ``llama.make_pallas_attend`` — the same builder the
+        serving path launches — so probe and serving cannot drift."""
+        from distributed_inference_server_tpu.models.llama import (
+            make_pallas_attend,
+            shard_pallas_attend,
         )
 
         pcfg = self.pcfg
@@ -1045,38 +1045,51 @@ class LLMEngine:
                 jax.ShapeDtypeStruct((B,), jnp.int32),
             )
 
+        # Under a tensor mesh the serving path launches the kernels INSIDE
+        # shard_map (llama.shard_pallas_attend) — probe that exact program
+        # at global shapes rather than the standalone per-shard lowering,
+        # whose Mosaic acceptance could in principle diverge (ADVICE r2).
+        sm = self.mesh is not None and tp > 1
+
         ok_decode = ok_prefill = True
         for cfg, launches in geometries:
-            kv = max(1, cfg.num_kv_heads // tp)
-            heads = max(1, cfg.num_heads // tp)
-            window = cfg.sliding_window or 0
             softcap = cfg.attn_logit_softcap or 0.0
+            if sm:  # global shapes: shard_map's specs do the splitting
+                kv, heads = cfg.num_kv_heads, cfg.num_heads
+            else:
+                kv = max(1, cfg.num_kv_heads // tp)
+                heads = max(1, cfg.num_heads // tp)
             pool = jax.ShapeDtypeStruct(
                 (slots, kv, cfg.head_dim), self.dtype
             )
-            tables, valid = tv(Bd)
+
+            def lower_kernel(decode_step, q_shape, B):
+                tables, valid = tv(B)
+                q = jax.ShapeDtypeStruct(q_shape, self.dtype)
+                w = jax.ShapeDtypeStruct((), jnp.int32)
+                fn = make_pallas_attend(
+                    pcfg.page_size, softcap, decode_step, interpret=False
+                )
+                if sm:
+                    fn = shard_pallas_attend(fn, self.mesh, decode_step)
+                if decode_step:
+                    return jax.jit(fn).lower(q, pool, pool, tables, valid, w)
+                # q_start shares kv_valid_len's [B] i32 shape
+                return jax.jit(fn).lower(
+                    q, pool, pool, tables, valid, valid, w
+                )
+
+            Bd_g = Bd * dp if sm else Bd
             ok_decode = ok_decode and try_compile(
                 "paged-decode",
-                lambda: paged_attention_decode.lower(
-                    jax.ShapeDtypeStruct(
-                        (Bd, heads, cfg.head_dim), self.dtype
-                    ),
-                    pool, pool, tables, valid,
-                    page_size=pcfg.page_size, sliding_window=window,
-                    attn_softcap=softcap, interpret=False,
-                ),
+                lambda: lower_kernel(True, (Bd_g, heads, cfg.head_dim), Bd_g),
             )
             for B, T in launches:
-                tables, valid = tv(B)
+                B_g = B * dp if sm else B
                 ok_prefill = ok_prefill and try_compile(
                     "chunked-prefill",
-                    lambda: paged_attention_prefill.lower(
-                        jax.ShapeDtypeStruct(
-                            (B, T, heads, cfg.head_dim), self.dtype
-                        ),
-                        pool, pool, tables, valid, valid,
-                        page_size=pcfg.page_size, sliding_window=window,
-                        attn_softcap=softcap, interpret=False,
+                    lambda: lower_kernel(
+                        False, (B_g, T, heads, cfg.head_dim), B_g
                     ),
                 )
                 if not ok_prefill:

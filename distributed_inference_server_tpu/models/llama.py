@@ -239,21 +239,37 @@ def _run_layers(
     h = params["embed"][input_ids]  # [B, T, H]
     if cfg.scale_embeddings:  # Gemma: embeddings scale by sqrt(hidden)
         h = h * jnp.asarray(cfg.hidden_size**0.5, h.dtype)
-    # per-layer sliding windows ride the scan as data (0 = full causal),
-    # so Gemma-2's alternating local/global layers share ONE compiled
-    # block body — no per-layer recompilation, no unrolled scan
-    windows = jnp.asarray(cfg.layer_windows(), jnp.int32)
+    if cfg.sliding_window:
+        # per-layer sliding windows ride the scan as data (0 = full
+        # causal), so Gemma-2's alternating local/global layers share ONE
+        # compiled block body — no per-layer recompile, no unrolled scan
+        windows = jnp.asarray(cfg.layer_windows(), jnp.int32)
 
-    def block(h, xs):
-        layer, k_layer, v_layer, window = xs
-        return layer_block(
-            cfg, layer, h, positions, k_layer, v_layer, write_fn, attend_fn,
-            inv_freq, moe_impl, valid_tokens, window=window,
+        def block(h, xs):
+            layer, k_layer, v_layer, window = xs
+            return layer_block(
+                cfg, layer, h, positions, k_layer, v_layer, write_fn,
+                attend_fn, inv_freq, moe_impl, valid_tokens, window=window,
+            )
+
+        h, (new_k, new_v) = lax.scan(
+            block, h, (params["layers"], cache_k, cache_v, windows)
         )
+    else:
+        # no layer slides: pass None STATICALLY so full-causal models keep
+        # gqa_attention's maskless branch instead of paying a traced
+        # (w <= 0) | ... [B, T, S] term every layer
 
-    h, (new_k, new_v) = lax.scan(
-        block, h, (params["layers"], cache_k, cache_v, windows)
-    )
+        def block(h, xs):
+            layer, k_layer, v_layer = xs
+            return layer_block(
+                cfg, layer, h, positions, k_layer, v_layer, write_fn,
+                attend_fn, inv_freq, moe_impl, valid_tokens, window=None,
+            )
+
+        h, (new_k, new_v) = lax.scan(
+            block, h, (params["layers"], cache_k, cache_v)
+        )
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     return h, new_k, new_v
 
@@ -360,6 +376,83 @@ def forward(
     return _unembed(params, cfg, h), KVCache(k=new_k, v=new_v)
 
 
+def make_pallas_attend(page_size: int, softcap: float, decode_step: bool,
+                       interpret=None):
+    """Build the per-shard Pallas attend callable — the EXACT kernel-arg
+    wiring the serving path launches. The engine's AOT "auto" probe uses
+    this same builder (optionally wrapped in ``shard_pallas_attend``) so
+    the probed program and the served program cannot drift apart.
+
+    Decode: ``fn(q3 [B,H,D], k_pool, v_pool, tables, kv_valid, window)``;
+    prefill: ``fn(q4 [B,T,H,D], k_pool, v_pool, tables, kv_valid,
+    q_start, window)`` (note the kernel itself takes q_start BEFORE
+    kv_valid — this wrapper's arg order matches shard_pallas_attend's
+    specs instead). ``interpret=None`` keeps the kernels' own off-TPU
+    auto-interpret default; the AOT probe passes False to make Mosaic
+    judge for real."""
+    from distributed_inference_server_tpu.ops.pallas import (
+        paged_attention_decode,
+        paged_attention_prefill,
+    )
+
+    if decode_step:
+        def fn(q3, k_layer, v_layer, tables, valid, w):
+            return paged_attention_decode(
+                q3, k_layer, v_layer, tables, valid,
+                page_size=page_size, sliding_window=w,
+                attn_softcap=softcap, interpret=interpret,
+            )
+    else:
+        def fn(q4, k_layer, v_layer, tables, valid, qs, w):
+            return paged_attention_prefill(
+                q4, k_layer, v_layer, tables, qs, valid,
+                page_size=page_size, sliding_window=w,
+                attn_softcap=softcap, interpret=interpret,
+            )
+    return fn
+
+
+def shard_pallas_attend(fn, mesh, decode_step: bool):
+    """shard_map-wrap a per-shard Pallas attend callable over ``mesh``:
+    ``tensor`` splits query heads and the pools' KV-head axis, ``data``
+    splits rows; the kernel body stays fully local (no collectives).
+
+    ``fn(q, k_pool, v_pool, page_tables, kv_valid_len, window)`` for
+    decode (q = [B, H, D]) or ``fn(q, k_pool, v_pool, page_tables,
+    kv_valid_len, q_start, window)`` for chunked prefill
+    (q = [B, T, H, D]); every per-row operand rides the specs so data
+    shards see their own rows (closure capture would replicate).
+
+    Shared by ``paged_forward`` and the engine's AOT "auto" probe so the
+    probe lowers the SAME shard_map program the serving path launches —
+    a standalone kernel lowering could in principle pass Mosaic while the
+    sharded lowering fails (or vice versa)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    q_spec = (
+        P("data", "tensor", None) if decode_step
+        else P("data", None, "tensor", None)
+    )
+    in_specs = [
+        q_spec,  # q [B, H, D] / [B, T, H, D]
+        P(None, "tensor", None),  # pool layer [slots, KV, D]
+        P(None, "tensor", None),
+        P("data", None),  # page tables [B, P]
+        P("data"),  # kv_valid_len [B]
+    ]
+    if not decode_step:
+        in_specs.append(P("data"))  # q_start [B] row starts
+    in_specs.append(P())  # this layer's sliding window (replicated scalar)
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+
+
 def paged_forward(
     params: Params,
     cfg: ModelConfig,
@@ -406,57 +499,24 @@ def paged_forward(
         attention_impl = attention_impl[0 if input_ids.shape[1] == 1 else 1]
     use_pallas = attention_impl == "pallas"
     if use_pallas:
-        from distributed_inference_server_tpu.ops.pallas import (
-            paged_attention_decode,
-            paged_attention_prefill,
-        )
-
         if page_size <= 0:
             raise ValueError("attention_impl='pallas' requires page_size")
         decode_step = input_ids.shape[1] == 1
-        softcap = cfg.attn_logit_softcap or 0.0
         # gather_slots rows are table[p]*page_size + offset by construction
         page_tables = gather_slots[:, ::page_size] // page_size
-
-        if decode_step:
-
-            def _attend_pallas(q3, k_layer, v_layer, tables, valid, w):
-                return paged_attention_decode(
-                    q3, k_layer, v_layer, tables, valid,
-                    page_size=page_size, sliding_window=w,
-                    attn_softcap=softcap,
-                )
-        else:
+        if not decode_step:
+            # q_start rides as an explicit row argument (NOT a closure
+            # capture): shard_map replicates captured values, which would
+            # hand every data shard the full global [B] starts misaligned
+            # with its own rows
             q_start = positions[:, 0]
 
-            def _attend_pallas(q4, k_layer, v_layer, tables, valid, w):
-                return paged_attention_prefill(
-                    q4, k_layer, v_layer, tables, q_start, valid,
-                    page_size=page_size, sliding_window=w,
-                    attn_softcap=softcap,
-                )
-
+        _attend_pallas = make_pallas_attend(
+            page_size, cfg.attn_logit_softcap or 0.0, decode_step
+        )
         if mesh is not None and mesh.shape.get("tensor", 1) > 1:
-            from jax import shard_map
-            from jax.sharding import PartitionSpec as P
-
-            q_spec = (
-                P("data", "tensor", None) if decode_step
-                else P("data", None, "tensor", None)
-            )
-            _attend_pallas = shard_map(
-                _attend_pallas,
-                mesh=mesh,
-                in_specs=(
-                    q_spec,  # q [B, H, D] / [B, T, H, D]
-                    P(None, "tensor", None),  # pool layer [slots, KV, D]
-                    P(None, "tensor", None),
-                    P("data", None),  # page tables [B, P]
-                    P("data"),  # kv_valid_len [B]
-                    P(),  # this layer's sliding window (replicated scalar)
-                ),
-                out_specs=q_spec,
-                check_vma=False,
+            _attend_pallas = shard_pallas_attend(
+                _attend_pallas, mesh, decode_step
             )
 
     def write_fn(layer, new):
@@ -465,6 +525,8 @@ def paged_forward(
 
     def attend_fn(q, k_layer, v_layer, window):
         if use_pallas:
+            if window is None:  # static full-causal: kernels take w <= 0
+                window = jnp.int32(0)
             if decode_step:
                 out = _attend_pallas(
                     q[:, 0], k_layer, v_layer, page_tables, kv_valid_len,
@@ -472,7 +534,8 @@ def paged_forward(
                 )
                 return out[:, None]
             return _attend_pallas(
-                q, k_layer, v_layer, page_tables, kv_valid_len, window
+                q, k_layer, v_layer, page_tables, kv_valid_len, q_start,
+                window,
             )
         k_seq = k_layer[gather_slots]  # [B, S_max, KV, D]
         v_seq = v_layer[gather_slots]

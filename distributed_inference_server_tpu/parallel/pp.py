@@ -86,16 +86,33 @@ def pp_forward(
 
         # this stage's slice of the per-layer sliding windows (0 = full
         # causal) — Gemma-2-style alternating layers keep their schedule
-        # across stage boundaries
+        # across stage boundaries. Non-sliding models skip the traced
+        # window entirely (static None keeps gqa's maskless branch).
         L_stage = layers["attn_norm"].shape[0]
-        win_stage = jnp.asarray(cfg.layer_windows(), jnp.int32).reshape(
-            -1, L_stage
-        )[stage]
+        if cfg.sliding_window:
+            win_stage = jnp.asarray(cfg.layer_windows(), jnp.int32).reshape(
+                -1, L_stage
+            )[stage]
+        else:
+            win_stage = None
 
         def run_stage(h_mb, pos_mb, ck_mb, cv_mb, wp_mb, kvv_mb):
             write_fn = lambda layer, new: llama._write_kv(layer, new, wp_mb)
             attend_fn = lambda q, k, v, w: gqa_attention(
                 q, k, v, pos_mb, kvv_mb, w, cfg.attn_logit_softcap)
+
+            if win_stage is None:
+                def blk(h, xs):
+                    layer, k_l, v_l = xs
+                    return llama.layer_block(
+                        cfg, layer, h, pos_mb, k_l, v_l, write_fn, attend_fn,
+                        inv_freq, window=None,
+                    )
+
+                h_mb, (nk, nv) = lax.scan(
+                    blk, h_mb, (layers, ck_mb, cv_mb)
+                )
+                return h_mb, nk, nv
 
             def blk(h, xs):
                 layer, k_l, v_l, w = xs
@@ -235,9 +252,12 @@ def pp_paged_forward(
         stage = lax.axis_index("stage")
 
         L_stage = layers["attn_norm"].shape[0]
-        win_stage = jnp.asarray(cfg.layer_windows(), jnp.int32).reshape(
-            -1, L_stage
-        )[stage]
+        if cfg.sliding_window:
+            win_stage = jnp.asarray(cfg.layer_windows(), jnp.int32).reshape(
+                -1, L_stage
+            )[stage]
+        else:  # static None keeps the maskless gqa branch (no traced w)
+            win_stage = None
 
         def run_stage(h_mb, pos_mb, pk, pv, ws_mb, gs_mb, kvv_mb):
             write_fn = lambda layer, new: layer.at[ws_mb].set(
@@ -249,6 +269,17 @@ def pp_paged_forward(
                 v_seq = v_layer[gs_mb]
                 return gqa_attention(q, k_seq, v_seq, pos_mb, kvv_mb, w,
                                      cfg.attn_logit_softcap)
+
+            if win_stage is None:
+                def blk(h, xs):
+                    layer, k_l, v_l = xs
+                    return llama.layer_block(
+                        cfg, layer, h, pos_mb, k_l, v_l, write_fn, attend_fn,
+                        inv_freq, window=None,
+                    )
+
+                h_mb, (nk, nv) = lax.scan(blk, h_mb, (layers, pk, pv))
+                return h_mb, nk, nv
 
             def blk(h, xs):
                 layer, k_l, v_l, w = xs

@@ -483,6 +483,7 @@ class EngineRunner:
             req = self._inflight.get(out.request_id)
             if req is None:
                 continue
+            done_delivered = False
             try:
                 if out.error is not None:
                     req.sink.on_error(out.error, "inference_failed")
@@ -509,6 +510,7 @@ class EngineRunner:
                             out.finish_reason or FinishReason.STOP,
                             out.usage or Usage(),
                         )
+                        done_delivered = True
                     if self.tracer and req.engine_span is not None:
                         if out.usage is not None:
                             req.engine_span.set(
@@ -525,11 +527,20 @@ class EngineRunner:
                 # best-effort: resolve the waiter before dropping, or the
                 # client's future waits forever on a request the runner
                 # no longer tracks (on_error is a different method — it
-                # may well work even when on_token just raised)
-                try:
-                    req.sink.on_error(f"sink failure: {e}", "server_error")
-                except Exception:  # noqa: BLE001
-                    pass
+                # may well work even when on_token just raised). But if
+                # on_done already succeeded (e.g. tracer.finish raised
+                # after), the request IS resolved — an error event after
+                # a done event would contradict the stream contract.
+                if not done_delivered:
+                    try:
+                        req.sink.on_error(f"sink failure: {e}",
+                                          "server_error")
+                    except Exception:  # noqa: BLE001
+                        pass
+                else:
+                    # the request DID complete (client saw done) — only
+                    # post-done bookkeeping raised; keep the count honest
+                    self._total_processed += 1
                 self._inflight.pop(out.request_id, None)
         if self.metrics and tokens:
             self.metrics.record_tokens(tokens)

@@ -271,6 +271,7 @@ def test_wrong_field_types_rejected():
     ),
 )
 def test_token_event_format(token, index, logprob):
+    """Property 13: SSE token event wire format (design.md:758-762)."""
     ev = TokenEvent.token_event(token, index, logprob)
     obj = json.loads(dumps(ev))
     assert obj["type"] == "token"
@@ -284,6 +285,7 @@ def test_token_event_format(token, index, logprob):
 @CASES
 @given(finish=arb_finish, usage=arb_usage)
 def test_done_event_format(finish, usage):
+    """Property 14: stream completion event format (design.md:764-768)."""
     ev = TokenEvent.done_event(finish, usage)
     obj = json.loads(dumps(ev))
     assert obj["type"] == "done"
@@ -295,6 +297,7 @@ def test_done_event_format(finish, usage):
 @CASES
 @given(messages=arb_text, code=st.text(min_size=1, max_size=40))
 def test_error_event_format(messages, code):
+    """Property 15: streaming error event format (design.md:770-774)."""
     ev = TokenEvent.error_event(messages, code)
     obj = json.loads(dumps(ev))
     assert obj["type"] == "error"

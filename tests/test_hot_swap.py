@@ -93,6 +93,8 @@ async def _gen(client, prompt="swap test", max_tokens=8):
 
 
 def test_swap_switches_new_requests(server):
+    """Property 28: requests submitted after the swap completes are
+    served by the new model (design.md:848-852 [spec])."""
     async def go(client):
         _, before = await _gen(client)
         resp = await client.post("/admin/model-swap",
@@ -110,6 +112,8 @@ def test_swap_switches_new_requests(server):
 
 
 def test_swap_failure_keeps_old_model(server):
+    """Property 29: a failed swap leaves the server serving the original
+    model without interruption (design.md:854-858 [spec])."""
     async def go(client):
         _, before = await _gen(client)
         resp = await client.post("/admin/model-swap",
@@ -138,8 +142,9 @@ def test_swap_unknown_model_rejected(server):
 
 
 def test_inflight_finishes_on_old_model(server):
-    """Property 29: a request in flight at swap time completes on the old
-    model — its tokens equal the old model's greedy continuation."""
+    """Property 28: a request in flight at swap time completes on the old
+    model — its tokens equal the old model's greedy continuation
+    (design.md:848-852: pre-swap requests are served by the original)."""
     async def go(client):
         _, want = await _gen(client, prompt="long one", max_tokens=48)
 

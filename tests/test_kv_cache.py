@@ -72,10 +72,14 @@ def test_prefix_match_refcounts_protect_pages():
     a.release(rest + more)
 
 
-# -- Property 10/11: LRU eviction & access clocks ---------------------------
+# -- Property 10 / Property 11: LRU eviction & access clocks ----------------
 
 
 def test_lru_eviction_order():
+    """Property 10: the least-recently-used cached page is the eviction
+    victim. Property 11: ``match_prefix`` (a cache access) updates the
+    access clock — touching t1 here is what demotes t2 to LRU victim
+    (design.md:740-750 [spec])."""
     a = PageAllocator(PCFG)
     t1 = [1] * 4
     t2 = [2] * 4

@@ -120,7 +120,8 @@ class TestAdmissionBatcher:
         max_batch=st.integers(min_value=1, max_value=32),
     )
     def test_property4_batch_size_bounds(self, n: int, max_batch: int):
-        """Every dispatched batch has 1 <= size <= max_batch_size."""
+        """Property 4: every dispatched batch has 1 <= size <=
+        max_batch_size (design.md:704-708 [spec])."""
         q, b = self._mk(window_ms=0.0, max_batch=max_batch)
         for i in range(n):
             q.enqueue(QueuedRequest(id=f"r{i}", data=i))
@@ -177,6 +178,8 @@ class TestChooseEngine:
         rr=st.integers(0, 1000),
     )
     def test_property16_only_healthy_selected(self, statuses, strategy, rr):
+        """Property 16 precondition shared by every strategy: routing only
+        ever selects healthy engines (design.md:776-780 [spec])."""
         chosen = choose_engine(strategy, statuses, rr)
         if chosen is None:
             assert not any(s.healthy for s in statuses)
@@ -192,6 +195,9 @@ class TestChooseEngine:
         rr=st.integers(0, 1000),
     )
     def test_property17_least_loaded_minimal(self, statuses, rr):
+        """Property 16 (least-loaded routes to min active batches) with
+        Property 17's memory-aware variant covered below
+        (design.md:776-786 [spec])."""
         chosen = choose_engine(SchedulingStrategy.LEAST_LOADED, statuses, rr)
         healthy = [s for s in statuses if s.healthy]
         if healthy:
@@ -211,6 +217,8 @@ class TestChooseEngine:
         assert picks == ["e0", "e1", "e2", "e0", "e1", "e2"]
 
     def test_memory_aware_prefers_free_pages(self):
+        """Property 17: memory-aware routing picks the engine with the
+        most available KV pages (design.md:782-786 [spec])."""
         statuses = [
             _status("full", used=90, total=100),
             _status("empty", used=10, total=100),
@@ -220,12 +228,71 @@ class TestChooseEngine:
         )
 
     def test_property20_no_healthy_none(self):
+        """Property 20's graceful-failure edge: with zero healthy engines
+        every strategy returns None instead of crashing (the spawn-N side
+        of Property 20 is covered by the server scale tests,
+        design.md:800-804 [spec])."""
         statuses = [_status("e0", healthy=False), _status("e1", healthy=False)]
         for strat in SchedulingStrategy:
             assert choose_engine(strat, statuses, 0) is None
 
 
+class _FakeRunner:
+    """Minimal EngineRunner stand-in for routing/health-loop tests."""
+
+    def __init__(self, eid: str):
+        self.engine_id = eid
+        self.healthy = True
+        self.restarts = 0
+
+    def status(self):
+        return _status(self.engine_id, healthy=self.healthy)
+
+    def is_healthy(self):
+        return self.healthy
+
+    def restart(self, wait_ready=True):
+        self.restarts += 1
+        self.healthy = True
+
+
 class TestAdaptiveScheduler:
+    def test_property18_unhealthy_removed_from_routing(self):
+        """Property 18: an engine that fails its health check leaves the
+        routing pool — no new batch is ever routed to it
+        (design.md:788-792 [spec])."""
+        s = AdaptiveScheduler(SchedulingStrategy.ROUND_ROBIN)
+        good, bad = _FakeRunner("good"), _FakeRunner("bad")
+        s.register(good)
+        s.register(bad)
+        bad.healthy = False
+        picks = {s.schedule().engine_id for _ in range(8)}
+        assert picks == {"good"}
+
+    def test_property19_recovered_engine_reinstated(self):
+        """Property 19: a previously unhealthy engine that passes its
+        health check again is eligible for routing (design.md:794-798
+        [spec]). The health loop's auto-restart is what brings it back."""
+        s = AdaptiveScheduler(
+            SchedulingStrategy.ROUND_ROBIN,
+            health_check_interval_s=0.01,
+            auto_restart=True,
+        )
+        r = _FakeRunner("solo")
+        s.register(r)
+        r.healthy = False
+        assert s.schedule() is None  # removed while unhealthy
+        s.start_health_loop()
+        try:
+            deadline = time.monotonic() + 5.0
+            while r.restarts == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            s.stop_health_loop()
+        assert r.restarts >= 1
+        picked = s.schedule()
+        assert picked is not None and picked.engine_id == "solo"
+
     def test_runtime_strategy_switch(self):
         s = AdaptiveScheduler(SchedulingStrategy.ROUND_ROBIN)
         assert s.strategy() is SchedulingStrategy.ROUND_ROBIN
