@@ -20,6 +20,14 @@ BENCH_COMPARE (default 1 on hardware: measure BOTH attention impls,
 report the better with both numbers in the line; 0 = single BENCH_IMPL
 run), BENCH_FORCE_CPU=1 (tiny-model smoke mode),
 BENCH_INIT_TIMEOUT_S (180).
+
+Scale knobs (BASELINE.json's metric is tok/s/chip AT 8B — measure it):
+BENCH_MODEL (any models/configs.py preset; default llama-3.2-1b),
+BENCH_QUANT (none|int8|int4 — weight-only; int8 fits 8B on one v5e:
+  BENCH_MODEL=llama-3-8b BENCH_QUANT=int8 BENCH_BATCH=32 python bench.py),
+BENCH_HBM_GBPS (819, v5e HBM bandwidth for the roofline estimate printed
+alongside every hardware run: roofline tok/s = batch * BW / weight
+bytes — the weight-read bound a decode step cannot beat).
 """
 
 from __future__ import annotations
@@ -35,8 +43,30 @@ def _emit(obj) -> None:
     print(json.dumps(obj), flush=True)
 
 
+_MODEL_SLUGS = {
+    "llama-3.2-1b": "llama1b",
+    "llama-3-8b": "llama8b",
+    "llama-3-70b": "llama70b",
+    "mistral-7b": "mistral7b",
+    "qwen2-7b": "qwen7b",
+    "gemma2-9b": "gemma9b",
+    "mixtral-8x7b": "mixtral",
+}
+
+
 def main() -> None:
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
+    model_name = os.environ.get("BENCH_MODEL", "llama-3.2-1b")
+    quant = os.environ.get("BENCH_QUANT", "none")
+    slug = _MODEL_SLUGS.get(
+        model_name, "".join(c for c in model_name if c.isalnum())
+    )
+    metric = (
+        "decode_tokens_per_sec_tiny_cpu" if force_cpu
+        else "decode_tokens_per_sec_%s_%s" % (
+            slug, quant if quant != "none" else "bf16"
+        )
+    )
     batch = int(os.environ.get("BENCH_BATCH", "64"))
     prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
     new_tokens = int(os.environ.get("BENCH_NEW", "128"))
@@ -66,7 +96,7 @@ def main() -> None:
                 continue
         if not alive:
             _emit({
-                "metric": "decode_tokens_per_sec_llama1b_bf16",
+                "metric": metric,
                 "value": 0.0,
                 "unit": "tokens/s",
                 "vs_baseline": 0.0,
@@ -82,7 +112,7 @@ def main() -> None:
     def _watchdog():
         if not init_done.wait(init_timeout):
             _emit({
-                "metric": "decode_tokens_per_sec_llama1b_bf16",
+                "metric": metric,
                 "value": 0.0,
                 "unit": "tokens/s",
                 "vs_baseline": 0.0,
@@ -111,8 +141,11 @@ def main() -> None:
     )
     from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
     from distributed_inference_server_tpu.models import llama
-    from distributed_inference_server_tpu.models.configs import LLAMA_3_2_1B, TINY
+    from distributed_inference_server_tpu.models.configs import TINY, get_config
     from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.ops.quant import (
+        init_random_quantized,
+    )
 
     if force_cpu:
         cfg, dtype = TINY, jnp.float32
@@ -120,7 +153,16 @@ def main() -> None:
         paged = PagedCacheConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
         buckets = (32, 64)
     else:
-        cfg, dtype = LLAMA_3_2_1B, jnp.bfloat16
+        try:
+            cfg = get_config(model_name)
+        except KeyError as e:
+            # keep the always-emit-JSON contract of the other error paths
+            _emit({
+                "metric": metric, "value": 0.0, "unit": "tokens/s",
+                "vs_baseline": 0.0, "error": str(e),
+            })
+            sys.exit(2)
+        dtype = jnp.bfloat16
         pages_per_seq = -(-(prompt_len + new_tokens + 16) // 16)
         paged = PagedCacheConfig(
             num_pages=(batch + 2) * pages_per_seq + 16,
@@ -129,8 +171,22 @@ def main() -> None:
         )
         buckets = (prompt_len, max(256, prompt_len))
 
-    params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    if quant != "none":
+        # quantized leaves are created directly (no dense intermediate):
+        # 8B bf16 (~16 GB) would not fit one v5e chip, 8B int8 (~8 GB) does
+        params = init_random_quantized(
+            jax.random.PRNGKey(0), cfg, quant, dtype=dtype
+        )
+    else:
+        params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     jax.block_until_ready(params)
+    # HBM roofline: every decode step reads every weight byte once, so
+    # steps/s <= BW / weight_bytes and tok/s <= batch * steps/s
+    weight_bytes = sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(params)
+    )
+    hbm_gbps = float(os.environ.get("BENCH_HBM_GBPS", "819"))
+    roofline = batch * hbm_gbps * 1e9 / max(1, weight_bytes)
     rng = np.random.default_rng(0)
 
     def run_once(use_impl: str) -> dict:
@@ -185,7 +241,8 @@ def main() -> None:
                 now = time.perf_counter() - t0
                 while pending and arrival_at[pending[0]] <= now:
                     add(pending.pop(0), new_tokens)
-                for out in engine.step():
+                outs = engine.step()
+                for out in outs:
                     if out.token_id is not None:
                         produced += 1
                         rid = out.request_id
@@ -193,12 +250,20 @@ def main() -> None:
                             ttfts[rid] = (
                                 time.perf_counter() - t0 - arrival_at[rid]
                             )
-                if not engine.has_work() and pending:
-                    time.sleep(min(
-                        0.005,
-                        max(0.0, arrival_at[pending[0]] - (
-                            time.perf_counter() - t0)),
-                    ))
+                if not outs:
+                    # nothing surfaced this pass — sleep toward the next
+                    # arrival instead of hot-spinning the host between
+                    # events (the spin perturbs the TTFT being measured);
+                    # a device block may still be in flight, so cap the
+                    # nap well under a block's service time
+                    wait = (
+                        arrival_at[pending[0]] - (time.perf_counter() - t0)
+                        if pending else 0.005
+                    )
+                    if engine.has_work():
+                        wait = min(wait, 0.001)
+                    if wait > 0:
+                        time.sleep(min(0.005, wait))
             elapsed = time.perf_counter() - t0
         else:
             for i in range(batch):
@@ -253,7 +318,7 @@ def main() -> None:
             # both paths died: emit an explicit error record (matching
             # the tunnel-down/watchdog contract) and exit nonzero
             _emit({
-                "metric": "decode_tokens_per_sec_llama1b_bf16",
+                "metric": metric,
                 "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
                 "error": "both attention impls failed", **extra,
             })
@@ -265,7 +330,7 @@ def main() -> None:
             # same structured-error contract as the tunnel-down /
             # both-failed paths: always emit a JSON record
             _emit({
-                "metric": "decode_tokens_per_sec_llama1b_bf16",
+                "metric": metric,
                 "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
                 "attention_impl": impl,
                 "error": str(e).split("\n")[0][:200],
@@ -273,19 +338,19 @@ def main() -> None:
             sys.exit(3)
 
     tput = r["tput"]
-    base_metric = (
-        "decode_tokens_per_sec_llama1b_bf16"
-        if not force_cpu else "decode_tokens_per_sec_tiny_cpu"
-    )
     _emit({
         # steady-state (arrival-limited) runs get their own metric name:
         # their throughput reflects offered load, not engine capacity,
         # and must not be trended against the burst-mode number
-        "metric": base_metric + ("_steady" if rate_rps > 0 else ""),
+        "metric": metric + ("_steady" if rate_rps > 0 else ""),
         "value": round(tput, 2),
         "unit": "tokens/s",
         "vs_baseline": round(tput / 2000.0, 4),
         "platform": platform,
+        "model": cfg.name,
+        **({"quant": quant} if quant != "none" else {}),
+        "weight_bytes": weight_bytes,
+        "roofline_tokens_per_sec": round(roofline, 1),
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,

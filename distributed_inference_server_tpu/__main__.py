@@ -13,6 +13,18 @@ import sys
 
 
 def main(argv=None) -> int:
+    import os
+
+    # explicit backend pin: site hooks (e.g. an axon sitecustomize) may
+    # force a device platform ahead of CPU; with that device's transport
+    # down, backend init hangs minutes before falling back. DIS_TPU_PLATFORM
+    # must win over such hooks, so apply it before anything touches jax.
+    platform = os.environ.get("DIS_TPU_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
     from distributed_inference_server_tpu.core.errors import (
         ConfigError,
         ModelLoadError,

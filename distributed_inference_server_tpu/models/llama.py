@@ -239,27 +239,32 @@ def _run_layers(
     h = params["embed"][input_ids]  # [B, T, H]
     if cfg.scale_embeddings:  # Gemma: embeddings scale by sqrt(hidden)
         h = h * jnp.asarray(cfg.hidden_size**0.5, h.dtype)
-    if cfg.sliding_window:
-        # per-layer sliding windows ride the scan as data (0 = full
-        # causal), so Gemma-2's alternating local/global layers share ONE
-        # compiled block body — no per-layer recompile, no unrolled scan
-        windows = jnp.asarray(cfg.layer_windows(), jnp.int32)
+    windows = (
+        jnp.asarray(cfg.layer_windows(), jnp.int32)
+        if cfg.sliding_window else None
+    )
+    h, (new_k, new_v) = scan_layer_blocks(
+        cfg, h, params["layers"], cache_k, cache_v, windows, positions,
+        write_fn, attend_fn, inv_freq, moe_impl, valid_tokens,
+    )
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return h, new_k, new_v
 
-        def block(h, xs):
-            layer, k_layer, v_layer, window = xs
-            return layer_block(
-                cfg, layer, h, positions, k_layer, v_layer, write_fn,
-                attend_fn, inv_freq, moe_impl, valid_tokens, window=window,
-            )
 
-        h, (new_k, new_v) = lax.scan(
-            block, h, (params["layers"], cache_k, cache_v, windows)
-        )
-    else:
-        # no layer slides: pass None STATICALLY so full-causal models keep
-        # gqa_attention's maskless branch instead of paying a traced
-        # (w <= 0) | ... [B, T, S] term every layer
+def scan_layer_blocks(cfg, h, layers, cache_k, cache_v, windows, positions,
+                      write_fn, attend_fn, inv_freq, moe_impl="dense",
+                      valid_tokens=None):
+    """``lax.scan`` over stacked layer blocks — the one place the scan
+    body exists (``_run_layers`` and both pipeline-parallel stage runners
+    in parallel/pp.py drive their layer stacks through here).
 
+    ``windows`` rides the scan as per-layer data (Gemma-2's alternating
+    local/global schedule shares ONE compiled block body — no per-layer
+    recompile, no unrolled scan) or is None when no layer slides: then
+    window=None is passed STATICALLY so full-causal models keep
+    gqa_attention's maskless branch instead of paying a traced
+    (w <= 0) | ... [B, T, S] term every layer."""
+    if windows is None:
         def block(h, xs):
             layer, k_layer, v_layer = xs
             return layer_block(
@@ -267,11 +272,16 @@ def _run_layers(
                 attend_fn, inv_freq, moe_impl, valid_tokens, window=None,
             )
 
-        h, (new_k, new_v) = lax.scan(
-            block, h, (params["layers"], cache_k, cache_v)
+        return lax.scan(block, h, (layers, cache_k, cache_v))
+
+    def block(h, xs):
+        layer, k_layer, v_layer, window = xs
+        return layer_block(
+            cfg, layer, h, positions, k_layer, v_layer, write_fn,
+            attend_fn, inv_freq, moe_impl, valid_tokens, window=window,
         )
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-    return h, new_k, new_v
+
+    return lax.scan(block, h, (layers, cache_k, cache_v, windows))
 
 
 def layer_block(

@@ -115,6 +115,70 @@ def is_quantized(w: Any) -> bool:
     return isinstance(w, (Q8Tensor, Q4Tensor))
 
 
+def init_random_quantized(
+    rng, cfg, mode: str, dtype=jnp.bfloat16, group_size: int = 0
+) -> Dict[str, Any]:
+    """Random param tree with the linear families created DIRECTLY in
+    quantized form — no dense intermediate. ``quantize_params`` over
+    ``llama.init_params`` would materialize the full-precision tree
+    first, which at 8B bf16 (~16 GB) exceeds one v5e chip's HBM; this
+    builds int8/int4 leaves from random bits (an 8B int8 tree is ~8 GB),
+    so single-chip 8B benchmarking is possible. Weight content is
+    irrelevant to throughput; scales are 1/(qmax*sqrt(d_in)) so
+    dequantized magnitudes match init_params' 0.02-ish normal init.
+    """
+    import jax
+    from jax.tree_util import (
+        DictKey,
+        tree_flatten_with_path,
+        tree_unflatten,
+    )
+
+    from distributed_inference_server_tpu.models import llama
+
+    if mode == "none":
+        return llama.init_params(rng, cfg, dtype=dtype)
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    qmax = 127 if mode == "int8" else 7
+    gs_default = group_size or (128 if mode == "int8" else 64)
+
+    shapes = jax.eval_shape(
+        lambda k: llama.init_params(k, cfg, dtype=dtype), rng
+    )
+    leaves, treedef = tree_flatten_with_path(shapes)
+    keys = jax.random.split(rng, len(leaves))
+
+    def quant_leaf(shape, k):
+        *lead, d_in, d_out = shape
+        gs = min(gs_default, d_in)
+        s = jnp.full(
+            (*lead, d_in // gs, d_out),
+            1.0 / (qmax * (d_in ** 0.5)), jnp.float32,
+        )
+        if mode == "int8":
+            bits = jax.random.bits(k, tuple(shape), jnp.uint8)
+            return Q8Tensor(
+                q=jax.lax.bitcast_convert_type(bits, jnp.int8), s=s
+            )
+        packed = jax.random.bits(k, (*lead, d_in // 2, d_out), jnp.uint8)
+        return Q4Tensor(q=packed, s=s)
+
+    new_leaves = []
+    for (path, sds), k in zip(leaves, keys):
+        name = path[-1].key if isinstance(path[-1], DictKey) else ""
+        if name in _QUANT_KEYS:
+            new_leaves.append(quant_leaf(sds.shape, k))
+        elif name.endswith("norm"):
+            new_leaves.append(jnp.ones(sds.shape, sds.dtype))
+        else:
+            new_leaves.append(
+                (jax.random.normal(k, sds.shape, jnp.float32) * 0.02)
+                .astype(sds.dtype)
+            )
+    return tree_unflatten(treedef, new_leaves)
+
+
 def dense_view(w: Any, dtype=jnp.bfloat16) -> jnp.ndarray:
     """Dense array for a possibly-quantized weight (pass-through for plain
     arrays) — the single dispatch point for matmul/einsum call sites."""

@@ -101,28 +101,9 @@ def pp_forward(
             attend_fn = lambda q, k, v, w: gqa_attention(
                 q, k, v, pos_mb, kvv_mb, w, cfg.attn_logit_softcap)
 
-            if win_stage is None:
-                def blk(h, xs):
-                    layer, k_l, v_l = xs
-                    return llama.layer_block(
-                        cfg, layer, h, pos_mb, k_l, v_l, write_fn, attend_fn,
-                        inv_freq, window=None,
-                    )
-
-                h_mb, (nk, nv) = lax.scan(
-                    blk, h_mb, (layers, ck_mb, cv_mb)
-                )
-                return h_mb, nk, nv
-
-            def blk(h, xs):
-                layer, k_l, v_l, w = xs
-                return llama.layer_block(
-                    cfg, layer, h, pos_mb, k_l, v_l, write_fn, attend_fn,
-                    inv_freq, window=w,
-                )
-
-            h_mb, (nk, nv) = lax.scan(
-                blk, h_mb, (layers, ck_mb, cv_mb, win_stage)
+            h_mb, (nk, nv) = llama.scan_layer_blocks(
+                cfg, h_mb, layers, ck_mb, cv_mb, win_stage, pos_mb,
+                write_fn, attend_fn, inv_freq,
             )
             return h_mb, nk, nv
 
@@ -270,26 +251,9 @@ def pp_paged_forward(
                 return gqa_attention(q, k_seq, v_seq, pos_mb, kvv_mb, w,
                                      cfg.attn_logit_softcap)
 
-            if win_stage is None:
-                def blk(h, xs):
-                    layer, k_l, v_l = xs
-                    return llama.layer_block(
-                        cfg, layer, h, pos_mb, k_l, v_l, write_fn, attend_fn,
-                        inv_freq, window=None,
-                    )
-
-                h_mb, (nk, nv) = lax.scan(blk, h_mb, (layers, pk, pv))
-                return h_mb, nk, nv
-
-            def blk(h, xs):
-                layer, k_l, v_l, w = xs
-                return llama.layer_block(
-                    cfg, layer, h, pos_mb, k_l, v_l, write_fn, attend_fn,
-                    inv_freq, window=w,
-                )
-
-            h_mb, (nk, nv) = lax.scan(
-                blk, h_mb, (layers, pk, pv, win_stage)
+            h_mb, (nk, nv) = llama.scan_layer_blocks(
+                cfg, h_mb, layers, pk, pv, win_stage, pos_mb,
+                write_fn, attend_fn, inv_freq,
             )
             return h_mb, nk, nv
 

@@ -483,10 +483,14 @@ class EngineRunner:
             req = self._inflight.get(out.request_id)
             if req is None:
                 continue
-            done_delivered = False
+            # a terminal event (done OR error) already reached the sink:
+            # the stream is resolved, so the except arm must not send a
+            # second terminal event and must still count the request
+            terminal_delivered = False
             try:
                 if out.error is not None:
                     req.sink.on_error(out.error, "inference_failed")
+                    terminal_delivered = True
                 elif out.token_id is not None or out.text:
                     if req.first_token_at is None:
                         req.first_token_at = time.monotonic()
@@ -510,7 +514,7 @@ class EngineRunner:
                             out.finish_reason or FinishReason.STOP,
                             out.usage or Usage(),
                         )
-                        done_delivered = True
+                        terminal_delivered = True
                     if self.tracer and req.engine_span is not None:
                         if out.usage is not None:
                             req.engine_span.set(
@@ -528,18 +532,19 @@ class EngineRunner:
                 # client's future waits forever on a request the runner
                 # no longer tracks (on_error is a different method — it
                 # may well work even when on_token just raised). But if
-                # on_done already succeeded (e.g. tracer.finish raised
-                # after), the request IS resolved — an error event after
-                # a done event would contradict the stream contract.
-                if not done_delivered:
+                # a terminal event already succeeded (e.g. tracer.finish
+                # raised after on_done/on_error), the request IS resolved
+                # — a second terminal event would contradict the stream
+                # contract.
+                if not terminal_delivered:
                     try:
                         req.sink.on_error(f"sink failure: {e}",
                                           "server_error")
                     except Exception:  # noqa: BLE001
                         pass
-                else:
-                    # the request DID complete (client saw done) — only
-                    # post-done bookkeeping raised; keep the count honest
+                elif out.finished:
+                    # the request DID resolve — only post-terminal
+                    # bookkeeping raised; keep the count honest
                     self._total_processed += 1
                 self._inflight.pop(out.request_id, None)
         if self.metrics and tokens:

@@ -158,3 +158,47 @@ def test_quantized_default_group_shards_with_tp():
     qparams = quantize_params(params, "int8")  # default group_size
     sharded = shard_params(qparams, mesh, TINY)  # must not raise
     assert isinstance(sharded["layers"]["wo"], Q8Tensor)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_init_random_quantized_matches_quantize_params_structure(mode):
+    """init_random_quantized builds the SAME pytree structure as
+    quantize_params(init_params(...)) — same treedef, same leaf shapes
+    and dtypes — without materializing the dense tree (the 8B-int8
+    single-chip bench path, bench.py BENCH_QUANT)."""
+    from distributed_inference_server_tpu.ops.quant import (
+        init_random_quantized,
+    )
+
+    key = jax.random.PRNGKey(1)
+    want = quantize_params(
+        llama.init_params(key, TINY, dtype=jnp.float32), mode
+    )
+    got = init_random_quantized(key, TINY, mode, dtype=jnp.float32)
+    wl, wd = jax.tree_util.tree_flatten(want)
+    gl, gd = jax.tree_util.tree_flatten(got)
+    assert wd == gd
+    for w, g in zip(wl, gl):
+        assert w.shape == g.shape and w.dtype == g.dtype
+
+
+def test_init_random_quantized_generates():
+    """A model built from init_random_quantized decodes finite logits
+    end-to-end (dequant fuses into the matmuls; content is random but
+    numerics must stay finite)."""
+    from distributed_inference_server_tpu.ops.quant import (
+        init_random_quantized,
+    )
+
+    params = init_random_quantized(
+        jax.random.PRNGKey(2), TINY, "int8", dtype=jnp.float32
+    )
+    B, T = 2, 8
+    ids = jnp.ones((B, T), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+    valid = jnp.full((B,), T, jnp.int32)
+    cache = llama.KVCache.create(TINY, B, T, dtype=jnp.float32)
+    logits = llama.forward(
+        params, TINY, ids, positions, cache, positions, valid
+    )[0]
+    assert bool(jnp.isfinite(logits).all())
