@@ -59,6 +59,9 @@ from distributed_inference_server_tpu.engine.speculative import (
     _probs as spec_probs,
     accept_and_resample as spec_accept_resample,
 )
+from distributed_inference_server_tpu.ops.sampling import (
+    nucleus_probs as spec_nucleus,
+)
 from distributed_inference_server_tpu.models import llama
 from distributed_inference_server_tpu.models.configs import ModelConfig
 from distributed_inference_server_tpu.models.tokenizer import Tokenizer
@@ -387,9 +390,12 @@ class LLMEngine:
         self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
         self._cp_fns: Dict[int, Callable] = {}
         self._block_fn = self._build_decode_block()
-        self._spec_block_fn = (
-            self._build_spec_block() if draft_params is not None else None
-        )
+        # speculative block variants keyed by use_topp: the nucleus-aware
+        # verify pays full-vocab sorts per round, so all-greedy/top_p=1
+        # launches dispatch a variant compiled without them
+        self._spec_block_fns: Dict[bool, Callable] = {}
+        if draft_params is not None:
+            self._spec_block_fns[False] = self._build_spec_block(False)
         self._sample_fn = jax.jit(sample_tokens)
 
     # ------------------------------------------------------------------
@@ -1245,7 +1251,17 @@ class LLMEngine:
 
         return self._with_mesh(block)
 
-    def _build_spec_block(self) -> Callable:
+    def _get_spec_block(self, use_topp: bool) -> Callable:
+        """Speculative block variant for this launch: the use_topp=True
+        variant (nucleus-aware verify) compiles lazily on the first
+        launch that seats a top_p<1 row."""
+        fn = self._spec_block_fns.get(use_topp)
+        if fn is None:
+            fn = self._build_spec_block(use_topp)
+            self._spec_block_fns[use_topp] = fn
+        return fn
+
+    def _build_spec_block(self, use_topp: bool) -> Callable:
         """Compile the speculative decode block (Req 12): R rounds of
         (draft proposes gamma tokens over its own page pool -> target
         verifies all of them in ONE T=gamma+1 paged forward -> rejection
@@ -1253,10 +1269,16 @@ class LLMEngine:
         program. Per round a row emits 1..gamma+1 tokens.
 
         Temperature-0 rows accept by exact greedy match (bit-identical to
-        plain decoding, tested); top-p rows cannot be verified exactly, so
-        they ride along with forced rejection at position 0 and their
-        resample distribution top-p filtered — one exact top-p token per
-        round. EOS truncates a row's emissions and freezes it on-device.
+        plain decoding, tested); top-p rows are verified NUCLEUS-AWARE —
+        the draft samples from its top-p-filtered q̃ and the verifier
+        scores against the filtered target p̃, so they keep full
+        multi-token acceptance and their output law is exactly nucleus
+        sampling from the target (tested for distribution exactness).
+        The nucleus machinery costs full-vocab sorts per round, so it is
+        compiled in only when ``use_topp`` — launches whose seated rows
+        are all top_p=1 dispatch the variant without it (see
+        ``_get_spec_block``). EOS truncates a row's emissions and freezes
+        it on-device.
         Writes past the row's capacity are dropped (speculative overshoot
         near max_seq_len)."""
         cfg, dcfg = self.cfg, self.draft_cfg
@@ -1314,7 +1336,12 @@ class LLMEngine:
                         dparams, dcfg, tok[:, None], pos[:, None],
                         dpk, dpv, write, gather, kv_valid, impl, "dense",
                     )
+                    # proposals MUST be sampled from the same nucleus-
+                    # filtered q̃ the verifier scores against (top_p=1
+                    # rows: identity, so the sorts are compiled out)
                     q = spec_probs(logits[:, 0], temp)
+                    if use_topp:
+                        q = spec_nucleus(q, top_p)
                     nxt = jax.random.categorical(
                         key, jnp.log(q + 1e-30), axis=-1
                     ).astype(jnp.int32)
@@ -1350,13 +1377,12 @@ class LLMEngine:
                 )
 
                 # ---- rejection sampling (shared speculative.py core) ----
-                # top-p rows can't be verified exactly: force rejection at
-                # 0 and top-p filter the resample distribution — exactly
-                # one correctly-sampled token per round
-                spec_ok = top_p >= 1.0
+                # nucleus-aware: the core filters BOTH sides to each row's
+                # top-p nucleus (the draft sampled from that same q̃
+                # above), so top-p rows keep full multi-token acceptance
                 toks_out, num_accepted = spec_accept_resample(
                     tps, dtoks, dqs, keys[gamma + 1], keys[gamma + 2],
-                    spec_ok=spec_ok, top_p=top_p,
+                    top_p=top_p if use_topp else None,
                 )
                 idx = jnp.arange(W)[None]
                 base = num_accepted + 1
@@ -1371,8 +1397,8 @@ class LLMEngine:
                     has_eos, jnp.minimum(base, first_eos + 1), base
                 )
                 emitted = jnp.where(active, emitted, 0)
-                acc_out = jnp.where(active & spec_ok, num_accepted, 0)
-                prop_out = jnp.where(active & spec_ok, gamma, 0)
+                acc_out = jnp.where(active, num_accepted, 0)
+                prop_out = jnp.where(active, gamma, 0)
                 toks_out = jnp.where(
                     (idx < emitted[:, None]) & active[:, None], toks_out, -1
                 )
@@ -1582,10 +1608,12 @@ class LLMEngine:
         )
         snapshot = [(i, s, advs[id(s)]) for i, s in seated]
         if use_spec:
+            # nucleus machinery only when a seated row actually needs it
+            use_topp = any(s.params.top_p < 1.0 for _, s in seated)
             (toks, lps, counts, acc, prop, tokens, positions, steps_left,
              active, self.state.k, self.state.v,
              self.draft_state.k, self.draft_state.v,
-             rng) = self._spec_block_fn(
+             rng) = self._get_spec_block(use_topp)(
                 self.params, self.draft_params,
                 self.state.k, self.state.v,
                 self.draft_state.k, self.draft_state.v,

@@ -73,3 +73,27 @@ def top_p_filter_probs(probs: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
     kept = jnp.where(keep_sorted, sorted_probs, jnp.inf)
     cutoff = jnp.min(kept, axis=-1, keepdims=True)
     return jnp.where(probs >= cutoff, probs, 0.0)
+
+
+def nucleus_probs(probs: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """NORMALIZED nucleus distribution: top-p filter, then renormalize.
+
+    The speculative verifier needs true distributions (the accept ratio
+    p̃/q̃ and the residual max(p̃-q̃, 0) are only meaningful when both
+    sides sum to 1), unlike ``categorical`` callers for whom the
+    unnormalized ``top_p_filter_probs`` suffices.
+
+    Args:
+      probs: [..., V] probability rows (each summing to 1).
+      top_p: broadcastable to probs.shape[:-1]; 1 => identity.
+
+    Returns: [..., V] renormalized nucleus distributions.
+    """
+    lead = probs.shape[:-1]
+    V = probs.shape[-1]
+    f = top_p_filter_probs(
+        probs.reshape(-1, V),
+        jnp.broadcast_to(top_p, lead).reshape(-1),
+    )
+    f = f / jnp.maximum(jnp.sum(f, axis=-1, keepdims=True), 1e-30)
+    return f.reshape(*lead, V)

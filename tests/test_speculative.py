@@ -162,3 +162,46 @@ class TestProbationReenable:
         assert not t.enabled
         t.reset()
         assert t.enabled
+
+
+def test_nucleus_aware_acceptance_is_distribution_exact():
+    """Statistical exactness of nucleus-aware verification: with draft
+    proposals sampled from the draft's filtered q̃, the first token each
+    round must be distributed exactly as NUCLEUS sampling from the
+    target, p̃ = norm(top_p_filter(p)) — the whole point of rejection
+    sampling. Also: acceptance must be high enough that top-p rows emit
+    >1 token per round on average (the old forced-rejection path pinned
+    this to exactly 1)."""
+    from distributed_inference_server_tpu.engine.speculative import (
+        accept_and_resample,
+    )
+    from distributed_inference_server_tpu.ops.sampling import nucleus_probs
+
+    V, gamma, N = 8, 2, 40_000
+    key = jax.random.PRNGKey(3)
+    kp, kq, kd, ku, kr = jax.random.split(key, 5)
+    p = jax.nn.softmax(jax.random.normal(kp, (V,)) * 1.5)
+    q = jax.nn.softmax(jax.random.normal(kq, (V,)) * 1.5)
+    topp = jnp.full((N,), 0.9, jnp.float32)
+
+    q_f = nucleus_probs(q[None], jnp.asarray([0.9]))[0]  # draft's q̃
+    draft_qs = jnp.broadcast_to(q_f, (N, gamma, V))
+    draft_toks = jax.random.categorical(
+        kd, jnp.log(draft_qs + 1e-30), axis=-1
+    ).astype(jnp.int32)
+    target_ps = jnp.broadcast_to(p, (N, gamma + 1, V))
+
+    tokens, num_accepted = accept_and_resample(
+        target_ps, draft_toks, draft_qs, ku, kr, top_p=topp,
+    )
+    p_f = np.asarray(nucleus_probs(p[None], jnp.asarray([0.9]))[0])
+
+    first = np.asarray(tokens[:, 0])
+    hist = np.bincount(first, minlength=V) / N
+    # outside-nucleus tokens must never be emitted
+    assert hist[p_f == 0].sum() == 0.0
+    np.testing.assert_allclose(hist, p_f, atol=0.02)
+
+    # >1 expected emitted tokens per round for top-p rows
+    emitted = np.asarray(num_accepted) + 1
+    assert emitted.mean() > 1.2, emitted.mean()
