@@ -283,11 +283,6 @@ class LLMEngine:
 
             pp = mesh.shape.get("stage", 1)
             stage_axis = "stage" if pp > 1 else None
-            if mesh.shape.get("seq", 1) > 1 and pp > 1:
-                raise NotImplementedError(
-                    "context-parallel prefill (seq axis) under pipeline "
-                    "parallelism (stage axis) is not supported yet"
-                )
             if self.ecfg.sp_impl not in ("ring", "ulysses"):
                 raise ValueError(
                     f"sp_impl must be 'ring' or 'ulysses', got "
@@ -789,15 +784,25 @@ class LLMEngine:
     def _cp_threshold(self) -> Optional[int]:
         """Prompt length from which ring prefill over the ``seq`` mesh axis
         kicks in (VERDICT r1: long-context serving must be reachable from
-        the engine, not a standalone demo). None = CP unavailable."""
+        the engine, not a standalone demo). None = CP unavailable.
+
+        CP x PP composition: under a ``stage`` axis the ring programs are
+        not used — ring attention is itself a manual shard_map over
+        ``seq``/``tensor``, and nesting it under the GPipe stage loop's
+        manual ``stage`` shard_map deadlocks XLA's collective scheduling
+        (verified on the CPU backend; the same ordering hazard exists on
+        ICI). Long prompts on a seq x stage mesh instead take the
+        PP-capable batched CHUNKED prefill path: same O(T^2) attention
+        FLOPs spread over the stage group, context bounded by the page
+        pool's max_seq_len rather than by one chip's dense-ring buffer —
+        the bound that matters (HBM) is unchanged, only the prefill
+        latency loses the ring overlap. Tested end-to-end in
+        tests/test_cp_engine.py::TestCPEngine::
+        test_seq_with_stage_takes_chunked_fallback and dryrun 'CP-PP'."""
         if self.mesh is None or self.mesh.shape.get("seq", 1) <= 1:
             return None
-        if self.cfg.sliding_window_pattern or self.cfg.attn_logit_softcap:
-            # Gemma-2-class models skip CP: the CP attends would apply one
-            # uniform window to every layer (wrong for alternating
-            # local/global schedules) and have no score soft-capping —
-            # long prompts take the chunked-prefill path instead
-            return None
+        if self.mesh.shape.get("stage", 1) > 1:
+            return None  # chunked-prefill fallback (see docstring)
         if self.ecfg.cp_min_tokens is not None:
             return self.ecfg.cp_min_tokens
         return self.ecfg.prefill_buckets[-1] + 1

@@ -35,7 +35,8 @@ def ring_attention(
     q_positions: jnp.ndarray,
     kv_positions: jnp.ndarray,
     axis_name: str = "seq",
-    sliding_window: int | None = None,
+    sliding_window=None,
+    attn_softcap: float | None = None,
 ) -> jnp.ndarray:
     """Per-shard ring attention body (must run inside shard_map/pmap).
 
@@ -47,6 +48,11 @@ def ring_attention(
       kv_positions: [B, Tl] absolute positions of local keys; negative
         positions mark padding keys (never attended).
       axis_name: the mesh axis the ring runs over.
+      sliding_window: None = full causal (static fast path); otherwise a
+        scalar — possibly TRACED (Gemma-2 per-layer windows ride the
+        layer scan) — where <= 0 means full causal.
+      attn_softcap: Gemma-2 score soft-capping, tanh(s/cap)*cap applied
+        before masking (None = off; static).
 
     Returns [B, Tl, H, D] in q.dtype — attention over the FULL sequence.
     """
@@ -64,10 +70,13 @@ def ring_attention(
         s = jnp.einsum(
             "btkgd,bskd->bkgts", qg, k_blk.astype(jnp.float32)
         ) * scale
+        if attn_softcap is not None:
+            s = jnp.tanh(s / attn_softcap) * attn_softcap
         causal = pos_kv[:, None, :] <= q_positions[:, :, None]  # [B, Tl, S]
         if sliding_window is not None:
-            causal &= (
-                pos_kv[:, None, :] > q_positions[:, :, None] - sliding_window
+            w = jnp.asarray(sliding_window, jnp.int32)
+            causal &= (w <= 0) | (
+                pos_kv[:, None, :] > q_positions[:, :, None] - w
             )
         valid = (pos_kv >= 0)[:, None, :] & (q_positions >= 0)[:, :, None]
         mask = (causal & valid)[:, None, None, :, :]
@@ -123,23 +132,39 @@ def ring_attention_sharded(
     q_positions: jnp.ndarray,
     kv_positions: jnp.ndarray,
     axis_name: str = "seq",
-    sliding_window: int | None = None,
+    sliding_window=None,
+    attn_softcap: float | None = None,
 ) -> jnp.ndarray:
     """shard_map wrapper: sequence dim sharded over ``axis_name``, heads
     over ``tensor`` (ring attention composes with TP: each tensor shard
-    rings its own heads)."""
+    rings its own heads). ``sliding_window`` may be a traced scalar (it
+    rides the specs as a replicated operand, never a closure capture)."""
+    row_specs = (
+        P("data", axis_name, "tensor", None),
+        P("data", axis_name, "tensor", None),
+        P("data", axis_name, "tensor", None),
+        P("data", axis_name),
+        P("data", axis_name),
+    )
+    if sliding_window is None:
+        fn = jax.shard_map(
+            lambda *a: ring_attention(*a, axis_name=axis_name,
+                                      attn_softcap=attn_softcap),
+            mesh=mesh,
+            in_specs=row_specs,
+            out_specs=P("data", axis_name, "tensor", None),
+            check_vma=False,
+        )
+        return fn(q, k, v, q_positions, kv_positions)
     fn = jax.shard_map(
-        lambda *a: ring_attention(*a, axis_name=axis_name,
-                                  sliding_window=sliding_window),
-        mesh=mesh,
-        in_specs=(
-            P("data", axis_name, "tensor", None),
-            P("data", axis_name, "tensor", None),
-            P("data", axis_name, "tensor", None),
-            P("data", axis_name),
-            P("data", axis_name),
+        lambda q, k, v, qp, kp, w: ring_attention(
+            q, k, v, qp, kp, axis_name=axis_name, sliding_window=w,
+            attn_softcap=attn_softcap,
         ),
+        mesh=mesh,
+        in_specs=row_specs + (P(),),  # window: replicated scalar
         out_specs=P("data", axis_name, "tensor", None),
         check_vma=False,
     )
-    return fn(q, k, v, q_positions, kv_positions)
+    return fn(q, k, v, q_positions, kv_positions,
+              jnp.asarray(sliding_window, jnp.int32))

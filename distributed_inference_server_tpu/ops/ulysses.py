@@ -34,7 +34,8 @@ def ulysses_attention(
     q_positions: jnp.ndarray,
     kv_valid_len: jnp.ndarray,
     axis_name: str = "seq",
-    sliding_window: int | None = None,
+    sliding_window=None,
+    attn_softcap: float | None = None,
 ) -> jnp.ndarray:
     """Per-shard Ulysses attention body (must run inside shard_map).
 
@@ -62,7 +63,8 @@ def ulysses_attention(
     pos = lax.all_gather(q_positions, axis_name, axis=1, tiled=True)  # [B, T]
     # full-sequence causal attention for this device's head group; padding
     # keys sit at positions >= kv_valid_len (right-padded) and are masked
-    out = gqa_attention(qh, kh, vh, pos, kv_valid_len, sliding_window)
+    out = gqa_attention(qh, kh, vh, pos, kv_valid_len, sliding_window,
+                        attn_softcap)
     # gather heads / scatter sequence back: [B, T, H/s, D] -> [B, Tl, H, D]
     return lax.all_to_all(
         out, axis_name, split_axis=1, concat_axis=2, tiled=True
@@ -77,23 +79,39 @@ def ulysses_attention_sharded(
     q_positions: jnp.ndarray,
     kv_valid_len: jnp.ndarray,
     axis_name: str = "seq",
-    sliding_window: int | None = None,
+    sliding_window=None,
+    attn_softcap: float | None = None,
 ) -> jnp.ndarray:
     """shard_map wrapper: sequence over ``axis_name``, heads over
     ``tensor`` (Ulysses composes with TP: the all-to-all re-shards each
-    tensor shard's own heads)."""
+    tensor shard's own heads). ``sliding_window`` may be a traced scalar
+    (rides the specs as a replicated operand, never a closure capture)."""
+    row_specs = (
+        P("data", axis_name, "tensor", None),
+        P("data", axis_name, "tensor", None),
+        P("data", axis_name, "tensor", None),
+        P("data", axis_name),
+        P("data"),
+    )
+    if sliding_window is None:
+        fn = jax.shard_map(
+            lambda *a: ulysses_attention(*a, axis_name=axis_name,
+                                         attn_softcap=attn_softcap),
+            mesh=mesh,
+            in_specs=row_specs,
+            out_specs=P("data", axis_name, "tensor", None),
+            check_vma=False,
+        )
+        return fn(q, k, v, q_positions, kv_valid_len)
     fn = jax.shard_map(
-        lambda *a: ulysses_attention(*a, axis_name=axis_name,
-                                     sliding_window=sliding_window),
-        mesh=mesh,
-        in_specs=(
-            P("data", axis_name, "tensor", None),
-            P("data", axis_name, "tensor", None),
-            P("data", axis_name, "tensor", None),
-            P("data", axis_name),
-            P("data"),
+        lambda q, k, v, qp, kv, w: ulysses_attention(
+            q, k, v, qp, kv, axis_name=axis_name, sliding_window=w,
+            attn_softcap=attn_softcap,
         ),
+        mesh=mesh,
+        in_specs=row_specs + (P(),),  # window: replicated scalar
         out_specs=P("data", axis_name, "tensor", None),
         check_vma=False,
     )
-    return fn(q, k, v, q_positions, kv_valid_len)
+    return fn(q, k, v, q_positions, kv_valid_len,
+              jnp.asarray(sliding_window, jnp.int32))

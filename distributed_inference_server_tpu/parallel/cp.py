@@ -64,25 +64,28 @@ def cp_prefill(
     # padding writes are dropped (slot T is out of range for the cache)
     write_pos = jnp.where(positions >= 0, positions, T)
 
+    # per-layer window: w rides the layer scan as a traced scalar (the
+    # Gemma-2 alternating local/global schedule works under CP), passed
+    # into the attends through their specs; score soft-capping applies
+    # inside the blockwise softmax. Non-sliding models keep the static
+    # maskless branch (w arrives as None from scan_layer_blocks).
+    softcap = cfg.attn_logit_softcap
     if sp_impl == "ulysses":
         from distributed_inference_server_tpu.ops.ulysses import (
             ulysses_attention_sharded,
         )
 
         def attend(q, k_layer, v_layer, w):
-            # uniform-window models only: the engine gates alternating-
-            # window (pattern) models off the CP path, so cfg.sliding_window
-            # is the per-layer truth here (w is the same value, traced)
             return ulysses_attention_sharded(
                 mesh, q, k_layer, v_layer, positions, valid_len,
-                sliding_window=cfg.sliding_window,
+                sliding_window=w, attn_softcap=softcap,
             )
     else:
 
         def attend(q, k_layer, v_layer, w):
             return ring_attention_sharded(
                 mesh, q, k_layer, v_layer, positions, positions,
-                sliding_window=cfg.sliding_window,
+                sliding_window=w, attn_softcap=softcap,
             )
 
     cache = llama.KVCache.create(cfg, B, T, dtype=params["embed"].dtype)

@@ -9,8 +9,14 @@ Megatron-style layout expressed as GSPMD annotations (no manual collectives
   sums that XLA AllReduces into the residual stream.
 - ``w_gate``/``w_up``: column-parallel on the intermediate dim;
   ``w_down``: row-parallel (second AllReduce per block).
-- Embedding/unembedding + norms: replicated (vocab-parallel unembedding is
-  a later optimization; logits are [B, V] once per step).
+- Unembedding: VOCAB-PARALLEL — ``lm_head`` [H, V] splits V on ``tensor``
+  (tied-embedding models split ``embed`` [V, H] on V instead, paying a
+  small [B, T, H] AllReduce on the masked embedding lookup). Each shard
+  projects its vocab slice — at Llama-3's 128k vocab a replicated [B, V]
+  projection per shard is the single largest TP tax — and XLA inserts
+  the gather/reduce the consuming sampling op actually needs (argmax and
+  sort reduce over the sharded axis; no hand-written collectives).
+- Norms: replicated.
 - Paged KV pool: sharded on the KV-head dim — each shard holds its own
   heads' pages, so cache writes and the attention gather are fully local;
   per-shard GQA groups stay intact (num_heads/num_kv_heads q heads per KV
@@ -97,12 +103,17 @@ def llama_param_specs(
             w_down=P(st, "tensor", None),
         )
     specs: Dict[str, Any] = {
-        "embed": P(None, None),
+        # vocab-parallel unembedding: untied models shard lm_head's vocab
+        # axis; tied models shard the embedding table's vocab axis (its
+        # transpose IS the unembedding) and GSPMD masks the lookup
+        "embed": (
+            P("tensor", None) if cfg.tie_word_embeddings else P(None, None)
+        ),
         "layers": layers,
         "final_norm": P(None),
     }
     if not cfg.tie_word_embeddings:
-        specs["lm_head"] = P(None, None)
+        specs["lm_head"] = P(None, "tensor")
     return specs
 
 
