@@ -5,6 +5,10 @@ S1 lists "optional gRPC (Tonic)" next to the HTTP server
 ``grpc.aio`` over the SAME InferenceHandler the HTTP app uses — one
 request-processing spine, two transports.
 
+The authoritative contract document is ``serving/inference.proto``
+(message schemas, streaming shapes, status mapping — protoc-valid, ready
+for real codegen in environments that have the plugin).
+
 Wire contract: JSON-encoded messages on generic method handlers (this
 image ships grpcio but no protoc gRPC codegen plugin, and the JSON bodies
 keep bit-for-bit schema parity with the HTTP endpoints — a client holding

@@ -53,6 +53,7 @@ from distributed_inference_server_tpu.engine.engine import SamplingParams
 from distributed_inference_server_tpu.models.tokenizer import (
     Tokenizer,
     apply_chat_template,
+    chat_template_family,
 )
 from distributed_inference_server_tpu.serving.dispatcher import Dispatcher
 from distributed_inference_server_tpu.serving.metrics import MetricsCollector
@@ -94,6 +95,13 @@ class InferenceHandler:
         self._span_ttl_s = 3600.0
 
     # -- shared internals --------------------------------------------------
+
+    @property
+    def chat_family(self) -> str:
+        """Chat-template family derived from the CURRENT model name —
+        a property so model hot-swap (server.py swap_model) retemplates
+        /chat without extra bookkeeping."""
+        return chat_template_family(self.model_name)
 
     def _params(self, max_tokens: int, temperature: float, top_p: float,
                 stop_sequences: List[str]) -> SamplingParams:
@@ -249,7 +257,10 @@ class InferenceHandler:
     def _chat_ids(self, req: ChatRequest) -> List[int]:
         # the template carries its own BOS marker text; HF tokenizers encode
         # it as a literal, so skip the extra BOS id
-        return self.tok.encode(apply_chat_template(req.messages), add_bos=False)
+        return self.tok.encode(
+            apply_chat_template(req.messages, self.chat_family),
+            add_bos=False,
+        )
 
     async def chat(self, obj: dict) -> ChatResponse:
         req = self.parse_chat(obj)

@@ -357,6 +357,13 @@ class EngineRunner:
 
     # -- introspection (any thread) ---------------------------------------
 
+    def tokenizer(self):
+        """Tokenizer of the currently-installed engine (None until ready).
+        A plain reference read — safe from other threads; the server uses
+        it to retarget the handler's tokenizer after a model swap."""
+        eng = self._engine
+        return eng.tok if eng is not None else None
+
     def is_healthy(self) -> bool:
         return self._healthy
 

@@ -235,6 +235,15 @@ class InferenceServer:
         self.engine_factory = engine_factory
         if model_name is not None:
             self.handler.model_name = model_name
+        # retarget the handler's tokenizer to the NEW model's: the chat
+        # template family follows model_name, and templating in the new
+        # family while encoding with the old tokenizer would garble every
+        # /chat prompt (cross-family swaps)
+        for runner in runners:
+            tok = runner.tokenizer()
+            if tok is not None:
+                self.handler.tok = tok
+                break
         return True, None
 
     # -- hot-reload --------------------------------------------------------

@@ -1,0 +1,128 @@
+"""Per-family chat templates (tasks.md:259-262 [spec]; VERDICT r2 missing
+#6: /chat applied the Llama-3 header format to every model family).
+Mistral gets [INST] pairs, Qwen2 gets ChatML, Gemma-2 gets start_of_turn
+blocks with the assistant role renamed to 'model'."""
+
+from distributed_inference_server_tpu.core.models import ChatMessage, Role
+from distributed_inference_server_tpu.models.tokenizer import (
+    apply_chat_template,
+    chat_template_family,
+)
+
+CONVO = [
+    ChatMessage(role=Role.SYSTEM, content="be brief"),
+    ChatMessage(role=Role.USER, content="hi"),
+    ChatMessage(role=Role.ASSISTANT, content="hello"),
+    ChatMessage(role=Role.USER, content="bye"),
+]
+
+
+class TestFamilyDetection:
+    def test_model_names_map_to_families(self):
+        assert chat_template_family("llama-3-8b") == "llama3"
+        assert chat_template_family("llama-3.2-1b") == "llama3"
+        assert chat_template_family("mistral-7b") == "mistral"
+        assert chat_template_family("mixtral-8x7b") == "mistral"
+        assert chat_template_family("qwen2-7b") == "chatml"
+        assert chat_template_family("gemma2-9b") == "gemma"
+        assert chat_template_family("tiny") == "llama3"  # default
+        assert chat_template_family("") == "llama3"
+
+
+class TestTemplates:
+    def test_llama3_headers(self):
+        out = apply_chat_template(CONVO, "llama3")
+        assert out.startswith("<|begin_of_text|>")
+        assert "<|start_header_id|>system<|end_header_id|>\n\nbe brief<|eot_id|>" in out
+        assert out.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+
+    def test_mistral_inst_pairs_fold_system(self):
+        out = apply_chat_template(CONVO, "mistral")
+        # system folds into the FIRST user turn; assistant closes with </s>
+        assert out == (
+            "<s>[INST] be brief\n\nhi [/INST]hello</s>[INST] bye [/INST]"
+        )
+
+    def test_chatml_blocks(self):
+        out = apply_chat_template(CONVO, "chatml")
+        assert out == (
+            "<|im_start|>system\nbe brief<|im_end|>\n"
+            "<|im_start|>user\nhi<|im_end|>\n"
+            "<|im_start|>assistant\nhello<|im_end|>\n"
+            "<|im_start|>user\nbye<|im_end|>\n"
+            "<|im_start|>assistant\n"
+        )
+
+    def test_gemma_turns_rename_assistant_to_model(self):
+        out = apply_chat_template(CONVO, "gemma")
+        assert out == (
+            "<bos><start_of_turn>user\nbe brief\n\nhi<end_of_turn>\n"
+            "<start_of_turn>model\nhello<end_of_turn>\n"
+            "<start_of_turn>user\nbye<end_of_turn>\n"
+            "<start_of_turn>model\n"
+        )
+
+    def test_default_family_is_llama3(self):
+        assert apply_chat_template(CONVO) == apply_chat_template(
+            CONVO, "llama3"
+        )
+
+
+class TestHandlerWiring:
+    def test_handler_family_follows_model_name(self):
+        """The handler derives the family from its CURRENT model name, so
+        hot-swap retemplates /chat automatically."""
+        from distributed_inference_server_tpu.models.tokenizer import (
+            ByteTokenizer,
+        )
+        from distributed_inference_server_tpu.serving.dispatcher import (
+            Dispatcher,
+        )
+        from distributed_inference_server_tpu.serving.handler import (
+            InferenceHandler,
+        )
+        from distributed_inference_server_tpu.serving.scheduler import (
+            AdaptiveScheduler,
+        )
+
+        h = InferenceHandler(
+            Dispatcher(AdaptiveScheduler()), ByteTokenizer(), "qwen2-7b"
+        )
+        assert h.chat_family == "chatml"
+        h.model_name = "gemma2-9b"  # what server.swap_model assigns
+        assert h.chat_family == "gemma"
+
+
+class TestSystemFolding:
+    """System content must never silently vanish (review finding): late
+    or multiple system messages still reach the model in families with
+    no native system slot."""
+
+    def test_mistral_trailing_system_not_dropped(self):
+        msgs = [
+            ChatMessage(role=Role.USER, content="hi"),
+            ChatMessage(role=Role.SYSTEM, content="be brief"),
+        ]
+        out = apply_chat_template(msgs, "mistral")
+        assert out == "<s>[INST] hi [/INST][INST] be brief [/INST]"
+
+    def test_mistral_multiple_systems_accumulate(self):
+        msgs = [
+            ChatMessage(role=Role.SYSTEM, content="one"),
+            ChatMessage(role=Role.SYSTEM, content="two"),
+            ChatMessage(role=Role.USER, content="hi"),
+        ]
+        out = apply_chat_template(msgs, "mistral")
+        assert out == "<s>[INST] one\n\ntwo\n\nhi [/INST]"
+
+    def test_gemma_trailing_system_becomes_user_turn(self):
+        msgs = [
+            ChatMessage(role=Role.USER, content="hi"),
+            ChatMessage(role=Role.SYSTEM, content="be brief"),
+        ]
+        out = apply_chat_template(msgs, "gemma")
+        assert out == (
+            "<bos><start_of_turn>user\nhi<end_of_turn>\n"
+            "<start_of_turn>user\nbe brief<end_of_turn>\n"
+            "<start_of_turn>model\n"
+        )
