@@ -27,7 +27,10 @@ BENCH_QUANT (none|int8|int4 — weight-only; int8 fits 8B on one v5e:
   BENCH_MODEL=llama-3-8b BENCH_QUANT=int8 BENCH_BATCH=32 python bench.py),
 BENCH_HBM_GBPS (819, v5e HBM bandwidth for the roofline estimate printed
 alongside every hardware run: roofline tok/s = batch * BW / weight
-bytes — the weight-read bound a decode step cannot beat).
+bytes — the weight-read bound a decode step cannot beat),
+BENCH_MEASURE_WARMUP=1 (measure cold first-request TTFT vs a warmed
+engine's first request vs steady-state — quantifies engine.warmup()'s
+compile amortization instead of asserting it).
 """
 
 from __future__ import annotations
@@ -189,8 +192,10 @@ def main() -> None:
     roofline = batch * hbm_gbps * 1e9 / max(1, weight_bytes)
     rng = np.random.default_rng(0)
 
-    def run_once(use_impl: str) -> dict:
-        engine = LLMEngine(
+    def mk_engine(use_impl: str) -> "LLMEngine":
+        # single construction site: warmup mode and throughput mode must
+        # measure the SAME engine configuration
+        return LLMEngine(
             params, cfg, ByteTokenizer(),
             EngineConfig(
                 max_batch=batch, prefill_buckets=buckets, paged=paged,
@@ -200,6 +205,76 @@ def main() -> None:
             ),
             dtype=dtype,
         )
+
+    warmup_metric = metric.replace(
+        "decode_tokens_per_sec", "warmup_first_request_ttft"
+    )
+    if os.environ.get("BENCH_MEASURE_WARMUP") == "1":
+        # Quantify the warmup machinery (engine.warmup docstring claims
+        # first-request compile ~20-40s on TPU; VERDICT r2 weak #9 — the
+        # benefit was never measured): cold first-request TTFT (pays
+        # tracing + XLA compile) vs the same engine's second request vs
+        # a warmed engine's FIRST request. No persistent compile cache is
+        # set here, so each engine's compiles are honest.
+        seq = [0]
+
+        def first_ttft(engine) -> float:
+            seq[0] += 1
+            ids = rng.integers(
+                1, min(cfg.vocab_size, 250), size=prompt_len
+            ).tolist()
+            t0 = time.perf_counter()
+            engine.add_request(
+                f"wu{seq[0]}", ids,
+                SamplingParams(max_tokens=8, temperature=0.0),
+            )
+            ttft = None
+            while engine.has_work():
+                for out in engine.step():
+                    if ttft is None and out.token_id is not None:
+                        ttft = time.perf_counter() - t0
+            assert ttft is not None
+            return ttft
+
+        try:
+            cold_engine = mk_engine(impl)
+            cold = first_ttft(cold_engine)
+            steady = first_ttft(cold_engine)
+            # release the first engine's KV pool + executables before
+            # building the second: at 8B-int8 two live engines would
+            # overshoot one chip's HBM
+            del cold_engine
+            warmed_engine = mk_engine(impl)
+            t0 = time.perf_counter()
+            warmed_engine.warmup()
+            warmup_s = time.perf_counter() - t0
+            warmed = first_ttft(warmed_engine)
+        except Exception as e:  # same always-emit contract as run paths
+            _emit({
+                "metric": warmup_metric, "value": 0.0, "unit": "s",
+                "vs_baseline": 0.0, "attention_impl": impl,
+                "error": str(e).split("\n")[0][:200],
+            })
+            sys.exit(3)
+        _emit({
+            "metric": warmup_metric,
+            "value": round(warmed, 4),
+            "unit": "s",
+            # >= 1 means the <200ms first-token target is met (matching
+            # the throughput emissions' higher-is-better convention)
+            "vs_baseline": round(0.2 / max(warmed, 1e-9), 4),
+            "platform": platform,
+            "model": cfg.name,
+            **({"quant": quant} if quant != "none" else {}),
+            "cold_first_ttft_s": round(cold, 4),
+            "steady_ttft_s": round(steady, 4),
+            "warmup_duration_s": round(warmup_s, 4),
+            "compile_cost_amortized_s": round(cold - warmed, 4),
+        })
+        return
+
+    def run_once(use_impl: str) -> dict:
+        engine = mk_engine(use_impl)
 
         def add(rid: str, n_new: int):
             ids = rng.integers(

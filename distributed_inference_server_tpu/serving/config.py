@@ -430,16 +430,20 @@ class ConfigWatcher:
         mtime = self._stat()
         if mtime == self._mtime:
             return False
-        self._mtime = mtime
         try:
             # re-merge with the original CLI args so CLI > env > file
             # precedence survives the reload (Property 26)
             new = ServerConfig.load(file_path=path,
                                     cli_args=self.current.cli_args)
         except Exception:  # noqa: BLE001 — malformed/partial file edits
-            # (yaml/toml parse errors, ENOENT during atomic replace) must
-            # never kill hot-reload; the old config stays active
+            # (toml parse errors, ENOENT during atomic replace) must
+            # never kill hot-reload; the old config stays active. The
+            # recorded mtime is NOT advanced on failure: if the writer
+            # completes within the same mtime tick (coarse filesystem
+            # timestamps), the next poll still retries instead of
+            # treating the torn snapshot as current forever
             return False
+        self._mtime = mtime
         diff = self.current.hot_diff(new)
         self.current = new
         if diff:
