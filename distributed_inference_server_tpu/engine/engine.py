@@ -184,6 +184,7 @@ class _Seq:
         "request_id", "token_ids", "prompt_len", "block_table",
         "seq_len", "next_token", "params", "output_text", "emitted_upto",
         "emitted_tokens", "dev_pos", "dev_steps_left", "freed_upto",
+        "pending_ids",
     )
 
     def __init__(self, request_id: RequestId, prompt_ids: List[int],
@@ -205,6 +206,9 @@ class _Seq:
         # sliding-window reclaim watermark: table entries below this are
         # freed (sentinel) — pages fully behind the attention window
         self.freed_upto = 0
+        # incremental-detokenization holdback: token ids whose text is an
+        # incomplete UTF-8 / byte-fallback sequence (decodes to U+FFFD)
+        self.pending_ids: List[int] = []
 
     def num_output_tokens(self) -> int:
         return len(self.token_ids) - self.prompt_len
@@ -1724,6 +1728,36 @@ class LLMEngine:
     # token emission & completion
     # ------------------------------------------------------------------
 
+    def _decode_piece(self, seq: _Seq, token_id: int) -> str:
+        """Incremental detokenization: a token whose isolated text decodes
+        to U+FFFD is (almost always) a fragment of a multi-token UTF-8
+        character — a raw byte from ByteTokenizer or a byte-fallback BPE
+        piece. Hold such tokens back and decode them TOGETHER with their
+        successors, emitting the completed character once the joint decode
+        is clean (previously every fragment streamed as a literal '�').
+        A genuinely undecodable run flushes after 8 tokens (a UTF-8
+        character is at most 4 bytes) so output cannot stall; _finish
+        flushes any remainder."""
+        if seq.pending_ids:
+            seq.pending_ids.append(token_id)
+            text = self.tok.decode(seq.pending_ids)
+            if text.endswith("�") and len(seq.pending_ids) < 8:
+                return ""
+            seq.pending_ids = []
+            return text
+        piece = self.tok.decode_token(token_id)
+        if "�" in piece:
+            seq.pending_ids = [token_id]
+            return ""
+        return piece
+
+    def _flush_pending_text(self, seq: _Seq) -> None:
+        """Decode and append any held-back fragment ids (request is
+        terminating — emit what exists, replacement chars included)."""
+        if seq.pending_ids:
+            seq.output_text += self.tok.decode(seq.pending_ids)
+            seq.pending_ids = []
+
     def _emit_token(self, seq: _Seq, token_id: int,
                     outputs: List[StepOutput],
                     logprob: Optional[float] = None) -> None:
@@ -1736,7 +1770,7 @@ class LLMEngine:
 
         seq.next_token = token_id
         seq.emitted_tokens += 1
-        piece = self.tok.decode_token(token_id)
+        piece = self._decode_piece(seq, token_id)
         seq.output_text += piece
 
         # stop sequences: scan the un-emitted tail
@@ -1748,6 +1782,11 @@ class LLMEngine:
                     earliest = idx
             if earliest >= 0:
                 seq.output_text = seq.output_text[:earliest]
+                # defensive: pending_ids is provably empty here (a held
+                # fragment leaves output_text unchanged, so no new stop
+                # match can appear while one is pending) — cleared anyway
+                # so _finish can never flush text past a stop truncation
+                seq.pending_ids = []
                 self._finish(seq, FinishReason.STOP_SEQUENCE, outputs)
                 return
 
@@ -1783,6 +1822,7 @@ class LLMEngine:
     def _finish(self, seq: _Seq, reason: FinishReason,
                 outputs: List[StepOutput]) -> None:
         # flush held-back text; index it as the last emitted token's
+        self._flush_pending_text(seq)
         delta = seq.output_text[seq.emitted_upto :]
         usage = Usage.of(seq.prompt_len, seq.emitted_tokens)
         outputs.append(StepOutput(
