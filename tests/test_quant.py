@@ -202,3 +202,36 @@ def test_init_random_quantized_generates():
         params, TINY, ids, positions, cache, positions, valid
     )[0]
     assert bool(jnp.isfinite(logits).all())
+
+
+def test_init_random_quantized_8b_shapes_fit_one_chip():
+    """The 8B-int8 bench path (BENCH_MODEL=llama-3-8b BENCH_QUANT=int8)
+    must not hit a shape/divisibility bug in its first real run on the
+    chip: eval_shape builds the full quantized tree abstractly (zero
+    allocation) and its byte count must fit v5e HBM (~16 GB) with room
+    for the KV pool."""
+    from distributed_inference_server_tpu.models.configs import LLAMA_3_8B
+    from distributed_inference_server_tpu.ops.quant import (
+        init_random_quantized,
+    )
+
+    shapes = jax.eval_shape(
+        lambda k: init_random_quantized(k, LLAMA_3_8B, "int8"),
+        jax.random.PRNGKey(0),
+    )
+    total = sum(
+        int(np.prod(x.shape)) * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(shapes)
+    )
+    # ~8 GB int8 linears + ~2 GB bf16 embed/unembed + scales
+    assert 8e9 < total < 13e9, total
+    # int4 halves the linear bytes again
+    shapes4 = jax.eval_shape(
+        lambda k: init_random_quantized(k, LLAMA_3_8B, "int4"),
+        jax.random.PRNGKey(0),
+    )
+    total4 = sum(
+        int(np.prod(x.shape)) * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(shapes4)
+    )
+    assert total4 < total - 2e9, (total4, total)
