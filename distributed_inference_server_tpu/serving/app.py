@@ -95,7 +95,11 @@ def build_app(
             raise ApiErrorJson("request body must be a JSON object")
         return obj
 
-    async def _stream_response(request: web.Request, request_id, events):
+    async def _stream_response(request: web.Request, request_id, events,
+                               encode=sse_encode):
+        """One SSE scaffold for every stream (native TokenEvent frames
+        and the /v1 OpenAI-chunk encoding differ only in ``encode``) —
+        the Req 5.4 abort-on-disconnect logic exists exactly once."""
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -107,7 +111,7 @@ def build_app(
         await resp.prepare(request)
         try:
             async for event in events:
-                await resp.write(sse_encode(event))
+                await resp.write(encode(event))
             await resp.write(SSE_DONE)
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: abort generation (Req 5.4)
@@ -116,26 +120,130 @@ def build_app(
         await resp.write_eof()
         return resp
 
-    async def generate(request: web.Request) -> web.StreamResponse:
+    async def _serve_completion(request, *, chat: bool, v1: bool):
+        """Shared stream-or-JSON dispatch for /generate, /chat and their
+        /v1 aliases — one copy of the negotiation, with the OpenAI field
+        translation and wire mapping applied only on the v1 paths."""
         obj = await _json_body(request)
+        if v1:
+            obj = _openai_fields(obj)
+        stream_fn = handler.chat_stream if chat else handler.generate_stream
+        call_fn = handler.chat if chat else handler.generate
         if obj.get("stream") is True:
-            request_id, events = await handler.generate_stream(obj)
+            request_id, events = await stream_fn(obj)
+            if v1:
+                return await _stream_response_v1(
+                    request, request_id, events, chat=chat
+                )
             return await _stream_response(request, request_id, events)
-        result = await handler.generate(obj)
-        return web.json_response(result.to_dict())
+        result = await call_fn(obj)
+        d = result.to_dict()
+        if v1:
+            d = _v1_finish_reasons(d)
+        return web.json_response(d)
+
+    async def generate(request: web.Request) -> web.StreamResponse:
+        return await _serve_completion(request, chat=False, v1=False)
 
     async def chat(request: web.Request) -> web.StreamResponse:
-        obj = await _json_body(request)
-        if obj.get("stream") is True:
-            request_id, events = await handler.chat_stream(obj)
-            return await _stream_response(request, request_id, events)
-        result = await handler.chat(obj)
-        return web.json_response(result.to_dict())
+        return await _serve_completion(request, chat=True, v1=False)
 
     async def embeddings(request: web.Request) -> web.Response:
         obj = await _json_body(request)
         result = await handler.embeddings(obj)
         return web.json_response(result.to_dict())
+
+    # -- OpenAI-compatible aliases -----------------------------------------
+    # The non-stream response envelopes already follow the OpenAI shapes
+    # (Req 11). The /v1/* aliases close the remaining wire gaps so
+    # off-the-shelf OpenAI clients work: the "stop" request field,
+    # finish_reason vocabulary ("stop_sequence" is not OpenAI's), and
+    # streaming as text_completion / chat.completion.chunk objects with
+    # choices[].text / choices[].delta instead of internal TokenEvents.
+
+    def _openai_fields(obj: dict) -> dict:
+        if not isinstance(obj, dict):
+            return obj
+        # the SDKs' recommended replacement for the deprecated max_tokens
+        if "max_completion_tokens" in obj and "max_tokens" not in obj:
+            obj["max_tokens"] = obj.pop("max_completion_tokens")
+        if "stop" in obj and "stop_sequences" not in obj:
+            stop = obj.pop("stop")
+            if stop is None:
+                stop = []
+            elif isinstance(stop, str):
+                stop = [stop]
+            if not (isinstance(stop, list)
+                    and all(isinstance(s, str) for s in stop)):
+                # name the field the CLIENT sent, not our internal one
+                raise ApiErrorJson('"stop" must be a string or an array '
+                                   "of strings")
+            if any(s == "" for s in stop):
+                # OpenAI rejects empty stop strings; ours would match at
+                # position 0 and instantly truncate to an empty output
+                raise ApiErrorJson('"stop" strings must be non-empty')
+            obj["stop_sequences"] = stop
+        return obj
+
+    def _v1_finish_reasons(d: dict) -> dict:
+        for c in d.get("choices", ()):
+            if c.get("finish_reason") == "stop_sequence":
+                c["finish_reason"] = "stop"
+        return d
+
+    async def _stream_response_v1(request, request_id, events, *,
+                                  chat: bool):
+        obj_name = "chat.completion.chunk" if chat else "text_completion"
+        rid = ("chatcmpl-" if chat else "cmpl-") + str(request_id)
+        created = int(time.time())
+        model = handler.model_name
+
+        def frame(payload: dict) -> bytes:
+            return b"data: " + json.dumps(payload).encode() + b"\n\n"
+
+        first = [True]  # OpenAI wire: role appears only in the 1st delta
+
+        def chunk(ev: dict) -> bytes:
+            t = ev.get("type")
+            if t == "token":
+                if chat:
+                    delta = {"content": ev.get("token") or ""}
+                    if first[0]:
+                        delta = {"role": "assistant", **delta}
+                        first[0] = False
+                    choice = {"index": 0, "delta": delta,
+                              "finish_reason": None}
+                else:
+                    choice = {"text": ev.get("token") or "", "index": 0,
+                              "logprobs": None, "finish_reason": None}
+            elif t == "done":
+                fr = ev.get("finish_reason")
+                fr = "stop" if fr == "stop_sequence" else fr
+                choice = (
+                    {"index": 0, "delta": {}, "finish_reason": fr}
+                    if chat else
+                    {"text": "", "index": 0, "logprobs": None,
+                     "finish_reason": fr}
+                )
+            else:  # error: no OpenAI stream-error standard; error object
+                return frame({"error": {
+                    "message": ev.get("messages") or "",
+                    "code": ev.get("code") or "server_error",
+                }})
+            return frame({"id": rid, "object": obj_name,
+                          "created": created, "model": model,
+                          "choices": [choice]})
+
+        return await _stream_response(
+            request, request_id, events,
+            encode=lambda event: chunk(event.to_dict()),
+        )
+
+    async def generate_v1(request: web.Request) -> web.StreamResponse:
+        return await _serve_completion(request, chat=False, v1=True)
+
+    async def chat_v1(request: web.Request) -> web.StreamResponse:
+        return await _serve_completion(request, chat=True, v1=True)
 
     async def stats(request: web.Request) -> web.Response:
         statuses = tuple(handler.dispatcher.scheduler.statuses())
@@ -333,6 +441,9 @@ def build_app(
     app.router.add_post("/generate", generate)
     app.router.add_post("/chat", chat)
     app.router.add_post("/embeddings", embeddings)
+    app.router.add_post("/v1/completions", generate_v1)
+    app.router.add_post("/v1/chat/completions", chat_v1)
+    app.router.add_post("/v1/embeddings", embeddings)
     app.router.add_get("/server/stats", stats)
     app.router.add_get("/metrics", prom)
     app.router.add_get("/health", health)

@@ -318,3 +318,141 @@ def test_admin_scale_endpoint(server):
         bad = await client.post("/admin/scale", json={"num_engines": 0})
         assert bad.status == 400
     _run(server, go)
+
+
+class TestOpenAIAliases:
+    """/v1/* aliases accept OpenAI request spellings (notably "stop") and
+    serve the same schemas — off-the-shelf OpenAI clients work
+    unchanged."""
+
+    def test_v1_completions_with_stop_string(self, server):
+        async def go(client):
+            ref = await (await client.post(
+                "/generate",
+                json={"prompt": "hello world", "max_tokens": 8,
+                      "temperature": 0.0},
+            )).json()
+            stop = ref["choices"][0]["text"][2:4]
+            resp = await client.post(
+                "/v1/completions",
+                json={"prompt": "hello world", "max_tokens": 8,
+                      "temperature": 0.0, "stop": stop},
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["object"] == "text_completion"
+            # OpenAI vocabulary: stop_sequence maps to "stop" on /v1
+            assert body["choices"][0]["finish_reason"] == "stop"
+            return ref, body, stop
+
+        ref, body, stop = _run(server, go)
+        # truncated at the stop's FIRST occurrence in the greedy text
+        want = ref["choices"][0]["text"]
+        assert body["choices"][0]["text"] == want[: want.find(stop)]
+
+    def test_v1_bad_stop_type_names_the_client_field(self, server):
+        async def go(client):
+            resp = await client.post(
+                "/v1/completions",
+                json={"prompt": "x", "stop": 5},
+            )
+            assert resp.status == 400
+            err = (await resp.json())["error"]
+            assert '"stop"' in err["message"]
+            assert "stop_sequences" not in err["message"]
+
+        _run(server, go)
+
+    def test_v1_chat_and_embeddings(self, server):
+        async def go(client):
+            chat = await client.post(
+                "/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 4, "stop": ["zzz_never"]},
+            )
+            assert chat.status == 200
+            assert (await chat.json())["object"] == "chat.completion"
+            emb = await client.post(
+                "/v1/embeddings", json={"input": ["a"]}
+            )
+            assert emb.status == 200
+            assert (await emb.json())["object"] == "list"
+
+        _run(server, go)
+
+    def test_v1_streaming_is_openai_chunks(self, server):
+        """/v1 streams OpenAI objects (choices[].text / choices[].delta),
+        NOT the internal TokenEvent frames — off-the-shelf SDK chunk
+        parsing depends on it."""
+        import json as _json
+
+        async def go(client):
+            resp = await client.post(
+                "/v1/completions",
+                json={"prompt": "abc", "max_tokens": 3, "stream": True},
+            )
+            assert resp.status == 200
+            comp = (await resp.read()).decode()
+            resp = await client.post(
+                "/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 3, "stream": True},
+            )
+            assert resp.status == 200
+            chat = (await resp.read()).decode()
+            return comp, chat
+
+        comp, chat = _run(server, go)
+        for body in (comp, chat):
+            assert '"type": "token"' not in body  # no internal frames
+            assert body.strip().endswith("data: [DONE]")
+        frames = [_json.loads(line[6:]) for line in comp.splitlines()
+                  if line.startswith("data: {")]
+        assert all(f["object"] == "text_completion" for f in frames)
+        assert "text" in frames[0]["choices"][0]
+        assert frames[-1]["choices"][0]["finish_reason"] == "length"
+        cframes = [_json.loads(line[6:]) for line in chat.splitlines()
+                   if line.startswith("data: {")]
+        assert all(f["object"] == "chat.completion.chunk" for f in cframes)
+        assert cframes[0]["choices"][0]["delta"]["role"] == "assistant"
+        assert cframes[-1]["choices"][0]["delta"] == {}
+        assert cframes[-1]["choices"][0]["finish_reason"] == "length"
+
+
+    def test_v1_max_completion_tokens_and_empty_stop(self, server):
+        async def go(client):
+            resp = await client.post(
+                "/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}],
+                      "max_completion_tokens": 3},
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["usage"]["completion_tokens"] <= 3
+            bad = await client.post(
+                "/v1/completions", json={"prompt": "x", "stop": [""]}
+            )
+            assert bad.status == 400
+            assert "non-empty" in (await bad.json())["error"]["message"]
+
+        _run(server, go)
+
+    def test_v1_chat_role_only_in_first_delta(self, server):
+        import json as _json
+
+        async def go(client):
+            resp = await client.post(
+                "/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 4, "stream": True},
+            )
+            return (await resp.read()).decode()
+
+        body = _run(server, go)
+        deltas = [
+            _json.loads(line[6:])["choices"][0]["delta"]
+            for line in body.splitlines() if line.startswith("data: {")
+        ]
+        token_deltas = [d for d in deltas if d.get("content") is not None]
+        assert "role" in token_deltas[0]
+        assert all("role" not in d for d in token_deltas[1:])
