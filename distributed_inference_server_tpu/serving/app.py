@@ -162,8 +162,7 @@ def build_app(
     # choices[].text / choices[].delta instead of internal TokenEvents.
 
     def _openai_fields(obj: dict) -> dict:
-        if not isinstance(obj, dict):
-            return obj
+        # _json_body already 400s on non-dict bodies
         # the SDKs' recommended replacement for the deprecated max_tokens
         if "max_completion_tokens" in obj and "max_tokens" not in obj:
             obj["max_tokens"] = obj.pop("max_completion_tokens")

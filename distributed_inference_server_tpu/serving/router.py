@@ -228,6 +228,17 @@ def build_router_app(router: Router) -> web.Application:
     async def embeddings(request):
         return await _proxy(request, "/embeddings")
 
+    # OpenAI-compatible aliases proxy 1:1 — the backend applies the
+    # field/wire translation (serving/app.py), the router stays dumb
+    async def generate_v1(request):
+        return await _proxy(request, "/v1/completions")
+
+    async def chat_v1(request):
+        return await _proxy(request, "/v1/chat/completions")
+
+    async def embeddings_v1(request):
+        return await _proxy(request, "/v1/embeddings")
+
     async def health(request: web.Request) -> web.Response:
         healthy = any(b.healthy for b in router.backends)
         return web.json_response(
@@ -260,6 +271,9 @@ def build_router_app(router: Router) -> web.Application:
     app.router.add_post("/generate", generate)
     app.router.add_post("/chat", chat)
     app.router.add_post("/embeddings", embeddings)
+    app.router.add_post("/v1/completions", generate_v1)
+    app.router.add_post("/v1/chat/completions", chat_v1)
+    app.router.add_post("/v1/embeddings", embeddings_v1)
     app.router.add_get("/health", health)
     app.router.add_get("/server/stats", stats)
     return app

@@ -110,6 +110,20 @@ def _run_router(backends, coro_fn, **router_kw):
 
 
 class TestRouter:
+    def test_v1_alias_via_router(self, backends):
+        """The OpenAI /v1 aliases proxy through the router 1:1; the
+        backend applies the field translation ("stop" here)."""
+        async def go(client, router, urls):
+            resp = await client.post("/v1/completions", json={
+                "prompt": "hello fleet", "max_tokens": 4,
+                "temperature": 0.0, "stop": ["zz_never"],
+            })
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["object"] == "text_completion"
+            assert body["usage"]["completion_tokens"] == 4
+        _run_router(backends, go)
+
     def test_generate_via_router(self, backends):
         async def go(client, router, urls):
             resp = await client.post("/generate", json={
