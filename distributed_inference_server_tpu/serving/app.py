@@ -163,6 +163,13 @@ def build_app(
 
     def _openai_fields(obj: dict) -> dict:
         # _json_body already 400s on non-dict bodies
+        n = obj.get("n")
+        if n is not None and (type(n) is not int or n != 1):
+            # a silent single choice where the client asked for n would
+            # be a wrong response shape, not a degraded one (and bool is
+            # not an int here: n=true must not pass as 1)
+            raise ApiErrorJson('"n" must be 1 (multiple choices are not '
+                               "supported)")
         # the SDKs' recommended replacement for the deprecated max_tokens
         if "max_completion_tokens" in obj and "max_tokens" not in obj:
             obj["max_tokens"] = obj.pop("max_completion_tokens")

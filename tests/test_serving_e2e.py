@@ -434,6 +434,17 @@ class TestOpenAIAliases:
             )
             assert bad.status == 400
             assert "non-empty" in (await bad.json())["error"]["message"]
+            for bad_n in (2, True, 0, "2"):
+                multi = await client.post(
+                    "/v1/completions", json={"prompt": "x", "n": bad_n}
+                )
+                assert multi.status == 400, bad_n  # no silent one-choice
+                assert '"n"' in (await multi.json())["error"]["message"]
+            ok_n = await client.post(
+                "/v1/completions",
+                json={"prompt": "x", "n": 1, "max_tokens": 1},
+            )
+            assert ok_n.status == 200
 
         _run(server, go)
 
