@@ -1617,8 +1617,13 @@ class LLMEngine:
         )
         snapshot = [(i, s, advs[id(s)]) for i, s in seated]
         if use_spec:
-            # nucleus machinery only when a seated row actually needs it
-            use_topp = any(s.params.top_p < 1.0 for _, s in seated)
+            # nucleus machinery only when a seated row actually needs it;
+            # greedy rows (temperature 0) sample a one-hot, for which
+            # nucleus filtering is a no-op — skip the full-vocab sorts
+            use_topp = any(
+                s.params.top_p < 1.0 and s.params.temperature > 0.0
+                for _, s in seated
+            )
             (toks, lps, counts, acc, prop, tokens, positions, steps_left,
              active, self.state.k, self.state.v,
              self.draft_state.k, self.draft_state.v,
@@ -1746,7 +1751,11 @@ class LLMEngine:
             seq.pending_ids = []
             return text
         piece = self.tok.decode_token(token_id)
-        if "�" in piece:
+        # only a TRAILING replacement char signals an incomplete multi-byte
+        # sequence; a vocab entry that legitimately decodes to U+FFFD
+        # mid-string would otherwise be delayed and merged into the next
+        # delta for no reason
+        if piece.endswith("�"):
             seq.pending_ids = [token_id]
             return ""
         return piece

@@ -321,11 +321,10 @@ def speculative_generate(
     """Generate with speculative decoding; returns [B, max_new_tokens].
 
     Host loop over jitted rounds; per-row raggedness means rows may finish
-    in different rounds (extra tokens are trimmed). Falls back to plain
-    rounds of gamma=1... no — when the tracker disables speculation, the
-    caller should switch to the normal decode path; here we simply stop
-    speculating and emit one (bonus) token per round, which is exactly
-    vanilla decoding cost."""
+    in different rounds (extra tokens are trimmed). When the tracker
+    disables speculation, rounds drop to gamma=1 — one draft + one verify
+    per emitted token, approximately vanilla decoding cost — until the
+    tracker's probation window re-enables full gamma."""
     B, T0 = prompt_ids.shape
     gamma_cfg = spec.num_draft_tokens
     # every round may write up to gamma+1 new positions past seq_len; the

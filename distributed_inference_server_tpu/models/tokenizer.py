@@ -15,8 +15,9 @@ processing (``tasks.md:259-262`` [spec]).
 
 from __future__ import annotations
 
+import json
 import os
-from typing import List, Optional, Protocol, Sequence
+from typing import Callable, List, Optional, Protocol, Sequence
 
 from distributed_inference_server_tpu.core.models import ChatMessage
 
@@ -87,12 +88,138 @@ class HFTokenizer:
 
 
 def load_tokenizer(model_dir: Optional[str]) -> Tokenizer:
-    """Load the checkpoint's tokenizer.json, or fall back to bytes."""
+    """Load the checkpoint's tokenizer.json, or fall back to bytes.
+
+    Also attaches the checkpoint's OWN chat template when the directory
+    ships one (``tokenizer_config.json``'s ``chat_template`` key) as a
+    ``chat_template`` attribute on the returned tokenizer — the
+    authoritative template travels with the tokenizer through model
+    hot-swap, and the handler prefers it over model-name family sniffing
+    (a finetune named "my-assistant-v2" over Qwen2 weights gets ChatML
+    from its checkpoint, not Llama-3 from its name)."""
     if model_dir:
         path = os.path.join(model_dir, "tokenizer.json")
-        if os.path.exists(path):
-            return HFTokenizer(path)
+        tok: Tokenizer = (
+            HFTokenizer(path) if os.path.exists(path) else ByteTokenizer()
+        )
+        template = load_chat_template(model_dir)
+        if template is not None:
+            tok.chat_template = template  # type: ignore[attr-defined]
+        return tok
     return ByteTokenizer()
+
+
+def _special_token_text(value) -> str:
+    """tokenizer_config.json serializes special tokens either as plain
+    strings or as AddedToken dicts ``{"content": "...", ...}``."""
+    if isinstance(value, dict):
+        return str(value.get("content", ""))
+    return str(value) if value is not None else ""
+
+
+def load_chat_template(
+    model_dir: str,
+) -> Optional[Callable[[Sequence[ChatMessage]], str]]:
+    """Compile the checkpoint's Jinja chat template into a renderer, or
+    None when the directory ships no usable template.
+
+    Real checkpoints carry the authoritative conversation format in
+    ``tokenizer_config.json`` under ``chat_template`` — either a single
+    Jinja string or a list of ``{"name", "template"}`` entries (the
+    "default" entry is the chat one). Rendering follows the HF
+    convention: a sandboxed immutable Jinja environment, ``messages`` as
+    a list of ``{"role", "content"}`` dicts, ``add_generation_prompt``
+    True (we always render to generate), and ``bos_token``/``eos_token``
+    from the same config file. A template that fails to compile is
+    treated as absent (the family table covers rendering) rather than
+    breaking tokenizer load."""
+    cfg_path = os.path.join(model_dir, "tokenizer_config.json")
+    try:
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+    except (OSError, ValueError):
+        return None
+    source = cfg.get("chat_template")
+    if isinstance(source, list):
+        # named-template list form; only the "default" entry is the chat
+        # template. With no default entry the right format is unknowable
+        # (the others are rag/tool_use/etc.) — treat as absent rather
+        # than guessing a wrong prompt format (HF raises here too)
+        by_name = {
+            e.get("name"): e.get("template")
+            for e in source
+            if isinstance(e, dict)
+        }
+        source = by_name.get("default")
+    if not isinstance(source, str) or not source.strip():
+        return None
+    try:
+        from jinja2.exceptions import TemplateError
+        from jinja2.sandbox import ImmutableSandboxedEnvironment
+    except ImportError:
+        return None
+
+    def _raise_exception(message: str):
+        raise TemplateError(message)
+
+    env = ImmutableSandboxedEnvironment(
+        trim_blocks=True, lstrip_blocks=True
+    )
+    env.globals["raise_exception"] = _raise_exception
+    try:
+        compiled = env.from_string(source)
+    except TemplateError:
+        return None
+    bos = _special_token_text(cfg.get("bos_token"))
+    eos = _special_token_text(cfg.get("eos_token"))
+
+    def render(messages: Sequence[ChatMessage]) -> str:
+        return compiled.render(
+            messages=[
+                {"role": m.role.value, "content": m.content}
+                for m in messages
+            ],
+            add_generation_prompt=True,
+            bos_token=bos,
+            eos_token=eos,
+        )
+
+    return render
+
+
+def render_chat(
+    messages: Sequence[ChatMessage],
+    tokenizer: Optional[Tokenizer] = None,
+    model_name: str = "",
+) -> str:
+    """Render a conversation for generation: the checkpoint's own
+    template when the tokenizer carries one (see ``load_tokenizer``),
+    else the family table keyed on the model name. A template that
+    raises at render time (e.g. one that forbids system messages via
+    ``raise_exception``) falls back to the family table rather than
+    failing the request."""
+    template = getattr(tokenizer, "chat_template", None)
+    if template is not None:
+        try:
+            return template(messages)
+        except Exception as e:
+            # fall back, but say so (once per tokenizer): a template that
+            # ALWAYS fails silently reverting to name-sniffing is the
+            # exact misrouting the checkpoint template exists to prevent
+            if not getattr(tokenizer, "_chat_template_warned", False):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "checkpoint chat_template failed to render (%s); "
+                    "falling back to the %r family template",
+                    e,
+                    chat_template_family(model_name),
+                )
+                try:
+                    tokenizer._chat_template_warned = True  # type: ignore[union-attr]
+                except AttributeError:
+                    pass
+    return apply_chat_template(messages, chat_template_family(model_name))
 
 
 def chat_template_family(model_name: str) -> str:
@@ -119,7 +246,7 @@ def apply_chat_template(
     Families (HF chat_template conventions):
     - ``llama3``: ``<|start_header_id|>role<|end_header_id|>`` headers,
       ``<|eot_id|>`` turn ends, assistant generation header appended.
-    - ``mistral``: ``[INST] user [/INST]assistant</s>`` pairs; a system
+    - ``mistral``: ``[INST] user [/INST] assistant</s>`` pairs; a system
       message is folded into the first user turn (Mistral's template has
       no system slot).
     - ``chatml`` (Qwen2): ``<|im_start|>role\\n...<|im_end|>`` blocks +
@@ -144,7 +271,9 @@ def apply_chat_template(
                 pending = []
                 parts.append(f"[INST] {content} [/INST]")
             else:  # assistant
-                parts.append(f"{m.content}</s>")
+                # HF's reference chat_template puts a space between
+                # [/INST] and the assistant text: "[/INST] reply</s>"
+                parts.append(f" {m.content}</s>")
         if pending:
             leftover = "\n\n".join(pending)
             parts.append(f"[INST] {leftover} [/INST]")

@@ -52,8 +52,8 @@ from distributed_inference_server_tpu.core.validator import RequestValidator
 from distributed_inference_server_tpu.engine.engine import SamplingParams
 from distributed_inference_server_tpu.models.tokenizer import (
     Tokenizer,
-    apply_chat_template,
     chat_template_family,
+    render_chat,
 )
 from distributed_inference_server_tpu.serving.dispatcher import Dispatcher
 from distributed_inference_server_tpu.serving.metrics import MetricsCollector
@@ -98,9 +98,10 @@ class InferenceHandler:
 
     @property
     def chat_family(self) -> str:
-        """Chat-template family derived from the CURRENT model name —
-        a property so model hot-swap (server.py swap_model) retemplates
-        /chat without extra bookkeeping."""
+        """Chat-template family the FALLBACK path would use for the
+        current model name. Introspection only — the request path goes
+        through render_chat, which prefers the checkpoint's own template
+        (carried on the tokenizer) and re-derives the family itself."""
         return chat_template_family(self.model_name)
 
     def _params(self, max_tokens: int, temperature: float, top_p: float,
@@ -256,9 +257,11 @@ class InferenceHandler:
 
     def _chat_ids(self, req: ChatRequest) -> List[int]:
         # the template carries its own BOS marker text; HF tokenizers encode
-        # it as a literal, so skip the extra BOS id
+        # it as a literal, so skip the extra BOS id. render_chat prefers the
+        # checkpoint's own chat_template (attached to the tokenizer at load,
+        # so hot-swap retargeting carries it) over model-name sniffing.
         return self.tok.encode(
-            apply_chat_template(req.messages, self.chat_family),
+            render_chat(req.messages, self.tok, self.model_name),
             add_bos=False,
         )
 

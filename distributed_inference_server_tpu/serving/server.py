@@ -244,6 +244,19 @@ class InferenceServer:
             if tok is not None:
                 self.handler.tok = tok
                 break
+        else:
+            # every runner's engine read back None after a successful
+            # swap — the handler keeps templating with the OLD tokenizer
+            # against the NEW model_name, exactly the cross-family /chat
+            # garbling the retarget exists to prevent; say so loudly
+            import logging
+
+            logging.getLogger(__name__).error(
+                "model swap to %r succeeded but no runner yielded a "
+                "tokenizer; handler tokenizer NOT retargeted (stale "
+                "tokenizer paired with the new model name)",
+                model_name,
+            )
         return True, None
 
     # -- hot-reload --------------------------------------------------------

@@ -38,9 +38,10 @@ class TestTemplates:
 
     def test_mistral_inst_pairs_fold_system(self):
         out = apply_chat_template(CONVO, "mistral")
-        # system folds into the FIRST user turn; assistant closes with </s>
+        # system folds into the FIRST user turn; assistant closes with
+        # </s> and follows "[/INST] " with a space (HF chat_template)
         assert out == (
-            "<s>[INST] be brief\n\nhi [/INST]hello</s>[INST] bye [/INST]"
+            "<s>[INST] be brief\n\nhi [/INST] hello</s>[INST] bye [/INST]"
         )
 
     def test_chatml_blocks(self):
@@ -126,3 +127,143 @@ class TestSystemFolding:
             "<start_of_turn>user\nbe brief<end_of_turn>\n"
             "<start_of_turn>model\n"
         )
+
+
+# Qwen2-style ChatML template as checkpoints actually ship it
+# (tokenizer_config.json "chat_template" key, Jinja)
+CHATML_JINJA = (
+    "{% for message in messages %}"
+    "{{ '<|im_start|>' + message['role'] + '\\n' + message['content'] "
+    "+ '<|im_end|>' + '\\n' }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|im_start|>assistant\\n' }}"
+    "{% endif %}"
+)
+
+CHATML_RENDERED = (
+    "<|im_start|>system\nbe brief<|im_end|>\n"
+    "<|im_start|>user\nhi<|im_end|>\n"
+    "<|im_start|>assistant\nhello<|im_end|>\n"
+    "<|im_start|>user\nbye<|im_end|>\n"
+    "<|im_start|>assistant\n"
+)
+
+
+def _write_cfg(tmp_path, cfg: dict) -> str:
+    import json
+
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps(cfg))
+    return str(tmp_path)
+
+
+class TestCheckpointTemplate:
+    """The checkpoint's own tokenizer_config.json chat_template is the
+    authority (VERDICT r3 weak #4: name sniffing gave a finetune named
+    'my-assistant-v2' over Qwen2 weights the Llama-3 template)."""
+
+    def test_template_from_file_beats_name_sniffing(self, tmp_path):
+        from distributed_inference_server_tpu.models.tokenizer import (
+            load_tokenizer,
+            render_chat,
+        )
+
+        d = tmp_path / "my-assistant-v2"  # sniffs as llama3
+        d.mkdir()
+        _write_cfg(d, {"chat_template": CHATML_JINJA})
+        tok = load_tokenizer(str(d))  # no tokenizer.json -> ByteTokenizer
+        assert chat_template_family("my-assistant-v2") == "llama3"
+        assert render_chat(CONVO, tok, "my-assistant-v2") == CHATML_RENDERED
+
+    def test_no_config_falls_back_to_family(self, tmp_path):
+        from distributed_inference_server_tpu.models.tokenizer import (
+            load_tokenizer,
+            render_chat,
+        )
+
+        tok = load_tokenizer(str(tmp_path))
+        assert render_chat(CONVO, tok, "qwen2-7b") == apply_chat_template(
+            CONVO, "chatml"
+        )
+
+    def test_list_form_picks_default_entry(self, tmp_path):
+        from distributed_inference_server_tpu.models.tokenizer import (
+            load_chat_template,
+        )
+
+        _write_cfg(tmp_path, {
+            "chat_template": [
+                {"name": "tool_use", "template": "TOOLS"},
+                {"name": "default", "template": CHATML_JINJA},
+            ],
+        })
+        tpl = load_chat_template(str(tmp_path))
+        assert tpl is not None
+        assert tpl(CONVO) == CHATML_RENDERED
+
+    def test_special_tokens_rendered_from_config(self, tmp_path):
+        from distributed_inference_server_tpu.models.tokenizer import (
+            load_chat_template,
+        )
+
+        _write_cfg(tmp_path, {
+            "chat_template": (
+                "{{ bos_token }}{% for m in messages %}{{ m['content'] }}"
+                "{{ eos_token }}{% endfor %}"
+            ),
+            # AddedToken-dict and plain-string spellings both appear in
+            # real checkpoints
+            "bos_token": {"content": "<s>"},
+            "eos_token": "</s>",
+        })
+        tpl = load_chat_template(str(tmp_path))
+        out = tpl([ChatMessage(role=Role.USER, content="hi")])
+        assert out == "<s>hi</s>"
+
+    def test_list_form_without_default_treated_as_absent(self, tmp_path):
+        """No 'default' entry means the chat format is unknowable (the
+        named entries are rag/tool_use/...); guessing one would render
+        every /chat in a wrong prompt format."""
+        from distributed_inference_server_tpu.models.tokenizer import (
+            load_chat_template,
+        )
+
+        _write_cfg(tmp_path, {
+            "chat_template": [
+                {"name": "rag", "template": "RAG"},
+                {"name": "tool_use", "template": "TOOLS"},
+            ],
+        })
+        assert load_chat_template(str(tmp_path)) is None
+
+    def test_broken_template_treated_as_absent(self, tmp_path):
+        from distributed_inference_server_tpu.models.tokenizer import (
+            load_chat_template,
+        )
+
+        _write_cfg(tmp_path, {"chat_template": "{% for m in %}broken"})
+        assert load_chat_template(str(tmp_path)) is None
+
+    def test_render_time_error_falls_back_to_family(self, tmp_path):
+        """Templates that reject conversations via raise_exception (e.g.
+        Mistral's no-system-message guard) must not 500 the request."""
+        from distributed_inference_server_tpu.models.tokenizer import (
+            load_tokenizer,
+            render_chat,
+        )
+
+        _write_cfg(tmp_path, {
+            "chat_template": (
+                "{% for m in messages %}"
+                "{% if m['role'] == 'system' %}"
+                "{{ raise_exception('no system role') }}{% endif %}"
+                "{{ m['content'] }}{% endfor %}"
+            ),
+        })
+        tok = load_tokenizer(str(tmp_path))
+        # CONVO opens with a system message -> template raises -> family
+        assert render_chat(CONVO, tok, "qwen2-7b") == apply_chat_template(
+            CONVO, "chatml"
+        )
+        # a conversation the template accepts renders via the template
+        ok = [ChatMessage(role=Role.USER, content="hi")]
+        assert render_chat(ok, tok, "qwen2-7b") == "hi"
