@@ -99,7 +99,9 @@ def build_app(
                                encode=sse_encode):
         """One SSE scaffold for every stream (native TokenEvent frames
         and the /v1 OpenAI-chunk encoding differ only in ``encode``) —
-        the Req 5.4 abort-on-disconnect logic exists exactly once."""
+        the Req 5.4 abort-on-disconnect logic exists exactly once.
+        ``request_id`` may be a single id or the list of fanned-out ids
+        (/v1 with n > 1): every live sequence is aborted on disconnect."""
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -108,14 +110,28 @@ def build_app(
                 "Connection": "keep-alive",
             },
         )
-        await resp.prepare(request)
+        consuming = False
         try:
+            await resp.prepare(request)
+            consuming = True  # past here the generator is entered, and a
+            # cancellation lands inside its frame — its finally then owns
+            # the per-request metrics/span bookkeeping
             async for event in events:
                 await resp.write(encode(event))
             await resp.write(SSE_DONE)
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: abort generation (Req 5.4)
-            handler.dispatcher.abort(request_id)
+            rids = (request_id if isinstance(request_id, (list, tuple))
+                    else (request_id,))
+            if consuming:
+                for rid in rids:
+                    handler.dispatcher.abort(rid)
+            else:
+                # disconnect during prepare: the generator never started,
+                # its finally will never run, and abort drops requests
+                # with no sink callback — so do the abort AND the
+                # bookkeeping the stream's finally would have done
+                handler.release_unstarted(rids)
             raise
         await resp.write_eof()
         return resp
@@ -126,21 +142,27 @@ def build_app(
         translation and wire mapping applied only on the v1 paths."""
         obj = await _json_body(request)
         if v1:
-            obj = _openai_fields(obj)
+            obj, opts = _openai_fields(obj, chat=chat)
+            if obj.get("stream") is True:
+                rids, events = await handler.stream_many(
+                    obj, chat=chat, n=opts.n
+                )
+                return await _stream_response_v1(
+                    request, rids, events, chat=chat, opts=opts
+                )
+            rid, choices, usage = await handler.complete_many(
+                obj, chat=chat, n=opts.n
+            )
+            return web.json_response(
+                _v1_response(rid, choices, usage, chat=chat, opts=opts)
+            )
         stream_fn = handler.chat_stream if chat else handler.generate_stream
         call_fn = handler.chat if chat else handler.generate
         if obj.get("stream") is True:
             request_id, events = await stream_fn(obj)
-            if v1:
-                return await _stream_response_v1(
-                    request, request_id, events, chat=chat
-                )
             return await _stream_response(request, request_id, events)
         result = await call_fn(obj)
-        d = result.to_dict()
-        if v1:
-            d = _v1_finish_reasons(d)
-        return web.json_response(d)
+        return web.json_response(result.to_dict())
 
     async def generate(request: web.Request) -> web.StreamResponse:
         return await _serve_completion(request, chat=False, v1=False)
@@ -161,15 +183,109 @@ def build_app(
     # streaming as text_completion / chat.completion.chunk objects with
     # choices[].text / choices[].delta instead of internal TokenEvents.
 
-    def _openai_fields(obj: dict) -> dict:
+    class _V1Opts:
+        """Parsed OpenAI-only request options (everything the native
+        schema doesn't carry)."""
+
+        __slots__ = ("n", "include_usage", "logprobs")
+
+        def __init__(self, n=1, include_usage=False, logprobs=False):
+            self.n = n
+            self.include_usage = include_usage
+            self.logprobs = logprobs
+
+    # fan-out bound: each choice is a full engine sequence admitted
+    # through the same queue, so one request must not be able to claim
+    # an unbounded slice of capacity (OpenAI itself caps n at 128)
+    _MAX_N = 16
+
+    def _openai_fields(obj: dict, *, chat: bool):
+        """Translate/validate the OpenAI request spellings. Returns
+        ``(obj, _V1Opts)``. Shape-changing fields we do not implement
+        (echo, best_of>n, top-alternative logprobs, suffix) are rejected
+        with a clear 400 — a silently wrong response shape is worse than
+        an honest error."""
         # _json_body already 400s on non-dict bodies
         n = obj.get("n")
-        if n is not None and (type(n) is not int or n != 1):
-            # a silent single choice where the client asked for n would
-            # be a wrong response shape, not a degraded one (and bool is
-            # not an int here: n=true must not pass as 1)
-            raise ApiErrorJson('"n" must be 1 (multiple choices are not '
-                               "supported)")
+        if n is None:
+            n = 1
+        elif type(n) is not int or not 1 <= n <= _MAX_N:
+            # bool is not an int here: n=true must not pass as 1
+            raise ApiErrorJson(
+                f'"n" must be an integer in [1, {_MAX_N}]'
+            )
+        opts = _V1Opts(n=n)
+
+        so = obj.get("stream_options")
+        if so is not None:
+            if obj.get("stream") is not True:
+                raise ApiErrorJson(
+                    '"stream_options" requires "stream": true'
+                )
+            if not isinstance(so, dict):
+                raise ApiErrorJson('"stream_options" must be an object')
+            iu = so.get("include_usage", False)
+            if not isinstance(iu, bool):
+                raise ApiErrorJson(
+                    '"stream_options.include_usage" must be a boolean'
+                )
+            opts.include_usage = iu
+
+        lp = obj.get("logprobs")
+        if chat:
+            if lp is not None and not isinstance(lp, bool):
+                raise ApiErrorJson('"logprobs" must be a boolean')
+            opts.logprobs = bool(lp)
+            tlp = obj.get("top_logprobs")
+            if tlp is not None:
+                if type(tlp) is not int or not 0 <= tlp <= 20:
+                    raise ApiErrorJson(
+                        '"top_logprobs" must be an integer in [0, 20]'
+                    )
+                if not opts.logprobs:
+                    raise ApiErrorJson(
+                        '"logprobs" must be true when "top_logprobs" '
+                        "is used"
+                    )
+                if tlp > 0:
+                    raise ApiErrorJson(
+                        '"top_logprobs" > 0 (alternative-token logprobs) '
+                        "is not supported; use 0 for sampled-token "
+                        "logprobs"
+                    )
+        else:
+            # completions spelling: logprobs is an int — the number of
+            # TOP-ALTERNATIVE tokens to return per position. 0 = just the
+            # sampled token's logprob (supported); >0 needs per-step
+            # top-k alternatives we don't surface.
+            if lp is not None:
+                if type(lp) is not int or lp < 0:
+                    raise ApiErrorJson(
+                        '"logprobs" must be a non-negative integer'
+                    )
+                if lp > 0:
+                    raise ApiErrorJson(
+                        '"logprobs" > 0 (alternative-token logprobs) is '
+                        "not supported; use 0 for sampled-token logprobs"
+                    )
+                opts.logprobs = True
+            if obj.get("echo"):
+                raise ApiErrorJson(
+                    '"echo" is not supported (the response would have to '
+                    "prepend the prompt)"
+                )
+            if obj.get("suffix") is not None:
+                raise ApiErrorJson('"suffix" is not supported')
+            bo = obj.get("best_of")
+            if bo is not None and (type(bo) is not int or bo != n):
+                # best_of == n degenerates to "return all n"; more means
+                # server-side reranking we don't do, fewer than n is
+                # self-contradictory (OpenAI 400s best_of < n too)
+                raise ApiErrorJson(
+                    f'"best_of" must equal n (= {n}); server-side '
+                    "candidate reranking is not supported"
+                )
+
         # the SDKs' recommended replacement for the deprecated max_tokens
         if "max_completion_tokens" in obj and "max_tokens" not in obj:
             obj["max_tokens"] = obj.pop("max_completion_tokens")
@@ -189,60 +305,206 @@ def build_app(
                 # position 0 and instantly truncate to an empty output
                 raise ApiErrorJson('"stop" strings must be non-empty')
             obj["stop_sequences"] = stop
-        return obj
+        return obj, opts
 
-    def _v1_finish_reasons(d: dict) -> dict:
-        for c in d.get("choices", ()):
-            if c.get("finish_reason") == "stop_sequence":
-                c["finish_reason"] = "stop"
-        return d
+    def _v1_finish(reason) -> Optional[str]:
+        fr = getattr(reason, "value", reason)
+        return "stop" if fr == "stop_sequence" else fr
 
-    async def _stream_response_v1(request, request_id, events, *,
-                                  chat: bool):
+    def _lp_completions(token_texts, logprobs) -> dict:
+        """OpenAI completions logprobs object (sampled token only).
+        text_offset is the cumulative character offset of each token's
+        isolated decode within the generated text; tokens held back by
+        incremental detok decode to U+FFFD fragments in isolation, same
+        as OpenAI's own byte-fragment rendering."""
+        offsets, pos = [], 0
+        for t in token_texts:
+            offsets.append(pos)
+            pos += len(t)
+        return {
+            "tokens": token_texts,
+            "token_logprobs": logprobs,
+            "top_logprobs": None,
+            "text_offset": offsets,
+        }
+
+    def _lp_chat(token_texts, logprobs) -> dict:
+        """OpenAI chat logprobs object: content[] of per-token entries.
+        top_logprobs is always [] — alternative-token logprobs are
+        rejected at request parse (top_logprobs > 0). Entries without a
+        logprob are dropped rather than emitted with null: the OpenAI
+        schema requires a float (a held-back-text flush carries no
+        logprob of its own — its tokens' logprobs already streamed)."""
+        return {
+            "content": [
+                {
+                    "token": t,
+                    "logprob": lp,
+                    "bytes": list(t.encode("utf-8")),
+                    "top_logprobs": [],
+                }
+                for t, lp in zip(token_texts, logprobs)
+                if lp is not None
+            ]
+        }
+
+    def _v1_response(request_id, choices, usage, *, chat: bool,
+                     opts) -> dict:
+        """Non-streaming OpenAI response envelope from the handler's
+        fan-out results (one entry per choice, indices 0..n-1)."""
+        out = []
+        for i, c in enumerate(choices):
+            lp_obj = None
+            if opts.logprobs:
+                texts = [handler.tok.decode_token(t)
+                         for t in c["token_ids"]]
+                lp_obj = (
+                    _lp_chat(texts, c["token_logprobs"]) if chat
+                    else _lp_completions(texts, c["token_logprobs"])
+                )
+            if chat:
+                out.append({
+                    "index": i,
+                    "message": {"role": "assistant",
+                                "content": c["text"]},
+                    "logprobs": lp_obj,
+                    "finish_reason": _v1_finish(c["finish_reason"]),
+                })
+            else:
+                out.append({
+                    "text": c["text"],
+                    "index": i,
+                    "logprobs": lp_obj,
+                    "finish_reason": _v1_finish(c["finish_reason"]),
+                })
+        return {
+            "id": ("chatcmpl-" if chat else "cmpl-") + str(request_id),
+            "object": "chat.completion" if chat else "text_completion",
+            "created": int(time.time()),
+            "model": handler.model_name,
+            "choices": out,
+            "usage": usage.to_dict(),
+        }
+
+    async def _stream_response_v1(request, request_ids, events, *,
+                                  chat: bool, opts):
+        """OpenAI chunk encoding over the merged (choice_index, event)
+        stream. Per-choice state: the role appears only in a choice's
+        first delta; each choice gets its own finish chunk. With
+        stream_options.include_usage every chunk carries "usage": null
+        and one final usage-only chunk (empty choices) precedes [DONE]."""
         obj_name = "chat.completion.chunk" if chat else "text_completion"
-        rid = ("chatcmpl-" if chat else "cmpl-") + str(request_id)
+        rid = ("chatcmpl-" if chat else "cmpl-") + str(request_ids[0])
         created = int(time.time())
         model = handler.model_name
+        n = len(request_ids)
 
         def frame(payload: dict) -> bytes:
+            if opts.include_usage and "usage" not in payload:
+                payload["usage"] = None
             return b"data: " + json.dumps(payload).encode() + b"\n\n"
 
-        first = [True]  # OpenAI wire: role appears only in the 1st delta
+        def envelope(choice: dict, usage=None) -> bytes:
+            payload = {"id": rid, "object": obj_name, "created": created,
+                       "model": model, "choices": [choice]}
+            if usage is not None:
+                payload["usage"] = usage
+            return frame(payload)
 
-        def chunk(ev: dict) -> bytes:
-            t = ev.get("type")
-            if t == "token":
+        first = [True] * n  # role only in each choice's 1st delta
+        offset = [0] * n  # per-choice char offset for completions logprobs
+        observed = [0] * n  # sampled tokens seen per choice (usage
+        # fallback for choices that error mid-generation: their done
+        # event — the authoritative usage carrier — never arrives)
+        prompt_tokens = [0]
+        completion_tokens = [0]
+        remaining = [n]
+
+        def chunk(pair) -> bytes:
+            idx, ev = pair
+            if ev.type == "token":
+                text = ev.token or ""
+                if ev.logprob is not None:
+                    # real sampled token (flushes carry no logprob)
+                    observed[idx] += 1
+                lp_obj = None
+                if opts.logprobs:
+                    # a held-back-text flush (no logprob of its own) gets
+                    # a null logprobs object, matching the non-stream
+                    # path which records sampled tokens only; its text
+                    # still advances the completions offset so offsets
+                    # keep matching the emitted text
+                    if chat:
+                        lp_obj = (
+                            _lp_chat([text], [ev.logprob])
+                            if ev.logprob is not None else None
+                        )
+                    else:
+                        if ev.logprob is not None:
+                            lp_obj = _lp_completions([text], [ev.logprob])
+                            lp_obj["text_offset"] = [offset[idx]]
+                        offset[idx] += len(text)
                 if chat:
-                    delta = {"content": ev.get("token") or ""}
-                    if first[0]:
+                    delta = {"content": text}
+                    if first[idx]:
                         delta = {"role": "assistant", **delta}
-                        first[0] = False
-                    choice = {"index": 0, "delta": delta,
-                              "finish_reason": None}
+                        first[idx] = False
+                    choice = {"index": idx, "delta": delta,
+                              "logprobs": lp_obj, "finish_reason": None}
                 else:
-                    choice = {"text": ev.get("token") or "", "index": 0,
-                              "logprobs": None, "finish_reason": None}
-            elif t == "done":
-                fr = ev.get("finish_reason")
-                fr = "stop" if fr == "stop_sequence" else fr
+                    choice = {"text": text, "index": idx,
+                              "logprobs": lp_obj, "finish_reason": None}
+                return envelope(choice)
+            if ev.type == "done":
+                fr = _v1_finish(ev.finish_reason)
+                if ev.usage is not None:
+                    prompt_tokens[0] = max(prompt_tokens[0],
+                                           ev.usage.prompt_tokens)
+                    completion_tokens[0] += ev.usage.completion_tokens
                 choice = (
-                    {"index": 0, "delta": {}, "finish_reason": fr}
+                    {"index": idx, "delta": {}, "logprobs": None,
+                     "finish_reason": fr}
                     if chat else
-                    {"text": "", "index": 0, "logprobs": None,
+                    {"text": "", "index": idx, "logprobs": None,
                      "finish_reason": fr}
                 )
-            else:  # error: no OpenAI stream-error standard; error object
-                return frame({"error": {
-                    "message": ev.get("messages") or "",
-                    "code": ev.get("code") or "server_error",
-                }})
-            return frame({"id": rid, "object": obj_name,
-                          "created": created, "model": model,
-                          "choices": [choice]})
+                return envelope(choice) + _maybe_usage_chunk()
+            # error: no OpenAI stream-error standard; error object with
+            # the choice index so n>1 clients can attribute it (the
+            # stream keeps going for the surviving choices). An error
+            # TERMINATES its choice (the sink closes after it), so it
+            # counts toward stream completion like a done event —
+            # otherwise the include_usage final chunk would never fire
+            # when any choice errors.
+            completion_tokens[0] += observed[idx]
+            return frame({"error": {
+                "message": ev.messages or "",
+                "code": ev.code or "server_error",
+                "index": idx,
+            }}) + _maybe_usage_chunk()
+
+        def _maybe_usage_chunk() -> bytes:
+            """Decrement the live-choice count; on the LAST terminal
+            event (done or error), emit the usage-only final chunk when
+            stream_options.include_usage asked for it (OpenAI: empty
+            choices array, preceding [DONE])."""
+            remaining[0] -= 1
+            if remaining[0] != 0 or not opts.include_usage:
+                return b""
+            total = prompt_tokens[0] + completion_tokens[0]
+            return frame({
+                "id": rid, "object": obj_name,
+                "created": created, "model": model,
+                "choices": [],
+                "usage": {
+                    "prompt_tokens": prompt_tokens[0],
+                    "completion_tokens": completion_tokens[0],
+                    "total_tokens": total,
+                },
+            })
 
         return await _stream_response(
-            request, request_id, events,
-            encode=lambda event: chunk(event.to_dict()),
+            request, request_ids, events, encode=chunk
         )
 
     async def generate_v1(request: web.Request) -> web.StreamResponse:

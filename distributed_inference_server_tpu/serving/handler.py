@@ -193,16 +193,10 @@ class InferenceHandler:
             raise ValidationApiError(e) from None
 
     async def generate(self, obj: dict) -> GenerateResponse:
-        req = self.parse_generate(obj)
+        ids, params, prio = self._parse_one(obj, chat=False)
         loop = asyncio.get_running_loop()
         sink = CollectingSink(loop)
-        request_id = self._submit(
-            self.tok.encode(req.prompt),
-            self._params(req.max_tokens, req.temperature, req.top_p,
-                         req.stop_sequences),
-            sink,
-            req.priority or Priority.NORMAL,
-        )
+        request_id = self._submit(ids, params, sink, prio)
         text, reason, usage = await self._await_completion(sink, request_id)
         return GenerateResponse(
             id=f"cmpl-{request_id}",
@@ -219,16 +213,10 @@ class InferenceHandler:
         """Validate + enqueue; returns (request_id, async TokenEvent
         iterator). Caller aborts via dispatcher on client disconnect
         (Req 5.4)."""
-        req = self.parse_generate(obj)
+        ids, params, prio = self._parse_one(obj, chat=False)
         loop = asyncio.get_running_loop()
         sink = StreamingSink(loop)
-        request_id = self._submit(
-            self.tok.encode(req.prompt),
-            self._params(req.max_tokens, req.temperature, req.top_p,
-                         req.stop_sequences),
-            sink,
-            req.priority or Priority.NORMAL,
-        )
+        request_id = self._submit(ids, params, sink, prio)
         return request_id, self._finalize_stream(sink, request_id)
 
     async def _finalize_stream(self, sink: StreamingSink,
@@ -266,16 +254,10 @@ class InferenceHandler:
         )
 
     async def chat(self, obj: dict) -> ChatResponse:
-        req = self.parse_chat(obj)
+        ids, params, prio = self._parse_one(obj, chat=True)
         loop = asyncio.get_running_loop()
         sink = CollectingSink(loop)
-        request_id = self._submit(
-            self._chat_ids(req),
-            self._params(req.max_tokens, req.temperature, req.top_p,
-                         req.stop_sequences),
-            sink,
-            Priority.NORMAL,
-        )
+        request_id = self._submit(ids, params, sink, prio, endpoint="chat")
         text, reason, usage = await self._await_completion(sink, request_id)
         return ChatResponse(
             id=f"chatcmpl-{request_id}",
@@ -295,17 +277,156 @@ class InferenceHandler:
     async def chat_stream(
         self, obj: dict
     ) -> Tuple[RequestId, AsyncIterator[TokenEvent]]:
-        req = self.parse_chat(obj)
+        ids, params, prio = self._parse_one(obj, chat=True)
         loop = asyncio.get_running_loop()
         sink = StreamingSink(loop)
-        request_id = self._submit(
-            self._chat_ids(req),
-            self._params(req.max_tokens, req.temperature, req.top_p,
-                         req.stop_sequences),
-            sink,
-            Priority.NORMAL,
-        )
+        request_id = self._submit(ids, params, sink, prio, endpoint="chat")
         return request_id, self._finalize_stream(sink, request_id)
+
+    # -- /v1 multi-choice fan-out ------------------------------------------
+
+    def _parse_one(self, obj: dict, chat: bool):
+        """Validate once, then share (prompt_ids, params, priority) across
+        every fanned-out choice."""
+        if chat:
+            req = self.parse_chat(obj)
+            ids = self._chat_ids(req)
+            prio = Priority.NORMAL
+        else:
+            req = self.parse_generate(obj)
+            ids = self.tok.encode(req.prompt)
+            prio = req.priority or Priority.NORMAL
+        params = self._params(req.max_tokens, req.temperature, req.top_p,
+                              req.stop_sequences)
+        return ids, params, prio
+
+    def _abort_submitted(self, request_ids) -> None:
+        """Clean up submitted requests whose sink path will never run:
+        ``dispatcher.abort`` drops a request with NO sink callback, so
+        the metrics/span bookkeeping the sink path would have done
+        happens here."""
+        for rid in request_ids:
+            self.dispatcher.abort(rid)
+            if self.metrics:
+                self.metrics.request_finished()
+            self._finish_span(rid, "aborted")
+
+    def release_unstarted(self, request_ids) -> None:
+        """Abort streams whose consumer never started iterating (client
+        disconnected between submit and SSE prepare): the stream
+        generator's finally will never run, so its per-request
+        bookkeeping happens here instead."""
+        self._abort_submitted(request_ids)
+
+    def _submit_fanout(self, obj: dict, chat: bool, n: int, make_sink):
+        ids, params, prio = self._parse_one(obj, chat)
+        endpoint = "chat" if chat else "generate"
+        sinks, rids = [], []
+        try:
+            for _ in range(n):
+                sink = make_sink()
+                rids.append(
+                    self._submit(ids, params, sink, prio, endpoint=endpoint)
+                )
+                sinks.append(sink)
+        except ApiError:
+            self._abort_submitted(rids)
+            raise
+        return sinks, rids
+
+    async def complete_many(self, obj: dict, *, chat: bool, n: int = 1):
+        """Non-streaming /v1 path: one validated request fanned into ``n``
+        engine sequences sharing the prompt (the reference schema carries
+        multi-choice responses, models.rs:147-171; the prefix cache makes
+        the shared-prompt prefill nearly free). Returns ``(request_id,
+        choices, usage)``: ``choices[i]`` has text / finish_reason /
+        token_ids / token_logprobs, and usage aggregates OpenAI-style —
+        prompt counted once, completion tokens summed over choices."""
+        loop = asyncio.get_running_loop()
+        sinks, rids = self._submit_fanout(
+            obj, chat, n, lambda: CollectingSink(loop)
+        )
+        # every choice runs to completion even if a sibling errors (each
+        # _await_completion settles its own metrics/span bookkeeping);
+        # the first error is re-raised after the gather. Cancelling the
+        # enclosing task cancels every child, and each child aborts its
+        # own engine request.
+        results = await asyncio.gather(
+            *(self._await_completion(s, rid)
+              for s, rid in zip(sinks, rids)),
+            return_exceptions=True,
+        )
+        errs = [r for r in results if isinstance(r, BaseException)]
+        if errs:
+            raise errs[0]
+        choices = [
+            {
+                "text": text,
+                "finish_reason": reason,
+                "token_ids": list(sink.token_ids),
+                "token_logprobs": list(sink.token_logprobs),
+            }
+            for sink, (text, reason, _) in zip(sinks, results)
+        ]
+        prompt_tokens = results[0][2].prompt_tokens
+        completion = sum(r[2].completion_tokens for r in results)
+        return rids[0], choices, Usage.of(prompt_tokens, completion)
+
+    async def stream_many(self, obj: dict, *, chat: bool, n: int = 1):
+        """Streaming /v1 path: fan one validated request into ``n``
+        sequences and merge their TokenEvent streams into
+        ``(choice_index, event)`` pairs (OpenAI chunks name their choice
+        by index, so interleaving order is free). Returns
+        ``(request_ids, async iterator)``."""
+        loop = asyncio.get_running_loop()
+        sinks, rids = self._submit_fanout(
+            obj, chat, n, lambda: StreamingSink(loop)
+        )
+        if n == 1:
+            # common case: no pump task / merge queue on the hot path —
+            # consume the sink directly, just tagged with choice index 0
+            return rids, self._indexed_stream(sinks[0], rids[0])
+        return rids, self._merge_streams(sinks, rids)
+
+    async def _indexed_stream(self, sink: StreamingSink, rid):
+        async for ev in self._finalize_stream(sink, rid):
+            yield 0, ev
+
+    async def _merge_streams(self, sinks, rids):
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def pump(idx: int, sink: StreamingSink, rid) -> None:
+            status = "ok"
+            try:
+                async for ev in sink.events():
+                    q.put_nowait((idx, ev))
+            except BaseException:
+                status = "error"
+                raise
+            finally:
+                # per-choice analogue of _finalize_stream's bookkeeping
+                # (put_nowait: awaiting in a finally during cancellation
+                # would swallow the CancelledError)
+                if self.metrics:
+                    self.metrics.request_finished()
+                self._finish_span(rid, status)
+                q.put_nowait((idx, None))
+
+        tasks = [
+            asyncio.ensure_future(pump(i, s, rid))
+            for i, (s, rid) in enumerate(zip(sinks, rids))
+        ]
+        done = 0
+        try:
+            while done < len(sinks):
+                idx, ev = await q.get()
+                if ev is None:
+                    done += 1
+                    continue
+                yield idx, ev
+        finally:
+            for t in tasks:
+                t.cancel()
 
     # -- /embeddings -------------------------------------------------------
 

@@ -100,12 +100,19 @@ class StreamingSink:
 class CollectingSink:
     """ResultSink accumulating the full completion for non-streaming
     responses; resolves an asyncio future with
-    ``(text, finish_reason, usage)`` or an error tuple."""
+    ``(text, finish_reason, usage)`` or an error tuple.
+
+    Also records the per-token ``(token_id, logprob)`` trail for the /v1
+    ``logprobs`` surfaces. Safe to read after the future resolves: the
+    runner thread appends strictly before it schedules ``on_done``'s
+    resolution onto the loop."""
 
     def __init__(self, loop: asyncio.AbstractEventLoop):
         self._loop = loop
         self.future: asyncio.Future = loop.create_future()
         self._parts: list = []
+        self.token_ids: list = []
+        self.token_logprobs: list = []
 
     def _resolve(self, value) -> None:
         def _set() -> None:
@@ -120,6 +127,11 @@ class CollectingSink:
                  token_index: int, logprob: Optional[float] = None) -> None:
         if text:
             self._parts.append(text)
+        # one record per REAL sampled token; a held-back-text flush rides
+        # with token_id None and no logprob of its own
+        if token_id is not None:
+            self.token_ids.append(token_id)
+            self.token_logprobs.append(logprob)
 
     def on_done(self, finish_reason: FinishReason, usage: Usage) -> None:
         self._resolve(("".join(self._parts), finish_reason, usage, None, None))

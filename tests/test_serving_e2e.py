@@ -434,7 +434,7 @@ class TestOpenAIAliases:
             )
             assert bad.status == 400
             assert "non-empty" in (await bad.json())["error"]["message"]
-            for bad_n in (2, True, 0, "2"):
+            for bad_n in (True, 0, "2", 17, -1):
                 multi = await client.post(
                     "/v1/completions", json={"prompt": "x", "n": bad_n}
                 )
@@ -467,3 +467,211 @@ class TestOpenAIAliases:
         token_deltas = [d for d in deltas if d.get("content") is not None]
         assert "role" in token_deltas[0]
         assert all("role" not in d for d in token_deltas[1:])
+
+
+def _v1_chunks(body: str):
+    import json as _json
+
+    return [
+        _json.loads(line[6:])
+        for line in body.splitlines()
+        if line.startswith("data: {")
+    ]
+
+
+class TestV1ParityTail:
+    """OpenAI /v1 parity: n>1 fan-out, sampled-token logprobs, and
+    stream_options.include_usage (VERDICT r3 missing #5 / next #4;
+    multi-choice response schema models.rs:147-171)."""
+
+    def test_n2_completions_nonstream(self, server):
+        async def go(client):
+            resp = await client.post(
+                "/v1/completions",
+                json={"prompt": "fan out", "n": 2, "max_tokens": 4,
+                      "temperature": 0.0},
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            assert [c["index"] for c in body["choices"]] == [0, 1]
+            for c in body["choices"]:
+                assert c["finish_reason"] in ("stop", "length")
+                assert c["logprobs"] is None
+            u = body["usage"]
+            # prompt counted ONCE; completions summed over both choices
+            assert u["prompt_tokens"] == len("fan out") + 1  # +BOS
+            assert u["completion_tokens"] <= 8
+            assert u["total_tokens"] == (
+                u["prompt_tokens"] + u["completion_tokens"]
+            )
+            # greedy decoding: both choices must agree
+            assert body["choices"][0]["text"] == body["choices"][1]["text"]
+
+        _run(server, go)
+
+    def test_n2_chat_stream_interleaves_choices(self, server):
+        async def go(client):
+            resp = await client.post(
+                "/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}],
+                      "n": 2, "max_tokens": 3, "stream": True},
+            )
+            assert resp.status == 200
+            return (await resp.read()).decode()
+
+        body = _run(server, go)
+        assert body.rstrip().endswith("data: [DONE]")
+        chunks = _v1_chunks(body)
+        by_idx = {0: [], 1: []}
+        for ch in chunks:
+            for c in ch["choices"]:
+                by_idx[c["index"]].append(c)
+        for idx in (0, 1):
+            finishes = [c for c in by_idx[idx]
+                        if c["finish_reason"] is not None]
+            assert len(finishes) == 1, f"choice {idx} finish chunks"
+            deltas = [c["delta"] for c in by_idx[idx]
+                      if c["delta"].get("content") is not None]
+            assert "role" in deltas[0]
+            assert all("role" not in d for d in deltas[1:])
+
+    def test_completions_logprobs_nonstream(self, server):
+        async def go(client):
+            resp = await client.post(
+                "/v1/completions",
+                json={"prompt": "lp", "max_tokens": 4, "logprobs": 0,
+                      "temperature": 0.0},
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            lp = body["choices"][0]["logprobs"]
+            assert lp is not None
+            k = len(lp["tokens"])
+            assert k >= 1
+            assert len(lp["token_logprobs"]) == k
+            assert len(lp["text_offset"]) == k
+            assert lp["top_logprobs"] is None
+            assert all(v <= 0.0 for v in lp["token_logprobs"]
+                       if v is not None)
+            assert lp["text_offset"][0] == 0
+            assert lp["text_offset"] == sorted(lp["text_offset"])
+
+        _run(server, go)
+
+    def test_chat_logprobs_nonstream_and_stream(self, server):
+        async def go(client):
+            resp = await client.post(
+                "/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 3, "logprobs": True},
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            content = body["choices"][0]["logprobs"]["content"]
+            assert content
+            for entry in content:
+                assert set(entry) == {"token", "logprob", "bytes",
+                                      "top_logprobs"}
+                assert entry["top_logprobs"] == []
+                assert isinstance(entry["bytes"], list)
+            sresp = await client.post(
+                "/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 3, "logprobs": True, "stream": True},
+            )
+            return (await sresp.read()).decode()
+
+        body = _run(server, go)
+        chunks = _v1_chunks(body)
+        token_chunks = [
+            c for ch in chunks for c in ch["choices"]
+            if c.get("delta", {}).get("content") is not None
+        ]
+        assert token_chunks
+        with_lp = [c for c in token_chunks if c["logprobs"] is not None]
+        assert with_lp, "no logprobs in stream chunks"
+        for c in with_lp:
+            for entry in c["logprobs"]["content"]:
+                assert "token" in entry and "logprob" in entry
+
+    def test_stream_include_usage(self, server):
+        async def go(client):
+            resp = await client.post(
+                "/v1/completions",
+                json={"prompt": "use me", "max_tokens": 3, "stream": True,
+                      "stream_options": {"include_usage": True}},
+            )
+            assert resp.status == 200
+            return (await resp.read()).decode()
+
+        body = _run(server, go)
+        chunks = _v1_chunks(body)
+        # every chunk carries a usage key; all null except the final one
+        assert all("usage" in ch for ch in chunks)
+        final = chunks[-1]
+        assert final["choices"] == []
+        u = final["usage"]
+        assert u["prompt_tokens"] == len("use me") + 1  # +BOS
+        assert 1 <= u["completion_tokens"] <= 3
+        assert u["total_tokens"] == (
+            u["prompt_tokens"] + u["completion_tokens"]
+        )
+        assert all(ch["usage"] is None for ch in chunks[:-1])
+
+    def test_stream_error_still_emits_usage_chunk(self, server):
+        """An error event terminates its choice, so include_usage's final
+        usage chunk must still arrive when a choice errors (review
+        finding: remaining was only decremented on done events)."""
+
+        async def go(client):
+            big = "x" * 400  # 401 tokens > 256-token engine cap
+            resp = await client.post(
+                "/v1/completions",
+                json={"prompt": big, "max_tokens": 3, "stream": True,
+                      "stream_options": {"include_usage": True}},
+            )
+            assert resp.status == 200
+            return (await resp.read()).decode()
+
+        body = _run(server, go)
+        assert body.rstrip().endswith("data: [DONE]")
+        chunks = _v1_chunks(body)
+        assert any("error" in ch for ch in chunks)
+        final = chunks[-1]
+        assert final["choices"] == []
+        assert final["usage"] is not None
+
+    def test_unsupported_shape_fields_rejected(self, server):
+        async def go(client):
+            cases = [
+                ("/v1/completions", {"prompt": "x", "echo": True}),
+                ("/v1/completions", {"prompt": "x", "best_of": 3}),
+                # best_of < n is self-contradictory (OpenAI 400s it too)
+                ("/v1/completions", {"prompt": "x", "n": 4, "best_of": 1}),
+                ("/v1/completions", {"prompt": "x", "suffix": "tail"}),
+                ("/v1/completions", {"prompt": "x", "logprobs": 3}),
+                ("/v1/completions",
+                 {"prompt": "x",
+                  "stream_options": {"include_usage": True}}),
+                ("/v1/chat/completions",
+                 {"messages": [{"role": "user", "content": "x"}],
+                  "logprobs": True, "top_logprobs": 2}),
+                ("/v1/chat/completions",
+                 {"messages": [{"role": "user", "content": "x"}],
+                  "top_logprobs": 0}),
+            ]
+            for path, payload in cases:
+                resp = await client.post(path, json=payload)
+                assert resp.status == 400, (path, payload)
+                msg = (await resp.json())["error"]["message"]
+                assert msg, (path, payload)
+            # best_of == n degenerates to "return all n" and is allowed
+            ok = await client.post(
+                "/v1/completions",
+                json={"prompt": "x", "n": 2, "best_of": 2,
+                      "max_tokens": 1},
+            )
+            assert ok.status == 200
+            assert len((await ok.json())["choices"]) == 2
+
+        _run(server, go)
