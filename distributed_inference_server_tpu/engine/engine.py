@@ -54,10 +54,11 @@ from distributed_inference_server_tpu.engine.kv_cache import (
     PagedKVState,
 )
 from distributed_inference_server_tpu.engine.speculative import (
-    AcceptanceTracker,
+    PatternTrackers,
     SpecConfig,
     _probs as spec_probs,
     accept_and_resample as spec_accept_resample,
+    spec_signature,
 )
 from distributed_inference_server_tpu.ops.sampling import (
     nucleus_probs as spec_nucleus,
@@ -270,8 +271,8 @@ class LLMEngine:
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.spec = spec or SpecConfig()
-        self.spec_tracker = (
-            AcceptanceTracker(self.spec) if draft_params is not None else None
+        self.spec_trackers = (
+            PatternTrackers(self.spec) if draft_params is not None else None
         )
         self.draft_state = (
             PagedKVState.create(draft_cfg, self.pcfg, dtype=dtype)
@@ -1303,11 +1304,11 @@ class LLMEngine:
         eos = jnp.asarray(sorted(self.tok.eos_ids), jnp.int32)
 
         @functools.partial(
-            jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 13)
+            jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 14)
         )
         def block(params, dparams, pool_k, pool_v, dpool_k, dpool_v,
                   tokens, positions, steps_left, active, block_tables,
-                  temp, top_p, rng,
+                  temp, top_p, spec_ok, rng,
                   set_mask, set_active, set_tokens, set_positions,
                   set_steps):
             tokens = jnp.where(set_mask, set_tokens, tokens)
@@ -1389,8 +1390,13 @@ class LLMEngine:
                 # nucleus-aware: the core filters BOTH sides to each row's
                 # top-p nucleus (the draft sampled from that same q̃
                 # above), so top-p rows keep full multi-token acceptance
+                # spec_ok=False rows (pattern on probation, Req 12.5)
+                # force-reject at 0 and draw their one token from the
+                # (filtered) target — plain decoding law at one
+                # token/round, no draft-quality dependence
                 toks_out, num_accepted = spec_accept_resample(
                     tps, dtoks, dqs, keys[gamma + 1], keys[gamma + 2],
+                    spec_ok=spec_ok,
                     top_p=top_p if use_topp else None,
                 )
                 idx = jnp.arange(W)[None]
@@ -1406,8 +1412,9 @@ class LLMEngine:
                     has_eos, jnp.minimum(base, first_eos + 1), base
                 )
                 emitted = jnp.where(active, emitted, 0)
-                acc_out = jnp.where(active, num_accepted, 0)
-                prop_out = jnp.where(active, gamma, 0)
+                # masked rows contribute nothing to acceptance stats
+                acc_out = jnp.where(active & spec_ok, num_accepted, 0)
+                prop_out = jnp.where(active & spec_ok, gamma, 0)
                 toks_out = jnp.where(
                     (idx < emitted[:, None]) & active[:, None], toks_out, -1
                 )
@@ -1442,29 +1449,37 @@ class LLMEngine:
 
         return self._with_mesh(block)
 
-    def _spec_on(self) -> bool:
-        """Speculate this launch? Requires a draft model and the tracker
-        not auto-disabled (Req 12.5). Runs on the engine thread, so it
-        owns the probation re-enable (stats readers see the pure
-        ``enabled`` view)."""
-        return (
-            self.draft_params is not None
-            and self.spec_tracker is not None
-            and self.spec_tracker.consume_probation()
-        )
+    def _spec_plan(self, seated):
+        """Per-launch speculation plan (Req 12.5 per-pattern disable):
+        ``(use_spec, ok_by_slot)`` where a seated row speculates iff its
+        request pattern's tracker is enabled. A launch whose rows are ALL
+        on disabled patterns takes the plain block; a mixed launch runs
+        the spec block with the disabled rows masked via ``spec_ok``
+        (they emit one target-sampled token per round — plain decoding
+        law — and contribute nothing to acceptance statistics). Runs on
+        the engine thread, so it owns the probation re-enable (stats
+        readers see the pure ``enabled`` view)."""
+        if self.draft_params is None or self.spec_trackers is None:
+            return False, None
+        ok: Dict[int, bool] = {}
+        any_ok = False
+        for i, s in seated:
+            en = self.spec_trackers.consume_probation(
+                spec_signature(s.params)
+            )
+            ok[i] = en
+            any_ok = any_ok or en
+        return any_ok, ok
 
     def spec_stats(self) -> Optional[dict]:
-        """Speculation metrics for /server/stats and /metrics (Req 12.4);
-        None when no draft model is configured."""
-        if self.spec_tracker is None:
+        """Speculation metrics for /server/stats and /metrics (Req 12.4),
+        aggregate plus per-pattern breakdown; None when no draft model is
+        configured."""
+        if self.spec_trackers is None:
             return None
-        t = self.spec_tracker
-        return {
-            "acceptance_rate": round(t.rate(), 4),
-            "estimated_speedup": round(t.speedup(), 4),
-            "enabled": t.enabled,
-            "num_draft_tokens": self.spec.num_draft_tokens,
-        }
+        out = self.spec_trackers.stats()
+        out["num_draft_tokens"] = self.spec.num_draft_tokens
+        return out
 
     def _stage_seat(self, slot: int, seq: _Seq) -> None:
         """Stage a freshly prefetched sequence into a decode slot: its first
@@ -1549,9 +1564,13 @@ class LLMEngine:
                 s.dev_steps_left > 0 for _, s in seated
             ):
                 return False
-            use_spec = self._spec_on()
+            use_spec, spec_ok = self._spec_plan(seated)
             for _, s in seated:
                 self._reclaim_window_pages(s)
+            # spec_ok=False rows in a spec launch still use the spec
+            # advance bound: the verify forward WRITES gamma+1 positions
+            # per round for every row, so their pages must cover the
+            # same worst-case write position
             advs = {id(s): self._assumed_adv(s, use_spec) for _, s in seated}
             try:
                 for _, s in seated:
@@ -1568,7 +1587,7 @@ class LLMEngine:
         for i, s in seated:
             if self._bt_pages[i] != len(s.block_table):
                 self._refresh_bt_row(i, s)
-        self._launch(seated, advs, use_spec)
+        self._launch(seated, advs, use_spec, spec_ok)
         for _, s in seated:
             adv = advs[id(s)]
             # no floor: negatives reconcile exactly when blocks complete
@@ -1577,7 +1596,8 @@ class LLMEngine:
         return True
 
     def _launch(self, seated: List[Tuple[int, _Seq]],
-                advs: Dict[int, int], use_spec: bool) -> None:
+                advs: Dict[int, int], use_spec: bool,
+                spec_ok: Optional[Dict[int, bool]] = None) -> None:
         B = self.ecfg.max_batch
         set_mask = np.zeros((B,), bool)
         set_active = np.zeros((B,), bool)
@@ -1624,6 +1644,9 @@ class LLMEngine:
                 s.params.top_p < 1.0 and s.params.temperature > 0.0
                 for _, s in seated
             )
+            ok_arr = np.zeros((self.ecfg.max_batch,), bool)
+            for i, _ in seated:
+                ok_arr[i] = spec_ok is None or spec_ok.get(i, True)
             (toks, lps, counts, acc, prop, tokens, positions, steps_left,
              active, self.state.k, self.state.v,
              self.draft_state.k, self.draft_state.v,
@@ -1632,7 +1655,7 @@ class LLMEngine:
                 self.state.k, self.state.v,
                 self.draft_state.k, self.draft_state.v,
                 tokens, positions, steps_left, active,
-                *uploads, rng, *injects,
+                *uploads, jnp.asarray(ok_arr), rng, *injects,
             )
             self._pending.append((toks, lps, counts, acc, prop, snapshot))
         else:
@@ -1678,13 +1701,27 @@ class LLMEngine:
             toks3 = toks
             lps3 = lps
             counts = np.asarray(counts_d)
-            if self.spec_tracker is not None:
+            if self.spec_trackers is not None:
+                # per-PATTERN attribution (Req 12.5): each seated row's
+                # accept/propose counts update its own request pattern's
+                # tracker, so a badly speculating pattern disables alone.
+                # prop/acc are [R(ounds), B]; spec_ok-masked and inactive
+                # rows carry prop 0 and drop out here.
                 prop_arr = np.asarray(prop_d)
-                proposed = int(prop_arr.sum())
-                if proposed > 0:
-                    self.spec_tracker.update(
-                        int(np.asarray(acc_d).sum()), proposed,
-                        rows=int((prop_arr > 0).sum()),
+                acc_arr = np.asarray(acc_d)
+                agg: Dict[tuple, list] = {}
+                for slot, seq, _ in snapshot:
+                    p = int(prop_arr[:, slot].sum())
+                    if p <= 0:
+                        continue
+                    a = agg.setdefault(spec_signature(seq.params),
+                                       [0, 0, 0])
+                    a[0] += int(acc_arr[:, slot].sum())
+                    a[1] += p
+                    a[2] += int((prop_arr[:, slot] > 0).sum())
+                for sig, (acc_n, prop_n, rows_n) in agg.items():
+                    self.spec_trackers.update(
+                        sig, acc_n, prop_n, rows=rows_n
                     )
         R = toks3.shape[0]
         for slot, seq, assumed in snapshot:

@@ -66,7 +66,10 @@ class AcceptanceTracker:
 
         self.cfg = cfg
         self._clock = clock or _time.monotonic
-        self._events: Deque[Tuple[int, int]] = deque(maxlen=cfg.window)
+        # (accepted, proposed, rows) per recorded round
+        self._events: Deque[Tuple[int, int, int]] = deque(
+            maxlen=cfg.window
+        )
         self._disabled_at: float | None = None
 
     def update(self, accepted: int, proposed: int, rows: int = 1) -> None:
@@ -79,19 +82,31 @@ class AcceptanceTracker:
         ):
             self._disabled_at = self._clock()
 
+    def totals(self) -> Tuple[int, int, int, int]:
+        """(accepted, proposed, rows, emitted) sums over the window —
+        the single accessor aggregate views build on (a snapshot copy,
+        safe against concurrent appends)."""
+        acc = prop = rows = 0
+        for a, p, r in tuple(self._events):
+            acc += a
+            prop += p
+            rows += r
+        return acc, prop, rows, acc + rows
+
     def rate(self) -> float:
-        acc = sum(a for a, _, _ in self._events)
-        prop = sum(p for _, p, _ in self._events)
+        acc, prop, _, _ = self.totals()
         return acc / prop if prop else 1.0
 
     def speedup(self) -> float:
         """Tokens emitted per row per target forward pass (>= 1.0):
         accepted draft tokens plus the bonus/resample token."""
-        rows = sum(r for _, _, r in self._events)
-        if not rows:
-            return 1.0
-        emitted = sum(a + r for a, _, r in self._events)
-        return emitted / rows
+        _, _, rows, emitted = self.totals()
+        return emitted / rows if rows else 1.0
+
+    def force_disable(self) -> None:
+        """Put the tracker on probation immediately (admin/test hook —
+        the organic path is update() crossing the threshold)."""
+        self._disabled_at = self._clock()
 
     @property
     def enabled(self) -> bool:
@@ -115,6 +130,139 @@ class AcceptanceTracker:
     def reset(self) -> None:
         self._events.clear()
         self._disabled_at = None
+
+
+def spec_signature(params) -> Tuple[int, int]:
+    """Cheap request-pattern key for per-pattern speculation tracking
+    (Req 12.5 "per request pattern", requirements.md:170): temperature
+    band × top_p band. Acceptance behavior is driven by how peaked the
+    sampling distribution is — greedy accepts on exact match, hot
+    sampling accepts probabilistically — so the bands separate the
+    regimes that plausibly speculate differently while keeping the key
+    space tiny (≤ 12 trackers).
+
+    ``params`` needs ``temperature`` and ``top_p`` attributes
+    (engine.SamplingParams)."""
+    t = params.temperature
+    p = params.top_p
+    tband = 0 if t <= 0.0 else (1 if t <= 0.5 else (2 if t <= 1.0 else 3))
+    pband = 0 if p >= 1.0 else (1 if p >= 0.9 else 2)
+    return (tband, pband)
+
+
+class PatternTrackers:
+    """One ``AcceptanceTracker`` per request pattern (Req 12.5): a
+    pattern that speculates badly is disabled ALONE — unrelated traffic
+    keeps speculating — and its probation window re-measures only that
+    pattern. (Previously one global tracker meant a steadily bad pattern
+    re-paid its full bad window for everyone after every cooldown.)
+
+    Writers (``consume_probation``, ``update``, ``disable``, ``reset``)
+    run on the engine thread; the aggregate readers (``stats``,
+    ``rate``, ``speedup``, ``all_enabled``, ``enabled``) may run on
+    stats/metrics threads. ONE lock guards both the registry dict and
+    every tracker mutation/aggregation, so readers can never observe a
+    dict or event deque mid-mutation; ``enabled`` never inserts (a pure
+    read). Contention is negligible: writes are one lock acquisition
+    per decode block, reads one per stats scrape."""
+
+    def __init__(self, cfg: SpecConfig, clock=None):
+        import threading
+
+        self.cfg = cfg
+        self._clock = clock
+        self._by_sig: dict = {}
+        self._lock = threading.Lock()
+
+    def _tracker_locked(self, sig) -> AcceptanceTracker:
+        tr = self._by_sig.get(sig)
+        if tr is None:
+            tr = AcceptanceTracker(self.cfg, clock=self._clock)
+            self._by_sig[sig] = tr
+        return tr
+
+    def consume_probation(self, sig) -> bool:
+        """Engine-thread gate for one launch row (see
+        AcceptanceTracker.consume_probation)."""
+        with self._lock:
+            return self._tracker_locked(sig).consume_probation()
+
+    def enabled(self, sig) -> bool:
+        """Pure read: would this pattern speculate right now? (Never
+        inserts a tracker — safe from any thread.)"""
+        with self._lock:
+            tr = self._by_sig.get(sig)
+            return tr.enabled if tr is not None else True
+
+    def update(self, sig, accepted: int, proposed: int,
+               rows: int = 1) -> None:
+        with self._lock:
+            self._tracker_locked(sig).update(accepted, proposed, rows)
+
+    def disable(self, sig) -> None:
+        """Force a pattern onto probation immediately (test/admin hook —
+        the organic path is update() crossing the threshold)."""
+        with self._lock:
+            self._tracker_locked(sig).force_disable()
+
+    def reset(self) -> None:
+        """Fleet reset (admin /admin/speculation): drop every pattern's
+        history and disables."""
+        with self._lock:
+            self._by_sig.clear()
+
+    def _totals_locked(self):
+        acc = prop = rows = emitted = 0
+        for tr in self._by_sig.values():
+            a, p, r, e = tr.totals()
+            acc += a
+            prop += p
+            rows += r
+            emitted += e
+        return acc, prop, rows, emitted
+
+    def rate(self) -> float:
+        """Aggregate acceptance rate over all patterns (event-weighted)."""
+        with self._lock:
+            acc, prop, _, _ = self._totals_locked()
+        return acc / prop if prop else 1.0
+
+    def speedup(self) -> float:
+        """Aggregate tokens per row per target forward (>= 1.0)."""
+        with self._lock:
+            _, _, rows, emitted = self._totals_locked()
+        return emitted / rows if rows else 1.0
+
+    @property
+    def all_enabled(self) -> bool:
+        """True when no pattern is currently on a disable cooldown."""
+        with self._lock:
+            return all(tr.enabled for tr in self._by_sig.values())
+
+    def stats(self) -> dict:
+        """Aggregate + per-pattern breakdown for /server/stats
+        (Req 12.4)."""
+        with self._lock:
+            acc, prop, rows, emitted = self._totals_locked()
+            return {
+                "acceptance_rate": round(
+                    acc / prop if prop else 1.0, 4
+                ),
+                "estimated_speedup": round(
+                    emitted / rows if rows else 1.0, 4
+                ),
+                "enabled": all(
+                    tr.enabled for tr in self._by_sig.values()
+                ),
+                "patterns": {
+                    f"temp_band={t},top_p_band={p}": {
+                        "acceptance_rate": round(tr.rate(), 4),
+                        "estimated_speedup": round(tr.speedup(), 4),
+                        "enabled": tr.enabled,
+                    }
+                    for (t, p), tr in sorted(self._by_sig.items())
+                },
+            }
 
 
 def _probs(logits: jnp.ndarray, temperature: jnp.ndarray) -> jnp.ndarray:

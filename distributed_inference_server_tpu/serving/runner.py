@@ -261,13 +261,14 @@ class EngineRunner:
         return True
 
     def reset_speculation(self) -> None:
-        """Clear the acceptance tracker (Req 12.5 explicit reset — e.g.
-        the operator knows the request pattern changed); re-enables
-        speculation immediately with a fresh measurement window."""
+        """Clear every pattern's acceptance tracker (Req 12.5 explicit
+        reset — e.g. the operator knows the request pattern changed);
+        re-enables speculation immediately with fresh measurement
+        windows."""
 
         def _do() -> None:
-            if self._engine.spec_tracker is not None:
-                self._engine.spec_tracker.reset()
+            if self._engine.spec_trackers is not None:
+                self._engine.spec_trackers.reset()
 
         self._post(_do)
 

@@ -116,11 +116,15 @@ def test_spec_greedy_bit_exact_different_draft(tiny_params, draft_params):
 
 
 def test_spec_auto_disable_falls_back(tiny_params, draft_params):
-    """A disabled tracker must fall back to plain decode blocks and still
+    """A disabled pattern must fall back to plain decode blocks and still
     produce the exact greedy output (Req 12.5)."""
+    from distributed_inference_server_tpu.engine.speculative import (
+        spec_signature,
+    )
+
     engine = make_engine(tiny_params, draft=draft_params,
                          spec=SpecConfig(num_draft_tokens=3))
-    engine.spec_tracker._disabled_at = engine.spec_tracker._clock()
+    engine.spec_trackers.disable(spec_signature(GREEDY))
     prompt = TOK.encode("fallback")
     engine.add_request("r", prompt, GREEDY)
     out = run(engine)["r"]
@@ -130,6 +134,49 @@ def test_spec_auto_disable_falls_back(tiny_params, draft_params):
     )
     assert out["tokens"] == expected
     assert engine.spec_stats()["enabled"] is False
+
+
+def test_spec_pattern_keyed_disable(tiny_params, draft_params):
+    """Req 12.5 'per request pattern': with the greedy pattern disabled,
+    an interleaved top-p request KEEPS speculating (its pattern tracker
+    accrues proposals) while the greedy rows ride the same launches
+    masked out (no proposals attributed to the greedy pattern) — and
+    greedy output stays bit-exact."""
+    from distributed_inference_server_tpu.engine.speculative import (
+        spec_signature,
+    )
+
+    engine = make_engine(tiny_params, draft=tiny_params,
+                         spec=SpecConfig(num_draft_tokens=3))
+    topp = SamplingParams(max_tokens=12, temperature=0.8, top_p=0.9)
+    greedy_sig = spec_signature(GREEDY)
+    topp_sig = spec_signature(topp)
+    assert greedy_sig != topp_sig
+    engine.spec_trackers.disable(greedy_sig)
+
+    prompt = TOK.encode("mixed batch")
+    engine.add_request("g", prompt, GREEDY)
+    engine.add_request("t", TOK.encode("sampled"), topp)
+    out = run(engine)
+    assert out["g"]["error"] is None and out["t"]["error"] is None
+
+    # greedy correctness unaffected by riding spec launches masked out
+    expected = greedy_generate(
+        tiny_params, TINY, prompt, max_new_tokens=12, max_seq=64,
+        eos_ids=TOK.eos_ids,
+    )
+    assert out["g"]["tokens"] == expected
+
+    stats = engine.spec_stats()["patterns"]
+    g_key = f"temp_band={greedy_sig[0]},top_p_band={greedy_sig[1]}"
+    t_key = f"temp_band={topp_sig[0]},top_p_band={topp_sig[1]}"
+    # the top-p pattern actually speculated (draft == target: perfect
+    # acceptance) while the greedy pattern logged nothing
+    assert t_key in stats
+    assert stats[t_key]["acceptance_rate"] > 0.99
+    assert stats[t_key]["estimated_speedup"] > 1.5
+    assert g_key not in stats or stats[g_key]["estimated_speedup"] == 1.0
+    assert engine.spec_stats()["enabled"] is False  # greedy on cooldown
 
 
 def test_spec_topp_rows_ride_along(tiny_params, draft_params):
@@ -238,7 +285,7 @@ def test_spec_topp_full_acceptance_same_draft(tiny_params):
     out = run(engine)
     assert out["topp"]["error"] is None
     assert len(out["topp"]["tokens"]) == 24
-    t = engine.spec_tracker
+    t = engine.spec_trackers
     # p̃ == q̃ -> accept prob min(1, 1) = 1 at every position
     assert t.rate() > 0.99, t.rate()
     # speedup: tokens per row per target forward must beat 1/round

@@ -143,13 +143,20 @@ def test_admin_speculation_reset(spec_server):
         client = TestClient(TestServer(spec_server.build_app()))
         await client.start_server()
         try:
-            # poison the tracker into disabled state
+            # poison the greedy pattern's tracker into disabled state
+            from distributed_inference_server_tpu.engine.speculative import (
+                spec_signature,
+            )
+            from distributed_inference_server_tpu.engine.engine import (
+                SamplingParams,
+            )
+
+            sig = spec_signature(SamplingParams(temperature=0.0))
             for runner in spec_server.scheduler.engines():
-                t = runner._engine.spec_tracker
+                t = runner._engine.spec_trackers
                 for _ in range(t.cfg.window):
-                    t.update(0, 4)
-                t._disabled_at = t._clock()  # force, bypass cooldown
-                assert not t.enabled or True
+                    t.update(sig, 0, 4)
+                t.disable(sig)  # force, bypass cooldown
             resp = await client.post("/admin/speculation",
                                      json={"action": "reset"})
             body = await resp.json()
@@ -161,7 +168,7 @@ def test_admin_speculation_reset(spec_server):
                 "temperature": 0.0})
             assert r.status == 200
             for runner in spec_server.scheduler.engines():
-                assert runner._engine.spec_tracker.enabled
+                assert runner._engine.spec_trackers.all_enabled
             bad = await client.post("/admin/speculation",
                                     json={"action": "nope"})
             assert bad.status == 400
