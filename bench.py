@@ -10,7 +10,8 @@ vs_baseline is against the north-star 2000 output tok/s/chip target
 (BASELINE.json; the reference itself publishes no numbers — BASELINE.md).
 
 Env knobs: BENCH_BATCH (64), BENCH_PROMPT (128), BENCH_NEW (128),
-BENCH_BLOCK (16, decode steps per device block), BENCH_PIPELINE (1,
+BENCH_BLOCK (64 burst / 16 when BENCH_RATE_RPS>0, decode steps per
+device block), BENCH_PIPELINE (1,
 blocks in flight), BENCH_PREFILL_BATCH (16, rows per batched prefill
 program), BENCH_PREFILL_BUDGET (8192, prefill tokens per engine step),
 BENCH_RATE_RPS (0; >0 switches to steady-state serving mode — requests
@@ -73,11 +74,18 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "64"))
     prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
     new_tokens = int(os.environ.get("BENCH_NEW", "128"))
-    block = int(os.environ.get("BENCH_BLOCK", "16"))
+    rate_rps = float(os.environ.get("BENCH_RATE_RPS", "0"))
+    # 64 measured best on-chip r4 for burst throughput (2187 tok/s vs
+    # 2120 at 16, 1B bf16) — but in steady-state rate mode the host
+    # blocks a full fixed-length device block per _process_block, so a
+    # large block quantum (~64 x 29 ms) would dominate the TTFT being
+    # measured; rate mode keeps the small block unless overridden
+    block = int(os.environ.get(
+        "BENCH_BLOCK", "16" if rate_rps > 0 else "64"
+    ))
     pipeline = int(os.environ.get("BENCH_PIPELINE", "1"))
     prefill_batch = int(os.environ.get("BENCH_PREFILL_BATCH", "16"))
     prefill_budget = int(os.environ.get("BENCH_PREFILL_BUDGET", "8192"))
-    rate_rps = float(os.environ.get("BENCH_RATE_RPS", "0"))
     impl = os.environ.get("BENCH_IMPL", "auto")
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "180"))
 
@@ -153,6 +161,10 @@ def main() -> None:
     if force_cpu:
         cfg, dtype = TINY, jnp.float32
         prompt_len, new_tokens = min(prompt_len, 16), min(new_tokens, 16)
+        # clamp the block too: warmup() needs max_seq_len (64 here) to
+        # cover block+1 steps, or every warmup request is skipped and the
+        # smoke mode silently stops exercising the warmup machinery
+        block = min(block, 8)
         paged = PagedCacheConfig(num_pages=64, page_size=8, max_pages_per_seq=8)
         buckets = (32, 64)
     else:

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Sequential hardware-window measurement queue (round 4).
+# Run FOREGROUND, alone — the chip is a one-process claim. Each step is
+# its own process with a generous timeout; results append to the log.
+# Usage: bash tools/hw_window.sh [logfile]
+set -u
+LOG="${1:-/root/repo/HW_WINDOW_r04.log}"
+export PYTHONPATH=/root/repo:/root/.axon_site
+
+alive() {  # the relay wedges mid-window: gate EVERY step, not just entry
+  timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name  $(date -u +%H:%M:%S) ===" | tee -a "$LOG"
+  if ! alive; then
+    echo "--- device hang; step skipped ---" | tee -a "$LOG"
+    return 2
+  fi
+  timeout "$tmo" "$@" 2>&1 | grep -vE "WARNING.*xla_bridge" | tail -6 | tee -a "$LOG"
+  local rc=${PIPESTATUS[0]}
+  echo "--- exit=$rc ---" | tee -a "$LOG"
+}
+
+# 0. liveness gate: skip the whole window if the device hangs
+if ! alive; then
+  echo "device hang at $(date -u +%H:%M:%S); aborting window" | tee -a "$LOG"
+  exit 2
+fi
+
+# 1. achievable HBM bandwidth + MXU (bounds every decode claim)
+step hbm_probe_b64 300 python tools/hbm_probe.py 64
+step hbm_probe_b256 300 python tools/hbm_probe.py 256
+
+# 1b. kernel crossover: prefill kernel vs XLA at long context (short-ctx
+#     r4 datapoint had pallas prefill at 0.66x; find where it wins)
+step kp_long_ctx 580 env KP_PAGES_PER_SEQ=64 KP_CTX=1024 KP_PREFILL_T=512 python tools/kernel_probe.py
+step kp_vlong_ctx 580 env KP_PAGES_PER_SEQ=256 KP_CTX=4096 KP_PREFILL_T=512 KP_BATCH=8 python tools/kernel_probe.py
+
+# 1c. pure-device decode block (no engine): device-vs-host attribution
+step decode_probe_b64 580 python tools/decode_probe.py 64 272 64
+step decode_probe_b128 580 python tools/decode_probe.py 128 272 64
+
+# 2. decode sweep remainder: pipeline depth, then best-combo confirm
+step pipeline2 580 env BENCH_PIPELINE=2 python bench.py
+step pipeline2_b128 580 env BENCH_PIPELINE=2 BENCH_BATCH=128 python bench.py
+
+# 3. the BASELINE metric: 8B int8 (compile is slow; give it room)
+step 8b_int8 1200 env BENCH_MODEL=llama-3-8b BENCH_QUANT=int8 BENCH_BATCH=32 python bench.py
+
+# 4. TTFT table: steady-state arrivals + warmup-compile split
+step rate_rps 900 env BENCH_RATE_RPS=16 python bench.py
+step warmup 900 env BENCH_MEASURE_WARMUP=1 python bench.py
+
+echo "window complete $(date -u +%H:%M:%S)" | tee -a "$LOG"
