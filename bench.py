@@ -92,7 +92,9 @@ def main() -> None:
     # Fail fast when the tunnel is not even listening (dead relay): the
     # axon backend dials localhost relay ports; refused connections mean
     # no chip this boot — report immediately instead of hanging the
-    # watchdog out.
+    # watchdog out. (Inline copy of tools/_relay.py's gate: the driver
+    # runs bench.py standalone, so no tools/ import here — keep the
+    # port set in sync with tools/_relay.RELAY_PORTS.)
     if not force_cpu and os.environ.get("JAX_PLATFORMS", "") == "axon":
         import socket
 

@@ -136,9 +136,37 @@ def _write_kv(
 def _mm(x: jnp.ndarray, w) -> jnp.ndarray:
     """x @ w with transparent weight-only quantization (ops/quant.py):
     quantized weights dequantize on the fly — XLA fuses the convert+scale
-    into the matmul, so HBM traffic stays int8/int4."""
-    from distributed_inference_server_tpu.ops.quant import dense_view
+    into the matmul, so HBM traffic stays int8/int4. With
+    DIS_TPU_PALLAS_FUSED=1 (single-device opt-in), aligned quantized
+    matmuls take the Pallas group-dequant kernel instead: dequant happens
+    in VMEM after the int tile's DMA, immune to XLA fusion misses."""
+    from distributed_inference_server_tpu.ops.pallas.fused import (
+        fused_mode,
+        quant_matmul_pallas,
+        quant_matmul_supported,
+    )
+    from distributed_inference_server_tpu.ops.quant import (
+        Q4Tensor,
+        dense_view,
+        is_quantized,
+    )
 
+    mode = fused_mode()
+    if mode is not None and is_quantized(w) and w.q.ndim == 2:
+        packed = isinstance(w, Q4Tensor)
+        K = w.q.shape[0] * (2 if packed else 1)
+        N = w.s.shape[-1]
+        group = K // w.s.shape[-2]
+        M = 1
+        for d in x.shape[:-1]:
+            M *= d
+        if x.shape[-1] == K and quant_matmul_supported(M, K, N, group,
+                                                       packed):
+            out = quant_matmul_pallas(
+                x.reshape(M, K), w.q, w.s, group=group, packed=packed,
+                interpret=mode == "interpret",
+            )
+            return out.reshape(*x.shape[:-1], N)
     return x @ dense_view(w, x.dtype)
 
 

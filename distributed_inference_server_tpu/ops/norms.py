@@ -2,9 +2,10 @@
 
 RMSNorm computes the variance in f32 regardless of activation dtype (bf16
 activations lose too much precision in the sum of squares), then casts back.
-XLA fuses this into the surrounding elementwise graph; the Pallas fused
-variant (ops/pallas/) exists for cases where we want it welded to the
-following matmul's prologue.
+XLA fuses this into the surrounding elementwise graph — that is the
+default path; the Pallas variant (ops/pallas/fused.py) is opt-in via
+DIS_TPU_PALLAS_FUSED=1 for single-device runs where the measured number
+(tools/kernel_probe.py) says it pays.
 """
 
 from __future__ import annotations
@@ -15,6 +16,15 @@ from jax import lax
 
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
     """y = x / rms(x) * weight, computed in f32."""
+    from distributed_inference_server_tpu.ops.pallas.fused import (
+        fused_mode,
+        rms_norm_pallas,
+    )
+
+    mode = fused_mode()
+    if mode is not None and x.shape[-1] % 128 == 0:
+        return rms_norm_pallas(x, weight, eps,
+                               interpret=mode == "interpret")
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * lax.rsqrt(var + eps)

@@ -6,22 +6,42 @@ equivalent tier is Pallas kernels lowered through Mosaic. Every kernel here
 has a pure-XLA reference implementation (ops/attention.py et al.) it is
 tested against, and runs in interpret mode on the CPU backend.
 
-Scope is deliberate: kernels exist where XLA's compilation model cannot
-express the access pattern — paged attention reads scattered KV pages
-straight from the HBM pool with manual double-buffered DMA, which the
-XLA alternative can only approximate by materializing a dense
-``[B, S_max]`` gather per layer per step. RMSNorm, RoPE, sampling, and
-on-the-fly dequantization intentionally stay XLA: they are elementwise
-chains adjacent to matmuls, exactly what XLA fuses into operand
-reads/writes on its own, and a hand kernel there starts from parity at
-best (SURVEY §7.1 planned four kernels; measurement on the chip — the
-r1 lesson that an unproven kernel can ship slower than the fusion it
-replaces — set this boundary instead).
+Two classes of kernel, with different defaults:
+
+- **Paged attention (default-on via the engine's "auto" probe)** — XLA's
+  compilation model cannot express the access pattern: the kernels read
+  scattered KV pages straight from the HBM pool with manual
+  double-buffered DMA, where the XLA alternative materializes a dense
+  ``[B, S_max]`` gather per layer per step.
+- **Fused RMSNorm / RoPE / group-dequant matmul (opt-in,
+  DIS_TPU_PALLAS_FUSED=1)** — these sit where XLA's own fusion usually
+  already wins (elementwise chains welded to matmul operand reads), so
+  the default stays XLA; the kernels complete SURVEY §2.3's native-tier
+  inventory and exist for the geometries where
+  ``tools/kernel_probe.py``'s on-chip comparison says they pay — the
+  dequant matmul in particular guards against XLA fusion misses that
+  materialize dense bf16 tiles at 2-4x the quantized HBM bytes. The r1
+  lesson stands: none of these flips on without a measured number.
 """
 
+from distributed_inference_server_tpu.ops.pallas.fused import (
+    apply_rope_pallas,
+    fused_mode,
+    quant_matmul_pallas,
+    quant_matmul_supported,
+    rms_norm_pallas,
+)
 from distributed_inference_server_tpu.ops.pallas.paged_attention import (
     paged_attention_decode,
     paged_attention_prefill,
 )
 
-__all__ = ["paged_attention_decode", "paged_attention_prefill"]
+__all__ = [
+    "paged_attention_decode",
+    "paged_attention_prefill",
+    "rms_norm_pallas",
+    "apply_rope_pallas",
+    "quant_matmul_pallas",
+    "quant_matmul_supported",
+    "fused_mode",
+]

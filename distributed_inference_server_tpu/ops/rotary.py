@@ -3,8 +3,9 @@
 TPU notes: frequencies are computed once per call in f32 and applied in the
 activation dtype; the half-split rotation form (not interleaved) matches HF
 Llama so loaded checkpoints are bit-compatible. XLA fuses the sin/cos and
-elementwise rotate into neighbouring ops, so a dedicated Pallas kernel only
-pays off when fused into attention (see ops/pallas/).
+elementwise rotate into neighbouring ops — the default path; the Pallas
+kernel (ops/pallas/fused.py, opt-in via DIS_TPU_PALLAS_FUSED=1) computes
+sin/cos in VMEM per row block instead.
 """
 
 from __future__ import annotations
@@ -55,6 +56,15 @@ def apply_rope(
     Uses the half-split convention: (x1, x2) -> (x1*cos - x2*sin,
     x2*cos + x1*sin) with x1 the first half of head_dim.
     """
+    from distributed_inference_server_tpu.ops.pallas.fused import (
+        apply_rope_pallas,
+        fused_mode,
+    )
+
+    mode = fused_mode()
+    if mode is not None and x.ndim >= 3 and x.shape[-1] % 16 == 0:
+        return apply_rope_pallas(x, positions, inv_freq,
+                                 interpret=mode == "interpret")
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., seq, hd/2]
     cos = jnp.cos(angles)[..., None, :]  # [..., seq, 1, hd/2]
     sin = jnp.sin(angles)[..., None, :]

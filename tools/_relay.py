@@ -1,0 +1,33 @@
+"""Shared axon-relay gate for the measurement tools in this directory.
+
+One definition of the relay port set and the fail-fast contract
+(bench.py keeps an inline copy because the driver runs it standalone;
+its comment points here). A wedged-but-listening relay passes this gate
+— that state is caught by hw_window.sh's per-step jax.devices()
+liveness check."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+RELAY_PORTS = (8082, 8083, 8087, 8092)
+
+
+def relay_gate() -> None:
+    """Exit 2 with a structured error when JAX_PLATFORMS=axon and no
+    relay port is even listening. No-op on other platforms."""
+    if os.environ.get("JAX_PLATFORMS", "") != "axon":
+        return
+    import socket
+
+    for p in RELAY_PORTS:
+        try:
+            socket.create_connection(("127.0.0.1", p), timeout=2).close()
+            return
+        except OSError:
+            continue
+    print(json.dumps({"error": "TPU tunnel down (relay ports refused "
+                               f"{RELAY_PORTS})"}), flush=True)
+    sys.exit(2)

@@ -24,29 +24,10 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _relay_gate() -> None:
-    """Fail fast (exit 2) when the axon relay is not even listening —
-    same contract as bench.py; a wedged-but-listening relay is caught by
-    hw_window.sh's per-step liveness gate."""
-    import os
-
-    if os.environ.get("JAX_PLATFORMS", "") != "axon":
-        return
-    import socket
-
-    for p in (8082, 8083, 8087, 8092):
-        try:
-            socket.create_connection(("127.0.0.1", p), timeout=2).close()
-            return
-        except OSError:
-            continue
-    print(json.dumps({"error": "TPU tunnel down (relay ports refused)"}),
-          flush=True)
-    sys.exit(2)
-
-
 def main() -> int:
-    _relay_gate()
+    from _relay import relay_gate
+
+    relay_gate()
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     ctx = int(sys.argv[2]) if len(sys.argv) > 2 else 272
     block = int(sys.argv[3]) if len(sys.argv) > 3 else 64

@@ -21,27 +21,10 @@ import jax
 import jax.numpy as jnp
 
 
-def _relay_gate() -> None:
-    """Fail fast (exit 2) when the axon relay is not even listening —
-    same contract as bench.py; a wedged-but-listening relay is caught by
-    hw_window.sh's per-step liveness gate."""
-    if os.environ.get("JAX_PLATFORMS", "") != "axon":
-        return
-    import socket
-
-    for p in (8082, 8083, 8087, 8092):
-        try:
-            socket.create_connection(("127.0.0.1", p), timeout=2).close()
-            return
-        except OSError:
-            continue
-    print(json.dumps({"error": "TPU tunnel down (relay ports refused)"}),
-          flush=True)
-    sys.exit(2)
-
-
 def main() -> int:
-    _relay_gate()
+    from _relay import relay_gate
+
+    relay_gate()
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     N = int(os.environ.get("HP_N", "4096"))
     L = int(os.environ.get("HP_L", "16"))  # 16 * 4096*4096*2B = 512 MiB

@@ -6,9 +6,15 @@
 set -u
 LOG="${1:-/root/repo/HW_WINDOW_r04.log}"
 export PYTHONPATH=/root/repo:/root/.axon_site
+export JAX_PLATFORMS=axon  # never let a fresh shell fall back to CPU and
+                           # log CPU numbers as chip measurements
 
-alive() {  # the relay wedges mid-window: gate EVERY step, not just entry
-  timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1
+alive() {  # the relay wedges mid-window: gate EVERY step, not just entry;
+           # also assert the backend is the real chip, not a CPU fallback
+  timeout 90 python -c "
+import jax
+assert jax.devices()[0].platform != 'cpu', 'CPU backend — not a chip window'
+" >/dev/null 2>&1
 }
 
 step() {
@@ -48,6 +54,12 @@ step pipeline2_b128 580 env BENCH_PIPELINE=2 BENCH_BATCH=128 python bench.py
 
 # 3. the BASELINE metric: 8B int8 (compile is slow; give it room)
 step 8b_int8 1200 env BENCH_MODEL=llama-3-8b BENCH_QUANT=int8 BENCH_BATCH=32 python bench.py
+
+# 3b. prefill efficiency (80 ms per [16,128] launch at b64 = ~33% MXU):
+#     more rows per prefill program amortizes launch + pads less often
+step prefill32 580 env BENCH_PREFILL_BATCH=32 python bench.py
+# 3c. int4: half the weight bytes of int8 -> ~2x the weight-bound ceiling
+step 8b_int4 1200 env BENCH_MODEL=llama-3-8b BENCH_QUANT=int4 BENCH_BATCH=32 python bench.py
 
 # 4. TTFT table: steady-state arrivals + warmup-compile split
 step rate_rps 900 env BENCH_RATE_RPS=16 python bench.py
