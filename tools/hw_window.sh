@@ -35,6 +35,11 @@ if ! alive; then
   exit 2
 fi
 
+# 1a0. kernel probe at serving geometry — reruns the attention proof and
+#      adds the NEW fused rms_norm/rope/q8_matmul kernels' first
+#      on-silicon compile + timing
+step kernel_probe 580 python tools/kernel_probe.py
+
 # 1. achievable HBM bandwidth + MXU (bounds every decode claim)
 step hbm_probe_b64 300 python tools/hbm_probe.py 64
 step hbm_probe_b256 300 python tools/hbm_probe.py 256
