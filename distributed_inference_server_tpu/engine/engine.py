@@ -396,7 +396,6 @@ class LLMEngine:
         self._spec_block_fns: Dict[bool, Callable] = {}
         if draft_params is not None:
             self._spec_block_fns[False] = self._build_spec_block(False)
-        self._sample_fn = jax.jit(sample_tokens)
 
     # ------------------------------------------------------------------
     # public API
@@ -1208,7 +1207,8 @@ class LLMEngine:
         @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6, 10))
         def block(params, pool_k, pool_v, tokens, positions, steps_left,
                   active, block_tables, temp, top_p, rng,
-                  set_mask, set_active, set_tokens, set_positions, set_steps):
+                  set_mask, set_active, set_tokens, set_positions, set_steps,
+                  any_topp):
             # merge host overrides (admissions / deactivations) into carry
             tokens = jnp.where(set_mask, set_tokens, tokens)
             positions = jnp.where(set_mask, set_positions, positions)
@@ -1235,7 +1235,21 @@ class LLMEngine:
                     pool_k, pool_v, write, gather, kv_valid, impl, moe_impl,
                 )
                 rng, sub = jax.random.split(rng)
-                nxt = sample_tokens(sub, logits[:, 0], temp, top_p)
+                # runtime branch, not a static variant: one compiled
+                # program per gather bucket (warmup coverage unchanged),
+                # and all-greedy/top_p=1 launches — the common serving
+                # mix and the whole bench path — skip the nucleus's
+                # full-vocab softmax + threshold-search passes entirely.
+                # XLA lowers lax.cond on a scalar to real control flow on
+                # TPU, so only the taken branch executes.
+                nxt = lax.cond(
+                    any_topp,
+                    lambda a: sample_tokens(a[0], a[1], a[2], a[3],
+                                            use_topp=True),
+                    lambda a: sample_tokens(a[0], a[1], a[2], a[3],
+                                            use_topp=False),
+                    (sub, logits[:, 0], temp, top_p),
+                )
                 lp = _chosen_logprob(logits[:, 0], nxt)
                 out = jnp.where(active, nxt, -1)
                 is_eos = (
@@ -1636,14 +1650,14 @@ class LLMEngine:
             jnp.asarray(self._topp),
         )
         snapshot = [(i, s, advs[id(s)]) for i, s in seated]
+        # nucleus machinery only when a seated row actually needs it;
+        # greedy rows (temperature 0) sample a one-hot, for which
+        # nucleus filtering is a no-op — skip the full-vocab passes
+        use_topp = any(
+            s.params.top_p < 1.0 and s.params.temperature > 0.0
+            for _, s in seated
+        )
         if use_spec:
-            # nucleus machinery only when a seated row actually needs it;
-            # greedy rows (temperature 0) sample a one-hot, for which
-            # nucleus filtering is a no-op — skip the full-vocab sorts
-            use_topp = any(
-                s.params.top_p < 1.0 and s.params.temperature > 0.0
-                for _, s in seated
-            )
             ok_arr = np.zeros((self.ecfg.max_batch,), bool)
             for i, _ in seated:
                 ok_arr[i] = spec_ok is None or spec_ok.get(i, True)
@@ -1663,7 +1677,7 @@ class LLMEngine:
              self.state.k, self.state.v, rng) = self._block_fn(
                 self.params, self.state.k, self.state.v,
                 tokens, positions, steps_left, active,
-                *uploads, rng, *injects,
+                *uploads, rng, *injects, jnp.asarray(use_topp),
             )
             self._pending.append((outs, lps, None, None, None, snapshot))
         self._carry = (tokens, positions, steps_left, active, rng)

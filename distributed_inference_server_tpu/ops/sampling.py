@@ -4,14 +4,74 @@ The reference spec'd host-side sampling per token (``design.md:666-671``
 [spec]); on TPU that would bounce logits to the host every decode step, so
 sampling is fused into the compiled step: a single jittable function over the
 batch, driven by a threaded PRNG key. Temperature==0 rows degrade to argmax;
-top_p==1 rows skip the nucleus cutoff — all branchless (lax.select) so one
-compiled program covers every request mix.
+top_p==1 rows skip the nucleus cutoff — per-ROW mixes are branchless
+(lax.select), while the per-LAUNCH ``use_topp`` flag statically compiles the
+nucleus machinery out for launches where no row needs it (the engine's decode
+block selects between the two via ``lax.cond`` on a runtime scalar, so one
+device program per shape still covers every request mix).
+
+The nucleus cutoff is computed WITHOUT a vocabulary sort. ``jnp.sort`` over
+[B, 128k] logits lowers to O(log^2 V) bitonic passes on TPU and was the
+single most expensive non-matmul op in the sampled-decode step; an
+equivalent cutoff is found by binary-searching the probability threshold
+(``nucleus_cutoff``), which is ~26 masked sums over [B, V] — each a cheap,
+fusable HBM pass.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+
+# Binary-search iterations for the nucleus threshold. The kept set is exact
+# up to a threshold resolution of 2**-_CUTOFF_ITERS (~1.5e-8): a token whose
+# probability lies within that margin BELOW the true boundary token's
+# probability may additionally be kept. f32 probabilities themselves only
+# resolve ~6e-8 near 1.0, so this matches the input precision.
+_CUTOFF_ITERS = 26
+
+
+def nucleus_cutoff(probs: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Per-row nucleus cutoff probability, sort-free.
+
+    Returns ``c`` of shape [B, 1] such that ``{i : probs[b, i] >= c[b]}``
+    equals the classic sorted-prefix nucleus — the smallest descending-order
+    prefix whose cumulative probability reaches ``top_p[b]``, extended to
+    all ties at the boundary value — up to the resolution documented at
+    ``_CUTOFF_ITERS``. The row argmax is always kept (``top_p == 0``
+    degrades to greedy); ``top_p >= 1`` keeps every token.
+
+    Mechanism: S(t) = sum of probabilities >= t is a decreasing step
+    function of t; the boundary probability is the largest t with
+    S(t) >= top_p. Bisect t in [0, 1]: the invariant S(lo) >= top_p holds
+    from S(0) = 1, so ``lo`` converges to the boundary from below and never
+    drops a token the sorted rule would keep.
+
+    Args:
+      probs: [B, V] probability rows (each summing to ~1).
+      top_p: [B] nucleus thresholds.
+
+    Returns: [B, 1] cutoff probabilities.
+    """
+    tp = top_p[:, None]
+    pmax = jnp.max(probs, axis=-1, keepdims=True)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = 0.5 * (lo + hi)
+        s = jnp.sum(jnp.where(probs >= mid, probs, 0.0), -1, keepdims=True)
+        ge = s >= tp
+        return jnp.where(ge, mid, lo), jnp.where(ge, hi, mid)
+
+    lo, _ = lax.fori_loop(
+        0, _CUTOFF_ITERS, body,
+        (jnp.zeros_like(pmax), jnp.ones_like(pmax)),
+    )
+    # top_p == 0 (or a float-sum shortfall at top_p == 1) leaves lo at an
+    # endpoint; clamping to pmax guarantees the top-1 token always survives
+    # while never excluding a token the prefix rule would keep.
+    return jnp.minimum(lo, pmax)
 
 
 def sample_tokens(
@@ -19,6 +79,8 @@ def sample_tokens(
     logits: jnp.ndarray,
     temperature: jnp.ndarray,
     top_p: jnp.ndarray,
+    *,
+    use_topp: bool = True,
 ) -> jnp.ndarray:
     """Sample next tokens for a batch.
 
@@ -27,28 +89,26 @@ def sample_tokens(
       logits: [B, V] f32 final-position logits.
       temperature: [B] per-request temperature (0 => greedy).
       top_p: [B] per-request nucleus threshold (1 => disabled).
+      use_topp: static; False compiles out the nucleus machinery entirely
+        (softmax + threshold search) for launches where every row has
+        top_p == 1 or temperature == 0 — for those rows the nucleus is a
+        no-op, so results are identical and the decode step saves the
+        full-vocab passes.
 
     Returns: [B] int32 sampled token ids.
     """
-    B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     # temperature scale (guard zero-temp rows; their result is overridden)
     safe_temp = jnp.where(temperature > 0, temperature, 1.0)[:, None]
     scaled = logits / safe_temp
 
-    # top-p: sort descending, keep the smallest prefix with cumprob >= top_p
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
-    # keep tokens while the cumulative prob *before* them is < top_p;
-    # the top-1 token is always kept so top_p=0 degrades to greedy
-    keep = (cumprobs - sorted_probs) < top_p[:, None]
-    keep = keep.at[:, 0].set(True)
-    # threshold logit = smallest kept logit per row
-    kept_logits = jnp.where(keep, sorted_logits, jnp.inf)
-    cutoff = jnp.min(kept_logits, axis=-1, keepdims=True)
-    filtered = jnp.where(scaled >= cutoff, scaled, -jnp.inf)
+    if use_topp:
+        probs = jax.nn.softmax(scaled, axis=-1)
+        cutoff = nucleus_cutoff(probs, top_p)
+        filtered = jnp.where(probs >= cutoff, scaled, -jnp.inf)
+    else:
+        filtered = scaled
 
     sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
@@ -65,13 +125,7 @@ def top_p_filter_probs(probs: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
 
     Returns: [B, V] filtered (unnormalized) probabilities.
     """
-    sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
-    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
-    keep_sorted = (cumprobs - sorted_probs) < top_p[:, None]
-    keep_sorted = keep_sorted.at[:, 0].set(True)
-    # smallest kept probability per row is the cutoff
-    kept = jnp.where(keep_sorted, sorted_probs, jnp.inf)
-    cutoff = jnp.min(kept, axis=-1, keepdims=True)
+    cutoff = nucleus_cutoff(probs, top_p)
     return jnp.where(probs >= cutoff, probs, 0.0)
 
 

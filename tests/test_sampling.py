@@ -4,7 +4,26 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from distributed_inference_server_tpu.ops.sampling import sample_tokens
+from distributed_inference_server_tpu.ops.sampling import (
+    nucleus_cutoff,
+    sample_tokens,
+    top_p_filter_probs,
+)
+
+
+def _sorted_reference_kept(probs: np.ndarray, top_p: np.ndarray) -> np.ndarray:
+    """The classic sort-based nucleus kept-mask: smallest descending prefix
+    reaching top_p, extended to boundary-value ties, argmax always kept."""
+    B, V = probs.shape
+    kept = np.zeros((B, V), bool)
+    for b in range(B):
+        order = np.argsort(-probs[b], kind="stable")
+        cum = np.cumsum(probs[b][order])
+        keep_sorted = (cum - probs[b][order]) < top_p[b]
+        keep_sorted[0] = True
+        cutoff = probs[b][order][keep_sorted].min()
+        kept[b] = probs[b] >= cutoff
+    return kept
 
 
 def test_zero_temperature_is_argmax():
@@ -59,3 +78,53 @@ def test_per_row_mixed_settings():
     )
     assert int(out[0]) == 0
     assert int(out[1]) == 1
+
+
+def test_nucleus_cutoff_matches_sorted_reference():
+    """The binary-search cutoff keeps exactly the sorted-prefix nucleus
+    (random rows are far from the 2^-26 threshold-resolution edge case)."""
+    rng = np.random.default_rng(7)
+    for trial in range(5):
+        logits = rng.normal(scale=3.0, size=(8, 997)).astype(np.float32)
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        top_p = np.asarray([0.0, 0.1, 0.3, 0.5, 0.7, 0.9, 0.99, 1.0],
+                           np.float32)
+        cut = np.asarray(nucleus_cutoff(jnp.asarray(probs),
+                                        jnp.asarray(top_p)))
+        kept = probs >= cut
+        ref = _sorted_reference_kept(probs, top_p)
+        # top_p=1 compares separately: the sorted rule's f32 cumsum
+        # saturates at 1.0 a few (~1e-8 prob) tail tokens early, while
+        # the threshold rule correctly keeps the entire vocabulary
+        np.testing.assert_array_equal(kept[:-1], ref[:-1])
+        assert kept[-1].all()
+
+
+def test_top_p_filter_probs_keeps_mass_and_argmax():
+    rng = np.random.default_rng(3)
+    probs = jax.nn.softmax(
+        jnp.asarray(rng.normal(scale=2.0, size=(6, 301)), jnp.float32), -1
+    )
+    top_p = jnp.asarray([0.2, 0.5, 0.8, 0.95, 1.0, 0.0], jnp.float32)
+    f = np.asarray(top_p_filter_probs(probs, top_p))
+    p = np.asarray(probs)
+    # kept mass reaches the threshold; argmax always kept
+    assert (f.sum(-1) >= np.minimum(np.asarray(top_p), p.sum(-1)) - 1e-6).all()
+    assert (f[np.arange(6), p.argmax(-1)] > 0).all()
+    # top_p=1 keeps everything; top_p=0 keeps only argmax-tied tokens
+    np.testing.assert_array_equal(f[4] > 0, p[4] > 0)
+    assert (f[5] > 0).sum() == (p[5] == p[5].max()).sum()
+
+
+def test_use_topp_false_matches_topp_one():
+    """With every row at top_p=1, the compiled-out variant must sample the
+    identical token for the same key (the nucleus is a no-op there)."""
+    rng = np.random.default_rng(11)
+    logits = jnp.asarray(rng.normal(size=(5, 203)), jnp.float32)
+    temp = jnp.asarray([0.0, 0.5, 1.0, 1.5, 2.0], jnp.float32)
+    top_p = jnp.ones((5,), jnp.float32)
+    for seed in range(4):
+        key = jax.random.PRNGKey(seed)
+        a = sample_tokens(key, logits, temp, top_p, use_topp=True)
+        b = sample_tokens(key, logits, temp, top_p, use_topp=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
