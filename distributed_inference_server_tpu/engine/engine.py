@@ -73,11 +73,18 @@ logger = logging.getLogger(__name__)
 
 def _chosen_logprob(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     """log softmax(logits)[token] per row: [B, V] x [B] -> [B] f32 (the
-    model-distribution log-probability of each sampled token)."""
-    lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    return jnp.take_along_axis(
-        lsm, jnp.maximum(tokens, 0)[:, None], axis=-1
+    model-distribution log-probability of each sampled token).
+
+    Computed as logits[token] - logsumexp(logits): two [B, V] reductions
+    with no [B, V] intermediate, where log_softmax-then-take would write
+    (and read back) the full 33 MB log-probability matrix per decode
+    step at the 128k-vocab bench geometry."""
+    x = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(x, axis=-1)
+    chosen = jnp.take_along_axis(
+        x, jnp.maximum(tokens, 0)[:, None], axis=-1
     )[:, 0]
+    return chosen - lse
 
 
 def _make_allocator(pcfg: PagedCacheConfig, force: Optional[bool]):
@@ -944,20 +951,21 @@ class LLMEngine:
             M = self.ecfg.pp_microbatches
 
             def fwd(params, cfg, ids, positions, pk, pv, ws, gs, kvv,
-                    impl, moe_impl):
+                    impl, moe_impl, logits_idx=None):
                 return pp_paged_forward(
                     mesh, params, cfg, ids, positions, pk, pv, ws, gs,
-                    kvv, num_microbatches=M,
+                    kvv, num_microbatches=M, page_size=ps,
+                    logits_idx=logits_idx,
                 )
 
             return fwd
 
         def fwd(params, cfg, ids, positions, pk, pv, ws, gs, kvv, impl,
-                moe_impl):
+                moe_impl, logits_idx=None):
             return llama.paged_forward(
                 params, cfg, ids, positions, pk, pv, ws, gs, kvv,
                 attention_impl=impl, page_size=ps, moe_impl=moe_impl,
-                mesh=mesh,
+                mesh=mesh, logits_idx=logits_idx,
             )
 
         return fwd
@@ -1136,14 +1144,18 @@ class LLMEngine:
                     logits, k, v = fwd(
                         params, cfg, ids, positions, pool_k, pool_v,
                         write_slots, gather_slots, kv_valid_len,
-                        impl, moe_impl,
+                        impl, moe_impl, logits_idx=last_idx,
                     )
+                    # draft logits are never read (only dk/dv are kept);
+                    # logits_idx shrinks its unembed to one position
+                    # rather than trusting XLA DCE to drop the [B, T, V]
+                    # projection
                     _, dk, dv = fwd(
                         dparams, dcfg, ids, positions, dpool_k, dpool_v,
                         write_slots, gather_slots, kv_valid_len,
-                        impl, "dense",
+                        impl, "dense", logits_idx=last_idx,
                     )
-                    last = logits[jnp.arange(ids.shape[0]), last_idx]
+                    last = logits[:, 0]
                     toks = sample_tokens(rng, last, temp, top_p)
                     return toks, _chosen_logprob(last, toks), k, v, dk, dv
 
@@ -1157,8 +1169,9 @@ class LLMEngine:
                 logits, k, v = fwd(
                     params, cfg, ids, positions, pool_k, pool_v,
                     write_slots, gather_slots, kv_valid_len, impl, moe_impl,
+                    logits_idx=last_idx,
                 )
-                last = logits[jnp.arange(ids.shape[0]), last_idx]
+                last = logits[:, 0]
                 toks = sample_tokens(rng, last, temp, top_p)
                 return toks, _chosen_logprob(last, toks), k, v
 

@@ -491,6 +491,36 @@ def shard_pallas_attend(fn, mesh, decode_step: bool):
     )
 
 
+def gather_kv_window(k_layer, v_layer, gather_slots, page_size: int):
+    """Gather each row's KV window from the flat pool.
+
+    PRECONDITION when ``page_size > 0`` and the shapes divide evenly:
+    every ``gather_slots`` row must be a page-aligned run — exactly
+    ``table[p] * page_size + offset`` for offset 0..page_size-1 per
+    page, which is how the engine builds them (the Pallas kernels rely
+    on the same contract, llama.py ``make_pallas_attend``). Under that
+    precondition, indexing whole [page_size, KV, D] pages moves ~16 KB
+    contiguous chunks per index instead of 1 KB slots — an order of
+    magnitude fewer gather indices for XLA's TPU gather lowering at
+    identical semantics (out-of-range sentinel pages clamp, and padding
+    is masked by kv_valid_len either way). Shape divisibility CANNOT
+    detect a misaligned layout; a caller with arbitrary (non-run)
+    slot indices must pass ``page_size=0`` to get the slot-granular
+    gather.
+
+    Returns (k_seq, v_seq), each [B, S_max, KV, D].
+    """
+    B, S = gather_slots.shape
+    if page_size > 0 and k_layer.shape[0] % page_size == 0 \
+            and S % page_size == 0:
+        pt = gather_slots[:, ::page_size] // page_size  # [B, P]
+        kp = k_layer.reshape(-1, page_size, *k_layer.shape[1:])
+        vp = v_layer.reshape(-1, page_size, *v_layer.shape[1:])
+        return (kp[pt].reshape(B, S, *k_layer.shape[1:]),
+                vp[pt].reshape(B, S, *v_layer.shape[1:]))
+    return k_layer[gather_slots], v_layer[gather_slots]
+
+
 def paged_forward(
     params: Params,
     cfg: ModelConfig,
@@ -505,6 +535,7 @@ def paged_forward(
     page_size: int = 0,
     moe_impl: str = "dense",
     mesh=None,
+    logits_idx: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forward pass over the paged KV pool (engine/kv_cache.py).
 
@@ -529,7 +560,12 @@ def paged_forward(
         in shard_map over the ``tensor`` axis — each shard runs the kernel
         on its own KV heads' pages, fully local, no collectives.
 
-    Returns (logits [B, T, V] f32, new pool_k, new pool_v).
+    Returns (logits [B, T, V] f32, new pool_k, new pool_v) — with
+    ``logits_idx`` given ([B] per-row position in T), only that position
+    is unembedded and the logits are [B, 1, V]. Prefill chunks use this:
+    unembedding every position materializes [B, T, 128k] f32 (~2 GB of
+    HBM writes at the bench geometry) and pays the full-vocab projection
+    for T-1 positions whose logits the caller immediately discards.
     """
     if not isinstance(attention_impl, str):
         # (decode_impl, prefill_impl) pair from the engine's per-kernel
@@ -575,8 +611,9 @@ def paged_forward(
                 q, k_layer, v_layer, page_tables, kv_valid_len, q_start,
                 window,
             )
-        k_seq = k_layer[gather_slots]  # [B, S_max, KV, D]
-        v_seq = v_layer[gather_slots]
+        k_seq, v_seq = gather_kv_window(
+            k_layer, v_layer, gather_slots, page_size
+        )  # [B, S_max, KV, D]
         return gqa_attention(q, k_seq, v_seq, positions, kv_valid_len,
                              window, cfg.attn_logit_softcap)
 
@@ -586,6 +623,8 @@ def paged_forward(
         # real tokens have in-range write slots; padding is dropped
         valid_tokens=write_slots < pool_k.shape[1],
     )
+    if logits_idx is not None:
+        h = h[jnp.arange(h.shape[0]), logits_idx][:, None]
     return _unembed(params, cfg, h), new_k, new_v
 
 

@@ -27,7 +27,7 @@ The reference has no PP (SURVEY.md §2.3 absence audit).
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -205,6 +205,8 @@ def pp_paged_forward(
     gather_slots: jnp.ndarray,
     kv_valid_len: jnp.ndarray,
     num_microbatches: int = 1,
+    page_size: int = 0,
+    logits_idx: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Pipeline-parallel forward over the PAGED KV pool — the serving
     engine's hot path under a ``stage`` mesh axis (the 70B TP x PP north
@@ -228,8 +230,10 @@ def pp_paged_forward(
     num_slots = pool_k.shape[1]
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
+    slice_h = logits_idx is not None
+
     def body(layers, embed, final_norm, unembed, ids, pos, pk, pv, ws, gs,
-             kvv):
+             kvv, lidx):
         stage = lax.axis_index("stage")
 
         L_stage = layers["attn_norm"].shape[0]
@@ -246,8 +250,9 @@ def pp_paged_forward(
             )
 
             def attend_fn(q, k_layer, v_layer, w):
-                k_seq = k_layer[gs_mb]
-                v_seq = v_layer[gs_mb]
+                k_seq, v_seq = llama.gather_kv_window(
+                    k_layer, v_layer, gs_mb, page_size
+                )
                 return gqa_attention(q, k_seq, v_seq, pos_mb, kvv_mb, w,
                                      cfg.attn_logit_softcap)
 
@@ -300,6 +305,11 @@ def pp_paged_forward(
         )
 
         out = lax.psum(out, "stage")  # only the last stage wrote; broadcast
+        if slice_h:
+            # single-position unembed (prefill chunks): slice hidden
+            # states BEFORE the vocab projection so the [B, T, V]
+            # materialization never happens on any stage
+            out = out[jnp.arange(out.shape[0]), lidx][:, None]
         h = rms_norm(out, final_norm, cfg.rms_norm_eps)
         logits = jnp.einsum(
             "bth,hv->btv", h, unembed, preferred_element_type=jnp.float32
@@ -328,14 +338,19 @@ def pp_paged_forward(
             P(),  # write_slots
             P(),  # gather_slots
             P(),  # kv_valid_len
+            P(),  # logits_idx (or its zero placeholder)
         ),
         out_specs=(P(), P("stage"), P("stage")),
+    )
+    lidx = (
+        logits_idx if slice_h
+        else jnp.zeros((input_ids.shape[0],), jnp.int32)
     )
     return fn(
         params["layers"], params["embed"],
         params["final_norm"], unembed,
         input_ids, positions, pool_k, pool_v, write_slots, gather_slots,
-        kv_valid_len,
+        kv_valid_len, lidx,
     )
 
 

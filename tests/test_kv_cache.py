@@ -189,3 +189,29 @@ def test_flat_slots_mapping():
     np.testing.assert_array_equal(
         np.asarray(slots), [[12, 4, 5], [9, 10, 11]]
     )
+
+
+def test_gather_kv_window_page_path_matches_slot_path():
+    """The page-granular fast path must produce exactly the slot-granular
+    gather's output when gather_slots rows are page-aligned runs of
+    in-range pages (the engine's construction; rows past a sequence's
+    live length reference real-but-stale pages and are masked by
+    kv_valid_len downstream, so exact equality only needs in-range
+    tables — out-of-range sentinels clamp differently per path and are
+    likewise masked)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from distributed_inference_server_tpu.models import llama
+
+    rng = np.random.default_rng(5)
+    ps, num_pages, KV, D, B, P = 4, 12, 2, 8, 3, 5
+    pool = rng.normal(size=(num_pages * ps, KV, D)).astype(np.float32)
+    tables = rng.integers(0, num_pages, size=(B, P))
+    offs = np.arange(P * ps)
+    gather = (tables[:, offs // ps] * ps + offs % ps).astype(np.int32)
+    k = jnp.asarray(pool)
+    v = jnp.asarray(pool * 2.0)
+    k_fast, v_fast = llama.gather_kv_window(k, v, jnp.asarray(gather), ps)
+    k_slow, v_slow = llama.gather_kv_window(k, v, jnp.asarray(gather), 0)
+    np.testing.assert_array_equal(np.asarray(k_fast), np.asarray(k_slow))
+    np.testing.assert_array_equal(np.asarray(v_fast), np.asarray(v_slow))

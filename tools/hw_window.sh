@@ -53,7 +53,12 @@ step kp_vlong_ctx 580 env KP_PAGES_PER_SEQ=256 KP_CTX=4096 KP_PREFILL_T=512 KP_B
 step decode_probe_b64 580 python tools/decode_probe.py 64 272 64
 step decode_probe_b128 580 python tools/decode_probe.py 128 272 64
 
-# 2. decode sweep remainder: pipeline depth, then best-combo confirm
+# 2. decode sweep remainder: batch scaling first — the r4 b128 anomaly
+#    (98.8 ms/step, superlinear) predates the sort-free sampler, and the
+#    full-vocab sort was the prime suspect; roofline at b128 is 2x b64
+step b96 580 env BENCH_BATCH=96 python bench.py
+step b128 580 env BENCH_BATCH=128 python bench.py
+step b256 900 env BENCH_BATCH=256 python bench.py
 step pipeline2 580 env BENCH_PIPELINE=2 python bench.py
 step pipeline2_b128 580 env BENCH_PIPELINE=2 BENCH_BATCH=128 python bench.py
 
