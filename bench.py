@@ -29,6 +29,11 @@ BENCH_QUANT (none|int8|int4 — weight-only; int8 fits 8B on one v5e:
 BENCH_HBM_GBPS (819, v5e HBM bandwidth for the roofline estimate printed
 alongside every hardware run: roofline tok/s = batch * BW / weight
 bytes — the weight-read bound a decode step cannot beat),
+BENCH_DRAFT (none|same|self-int8|self-int4 — speculative decoding with a
+  draft sharing the target's weights ("same": acceptance 1.0 ceiling) or a
+  quantized copy of them ("self-int*": honest sub-1.0 acceptance from
+  quantization disagreement, a real self-speculation config),
+BENCH_GAMMA (4, draft tokens per speculation round),
 BENCH_MEASURE_WARMUP=1 (measure cold first-request TTFT vs a warmed
 engine's first request vs steady-state — quantifies engine.warmup()'s
 compile amortization instead of asserting it).
@@ -88,6 +93,27 @@ def main() -> None:
     prefill_budget = int(os.environ.get("BENCH_PREFILL_BUDGET", "8192"))
     impl = os.environ.get("BENCH_IMPL", "auto")
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "180"))
+    # speculative decoding: "same" shares the target's weight arrays
+    # (acceptance 1.0 — mechanism proof / ceiling), "self-int8"/"self-int4"
+    # draft with a quantized copy of the SAME weights — a genuinely
+    # cheaper forward whose argmax mostly-but-not-always agrees with the
+    # bf16 target, i.e. an honest sub-1.0 acceptance measurable with
+    # random weights (no checkpoint download exists in this environment)
+    draft_mode = os.environ.get("BENCH_DRAFT", "none")
+    gamma = int(os.environ.get("BENCH_GAMMA", "4"))
+    if draft_mode not in ("none", "same", "self-int8", "self-int4"):
+        # validate at parse time: an unknown value must fail in
+        # milliseconds, not after minutes of 8B weight init inside a
+        # hardware-window step budget
+        _emit({
+            "metric": metric, "value": 0.0, "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "error": f"unknown BENCH_DRAFT {draft_mode!r}; "
+                     "known: none|same|self-int8|self-int4",
+        })
+        sys.exit(2)
+    if draft_mode != "none":
+        metric += "_spec_" + draft_mode.replace("self-", "self")
 
     # Fail fast when the tunnel is not even listening (dead relay): the
     # axon backend dials localhost relay ports; refused connections mean
@@ -197,6 +223,25 @@ def main() -> None:
     else:
         params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     jax.block_until_ready(params)
+
+    draft_params = None
+    if draft_mode == "same":
+        draft_params = params  # shared arrays: no extra weight HBM
+    elif draft_mode in ("self-int8", "self-int4"):
+        if quant != "none":
+            _emit({
+                "metric": metric, "value": 0.0, "unit": "tokens/s",
+                "vs_baseline": 0.0,
+                "error": "BENCH_DRAFT=self-int* requires BENCH_QUANT=none "
+                         "(the draft is quantized FROM the bf16 target)",
+            })
+            sys.exit(2)
+        from distributed_inference_server_tpu.ops.quant import (
+            quantize_params,
+        )
+        draft_params = quantize_params(params, draft_mode[len("self-"):])
+        jax.block_until_ready(draft_params)
+
     # HBM roofline: every decode step reads every weight byte once, so
     # steps/s <= BW / weight_bytes and tok/s <= batch * steps/s
     weight_bytes = sum(
@@ -209,6 +254,16 @@ def main() -> None:
     def mk_engine(use_impl: str) -> "LLMEngine":
         # single construction site: warmup mode and throughput mode must
         # measure the SAME engine configuration
+        kw = {}
+        if draft_params is not None:
+            from distributed_inference_server_tpu.engine.speculative import (
+                SpecConfig,
+            )
+
+            kw = dict(
+                draft_params=draft_params, draft_cfg=cfg,
+                spec=SpecConfig(num_draft_tokens=gamma),
+            )
         return LLMEngine(
             params, cfg, ByteTokenizer(),
             EngineConfig(
@@ -218,6 +273,7 @@ def main() -> None:
                 prefill_token_budget=prefill_budget,
             ),
             dtype=dtype,
+            **kw,
         )
 
     warmup_metric = metric.replace(
@@ -361,9 +417,21 @@ def main() -> None:
             produced = drain(t0, ttfts)
             elapsed = time.perf_counter() - t0
         ttft_sorted = sorted(ttfts.values())
+        spec = None
+        ss = engine.spec_stats()
+        if ss is not None:
+            spec = {
+                "gamma": ss["num_draft_tokens"],
+                "acceptance_rate": ss["acceptance_rate"],
+                # emitted tokens per TARGET forward (incl. the bonus
+                # token) — the speculative speedup factor
+                "tokens_per_target_forward": ss["estimated_speedup"],
+                "enabled": ss["enabled"],
+            }
         return {
             "tput": produced / elapsed,
             "total_tokens": produced,
+            "spec": spec,
             "elapsed_s": round(elapsed, 3),
             "p50_ttft_s": round(
                 ttft_sorted[len(ttft_sorted) // 2], 3
@@ -438,6 +506,8 @@ def main() -> None:
         "platform": platform,
         "model": cfg.name,
         **({"quant": quant} if quant != "none" else {}),
+        **({"draft": draft_mode, "spec": r["spec"]}
+           if r.get("spec") else {}),
         "weight_bytes": weight_bytes,
         "roofline_tokens_per_sec": round(roofline, 1),
         "batch": batch,

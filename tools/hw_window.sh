@@ -71,6 +71,13 @@ step prefill32 580 env BENCH_PREFILL_BATCH=32 python bench.py
 # 3c. int4: half the weight bytes of int8 -> ~2x the weight-bound ceiling
 step 8b_int4 1200 env BENCH_MODEL=llama-3-8b BENCH_QUANT=int4 BENCH_BATCH=32 python bench.py
 
+# 3d. speculative decoding on silicon: self-quantized draft (honest
+#     sub-1.0 acceptance from int8/int4-vs-bf16 argmax disagreement)
+#     and the shared-weights ceiling (acceptance 1.0, overhead bound)
+step spec_selfint8 580 env BENCH_DRAFT=self-int8 python bench.py
+step spec_selfint4 580 env BENCH_DRAFT=self-int4 python bench.py
+step spec_same 580 env BENCH_DRAFT=same python bench.py
+
 # 4. TTFT table: steady-state arrivals + warmup-compile split
 step rate_rps 900 env BENCH_RATE_RPS=16 python bench.py
 step warmup 900 env BENCH_MEASURE_WARMUP=1 python bench.py
