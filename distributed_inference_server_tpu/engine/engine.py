@@ -1221,7 +1221,7 @@ class LLMEngine:
         def block(params, pool_k, pool_v, tokens, positions, steps_left,
                   active, block_tables, temp, top_p, rng,
                   set_mask, set_active, set_tokens, set_positions, set_steps,
-                  any_topp):
+                  sample_mode):
             # merge host overrides (admissions / deactivations) into carry
             tokens = jnp.where(set_mask, set_tokens, tokens)
             positions = jnp.where(set_mask, set_positions, positions)
@@ -1248,19 +1248,28 @@ class LLMEngine:
                     pool_k, pool_v, write, gather, kv_valid, impl, moe_impl,
                 )
                 rng, sub = jax.random.split(rng)
-                # runtime branch, not a static variant: one compiled
+                # runtime 3-way branch, not static variants: one compiled
                 # program per gather bucket (warmup coverage unchanged),
-                # and all-greedy/top_p=1 launches — the common serving
-                # mix and the whole bench path — skip the nucleus's
-                # full-vocab softmax + threshold-search passes entirely.
-                # XLA lowers lax.cond on a scalar to real control flow on
-                # TPU, so only the taken branch executes.
-                nxt = lax.cond(
-                    any_topp,
-                    lambda a: sample_tokens(a[0], a[1], a[2], a[3],
-                                            use_topp=True),
-                    lambda a: sample_tokens(a[0], a[1], a[2], a[3],
-                                            use_topp=False),
+                # with the launcher picking the cheapest sampler the
+                # seated mix needs. XLA lowers lax.switch on a scalar to
+                # real control flow on TPU, so only the taken branch
+                # executes:
+                #   0 all-greedy (the bench path): pure argmax — no
+                #     nucleus passes AND no [B, V] Gumbel noise, which
+                #     the temperature>0 select cannot DCE away since
+                #     temperature is a runtime tensor;
+                #   1 sampled, all top_p==1: categorical without the
+                #     nucleus softmax + threshold search;
+                #   2 nucleus rows present: the full machinery.
+                nxt = lax.switch(
+                    sample_mode,
+                    [
+                        lambda a: jnp.argmax(a[1], -1).astype(jnp.int32),
+                        lambda a: sample_tokens(a[0], a[1], a[2], a[3],
+                                                use_topp=False),
+                        lambda a: sample_tokens(a[0], a[1], a[2], a[3],
+                                                use_topp=True),
+                    ],
                     (sub, logits[:, 0], temp, top_p),
                 )
                 lp = _chosen_logprob(logits[:, 0], nxt)
@@ -1663,13 +1672,17 @@ class LLMEngine:
             jnp.asarray(self._topp),
         )
         snapshot = [(i, s, advs[id(s)]) for i, s in seated]
-        # nucleus machinery only when a seated row actually needs it;
-        # greedy rows (temperature 0) sample a one-hot, for which
-        # nucleus filtering is a no-op — skip the full-vocab passes
+        # sampling machinery only as heavy as a seated row actually
+        # needs: greedy rows (temperature 0) sample a one-hot, for which
+        # nucleus filtering is a no-op — and an all-greedy launch needs
+        # neither the full-vocab nucleus passes nor categorical's [B, V]
+        # Gumbel noise (sample_mode 0/1/2, decoded in the block)
         use_topp = any(
             s.params.top_p < 1.0 and s.params.temperature > 0.0
             for _, s in seated
         )
+        any_temp = any(s.params.temperature > 0.0 for _, s in seated)
+        sample_mode = 2 if use_topp else (1 if any_temp else 0)
         if use_spec:
             ok_arr = np.zeros((self.ecfg.max_batch,), bool)
             for i, _ in seated:
@@ -1690,7 +1703,8 @@ class LLMEngine:
              self.state.k, self.state.v, rng) = self._block_fn(
                 self.params, self.state.k, self.state.v,
                 tokens, positions, steps_left, active,
-                *uploads, rng, *injects, jnp.asarray(use_topp),
+                *uploads, rng, *injects,
+                jnp.asarray(sample_mode, jnp.int32),
             )
             self._pending.append((outs, lps, None, None, None, snapshot))
         self._carry = (tokens, positions, steps_left, active, rng)

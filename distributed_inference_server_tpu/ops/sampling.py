@@ -5,10 +5,12 @@ The reference spec'd host-side sampling per token (``design.md:666-671``
 sampling is fused into the compiled step: a single jittable function over the
 batch, driven by a threaded PRNG key. Temperature==0 rows degrade to argmax;
 top_p==1 rows skip the nucleus cutoff — per-ROW mixes are branchless
-(lax.select), while the per-LAUNCH ``use_topp`` flag statically compiles the
-nucleus machinery out for launches where no row needs it (the engine's decode
-block selects between the two via ``lax.cond`` on a runtime scalar, so one
-device program per shape still covers every request mix).
+(lax.select). Per LAUNCH, the engine's decode block picks the cheapest
+sampler the seated mix needs via ``lax.switch`` on a runtime scalar: pure
+argmax for all-greedy launches (bypassing this module — no Gumbel noise at
+all), ``use_topp=False`` for sampled launches with every top_p == 1, and
+``use_topp=True`` (the full nucleus machinery here) otherwise — one device
+program per shape still covers every request mix.
 
 The nucleus cutoff is computed WITHOUT a vocabulary sort. ``jnp.sort`` over
 [B, 128k] logits lowers to O(log^2 V) bitonic passes on TPU and was the
