@@ -1346,7 +1346,7 @@ class LLMEngine:
                   tokens, positions, steps_left, active, block_tables,
                   temp, top_p, spec_ok, rng,
                   set_mask, set_active, set_tokens, set_positions,
-                  set_steps):
+                  set_steps, any_temp):
             tokens = jnp.where(set_mask, set_tokens, tokens)
             positions = jnp.where(set_mask, set_positions, positions)
             steps_left = jnp.where(set_mask, set_steps, steps_left)
@@ -1388,9 +1388,17 @@ class LLMEngine:
                     q = spec_probs(logits[:, 0], temp)
                     if use_topp:
                         q = spec_nucleus(q, top_p)
-                    nxt = jax.random.categorical(
-                        key, jnp.log(q + 1e-30), axis=-1
-                    ).astype(jnp.int32)
+                    # all-greedy launches (runtime branch): q rows are
+                    # one-hots, so argmax(q) IS the draw — skip the
+                    # [B, V] Gumbel noise per draft step
+                    nxt = lax.cond(
+                        any_temp,
+                        lambda a: jax.random.categorical(
+                            a[0], jnp.log(a[1] + 1e-30), axis=-1
+                        ).astype(jnp.int32),
+                        lambda a: jnp.argmax(a[1], -1).astype(jnp.int32),
+                        (key, q),
+                    )
                     return (dpk, dpv, nxt, pos + 1), (nxt, q)
 
                 (dpool_k, dpool_v, _, _), (dtoks, dqs) = lax.scan(
@@ -1417,10 +1425,11 @@ class LLMEngine:
                 )
                 tps = spec_probs(logits, temp[:, None])  # [B, W, V]
                 # model-distribution logprobs of whatever gets emitted
-                # (raw logits, matching the plain decode path)
-                lraw = jax.nn.log_softmax(
-                    logits.astype(jnp.float32), axis=-1
-                )
+                # (raw logits, matching the plain decode path): computed
+                # as logits[token] - logsumexp, no [B, W, V] log-softmax
+                # intermediate
+                x32 = logits.astype(jnp.float32)
+                lse = jax.scipy.special.logsumexp(x32, axis=-1)  # [B, W]
 
                 # ---- rejection sampling (shared speculative.py core) ----
                 # nucleus-aware: the core filters BOTH sides to each row's
@@ -1434,6 +1443,7 @@ class LLMEngine:
                     tps, dtoks, dqs, keys[gamma + 1], keys[gamma + 2],
                     spec_ok=spec_ok,
                     top_p=top_p if use_topp else None,
+                    greedy_only=~any_temp,
                 )
                 idx = jnp.arange(W)[None]
                 base = num_accepted + 1
@@ -1455,8 +1465,8 @@ class LLMEngine:
                     (idx < emitted[:, None]) & active[:, None], toks_out, -1
                 )
                 lp_out = jnp.take_along_axis(
-                    lraw, jnp.maximum(toks_out, 0)[..., None], axis=-1
-                )[..., 0]
+                    x32, jnp.maximum(toks_out, 0)[..., None], axis=-1
+                )[..., 0] - lse
                 new_last = toks_out[rows, jnp.maximum(emitted, 1) - 1]
                 tokens = jnp.where(active & (emitted > 0), new_last, tokens)
                 positions = positions + emitted
@@ -1696,6 +1706,7 @@ class LLMEngine:
                 self.draft_state.k, self.draft_state.v,
                 tokens, positions, steps_left, active,
                 *uploads, jnp.asarray(ok_arr), rng, *injects,
+                jnp.asarray(any_temp),
             )
             self._pending.append((toks, lps, counts, acc, prop, snapshot))
         else:

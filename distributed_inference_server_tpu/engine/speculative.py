@@ -284,6 +284,7 @@ def accept_and_resample(
     resample_key: jax.Array,
     spec_ok: jnp.ndarray | None = None,  # [B] False forces reject at 0
     top_p: jnp.ndarray | None = None,  # [B] nucleus-aware verify, ALL rows
+    greedy_only: jnp.ndarray | None = None,  # [] True: every row temp==0
 ):
     """Shared rejection-sampling core of one speculative round — the
     accept/resample math used by BOTH the dense-cache ``spec_round`` and
@@ -352,9 +353,22 @@ def accept_and_resample(
     resid_sum = jnp.sum(resid, axis=-1, keepdims=True)
     # numerical corner (p == q exactly): fall back to the target dist
     resid = jnp.where(resid_sum > 1e-30, resid, p_rej)
-    extra = jax.random.categorical(
-        resample_key, jnp.log(resid + 1e-30), axis=-1
-    ).astype(jnp.int32)  # [B]
+    if greedy_only is None:
+        extra = jax.random.categorical(
+            resample_key, jnp.log(resid + 1e-30), axis=-1
+        ).astype(jnp.int32)  # [B]
+    else:
+        # all-greedy launches (runtime branch): residuals are one-hots
+        # (or the one-hot target fallback), so argmax IS the draw —
+        # skip the [B, V] Gumbel noise
+        extra = lax.cond(
+            greedy_only,
+            lambda a: jnp.argmax(a[1], -1).astype(jnp.int32),
+            lambda a: jax.random.categorical(
+                a[0], jnp.log(a[1] + 1e-30), axis=-1
+            ).astype(jnp.int32),
+            (resample_key, resid),
+        )
 
     # tokens emitted this round: accepted draft prefix + extra token
     idx = jnp.arange(gamma + 1)[None]
