@@ -5,6 +5,12 @@
 # Usage: bash tools/hw_window.sh [logfile]
 set -u
 LOG="${1:-/root/repo/HW_WINDOW_r04.log}"
+# steps that completed (exit 0) in ANY attempt are recorded here and
+# skipped on retry — windows are short and flaky, so a rerun must spend
+# its minutes on NEW steps, not re-measuring the ones that already landed.
+# Delete this file to force a full re-measure.
+DONE="${HW_DONE_FILE:-/root/repo/.hw_done_r04}"
+touch "$DONE"
 export PYTHONPATH=/root/repo:/root/.axon_site
 export JAX_PLATFORMS=axon  # never let a fresh shell fall back to CPU and
                            # log CPU numbers as chip measurements
@@ -19,6 +25,10 @@ assert jax.devices()[0].platform != 'cpu', 'CPU backend — not a chip window'
 
 step() {
   local name="$1" tmo="$2"; shift 2
+  if grep -qx "$name" "$DONE"; then
+    echo "=== $name already done; skipped ===" | tee -a "$LOG"
+    return 0
+  fi
   echo "=== $name  $(date -u +%H:%M:%S) ===" | tee -a "$LOG"
   if ! alive; then
     echo "--- device hang; step skipped ---" | tee -a "$LOG"
@@ -27,6 +37,9 @@ step() {
   timeout "$tmo" "$@" 2>&1 | grep -vE "WARNING.*xla_bridge" | tail -6 | tee -a "$LOG"
   local rc=${PIPESTATUS[0]}
   echo "--- exit=$rc ---" | tee -a "$LOG"
+  if [ "$rc" -eq 0 ]; then
+    echo "$name" >>"$DONE"
+  fi
 }
 
 # 0. liveness gate: skip the whole window if the device hangs
