@@ -3,6 +3,8 @@
 import numpy as np
 import jax
 import jax.numpy as jnp
+from hypothesis import given, settings
+from hypothesis import strategies as st
 
 from distributed_inference_server_tpu.ops.sampling import (
     nucleus_cutoff,
@@ -128,3 +130,27 @@ def test_use_topp_false_matches_topp_one():
         a = sample_tokens(key, logits, temp, top_p, use_topp=True)
         b = sample_tokens(key, logits, temp, top_p, use_topp=False)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    seed=st.integers(0, 2**31 - 1),
+    vocab=st.integers(2, 400),
+    top_p=st.floats(0.0, 0.999),
+    scale=st.floats(0.1, 8.0),
+)
+def test_nucleus_cutoff_property_matches_sorted_rule(seed, vocab, top_p, scale):
+    """Property (100 cases, SURVEY §4.2 style): for any distribution and
+    any top_p < 1, the binary-search kept set equals the sorted-prefix
+    nucleus extended to boundary ties. top_p=1.0 is excluded — there the
+    sorted rule's own f32 cumsum saturation drops ~1e-8-mass tail tokens
+    that the threshold rule correctly keeps (covered by the directed
+    test above)."""
+    rng = np.random.default_rng(seed)
+    logits = rng.normal(scale=scale, size=(1, vocab)).astype(np.float32)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    tp = np.asarray([top_p], np.float32)
+    cut = np.asarray(nucleus_cutoff(jnp.asarray(probs), jnp.asarray(tp)))
+    kept = probs >= cut
+    ref = _sorted_reference_kept(probs, tp)
+    np.testing.assert_array_equal(kept, ref)
