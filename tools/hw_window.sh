@@ -84,6 +84,14 @@ step prefill32 580 env BENCH_PREFILL_BATCH=32 python bench.py
 # 3c. int4: half the weight bytes of int8 -> ~2x the weight-bound ceiling
 step 8b_int4 1200 env BENCH_MODEL=llama-3-8b BENCH_QUANT=int4 BENCH_BATCH=32 python bench.py
 
+# 3c2. long-context decode + prefill: the paged design's context story
+#      (and the pallas-prefill crossover through the real engine —
+#      compare mode measures both impls). KV reads per step grow with
+#      context while weight reads stay fixed, so these bound the
+#      KV-path efficiency directly.
+step longctx_2k 900 env BENCH_PROMPT=2048 BENCH_BATCH=16 BENCH_NEW=128 python bench.py
+step longctx_4k 900 env BENCH_PROMPT=4096 BENCH_BATCH=8 BENCH_NEW=128 python bench.py
+
 # 3d. speculative decoding on silicon: self-quantized draft (honest
 #     sub-1.0 acceptance from int8/int4-vs-bf16 argmax disagreement)
 #     and the shared-weights ceiling (acceptance 1.0, overhead bound)
