@@ -101,6 +101,16 @@ def main() -> None:
     # random weights (no checkpoint download exists in this environment)
     draft_mode = os.environ.get("BENCH_DRAFT", "none")
     gamma = int(os.environ.get("BENCH_GAMMA", "4"))
+    kv_quant = os.environ.get("BENCH_KV_QUANT", "none")
+    if kv_quant not in ("none", "int8"):
+        _emit({
+            "metric": metric, "value": 0.0, "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "error": f"unknown BENCH_KV_QUANT {kv_quant!r}; known: none|int8",
+        })
+        sys.exit(2)
+    if kv_quant != "none":
+        metric += "_kv" + kv_quant
     if draft_mode not in ("none", "same", "self-int8", "self-int4"):
         # validate at parse time: an unknown value must fail in
         # milliseconds, not after minutes of 8B weight init inside a
@@ -270,7 +280,7 @@ def main() -> None:
                 max_batch=batch, prefill_buckets=buckets, paged=paged,
                 attention_impl=use_impl, decode_block_size=block,
                 pipeline_depth=pipeline, prefill_batch=prefill_batch,
-                prefill_token_budget=prefill_budget,
+                prefill_token_budget=prefill_budget, kv_quant=kv_quant,
             ),
             dtype=dtype,
             **kw,
@@ -506,6 +516,7 @@ def main() -> None:
         "platform": platform,
         "model": cfg.name,
         **({"quant": quant} if quant != "none" else {}),
+        **({"kv_quant": kv_quant} if kv_quant != "none" else {}),
         **({"draft": draft_mode, "spec": r["spec"]}
            if r.get("spec") else {}),
         "weight_bytes": weight_bytes,

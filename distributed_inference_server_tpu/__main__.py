@@ -100,6 +100,7 @@ def main(argv=None) -> int:
         cp_min_tokens=cfg.get("engine", "cp_min_tokens") or None,
         sp_impl=cfg.get("engine", "sp_impl"),
         warmup_compile=cfg.get("engine", "warmup_compile"),
+        kv_quant=cfg.get("engine", "kv_quant"),
     )
     tokenizer = load_tokenizer(model_dir)
 

@@ -52,6 +52,7 @@ from distributed_inference_server_tpu.engine.kv_cache import (
     PageAllocator,
     PagedCacheConfig,
     PagedKVState,
+    QuantPool,
 )
 from distributed_inference_server_tpu.engine.speculative import (
     PatternTrackers,
@@ -165,6 +166,12 @@ class EngineConfig:
     # TPU). Off by default — tests build many engines; the server and
     # hot-swap paths turn it on (serving config engine.warmup_compile).
     warmup_compile: bool = False
+    # KV cache quantization: "int8" stores pools as per-vector-absmax
+    # int8 codes + f32 scales (engine/kv_cache.py QuantPool) — half the
+    # KV HBM traffic per decode step and double the context capacity.
+    # Forces the XLA attention path (the Pallas kernels DMA raw pages)
+    # and is not supported under stage/seq mesh axes.
+    kv_quant: str = "none"
 
 
 @dataclass
@@ -281,13 +288,32 @@ class LLMEngine:
         self.spec_trackers = (
             PatternTrackers(self.spec) if draft_params is not None else None
         )
+        kvq = self.ecfg.kv_quant
+        if kvq != "none":
+            # (value validation itself lives in PagedKVState.create)
+            if self.ecfg.attention_impl == "pallas":
+                raise ValueError(
+                    "kv_quant='int8' requires the XLA attention path; "
+                    "attention_impl='pallas' cannot read quantized pools"
+                )
+            if mesh is not None and (
+                mesh.shape.get("stage", 1) > 1
+                or mesh.shape.get("seq", 1) > 1
+            ):
+                raise ValueError(
+                    "kv_quant='int8' is not supported under stage/seq "
+                    "mesh axes yet (PP pool specs and ring-attention "
+                    "consume raw pools)"
+                )
         self.draft_state = (
-            PagedKVState.create(draft_cfg, self.pcfg, dtype=dtype)
+            PagedKVState.create(draft_cfg, self.pcfg, dtype=dtype,
+                                kv_quant=kvq)
             if draft_params is not None
             else None
         )
 
-        self.state = PagedKVState.create(cfg, self.pcfg, dtype=dtype)
+        self.state = PagedKVState.create(cfg, self.pcfg, dtype=dtype,
+                                         kv_quant=kvq)
         if mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -333,20 +359,30 @@ class LLMEngine:
             pool_sharding = NamedSharding(
                 mesh, tp_rules.kv_pool_spec(stage_axis)
             )
-            self.state.k = jax.device_put(self.state.k, pool_sharding)
-            self.state.v = jax.device_put(self.state.v, pool_sharding)
+
+            def put_pool(pool):
+                if isinstance(pool, QuantPool):
+                    # scale [L, slots, KV] shards on KV heads like the
+                    # codes (stage is rejected with kv_quant at init)
+                    from jax.sharding import PartitionSpec as P
+
+                    scale_sh = NamedSharding(mesh, P(None, None, "tensor"))
+                    return QuantPool(
+                        jax.device_put(pool.data, pool_sharding),
+                        jax.device_put(pool.scale, scale_sh),
+                    )
+                return jax.device_put(pool, pool_sharding)
+
+            self.state.k = put_pool(self.state.k)
+            self.state.v = put_pool(self.state.v)
             if self.draft_params is not None:
                 tp_rules.validate_tp(draft_cfg, mesh.shape.get("tensor", 1))
                 self.draft_params = tp_rules.shard_params(
                     self.draft_params, mesh, draft_cfg,
                     stage_axis=stage_axis,
                 )
-                self.draft_state.k = jax.device_put(
-                    self.draft_state.k, pool_sharding
-                )
-                self.draft_state.v = jax.device_put(
-                    self.draft_state.v, pool_sharding
-                )
+                self.draft_state.k = put_pool(self.draft_state.k)
+                self.draft_state.v = put_pool(self.draft_state.v)
         if self._moe_impl() == "ep":
             # Serving is drop-free: per-expert load never exceeds N (top-k
             # experts are distinct per token), so a capacity factor of E/k
@@ -1000,6 +1036,10 @@ class LLMEngine:
         independently per kernel, so a prefill-only rejection keeps the
         decode hot loop on Pallas."""
         impl = self.ecfg.attention_impl
+        if self.ecfg.kv_quant != "none":
+            # quantized pools are XLA-gather-only (the kernels DMA raw
+            # pages); "pallas" was rejected at construction
+            return "xla"
         if impl != "auto":
             return impl
         if self._auto_impl is None:

@@ -29,7 +29,7 @@ import io
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +37,12 @@ import numpy as np
 
 from distributed_inference_server_tpu.core.errors import CacheDeserializationError, CacheFull
 from distributed_inference_server_tpu.models.configs import ModelConfig
+from distributed_inference_server_tpu.ops.quant import (
+    QuantPool,
+    dequantize_kv,
+    pool_num_slots,
+    quantize_kv,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -67,7 +73,8 @@ class PagedKVState:
 
     @classmethod
     def create(
-        cls, cfg: ModelConfig, pcfg: PagedCacheConfig, dtype=jnp.bfloat16
+        cls, cfg: ModelConfig, pcfg: PagedCacheConfig, dtype=jnp.bfloat16,
+        kv_quant: str = "none",
     ) -> "PagedKVState":
         shape = (
             cfg.num_layers,
@@ -75,7 +82,25 @@ class PagedKVState:
             cfg.num_kv_heads,
             cfg.head_dim,
         )
+        if kv_quant == "int8":
+            def pool():
+                return QuantPool(
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:-1], jnp.float32),
+                )
+
+            return cls(pool(), pool())
+        if kv_quant != "none":
+            raise ValueError(
+                f"unknown kv_quant {kv_quant!r}; known: none|int8"
+            )
         return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+# The quantized-pool representation and codec live in ops/quant.py next
+# to the weight quantization (models and parallel code consume them
+# without depending on the engine layer); re-exported here because the
+# pool is created and serialized at this layer.
 
 def flat_slots(
     block_tables: jnp.ndarray, positions: jnp.ndarray, page_size: int
@@ -332,9 +357,21 @@ def serialize_kv(
     slots = np.concatenate(
         [np.arange(p * page_size, (p + 1) * page_size) for p in page_ids]
     )
+    buf = io.BytesIO()
+    if isinstance(state.k, QuantPool):
+        # quantized pools serialize codes + scales; the round-trip is
+        # exact at the quantized representation (Property 12 semantics)
+        np.savez(
+            buf,
+            k=np.asarray(state.k.data[:, slots]),
+            v=np.asarray(state.v.data[:, slots]),
+            k_scale=np.asarray(state.k.scale[:, slots]),
+            v_scale=np.asarray(state.v.scale[:, slots]),
+            token_count=np.int64(token_count),
+        )
+        return buf.getvalue()
     k = np.asarray(state.k[:, slots])
     v = np.asarray(state.v[:, slots])
-    buf = io.BytesIO()
     np.savez(
         buf,
         k=np.frombuffer(k.tobytes(), np.uint8),
@@ -351,12 +388,23 @@ def deserialize_kv(
 ) -> Tuple[PagedKVState, int]:
     """Restore serialized pages into freshly-allocated page ids. Returns the
     updated device state and the token count."""
+    quant = isinstance(state.k, QuantPool)
     try:
         with np.load(io.BytesIO(data)) as z:
-            shape = tuple(z["shape"])
-            dtype = _np_dtype(bytes(z["dtype"]).decode())
-            k = np.frombuffer(z["k"].tobytes(), dtype).reshape(shape)
-            v = np.frombuffer(z["v"].tobytes(), dtype).reshape(shape)
+            if quant:
+                if "k_scale" not in z:
+                    raise ValueError(
+                        "payload is not a quantized-pool serialization"
+                    )
+                k = z["k"]
+                v = z["v"]
+                k_scale = z["k_scale"]
+                v_scale = z["v_scale"]
+            else:
+                shape = tuple(z["shape"])
+                dtype = _np_dtype(bytes(z["dtype"]).decode())
+                k = np.frombuffer(z["k"].tobytes(), dtype).reshape(shape)
+                v = np.frombuffer(z["v"].tobytes(), dtype).reshape(shape)
             token_count = int(z["token_count"])
     except Exception as e:
         raise CacheDeserializationError(str(e)) from None
@@ -368,8 +416,18 @@ def deserialize_kv(
             f"page count mismatch: payload {k.shape[1]} slots, target {len(slots)}"
         )
     try:
-        new_k = state.k.at[:, slots].set(jnp.asarray(k))
-        new_v = state.v.at[:, slots].set(jnp.asarray(v))
+        if quant:
+            new_k = QuantPool(
+                state.k.data.at[:, slots].set(jnp.asarray(k)),
+                state.k.scale.at[:, slots].set(jnp.asarray(k_scale)),
+            )
+            new_v = QuantPool(
+                state.v.data.at[:, slots].set(jnp.asarray(v)),
+                state.v.scale.at[:, slots].set(jnp.asarray(v_scale)),
+            )
+        else:
+            new_k = state.k.at[:, slots].set(jnp.asarray(k))
+            new_v = state.v.at[:, slots].set(jnp.asarray(v))
     except Exception as e:
         raise CacheDeserializationError(str(e)) from None
     return PagedKVState(new_k, new_v), token_count

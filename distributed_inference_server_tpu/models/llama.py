@@ -571,8 +571,22 @@ def paged_forward(
         # (decode_impl, prefill_impl) pair from the engine's per-kernel
         # "auto" probe — pick by this call's token count
         attention_impl = attention_impl[0 if input_ids.shape[1] == 1 else 1]
+    from distributed_inference_server_tpu.ops.quant import (
+        QuantPool,
+        dequantize_kv,
+        pool_num_slots,
+        quantize_kv,
+    )
+
+    kv_quantized = isinstance(pool_k, QuantPool)
     use_pallas = attention_impl == "pallas"
     if use_pallas:
+        if kv_quantized:
+            raise ValueError(
+                "attention_impl='pallas' does not support quantized KV "
+                "pools (the kernels DMA raw pool pages); the engine "
+                "forces the XLA path when kv_quant is enabled"
+            )
         if page_size <= 0:
             raise ValueError("attention_impl='pallas' requires page_size")
         decode_step = input_ids.shape[1] == 1
@@ -594,7 +608,13 @@ def paged_forward(
             )
 
     def write_fn(layer, new):
-        # layer: [num_slots, KV, D]; new: [B, T, KV, D]
+        # layer: [num_slots, KV, D] (or QuantPool); new: [B, T, KV, D]
+        if kv_quantized:
+            codes, scale = quantize_kv(new)
+            return QuantPool(
+                layer.data.at[write_slots].set(codes, mode="drop"),
+                layer.scale.at[write_slots].set(scale, mode="drop"),
+            )
         return layer.at[write_slots].set(new, mode="drop")
 
     def attend_fn(q, k_layer, v_layer, window):
@@ -611,9 +631,19 @@ def paged_forward(
                 q, k_layer, v_layer, page_tables, kv_valid_len, q_start,
                 window,
             )
-        k_seq, v_seq = gather_kv_window(
-            k_layer, v_layer, gather_slots, page_size
-        )  # [B, S_max, KV, D]
+        if kv_quantized:
+            kd, vd = gather_kv_window(
+                k_layer.data, v_layer.data, gather_slots, page_size
+            )
+            ks, vs = gather_kv_window(
+                k_layer.scale, v_layer.scale, gather_slots, page_size
+            )
+            k_seq = dequantize_kv(kd, ks, q.dtype)
+            v_seq = dequantize_kv(vd, vs, q.dtype)
+        else:
+            k_seq, v_seq = gather_kv_window(
+                k_layer, v_layer, gather_slots, page_size
+            )  # [B, S_max, KV, D]
         return gqa_attention(q, k_seq, v_seq, positions, kv_valid_len,
                              window, cfg.attn_logit_softcap)
 
@@ -621,7 +651,7 @@ def paged_forward(
         params, cfg, input_ids, positions, pool_k, pool_v, write_fn,
         attend_fn, moe_impl=moe_impl,
         # real tokens have in-range write slots; padding is dropped
-        valid_tokens=write_slots < pool_k.shape[1],
+        valid_tokens=write_slots < pool_num_slots(pool_k),
     )
     if logits_idx is not None:
         h = h[jnp.arange(h.shape[0]), logits_idx][:, None]

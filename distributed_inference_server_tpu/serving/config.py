@@ -100,6 +100,10 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "spec_reenable_after_s": (float, 30.0),
         # compile all serving programs before a replica reports ready
         "warmup_compile": (bool, True),
+        # KV cache quantization: none | int8 (engine/kv_cache.py
+        # QuantPool — half the KV HBM traffic, double the context
+        # capacity; forces the XLA attention path)
+        "kv_quant": (str, "none"),
     },
     "tracing": {
         # OTLP/HTTP collector URL for span export (utils/otlp.py), e.g.

@@ -1,0 +1,197 @@
+"""Int8 KV cache (engine/kv_cache.py QuantPool): quantization machinery,
+engine end-to-end, serialize round-trip, and guard rails."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distributed_inference_server_tpu.engine.kv_cache import (
+    PagedCacheConfig,
+    PagedKVState,
+    QuantPool,
+    dequantize_kv,
+    pool_num_slots,
+    quantize_kv,
+)
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+TOK = ByteTokenizer()
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+
+
+def test_quantize_dequantize_bounded_error():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(scale=3.0, size=(5, 7, 4, 16)), jnp.float32)
+    codes, scale = quantize_kv(x)
+    assert codes.dtype == jnp.int8 and scale.shape == (5, 7, 4)
+    back = dequantize_kv(codes, scale, jnp.float32)
+    # absmax scaling: error <= scale/2 per element
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    bound = np.asarray(scale)[..., None] / 2 + 1e-6
+    assert (err <= bound).all()
+    # zero vectors reconstruct exactly
+    z, zs = quantize_kv(jnp.zeros((2, 3, 4, 16)))
+    assert np.asarray(dequantize_kv(z, zs)).sum() == 0
+
+
+def test_quant_pool_create_and_slots():
+    pcfg = PagedCacheConfig(num_pages=8, page_size=4, max_pages_per_seq=4)
+    st = PagedKVState.create(TINY, pcfg, kv_quant="int8")
+    assert isinstance(st.k, QuantPool)
+    assert st.k.data.shape == (TINY.num_layers, 32, TINY.num_kv_heads,
+                               TINY.head_dim)
+    assert st.k.scale.shape == st.k.data.shape[:-1]
+    assert pool_num_slots(st.k) == 32
+    dense = PagedKVState.create(TINY, pcfg)
+    assert pool_num_slots(dense.k) == 32
+    with pytest.raises(ValueError):
+        PagedKVState.create(TINY, pcfg, kv_quant="fp8")
+
+
+def _make_engine(params, kv_quant="int8", **kw):
+    kw.setdefault("attention_impl", "xla")
+    return LLMEngine(
+        params, TINY, TOK,
+        EngineConfig(
+            max_batch=4,
+            prefill_buckets=(16,),
+            paged=PagedCacheConfig(
+                num_pages=24, page_size=4, max_pages_per_seq=8
+            ),
+            decode_block_size=4,
+            kv_quant=kv_quant,
+            **kw,
+        ),
+        dtype=jnp.float32,
+    )
+
+
+def _drain(engine):
+    out = {}
+    while engine.has_work():
+        for o in engine.step():
+            r = out.setdefault(o.request_id,
+                               {"tokens": [], "finish": None})
+            if o.token_id is not None:
+                r["tokens"].append(o.token_id)
+            if o.finished:
+                r["finish"] = o.finish_reason
+                r["error"] = o.error
+    return out
+
+def test_engine_generates_with_int8_kv(tiny_params):
+    """End-to-end: int8-KV decode produces a full, error-free generation
+    whose tokens mostly agree with the bf16-pool engine (quantization
+    noise may flip a late argmax on random weights — the machinery is
+    exercised either way)."""
+    prompt = TOK.encode("kv quant check")
+    e_quant = _make_engine(tiny_params)
+    e_quant.add_request("q", prompt, SamplingParams(max_tokens=8,
+                                                    temperature=0.0))
+    rq = _drain(e_quant)["q"]
+    assert rq["error"] is None and len(rq["tokens"]) >= 1
+
+    e_dense = _make_engine(tiny_params, kv_quant="none")
+    e_dense.add_request("d", prompt, SamplingParams(max_tokens=8,
+                                                    temperature=0.0))
+    rd = _drain(e_dense)["d"]
+    # first token comes from the same prefill with quantized K/V of the
+    # prompt only — expect agreement on at least the first token
+    assert rq["tokens"][0] == rd["tokens"][0]
+
+
+def test_engine_serialize_roundtrip_int8(tiny_params):
+    """Property 12 under quantization: a sequence's pages serialize and
+    restore bit-exactly at the quantized representation."""
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        deserialize_kv,
+        serialize_kv,
+    )
+
+    engine = _make_engine(tiny_params)
+    prompt = TOK.encode("serialize me please")
+    engine.add_request("s", prompt, SamplingParams(max_tokens=4,
+                                                   temperature=0.0))
+    _drain(engine)
+    st = engine.state
+    blob = serialize_kv(st, [0, 1], 4, token_count=8)
+    st2, count = deserialize_kv(st, blob, [2, 3], 4)
+    assert count == 8
+    np.testing.assert_array_equal(
+        np.asarray(st.k.data[:, 0:8]), np.asarray(st2.k.data[:, 8:16])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st.k.scale[:, 0:8]), np.asarray(st2.k.scale[:, 8:16])
+    )
+
+
+def test_kv_quant_rejects_pallas_and_unknown(tiny_params):
+    with pytest.raises(ValueError, match="XLA attention"):
+        _make_engine(tiny_params, kv_quant="int8",
+                     attention_impl="pallas")
+    with pytest.raises(ValueError, match="unknown kv_quant"):
+        _make_engine(tiny_params, kv_quant="fp8")
+
+
+def test_int8_kv_under_tensor_parallel(tiny_params):
+    """The quant pool's scale leaves shard on KV heads alongside the
+    codes; TP generation must match the single-device int8 engine."""
+    from distributed_inference_server_tpu.parallel.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+
+    mesh = make_mesh(MeshSpec(tensor=2))
+    prompt = TOK.encode("tp kv quant")
+    single = _make_engine(tiny_params)
+    single.add_request("a", prompt, SamplingParams(max_tokens=6,
+                                                   temperature=0.0))
+    rs = _drain(single)["a"]
+
+    tp = LLMEngine(
+        tiny_params, TINY, TOK,
+        EngineConfig(
+            max_batch=4, prefill_buckets=(16,),
+            paged=PagedCacheConfig(num_pages=24, page_size=4,
+                                   max_pages_per_seq=8),
+            attention_impl="xla", decode_block_size=4, kv_quant="int8",
+        ),
+        dtype=jnp.float32, mesh=mesh,
+    )
+    tp.add_request("b", prompt, SamplingParams(max_tokens=6,
+                                               temperature=0.0))
+    rt = _drain(tp)["b"]
+    assert rt["error"] is None
+    assert rs["tokens"] == rt["tokens"]
+
+
+def test_kv_quant_rejects_stage_seq_axes(tiny_params):
+    from distributed_inference_server_tpu.parallel.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+
+    with pytest.raises(ValueError, match="stage/seq"):
+        LLMEngine(
+            tiny_params, TINY, TOK,
+            EngineConfig(
+                max_batch=4, prefill_buckets=(16,),
+                paged=PagedCacheConfig(num_pages=24, page_size=4,
+                                       max_pages_per_seq=8),
+                attention_impl="xla", kv_quant="int8",
+                pp_microbatches=2,
+            ),
+            dtype=jnp.float32, mesh=make_mesh(MeshSpec(stage=2)),
+        )

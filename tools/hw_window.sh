@@ -91,6 +91,11 @@ step 8b_int4 1200 env BENCH_MODEL=llama-3-8b BENCH_QUANT=int4 BENCH_BATCH=32 pyt
 #      KV-path efficiency directly.
 step longctx_2k 900 env BENCH_PROMPT=2048 BENCH_BATCH=16 BENCH_NEW=128 python bench.py
 step longctx_4k 900 env BENCH_PROMPT=4096 BENCH_BATCH=8 BENCH_NEW=128 python bench.py
+# int8 KV pool at long context: KV reads dominate the step there, so
+# halving KV bytes should show directly (and doubled KV capacity allows
+# 2x the batch at fixed HBM)
+step longctx_2k_kvint8 900 env BENCH_PROMPT=2048 BENCH_BATCH=16 BENCH_NEW=128 BENCH_KV_QUANT=int8 BENCH_IMPL=xla python bench.py
+step longctx_2k_kvint8_b32 900 env BENCH_PROMPT=2048 BENCH_BATCH=32 BENCH_NEW=128 BENCH_KV_QUANT=int8 BENCH_IMPL=xla python bench.py
 
 # 3d. speculative decoding on silicon: self-quantized draft (honest
 #     sub-1.0 acceptance from int8/int4-vs-bf16 argmax disagreement)
