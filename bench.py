@@ -41,6 +41,7 @@ compile amortization instead of asserting it).
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -48,7 +49,29 @@ import threading
 import time
 
 
+@functools.lru_cache(maxsize=1)
+def _git_rev() -> str:
+    """Short commit id stamped into every record so a number can always
+    be traced to the exact tree that produced it; empty when git is
+    unavailable (the record must never fail over provenance). Cached —
+    the rev cannot change within a run, and a wedged git must not stall
+    every emission."""
+    try:
+        import subprocess
+
+        return subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            text=True, stderr=subprocess.DEVNULL, timeout=5,
+        ).strip()
+    except Exception:
+        return ""
+
+
 def _emit(obj) -> None:
+    rev = _git_rev()
+    if rev:
+        obj.setdefault("rev", rev)
     print(json.dumps(obj), flush=True)
 
 
