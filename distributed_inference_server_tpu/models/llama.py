@@ -24,6 +24,7 @@ Design, TPU-first:
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -414,6 +415,26 @@ def forward(
     return _unembed(params, cfg, h), KVCache(k=new_k, v=new_v)
 
 
+def pallas_tuning() -> Tuple[int, int, int]:
+    """Kernel tuning knobs from env — the SINGLE parse site shared by the
+    serving builder (``make_pallas_attend``) and the in-window probe
+    (``tools/kernel_probe.py``), so a sweep tunes exactly the program
+    serving launches and the two cannot drift.
+
+    Returns (decode_pages_per_block, prefill_pages_per_block,
+    prefill_q_block). ``DIS_TPU_PALLAS_PAGES_PER_BLOCK`` sets both
+    phases; the per-phase ``..._DECODE_PAGES_PER_BLOCK`` /
+    ``..._PREFILL_PAGES_PER_BLOCK`` override it (the best DMA depth can
+    differ between one-query decode and tiled prefill). Unset = the
+    kernels' shipped defaults (8 pages, 128 queries)."""
+    env = os.environ
+    shared = env.get("DIS_TPU_PALLAS_PAGES_PER_BLOCK", "8")
+    dpb = int(env.get("DIS_TPU_PALLAS_DECODE_PAGES_PER_BLOCK", shared))
+    ppb = int(env.get("DIS_TPU_PALLAS_PREFILL_PAGES_PER_BLOCK", shared))
+    qb = int(env.get("DIS_TPU_PALLAS_QBLOCK", "128"))
+    return dpb, ppb, qb
+
+
 def make_pallas_attend(page_size: int, softcap: float, decode_step: bool,
                        interpret=None):
     """Build the per-shard Pallas attend callable — the EXACT kernel-arg
@@ -433,18 +454,21 @@ def make_pallas_attend(page_size: int, softcap: float, decode_step: bool,
         paged_attention_prefill,
     )
 
+    dpb, ppb, qb = pallas_tuning()
     if decode_step:
         def fn(q3, k_layer, v_layer, tables, valid, w):
             return paged_attention_decode(
                 q3, k_layer, v_layer, tables, valid,
-                page_size=page_size, sliding_window=w,
+                page_size=page_size, pages_per_block=dpb,
+                sliding_window=w,
                 attn_softcap=softcap, interpret=interpret,
             )
     else:
         def fn(q4, k_layer, v_layer, tables, valid, qs, w):
             return paged_attention_prefill(
                 q4, k_layer, v_layer, tables, qs, valid,
-                page_size=page_size, sliding_window=w,
+                page_size=page_size, q_block=qb, pages_per_block=ppb,
+                sliding_window=w,
                 attn_softcap=softcap, interpret=interpret,
             )
     return fn

@@ -61,6 +61,11 @@ step hbm_probe_b256 300 python tools/hbm_probe.py 256
 #     r4 datapoint had pallas prefill at 0.66x; find where it wins)
 step kp_long_ctx 580 env KP_PAGES_PER_SEQ=64 KP_CTX=1024 KP_PREFILL_T=512 python tools/kernel_probe.py
 step kp_vlong_ctx 580 env KP_PAGES_PER_SEQ=256 KP_CTX=4096 KP_PREFILL_T=512 KP_BATCH=8 python tools/kernel_probe.py
+# prefill-kernel tuning at long ctx (0.66x XLA at short ctx in the r4
+# first window): bigger DMA blocks / smaller query tiles via the env
+# knobs that feed the EXACT serving builder (make_pallas_attend)
+step kp_long_pb16 580 env KP_PAGES_PER_SEQ=64 KP_CTX=1024 KP_PREFILL_T=512 DIS_TPU_PALLAS_PREFILL_PAGES_PER_BLOCK=16 python tools/kernel_probe.py
+step kp_long_qb64 580 env KP_PAGES_PER_SEQ=64 KP_CTX=1024 KP_PREFILL_T=512 DIS_TPU_PALLAS_QBLOCK=64 python tools/kernel_probe.py
 
 # 1c. pure-device decode block (no engine): device-vs-host attribution
 step decode_probe_b64 580 python tools/decode_probe.py 64 272 64

@@ -36,6 +36,7 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    from distributed_inference_server_tpu.models import llama
     from distributed_inference_server_tpu.ops.attention import gqa_attention
     from distributed_inference_server_tpu.ops.pallas import (
         paged_attention_decode,
@@ -88,8 +89,12 @@ def main() -> int:
     for name, kernel_fn, xla_fn in (
         (
             "decode",
+            # tuning knobs come from the ONE shared parse site the
+            # serving builder uses (llama.pallas_tuning), so a probe
+            # sweep tunes exactly what serving launches
             lambda: paged_attention_decode(
                 q1, pool_k, pool_v, tables, valid, page_size=ps,
+                pages_per_block=llama.pallas_tuning()[0],
                 interpret=False,
             ),
             # jitted like the kernel wrappers, so the comparison is the
@@ -102,6 +107,8 @@ def main() -> int:
             "prefill",
             lambda: paged_attention_prefill(
                 qT, pool_k, pool_v, tables, qstart, valid, page_size=ps,
+                q_block=llama.pallas_tuning()[2],
+                pages_per_block=llama.pallas_tuning()[1],
                 interpret=False,
             ),
             jax.jit(lambda: _xla_prefill(
