@@ -63,25 +63,21 @@ def _decode_kernel(
     valid_ref,  # [B] valid token count per row
     window_ref,  # [1] sliding window (0 = full causal; runtime so Gemma-2
     #              per-layer windows flow through one compiled program)
-    # tensor refs
-    qbd_ref,  # [1, H, KV*D] this row's BLOCK-DIAGONAL query (VMEM)
-    k_hbm,  # [num_pages, page_size, KV*D] full K pool (HBM)
-    v_hbm,  # [num_pages, page_size, KV*D] full V pool (HBM)
-    out_ref,  # [1, H, KV*D] (VMEM; per-head diagonal lanes valid)
-    # scratch
-    k_buf,  # [2, PB, page_size, KV*D] double-buffered K pages
-    v_buf,  # [2, PB, page_size, KV*D]
-    sem_k,  # DMA semaphores [2, PB]
-    sem_v,  # [2, PB]
-    m_ref,  # [H, LANES] f32 running max
-    l_ref,  # [H, LANES] f32 running denominator
-    acc_ref,  # [H, KV*D] f32 running numerator
-    *,
+    # tensor refs, then scratch — layout depends on `quantized`:
+    #   dense:  qbd, k_hbm, v_hbm, out,
+    #           k_buf, v_buf, sem_k, sem_v, m, l, acc
+    #   int8:   qbd, k_hbm, v_hbm, ks_hbm, vs_hbm, out,
+    #           k_buf, v_buf, ks_buf, vs_buf,
+    #           sem_k, sem_v, sem_ks, sem_vs, m, l, acc
+    # where ks/vs are the QuantPool scale pages [num_pages, ps, KV] f32
+    # and k/v carry int8 codes (engine/kv_cache.py QuantPool layout)
+    *refs,
     page_size: int,
     pages_per_block: int,
     num_page_slots: int,
     head_dim: int,
     attn_softcap: float = 0.0,
+    quantized: bool = False,
 ):
     """v3 body: block-diagonal GQA — every shape Mosaic-tile-aligned.
 
@@ -92,7 +88,25 @@ def _decode_kernel(
     dimension anywhere — the per-head lane slices of v2 were 64-wide for
     head_dim-64 models, which Mosaic rejects (tiling is 128). The extra
     FLOPs (contraction over KV*D instead of D) are irrelevant: decode
-    attention is DMA-bound, the MXU idles either way."""
+    attention is DMA-bound, the MXU idles either way.
+
+    Int8 mode (``quantized``): K/V pages carry int8 codes and separate
+    per-(token, head) f32 scale pages ride their own (much smaller) DMAs —
+    HALF the attention DMA bytes, the bound this kernel lives under. The
+    codes are cast to bf16 for the MXU and the scales are folded in
+    WITHOUT any lane-crossing reshape: score[h, t] needs k_scale[t, kv(h)]
+    and the PV accumulation needs probs[h, t] * v_scale[t, kv(h)], both
+    of which are one [H, KV] x [KV, T] one-hot MXU dot per block (the
+    head->kv map) multiplied elementwise into the score/prob matrix.
+    Cross-head lanes of the accumulator pick up wrongly-scaled garbage —
+    exactly the lanes the wrapper already discards."""
+    if quantized:
+        (qbd_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, out_ref,
+         k_buf, v_buf, ks_buf, vs_buf, sem_k, sem_v, sem_ks, sem_vs,
+         m_ref, l_ref, acc_ref) = refs
+    else:
+        (qbd_ref, k_hbm, v_hbm, out_ref,
+         k_buf, v_buf, sem_k, sem_v, m_ref, l_ref, acc_ref) = refs
     b = pl.program_id(0)
     PB = pages_per_block
     blk_tokens = PB * page_size
@@ -124,6 +138,13 @@ def _decode_kernel(
             pltpu.make_async_copy(
                 v_hbm.at[page], v_buf.at[slot, i], sem_v.at[slot, i]
             ).start()
+            if quantized:
+                pltpu.make_async_copy(
+                    ks_hbm.at[page], ks_buf.at[slot, i], sem_ks.at[slot, i]
+                ).start()
+                pltpu.make_async_copy(
+                    vs_hbm.at[page], vs_buf.at[slot, i], sem_vs.at[slot, i]
+                ).start()
 
     def wait_block(slot, blk):
         for i in range(PB):
@@ -135,10 +156,27 @@ def _decode_kernel(
             pltpu.make_async_copy(
                 v_hbm.at[page], v_buf.at[slot, i], sem_v.at[slot, i]
             ).wait()
+            if quantized:
+                pltpu.make_async_copy(
+                    ks_hbm.at[page], ks_buf.at[slot, i], sem_ks.at[slot, i]
+                ).wait()
+                pltpu.make_async_copy(
+                    vs_hbm.at[page], vs_buf.at[slot, i], sem_vs.at[slot, i]
+                ).wait()
 
     @pl.when(num_blocks > first_block)
     def _run():
         qbd = qbd_ref[0] * (1.0 / (head_dim**0.5))  # [H, KV*D]
+        if quantized:
+            # head -> kv-head map as a one-hot [H, KV] (static iota
+            # compare): row h = kv*G + g selects column kv
+            H, CD = qbd_ref.shape[1], qbd_ref.shape[2]
+            KV = CD // head_dim
+            G = H // KV
+            head_onehot = (
+                lax.broadcasted_iota(jnp.int32, (H, KV), 0) // G
+                == lax.broadcasted_iota(jnp.int32, (H, KV), 1)
+            ).astype(jnp.float32)
         start_block(lax.rem(first_block, 2), first_block)
 
         def loop(blk, _):
@@ -153,6 +191,9 @@ def _decode_kernel(
 
             k = k_buf[slot].reshape(blk_tokens, -1)  # [T, KV*D]
             v = v_buf[slot].reshape(blk_tokens, -1)
+            if quantized:
+                k = k.astype(jnp.bfloat16)
+                v = v.astype(jnp.bfloat16)
 
             # [H, T] scores in ONE MXU dot; block-diagonal q rows contract
             # only their own head's lanes
@@ -160,6 +201,15 @@ def _decode_kernel(
                 qbd.astype(k.dtype), k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            if quantized:
+                # fold k scales in: score[h, t] *= k_scale[t, kv(h)],
+                # realized as onehot[H, KV] @ kscale[T, KV]^T — one tiny
+                # MXU dot, no lane-crossing reshape
+                ksc = ks_buf[slot].reshape(blk_tokens, -1)  # [T, KV]
+                s = s * lax.dot_general(
+                    head_onehot, ksc, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
             if attn_softcap:
                 s = jnp.tanh(s * (1.0 / attn_softcap)) * attn_softcap
             token_ids = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -173,6 +223,16 @@ def _decode_kernel(
             alpha = jnp.exp(m_prev - m_new)
             probs = jnp.exp(s - m_new)  # [H, T] f32
             l_new = l_prev * alpha + jnp.sum(probs, -1, keepdims=True)
+            if quantized:
+                # fold v scales into the probabilities: row h's own-head
+                # lanes then accumulate sum(p * v_scale * codes) exactly;
+                # cross-head lanes get wrongly-scaled garbage the wrapper
+                # discards anyway
+                vsc = vs_buf[slot].reshape(blk_tokens, -1)  # [T, KV]
+                probs = probs * lax.dot_general(
+                    head_onehot, vsc, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
             # [H, KV*D]: row h accumulates its own head's V in the diagonal
             # lane block (other lanes carry cross-head garbage the wrapper
             # discards)
@@ -500,7 +560,10 @@ def paged_attention_decode(
     Args:
       q: [B, H, D] one query per row (the token being decoded).
       pool_k, pool_v: [num_slots, KV, D] one layer's flat page pool
-        (num_slots = num_pages * page_size — engine/kv_cache.py layout).
+        (num_slots = num_pages * page_size — engine/kv_cache.py layout),
+        or ``ops.quant.QuantPool`` (int8 codes + f32 per-vector scales):
+        the kernel then DMAs HALF the attention bytes and folds the
+        scales into the score/probability matrices on the fly.
       page_tables: [B, P] page ids per row (entries past the row's last
         page may be any value; they are clamped to the pool and masked).
       kv_valid_len: [B] valid tokens per row, INCLUDING the just-written
@@ -517,8 +580,12 @@ def paged_attention_decode(
 
     Returns: [B, H, D] attention outputs in q.dtype.
     """
+    from distributed_inference_server_tpu.ops.quant import QuantPool
+
+    quantized = isinstance(pool_k, QuantPool)
+    k_arr = pool_k.data if quantized else pool_k
     B, H, D = q.shape
-    num_slots, KV, _ = pool_k.shape
+    num_slots, KV, _ = k_arr.shape
     G = H // KV
     CD = KV * D
     num_pages = num_slots // page_size
@@ -535,8 +602,28 @@ def paged_attention_decode(
     qbd = jnp.einsum(
         "bkgd,kj->bkgjd", q.reshape(B, KV, G, D), eye
     ).reshape(B, H, CD)
-    k_pages = pool_k.reshape(num_pages, page_size, CD)
-    v_pages = pool_v.reshape(num_pages, page_size, CD)
+    if quantized:
+        k_pages = pool_k.data.reshape(num_pages, page_size, CD)
+        v_pages = pool_v.data.reshape(num_pages, page_size, CD)
+        ks_pages = pool_k.scale.reshape(num_pages, page_size, KV)
+        vs_pages = pool_v.scale.reshape(num_pages, page_size, KV)
+        extra_in = [ks_pages, vs_pages]
+        extra_in_specs = [
+            pl.BlockSpec(memory_space=pl.ANY),  # K scales stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # V scales stay in HBM
+        ]
+        extra_scratch = [
+            pltpu.VMEM((2, PB, page_size, KV), jnp.float32),
+            pltpu.VMEM((2, PB, page_size, KV), jnp.float32),
+        ]
+        extra_sems = [
+            pltpu.SemaphoreType.DMA((2, PB)),
+            pltpu.SemaphoreType.DMA((2, PB)),
+        ]
+    else:
+        k_pages = pool_k.reshape(num_pages, page_size, CD)
+        v_pages = pool_v.reshape(num_pages, page_size, CD)
+        extra_in, extra_in_specs, extra_scratch, extra_sems = [], [], [], []
     tables = jnp.clip(page_tables.astype(jnp.int32), 0, num_pages - 1)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -546,13 +633,16 @@ def paged_attention_decode(
             pl.BlockSpec((1, H, CD), lambda b, t, vl, w: (b, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),  # K pool stays in HBM
             pl.BlockSpec(memory_space=pl.ANY),  # V pool stays in HBM
+            *extra_in_specs,
         ],
         out_specs=pl.BlockSpec((1, H, CD), lambda b, t, vl, w: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, PB, page_size, CD), pool_k.dtype),
-            pltpu.VMEM((2, PB, page_size, CD), pool_v.dtype),
+            pltpu.VMEM((2, PB, page_size, CD), k_arr.dtype),
+            pltpu.VMEM((2, PB, page_size, CD), k_arr.dtype),
+            *extra_scratch,
             pltpu.SemaphoreType.DMA((2, PB)),
             pltpu.SemaphoreType.DMA((2, PB)),
+            *extra_sems,
             pltpu.VMEM((H, _LANES), jnp.float32),
             pltpu.VMEM((H, _LANES), jnp.float32),
             pltpu.VMEM((H, CD), jnp.float32),
@@ -567,6 +657,7 @@ def paged_attention_decode(
             num_page_slots=P,
             head_dim=D,
             attn_softcap=attn_softcap,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, CD), q.dtype),
@@ -579,12 +670,12 @@ def paged_attention_decode(
         cost_estimate=pl.CostEstimate(
             flops=4 * B * H * P * page_size * CD,
             bytes_accessed=2 * B * KV * P * page_size * D
-            * pool_k.dtype.itemsize,
+            * k_arr.dtype.itemsize,
             transcendentals=B * H * P * page_size,
         ),
     )(tables, kv_valid_len.astype(jnp.int32),
       jnp.asarray(sliding_window, jnp.int32).reshape(1),
-      qbd, k_pages, v_pages)
+      qbd, k_pages, v_pages, *extra_in)
     # extract each head's diagonal lane block (the rest is cross-head
     # garbage by construction)
     out = jnp.einsum(

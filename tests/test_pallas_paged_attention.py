@@ -319,3 +319,43 @@ class TestPrefillKernel:
             np.asarray(outs["xla"][1]), np.asarray(outs["pallas"][1]),
             rtol=1e-6, atol=1e-6,
         )
+
+
+@pytest.mark.parametrize(
+    "B,H,KV,D,P",
+    [
+        (4, 8, 4, 16, 4),   # GQA, ragged
+        (1, 16, 2, 64, 2),  # heavy grouping
+    ],
+)
+def test_kernel_int8_pool_matches_dequantized_reference(B, H, KV, D, P):
+    """QuantPool decode (int8 codes + scale pages, scales folded into the
+    score/probability matrices in-kernel) must match the XLA reference
+    attention run over the DEQUANTIZED pool exactly — the quantization
+    error itself cancels out of the comparison."""
+    from distributed_inference_server_tpu.ops.quant import (
+        QuantPool,
+        dequantize_kv,
+        quantize_kv,
+    )
+
+    rng = jax.random.PRNGKey(B * 77 + H)
+    q, pk, pv, tables, valid = _make_case(rng, B, H, KV, D, num_pages=16, P=P)
+    kq, ks = quantize_kv(pk)
+    vq, vs = quantize_kv(pv)
+    qpool_k = QuantPool(kq, ks)
+    qpool_v = QuantPool(vq, vs)
+    got = paged_attention_decode(
+        q, qpool_k, qpool_v, tables, valid, page_size=PAGE, interpret=True
+    )
+    want = _reference(
+        q,
+        dequantize_kv(kq, ks, jnp.float32),
+        dequantize_kv(vq, vs, jnp.float32),
+        tables, valid,
+    )
+    # kernel casts codes to bf16 and folds scales in f32; the reference
+    # dequantizes to f32 directly — tolerance covers the bf16 cast only
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2
+    )

@@ -67,6 +67,11 @@ step kp_vlong_ctx 580 env KP_PAGES_PER_SEQ=256 KP_CTX=4096 KP_PREFILL_T=512 KP_B
 step kp_long_pb16 580 env KP_PAGES_PER_SEQ=64 KP_CTX=1024 KP_PREFILL_T=512 DIS_TPU_PALLAS_PREFILL_PAGES_PER_BLOCK=16 python tools/kernel_probe.py
 step kp_long_qb64 580 env KP_PAGES_PER_SEQ=64 KP_CTX=1024 KP_PREFILL_T=512 DIS_TPU_PALLAS_QBLOCK=64 python tools/kernel_probe.py
 
+# 1b2. int8-pool decode kernel first compile + timing vs bf16-XLA-gather
+#      (half the attention DMA bytes; long ctx is where it pays)
+step kp_int8_kv 580 env KP_KV_QUANT=1 python tools/kernel_probe.py
+step kp_int8_kv_long 580 env KP_KV_QUANT=1 KP_PAGES_PER_SEQ=64 KP_CTX=1024 python tools/kernel_probe.py
+
 # 1c. pure-device decode block (no engine): device-vs-host attribution
 step decode_probe_b64 580 python tools/decode_probe.py 64 272 64
 step decode_probe_b128 580 python tools/decode_probe.py 128 272 64

@@ -65,6 +65,24 @@ def main() -> int:
         rng.permutation(num_pages)[: B * P].reshape(B, P).astype(np.int32)
     )
     valid = jnp.full((B,), min(ctx, P * ps), jnp.int32)
+    if os.environ.get("KP_KV_QUANT") == "1":
+        # probe the int8-pool decode kernel variant: half the attention
+        # DMA bytes; scales fold into the score/prob matrices in-kernel
+        from distributed_inference_server_tpu.ops.quant import (
+            QuantPool,
+            quantize_kv,
+        )
+
+        kq, kscale = quantize_kv(pool_k)
+        vq, vscale = quantize_kv(pool_v)
+        # XLA comparison path keeps the original dense bf16 pools (the
+        # honest alternative: bf16 gather vs int8 kernel); the prefill
+        # kernel has no int8 variant, so only the decode probe quantizes
+        dense_k, dense_v = pool_k, pool_v
+        pool_k = QuantPool(kq, kscale)
+        pool_v = QuantPool(vq, vscale)
+    else:
+        dense_k, dense_v = pool_k, pool_v
     q1 = jnp.asarray(rng.standard_normal((B, H, D), np.float32), dtype)
     qT = jnp.asarray(
         rng.standard_normal((B, T, H, D), np.float32), dtype
@@ -100,25 +118,27 @@ def main() -> int:
             # jitted like the kernel wrappers, so the comparison is the
             # fused program the production XLA path actually runs
             jax.jit(lambda: _xla_decode(
-                jnp, gqa_attention, q1, pool_k, pool_v, tables, valid, ps
+                jnp, gqa_attention, q1, dense_k, dense_v, tables, valid, ps
             )),
         ),
         (
             "prefill",
             lambda: paged_attention_prefill(
-                qT, pool_k, pool_v, tables, qstart, valid, page_size=ps,
+                qT, dense_k, dense_v, tables, qstart, valid, page_size=ps,
                 q_block=llama.pallas_tuning()[2],
                 pages_per_block=llama.pallas_tuning()[1],
                 interpret=False,
             ),
             jax.jit(lambda: _xla_prefill(
-                jnp, gqa_attention, qT, pool_k, pool_v, tables, qstart,
+                jnp, gqa_attention, qT, dense_k, dense_v, tables, qstart,
                 valid, ps
             )),
         ),
     ):
         rec = {"kernel": name, "B": B, "H": H, "KV": KV, "D": D,
                "page_size": ps, "pages_per_seq": P}
+        if name == "decode" and os.environ.get("KP_KV_QUANT") == "1":
+            rec["kv_quant"] = "int8"
         try:
             enq, blk = timeit(kernel_fn)
             rec.update(pallas_enqueue_ms=round(enq, 3),
