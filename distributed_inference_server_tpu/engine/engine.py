@@ -293,8 +293,12 @@ class LLMEngine:
             # (value validation itself lives in PagedKVState.create)
             if self.ecfg.attention_impl == "pallas":
                 raise ValueError(
-                    "kv_quant='int8' requires the XLA attention path; "
-                    "attention_impl='pallas' cannot read quantized pools"
+                    "kv_quant='int8' serves on the XLA attention path "
+                    "for now: the int8-pool decode kernel exists "
+                    "(ops/pallas/paged_attention.py) but is not wired "
+                    "into serving until proven on real silicon "
+                    "(tools/kernel_probe.py KP_KV_QUANT=1), and the "
+                    "prefill kernel has no int8 variant"
                 )
             if mesh is not None and (
                 mesh.shape.get("stage", 1) > 1

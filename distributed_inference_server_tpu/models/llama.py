@@ -607,9 +607,11 @@ def paged_forward(
     if use_pallas:
         if kv_quantized:
             raise ValueError(
-                "attention_impl='pallas' does not support quantized KV "
-                "pools (the kernels DMA raw pool pages); the engine "
-                "forces the XLA path when kv_quant is enabled"
+                "quantized KV pools are not wired into the Pallas "
+                "serving path yet: the decode kernel supports QuantPool "
+                "(ops/pallas/paged_attention.py) pending silicon proof, "
+                "the prefill kernel does not; the engine serves "
+                "kv_quant on the XLA path"
             )
         if page_size <= 0:
             raise ValueError("attention_impl='pallas' requires page_size")

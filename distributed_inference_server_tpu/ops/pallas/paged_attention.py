@@ -584,6 +584,7 @@ def paged_attention_decode(
 
     quantized = isinstance(pool_k, QuantPool)
     k_arr = pool_k.data if quantized else pool_k
+    v_arr = pool_v.data if quantized else pool_v
     B, H, D = q.shape
     num_slots, KV, _ = k_arr.shape
     G = H // KV
@@ -638,7 +639,7 @@ def paged_attention_decode(
         out_specs=pl.BlockSpec((1, H, CD), lambda b, t, vl, w: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, PB, page_size, CD), k_arr.dtype),
-            pltpu.VMEM((2, PB, page_size, CD), k_arr.dtype),
+            pltpu.VMEM((2, PB, page_size, CD), v_arr.dtype),
             *extra_scratch,
             pltpu.SemaphoreType.DMA((2, PB)),
             pltpu.SemaphoreType.DMA((2, PB)),

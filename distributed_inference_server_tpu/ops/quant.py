@@ -224,8 +224,11 @@ class QuantPool(NamedTuple):
     ``codes * scale`` at attention time. A pytree, so ``lax.scan`` over
     stacked layers, buffer donation, and device_put thread it like a
     plain array; XLA-gather attention dequantizes after the page-granular
-    gather. The Pallas kernels DMA raw pool pages and do not support it —
-    the engine forces the XLA attention path when kv_quant is enabled.
+    gather. The Pallas DECODE kernel also accepts it (int8 page DMA with
+    in-kernel scale folding, ops/pallas/paged_attention.py), but serving
+    keeps the XLA path for kv_quant until that variant is proven on real
+    silicon (tools/kernel_probe.py KP_KV_QUANT=1 is the proof step); the
+    prefill kernel has no int8 variant.
 
     data:  [..., num_slots, KV, D] int8 codes
     scale: [..., num_slots, KV] f32 per-vector scales
