@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Tuple
@@ -1041,8 +1042,34 @@ class LLMEngine:
         decode hot loop on Pallas."""
         impl = self.ecfg.attention_impl
         if self.ecfg.kv_quant != "none":
-            # quantized pools are XLA-gather-only (the kernels DMA raw
-            # pages); "pallas" was rejected at construction
+            # quantized pools serve on the XLA gather path, EXCEPT the
+            # experimental opt-in: with attention_impl='auto' (an
+            # explicit 'xla' pin always wins) and no tensor axis
+            # (shard_pallas_attend's pool specs can't describe a
+            # QuantPool yet — under TP the probe would die on the spec
+            # rank before Mosaic ever judged the kernel),
+            # DIS_TPU_KV_QUANT_PALLAS=1 lets the auto probe judge the
+            # int8-pool decode kernel with QuantPool-shaped pools.
+            # Prefill stays XLA either way — no int8 prefill kernel.
+            # Explicit 'pallas' was rejected at construction.
+            tensor = (
+                self.mesh.shape.get("tensor", 1)
+                if self.mesh is not None else 1
+            )
+            if (
+                impl == "auto"
+                and tensor == 1
+                and os.environ.get("DIS_TPU_KV_QUANT_PALLAS") == "1"
+            ):
+                if self._auto_impl is None:
+                    if jax.default_backend() != "tpu":
+                        self._auto_impl = ("xla", "xla")
+                    else:
+                        ok_decode, _ = self._probe_pallas()
+                        self._auto_impl = (
+                            "pallas" if ok_decode else "xla", "xla"
+                        )
+                return self._auto_impl
             return "xla"
         if impl != "auto":
             return impl
@@ -1129,6 +1156,17 @@ class LLMEngine:
             pool = jax.ShapeDtypeStruct(
                 (slots, kv, cfg.head_dim), self.dtype
             )
+            if self.ecfg.kv_quant == "int8":
+                # probe with QuantPool-shaped pools so Mosaic judges the
+                # int8 kernel variant serving would launch; the prefill
+                # lowering raises (no int8 prefill kernel) and resolves
+                # to the XLA path via the same try_compile catch
+                pool = QuantPool(
+                    jax.ShapeDtypeStruct(
+                        (slots, kv, cfg.head_dim), jnp.int8
+                    ),
+                    jax.ShapeDtypeStruct((slots, kv), jnp.float32),
+                )
 
             def lower_kernel(decode_step, q_shape, B):
                 tables, valid = tv(B)

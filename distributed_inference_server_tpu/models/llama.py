@@ -605,17 +605,15 @@ def paged_forward(
     kv_quantized = isinstance(pool_k, QuantPool)
     use_pallas = attention_impl == "pallas"
     if use_pallas:
-        if kv_quantized:
-            raise ValueError(
-                "quantized KV pools are not wired into the Pallas "
-                "serving path yet: the decode kernel supports QuantPool "
-                "(ops/pallas/paged_attention.py) pending silicon proof, "
-                "the prefill kernel does not; the engine serves "
-                "kv_quant on the XLA path"
-            )
         if page_size <= 0:
             raise ValueError("attention_impl='pallas' requires page_size")
         decode_step = input_ids.shape[1] == 1
+        if kv_quantized and not decode_step:
+            raise ValueError(
+                "the Pallas chunked-prefill kernel has no int8-pool "
+                "variant; quantized prefill must take the XLA path "
+                "(the engine's kv_quant resolution does this)"
+            )
         # gather_slots rows are table[p]*page_size + offset by construction
         page_tables = gather_slots[:, ::page_size] // page_size
         if not decode_step:

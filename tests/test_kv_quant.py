@@ -195,3 +195,34 @@ def test_kv_quant_rejects_stage_seq_axes(tiny_params):
             ),
             dtype=jnp.float32, mesh=make_mesh(MeshSpec(stage=2)),
         )
+
+
+def test_kv_quant_pallas_env_resolution(tiny_params, monkeypatch):
+    """DIS_TPU_KV_QUANT_PALLAS=1: the auto resolution probes the int8
+    decode kernel (QuantPool-shaped pools) and serves decode on Pallas /
+    prefill on XLA when Mosaic accepts; without the flag kv_quant stays
+    XLA-only."""
+    monkeypatch.delenv("DIS_TPU_KV_QUANT_PALLAS", raising=False)
+    engine = _make_engine(tiny_params, attention_impl="auto")
+    assert engine._resolved_impl() == "xla"
+
+    monkeypatch.setenv("DIS_TPU_KV_QUANT_PALLAS", "1")
+    # an explicit XLA pin always wins over the experimental flag
+    pinned = _make_engine(tiny_params, attention_impl="xla")
+    assert pinned._resolved_impl() == "xla"
+    import jax as jax_mod
+
+    monkeypatch.setattr(jax_mod, "default_backend", lambda: "tpu")
+    # the engine resolves (and caches) during construction, so the probe
+    # must be patched on the CLASS before building
+    monkeypatch.setattr(
+        LLMEngine, "_probe_pallas", lambda self: (True, False)
+    )
+    engine2 = _make_engine(tiny_params, attention_impl="auto")
+    assert engine2._resolved_impl() == ("pallas", "xla")
+
+    monkeypatch.setattr(
+        LLMEngine, "_probe_pallas", lambda self: (False, False)
+    )
+    engine3 = _make_engine(tiny_params, attention_impl="auto")
+    assert engine3._resolved_impl() == ("xla", "xla")

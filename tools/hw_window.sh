@@ -106,6 +106,10 @@ step longctx_4k 900 env BENCH_PROMPT=4096 BENCH_BATCH=8 BENCH_NEW=128 python ben
 # 2x the batch at fixed HBM)
 step longctx_2k_kvint8 900 env BENCH_PROMPT=2048 BENCH_BATCH=16 BENCH_NEW=128 BENCH_KV_QUANT=int8 BENCH_IMPL=xla python bench.py
 step longctx_2k_kvint8_b32 900 env BENCH_PROMPT=2048 BENCH_BATCH=32 BENCH_NEW=128 BENCH_KV_QUANT=int8 BENCH_IMPL=xla python bench.py
+# experimental: int8 pool + the int8-pool PALLAS decode kernel end to
+# end ("auto" probes the quant kernel via DIS_TPU_KV_QUANT_PALLAS;
+# Mosaic rejection falls back to the XLA record above)
+step longctx_2k_kvint8_pallas 900 env BENCH_PROMPT=2048 BENCH_BATCH=16 BENCH_NEW=128 BENCH_KV_QUANT=int8 BENCH_IMPL=auto DIS_TPU_KV_QUANT_PALLAS=1 python bench.py
 
 # 3d. speculative decoding on silicon: self-quantized draft (honest
 #     sub-1.0 acceptance from int8/int4-vs-bf16 argmax disagreement)
