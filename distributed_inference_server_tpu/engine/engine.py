@@ -1044,21 +1044,16 @@ class LLMEngine:
         if self.ecfg.kv_quant != "none":
             # quantized pools serve on the XLA gather path, EXCEPT the
             # experimental opt-in: with attention_impl='auto' (an
-            # explicit 'xla' pin always wins) and no tensor axis
-            # (shard_pallas_attend's pool specs can't describe a
-            # QuantPool yet — under TP the probe would die on the spec
-            # rank before Mosaic ever judged the kernel),
+            # explicit 'xla' pin always wins),
             # DIS_TPU_KV_QUANT_PALLAS=1 lets the auto probe judge the
-            # int8-pool decode kernel with QuantPool-shaped pools.
-            # Prefill stays XLA either way — no int8 prefill kernel.
-            # Explicit 'pallas' was rejected at construction.
-            tensor = (
-                self.mesh.shape.get("tensor", 1)
-                if self.mesh is not None else 1
-            )
+            # int8-pool decode kernel with QuantPool-shaped pools —
+            # including under a tensor axis, where shard_pallas_attend
+            # carries per-leaf QuantPool specs (codes on KV heads,
+            # scales alongside). Prefill stays XLA either way — no int8
+            # prefill kernel. Explicit 'pallas' was rejected at
+            # construction.
             if (
                 impl == "auto"
-                and tensor == 1
                 and os.environ.get("DIS_TPU_KV_QUANT_PALLAS") == "1"
             ):
                 if self._auto_impl is None:
@@ -1176,7 +1171,10 @@ class LLMEngine:
                     pcfg.page_size, softcap, decode_step, interpret=False
                 )
                 if sm:
-                    fn = shard_pallas_attend(fn, self.mesh, decode_step)
+                    fn = shard_pallas_attend(
+                        fn, self.mesh, decode_step,
+                        kv_quantized=self.ecfg.kv_quant == "int8",
+                    )
                 if decode_step:
                     return jax.jit(fn).lower(q, pool, pool, tables, valid, w)
                 # q_start shares kv_valid_len's [B] i32 shape

@@ -474,7 +474,8 @@ def make_pallas_attend(page_size: int, softcap: float, decode_step: bool,
     return fn
 
 
-def shard_pallas_attend(fn, mesh, decode_step: bool):
+def shard_pallas_attend(fn, mesh, decode_step: bool,
+                        kv_quantized: bool = False):
     """shard_map-wrap a per-shard Pallas attend callable over ``mesh``:
     ``tensor`` splits query heads and the pools' KV-head axis, ``data``
     splits rows; the kernel body stays fully local (no collectives).
@@ -484,6 +485,9 @@ def shard_pallas_attend(fn, mesh, decode_step: bool):
     kv_valid_len, q_start, window)`` for chunked prefill
     (q = [B, T, H, D]); every per-row operand rides the specs so data
     shards see their own rows (closure capture would replicate).
+    ``kv_quantized`` pools (ops.quant.QuantPool) get per-leaf specs:
+    codes shard like the dense pool, scales [slots, KV] shard on the
+    same KV-head axis.
 
     Shared by ``paged_forward`` and the engine's AOT "auto" probe so the
     probe lowers the SAME shard_map program the serving path launches —
@@ -492,14 +496,19 @@ def shard_pallas_attend(fn, mesh, decode_step: bool):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from distributed_inference_server_tpu.ops.quant import QuantPool
+
     q_spec = (
         P("data", "tensor", None) if decode_step
         else P("data", None, "tensor", None)
     )
+    pool_spec = P(None, "tensor", None)  # pool layer [slots, KV, D]
+    if kv_quantized:
+        pool_spec = QuantPool(pool_spec, P(None, "tensor"))
     in_specs = [
         q_spec,  # q [B, H, D] / [B, T, H, D]
-        P(None, "tensor", None),  # pool layer [slots, KV, D]
-        P(None, "tensor", None),
+        pool_spec,
+        pool_spec,
         P("data", None),  # page tables [B, P]
         P("data"),  # kv_valid_len [B]
     ]
@@ -628,7 +637,8 @@ def paged_forward(
         )
         if mesh is not None and mesh.shape.get("tensor", 1) > 1:
             _attend_pallas = shard_pallas_attend(
-                _attend_pallas, mesh, decode_step
+                _attend_pallas, mesh, decode_step,
+                kv_quantized=kv_quantized,
             )
 
     def write_fn(layer, new):

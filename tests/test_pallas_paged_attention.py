@@ -359,3 +359,41 @@ def test_kernel_int8_pool_matches_dequantized_reference(B, H, KV, D, P):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2
     )
+
+
+def test_sharded_int8_kernel_matches_dequantized_reference():
+    """The QuantPool decode kernel under shard_map (tensor=2 splitting KV
+    heads, per-leaf QuantPool specs) matches the dequantized XLA
+    reference — the TP wiring the DIS_TPU_KV_QUANT_PALLAS serving path
+    launches."""
+    from distributed_inference_server_tpu.models.llama import (
+        make_pallas_attend,
+        shard_pallas_attend,
+    )
+    from distributed_inference_server_tpu.ops.quant import (
+        QuantPool,
+        dequantize_kv,
+        quantize_kv,
+    )
+    from distributed_inference_server_tpu.parallel import MeshSpec, make_mesh
+
+    B, H, KV, D, P = 4, 8, 4, 16, 4
+    rng = jax.random.PRNGKey(5)
+    q, pk, pv, tables, valid = _make_case(rng, B, H, KV, D, num_pages=16, P=P)
+    kq, ks = quantize_kv(pk)
+    vq, vs = quantize_kv(pv)
+    mesh = make_mesh(MeshSpec(tensor=2))
+    fn = shard_pallas_attend(
+        make_pallas_attend(PAGE, 0.0, True, interpret=True),
+        mesh, True, kv_quantized=True,
+    )
+    with jax.set_mesh(mesh):
+        got = fn(q, QuantPool(kq, ks), QuantPool(vq, vs), tables, valid,
+                 jnp.int32(0))
+    want = _reference(
+        q, dequantize_kv(kq, ks, jnp.float32),
+        dequantize_kv(vq, vs, jnp.float32), tables, valid,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2
+    )
