@@ -29,6 +29,9 @@ BENCH_QUANT (none|int8|int4 — weight-only; int8 fits 8B on one v5e:
 BENCH_HBM_GBPS (819, v5e HBM bandwidth for the roofline estimate printed
 alongside every hardware run: roofline tok/s = batch * BW / weight
 bytes — the weight-read bound a decode step cannot beat),
+BENCH_SHARED_PREFIX (0; >0 = first K prompt tokens identical across
+  requests, so later requests reuse the prefix pages — the TTFT delta vs
+  0 measures the prefix cache, and records carry the allocator hit rate),
 BENCH_DRAFT (none|same|self-int8|self-int4 — speculative decoding with a
   draft sharing the target's weights ("same": acceptance 1.0 ceiling) or a
   quantized copy of them ("self-int*": honest sub-1.0 acceptance from
@@ -125,6 +128,21 @@ def main() -> None:
     draft_mode = os.environ.get("BENCH_DRAFT", "none")
     gamma = int(os.environ.get("BENCH_GAMMA", "4"))
     kv_quant = os.environ.get("BENCH_KV_QUANT", "none")
+    # shared-prefix mode: every request's first K prompt tokens are
+    # identical, so requests after the first reuse the prefix pages
+    # (content-addressed page sharing — reference Req 4.1/Property 9);
+    # the TTFT delta vs BENCH_SHARED_PREFIX=0 is the prefix cache's
+    # measured value, and the record carries the allocator's hit rate
+    shared_prefix = int(os.environ.get("BENCH_SHARED_PREFIX", "0"))
+    if shared_prefix < 0:
+        _emit({
+            "metric": metric, "value": 0.0, "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "error": f"BENCH_SHARED_PREFIX must be >= 0, got {shared_prefix}",
+        })
+        sys.exit(2)
+    if shared_prefix > 0:
+        metric += f"_prefix{shared_prefix}"
     if kv_quant not in ("none", "int8"):
         _emit({
             "metric": metric, "value": 0.0, "unit": "tokens/s",
@@ -246,6 +264,14 @@ def main() -> None:
             max_pages_per_seq=pages_per_seq,
         )
         buckets = (prompt_len, max(256, prompt_len))
+
+    if 0 < shared_prefix < prompt_len:
+        # the post-prefix residual chunk needs its OWN prefill bucket:
+        # without it the residual pads up to the full prompt bucket and
+        # runs the exact same device program as an unshared prompt,
+        # reducing the measured "prefix cache benefit" to host-side page
+        # bookkeeping noise
+        buckets = tuple(sorted(set(buckets) | {prompt_len - shared_prefix}))
 
     if quant != "none":
         # quantized leaves are created directly (no dense intermediate):
@@ -379,9 +405,14 @@ def main() -> None:
     def run_once(use_impl: str) -> dict:
         engine = mk_engine(use_impl)
 
+        hi = min(cfg.vocab_size, 250)
+        prefix_ids = rng.integers(
+            1, hi, size=min(shared_prefix, prompt_len)
+        ).tolist()
+
         def add(rid: str, n_new: int):
-            ids = rng.integers(
-                1, min(cfg.vocab_size, 250), size=prompt_len
+            ids = prefix_ids + rng.integers(
+                1, hi, size=prompt_len - len(prefix_ids)
             ).tolist()
             engine.add_request(rid, ids, SamplingParams(
                 max_tokens=n_new, temperature=0.0, top_p=1.0))
@@ -450,6 +481,16 @@ def main() -> None:
             produced = drain(t0, ttfts)
             elapsed = time.perf_counter() - t0
         ttft_sorted = sorted(ttfts.values())
+        cache = None
+        if shared_prefix > 0:
+            cs = engine.cache_stats()
+            cache = {
+                "hits": cs.hits,
+                "misses": cs.misses,
+                "hit_rate": round(
+                    cs.hits / max(1, cs.hits + cs.misses), 4
+                ),
+            }
         spec = None
         ss = engine.spec_stats()
         if ss is not None:
@@ -465,6 +506,7 @@ def main() -> None:
             "tput": produced / elapsed,
             "total_tokens": produced,
             "spec": spec,
+            "cache": cache,
             "elapsed_s": round(elapsed, 3),
             "p50_ttft_s": round(
                 ttft_sorted[len(ttft_sorted) // 2], 3
@@ -540,6 +582,8 @@ def main() -> None:
         "model": cfg.name,
         **({"quant": quant} if quant != "none" else {}),
         **({"kv_quant": kv_quant} if kv_quant != "none" else {}),
+        **({"shared_prefix": shared_prefix, "prefix_cache": r["cache"]}
+           if r.get("cache") else {}),
         **({"draft": draft_mode, "spec": r["spec"]}
            if r.get("spec") else {}),
         "weight_bytes": weight_bytes,

@@ -118,6 +118,11 @@ step spec_selfint8 580 env BENCH_DRAFT=self-int8 python bench.py
 step spec_selfint4 580 env BENCH_DRAFT=self-int4 python bench.py
 step spec_same 580 env BENCH_DRAFT=same python bench.py
 
+# 3e. prefix cache on silicon (Req 4.1 / Property 9): 96 of 128 prompt
+#     tokens shared -> page-sharing prefill; TTFT delta vs the plain
+#     rate_rps run below is the cache's measured value
+step prefix96_rps 900 env BENCH_SHARED_PREFIX=96 BENCH_RATE_RPS=16 python bench.py
+
 # 4. TTFT table: steady-state arrivals + warmup-compile split
 step rate_rps 900 env BENCH_RATE_RPS=16 python bench.py
 step warmup 900 env BENCH_MEASURE_WARMUP=1 python bench.py
