@@ -141,8 +141,18 @@ def main() -> None:
             "error": f"BENCH_SHARED_PREFIX must be >= 0, got {shared_prefix}",
         })
         sys.exit(2)
-    if shared_prefix > 0:
-        metric += f"_prefix{shared_prefix}"
+    if shared_prefix > 0 and os.environ.get("BENCH_MEASURE_WARMUP") == "1":
+        # the warmup path builds its own unshared prompts; a record
+        # labelled _prefixK for a run that shared nothing would lie
+        _emit({
+            "metric": metric, "value": 0.0, "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "error": "BENCH_SHARED_PREFIX and BENCH_MEASURE_WARMUP are "
+                     "mutually exclusive (warmup prompts are unshared)",
+        })
+        sys.exit(2)
+    # metric suffix + residual bucket are added AFTER prompt_len and
+    # page_size are final (force_cpu clamps the prompt): see below
     if kv_quant not in ("none", "int8"):
         _emit({
             "metric": metric, "value": 0.0, "unit": "tokens/s",
@@ -265,13 +275,22 @@ def main() -> None:
         )
         buckets = (prompt_len, max(256, prompt_len))
 
-    if 0 < shared_prefix < prompt_len:
+    shared_prefix = min(shared_prefix, prompt_len)
+    if shared_prefix > 0:
+        metric += f"_prefix{shared_prefix}"
         # the post-prefix residual chunk needs its OWN prefill bucket:
         # without it the residual pads up to the full prompt bucket and
         # runs the exact same device program as an unshared prompt,
         # reducing the measured "prefix cache benefit" to host-side page
-        # bookkeeping noise
-        buckets = tuple(sorted(set(buckets) | {prompt_len - shared_prefix}))
+        # bookkeeping noise. Prefix matching shares whole PAGES only, so
+        # the real residual is prompt_len minus the matched full pages —
+        # and when every page would match, the engine holds one back
+        # (the divergence page), leaving a one-page residual.
+        matched = (shared_prefix // paged.page_size) * paged.page_size
+        resid = prompt_len - matched
+        if resid <= 0:
+            resid = paged.page_size
+        buckets = tuple(sorted(set(buckets) | {resid}))
 
     if quant != "none":
         # quantized leaves are created directly (no dense intermediate):

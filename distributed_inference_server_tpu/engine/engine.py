@@ -434,6 +434,12 @@ class LLMEngine:
         # jit caches
         # "auto" probe result: (decode_impl, prefill_impl) once resolved
         self._auto_impl: Optional[Tuple[str, str]] = None
+        # experimental int8-pool Pallas decode opt-in, captured ONCE at
+        # construction: re-reading the env per resolution call could flip
+        # the attention impl mid-serving after blocks were already built
+        self._kv_quant_pallas = (
+            os.environ.get("DIS_TPU_KV_QUANT_PALLAS") == "1"
+        )
         self._fwd = self._make_fwd()
         self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
         self._cp_fns: Dict[int, Callable] = {}
@@ -1052,10 +1058,7 @@ class LLMEngine:
             # scales alongside). Prefill stays XLA either way — no int8
             # prefill kernel. Explicit 'pallas' was rejected at
             # construction.
-            if (
-                impl == "auto"
-                and os.environ.get("DIS_TPU_KV_QUANT_PALLAS") == "1"
-            ):
+            if impl == "auto" and self._kv_quant_pallas:
                 if self._auto_impl is None:
                     if jax.default_backend() != "tpu":
                         self._auto_impl = ("xla", "xla")

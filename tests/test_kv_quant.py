@@ -226,3 +226,31 @@ def test_kv_quant_pallas_env_resolution(tiny_params, monkeypatch):
     )
     engine3 = _make_engine(tiny_params, attention_impl="auto")
     assert engine3._resolved_impl() == ("xla", "xla")
+
+
+def test_engine_int8_pallas_path_end_to_end(tiny_params, monkeypatch):
+    """The full DIS_TPU_KV_QUANT_PALLAS serving path — decode blocks
+    launching the int8-pool Pallas kernel over QuantPool pools — produces
+    the same greedy tokens as the int8 XLA path. Resolution is pinned
+    during construction (backend patched to 'tpu' + probe stubbed) and
+    then reverted, so the kernels execute in interpret mode on CPU."""
+    import jax as jax_mod
+
+    with monkeypatch.context() as m:
+        m.setenv("DIS_TPU_KV_QUANT_PALLAS", "1")
+        m.setattr(jax_mod, "default_backend", lambda: "tpu")
+        m.setattr(LLMEngine, "_probe_pallas", lambda self: (True, False))
+        eng = _make_engine(tiny_params, attention_impl="auto")
+    assert eng._resolved_impl() == ("pallas", "xla")
+
+    prompt = TOK.encode("pallas int8 path")
+    eng.add_request("p", prompt, SamplingParams(max_tokens=8,
+                                                temperature=0.0))
+    rp = _drain(eng)["p"]
+    assert rp["error"] is None
+
+    ref = _make_engine(tiny_params)  # int8 + XLA attention
+    ref.add_request("x", prompt, SamplingParams(max_tokens=8,
+                                                temperature=0.0))
+    rx = _drain(ref)["x"]
+    assert rp["tokens"] == rx["tokens"]
