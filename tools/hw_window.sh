@@ -53,6 +53,13 @@ fi
 #      on-silicon compile + timing
 step kernel_probe 580 python tools/kernel_probe.py
 
+# 0b. THE headline numbers first — a short flaky window must land these
+#     before anything exploratory: (a) the driver-shape 1B defaults run
+#     with the round-4 decode-cost fixes in the tree (sort-free sampler,
+#     argmax launches, page gather), (b) the BASELINE metric: 8B int8
+step bench_defaults 900 python bench.py
+step 8b_int8_early 1500 env BENCH_MODEL=llama-3-8b BENCH_QUANT=int8 BENCH_BATCH=32 python bench.py
+
 # 1. achievable HBM bandwidth + MXU (bounds every decode claim)
 step hbm_probe_b64 300 python tools/hbm_probe.py 64
 step hbm_probe_b256 300 python tools/hbm_probe.py 256
@@ -85,8 +92,7 @@ step b256 900 env BENCH_BATCH=256 python bench.py
 step pipeline2 580 env BENCH_PIPELINE=2 python bench.py
 step pipeline2_b128 580 env BENCH_PIPELINE=2 BENCH_BATCH=128 python bench.py
 
-# 3. the BASELINE metric: 8B int8 (compile is slow; give it room)
-step 8b_int8 1200 env BENCH_MODEL=llama-3-8b BENCH_QUANT=int8 BENCH_BATCH=32 python bench.py
+# (8B int8 moved to the top of the queue as 8b_int8_early)
 
 # 3b. prefill efficiency (80 ms per [16,128] launch at b64 = ~33% MXU):
 #     more rows per prefill program amortizes launch + pads less often
