@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Poll the relay and re-run the r4 hardware window whenever the device
+# Poll the relay and re-run the r5 hardware window whenever the device
 # recovers, until one attempt executes a critical mass of the queue.
 # The relay wedges unpredictably mid-window (TCP accepts, jax hangs), so
 # each attempt gets its own log; attempts where (almost) every step was
@@ -12,14 +12,17 @@ while :; do
       python -c "import jax; assert jax.devices()[0].platform != 'cpu'" \
       >/dev/null 2>&1; then
     ATTEMPT=$((ATTEMPT + 1))
-    LOG="/root/repo/HW_WINDOW_r04_try${ATTEMPT}.log"
+    LOG="/root/repo/HW_WINDOW_r05_try${ATTEMPT}.log"
     echo "relay alive $(date -u +%H:%M:%S); attempt ${ATTEMPT}" >"$LOG"
     bash tools/hw_window.sh "$LOG"
     # completed steps accumulate in the done-file across attempts (each
     # retry skips them); finish once nearly the whole queue has landed —
-    # a couple of permanently-failing steps must not spin us forever
-    total=$(grep -c "^step " tools/hw_window.sh || echo 0)
-    done_n=$(grep -c . /root/repo/.hw_done_r04 2>/dev/null || echo 0)
+    # a couple of permanently-failing steps must not spin us forever.
+    # NB: grep -c already prints 0 on no-match (it just exits 1), so no
+    # `|| echo 0` — that produced a two-line "0\n0" value (ADVICE r4).
+    total=$(grep -c "^step " tools/hw_window.sh || true)
+    done_n=$(grep -c . /root/repo/.hw_done_r05 2>/dev/null || true)
+    done_n=${done_n:-0}
     if [ "$done_n" -ge $((total - 2)) ]; then
       echo "queue complete: ${done_n}/${total} steps done" | tee -a "$LOG"
       exit 0

@@ -1,15 +1,15 @@
 #!/usr/bin/env bash
-# Sequential hardware-window measurement queue (round 4).
+# Sequential hardware-window measurement queue (round 5).
 # Run FOREGROUND, alone — the chip is a one-process claim. Each step is
 # its own process with a generous timeout; results append to the log.
 # Usage: bash tools/hw_window.sh [logfile]
 set -u
-LOG="${1:-/root/repo/HW_WINDOW_r04.log}"
+LOG="${1:-/root/repo/HW_WINDOW_r05.log}"
 # steps that completed (exit 0) in ANY attempt are recorded here and
 # skipped on retry — windows are short and flaky, so a rerun must spend
 # its minutes on NEW steps, not re-measuring the ones that already landed.
 # Delete this file to force a full re-measure.
-DONE="${HW_DONE_FILE:-/root/repo/.hw_done_r04}"
+DONE="${HW_DONE_FILE:-/root/repo/.hw_done_r05}"
 touch "$DONE"
 export PYTHONPATH=/root/repo:/root/.axon_site
 export JAX_PLATFORMS=axon  # never let a fresh shell fall back to CPU and
@@ -129,8 +129,10 @@ step spec_same 580 env BENCH_DRAFT=same python bench.py
 #     rate_rps run below is the cache's measured value
 step prefix96_rps 900 env BENCH_SHARED_PREFIX=96 BENCH_RATE_RPS=16 python bench.py
 
-# 4. TTFT table: steady-state arrivals + warmup-compile split
+# 4. TTFT table: steady-state arrivals at two rates (VERDICT r4 #7 asks
+#    for >=2 arrival rates) + warmup-compile split
 step rate_rps 900 env BENCH_RATE_RPS=16 python bench.py
+step rate_rps8 900 env BENCH_RATE_RPS=8 python bench.py
 step warmup 900 env BENCH_MEASURE_WARMUP=1 python bench.py
 
 echo "window complete $(date -u +%H:%M:%S)" | tee -a "$LOG"
