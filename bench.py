@@ -151,8 +151,7 @@ def main() -> None:
                      "mutually exclusive (warmup prompts are unshared)",
         })
         sys.exit(2)
-    # metric suffix + residual bucket are added AFTER prompt_len and
-    # page_size are final (force_cpu clamps the prompt): see below
+    # validation happens here (fail in milliseconds, before weight init)
     if kv_quant not in ("none", "int8"):
         _emit({
             "metric": metric, "value": 0.0, "unit": "tokens/s",
@@ -160,8 +159,6 @@ def main() -> None:
             "error": f"unknown BENCH_KV_QUANT {kv_quant!r}; known: none|int8",
         })
         sys.exit(2)
-    if kv_quant != "none":
-        metric += "_kv" + kv_quant
     if draft_mode not in ("none", "same", "self-int8", "self-int4"):
         # validate at parse time: an unknown value must fail in
         # milliseconds, not after minutes of 8B weight init inside a
@@ -173,6 +170,13 @@ def main() -> None:
                      "known: none|same|self-int8|self-int4",
         })
         sys.exit(2)
+    # kv/spec suffixes are clamp-INDEPENDENT (force_cpu never alters
+    # them), so they attach before the error paths below — an error
+    # record from a spec or kv-quant step must still carry the config
+    # it was measuring. Only _prefixK depends on post-clamp values
+    # (prompt_len, page_size) and attaches after the clamp.
+    if kv_quant != "none":
+        metric += "_kv" + kv_quant
     if draft_mode != "none":
         metric += "_spec_" + draft_mode.replace("self-", "self")
 

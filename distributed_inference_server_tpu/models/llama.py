@@ -546,6 +546,33 @@ def gather_kv_window(k_layer, v_layer, gather_slots, page_size: int):
     B, S = gather_slots.shape
     if page_size > 0 and k_layer.shape[0] % page_size == 0 \
             and S % page_size == 0:
+        if os.environ.get("DIS_TPU_DEBUG_GATHER") == "1" and not isinstance(
+            gather_slots, jax.core.Tracer
+        ):
+            # Debug-mode guard (ADVICE r4): shape divisibility cannot
+            # detect a caller whose slot rows are NOT page-aligned runs —
+            # such a caller would get wrong KV values silently. Concrete
+            # (non-traced) inputs — i.e. direct/test calls — verify the
+            # precondition here; inside jit the slots are tracers and the
+            # contract rests on the engine's table construction.
+            import numpy as np
+
+            slots = np.asarray(gather_slots).reshape(B, -1, page_size)
+            base = slots[:, :, :1]
+            is_run = (slots == base + np.arange(page_size)).all(axis=2)
+            # a consecutive run starting mid-page (e.g. [4..11] at
+            # page_size 8) is NOT table[p]*page_size+offset either — the
+            # fast path would silently gather page 0 instead of 4..11
+            is_run &= (slots[:, :, 0] % page_size) == 0
+            # sentinel pages (any slot >= pool size) clamp page-granular;
+            # their rows need not be runs
+            sentinel = (slots >= k_layer.shape[0]).any(axis=2)
+            bad = ~(is_run | sentinel)
+            assert not bad.any(), (
+                "gather_kv_window fast path requires page-aligned slot "
+                f"runs; misaligned rows at (batch, page)={np.argwhere(bad)[:4].tolist()} "
+                "— pass page_size=0 for arbitrary slot layouts"
+            )
         pt = gather_slots[:, ::page_size] // page_size  # [B, P]
         kp = k_layer.reshape(-1, page_size, *k_layer.shape[1:])
         vp = v_layer.reshape(-1, page_size, *v_layer.shape[1:])

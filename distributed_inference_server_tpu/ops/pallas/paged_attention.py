@@ -564,6 +564,13 @@ def paged_attention_decode(
         or ``ops.quant.QuantPool`` (int8 codes + f32 per-vector scales):
         the kernel then DMAs HALF the attention bytes and folds the
         scales into the score/probability matrices on the fly.
+        CAVEAT (quantized mode): the scale VMEM scratch and DMA tiles are
+        [page_size, KV] with KV typically far below the 128-lane Mosaic
+        tile — this lane width is the expected Mosaic rejection point on
+        real silicon (all CI runs use interpret=True). Serving gates the
+        kernel behind DIS_TPU_KV_QUANT_PALLAS=1 plus an AOT probe with
+        XLA fallback; land the KP_KV_QUANT=1 silicon probe before
+        widening the opt-in.
       page_tables: [B, P] page ids per row (entries past the row's last
         page may be any value; they are clamped to the pool and masked).
       kv_valid_len: [B] valid tokens per row, INCLUDING the just-written

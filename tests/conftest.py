@@ -18,6 +18,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Debug-mode precondition checks that are too hot for production (e.g.
+# gather_kv_window's page-aligned-run assertion) fire throughout the suite.
+os.environ.setdefault("DIS_TPU_DEBUG_GATHER", "1")
+
 # The axon sitecustomize calls jax.config.update("jax_platforms", "axon,cpu")
 # in every interpreter, overriding the env var — so the env override above is
 # not enough: force the config back to cpu-only before any backend

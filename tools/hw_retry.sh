@@ -20,10 +20,11 @@ while :; do
     # a couple of permanently-failing steps must not spin us forever.
     # NB: grep -c already prints 0 on no-match (it just exits 1), so no
     # `|| echo 0` — that produced a two-line "0\n0" value (ADVICE r4).
-    total=$(grep -c "^step " tools/hw_window.sh || true)
+    total=$(grep -c "^step " /root/repo/tools/hw_window.sh 2>/dev/null || true)
+    total=${total:-0}
     done_n=$(grep -c . /root/repo/.hw_done_r05 2>/dev/null || true)
     done_n=${done_n:-0}
-    if [ "$done_n" -ge $((total - 2)) ]; then
+    if [ "$total" -gt 0 ] && [ "$done_n" -ge $((total - 2)) ]; then
       echo "queue complete: ${done_n}/${total} steps done" | tee -a "$LOG"
       exit 0
     fi
