@@ -159,6 +159,13 @@ def main() -> None:
             "error": f"unknown BENCH_KV_QUANT {kv_quant!r}; known: none|int8",
         })
         sys.exit(2)
+    # suffixes attach immediately after their own validation so every
+    # later error record (unknown draft, relay down, watchdog, bad
+    # model) carries the already-validated config it was measuring;
+    # kv/spec are clamp-INDEPENDENT (force_cpu never alters them) —
+    # only _prefixK waits for the post-clamp prompt_len/page_size
+    if kv_quant != "none":
+        metric += "_kv" + kv_quant
     if draft_mode not in ("none", "same", "self-int8", "self-int4"):
         # validate at parse time: an unknown value must fail in
         # milliseconds, not after minutes of 8B weight init inside a
@@ -170,13 +177,6 @@ def main() -> None:
                      "known: none|same|self-int8|self-int4",
         })
         sys.exit(2)
-    # kv/spec suffixes are clamp-INDEPENDENT (force_cpu never alters
-    # them), so they attach before the error paths below — an error
-    # record from a spec or kv-quant step must still carry the config
-    # it was measuring. Only _prefixK depends on post-clamp values
-    # (prompt_len, page_size) and attaches after the clamp.
-    if kv_quant != "none":
-        metric += "_kv" + kv_quant
     if draft_mode != "none":
         metric += "_spec_" + draft_mode.replace("self-", "self")
 

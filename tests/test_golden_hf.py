@@ -1,0 +1,154 @@
+"""Golden-fixture conformance against REAL HF artifacts (VERDICT r4 #6).
+
+The fixtures under tests/fixtures/ were produced by Hugging Face tooling
+(tools/make_golden_fixtures.py): `LlamaForCausalLM.save_pretrained`
+wrote the checkpoint bytes, the `tokenizers` library wrote
+tokenizer.json, and the golden logits / greedy continuation were
+computed by the HF torch forward — an INDEPENDENT implementation of the
+same model math. These tests are the first non-synthetic anchor for the
+loader/tokenizer/forward stack (SURVEY §7.2 M1 "logits vs. HF
+reference"; reference model-load capability ``design.md:324-332``).
+
+Tolerances: both sides run float32; differences are op-ordering only
+(XLA vs torch/oneDNN), observed ~1e-5 — asserted at 100x margin.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.loader import load_checkpoint
+from distributed_inference_server_tpu.models.tokenizer import load_tokenizer
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+CKPT = os.path.join(FIXTURES, "tiny_llama_hf")
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return np.load(os.path.join(FIXTURES, "golden_tiny_llama.npz"))
+
+
+@pytest.fixture(scope="module")
+def loaded():
+    return load_checkpoint(CKPT, dtype=jnp.float32)
+
+
+def test_config_parses_hf_config_json(loaded):
+    _, cfg = loaded
+    assert cfg.vocab_size == 384
+    assert cfg.hidden_size == 64
+    assert cfg.num_layers == 2
+    assert cfg.num_heads == 4
+    assert cfg.num_kv_heads == 2
+    assert cfg.head_dim == 16
+    assert not cfg.tie_word_embeddings
+
+
+def test_forward_matches_hf_logits(loaded, golden):
+    """Prefill logits vs the HF torch forward, all prompts, all valid
+    positions."""
+    params, cfg = loaded
+    ids = golden["input_ids"]
+    mask = golden["attention_mask"]
+    B, T = ids.shape
+    cache = llama.KVCache.create(cfg, B, T, dtype=jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    valid = jnp.asarray(mask.sum(axis=1), jnp.int32)
+    logits, _ = llama.forward(
+        params, cfg, jnp.asarray(ids), positions, cache,
+        write_pos=positions, kv_valid_len=valid,
+    )
+    got = np.asarray(logits)
+    want = golden["logits"]
+    sel = mask.astype(bool)
+    diff = np.abs(got[sel] - want[sel]).max()
+    assert diff < 1e-3, f"max |logit diff| {diff} vs HF"
+    # argmax agreement at every valid position — the decision-relevant bit
+    assert (got[sel].argmax(-1) == want[sel].argmax(-1)).all()
+
+
+def test_greedy_generation_matches_hf(loaded, golden):
+    """16-token greedy continuation vs HF `generate` (dense path)."""
+    from distributed_inference_server_tpu.models.generate import greedy_generate
+
+    params, cfg = loaded
+    prompt = golden["greedy_prompt"].tolist()
+    want = golden["greedy_out"].tolist()
+    got = greedy_generate(params, cfg, prompt, max_new_tokens=16)
+    # greedy_generate returns the NEW tokens only
+    assert got == want[len(prompt):]
+
+
+def test_engine_paged_greedy_matches_hf(loaded, golden):
+    """The PAGED serving path (engine, page tables, continuous batching)
+    reproduces the HF greedy continuation — the strongest end-to-end
+    anchor: tokens → pages → paged attention → sampling."""
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+
+    params, cfg = loaded
+    prompt = golden["greedy_prompt"].tolist()
+    want = golden["greedy_out"].tolist()
+    # the checkpoint's OWN tokenizer: its eos (<|end_of_text|>=1) must not
+    # collide with ordinary generated ids (ByteTokenizer's eos 257 is a
+    # regular token in this vocab and HF happens to emit it)
+    engine = LLMEngine(
+        params, cfg, load_tokenizer(CKPT),
+        EngineConfig(
+            max_batch=2,
+            prefill_buckets=(16,),
+            paged=PagedCacheConfig(
+                num_pages=32, page_size=4, max_pages_per_seq=16
+            ),
+        ),
+        dtype=jnp.float32,
+    )
+    engine.add_request(
+        "g", prompt, SamplingParams(max_tokens=16, temperature=0.0)
+    )
+    tokens = []
+    for _ in range(200):
+        if not engine.has_work():
+            break
+        for out in engine.step():
+            if out.token_id is not None:
+                tokens.append(out.token_id)
+    assert tokens == want[len(prompt):]
+
+
+def test_tokenizer_parity_with_hf_tokenizers(golden):
+    """HFTokenizer over the committed tokenizer.json reproduces the
+    `tokenizers` library's encodings/decodings exactly."""
+    with open(os.path.join(FIXTURES, "golden_tok.json")) as f:
+        g = json.load(f)
+    tok = load_tokenizer(CKPT)
+    assert tok.vocab_size == g["vocab_size"]
+    for text, want_ids in g["encodings"].items():
+        assert tok.encode(text, add_bos=False) == want_ids, text
+    for text, want_text in g["decodings"].items():
+        assert tok.decode(tok.encode(text, add_bos=False)) == want_text
+    # checkpoint-shipped chat template travels with the tokenizer
+    assert getattr(tok, "chat_template", None)
+
+
+def test_fixture_generator_is_hf_not_ours():
+    """Guard: the checkpoint fixture must remain HF-produced bytes — the
+    metadata written by save_pretrained names transformers as producer.
+    (Our own save path writing the fixture would reintroduce the shared
+    saver/loader-bug blind spot this fixture exists to remove.)"""
+    import struct
+
+    path = os.path.join(CKPT, "model.safetensors")
+    with open(path, "rb") as f:
+        n = struct.unpack("<Q", f.read(8))[0]
+        header = json.loads(f.read(n))
+    assert header.get("__metadata__", {}).get("format") == "pt"

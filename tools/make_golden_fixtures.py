@@ -1,0 +1,166 @@
+"""Generate REAL-HF-format golden fixtures (VERDICT r4 missing #2).
+
+The loader/tokenizer/forward stack had only ever seen synthetic fixtures
+built by our own save path — a bug shared by saver and loader would be
+invisible. This script builds the fixtures with HUGGING FACE tooling
+(`transformers.LlamaForCausalLM.save_pretrained`, the `tokenizers`
+library), so the artifacts are byte-exact HF format produced by the
+code that produces real checkpoints, and computes golden logits /
+greedy continuations with the HF torch forward — an independent
+implementation of the same math (reference anchor: SURVEY §7.2 M1
+"logits vs. HF reference"; ``design.md:324-332`` model-load capability).
+
+Run offline (no network): everything is constructed locally with seeded
+RNG. Outputs under tests/fixtures/tiny_llama_hf/ (checkpoint dir) and
+tests/fixtures/golden_tiny_llama.npz + golden_tok.json. Deterministic:
+torch.manual_seed + a fixed BPE corpus; re-running must reproduce the
+committed bytes (drift means torch/transformers changed init behavior —
+regenerate and re-commit with the version note below).
+
+Built with torch 2.13.0+cpu / transformers 4.57.6 / tokenizers 0.22.2.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+FIXTURE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures"
+)
+CKPT_DIR = os.path.join(FIXTURE_DIR, "tiny_llama_hf")
+
+# enough text to train a small but real BPE vocabulary; mixed prose /
+# code / unicode so merges, byte fallback, and whitespace handling are
+# all exercised
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "Distributed inference servers batch requests for throughput.",
+    "TPU systolic arrays multiply matrices in bfloat16.",
+    "def forward(params, input_ids):\n    return logits\n",
+    "KV caches store keys and values per attention layer.",
+    "Paged attention maps logical pages to physical slots.",
+    "naïve café déjà vu — unicode round-trips: 日本語 ελληνικά",
+    "0123456789 !@#$%^&*() [] {} <> | ~ ` ' \"",
+    "for i in range(16): print(i * i)",
+    "Speculative decoding drafts tokens and verifies them in one pass.",
+]
+PROMPTS = [
+    "The quick brown fox",
+    "Paged attention maps",
+    "def forward(params",
+]
+
+
+def build_tokenizer():
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers, decoders
+
+    tok = Tokenizer(models.BPE(unk_token=None, byte_fallback=True))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=384,
+        special_tokens=["<|begin_of_text|>", "<|end_of_text|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(CORPUS, trainer)
+    return tok
+
+
+def main() -> None:
+    import numpy as np
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    os.makedirs(CKPT_DIR, exist_ok=True)
+
+    tok = build_tokenizer()
+    tok.save(os.path.join(CKPT_DIR, "tokenizer.json"))
+    vocab = tok.get_vocab_size()
+    with open(os.path.join(CKPT_DIR, "tokenizer_config.json"), "w") as f:
+        json.dump({
+            "bos_token": "<|begin_of_text|>",
+            "eos_token": "<|end_of_text|>",
+            # a real (if minimal) template so load_chat_template sees a
+            # checkpoint-shipped one
+            "chat_template": (
+                "{% for message in messages %}<|begin_of_text|>"
+                "{{ message['role'] }}: {{ message['content'] }}\n"
+                "{% endfor %}"
+            ),
+        }, f, indent=1)
+
+    torch.manual_seed(0)
+    hf_cfg = LlamaConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attention_bias=False,
+        torch_dtype="float32",
+    )
+    model = LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(CKPT_DIR, safe_serialization=True)
+    # save_pretrained writes generation_config.json too; harmless, keep it.
+
+    bos = tok.token_to_id("<|begin_of_text|>")
+    enc = [[bos] + tok.encode(p, add_special_tokens=False).ids
+           for p in PROMPTS]
+    T = max(len(e) for e in enc)
+    # left-align, pad with eos (masked out via attention_mask)
+    ids = np.full((len(enc), T), tok.token_to_id("<|end_of_text|>"), np.int64)
+    mask = np.zeros((len(enc), T), np.int64)
+    for i, e in enumerate(enc):
+        ids[i, : len(e)] = e
+        mask[i, : len(e)] = 1
+
+    with torch.no_grad():
+        out = model(
+            input_ids=torch.from_numpy(ids),
+            attention_mask=torch.from_numpy(mask),
+        )
+        logits = out.logits.float().numpy()
+        # greedy continuation of the first prompt, 16 new tokens
+        gen = model.generate(
+            input_ids=torch.from_numpy(ids[:1, : len(enc[0])]),
+            max_new_tokens=16,
+            do_sample=False,
+            num_beams=1,
+        ).numpy()[0]
+
+    np.savez(
+        os.path.join(FIXTURE_DIR, "golden_tiny_llama.npz"),
+        input_ids=ids,
+        attention_mask=mask,
+        logits=logits,
+        greedy_prompt=np.asarray(enc[0], np.int64),
+        greedy_out=gen,
+    )
+    with open(os.path.join(FIXTURE_DIR, "golden_tok.json"), "w") as f:
+        json.dump({
+            "vocab_size": vocab,
+            "bos_id": bos,
+            "eos_id": tok.token_to_id("<|end_of_text|>"),
+            "encodings": {
+                p: tok.encode(p, add_special_tokens=False).ids
+                for p in PROMPTS + CORPUS[:4]
+            },
+            "decodings": {
+                p: tok.decode(tok.encode(p, add_special_tokens=False).ids)
+                for p in PROMPTS
+            },
+        }, f, indent=1)
+    print(f"fixtures written: vocab={vocab}, logits={logits.shape}, "
+          f"greedy={gen.tolist()}")
+
+
+if __name__ == "__main__":
+    main()
