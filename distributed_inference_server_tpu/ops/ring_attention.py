@@ -114,6 +114,18 @@ def ring_attention(
         jnp.zeros((B, KV, G, Tl), jnp.float32),
         jnp.zeros((B, Tl, KV, G, D), jnp.float32),
     )
+    # when the surrounding manual region tracks varying-manual-axes (vma)
+    # — e.g. the unified seq x stage shard_map of parallel/cp.py's
+    # cp_pp_prefill — the scan carry must start with the same vma set the
+    # accumulate step produces, or the carry types mismatch. Promote the
+    # fresh zeros to the inputs' varying set (no-op under check_vma=False
+    # wrappers, where the set is empty).
+    try:
+        vma = tuple(jax.typeof(q).vma | jax.typeof(k).vma)
+    except (AttributeError, TypeError):
+        vma = ()
+    if vma:
+        stats0 = tuple(lax.pcast(x, vma, to="varying") for x in stats0)
     # ring-1 rotate-and-accumulate steps, then a peeled final accumulate —
     # the last rotation's result would be discarded, so don't issue it
     (stats, k_last, v_last, pos_last), _ = lax.scan(

@@ -66,3 +66,94 @@ def test_cp_prefill_rejects_indivisible_buffer(params):
     ids = jnp.zeros((1, 12), jnp.int32)
     with pytest.raises(ValueError, match="not divisible"):
         cp_prefill(params, TINY, mesh, ids, jnp.asarray([12]))
+
+
+class TestCPxPP:
+    """Ring CP composed with pipeline parallelism in one unified
+    {seq, stage} shard_map (parallel/cp.py:cp_pp_prefill, VERDICT r4
+    #5) — last-token logits and KV match the dense path."""
+
+    @pytest.mark.parametrize("spec,mb", [
+        (MeshSpec(seq=2, stage=2), 1),
+        (MeshSpec(seq=2, stage=2), 2),
+        (MeshSpec(seq=4, stage=2), 1),
+        (MeshSpec(seq=2, stage=2, tensor=2), 1),
+    ])
+    def test_cp_pp_prefill_matches_dense(self, params, spec, mb):
+        from distributed_inference_server_tpu.parallel.cp import (
+            cp_pp_prefill,
+        )
+
+        mesh = make_mesh(spec)
+        B, T = 2, 32
+        ids = jax.random.randint(
+            jax.random.PRNGKey(2), (B, T), 0, TINY.vocab_size
+        )
+        valid = jnp.asarray([29, 17], jnp.int32)
+        want, dense_cache = _dense_last_logits(params, ids, valid)
+        p = shard_params(params, mesh, TINY) if spec.tensor > 1 else params
+        with mesh:
+            got, k, v = cp_pp_prefill(
+                p, TINY, mesh, ids, valid, num_microbatches=mb
+            )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+        for b in range(B):
+            n = int(valid[b])
+            np.testing.assert_allclose(
+                np.asarray(k[:, b, :n]),
+                np.asarray(dense_cache.k[:, b, :n]),
+                rtol=2e-4, atol=2e-4,
+            )
+            np.testing.assert_allclose(
+                np.asarray(v[:, b, :n]),
+                np.asarray(dense_cache.v[:, b, :n]),
+                rtol=2e-4, atol=2e-4,
+            )
+
+    def test_cp_pp_gemma2_windows(self, params):
+        """Per-layer sliding windows (Gemma-2 schedule) ride the stage
+        slices: each stage picks ITS layers' windows."""
+        from distributed_inference_server_tpu.models.configs import (
+            TINY_GEMMA2,
+        )
+        from distributed_inference_server_tpu.parallel.cp import (
+            cp_pp_prefill,
+        )
+
+        cfg = TINY_GEMMA2
+        g2 = llama.init_params(jax.random.PRNGKey(3), cfg, jnp.float32)
+        B, T = 1, 32
+        ids = jax.random.randint(
+            jax.random.PRNGKey(4), (B, T), 0, cfg.vocab_size
+        )
+        valid = jnp.asarray([27], jnp.int32)
+        positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        cache = llama.KVCache.create(cfg, B, T, dtype=jnp.float32)
+        write_pos = jnp.where(positions < valid[:, None], positions, T)
+        logits, _ = llama.forward(
+            g2, cfg, ids, positions, cache, write_pos, valid
+        )
+        want = jnp.take_along_axis(
+            logits, (valid - 1)[:, None, None], axis=1
+        )[:, 0]
+        mesh = make_mesh(MeshSpec(seq=2, stage=2))
+        with mesh:
+            got, _, _ = cp_pp_prefill(g2, cfg, mesh, ids, valid)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+    def test_cp_pp_rejects_bad_geometry(self, params):
+        from distributed_inference_server_tpu.parallel.cp import (
+            cp_pp_prefill,
+        )
+
+        mesh = make_mesh(MeshSpec(seq=2, stage=2))
+        with pytest.raises(ValueError, match="not divisible"):
+            cp_pp_prefill(params, TINY, mesh, jnp.zeros((1, 13), jnp.int32),
+                          jnp.asarray([13]))
+        with pytest.raises(ValueError, match="microbatches"):
+            cp_pp_prefill(params, TINY, mesh, jnp.zeros((3, 16), jnp.int32),
+                          jnp.asarray([16, 16, 16]), num_microbatches=2)

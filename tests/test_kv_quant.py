@@ -177,24 +177,90 @@ def test_int8_kv_under_tensor_parallel(tiny_params):
     assert rs["tokens"] == rt["tokens"]
 
 
-def test_kv_quant_rejects_stage_seq_axes(tiny_params):
+def _mesh_engine(tiny_params, mesh, **kw):
+    return LLMEngine(
+        tiny_params, TINY, TOK,
+        EngineConfig(
+            max_batch=4, prefill_buckets=(16,),
+            paged=PagedCacheConfig(num_pages=24, page_size=4,
+                                   max_pages_per_seq=8),
+            attention_impl="xla", decode_block_size=4, kv_quant="int8",
+            **kw,
+        ),
+        dtype=jnp.float32, mesh=mesh,
+    )
+
+
+def test_int8_kv_under_pipeline_parallel(tiny_params):
+    """VERDICT r4 #4: QuantPool pools thread through pp_paged_forward as
+    pytrees with stage-sharded members; PP generation matches the
+    single-device int8 engine token-for-token."""
     from distributed_inference_server_tpu.parallel.mesh import (
         MeshSpec,
         make_mesh,
     )
 
-    with pytest.raises(ValueError, match="stage/seq"):
-        LLMEngine(
-            tiny_params, TINY, TOK,
-            EngineConfig(
-                max_batch=4, prefill_buckets=(16,),
-                paged=PagedCacheConfig(num_pages=24, page_size=4,
-                                       max_pages_per_seq=8),
-                attention_impl="xla", kv_quant="int8",
-                pp_microbatches=2,
-            ),
-            dtype=jnp.float32, mesh=make_mesh(MeshSpec(stage=2)),
-        )
+    prompt = TOK.encode("pp kv quant")
+    single = _make_engine(tiny_params)
+    single.add_request("a", prompt, SamplingParams(max_tokens=6,
+                                                   temperature=0.0))
+    rs = _drain(single)["a"]
+
+    pp = _mesh_engine(tiny_params, make_mesh(MeshSpec(stage=2)),
+                      pp_microbatches=2)
+    pp.add_request("b", prompt, SamplingParams(max_tokens=6,
+                                               temperature=0.0))
+    rt = _drain(pp)["b"]
+    assert rt["error"] is None
+    assert rs["tokens"] == rt["tokens"]
+
+
+def test_int8_kv_under_ring_cp(tiny_params):
+    """Ring prefill with an int8 pool: the dense ring K/V quantizes at
+    the pool scatter (parallel/cp.py:_scatter_pool); decode reads the
+    quantized pages. Long prompt on a seq mesh matches the single-device
+    int8 engine."""
+    from distributed_inference_server_tpu.parallel.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+
+    prompt = TOK.encode("int8 kv ring prefill!")  # 22 tokens > 16
+    single = _make_engine(tiny_params)
+    single.add_request("a", prompt, SamplingParams(max_tokens=6,
+                                                   temperature=0.0))
+    rs = _drain(single)["a"]
+
+    cp = _mesh_engine(tiny_params, make_mesh(MeshSpec(seq=2)))
+    cp.add_request("b", prompt, SamplingParams(max_tokens=6,
+                                               temperature=0.0))
+    rt = _drain(cp)["b"]
+    assert rt["error"] is None
+    assert cp._cp_fns, "ring path was never taken"
+    assert rs["tokens"] == rt["tokens"]
+
+
+def test_int8_kv_under_cp_pp(tiny_params):
+    """The full composition: ring CP x PP x int8 KV in one engine."""
+    from distributed_inference_server_tpu.parallel.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+
+    prompt = TOK.encode("int8 kv ring prefill!")
+    single = _make_engine(tiny_params)
+    single.add_request("a", prompt, SamplingParams(max_tokens=6,
+                                                   temperature=0.0))
+    rs = _drain(single)["a"]
+
+    eng = _mesh_engine(tiny_params, make_mesh(MeshSpec(seq=2, stage=2)),
+                       pp_microbatches=2)
+    eng.add_request("b", prompt, SamplingParams(max_tokens=6,
+                                                temperature=0.0))
+    rt = _drain(eng)["b"]
+    assert rt["error"] is None
+    assert eng._cp_fns, "ring path was never taken"
+    assert rs["tokens"] == rt["tokens"]
 
 
 def test_kv_quant_pallas_env_resolution(tiny_params, monkeypatch):
