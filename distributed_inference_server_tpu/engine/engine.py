@@ -847,7 +847,8 @@ class LLMEngine:
         loop inside and the per-shard ring body as the attend, so every
         device issues the seq- and stage-axis collectives in the same
         static order. (Nesting ring's own shard_map under the stage
-        loop's deadlocked XLA collective scheduling —
+        loop DEADLOCKED XLA's collective scheduling on the r4-window
+        jax, and current jax rejects the nesting at trace time —
         tools/nested_shardmap_repro.py keeps the minimal repro.)
         Ulysses is seq-only: its all-to-all head scatter does not
         compose with the stage loop, so ulysses + stage falls back to
@@ -1077,9 +1078,26 @@ class LLMEngine:
                 self._auto_impl = ("xla", "xla")
             else:
                 ok_decode, ok_prefill = self._probe_pallas()
+                # prefill DEMOTED to opt-in (VERDICT r4 #3 "win or
+                # demote"): Mosaic acceptance proves the kernel compiles,
+                # not that it's fast, and the only silicon datapoint has
+                # the chunked-prefill kernel at 0.66x XLA blocking at
+                # serving geometry (BENCH_NOTES_r04.md §1). Until the
+                # queued long-context crossover sweep produces >= 2
+                # geometries where it wins, auto serves prefill on XLA;
+                # DIS_TPU_PALLAS_PREFILL=1 re-enables it for sweeps (an
+                # explicit attention_impl='pallas' pin always did).
+                # Decode keeps pallas-if-compiles: end-to-end parity at
+                # short context (2,049 vs 2,120 tok/s) with strictly
+                # less DMA at long context (reads only valid pages vs
+                # the XLA path's bucketed gather).
+                want_prefill = (
+                    ok_prefill
+                    and os.environ.get("DIS_TPU_PALLAS_PREFILL") == "1"
+                )
                 self._auto_impl = (
                     "pallas" if ok_decode else "xla",
-                    "pallas" if ok_prefill else "xla",
+                    "pallas" if want_prefill else "xla",
                 )
         return self._auto_impl
 

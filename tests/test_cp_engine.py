@@ -186,3 +186,43 @@ class TestGemma2CP:
             LONG_PROMPT,
         )
         assert plain == cp
+
+
+class TestCPWithDraft:
+    """Speculative decoding composed with ring-CP prefill: the draft's
+    pool prefills through the same cp program (same slots), so
+    speculative rounds can attend the full long prompt."""
+
+    def _spec_engine(self, params, draft, mesh=None, **kw):
+        return LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(
+                max_batch=2, prefill_buckets=(16,), paged=PAGED,
+                decode_block_size=3, **kw,
+            ),
+            dtype=jnp.float32, mesh=mesh,
+            draft_params=draft, draft_cfg=TINY,
+        )
+
+    def test_long_prompt_spec_on_seq_mesh_matches_plain(self):
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, jnp.float32)
+        draft = llama.init_params(jax.random.PRNGKey(7), TINY, jnp.float32)
+        plain = _generate(self._spec_engine(params, draft), LONG_PROMPT)
+        cp_eng = self._spec_engine(
+            params, draft, mesh=make_mesh(MeshSpec(seq=2))
+        )
+        got = _generate(cp_eng, LONG_PROMPT)
+        assert cp_eng._cp_fns, "CP path was never taken"
+        assert got == plain
+
+    def test_long_prompt_spec_on_seq_stage_mesh(self):
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, jnp.float32)
+        draft = llama.init_params(jax.random.PRNGKey(7), TINY, jnp.float32)
+        plain = _generate(self._spec_engine(params, draft), LONG_PROMPT)
+        eng = self._spec_engine(
+            params, draft, mesh=make_mesh(MeshSpec(seq=2, stage=2)),
+            pp_microbatches=2,
+        )
+        got = _generate(eng, LONG_PROMPT)
+        assert eng._cp_fns, "ring path was never taken"
+        assert got == plain

@@ -88,6 +88,23 @@ def main() -> int:
                 r = decode_block(params, pool_k, pool_v, tokens, start, impl)
             jax.block_until_ready(r)
             dt = (time.perf_counter() - t0) / reps
+            # DP_TRACE=1: capture a device trace of ONE extra block (the
+            # VERDICT r5 #2 evidence: name the residual per-step cost on
+            # the chip, op by op). Deliberately OUTSIDE the timed reps —
+            # step_ms stays comparable to the untraced r4 datapoints the
+            # probe exists to diagnose. Trace lands in traces/.
+            if os.environ.get("DP_TRACE") == "1":
+                trace_dir = os.path.join(
+                    os.path.dirname(__file__), "..", "traces",
+                    f"decode_probe_{impl}_b{batch}_ctx{ctx}",
+                )
+                jax.profiler.start_trace(trace_dir)
+                try:
+                    jax.block_until_ready(decode_block(
+                        params, pool_k, pool_v, tokens, start, impl
+                    ))
+                finally:
+                    jax.profiler.stop_trace()
             step_ms = dt / block * 1e3
             print(json.dumps({
                 "probe": "decode_block", "impl": impl, "batch": batch,

@@ -79,9 +79,11 @@ step kp_long_qb64 580 env KP_PAGES_PER_SEQ=64 KP_CTX=1024 KP_PREFILL_T=512 DIS_T
 step kp_int8_kv 580 env KP_KV_QUANT=1 python tools/kernel_probe.py
 step kp_int8_kv_long 580 env KP_KV_QUANT=1 KP_PAGES_PER_SEQ=64 KP_CTX=1024 python tools/kernel_probe.py
 
-# 1c. pure-device decode block (no engine): device-vs-host attribution
-step decode_probe_b64 580 python tools/decode_probe.py 64 272 64
-step decode_probe_b128 580 python tools/decode_probe.py 128 272 64
+# 1c. pure-device decode block (no engine): device-vs-host attribution,
+#     WITH device traces (DP_TRACE=1) — the op-level evidence that names
+#     the residual per-step cost (VERDICT r5 #2) and the b128 anomaly
+step decode_probe_b64 580 env DP_TRACE=1 python tools/decode_probe.py 64 272 64
+step decode_probe_b128 580 env DP_TRACE=1 python tools/decode_probe.py 128 272 64
 
 # 2. decode sweep remainder: batch scaling first — the r4 b128 anomaly
 #    (98.8 ms/step, superlinear) predates the sort-free sampler, and the
