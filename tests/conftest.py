@@ -29,3 +29,33 @@ os.environ.setdefault("DIS_TPU_DEBUG_GATHER", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# ---------------------------------------------------------------------------
+# Fast/slow test tiers (VERDICT r4 #9): tests listed in slow_tests.txt
+# (>= 4s on a clean timing run — JAX-compile-heavy e2e/mesh tests) are
+# marked `slow` at collection, and the DEFAULT run excludes them via
+# pyproject addopts so the conformance tier finishes in < 5 min.
+#   full suite:  python -m pytest tests/ -m "" -q
+#   slow only:   python -m pytest tests/ -m slow -q
+#   regenerate:  python tools/update_slowlist.py (see its docstring)
+# A slowlisted test that no longer exists is ignored; NEW tests default
+# to the fast tier until the next regeneration.
+# ---------------------------------------------------------------------------
+import os.path as _osp  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    path = _osp.join(_osp.dirname(__file__), "slow_tests.txt")
+    try:
+        with open(path) as f:
+            slow = {
+                ln.strip() for ln in f
+                if ln.strip() and not ln.startswith("#")
+            }
+    except OSError:
+        return
+    for item in items:
+        if item.nodeid in slow:
+            item.add_marker(pytest.mark.slow)

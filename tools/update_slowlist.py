@@ -1,0 +1,55 @@
+"""Regenerate tests/slow_tests.txt from a pytest durations log.
+
+The fast/slow test tiers (VERDICT r4 #9: default tier < 5 min) are
+data-driven: run the full suite once with complete durations, then feed
+the log here. Tests at or above the threshold are listed in
+tests/slow_tests.txt and marked ``slow`` at collection by
+tests/conftest.py; the default run excludes them via pyproject addopts.
+
+    python -m pytest tests/ -q --durations=0 -m "" > /tmp/durations.txt
+    python tools/update_slowlist.py /tmp/durations.txt 4.0
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+HEADER = """\
+# Tests >= {thr}s on the clean single-process timing run (tools/update_slowlist.py).
+# Marked `slow` at collection (tests/conftest.py); the DEFAULT pytest run
+# excludes them (pyproject addopts) so the fast tier stays under 5 min.
+# Full suite: python -m pytest tests/ -m "" -q
+# Regenerate: python tools/update_slowlist.py <durations-log> [threshold-s]
+"""
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 2
+    log = sys.argv[1]
+    thr = float(sys.argv[2]) if len(sys.argv) > 2 else 4.0
+    pat = re.compile(r"^\s*([0-9.]+)s\s+call\s+(\S+)")
+    slow = []
+    with open(log) as f:
+        for line in f:
+            m = pat.match(line)
+            if m and float(m.group(1)) >= thr:
+                slow.append(m.group(2))
+    if not slow:
+        print("no slow tests parsed — wrong log file? (need --durations=0)")
+        return 1
+    out = os.path.join(os.path.dirname(__file__), "..", "tests",
+                       "slow_tests.txt")
+    with open(out, "w") as f:
+        f.write(HEADER.format(thr=thr))
+        for t in sorted(set(slow)):
+            f.write(t + "\n")
+    print(f"{len(set(slow))} slow tests >= {thr}s -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
