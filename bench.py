@@ -231,6 +231,17 @@ def main() -> None:
 
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
+    # persistent XLA compile cache (same policy as the server's):
+    # hardware windows are short and flaky — the r4 b256 step died to
+    # compile time a previous attempt had already paid. hw_window.sh
+    # sets JAX_COMPILATION_CACHE_DIR so every tool shares one cache.
+    from distributed_inference_server_tpu.utils.compile_cache import (
+        setup_compile_cache,
+    )
+
+    setup_compile_cache(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+    ) if "JAX_COMPILATION_CACHE_DIR" not in os.environ else None)
     devices = jax.devices()
     init_done.set()
     platform = devices[0].platform

@@ -57,16 +57,11 @@ def main(argv=None) -> int:
 
     cache_dir = cfg.get("server", "compile_cache_dir")
     if cache_dir:
-        import os
+        from distributed_inference_server_tpu.utils.compile_cache import (
+            setup_compile_cache,
+        )
 
-        import jax
-
-        os.makedirs(os.path.expanduser(cache_dir), exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.expanduser(cache_dir))
-        # serving programs are large; cache everything
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        setup_compile_cache(cache_dir)
 
     import jax.numpy as jnp
 

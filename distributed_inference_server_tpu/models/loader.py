@@ -164,7 +164,16 @@ def config_from_hf_json(obj: Mapping[str, Any], name: str = "hf") -> ModelConfig
         rms_norm_eps=float(obj.get("rms_norm_eps", 1e-5)),
         rope_theta=float(obj.get("rope_theta", 10000.0)),
         rope_scaling=rope_scaling,
-        tie_word_embeddings=bool(obj.get("tie_word_embeddings", False)),
+        # HF PretrainedConfig defaults tie_word_embeddings to TRUE when
+        # the key is absent or null (Gemma-2 checkpoints ship it as null
+        # and tie; Llama ships an explicit false) — treating absent as
+        # False made the loader demand a nonexistent lm_head.weight from
+        # genuine Gemma-2 artifacts (caught by tests/fixtures/
+        # tiny_gemma2_hf, golden test).
+        tie_word_embeddings=(
+            True if obj.get("tie_word_embeddings") is None
+            else bool(obj["tie_word_embeddings"])
+        ),
         max_position_embeddings=int(obj.get("max_position_embeddings", 8192)),
         num_experts=int(obj.get("num_local_experts", 0)),
         num_experts_per_tok=int(obj.get("num_experts_per_tok", 2)),

@@ -1,0 +1,37 @@
+"""Persistent XLA compile-cache setup shared by every entrypoint.
+
+Serving programs are large and TPU compiles cost 20-40 s; the server
+(``__main__.py``), the benchmark (``bench.py``), and the hardware-window
+tools all want the same policy: cache everything that took >= 1 s to
+compile, no size floor. One definition here so the policy cannot drift
+between entrypoints (it did: bench.py lacked the cache entirely through
+round 4, and the r4 b256 window step died re-paying compiles a previous
+attempt had already done).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def setup_compile_cache(cache_dir: str | None = None) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Resolution order: explicit argument, ``JAX_COMPILATION_CACHE_DIR``
+    env (which jax also honors natively — set it and this call is a
+    consistent no-op), then ``~/.cache/dis_tpu_xla``. Creates the
+    directory. Returns the resolved path."""
+    import jax
+
+    cache_dir = (
+        cache_dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.expanduser("~/.cache/dis_tpu_xla")
+    )
+    cache_dir = os.path.expanduser(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # serving programs are large; cache everything nontrivial
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir

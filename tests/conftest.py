@@ -47,6 +47,12 @@ import pytest  # noqa: E402
 
 
 def pytest_collection_modifyitems(config, items):
+    # naming a test explicitly (`pytest tests/foo.py::test_bar`) must RUN
+    # it, slowlisted or not — skip the marking entirely so the default
+    # `-m "not slow"` addopts has nothing to deselect. The tier split
+    # only applies to directory/file-level runs.
+    if any("::" in a for a in config.args):
+        return
     path = _osp.join(_osp.dirname(__file__), "slow_tests.txt")
     try:
         with open(path) as f:

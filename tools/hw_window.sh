@@ -14,6 +14,9 @@ touch "$DONE"
 export PYTHONPATH=/root/repo:/root/.axon_site
 export JAX_PLATFORMS=axon  # never let a fresh shell fall back to CPU and
                            # log CPU numbers as chip measurements
+# persistent XLA compile cache shared by EVERY step and retry attempt:
+# a wedge mid-step must not make the next attempt re-pay the compile
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
 
 alive() {  # the relay wedges mid-window: gate EVERY step, not just entry;
            # also assert the backend is the real chip, not a CPU fallback

@@ -68,6 +68,81 @@ def build_tokenizer():
     return tok
 
 
+def make_family_fixtures() -> None:
+    """HF-produced tiny checkpoints + golden logits for the OTHER model
+    families the loader maps: Mixtral (block_sparse_moe expert naming),
+    Gemma-2 (unit-offset sandwich norms, soft-capping,
+    query_pre_attn_scalar), Qwen2 (qkv bias), Mistral (sliding window).
+    Each family exercises a distinct loader/forward code path that a
+    llama-only golden cannot. No tokenizer needed — inputs are fixed
+    random ids; goldens are the HF float32 forward's logits."""
+    import numpy as np
+    import torch
+    from transformers import (
+        Gemma2Config,
+        Gemma2ForCausalLM,
+        MistralConfig,
+        MistralForCausalLM,
+        MixtralConfig,
+        MixtralForCausalLM,
+        Qwen2Config,
+        Qwen2ForCausalLM,
+    )
+
+    common = dict(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+    )
+    fams = {
+        "tiny_mixtral_hf": (MixtralForCausalLM, MixtralConfig(
+            **common, num_local_experts=4, num_experts_per_tok=2,
+            head_dim=16, tie_word_embeddings=False, attention_bias=False,
+            sliding_window=None, torch_dtype="float32",
+        )),
+        "tiny_gemma2_hf": (Gemma2ForCausalLM, Gemma2Config(
+            **common, head_dim=16, query_pre_attn_scalar=24.0,
+            sliding_window=8, attn_logit_softcapping=50.0,
+            final_logit_softcapping=30.0,
+            hidden_activation="gelu_pytorch_tanh",
+            attention_bias=False, torch_dtype="float32",
+        )),
+        "tiny_qwen2_hf": (Qwen2ForCausalLM, Qwen2Config(
+            **common, tie_word_embeddings=False,
+            # HF Qwen2 hardwires qkv bias on; keep the default
+            torch_dtype="float32",
+        )),
+        "tiny_mistral_hf": (MistralForCausalLM, MistralConfig(
+            **common, head_dim=16, sliding_window=8,
+            tie_word_embeddings=False, attention_bias=False,
+            torch_dtype="float32",
+        )),
+    }
+    rng = np.random.RandomState(42)
+    B, T = 2, 12
+    for name, (cls, cfg) in fams.items():
+        torch.manual_seed(1)
+        model = cls(cfg).eval()
+        d = os.path.join(FIXTURE_DIR, name)
+        os.makedirs(d, exist_ok=True)
+        model.save_pretrained(d, safe_serialization=True)
+        ids = rng.randint(1, cfg.vocab_size, (B, T)).astype(np.int64)
+        with torch.no_grad():
+            logits = model(input_ids=torch.from_numpy(ids)).logits
+        np.savez(
+            os.path.join(FIXTURE_DIR, f"golden_{name}.npz"),
+            input_ids=ids,
+            logits=logits.float().numpy(),
+        )
+        print(f"{name}: logits {tuple(logits.shape)}")
+
+
 def main() -> None:
     import numpy as np
     import torch
@@ -144,6 +219,7 @@ def main() -> None:
         greedy_prompt=np.asarray(enc[0], np.int64),
         greedy_out=gen,
     )
+    make_family_fixtures()
     with open(os.path.join(FIXTURE_DIR, "golden_tok.json"), "w") as f:
         json.dump({
             "vocab_size": vocab,
