@@ -242,6 +242,14 @@ def load_checkpoint(
         with safe_open(os.path.join(model_dir, shard), framework="numpy") as f:
             for key in f.keys():
                 state[key] = f.get_tensor(key)
+    # the CHECKPOINT is the ground truth for head tying (config.json's
+    # tie_word_embeddings may be absent/null — HF serializes tied models
+    # WITHOUT lm_head.weight and untied ones WITH it, always): a config
+    # claiming tied while the shards carry a real head would silently
+    # unembed with the embedding matrix and produce wrong logits.
+    untied = "lm_head.weight" in state
+    if untied == cfg.tie_word_embeddings:
+        cfg = cfg.with_overrides(tie_word_embeddings=not untied)
     return params_from_hf_state_dict(state, cfg, dtype=dtype), cfg
 
 

@@ -47,12 +47,12 @@ import pytest  # noqa: E402
 
 
 def pytest_collection_modifyitems(config, items):
-    # naming a test explicitly (`pytest tests/foo.py::test_bar`) must RUN
-    # it, slowlisted or not — skip the marking entirely so the default
-    # `-m "not slow"` addopts has nothing to deselect. The tier split
-    # only applies to directory/file-level runs.
-    if any("::" in a for a in config.args):
-        return
+    # a test named explicitly (`pytest tests/foo.py::test_bar`) must RUN,
+    # slowlisted or not — those ITEMS skip the marking so the default
+    # `-m "not slow"` addopts has nothing to deselect there. Marking is
+    # per-item: directory/file args in the same invocation keep their
+    # tier split.
+    named = tuple(a.split("[", 1)[0] for a in config.args if "::" in a)
     path = _osp.join(_osp.dirname(__file__), "slow_tests.txt")
     try:
         with open(path) as f:
@@ -63,5 +63,9 @@ def pytest_collection_modifyitems(config, items):
     except OSError:
         return
     for item in items:
-        if item.nodeid in slow:
+        explicit = any(
+            item.nodeid == n or item.nodeid.startswith(n + "[")
+            for n in named
+        )
+        if not explicit and item.nodeid in slow:
             item.add_marker(pytest.mark.slow)

@@ -154,10 +154,13 @@ def test_fixture_generator_is_hf_not_ours():
     assert header.get("__metadata__", {}).get("format") == "pt"
 
 
-@pytest.mark.parametrize("family", [
+FAMILIES = [
     "tiny_mixtral_hf", "tiny_gemma2_hf", "tiny_qwen2_hf",
     "tiny_mistral_hf",
-])
+]
+
+
+@pytest.mark.parametrize("family", FAMILIES)
 def test_family_forward_matches_hf_logits(family):
     """Every model family's loader mapping + forward against its own
     HF-produced checkpoint and HF-torch golden logits: Mixtral
@@ -182,3 +185,40 @@ def test_family_forward_matches_hf_logits(family):
     diff = np.abs(got - want).max()
     assert diff < 2e-3, f"{family}: max |logit diff| {diff} vs HF"
     assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.99, family
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_family_greedy_matches_hf(family):
+    """Each family's DECODE path (cache layout, sliding windows,
+    soft-caps, MoE routing at T=1) vs the HF greedy continuation."""
+    from distributed_inference_server_tpu.models.generate import (
+        greedy_generate,
+    )
+
+    ck = os.path.join(FIXTURES, family)
+    params, cfg = load_checkpoint(ck, dtype=jnp.float32)
+    g = np.load(os.path.join(FIXTURES, f"golden_{family}.npz"))
+    prompt = g["input_ids"][0].tolist()
+    want = g["greedy_out"].tolist()
+    got = greedy_generate(params, cfg, prompt, max_new_tokens=8)
+    assert got == want[len(prompt):], family
+
+
+def test_loader_reconciles_tie_with_checkpoint_contents(tmp_path):
+    """The checkpoint is ground truth for head tying: HF serializes tied
+    models WITHOUT lm_head.weight and untied ones WITH it. A config.json
+    whose tie flag disagrees (absent/null keys, hand-edited configs) is
+    overridden instead of silently unembedding with the wrong matrix."""
+    import shutil
+
+    # start from the untied llama fixture; claim tied in config.json
+    src = CKPT
+    dst = tmp_path / "claims_tied"
+    shutil.copytree(src, dst)
+    cfgp = dst / "config.json"
+    obj = json.loads(cfgp.read_text())
+    obj["tie_word_embeddings"] = True  # lie: shards carry lm_head.weight
+    cfgp.write_text(json.dumps(obj))
+    params, cfg = load_checkpoint(str(dst), dtype=jnp.float32)
+    assert not cfg.tie_word_embeddings  # checkpoint wins
+    assert "lm_head" in params

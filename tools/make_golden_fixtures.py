@@ -135,12 +135,23 @@ def make_family_fixtures() -> None:
         ids = rng.randint(1, cfg.vocab_size, (B, T)).astype(np.int64)
         with torch.no_grad():
             logits = model(input_ids=torch.from_numpy(ids)).logits
+            # greedy continuation anchors each family's DECODE path too
+            # (cache layout, sliding windows, soft-caps, MoE routing
+            # during single-token steps)
+            gen = model.generate(
+                input_ids=torch.from_numpy(ids[:1]),
+                max_new_tokens=8, min_new_tokens=8,  # pin length: our
+                # greedy_generate runs eos-less, goldens must too
+                do_sample=False, num_beams=1,
+            ).numpy()[0]
         np.savez(
             os.path.join(FIXTURE_DIR, f"golden_{name}.npz"),
             input_ids=ids,
             logits=logits.float().numpy(),
+            greedy_out=gen,
         )
-        print(f"{name}: logits {tuple(logits.shape)}")
+        print(f"{name}: logits {tuple(logits.shape)}, "
+              f"greedy {gen[T:].tolist()}")
 
 
 def main() -> None:
@@ -207,6 +218,7 @@ def main() -> None:
         gen = model.generate(
             input_ids=torch.from_numpy(ids[:1, : len(enc[0])]),
             max_new_tokens=16,
+            min_new_tokens=16,  # pin length: eos-less golden
             do_sample=False,
             num_beams=1,
         ).numpy()[0]
