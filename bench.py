@@ -19,7 +19,11 @@ arrive at this rate and TTFT is measured from arrival, the number the
 p50<200ms target is about), BENCH_IMPL (auto|pallas|xla decode attention),
 BENCH_COMPARE (default 1 on hardware: measure BOTH attention impls,
 report the better with both numbers in the line; 0 = single BENCH_IMPL
-run), BENCH_FORCE_CPU=1 (tiny-model smoke mode),
+run), BENCH_FORCE_CPU=1 (tiny-model smoke mode), BENCH_CPU_FULL=1
+(BASELINE.md config 1: the REAL BENCH_MODEL on the CPU backend, batch 1,
+greedy single-request decode, f32 — the CPU-backend baseline config is
+measurable with no TPU at all; defaults clamp to prompt 64 / 32 new
+tokens so a 1-core run finishes in minutes),
 BENCH_INIT_TIMEOUT_S (180).
 
 Scale knobs (BASELINE.json's metric is tok/s/chip AT 8B — measure it):
@@ -91,20 +95,28 @@ _MODEL_SLUGS = {
 
 def main() -> None:
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
+    cpu_full = os.environ.get("BENCH_CPU_FULL") == "1"
     model_name = os.environ.get("BENCH_MODEL", "llama-3.2-1b")
     quant = os.environ.get("BENCH_QUANT", "none")
     slug = _MODEL_SLUGS.get(
         model_name, "".join(c for c in model_name if c.isalnum())
     )
-    metric = (
-        "decode_tokens_per_sec_tiny_cpu" if force_cpu
-        else "decode_tokens_per_sec_%s_%s" % (
+    if force_cpu:
+        metric = "decode_tokens_per_sec_tiny_cpu"
+    elif cpu_full:
+        # BASELINE.md config 1: real model, CPU backend, single request
+        metric = f"decode_tokens_per_sec_{slug}_f32_cpu_single"
+    else:
+        metric = "decode_tokens_per_sec_%s_%s" % (
             slug, quant if quant != "none" else "bf16"
         )
-    )
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
-    new_tokens = int(os.environ.get("BENCH_NEW", "128"))
+    batch = int(os.environ.get("BENCH_BATCH", "1" if cpu_full else "64"))
+    prompt_len = int(os.environ.get(
+        "BENCH_PROMPT", "64" if cpu_full else "128"
+    ))
+    new_tokens = int(os.environ.get(
+        "BENCH_NEW", "32" if cpu_full else "128"
+    ))
     rate_rps = float(os.environ.get("BENCH_RATE_RPS", "0"))
     # 64 measured best on-chip r4 for burst throughput (2187 tok/s vs
     # 2120 at 16, 1B bf16) — but in steady-state rate mode the host
@@ -134,6 +146,24 @@ def main() -> None:
     # the TTFT delta vs BENCH_SHARED_PREFIX=0 is the prefix cache's
     # measured value, and the record carries the allocator's hit rate
     shared_prefix = int(os.environ.get("BENCH_SHARED_PREFIX", "0"))
+    if force_cpu and cpu_full:
+        _emit({
+            "metric": metric, "value": 0.0, "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "error": "BENCH_FORCE_CPU and BENCH_CPU_FULL are mutually "
+                     "exclusive (tiny smoke vs real-model CPU baseline)",
+        })
+        sys.exit(2)
+    if cpu_full and quant != "none":
+        # BASELINE config 1 is the f32 CPU baseline; a quantized run
+        # under the _f32_cpu_single metric name would lie
+        _emit({
+            "metric": metric, "value": 0.0, "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "error": "BENCH_CPU_FULL is the f32 CPU baseline (config 1); "
+                     "BENCH_QUANT must be none",
+        })
+        sys.exit(2)
     if shared_prefix < 0:
         _emit({
             "metric": metric, "value": 0.0, "unit": "tokens/s",
@@ -186,7 +216,8 @@ def main() -> None:
     # watchdog out. (Inline copy of tools/_relay.py's gate: the driver
     # runs bench.py standalone, so no tools/ import here — keep the
     # port set in sync with tools/_relay.RELAY_PORTS.)
-    if not force_cpu and os.environ.get("JAX_PLATFORMS", "") == "axon":
+    if (not force_cpu and not cpu_full
+            and os.environ.get("JAX_PLATFORMS", "") == "axon"):
         import socket
 
         relay_ports = (8082, 8083, 8087, 8092)
@@ -229,7 +260,7 @@ def main() -> None:
 
     import jax
 
-    if force_cpu:
+    if force_cpu or cpu_full:
         jax.config.update("jax_platforms", "cpu")
     # persistent XLA compile cache (same policy as the server's):
     # hardware windows are short and flaky — the r4 b256 step died to
@@ -281,7 +312,9 @@ def main() -> None:
                 "vs_baseline": 0.0, "error": str(e),
             })
             sys.exit(2)
-        dtype = jnp.bfloat16
+        # CPU-backend baseline (config 1) runs f32 — oneDNN's fast path;
+        # bf16 matmuls take a slow emulation route on CPU
+        dtype = jnp.float32 if cpu_full else jnp.bfloat16
         pages_per_seq = -(-(prompt_len + new_tokens + 16) // 16)
         paged = PagedCacheConfig(
             num_pages=(batch + 2) * pages_per_seq + 16,
@@ -557,7 +590,8 @@ def main() -> None:
     # BENCH_COMPARE=1 is also explicit
     compare = os.environ.get(
         "BENCH_COMPARE",
-        "0" if force_cpu or "BENCH_IMPL" in os.environ else "1",
+        "0" if force_cpu or cpu_full or "BENCH_IMPL" in os.environ
+        else "1",
     )
     if compare == "1":
         # measure BOTH attention impls (default on hardware); report the
@@ -621,7 +655,11 @@ def main() -> None:
         **({"draft": draft_mode, "spec": r["spec"]}
            if r.get("spec") else {}),
         "weight_bytes": weight_bytes,
-        "roofline_tokens_per_sec": round(roofline, 1),
+        # the roofline is an HBM-bandwidth bound — meaningless for CPU
+        # rows (smoke/config-1), where emitting it would hand consumers
+        # a nonsense value/roofline ratio
+        **({"roofline_tokens_per_sec": round(roofline, 1)}
+           if platform != "cpu" else {}),
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
