@@ -154,6 +154,15 @@ def main() -> None:
                      "exclusive (tiny smoke vs real-model CPU baseline)",
         })
         sys.exit(2)
+    if cpu_full and batch != 1:
+        # the metric name says _single; a batched run under it would lie
+        _emit({
+            "metric": metric, "value": 0.0, "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "error": "BENCH_CPU_FULL is the single-request baseline "
+                     f"(config 1); BENCH_BATCH must be 1, got {batch}",
+        })
+        sys.exit(2)
     if cpu_full and quant != "none":
         # BASELINE config 1 is the f32 CPU baseline; a quantized run
         # under the _f32_cpu_single metric name would lie

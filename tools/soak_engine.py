@@ -1,0 +1,137 @@
+"""Time-budgeted engine soak: randomized fuzz scenarios until the clock
+runs out, fresh seed per iteration.
+
+The committed fuzz tier (tests/test_engine_fuzz.py) runs a handful of
+fixed seeds per scenario so the suite stays fast; this driver reuses the
+SAME workload generator and invariant checks but burns idle machine time
+on ever-new seeds across the scenario matrix (plain / preemption /
+speculative / sliding-window / CP mesh / CP x PP). Any violation prints
+the scenario + seed — which then becomes a committed regression seed in
+the test file.
+
+    python tools/soak_engine.py [minutes] [--scenarios plain,spec,...]
+
+Exit 0 = clean soak; exit 1 = invariant violation (details on stdout).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_inference_server_tpu.engine.engine import (  # noqa: E402
+    EngineConfig,
+    LLMEngine,
+)
+from distributed_inference_server_tpu.engine.kv_cache import (  # noqa: E402
+    PagedCacheConfig,
+)
+from distributed_inference_server_tpu.models import llama  # noqa: E402
+from distributed_inference_server_tpu.models.configs import (  # noqa: E402
+    TINY,
+    TINY_SWA,
+)
+from distributed_inference_server_tpu.models.tokenizer import (  # noqa: E402
+    ByteTokenizer,
+)
+from distributed_inference_server_tpu.parallel import (  # noqa: E402
+    MeshSpec,
+    make_mesh,
+)
+
+import test_engine_fuzz as fz  # noqa: E402  (the committed generator)
+
+TOK = ByteTokenizer()
+# capacity (max_pages_per_seq * page_size = 64) must cover prompt_max 36
+# + max_tokens 24: capacity errors are the ENGINE working as designed,
+# not an invariant violation, so the workload must stay inside it (the
+# committed fuzz tier uses the same geometry)
+PAGED = PagedCacheConfig(num_pages=24, page_size=4, max_pages_per_seq=16)
+
+
+def _params(cfg=TINY, key=0):
+    return llama.init_params(jax.random.PRNGKey(key), cfg, jnp.float32)
+
+
+def _build(scenario, params, draft):
+    if scenario == "plain":
+        return LLMEngine(params, TINY, TOK, EngineConfig(
+            max_batch=4, prefill_buckets=(8, 32), paged=PAGED,
+            decode_block_size=4,
+        ), dtype=jnp.float32)
+    if scenario == "spec":
+        return LLMEngine(params, TINY, TOK, EngineConfig(
+            max_batch=3, prefill_buckets=(8, 32), paged=PAGED,
+            decode_block_size=3,
+        ), dtype=jnp.float32, draft_params=draft, draft_cfg=TINY)
+    if scenario == "swa":
+        return LLMEngine(_params(TINY_SWA, 3), TINY_SWA, TOK, EngineConfig(
+            max_batch=4, prefill_buckets=(8, 32), paged=PAGED,
+            decode_block_size=4,
+        ), dtype=jnp.float32)
+    if scenario == "cp":
+        return LLMEngine(params, TINY, TOK, EngineConfig(
+            max_batch=2, prefill_buckets=(16,), paged=PagedCacheConfig(
+                num_pages=64, page_size=8, max_pages_per_seq=8,
+            ),
+        ), dtype=jnp.float32, mesh=make_mesh(MeshSpec(seq=2)))
+    if scenario == "cp_pp":
+        return LLMEngine(params, TINY, TOK, EngineConfig(
+            max_batch=2, prefill_buckets=(16,), pp_microbatches=2,
+            paged=PagedCacheConfig(
+                num_pages=64, page_size=8, max_pages_per_seq=8,
+            ),
+        ), dtype=jnp.float32, mesh=make_mesh(MeshSpec(seq=2, stage=2)))
+    raise ValueError(scenario)
+
+
+def main() -> int:
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
+    scenarios = ["plain", "spec", "swa", "cp", "cp_pp"]
+    for a in sys.argv[2:]:
+        if a.startswith("--scenarios"):
+            scenarios = a.split("=", 1)[1].split(",")
+    params = _params()
+    draft = _params(TINY, 9)
+    deadline = time.time() + minutes * 60
+    it = 0
+    base_seed = int(time.time()) * 1000
+    while time.time() < deadline:
+        for sc in scenarios:
+            if time.time() >= deadline:
+                break
+            seed = base_seed + it
+            it += 1
+            eng = _build(sc, params, draft)
+            try:
+                fz._fuzz(eng, seed, n_requests=10, prompt_max=36)
+            except AssertionError as e:
+                print(f"VIOLATION scenario={sc} seed={seed}: {e}",
+                      flush=True)
+                return 1
+            print(f"ok scenario={sc} seed={seed} "
+                  f"({int(deadline - time.time())}s left)", flush=True)
+    print(f"soak clean: {it} iterations across {scenarios}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
