@@ -157,3 +157,18 @@ class TestCPxPP:
         with pytest.raises(ValueError, match="microbatches"):
             cp_pp_prefill(params, TINY, mesh, jnp.zeros((3, 16), jnp.int32),
                           jnp.asarray([16, 16, 16]), num_microbatches=2)
+
+    def test_ulysses_rejected_on_stage_mesh(self, params):
+        from distributed_inference_server_tpu.parallel.cp import (
+            cp_paged_prefill_any,
+        )
+
+        mesh = make_mesh(MeshSpec(seq=2, stage=2))
+        pool = jnp.zeros((TINY.num_layers, 64, TINY.num_kv_heads,
+                          TINY.head_dim))
+        with pytest.raises(ValueError, match="ring"):
+            cp_paged_prefill_any(
+                params, TINY, mesh, jnp.zeros((1, 16), jnp.int32),
+                jnp.asarray([16]), pool, pool,
+                jnp.zeros((1, 16), jnp.int32), sp_impl="ulysses",
+            )

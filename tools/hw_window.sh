@@ -37,12 +37,20 @@ step() {
     echo "--- device hang; step skipped ---" | tee -a "$LOG"
     return 2
   fi
-  timeout "$tmo" "$@" 2>&1 | grep -vE "WARNING.*xla_bridge" | tail -6 | tee -a "$LOG"
-  local rc=${PIPESTATUS[0]}
+  local out="/tmp/hw_step_out.$$"
+  timeout "$tmo" "$@" >"$out" 2>&1
+  local rc=$?
+  grep -vE "WARNING.*xla_bridge" "$out" | tail -6 | tee -a "$LOG"
   echo "--- exit=$rc ---" | tee -a "$LOG"
   if [ "$rc" -eq 0 ]; then
     echo "$name" >>"$DONE"
+    # consolidate machine-readable records: every JSON line a successful
+    # step printed lands in one jsonl the judge/driver can read directly
+    grep -hE '^\{.*\}$' "$out" 2>/dev/null \
+      | sed "s/^/{\"step\": \"$name\", \"record\": /; s/$/}/" \
+      >> /root/repo/BENCH_RESULTS_r05.jsonl || true
   fi
+  rm -f "$out"
 }
 
 # 0. liveness gate: skip the whole window if the device hangs

@@ -100,12 +100,27 @@ def _build(scenario, params, draft):
                 num_pages=64, page_size=8, max_pages_per_seq=8,
             ),
         ), dtype=jnp.float32, mesh=make_mesh(MeshSpec(seq=2, stage=2)))
+    if scenario == "gemma2":
+        from distributed_inference_server_tpu.models.configs import (
+            TINY_GEMMA2,
+        )
+
+        return LLMEngine(_params(TINY_GEMMA2, 5), TINY_GEMMA2, TOK,
+                         EngineConfig(
+            max_batch=3, prefill_buckets=(8, 32), paged=PAGED,
+            decode_block_size=3,
+        ), dtype=jnp.float32)
+    if scenario == "kvint8":
+        return LLMEngine(params, TINY, TOK, EngineConfig(
+            max_batch=4, prefill_buckets=(8, 32), paged=PAGED,
+            decode_block_size=4, kv_quant="int8", attention_impl="xla",
+        ), dtype=jnp.float32)
     raise ValueError(scenario)
 
 
 def main() -> int:
     minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
-    scenarios = ["plain", "spec", "swa", "cp", "cp_pp"]
+    scenarios = ["plain", "spec", "swa", "cp", "cp_pp", "gemma2", "kvint8"]
     for a in sys.argv[2:]:
         if a.startswith("--scenarios"):
             scenarios = a.split("=", 1)[1].split(",")
