@@ -320,3 +320,31 @@ def test_engine_int8_pallas_path_end_to_end(tiny_params, monkeypatch):
                                                 temperature=0.0))
     rx = _drain(ref)["x"]
     assert rp["tokens"] == rx["tokens"]
+
+
+def test_int8_kv_with_speculative_draft(tiny_params):
+    """Speculative decoding over int8 KV pools (target AND draft pools
+    quantize): greedy output matches the plain int8 engine — rejection
+    sampling must hold bit-exactness on the quantized cache too."""
+    draft = llama.init_params(jax.random.PRNGKey(9), TINY, jnp.float32)
+    prompt = TOK.encode("spec over int8 kv")
+    plain = _make_engine(tiny_params)
+    plain.add_request("a", prompt, SamplingParams(max_tokens=8,
+                                                  temperature=0.0))
+    rp = _drain(plain)["a"]
+
+    spec = LLMEngine(
+        tiny_params, TINY, TOK,
+        EngineConfig(
+            max_batch=4, prefill_buckets=(16,),
+            paged=PagedCacheConfig(num_pages=24, page_size=4,
+                                   max_pages_per_seq=8),
+            decode_block_size=3, kv_quant="int8", attention_impl="xla",
+        ),
+        dtype=jnp.float32, draft_params=draft, draft_cfg=TINY,
+    )
+    spec.add_request("b", prompt, SamplingParams(max_tokens=8,
+                                                 temperature=0.0))
+    rs = _drain(spec)["b"]
+    assert rs["error"] is None
+    assert rp["tokens"] == rs["tokens"]
