@@ -119,14 +119,16 @@ class KVCache(NamedTuple):
 
 
 def _write_kv(
-    cache_layer: jnp.ndarray, new: jnp.ndarray, write_pos: jnp.ndarray
+    cache: jnp.ndarray, l, new: jnp.ndarray, write_pos: jnp.ndarray
 ) -> jnp.ndarray:
-    """Scatter new K or V ([B, T, KV, D]) into a cache layer ([B, S, KV, D])
-    at per-row positions ([B, T]); out-of-range positions are dropped (used
-    to discard padding tokens)."""
+    """Scatter new K or V ([B, T, KV, D]) into layer ``l`` of the STACKED
+    dense cache ([L, B, S, KV, D]) at per-row positions ([B, T]);
+    out-of-range positions are dropped (used to discard padding tokens).
+    One scatter on the stacked buffer — the form XLA aliases in place
+    when the cache is a scan carry (see scan_layer_blocks)."""
     B = new.shape[0]
     rows = jnp.arange(B)[:, None]
-    return cache_layer.at[rows, write_pos].set(new, mode="drop")
+    return cache.at[l, rows, write_pos].set(new, mode="drop")
 
 
 # ---------------------------------------------------------------------------
@@ -255,12 +257,13 @@ def _run_layers(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Shared transformer trunk: embed, scan layer blocks, final norm.
 
-    The cache backend is pluggable: ``write_fn(cache_layer, new_kv) ->
-    cache_layer`` scatters the new tokens' K/V into one layer's cache;
+    The cache backend is pluggable: ``write_fn(pool, l, new_kv) ->
+    pool`` scatters the new tokens' K/V into layer ``l`` of the STACKED
+    cache in one op (scan-carry in-place aliasing — scan_layer_blocks);
     ``attend_fn(q, k_layer, v_layer, window) -> out`` runs attention
-    against it (``window`` = the layer's sliding window, 0 = full causal).
-    Dense (contiguous) and paged backends both route through here, so the
-    block body exists exactly once.
+    against this layer's cache view (``window`` = the layer's sliding
+    window, 0 = full causal). Dense (contiguous) and paged backends both
+    route through here, so the block body exists exactly once.
 
     Returns (normed hidden [B, T, H], new cache_k, new cache_v).
     """
@@ -280,6 +283,42 @@ def _run_layers(
     return h, new_k, new_v
 
 
+def make_paged_write_fn(write_slots, kv_quantized: bool):
+    """Stacked-pool write_fn for the paged cache: one scatter at
+    ``[l, write_slots]`` (mode="drop" — out-of-range slots are padding),
+    quantizing at write time for QuantPool pools. The ONE definition
+    shared by ``paged_forward`` and ``parallel/pp.py:pp_paged_forward``
+    so the quantized write path cannot drift between them."""
+    from distributed_inference_server_tpu.ops.quant import (
+        QuantPool,
+        quantize_kv,
+    )
+
+    def write_fn(pool, l, new):
+        if kv_quantized:
+            codes, scale = quantize_kv(new)
+            return QuantPool(
+                pool.data.at[l, write_slots].set(codes, mode="drop"),
+                pool.scale.at[l, write_slots].set(scale, mode="drop"),
+            )
+        return pool.at[l, write_slots].set(new, mode="drop")
+
+    return write_fn
+
+
+def pool_at(pool, l):
+    """Read layer ``l``'s cache from a stacked pool (QuantPool-aware).
+
+    A pure read: XLA fuses the dynamic-slice into the downstream gather
+    (gather-of-slice folds the layer offset into the gather indices), so
+    only the gathered rows cost HBM traffic."""
+    from distributed_inference_server_tpu.ops.quant import QuantPool
+
+    if isinstance(pool, QuantPool):
+        return QuantPool(pool_at(pool.data, l), pool_at(pool.scale, l))
+    return lax.dynamic_index_in_dim(pool, l, 0, keepdims=False)
+
+
 def scan_layer_blocks(cfg, h, layers, cache_k, cache_v, windows, positions,
                       write_fn, attend_fn, inv_freq, moe_impl="dense",
                       valid_tokens=None):
@@ -287,30 +326,44 @@ def scan_layer_blocks(cfg, h, layers, cache_k, cache_v, windows, positions,
     body exists (``_run_layers`` and both pipeline-parallel stage runners
     in parallel/pp.py drive their layer stacks through here).
 
+    The KV pools ride the scan as CARRY, not xs/ys (changed r5): the
+    xs->ys form forced XLA to materialize the ENTIRE stacked pool as a
+    fresh scan output every call — ~1.26 GB/decode-step of pure copy
+    traffic at the 1B bench geometry, growing with batch (the prime
+    suspect for the 10x roofline gap and the superlinear b128 step
+    cost; CPU microbenchmark: 266 ms/call xs->ys vs 0.03 ms carried at
+    a 135 MB pool). With the pools carried, ``write_fn(pool, l, new)``
+    scatters DIRECTLY into the stacked buffer at layer ``l`` (XLA
+    aliases scan carries in place, so only the written rows move), and
+    reads extract layer ``l`` via ``pool_at`` (fuses into the gather).
+    NOTE the write MUST be a single 2D scatter on the stacked pool —
+    extract-scatter-writeback does NOT fuse (85 ms/call measured).
+
     ``windows`` rides the scan as per-layer data (Gemma-2's alternating
     local/global schedule shares ONE compiled block body — no per-layer
     recompile, no unrolled scan) or is None when no layer slides: then
     window=None is passed STATICALLY so full-causal models keep
     gqa_attention's maskless branch instead of paying a traced
     (w <= 0) | ... [B, T, S] term every layer."""
-    if windows is None:
-        def block(h, xs):
-            layer, k_layer, v_layer = xs
-            return layer_block(
-                cfg, layer, h, positions, k_layer, v_layer, write_fn,
-                attend_fn, inv_freq, moe_impl, valid_tokens, window=None,
-            )
+    L = layers["attn_norm"].shape[0]
+    idx = jnp.arange(L, dtype=jnp.int32)
 
-        return lax.scan(block, h, (layers, cache_k, cache_v))
-
-    def block(h, xs):
-        layer, k_layer, v_layer, window = xs
-        return layer_block(
-            cfg, layer, h, positions, k_layer, v_layer, write_fn,
+    def block(carry, xs):
+        h, ck, cv = carry
+        if windows is None:
+            layer, l = xs
+            window = None
+        else:
+            layer, l, window = xs
+        h, ck, cv = layer_block(
+            cfg, layer, h, positions, ck, cv, l, write_fn,
             attend_fn, inv_freq, moe_impl, valid_tokens, window=window,
         )
+        return (h, ck, cv), None
 
-    return lax.scan(block, h, (layers, cache_k, cache_v, windows))
+    xs = (layers, idx) if windows is None else (layers, idx, windows)
+    (h, ck, cv), _ = lax.scan(block, (h, cache_k, cache_v), xs)
+    return h, (ck, cv)
 
 
 def layer_block(
@@ -318,8 +371,9 @@ def layer_block(
     layer: Dict[str, jnp.ndarray],
     h: jnp.ndarray,
     positions: jnp.ndarray,
-    k_layer: jnp.ndarray,
-    v_layer: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    l: jnp.ndarray,
     write_fn,
     attend_fn,
     inv_freq: jnp.ndarray,
@@ -327,10 +381,14 @@ def layer_block(
     valid_tokens: Optional[jnp.ndarray] = None,
     window=0,
 ):
-    """One transformer block (attention + MLP/MoE) against one layer's
+    """One transformer block (attention + MLP/MoE) against the STACKED
     cache — the scan body of ``_run_layers``, exposed so the pipeline-
-    parallel runner (parallel/pp.py) can drive per-stage layer stacks.
+    parallel runners (parallel/pp.py) can drive per-stage layer stacks.
 
+    ``pool_k``/``pool_v`` are the full (local) stacked pools and ``l``
+    the traced layer index; ``write_fn(pool, l, new) -> pool`` must
+    scatter in one op on the stacked buffer (see scan_layer_blocks on
+    why), and attention reads this layer's cache via ``pool_at``.
     ``window`` is this layer's sliding window (0 = full causal; may be a
     traced scalar riding the layer scan) and is handed to ``attend_fn``
     as its fourth argument."""
@@ -350,9 +408,9 @@ def layer_block(
         q = q * jnp.asarray(
             (cfg.head_dim / cfg.query_pre_attn_scalar) ** 0.5, q.dtype
         )
-    k_layer = write_fn(k_layer, k)
-    v_layer = write_fn(v_layer, v)
-    attn = attend_fn(q, k_layer, v_layer, window)
+    pool_k = write_fn(pool_k, l, k)
+    pool_v = write_fn(pool_v, l, v)
+    attn = attend_fn(q, pool_at(pool_k, l), pool_at(pool_v, l), window)
     attn_out = _mm(attn.reshape(B, T, cfg.q_size), layer["wo"])
     if cfg.sandwich_norms:
         attn_out = rms_norm(
@@ -368,7 +426,7 @@ def layer_block(
     if cfg.sandwich_norms:
         mlp_out = rms_norm(mlp_out, layer["post_mlp_norm"], cfg.rms_norm_eps)
     h = h + mlp_out
-    return h, (k_layer, v_layer)
+    return h, pool_k, pool_v
 
 
 def _unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
@@ -404,7 +462,7 @@ def forward(
 
     Returns: (logits [B, T, vocab] f32, updated cache).
     """
-    write_fn = lambda layer, new: _write_kv(layer, new, write_pos)
+    write_fn = lambda pool, l, new: _write_kv(pool, l, new, write_pos)
     attend_fn = lambda q, k, v, w: gqa_attention(
         q, k, v, positions, kv_valid_len, w, cfg.attn_logit_softcap)
     h, new_k, new_v = _run_layers(
@@ -668,15 +726,7 @@ def paged_forward(
                 kv_quantized=kv_quantized,
             )
 
-    def write_fn(layer, new):
-        # layer: [num_slots, KV, D] (or QuantPool); new: [B, T, KV, D]
-        if kv_quantized:
-            codes, scale = quantize_kv(new)
-            return QuantPool(
-                layer.data.at[write_slots].set(codes, mode="drop"),
-                layer.scale.at[write_slots].set(scale, mode="drop"),
-            )
-        return layer.at[write_slots].set(new, mode="drop")
+    write_fn = make_paged_write_fn(write_slots, kv_quantized)
 
     def attend_fn(q, k_layer, v_layer, window):
         if use_pallas:
@@ -730,7 +780,7 @@ def hidden_states(
     endpoint: a cache-less full forward. Returns [B, T, H] f32."""
     B, T = input_ids.shape
     cache = KVCache.create(cfg, B, T, dtype=params["embed"].dtype)
-    write_fn = lambda layer, new: _write_kv(layer, new, positions)
+    write_fn = lambda pool, l, new: _write_kv(pool, l, new, positions)
     attend_fn = lambda q, k, v, w: gqa_attention(
         q, k, v, positions, kv_valid_len, w, cfg.attn_logit_softcap)
     h, _, _ = _run_layers(

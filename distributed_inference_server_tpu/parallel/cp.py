@@ -93,7 +93,7 @@ def cp_prefill(
     cache = llama.KVCache.create(cfg, B, T, dtype=params["embed"].dtype)
     h, new_k, new_v = llama._run_layers(
         params, cfg, input_ids, positions, cache.k, cache.v,
-        lambda layer, new: llama._write_kv(layer, new, write_pos),
+        lambda pool, l, new: llama._write_kv(pool, l, new, write_pos),
         attend,
     )
     last = jnp.take_along_axis(
@@ -233,7 +233,8 @@ def cp_pp_prefill(
         wp_all = jnp.where(pos_l >= 0, slot_of, Tl)
 
         def run_stage(h_mb, pos_mb, ck_mb, cv_mb, wp_mb):
-            write_fn = lambda layer, new: llama._write_kv(layer, new, wp_mb)
+            write_fn = lambda pool, l, new: llama._write_kv(
+                pool, l, new, wp_mb)
 
             def attend_fn(q, k_layer, v_layer, w):
                 # per-shard ring body: KV chunks rotate over `seq` while

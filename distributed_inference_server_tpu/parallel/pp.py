@@ -97,7 +97,8 @@ def pp_forward(
             win_stage = None
 
         def run_stage(h_mb, pos_mb, ck_mb, cv_mb, wp_mb, kvv_mb):
-            write_fn = lambda layer, new: llama._write_kv(layer, new, wp_mb)
+            write_fn = lambda pool, l, new: llama._write_kv(
+                pool, l, new, wp_mb)
             attend_fn = lambda q, k, v, w: gqa_attention(
                 q, k, v, pos_mb, kvv_mb, w, cfg.attn_logit_softcap)
 
@@ -233,7 +234,6 @@ def pp_paged_forward(
         QuantPool,
         dequantize_kv,
         pool_num_slots,
-        quantize_kv,
     )
 
     S = mesh.shape.get("stage", 1)
@@ -260,14 +260,7 @@ def pp_paged_forward(
             win_stage = None
 
         def run_stage(h_mb, pos_mb, pk, pv, ws_mb, gs_mb, kvv_mb):
-            def write_fn(layer, new):
-                if kv_quantized:
-                    codes, scale = quantize_kv(new)
-                    return QuantPool(
-                        layer.data.at[ws_mb].set(codes, mode="drop"),
-                        layer.scale.at[ws_mb].set(scale, mode="drop"),
-                    )
-                return layer.at[ws_mb].set(new, mode="drop")
+            write_fn = llama.make_paged_write_fn(ws_mb, kv_quantized)
 
             def attend_fn(q, k_layer, v_layer, w):
                 if kv_quantized:
