@@ -1,0 +1,50 @@
+"""Summarize BENCH_RESULTS_r05.jsonl into a compact table.
+
+Run after a hardware window to see what landed:
+
+    python tools/summarize_results.py [path]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_RESULTS_r05.jsonl"
+    try:
+        lines = open(path).read().splitlines()
+    except OSError:
+        print(f"no {path} yet (no hardware window has landed records)")
+        return 1
+    rows = []
+    for ln in lines:
+        if not ln.strip():
+            continue
+        try:
+            obj = json.loads(ln)
+        except ValueError:
+            continue
+        r = obj.get("record", {})
+        rows.append((
+            obj.get("step", "?"),
+            r.get("metric", r.get("probe", r.get("kernel", "?"))),
+            r.get("value", r.get("tok_per_s", r.get(
+                "pallas_speedup_blocking", ""))),
+            r.get("unit", ""),
+            r.get("error", ""),
+        ))
+    w = max((len(r[0]) for r in rows), default=4)
+    m = max((len(str(r[1])) for r in rows), default=6)
+    for step, metric, value, unit, err in rows:
+        line = f"{step:<{w}}  {str(metric):<{m}}  {value} {unit}"
+        if err:
+            line += f"  ERROR: {err[:60]}"
+        print(line)
+    print(f"\n{len(rows)} records")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
