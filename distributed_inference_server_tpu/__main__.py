@@ -236,6 +236,9 @@ def main(argv=None) -> int:
             health_check_interval_s=cfg.get("server", "health_check_interval_s"),
             otlp_endpoint=cfg.get("tracing", "otlp_endpoint"),
             otlp_service_name=cfg.get("tracing", "service_name"),
+            # disaggregated prefill/decode serving (docs/DISAGG.md)
+            engine_roles=cfg.engine_roles(),
+            disagg_settings=cfg.disagg_settings(),
         )
         server.start()
     except (ModelLoadError, RuntimeError, TimeoutError) as e:

@@ -46,7 +46,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from distributed_inference_server_tpu.core.errors import CacheFull
+from distributed_inference_server_tpu.core.errors import (
+    CacheDeserializationError,
+    CacheFull,
+)
 from distributed_inference_server_tpu.core.models import FinishReason, Usage
 from distributed_inference_server_tpu.core.types import RequestId
 from distributed_inference_server_tpu.engine.kv_cache import (
@@ -54,6 +57,9 @@ from distributed_inference_server_tpu.engine.kv_cache import (
     PagedCacheConfig,
     PagedKVState,
     QuantPool,
+    deserialize_into_allocator,
+    deserialize_kv,
+    serialize_kv,
 )
 from distributed_inference_server_tpu.engine.speculative import (
     PatternTrackers,
@@ -176,6 +182,33 @@ class EngineConfig:
 
 
 @dataclass
+class SequenceExport:
+    """A live sequence lifted off its engine for KV handoff (disaggregated
+    prefill/decode serving, serving/disagg.py): everything a receiving
+    engine needs to resume decoding exactly where the source stopped —
+    paged K/V bytes (serialize_kv format, Property 12), the host text /
+    emission state, and the sampling params. Token-identical resumption
+    is tested in tests/test_disagg.py."""
+
+    request_id: RequestId
+    token_ids: List[int]  # tokens whose K/V is resident (prompt so far)
+    prompt_len: int
+    seq_len: int  # == len(token_ids) for a completed prefill
+    next_token: int  # sampled, not yet decoded (the migration point)
+    params: SamplingParams
+    output_text: str
+    emitted_upto: int
+    emitted_tokens: int
+    pending_ids: List[int]
+    kv: bytes
+    draft_kv: Optional[bytes] = None
+    source_engine: str = ""
+
+    def kv_bytes(self) -> int:
+        return len(self.kv) + len(self.draft_kv or b"")
+
+
+@dataclass
 class StepOutput:
     """One event emitted by step(): a token delta and/or completion."""
 
@@ -200,7 +233,7 @@ class _Seq:
         "request_id", "token_ids", "prompt_len", "block_table",
         "seq_len", "next_token", "params", "output_text", "emitted_upto",
         "emitted_tokens", "dev_pos", "dev_steps_left", "freed_upto",
-        "pending_ids",
+        "pending_ids", "prefill_only",
     )
 
     def __init__(self, request_id: RequestId, prompt_ids: List[int],
@@ -225,6 +258,10 @@ class _Seq:
         # incremental-detokenization holdback: token ids whose text is an
         # incomplete UTF-8 / byte-fallback sequence (decodes to U+FFFD)
         self.pending_ids: List[int] = []
+        # disaggregated serving (serving/disagg.py): stop after the first
+        # sampled token and park in the handoff-ready set instead of
+        # seating for decode — the KV migrates to a decode engine
+        self.prefill_only = False
 
     def num_output_tokens(self) -> int:
         return len(self.token_ids) - self.prompt_len
@@ -397,6 +434,9 @@ class LLMEngine:
                 )
         self.allocator = _make_allocator(self.pcfg, self.ecfg.native_allocator)
         self.waiting: Deque[_Seq] = deque()
+        # prefill_only sequences whose first token has been emitted: pages
+        # held, waiting for the serving layer to export_handoff() them
+        self._handoff_ready: Dict[RequestId, _Seq] = {}
         self.slots: List[Optional[_Seq]] = [None] * self.ecfg.max_batch
         self._by_id: Dict[RequestId, _Seq] = {}
         self._rng = jax.random.PRNGKey(self.ecfg.seed)
@@ -457,9 +497,13 @@ class LLMEngine:
         request_id: RequestId,
         prompt_ids: List[int],
         params: SamplingParams,
+        prefill_only: bool = False,
     ) -> None:
-        """Queue a tokenized request for execution."""
+        """Queue a tokenized request for execution. ``prefill_only``
+        (disaggregated serving): emit the first sampled token, then park
+        the sequence for KV handoff instead of decoding here."""
         seq = _Seq(request_id, prompt_ids, params)
+        seq.prefill_only = prefill_only
         self._by_id[request_id] = seq
         self.waiting.append(seq)
 
@@ -474,6 +518,7 @@ class LLMEngine:
         seq = self._by_id.pop(request_id, None)
         if seq is None:
             return False
+        self._handoff_ready.pop(request_id, None)
         if seq in self.waiting:
             self.waiting.remove(seq)
         for i, s in enumerate(self.slots):
@@ -567,6 +612,127 @@ class LLMEngine:
     def cache_stats(self):
         return self.allocator.stats()
 
+    # ------------------------------------------------------------------
+    # KV handoff (disaggregated prefill/decode serving, serving/disagg.py)
+    # ------------------------------------------------------------------
+
+    def handoff_ready_ids(self) -> List[RequestId]:
+        """Requests whose prefill finished under ``prefill_only`` and are
+        parked for export (pages held, first token already emitted)."""
+        return list(self._handoff_ready)
+
+    def export_handoff(self, request_id: RequestId) -> Optional[SequenceExport]:
+        """Lift a handoff-ready sequence off this engine: serialize its
+        paged K/V (and the draft pool's, when speculating) plus the host
+        emission state, publish the prompt's full pages so this engine's
+        prefix cache stays warm for future prompts sharing it, then
+        release the pages. Returns None if the request is unknown (e.g.
+        aborted between readiness and export)."""
+        seq = self._handoff_ready.pop(request_id, None)
+        if seq is None or self._by_id.get(request_id) is not seq:
+            return None
+        if seq.freed_upto or self.pcfg.num_pages in seq.block_table:
+            # never reached (window reclaim skips prefill_only), but a
+            # sentinel-holed table must not serialize neighboring
+            # sequences' KV — fail the export loudly; the runner aborts
+            # the request rather than migrating corruption
+            self._handoff_ready[request_id] = seq
+            raise RuntimeError(
+                "handoff candidate has window-reclaimed pages"
+            )
+        ps = self.pcfg.page_size
+        kv = serialize_kv(self.state, seq.block_table, ps, seq.seq_len)
+        draft_kv = (
+            serialize_kv(self.draft_state, seq.block_table, ps, seq.seq_len)
+            if self.draft_state is not None
+            else None
+        )
+        exp = SequenceExport(
+            request_id=seq.request_id,
+            token_ids=list(seq.token_ids),
+            prompt_len=seq.prompt_len,
+            seq_len=seq.seq_len,
+            next_token=int(seq.next_token),
+            params=seq.params,
+            output_text=seq.output_text,
+            emitted_upto=seq.emitted_upto,
+            emitted_tokens=seq.emitted_tokens,
+            pending_ids=list(seq.pending_ids),
+            kv=kv,
+            draft_kv=draft_kv,
+        )
+        self._by_id.pop(request_id, None)
+        if seq.freed_upto == 0:
+            self.allocator.publish(seq.token_ids, seq.block_table)
+        self._release_seq(seq)
+        return exp
+
+    def import_sequence(self, exp: SequenceExport) -> None:
+        """Resume an exported sequence on this engine: allocate pages,
+        restore the serialized K/V with prefix-cache registration
+        (kv_cache.deserialize_into_allocator), and queue the sequence for
+        an immediate decode seat — no prefill recomputation. Raises
+        CacheFull / CacheDeserializationError with the engine unchanged
+        (modulo garbage in freed pages, which is never gathered)."""
+        n = exp.seq_len
+        ps = self.pcfg.page_size
+        if n != len(exp.token_ids) or exp.next_token is None:
+            raise CacheDeserializationError(
+                "export is not at a decode boundary (seq_len != resident "
+                "tokens or no sampled token)"
+            )
+        if n + 1 > self.pcfg.max_seq_len:
+            raise CacheDeserializationError(
+                f"sequence of {n} tokens exceeds this engine's capacity "
+                f"({self.pcfg.max_seq_len} tokens)"
+            )
+        if exp.request_id in self._by_id:
+            raise CacheDeserializationError(
+                f"request {exp.request_id} is already live on this engine"
+            )
+        if (exp.draft_kv is None) != (self.draft_params is None):
+            raise CacheDeserializationError(
+                "draft-model topology mismatch between source and target "
+                "engines (speculation must match across a handoff)"
+            )
+        if exp.draft_kv is None:
+            self.state, pages = deserialize_into_allocator(
+                self.state, self.allocator, exp.kv, exp.token_ids, ps
+            )
+        else:
+            # both pools restore into the SAME pages (shared block
+            # tables); publish only once both succeed, so the prefix
+            # cache never addresses pages with a torn draft half
+            pages = self.allocator.allocate(-(-n // ps))
+            try:
+                self.state, tc = deserialize_kv(self.state, exp.kv, pages, ps)
+                if tc != n:
+                    raise CacheDeserializationError(
+                        f"payload carries {tc} tokens, expected {n}"
+                    )
+                self.draft_state, dtc = deserialize_kv(
+                    self.draft_state, exp.draft_kv, pages, ps
+                )
+                if dtc != n:
+                    raise CacheDeserializationError(
+                        f"draft payload carries {dtc} tokens, expected {n}"
+                    )
+            except Exception:
+                self.allocator.release(pages)
+                raise
+            self.allocator.publish(exp.token_ids, pages)
+        seq = _Seq(exp.request_id, list(exp.token_ids), exp.params)
+        seq.prompt_len = exp.prompt_len  # ctor set it to len(token_ids)
+        seq.block_table = list(pages)
+        seq.seq_len = n
+        seq.next_token = int(exp.next_token)
+        seq.output_text = exp.output_text
+        seq.emitted_upto = int(exp.emitted_upto)
+        seq.emitted_tokens = int(exp.emitted_tokens)
+        seq.pending_ids = list(exp.pending_ids)
+        self._by_id[seq.request_id] = seq
+        self.waiting.append(seq)
+
     def warmup(self) -> None:
         """Compile every serving program before traffic arrives: one
         throwaway request per prefill bucket (compiles that bucket's
@@ -637,6 +803,18 @@ class LLMEngine:
                     error=f"prompt of {n} tokens exceeds the engine "
                           f"capacity ({self.pcfg.max_seq_len} tokens)",
                 ))
+                continue
+            if (
+                seq.next_token is not None
+                and seq.block_table
+                and seq.seq_len >= len(seq.token_ids)
+            ):
+                # imported via KV handoff (import_sequence): K/V already
+                # resident in this engine's pages — seat straight into
+                # the decode carry, no prefill
+                self.waiting.popleft()
+                self.slots[slot] = seq
+                self._stage_seat(slot, seq)
                 continue
             try:
                 self._start_prefill(seq)
@@ -822,7 +1000,14 @@ class LLMEngine:
                         request_id=s.request_id, finished=True, error=str(e)))
                     continue
                 if self._by_id.get(s.request_id) is s:
-                    self._stage_seat(slot, s)
+                    if s.prefill_only:
+                        # disaggregated handoff point: first token is out;
+                        # free the slot but keep the pages — the serving
+                        # layer exports the sequence to a decode engine
+                        self.slots[slot] = None
+                        self._handoff_ready[s.request_id] = s
+                    else:
+                        self._stage_seat(slot, s)
                 # else: finished during its very first token (EOS or
                 # max_tokens=1) — _finish already cleared the slot
 
@@ -968,7 +1153,11 @@ class LLMEngine:
         self._emit_token(s, int(np.asarray(toks)[0]), outputs,
                          float(np.asarray(lps)[0]))
         if self._by_id.get(s.request_id) is s:
-            self._stage_seat(slot, s)
+            if s.prefill_only:
+                self.slots[slot] = None
+                self._handoff_ready[s.request_id] = s
+            else:
+                self._stage_seat(slot, s)
 
     def _with_mesh(self, fn: Callable) -> Callable:
         """Run a jitted step inside the mesh context (PartitionSpec-based
@@ -2064,6 +2253,11 @@ class LLMEngine:
         Turns per-sequence KV from O(length) into O(window)."""
         W = self.cfg.sliding_window
         if not W or not seq.block_table:
+            return
+        if seq.prefill_only:
+            # a handoff candidate must keep EVERY page serializable:
+            # sentinel-holed tables cannot migrate (and the import-side
+            # prefix registration would content-address garbage pages)
             return
         if self.cfg.sliding_window_pattern:
             # Gemma-2-style alternating layers: the GLOBAL layers still

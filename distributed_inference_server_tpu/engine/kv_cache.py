@@ -383,6 +383,37 @@ def serialize_kv(
     return buf.getvalue()
 
 
+def deserialize_into_allocator(
+    state: PagedKVState,
+    allocator: "PageAllocator",
+    data: bytes,
+    tokens: Sequence[int],
+    page_size: int,
+) -> Tuple[PagedKVState, List[int]]:
+    """KV-handoff import primitive: allocate pages for ``tokens`` from a
+    LIVE allocator, restore the serialized K/V into them, and content-
+    address the full pages so future prompts sharing the prefix reuse
+    them (Property 9 carries across the handoff). Returns
+    ``(new_state, page_ids)``; the caller owns one reference per page
+    (release() them when the sequence finishes). On any failure no pages
+    stay allocated. Raises CacheFull / CacheDeserializationError."""
+    n = len(tokens)
+    if n <= 0:
+        raise CacheDeserializationError("cannot import an empty sequence")
+    pages = allocator.allocate(-(-n // page_size))
+    try:
+        new_state, token_count = deserialize_kv(state, data, pages, page_size)
+        if token_count != n:
+            raise CacheDeserializationError(
+                f"payload carries {token_count} tokens, expected {n}"
+            )
+    except Exception:
+        allocator.release(pages)
+        raise
+    allocator.publish(tokens, pages)
+    return new_state, pages
+
+
 def deserialize_kv(
     state: PagedKVState, data: bytes, page_ids: Sequence[int], page_size: int
 ) -> Tuple[PagedKVState, int]:

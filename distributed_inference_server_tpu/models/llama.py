@@ -551,7 +551,7 @@ def shard_pallas_attend(fn, mesh, decode_step: bool,
     probe lowers the SAME shard_map program the serving path launches —
     a standalone kernel lowering could in principle pass Mosaic while the
     sharded lowering fails (or vice versa)."""
-    from jax import shard_map
+    from distributed_inference_server_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from distributed_inference_server_tpu.ops.quant import QuantPool

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from distributed_inference_server_tpu.utils.compat import tpu_compiler_params
 
 _NEG_INF = -1e30
 _LANES = 128  # VPU lane width; scratch statistics are broadcast across lanes
@@ -515,7 +516,7 @@ def paged_attention_prefill(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KVc, T * C * G, CD), q.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -670,7 +671,7 @@ def paged_attention_decode(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, CD), q.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             # rows are independent — scratch state is reset per grid step
             # — so let megacore split the batch
             dimension_semantics=("parallel",),

@@ -73,7 +73,11 @@ def nucleus_cutoff(probs: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
     # top_p == 0 (or a float-sum shortfall at top_p == 1) leaves lo at an
     # endpoint; clamping to pmax guarantees the top-1 token always survives
     # while never excluding a token the prefix rule would keep.
-    return jnp.minimum(lo, pmax)
+    # top_p >= 1 pins the cutoff to 0 explicitly: when the f32 probability
+    # sum lands a hair ABOVE 1.0, the bisection would otherwise find a
+    # positive threshold and shave ~1e-7 of tail mass off the "keep
+    # everything" contract.
+    return jnp.where(tp >= 1.0, 0.0, jnp.minimum(lo, pmax))
 
 
 def sample_tokens(

@@ -20,6 +20,7 @@ counts (GQA keeps its group structure after the scatter).
 from __future__ import annotations
 
 import jax
+from distributed_inference_server_tpu.utils.compat import axis_size, shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -49,7 +50,7 @@ def ulysses_attention(
 
     Returns [B, Tl, H, D] in q.dtype — attention over the FULL sequence.
     """
-    s = lax.axis_size(axis_name)
+    s = axis_size(axis_name)
     H, KV = q.shape[2], k.shape[2]
     if H % s or KV % s:
         raise ValueError(
@@ -94,7 +95,7 @@ def ulysses_attention_sharded(
         P("data"),
     )
     if sliding_window is None:
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda *a: ulysses_attention(*a, axis_name=axis_name,
                                          attn_softcap=attn_softcap),
             mesh=mesh,
@@ -103,7 +104,7 @@ def ulysses_attention_sharded(
             check_vma=False,
         )
         return fn(q, k, v, q_positions, kv_valid_len)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda q, k, v, qp, kv, w: ulysses_attention(
             q, k, v, qp, kv, axis_name=axis_name, sliding_window=w,
             attn_softcap=attn_softcap,

@@ -9,6 +9,14 @@ from distributed_inference_server_tpu.serving.batcher import (
     AdmissionBatcher,
     BatcherConfig,
 )
+from distributed_inference_server_tpu.serving.disagg import (
+    DisaggController,
+    DisaggSettings,
+    InProcessChannel,
+    KVTransferChannel,
+    ProtowireChannel,
+    parse_roles,
+)
 from distributed_inference_server_tpu.serving.dispatcher import Dispatcher
 from distributed_inference_server_tpu.serving.handler import InferenceHandler
 from distributed_inference_server_tpu.serving.metrics import (
@@ -37,6 +45,12 @@ __all__ = [
     "AdmissionBatch",
     "AdmissionBatcher",
     "BatcherConfig",
+    "DisaggController",
+    "DisaggSettings",
+    "InProcessChannel",
+    "KVTransferChannel",
+    "ProtowireChannel",
+    "parse_roles",
     "Dispatcher",
     "InferenceHandler",
     "EngineStatus",

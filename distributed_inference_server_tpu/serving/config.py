@@ -49,6 +49,12 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
             str, "~/.cache/distributed-inference-server-tpu/xla"
         ),
         "num_engines": (int, 1),
+        # disaggregated prefill/decode serving (serving/disagg.py;
+        # docs/DISAGG.md): comma-separated role per replica, e.g.
+        # "prefill,decode" for num_engines=2. "" = all unified (the
+        # monolithic default). Validated against num_engines and for
+        # nonsensical topologies (decode with no prefill and vice versa).
+        "engine_roles": (str, ""),
         "strategy": (str, "least_loaded"),
         "auto_restart": (bool, True),
         "health_check_interval_s": (float, 1.0),
@@ -104,6 +110,16 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # QuantPool — half the KV HBM traffic, double the context
         # capacity; forces the XLA attention path)
         "kv_quant": (str, "none"),
+    },
+    "disagg": {
+        # migration budget per handoff: past the deadline (or after the
+        # retries) the request decodes in place on its prefill engine
+        "handoff_timeout_s": (float, 5.0),
+        "handoff_retries": (int, 1),
+        # transfer backend: "inproc" (zero-copy object pass) or
+        # "protowire" (round-trips the KvHandoff protobuf framing —
+        # the cross-process wire format, exercised in-process)
+        "channel": (str, "inproc"),
     },
     "tracing": {
         # OTLP/HTTP collector URL for span export (utils/otlp.py), e.g.
@@ -189,10 +205,9 @@ def _load_file(path: str) -> Dict[str, Any]:
         with open(path) as f:
             obj = yaml.safe_load(f) or {}
     elif path.endswith(".toml"):
-        import tomllib
+        from distributed_inference_server_tpu.utils.compat import load_toml
 
-        with open(path, "rb") as f:
-            obj = tomllib.load(f)
+        obj = load_toml(path)
     else:
         raise ConfigError(f"unsupported config format: {path} (use .toml/.yaml)")
     if not isinstance(obj, dict):
@@ -296,6 +311,27 @@ class ServerConfig:
     def strategy(self) -> SchedulingStrategy:
         return SchedulingStrategy.parse(self.raw["server"]["strategy"])
 
+    def engine_roles(self):
+        """Validated per-replica role list (serving/disagg.py)."""
+        from distributed_inference_server_tpu.serving.disagg import (
+            parse_roles,
+        )
+
+        return parse_roles(self.raw["server"]["engine_roles"],
+                           self.raw["server"]["num_engines"])
+
+    def disagg_settings(self):
+        from distributed_inference_server_tpu.serving.disagg import (
+            DisaggSettings,
+        )
+
+        d = self.raw["disagg"]
+        return DisaggSettings(
+            handoff_timeout_s=d["handoff_timeout_s"],
+            handoff_retries=d["handoff_retries"],
+            channel=d["channel"],
+        )
+
     # -- validation --------------------------------------------------------
 
     def validate(self) -> None:
@@ -358,6 +394,18 @@ class ServerConfig:
             raise ConfigError(
                 f"model.quantization must be none/int8/int4, "
                 f"got {r['model']['quantization']!r}"
+            )
+        # disaggregated serving: roles parse + topology sanity
+        # (decode-with-no-prefill etc.) live in disagg.parse_roles
+        self.engine_roles()
+        if r["disagg"]["handoff_timeout_s"] <= 0:
+            raise ConfigError("disagg.handoff_timeout_s must be positive")
+        if r["disagg"]["handoff_retries"] < 0:
+            raise ConfigError("disagg.handoff_retries must be >= 0")
+        if r["disagg"]["channel"] not in ("inproc", "protowire"):
+            raise ConfigError(
+                f"disagg.channel must be inproc/protowire, "
+                f"got {r['disagg']['channel']!r}"
             )
 
     def hot_diff(self, other: "ServerConfig") -> Dict[tuple, Any]:

@@ -1,0 +1,496 @@
+"""Disaggregated prefill/decode serving: engine roles, the KV handoff
+channel, and the migration controller.
+
+The serving spine (queue → batcher → scheduler → engine runners) treats
+every engine as a monolith that prefills and decodes in place, which
+couples long-prompt prefill latency to the decode TBT of every other
+request on that replica. This subsystem splits the pipeline:
+
+- every engine runner carries a **role** — ``prefill``, ``decode``, or
+  ``unified`` (the default; preserves the monolithic behavior exactly);
+- the scheduler routes **admission batches to prefill engines** (least-
+  load among non-decode replicas) and, after a request's first token,
+  the runner parks the sequence for **migration**: the engine exports
+  its paged K/V + host state (``LLMEngine.export_handoff``), a
+  **KVTransferChannel** moves the payload, and a decode engine imports
+  it (``LLMEngine.import_sequence``) and resumes decoding at the exact
+  same position — token-identical under greedy sampling (tested in
+  tests/test_disagg.py);
+- the **DisaggController** owns the migration queue and a worker thread
+  with timeout/retry; any failure (channel error, no healthy decode
+  engine, import CacheFull, dtype mismatch) **falls back to decoding in
+  place** on the source engine, so a handoff can degrade the topology
+  but never drop a request. Fallbacks are visible in metrics
+  (``kv_handoff_total{outcome="fallback"}``).
+
+Channel backends: ``InProcessChannel`` hands the payload object over
+zero-copy (the single-process deployment); ``ProtowireChannel`` frames
+it through the ``KvHandoff`` protobuf message (serving/protowire.py,
+contract in serving/inference.proto) — the cross-process wire format a
+gRPC transport will carry, exercised end-to-end in-process so the
+framing cannot rot before the multi-host deployment lands.
+
+Shutdown drains: the controller stops accepting migrations and resumes
+every queued job in place, so graceful shutdown (Req 9.5) holds across
+the disaggregated topology.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Sequence
+
+from distributed_inference_server_tpu.core.errors import ConfigError
+from distributed_inference_server_tpu.engine.engine import (
+    SamplingParams,
+    SequenceExport,
+)
+from distributed_inference_server_tpu.serving import protowire
+from distributed_inference_server_tpu.serving.metrics import MetricsCollector
+
+logger = logging.getLogger(__name__)
+
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_UNIFIED = "unified"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_UNIFIED)
+
+
+class HandoffError(RuntimeError):
+    """A KV handoff attempt failed (channel or import); the controller
+    retries and ultimately falls back to in-place decode."""
+
+
+@dataclass(frozen=True)
+class DisaggSettings:
+    """Knobs for the migration controller (serving config section
+    ``disagg``, CLI ``--disagg-*``)."""
+
+    handoff_timeout_s: float = 5.0
+    handoff_retries: int = 1  # attempts beyond the first
+    channel: str = "inproc"  # inproc | protowire
+
+
+def parse_roles(spec: str, num_engines: int) -> List[str]:
+    """Parse/validate ``server.engine_roles`` ("prefill,decode", ...).
+
+    Empty spec = every engine ``unified`` (today's behavior). Raises
+    ConfigError for unknown roles, a count mismatch with
+    ``server.num_engines``, and nonsensical topologies: decode engines
+    with no prefill engine would never receive work, and prefill engines
+    with no decode engine would have nowhere to hand off.
+    """
+    if not spec.strip():
+        return [ROLE_UNIFIED] * num_engines
+    roles = [r.strip().lower() for r in spec.split(",") if r.strip()]
+    for r in roles:
+        if r not in ROLES:
+            raise ConfigError(
+                f"server.engine_roles: unknown role {r!r} "
+                f"(known: {', '.join(ROLES)})"
+            )
+    if len(roles) != num_engines:
+        raise ConfigError(
+            f"server.engine_roles lists {len(roles)} roles but "
+            f"server.num_engines is {num_engines}"
+        )
+    n_prefill = roles.count(ROLE_PREFILL)
+    n_decode = roles.count(ROLE_DECODE)
+    if n_decode and not n_prefill:
+        raise ConfigError(
+            "server.engine_roles: decode engines without any prefill "
+            "engine would sit idle — prompts are only admitted to "
+            "prefill/unified replicas and only prefill replicas migrate"
+        )
+    if n_prefill and not n_decode:
+        raise ConfigError(
+            "server.engine_roles: prefill engines need at least one "
+            "decode engine to hand off to"
+        )
+    return roles
+
+
+# ---------------------------------------------------------------------------
+# Transfer channels
+# ---------------------------------------------------------------------------
+
+
+class KVTransferChannel:
+    """Moves a SequenceExport from a prefill engine toward a decode
+    engine. ``transfer`` returns the payload as the receiver will see it
+    and raises on failure (the controller retries / falls back)."""
+
+    name = "null"
+
+    def transfer(self, exp: SequenceExport) -> SequenceExport:
+        raise NotImplementedError
+
+
+class InProcessChannel(KVTransferChannel):
+    """Zero-copy in-process handoff: both engines live in this process,
+    so the export object moves by reference — the page bytes are not
+    copied again beyond the device→host pull serialize_kv already did."""
+
+    name = "inproc"
+
+    def transfer(self, exp: SequenceExport) -> SequenceExport:
+        return exp
+
+
+def export_to_wire(exp: SequenceExport) -> bytes:
+    """Encode a SequenceExport as a length-delimited ``KvHandoff``
+    protobuf message (serving/inference.proto)."""
+    obj: Dict[str, Any] = {
+        "request_id": str(exp.request_id),
+        "token_ids": [int(t) for t in exp.token_ids],
+        "prompt_len": exp.prompt_len,
+        "seq_len": exp.seq_len,
+        "next_token": int(exp.next_token),
+        "emitted_tokens": exp.emitted_tokens,
+        "output_text": exp.output_text,
+        "emitted_upto": exp.emitted_upto,
+        "pending_ids": [int(t) for t in exp.pending_ids],
+        "max_tokens": exp.params.max_tokens,
+        "temperature": exp.params.temperature,
+        "top_p": exp.params.top_p,
+        "stop_sequences": list(exp.params.stop_sequences),
+        "kv": exp.kv,
+        "source_engine": exp.source_engine,
+    }
+    if exp.draft_kv is not None:
+        obj["draft_kv"] = exp.draft_kv
+    return protowire.encode("KvHandoff", obj)
+
+
+def export_from_wire(data: bytes) -> SequenceExport:
+    """Decode a ``KvHandoff`` frame back into a SequenceExport."""
+    d = protowire.decode("KvHandoff", data)
+    return SequenceExport(
+        request_id=d["request_id"],
+        token_ids=list(d["token_ids"]),
+        prompt_len=d["prompt_len"],
+        seq_len=d["seq_len"],
+        next_token=d["next_token"],
+        params=SamplingParams(
+            max_tokens=d["max_tokens"],
+            temperature=d["temperature"],
+            top_p=d["top_p"],
+            stop_sequences=tuple(d["stop_sequences"]),
+        ),
+        output_text=d["output_text"],
+        emitted_upto=d["emitted_upto"],
+        emitted_tokens=d["emitted_tokens"],
+        pending_ids=list(d["pending_ids"]),
+        kv=d["kv"],
+        draft_kv=d.get("draft_kv"),
+        source_engine=d["source_engine"],
+    )
+
+
+class ProtowireChannel(KVTransferChannel):
+    """Cross-process framing exercised in-process: every handoff
+    round-trips through the ``KvHandoff`` protobuf encoding, so the wire
+    format the future gRPC transport will carry is differentially tested
+    on every migration instead of rotting in a docstring."""
+
+    name = "protowire"
+
+    def transfer(self, exp: SequenceExport) -> SequenceExport:
+        return export_from_wire(export_to_wire(exp))
+
+
+def make_channel(name: str) -> KVTransferChannel:
+    if name == "inproc":
+        return InProcessChannel()
+    if name == "protowire":
+        return ProtowireChannel()
+    raise ConfigError(
+        f"disagg.channel must be inproc/protowire, got {name!r}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Migration controller
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _MigrationJob:
+    exp: SequenceExport
+    req: Any  # ServerRequest (typed loosely to avoid an import cycle)
+    source: Any  # EngineRunner that prefilled (the in-place fallback)
+    enqueued_at: float = field(default_factory=time.monotonic)
+    deadline: float = 0.0
+    attempts: int = 0
+
+
+class DisaggController:
+    """Owns the migration queue between prefill and decode engines.
+
+    Prefill runners enqueue ``(export, request, source_runner)`` after
+    the first token; the worker thread moves each payload through the
+    channel, picks the least-loaded healthy decode engine
+    (``scheduler.schedule_decode``), and resumes the request there. Any
+    failure — channel error, no decode engine, import rejection — is
+    retried up to ``handoff_retries`` times within ``handoff_timeout_s``,
+    then falls back to resuming on the SOURCE engine, so the request
+    completes (merely un-disaggregated) instead of dropping. Shutdown
+    drains the queue the same way.
+    """
+
+    def __init__(
+        self,
+        scheduler,
+        metrics: Optional[MetricsCollector] = None,
+        channel: Optional[KVTransferChannel] = None,
+        settings: Optional[DisaggSettings] = None,
+    ):
+        self.scheduler = scheduler
+        self.metrics = metrics
+        self.channel = channel or InProcessChannel()
+        self.settings = settings or DisaggSettings()
+        self._jobs: Deque[_MigrationJob] = deque()
+        self._cv = threading.Condition()
+        # requests between dequeue and resume-submit: counted by
+        # pending_count() so the dispatcher's drain loop cannot miss a
+        # request that is in neither a queue nor a runner's inflight map
+        self._migrating: Dict[Any, _MigrationJob] = {}
+        # client disconnects that raced an in-flight migration: checked
+        # right before the resume submit so a dead request is dropped
+        # instead of decoding to completion into a closed sink
+        self._aborted: set = set()
+        self._stop = threading.Event()
+        self._accepting = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._accepting = True
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._worker, name="disagg-migrator", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        """Stop accepting migrations and drain: every queued job resumes
+        in place on its source engine (drain-on-shutdown semantics — a
+        graceful shutdown may lose disaggregation, never requests)."""
+        self._accepting = False
+        self._stop.set()
+        with self._cv:
+            leftovers = list(self._jobs)
+            self._jobs.clear()
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        for job in leftovers:
+            self._fallback(job, "controller shutdown")
+
+    # -- submission (runner threads) ---------------------------------------
+
+    def enqueue(self, exp: SequenceExport, req, source) -> None:
+        """Queue a migration. Called on the source runner's thread right
+        after export; if the controller is not accepting (shutdown race),
+        the request resumes in place immediately."""
+        job = _MigrationJob(
+            exp=exp, req=req, source=source,
+            deadline=time.monotonic() + self.settings.handoff_timeout_s,
+        )
+        if not self._accepting:
+            self._fallback(job, "controller not accepting")
+            return
+        with self._cv:
+            self._jobs.append(job)
+            self._cv.notify()
+
+    def abort(self, request_id) -> bool:
+        """Client disconnect while the request sat in the migration
+        queue (drop the job — pages already released by the export) or
+        mid-migration (flag it so the worker drops it before the resume
+        submit instead of decoding into a closed sink).
+
+        Mid-migration returns False on purpose: the caller
+        (Dispatcher.abort) then also sweeps every runner, covering the
+        window where the resume was already submitted to a target; the
+        flag covers the window where it was not."""
+        with self._cv:
+            for job in self._jobs:
+                if job.req.request_id == request_id:
+                    self._jobs.remove(job)
+                    return True
+            if request_id in self._migrating:
+                self._aborted.add(request_id)
+        return False
+
+    def _consume_abort(self, job: _MigrationJob) -> bool:
+        with self._cv:
+            if job.req.request_id in self._aborted:
+                self._aborted.discard(job.req.request_id)
+                self._migrating.pop(job.req.request_id, None)
+                return True
+        return False
+
+    def _consume_abort_flag(self, request_id) -> bool:
+        """Pop just the abort flag (the _migrating entry is handled by
+        the caller's own finish path)."""
+        with self._cv:
+            if request_id in self._aborted:
+                self._aborted.discard(request_id)
+                return True
+        return False
+
+    def pending_count(self) -> int:
+        with self._cv:
+            return len(self._jobs) + len(self._migrating)
+
+    # -- worker ------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._jobs and not self._stop.is_set():
+                    self._cv.wait(0.1)
+                if self._stop.is_set():
+                    return
+                job = self._jobs.popleft()
+                self._migrating[job.req.request_id] = job
+            try:
+                self._migrate(job)
+            except Exception as e:  # noqa: BLE001 — worker must survive
+                logger.exception("unexpected migration failure")
+                self._fallback(job, str(e))
+
+    def _migrate(self, job: _MigrationJob) -> None:
+        """One migration: channel transfer + decode-engine selection,
+        retried within the deadline; the import itself resolves
+        asynchronously on the target runner's thread and falls back on
+        rejection."""
+        last_err = "handoff timeout"
+        max_attempts = 1 + max(0, self.settings.handoff_retries)
+        while job.attempts < max_attempts and time.monotonic() < job.deadline:
+            if job.attempts:
+                # exponential backoff between attempts, bounded by the
+                # deadline: a decode replica mid-restart gets a real
+                # chance to come back before the in-place fallback
+                # (back-to-back retries would burn the whole budget in
+                # microseconds); _stop short-circuits for shutdown
+                delay = min(0.1 * (2 ** (job.attempts - 1)),
+                            job.deadline - time.monotonic())
+                if delay > 0 and self._stop.wait(delay):
+                    break
+            if self._consume_abort(job):
+                return
+            job.attempts += 1
+            try:
+                wired = self.channel.transfer(job.exp)
+            except Exception as e:  # noqa: BLE001 — channel fault domain
+                last_err = f"channel {self.channel.name}: {e}"
+                if self.metrics:
+                    self.metrics.record_handoff("retry")
+                continue
+            target = self.scheduler.schedule_decode(
+                exclude=job.source.engine_id
+            )
+            if target is None:
+                last_err = "no healthy decode engine"
+                if self.metrics:
+                    self.metrics.record_handoff("retry")
+                continue
+            if self._consume_abort(job):
+                return
+
+            def _done(ok: bool, err: Optional[str],
+                      job=job, target=target) -> None:
+                # runs on the target runner's thread
+                if ok:
+                    # the request is (and stays) in the target's
+                    # inflight map — safe to leave the migrating set
+                    self._finish_migration(job)
+                    if self._consume_abort_flag(job.req.request_id):
+                        # client disconnected while the resume was in
+                        # flight and the dispatcher's runner sweep ran
+                        # before the target registered it — apply the
+                        # abort now instead of decoding into a dead sink
+                        target.abort(job.req.request_id)
+                        return
+                    if err == "aborted":
+                        return  # resolved by an abort, not a transfer
+                    if self.metrics:
+                        self.metrics.record_handoff(
+                            "ok",
+                            latency_s=time.monotonic() - job.enqueued_at,
+                            nbytes=job.exp.kv_bytes(),
+                        )
+                else:
+                    logger.warning(
+                        "KV handoff import rejected by %s (%s); decoding "
+                        "in place on %s",
+                        target.engine_id, err, job.source.engine_id,
+                    )
+                    self._fallback(job, err or "import failed")
+
+            target.submit_resume(wired, job.req, _done)
+            return
+        self._fallback(job, last_err)
+
+    def _finish_migration(self, job: _MigrationJob) -> None:
+        with self._cv:
+            self._migrating.pop(job.req.request_id, None)
+
+    def _fallback(self, job: _MigrationJob, err: str) -> None:
+        """Resume the request on its SOURCE engine (in-place decode). If
+        even that fails, the request errors out — visibly, never
+        silently dropped.
+
+        Drain-coverage invariant: the job leaves the migrating set only
+        AFTER submit_resume has registered the request with the source
+        runner (registration is synchronous), so at every instant the
+        request is visible to the dispatcher's drain loop through either
+        ``pending_count()`` or some runner's ``active_count()``."""
+        if self._consume_abort(job):
+            return
+        if self.metrics:
+            self.metrics.record_handoff("fallback")
+
+        def _done(ok: bool, import_err: Optional[str]) -> None:
+            if not ok:
+                try:
+                    job.req.sink.on_error(
+                        f"KV handoff failed ({err}) and in-place resume "
+                        f"failed ({import_err})",
+                        "handoff_failed",
+                    )
+                except Exception:  # noqa: BLE001 — sink isolation
+                    pass
+
+        # the original (pre-channel) export resumes in place: the source
+        # engine's own dtype/topology always matches itself
+        job.source.submit_resume(job.exp, job.req, _done)
+        self._finish_migration(job)
+
+    # -- introspection -----------------------------------------------------
+
+    def has_decode_targets(self) -> bool:
+        """True while at least one decode-role replica is REGISTERED
+        (health is deliberately ignored: a transiently unhealthy decode
+        engine is worth the retry/fallback path, a topology with no
+        decode replicas at all is not — prefill runners then admit
+        unified and skip the per-request serialize/fallback churn)."""
+        return any(
+            getattr(r, "role", "unified") == "decode"
+            for r in self.scheduler.engines()
+        )
+
+    @staticmethod
+    def role_counts(roles: Sequence[str]) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in roles:
+            out[r] = out.get(r, 0) + 1
+        return out

@@ -87,8 +87,13 @@ class Dispatcher:
         poll_interval_s: float = 0.002,
         native_queue: Optional[bool] = None,
         tracer=None,
+        disagg=None,
     ):
+        """``disagg``: the DisaggController when the topology is
+        disaggregated (serving/disagg.py) — its migration queue counts
+        toward drain, and aborts reach requests parked there."""
         self.scheduler = scheduler
+        self.disagg = disagg
         self.tracer = tracer
         self.queue: PriorityQueueManager[ServerRequest] = _make_queue(
             queue_config, native_queue
@@ -128,6 +133,8 @@ class Dispatcher:
                 and not any(
                     r.active_count() for r in self.scheduler.engines()
                 )
+                and (self.disagg is None
+                     or self.disagg.pending_count() == 0)
             ):
                 break
             time.sleep(0.01)
@@ -168,6 +175,8 @@ class Dispatcher:
         if self.queue.cancel(request_id) is not None:
             return
         if self.batcher.cancel(request_id) is not None:
+            return
+        if self.disagg is not None and self.disagg.abort(request_id):
             return
         for runner in self.scheduler.engines():
             runner.abort(request_id)

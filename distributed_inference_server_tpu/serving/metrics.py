@@ -44,6 +44,9 @@ class EngineStatus:
     total_processed: int
     memory_used_pages: int = 0
     memory_total_pages: int = 0
+    # disaggregated prefill/decode serving (serving/disagg.py): which
+    # part of the pipeline this replica serves
+    role: str = "unified"
     # speculative-decoding stats (Req 12.4): acceptance_rate,
     # estimated_speedup, enabled, num_draft_tokens — None when no draft
     # model is configured
@@ -58,6 +61,7 @@ class EngineStatus:
             "total_processed": self.total_processed,
             "memory_used_pages": self.memory_used_pages,
             "memory_total_pages": self.memory_total_pages,
+            "role": self.role,
         }
         if self.speculation is not None:
             d["speculation"] = self.speculation
@@ -80,9 +84,12 @@ class MetricsSnapshot:
     queue_depth: int
     worker_statuses: Tuple[EngineStatus, ...] = ()
     uptime_seconds: float = 0.0
+    # disaggregated-serving block (None when no handoff has happened and
+    # every engine is unified): handoff outcome counts + bytes moved
+    disagg: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "total_requests": self.total_requests,
             "active_requests": self.active_requests,
             "tokens_per_second": round(self.tokens_per_second, 3),
@@ -95,6 +102,9 @@ class MetricsSnapshot:
             "worker_statuses": [w.to_dict() for w in self.worker_statuses],
             "uptime_seconds": round(self.uptime_seconds, 1),
         }
+        if self.disagg is not None:
+            out["disagg"] = self.disagg
+        return out
 
 
 class MetricsCollector:
@@ -174,6 +184,29 @@ class MetricsCollector:
             "engine_up", "1 if the engine replica is healthy", ["engine_id"],
             registry=r,
         )
+        # disaggregated prefill/decode serving (serving/disagg.py)
+        self.handoff_latency = Histogram(
+            "kv_handoff_latency_seconds",
+            "Prefill->decode KV handoff latency (export to resume)",
+            registry=r,
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1,
+                     2, 5),
+        )
+        self.handoff_bytes = Counter(
+            "kv_handoff_bytes_total",
+            "Serialized KV bytes moved over the handoff channel",
+            registry=r,
+        )
+        self.handoffs = Counter(
+            "kv_handoff_total",
+            "KV handoffs by outcome (ok | fallback | retry)", ["outcome"],
+            registry=r,
+        )
+        self.engines_by_role = Gauge(
+            "engines_by_role",
+            "Engine replicas per disaggregation role", ["role"],
+            registry=r,
+        )
 
         # snapshot internals
         self._total_requests = 0
@@ -184,6 +217,8 @@ class MetricsCollector:
         self._batch_sizes: Deque[int] = deque(maxlen=_LATENCY_WINDOW)
         self._cache_hits = 0
         self._cache_misses = 0
+        self._handoffs: Dict[str, int] = {}
+        self._handoff_bytes = 0
 
     # -- recording ---------------------------------------------------------
 
@@ -252,6 +287,25 @@ class MetricsCollector:
     def set_engine_up(self, engine_id: str, up: bool) -> None:
         self.engine_up.labels(engine_id=engine_id).set(1 if up else 0)
 
+    def record_handoff(self, outcome: str, latency_s: Optional[float] = None,
+                       nbytes: int = 0) -> None:
+        """One KV-handoff event (serving/disagg.py): ``outcome`` is
+        "ok" (resumed on a decode engine), "fallback" (decoded in place
+        on the source), or "retry" (a failed attempt that was retried)."""
+        self.handoffs.labels(outcome=outcome).inc()
+        if latency_s is not None:
+            self.handoff_latency.observe(latency_s)
+        if nbytes:
+            self.handoff_bytes.inc(nbytes)
+        with self._lock:
+            self._handoffs[outcome] = self._handoffs.get(outcome, 0) + 1
+            self._handoff_bytes += nbytes
+
+    def set_engines_by_role(self, counts: Dict[str, int]) -> None:
+        """Per-role replica counts (prefill / decode / unified gauges)."""
+        for role in ("prefill", "decode", "unified"):
+            self.engines_by_role.labels(role=role).set(counts.get(role, 0))
+
     def set_speculation(self, engine_id: str, stats: Dict[str, Any]) -> None:
         """Export speculative-decoding gauges (Req 12.4)."""
         self.spec_acceptance.labels(engine_id=engine_id).set(
@@ -285,6 +339,14 @@ class MetricsCollector:
             lat = sorted(self._latencies_ms)
             p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat else 0.0
             total_cache = self._cache_hits + self._cache_misses
+            disagg = None
+            if self._handoffs or any(
+                s.role != "unified" for s in engine_statuses
+            ):
+                disagg = {
+                    "handoffs": dict(self._handoffs),
+                    "handoff_bytes": self._handoff_bytes,
+                }
             return MetricsSnapshot(
                 total_requests=self._total_requests,
                 active_requests=self._active_requests,
@@ -303,4 +365,5 @@ class MetricsCollector:
                 queue_depth=getattr(self, "_queue_depth", 0),
                 worker_statuses=engine_statuses,
                 uptime_seconds=now - self._started_at,
+                disagg=disagg,
             )

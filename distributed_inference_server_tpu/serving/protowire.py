@@ -177,6 +177,9 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         5: ("total_processed", "int64", "one"),
         6: ("memory_used_pages", "uint32", "one"),
         7: ("memory_total_pages", "uint32", "one"),
+        # disaggregation role (serving/disagg.py); "unified" when the
+        # topology is monolithic, so it is always on the wire
+        8: ("role", "string", "one"),
     },
     "HealthResponse": {
         1: ("status", "string", "one"),
@@ -211,10 +214,36 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
     "ErrorResponse": {
         1: ("error", "msg:ErrorDetail", "opt"),
     },
+    # Disaggregated prefill/decode serving (serving/disagg.py): a live
+    # sequence lifted off a prefill engine for cross-process KV transfer.
+    # ``kv`` / ``draft_kv`` carry the serialize_kv page payloads opaque;
+    # the rest reconstructs the host-side sequence state exactly.
+    "KvHandoff": {
+        1: ("request_id", "string", "one"),
+        2: ("token_ids", "uint32", "rep"),
+        3: ("prompt_len", "uint32", "one"),
+        4: ("seq_len", "uint32", "one"),
+        5: ("next_token", "uint32", "one"),
+        6: ("emitted_tokens", "uint32", "one"),
+        7: ("output_text", "string", "one"),
+        8: ("emitted_upto", "uint32", "one"),
+        9: ("pending_ids", "uint32", "rep"),
+        10: ("max_tokens", "uint32", "one"),
+        # double, not float: sampled-path token identity across the
+        # handoff requires the params bit-exact, and Python floats are
+        # doubles
+        11: ("temperature", "double", "one"),
+        12: ("top_p", "double", "one"),
+        13: ("stop_sequences", "string", "rep"),
+        14: ("kv", "bytes", "one"),
+        15: ("draft_kv", "bytes", "opt"),
+        16: ("source_engine", "string", "one"),
+    },
 }
 
 _SCALAR_DEFAULT = {
     "string": "",
+    "bytes": b"",
     "uint32": 0,
     "int64": 0,
     "bool": False,
@@ -230,6 +259,9 @@ def _enc_scalar(ftype: str, value) -> Tuple[int, bytes]:
     """Returns (wire_type, payload bytes without the key)."""
     if ftype == "string":
         data = str(value).encode("utf-8")
+        return _LEN, _enc_varint(len(data)) + data
+    if ftype == "bytes":
+        data = bytes(value)
         return _LEN, _enc_varint(len(data)) + data
     if ftype in ("uint32", "int64"):
         return _VARINT, _enc_varint(int(value))
@@ -343,6 +375,11 @@ def _dec_scalar(ftype: str, wire: int, data: bytes, pos: int):
             raise ValueError("string field must be length-delimited")
         length, pos = _dec_varint(data, pos)
         return data[pos:pos + length].decode("utf-8"), pos + length
+    if ftype == "bytes":
+        if wire != _LEN:
+            raise ValueError("bytes field must be length-delimited")
+        length, pos = _dec_varint(data, pos)
+        return bytes(data[pos:pos + length]), pos + length
     if ftype in ("uint32", "int64"):
         v, pos = _dec_varint(data, pos)
         return (_signed64(v) if ftype == "int64" else v), pos

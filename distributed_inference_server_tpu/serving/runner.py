@@ -87,8 +87,19 @@ class EngineRunner:
         engine_factory: Callable[[], LLMEngine],
         metrics: Optional[MetricsCollector] = None,
         tracer=None,
+        role: str = "unified",
+        disagg=None,
     ):
+        """``role`` ("prefill" | "decode" | "unified") and ``disagg``
+        (the DisaggController) enable disaggregated serving
+        (serving/disagg.py): a prefill runner admits requests
+        prefill-only and exports each finished prefill to the controller
+        for migration; a decode runner receives them via
+        ``submit_resume``. Unified (the default) is today's monolithic
+        behavior exactly."""
         self.engine_id = engine_id
+        self.role = role
+        self._disagg = disagg
         self._factory = engine_factory
         self.metrics = metrics
         self.tracer = tracer
@@ -163,6 +174,15 @@ class EngineRunner:
             self._fail_all_of(reqs, self._last_error or "engine unavailable")
             return
 
+        # admit unified when the decode fleet is gone (e.g. scaled away):
+        # prefill-only admission would pay a KV serialize + retry +
+        # in-place fallback on every request, forever
+        prefill_only = (
+            self.role == "prefill"
+            and self._disagg is not None
+            and self._disagg.has_decode_targets()
+        )
+
         def _do() -> None:
             for r in reqs:
                 if r.request_id in self._inflight:  # not aborted meanwhile
@@ -172,7 +192,9 @@ class EngineRunner:
                             engine_id=self.engine_id,
                             prompt_tokens=len(r.prompt_ids),
                         )
-                    self._engine.add_request(r.request_id, r.prompt_ids, r.params)
+                    self._engine.add_request(r.request_id, r.prompt_ids,
+                                             r.params,
+                                             prefill_only=prefill_only)
 
         self._post(_do)
 
@@ -185,6 +207,72 @@ class EngineRunner:
             self._inflight.pop(request_id, None)
 
         self._post(_do)
+
+    def submit_resume(self, exp, req: ServerRequest,
+                      on_done: Callable[[bool, Optional[str]], None]) -> None:
+        """Resume a migrated sequence on this runner's engine (KV handoff
+        import, serving/disagg.py). ``on_done(ok, err)`` fires exactly
+        once from the runner thread — or here, if the engine is already
+        down. On ok=False the request has been deregistered again and the
+        caller (the DisaggController) owns its fate (fallback)."""
+        self._inflight[req.request_id] = req
+        if not self._healthy:
+            self._inflight.pop(req.request_id, None)
+            on_done(False, self._last_error or "engine unavailable")
+            return
+
+        def _do() -> None:
+            if req.request_id not in self._inflight:
+                # aborted between registration and import: resolved (no
+                # fallback wanted), but NOT a real transfer — the
+                # "aborted" marker keeps the handoff metrics honest
+                on_done(True, "aborted")
+                return
+            try:
+                self._engine.import_sequence(exp)
+            except Exception as e:  # noqa: BLE001 — import fault domain
+                self._inflight.pop(req.request_id, None)
+                on_done(False, str(e))
+                return
+            on_done(True, None)
+
+        self._post(_do)
+
+    def _drain_handoffs(self) -> bool:
+        """Export finished prefills parked by the engine and queue their
+        migration (prefill-role runners only). Runs on the runner thread
+        between steps; returns True if it moved anything."""
+        if self._disagg is None or self._engine is None:
+            return False
+        ids = self._engine.handoff_ready_ids()
+        if not ids:
+            return False
+        for rid in ids:
+            req = self._inflight.get(rid)
+            if req is None:
+                # aborted after readiness: clear the engine-side state
+                self._engine.abort(rid)
+                continue
+            try:
+                exp = self._engine.export_handoff(rid)
+            except Exception as e:  # noqa: BLE001 — per-request isolation
+                # the engine may still hold the sequence (and its pages);
+                # abort releases them and clears has_work, or the runner
+                # loop would busy-spin on a zombie forever
+                self._engine.abort(rid)
+                self._inflight.pop(rid, None)
+                try:
+                    req.sink.on_error(f"KV export failed: {e}",
+                                      "handoff_failed")
+                except Exception:  # noqa: BLE001
+                    pass
+                continue
+            if exp is None:
+                continue
+            exp.source_engine = self.engine_id
+            self._inflight.pop(rid, None)
+            self._disagg.enqueue(exp, req, self)
+        return True
 
     def evict_cache(self, target_frac: float) -> None:
         """Evict cached (refcount-0) prefix pages until used/total <=
@@ -398,6 +486,7 @@ class EngineRunner:
                 pass
         return EngineStatus(
             engine_id=self.engine_id,
+            role=self.role,
             healthy=self._healthy,
             active_requests=len(self._inflight),
             waiting_requests=waiting,
@@ -440,6 +529,7 @@ class EngineRunner:
                         self.metrics.record_inference(dt)
                     self._dispatch(outputs)
                     self._report_cache_deltas()
+                worked |= self._drain_handoffs()
                 worked |= self._step_draining()
                 worked |= self._embed_quantum()
                 if not worked:

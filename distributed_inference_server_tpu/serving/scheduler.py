@@ -44,13 +44,20 @@ def choose_engine(
     strategy: SchedulingStrategy,
     statuses: Sequence[EngineStatus],
     rr_counter: int,
+    roles: Optional[Sequence[str]] = None,
 ) -> Optional[str]:
     """Pure strategy core: pick an engine id from healthy statuses.
 
     Property 16: only healthy engines are eligible. Property 17:
     least-loaded picks a minimum-load engine. Deterministic given inputs.
+    ``roles`` (disaggregated serving, serving/disagg.py) restricts the
+    eligible set to engines carrying one of those roles; None = all.
     """
     healthy = [s for s in statuses if s.healthy]
+    if roles is not None:
+        healthy = [
+            s for s in healthy if getattr(s, "role", "unified") in roles
+        ]
     if not healthy:
         return None
     if strategy is SchedulingStrategy.ROUND_ROBIN:
@@ -123,13 +130,43 @@ class AdaptiveScheduler:
 
     def schedule(self) -> Optional[EngineRunner]:
         """Pick an engine for the next admission batch, or None if no
-        healthy engine exists (graceful failure, Property 20)."""
+        healthy engine exists (graceful failure, Property 20).
+
+        Role-aware routing (disaggregated serving): decode-role engines
+        never take admission batches — prompts go to prefill/unified
+        replicas and reach decode replicas via KV handoff. If only
+        decode engines are healthy (prefill fleet down), they take
+        admissions anyway: a unified-decoding decode engine beats a 503.
+        """
         statuses = self.statuses()
+        roles = None
+        if any(getattr(s, "role", "unified") == "decode" and s.healthy
+               for s in statuses):
+            non_decode = ("prefill", "unified")
+            if any(s.healthy and getattr(s, "role", "unified") in non_decode
+                   for s in statuses):
+                roles = non_decode
         with self._lock:
-            engine_id = choose_engine(self._strategy, statuses, self._rr)
+            engine_id = choose_engine(self._strategy, statuses, self._rr,
+                                      roles=roles)
             if engine_id is None:
                 return None
             self._rr += 1
+            return self._engines.get(engine_id)
+
+    def schedule_decode(self, exclude: Optional[str] = None
+                        ) -> Optional[EngineRunner]:
+        """Pick the migration target for a finished prefill: the least-
+        loaded healthy decode-role engine (``exclude`` drops the source,
+        relevant only if an engine is both). None = no decode capacity —
+        the caller falls back to decoding in place."""
+        statuses = [s for s in self.statuses() if s.engine_id != exclude]
+        engine_id = choose_engine(
+            SchedulingStrategy.LEAST_LOADED, statuses, 0, roles=("decode",)
+        )
+        if engine_id is None:
+            return None
+        with self._lock:
             return self._engines.get(engine_id)
 
     # -- health loop -------------------------------------------------------

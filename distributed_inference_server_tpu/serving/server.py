@@ -65,12 +65,21 @@ class InferenceServer:
         model_resolver: Optional[Callable[[str], Callable[[], LLMEngine]]] = None,
         otlp_endpoint: str = "",
         otlp_service_name: str = "distributed-inference-server-tpu",
+        engine_roles: Optional[List[str]] = None,
+        disagg_settings=None,
     ):
         """``model_resolver(name) -> engine_factory`` enables the admin
         model-swap endpoint (Req 13); None leaves it unconfigured (501).
         ``otlp_endpoint`` (a collector's /v1/traces URL) turns on the
         OTLP/HTTP exporter (utils/otlp.py) — real OpenTelemetry export,
-        S12 — alongside the in-memory ring."""
+        S12 — alongside the in-memory ring.
+
+        ``engine_roles`` (disaggregated prefill/decode serving,
+        serving/disagg.py; docs/DISAGG.md): one role per replica —
+        "prefill" | "decode" | "unified". Any prefill/decode role brings
+        up the DisaggController and KV-handoff channel; None/all-unified
+        is exactly today's monolithic behavior. ``disagg_settings`` is a
+        disagg.DisaggSettings (timeout/retries/channel backend)."""
         from distributed_inference_server_tpu.utils.tracing import Tracer
 
         self.engine_factory = engine_factory
@@ -91,12 +100,35 @@ class InferenceServer:
             health_check_interval_s=health_check_interval_s,
             auto_restart=auto_restart,
         )
+        from distributed_inference_server_tpu.serving.disagg import (
+            DisaggController,
+            DisaggSettings,
+            make_channel,
+            parse_roles,
+        )
+
+        if engine_roles is not None and isinstance(engine_roles, str):
+            engine_roles = parse_roles(engine_roles, num_engines)
+        self._roles: List[str] = list(engine_roles or [])
+        self.disagg: Optional[DisaggController] = None
+        if any(r in ("prefill", "decode") for r in self._roles):
+            settings = disagg_settings or DisaggSettings()
+            self.disagg = DisaggController(
+                self.scheduler,
+                metrics=self.metrics,
+                channel=make_channel(settings.channel),
+                settings=settings,
+            )
+            self.metrics.set_engines_by_role(
+                DisaggController.role_counts(self._roles)
+            )
         self.dispatcher = Dispatcher(
             self.scheduler,
             queue_config=queue_config,
             batcher_config=batcher_config,
             metrics=self.metrics,
             tracer=self.tracer,
+            disagg=self.disagg,
         )
         from distributed_inference_server_tpu.native import make_validator
 
@@ -124,6 +156,8 @@ class InferenceServer:
 
     def start(self, wait_ready: bool = True) -> None:
         """Spawn engines (Req 7.1-7.2), start health checks and dispatch."""
+        if self.disagg is not None:
+            self.disagg.start()
         for _ in range(self._num_engines):
             self._spawn_engine(wait_ready=wait_ready)
         self.scheduler.start_health_loop()
@@ -132,9 +166,14 @@ class InferenceServer:
         self._started = True
 
     def shutdown(self, drain_timeout_s: float = 30.0) -> None:
-        """Graceful: stop accepting, drain, stop engines (Req 9.5)."""
+        """Graceful: stop accepting, drain, stop engines (Req 9.5).
+        The dispatcher drain counts in-flight KV migrations
+        (disagg.pending_count); the controller then drains its queue by
+        resuming any stragglers in place before the engines stop."""
         self.degradation.stop()
         self.dispatcher.shutdown(drain_timeout_s)
+        if self.disagg is not None:
+            self.disagg.shutdown()
         self.scheduler.stop_health_loop()
         for runner in self.scheduler.engines():
             runner.shutdown()
@@ -148,9 +187,13 @@ class InferenceServer:
         idx = self._next_engine_idx
         engine_id = f"engine-{idx}"
         self._next_engine_idx += 1
+        # replicas beyond the configured role list (elastic scale_to)
+        # come up unified: they serve immediately without rebalancing
+        # the prefill/decode split under the operator
+        role = self._roles[idx] if idx < len(self._roles) else "unified"
         runner = EngineRunner(
             engine_id, _bind_factory(self.engine_factory, idx), self.metrics,
-            tracer=self.tracer,
+            tracer=self.tracer, role=role, disagg=self.disagg,
         )
         runner.start(wait_ready=wait_ready)
         self.scheduler.register(runner)
@@ -164,8 +207,13 @@ class InferenceServer:
         for _ in range(n - len(current)):
             self._spawn_engine()
         if n < len(current):
-            # retire the youngest replicas
-            for runner in current[n:]:
+            # retire unified replicas first (youngest within each class):
+            # scaling down must not tear out a disaggregated topology's
+            # only decode engine while unified spares exist
+            unified = [r for r in current if r.role == "unified"]
+            roled = [r for r in current if r.role != "unified"]
+            victims = (unified[::-1] + roled[::-1])[: len(current) - n]
+            for runner in victims:
                 self.scheduler.unregister(runner.engine_id)
                 self._drain_and_stop(runner)
 

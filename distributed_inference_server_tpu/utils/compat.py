@@ -1,0 +1,69 @@
+"""Version compatibility shims for the jax / stdlib API surface.
+
+The runtime image pins jax 0.4.x and Python 3.10; newer call sites in
+this codebase use the current spellings. Each shim resolves the modern
+name when it exists and falls back to the legacy location otherwise, so
+the same source serves both toolchains.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# jax.shard_map moved to the top-level namespace after 0.4.x (and renamed
+# its replication-check kwarg check_rep -> check_vma); older toolchains
+# only ship jax.experimental.shard_map.shard_map with the old kwarg.
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        # partial-manual spelling: new axis_names={...} == legacy
+        # auto=<complement over the mesh axes>
+        names = kwargs.pop("axis_names", None)
+        if names is not None:
+            auto = frozenset(kwargs["mesh"].axis_names) - frozenset(names)
+            if auto:
+                kwargs["auto"] = auto
+                kwargs["check_rep"] = False  # legacy auto requires it
+        return _legacy_shard_map(f, **kwargs)
+
+
+# lax.axis_size is post-0.4.x; psum of a concrete 1 over a named axis
+# constant-folds to the axis size at trace time on every version.
+def axis_size(axis_name):
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
+def pcast(x, axis_name, *, to):
+    """lax.pcast across versions: legacy shard_map (check_rep=False) has
+    no varying-manual-axes tracking, so the promotion is an identity."""
+    try:
+        return jax.lax.pcast(x, axis_name, to=to)
+    except AttributeError:
+        return x
+
+
+def tpu_compiler_params(**kwargs):
+    """Pallas TPU compiler params across the CompilerParams /
+    TPUCompilerParams rename."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def load_toml(path: str) -> dict:
+    """Parse a TOML file via stdlib tomllib (3.11+) or tomli (3.10)."""
+    try:
+        import tomllib
+    except ModuleNotFoundError:  # Python 3.10
+        import tomli as tomllib
+    with open(path, "rb") as f:
+        return tomllib.load(f)

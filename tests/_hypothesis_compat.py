@@ -1,0 +1,52 @@
+"""Hypothesis shim for images that do not ship it (seed-known, triaged
+in ISSUE 1).
+
+When hypothesis is installed this re-exports the real ``given`` /
+``settings`` / ``strategies``. When it is absent, ``@given`` tests
+self-skip at call time while every plain test in the same module still
+runs — a module-level ``pytest.importorskip`` would silently disable
+dozens of non-property tests (dispatcher, scheduler, sampling, ...)
+along with the handful that actually need hypothesis.
+"""
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    HAS_HYPOTHESIS = True
+except ModuleNotFoundError:
+    import pytest
+
+    HAS_HYPOTHESIS = False
+
+    def given(*_args, **_kwargs):
+        def deco(_fn):
+            def _skipped(*args, **kwargs):
+                pytest.skip(
+                    "hypothesis not installed in this image "
+                    "(seed-known, triaged in ISSUE 1)"
+                )
+
+            return _skipped
+
+        return deco
+
+    def settings(*_args, **_kwargs):
+        return lambda fn: fn
+
+    class _DummyStrategy:
+        """Chainable stand-in: strategies are constructed and composed
+        (.map/.filter/...) at module import, but the decorated tests
+        never run, so no value is ever drawn."""
+
+        def __getattr__(self, _name):
+            return self
+
+        def __call__(self, *a, **k):
+            return self
+
+    class _Strategies:
+        def __getattr__(self, _name):
+            return _DummyStrategy()
+
+    st = _Strategies()

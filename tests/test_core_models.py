@@ -10,8 +10,8 @@ serde round-trip properties for all response types at 100 cases each
 import json
 
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+from _hypothesis_compat import given, settings, st
 
 from distributed_inference_server_tpu.core import (
     ChatChoice,

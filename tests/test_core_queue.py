@@ -9,9 +9,9 @@ plus the absolute cap (queue.rs:110-113).
 
 import itertools
 
-from hypothesis import given, settings
-from hypothesis import strategies as st
 import pytest
+
+from _hypothesis_compat import given, settings, st
 
 from distributed_inference_server_tpu.core import (
     Priority,

@@ -7,8 +7,8 @@ token-count monotonicity — **Properties 1-3** (design.md:686-701).
 """
 
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+from _hypothesis_compat import given, settings, st
 
 from distributed_inference_server_tpu.core import (
     ChatMessage,

@@ -5,8 +5,8 @@ on dispatcher/batcher, including reversal when pressure drops."""
 from __future__ import annotations
 
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+from _hypothesis_compat import given, settings, st
 
 from distributed_inference_server_tpu.core.errors import QueueFull
 from distributed_inference_server_tpu.core.types import Priority

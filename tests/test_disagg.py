@@ -1,0 +1,563 @@
+"""Disaggregated prefill/decode serving (serving/disagg.py; ISSUE 1).
+
+Covers the full handoff stack bottom-up:
+
+- KV serialize/deserialize round-trips across pool dtypes (float32,
+  bfloat16, int8 quantized pages) and the deserialize-into-allocator
+  prefix registration — the handoff path's foundation;
+- the KvHandoff protowire framing and both channel backends;
+- engine-level export/import token identity;
+- role parsing/config validation (nonsensical topologies rejected);
+- role-aware scheduling (admission never lands on decode engines);
+- serving-level acceptance: a request on 1 prefill + 1 decode engine is
+  token-identical to the same request on a single unified engine
+  (greedy), and an injected channel failure falls back to in-place
+  decode without dropping the request, visibly in metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_inference_server_tpu.core.errors import (
+    CacheDeserializationError,
+    CacheFull,
+    ConfigError,
+)
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+    SequenceExport,
+)
+from distributed_inference_server_tpu.engine.kv_cache import (
+    PageAllocator,
+    PagedCacheConfig,
+    PagedKVState,
+    deserialize_into_allocator,
+    deserialize_kv,
+    serialize_kv,
+)
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+from distributed_inference_server_tpu.serving.disagg import (
+    DisaggSettings,
+    InProcessChannel,
+    KVTransferChannel,
+    ProtowireChannel,
+    export_from_wire,
+    export_to_wire,
+    make_channel,
+    parse_roles,
+)
+from distributed_inference_server_tpu.serving.metrics import EngineStatus
+from distributed_inference_server_tpu.serving.runner import ServerRequest
+from distributed_inference_server_tpu.serving.scheduler import (
+    SchedulingStrategy,
+    choose_engine,
+)
+from distributed_inference_server_tpu.serving.server import InferenceServer
+
+_PAGED = PagedCacheConfig(num_pages=192, page_size=8, max_pages_per_seq=32)
+_PROMPT = "hello disaggregation world"
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+
+
+def _engine(params, **over):
+    return LLMEngine(
+        params,
+        TINY,
+        ByteTokenizer(),
+        EngineConfig(max_batch=4, prefill_buckets=(16, 64), paged=_PAGED,
+                     **over),
+        dtype=jnp.float32,
+    )
+
+
+def _drain(engine, sink_tokens, sink_text):
+    while engine.has_work() and not engine.handoff_ready_ids():
+        for o in engine.step():
+            assert o.error is None, o.error
+            if o.token_id is not None:
+                sink_tokens.append(o.token_id)
+            sink_text.append(o.text)
+
+
+# ---------------------------------------------------------------------------
+# KV serialize/deserialize round-trips (the handoff foundation)
+# ---------------------------------------------------------------------------
+
+
+class TestKvRoundTrip:
+    def _state(self, dtype=jnp.float32, kv_quant="none", seed=0):
+        cfg = PagedCacheConfig(num_pages=16, page_size=4, max_pages_per_seq=8)
+        state = PagedKVState.create(TINY, cfg, dtype=dtype, kv_quant=kv_quant)
+        rng = np.random.default_rng(seed)
+        if kv_quant == "int8":
+            from distributed_inference_server_tpu.ops.quant import QuantPool
+
+            shape = state.k.data.shape
+            state.k = QuantPool(
+                jnp.asarray(rng.integers(-127, 127, shape, np.int8)),
+                jnp.asarray(rng.random(shape[:-1], np.float32)),
+            )
+            state.v = QuantPool(
+                jnp.asarray(rng.integers(-127, 127, shape, np.int8)),
+                jnp.asarray(rng.random(shape[:-1], np.float32)),
+            )
+        else:
+            shape = state.k.shape
+            state.k = jnp.asarray(
+                rng.standard_normal(shape, np.float32), dtype
+            )
+            state.v = jnp.asarray(
+                rng.standard_normal(shape, np.float32), dtype
+            )
+        return cfg, state
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_roundtrip_exact(self, dtype):
+        cfg, state = self._state(dtype)
+        pages = [3, 7, 1]
+        blob = serialize_kv(state, pages, cfg.page_size, token_count=10)
+        fresh = PagedKVState.create(TINY, cfg, dtype=dtype)
+        restored, n = deserialize_kv(fresh, blob, pages, cfg.page_size)
+        assert n == 10
+        slots = np.concatenate(
+            [np.arange(p * 4, (p + 1) * 4) for p in pages]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored.k[:, slots]), np.asarray(state.k[:, slots])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored.v[:, slots]), np.asarray(state.v[:, slots])
+        )
+
+    def test_roundtrip_int8_quantized(self):
+        cfg, state = self._state(kv_quant="int8")
+        pages = [2, 5]
+        blob = serialize_kv(state, pages, cfg.page_size, token_count=8)
+        fresh = PagedKVState.create(TINY, cfg, kv_quant="int8")
+        restored, n = deserialize_kv(fresh, blob, pages, cfg.page_size)
+        assert n == 8
+        slots = np.concatenate([np.arange(p * 4, (p + 1) * 4) for p in pages])
+        np.testing.assert_array_equal(
+            np.asarray(restored.k.data[:, slots]),
+            np.asarray(state.k.data[:, slots]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored.k.scale[:, slots]),
+            np.asarray(state.k.scale[:, slots]),
+        )
+
+    def test_quantized_payload_into_float_pool_rejected(self):
+        cfg, state = self._state(kv_quant="int8")
+        blob = serialize_kv(state, [0], cfg.page_size, token_count=4)
+        fresh = PagedKVState.create(TINY, cfg, dtype=jnp.float32)
+        with pytest.raises(CacheDeserializationError):
+            deserialize_kv(fresh, blob, [0], cfg.page_size)
+
+    def test_deserialize_into_allocator_registers_prefix(self):
+        cfg, state = self._state()
+        alloc = PageAllocator(cfg)
+        tokens = list(range(1, 9))  # 8 tokens = 2 full pages
+        src_pages = alloc.allocate(2)
+        alloc.publish(tokens, src_pages)
+        blob = serialize_kv(state, src_pages, cfg.page_size, token_count=8)
+        # import into a FRESH allocator (the decode engine's)
+        alloc2 = PageAllocator(cfg)
+        state2, pages = deserialize_into_allocator(
+            state, alloc2, blob, tokens, cfg.page_size
+        )
+        assert len(pages) == 2
+        # prefix registration: a later prompt sharing the tokens hits
+        shared, matched = alloc2.match_prefix(tokens + [99])
+        assert matched == 8 and shared == list(pages)
+        alloc2.release(shared)
+
+    def test_deserialize_into_allocator_no_leak_on_failure(self):
+        cfg, state = self._state()
+        alloc = PageAllocator(cfg)
+        blob = serialize_kv(state, [0, 1], cfg.page_size, token_count=8)
+        free_before = alloc.num_free()
+        with pytest.raises(CacheDeserializationError):
+            # 12 tokens claimed but the payload carries 8
+            deserialize_into_allocator(
+                state, alloc, blob, list(range(12)), cfg.page_size
+            )
+        assert alloc.num_free() == free_before
+
+    def test_deserialize_into_allocator_cache_full(self):
+        cfg, state = self._state()
+        alloc = PageAllocator(cfg)
+        held = alloc.allocate(cfg.num_pages)  # exhaust the pool
+        blob = serialize_kv(state, [0], cfg.page_size, token_count=4)
+        with pytest.raises(CacheFull):
+            deserialize_into_allocator(
+                state, alloc, blob, [1, 2, 3, 4], cfg.page_size
+            )
+        alloc.release(held)
+
+
+# ---------------------------------------------------------------------------
+# Wire framing + channels
+# ---------------------------------------------------------------------------
+
+
+def _export(draft: bool = False) -> SequenceExport:
+    return SequenceExport(
+        request_id="req-1",
+        token_ids=[1, 2, 3, 4, 5],
+        prompt_len=5,
+        seq_len=5,
+        next_token=42,
+        params=SamplingParams(max_tokens=16, temperature=0.0, top_p=0.9,
+                              stop_sequences=("END",)),
+        output_text="heé",  # non-ASCII survives the wire
+        emitted_upto=2,
+        emitted_tokens=1,
+        pending_ids=[200],
+        kv=b"\x00\x01\xffkv-payload",
+        draft_kv=b"draft" if draft else None,
+        source_engine="engine-0",
+    )
+
+
+class TestKvHandoffWire:
+    @pytest.mark.parametrize("draft", [False, True])
+    def test_wire_roundtrip(self, draft):
+        exp = _export(draft)
+        got = export_from_wire(export_to_wire(exp))
+        assert got.request_id == exp.request_id
+        assert got.token_ids == exp.token_ids
+        assert got.prompt_len == exp.prompt_len
+        assert got.seq_len == exp.seq_len
+        assert got.next_token == exp.next_token
+        assert got.params == exp.params
+        assert got.output_text == exp.output_text
+        assert got.emitted_upto == exp.emitted_upto
+        assert got.emitted_tokens == exp.emitted_tokens
+        assert got.pending_ids == exp.pending_ids
+        assert got.kv == exp.kv
+        assert got.draft_kv == exp.draft_kv
+        assert got.source_engine == exp.source_engine
+
+    def test_greedy_temperature_zero_survives(self):
+        # proto3 implicit presence drops 0.0 off the wire; decode must
+        # fill it back (temperature 0 = greedy is the acceptance path)
+        exp = _export()
+        assert export_from_wire(export_to_wire(exp)).params.temperature == 0.0
+
+    def test_channels(self):
+        exp = _export()
+        assert InProcessChannel().transfer(exp) is exp  # zero-copy
+        got = ProtowireChannel().transfer(exp)
+        assert got is not exp and got.kv == exp.kv
+        assert make_channel("inproc").name == "inproc"
+        assert make_channel("protowire").name == "protowire"
+        with pytest.raises(ConfigError):
+            make_channel("carrier-pigeon")
+
+
+# ---------------------------------------------------------------------------
+# Roles: parsing, topology validation, scheduling
+# ---------------------------------------------------------------------------
+
+
+class TestRoles:
+    def test_parse_default_unified(self):
+        assert parse_roles("", 3) == ["unified"] * 3
+
+    def test_parse_mixed(self):
+        assert parse_roles("Prefill, decode ,unified", 3) == [
+            "prefill", "decode", "unified",
+        ]
+
+    @pytest.mark.parametrize("spec,n", [
+        ("prefill,decode", 3),      # count mismatch
+        ("prefill,warp-core", 2),   # unknown role
+        ("decode,decode", 2),       # decode with no prefill
+        ("prefill,prefill", 2),     # prefill with nowhere to hand off
+        ("decode,unified", 2),      # decode fed by nobody
+    ])
+    def test_parse_rejects(self, spec, n):
+        with pytest.raises(ConfigError):
+            parse_roles(spec, n)
+
+    def test_config_wires_roles_and_validates(self):
+        from distributed_inference_server_tpu.serving.config import (
+            ServerConfig,
+        )
+
+        cfg = ServerConfig.load(environ={
+            "DIS_TPU_SERVER__NUM_ENGINES": "2",
+            "DIS_TPU_SERVER__ENGINE_ROLES": "prefill,decode",
+            "DIS_TPU_DISAGG__CHANNEL": "protowire",
+            "DIS_TPU_DISAGG__HANDOFF_RETRIES": "3",
+        })
+        assert cfg.engine_roles() == ["prefill", "decode"]
+        s = cfg.disagg_settings()
+        assert s.channel == "protowire" and s.handoff_retries == 3
+        with pytest.raises(ConfigError):
+            ServerConfig.load(environ={
+                "DIS_TPU_SERVER__NUM_ENGINES": "2",
+                "DIS_TPU_SERVER__ENGINE_ROLES": "decode,decode",
+            })
+        with pytest.raises(ConfigError):
+            ServerConfig.load(environ={
+                "DIS_TPU_DISAGG__CHANNEL": "smoke-signal",
+            })
+
+    def _status(self, eid, role, load=0, healthy=True):
+        return EngineStatus(
+            engine_id=eid, role=role, healthy=healthy, active_requests=load,
+            waiting_requests=0, total_processed=0,
+        )
+
+    def test_choose_engine_role_filter(self):
+        statuses = [
+            self._status("p0", "prefill", load=5),
+            self._status("d0", "decode", load=0),
+            self._status("u0", "unified", load=9),
+        ]
+        # admission: decode engines excluded even when least loaded
+        got = choose_engine(SchedulingStrategy.LEAST_LOADED, statuses, 0,
+                            roles=("prefill", "unified"))
+        assert got == "p0"
+        # unrestricted call keeps the legacy behavior
+        assert choose_engine(
+            SchedulingStrategy.LEAST_LOADED, statuses, 0
+        ) == "d0"
+
+
+# ---------------------------------------------------------------------------
+# Engine-level handoff
+# ---------------------------------------------------------------------------
+
+
+class TestEngineHandoff:
+    def test_export_import_token_identical(self, tiny_params):
+        tok = ByteTokenizer()
+        ids = tok.encode(_PROMPT)
+        sp = SamplingParams(max_tokens=10, temperature=0.0)
+
+        uni = _engine(tiny_params)
+        uni.add_request("r", ids, sp)
+        ref_toks, ref_text = [], []
+        _drain(uni, ref_toks, ref_text)
+
+        pre, dec = _engine(tiny_params), _engine(tiny_params)
+        pre.add_request("r", ids, sp, prefill_only=True)
+        got_toks, got_text = [], []
+        _drain(pre, got_toks, got_text)
+        assert pre.handoff_ready_ids() == ["r"]
+        exp = pre.export_handoff("r")
+        assert not pre.has_work()
+        assert exp.seq_len == len(ids) and exp.prompt_len == len(ids)
+        dec.import_sequence(exp)
+        _drain(dec, got_toks, got_text)
+        assert got_toks == ref_toks
+        assert "".join(got_text) == "".join(ref_text)
+
+    def test_import_through_protowire_channel_identical(self, tiny_params):
+        tok = ByteTokenizer()
+        ids = tok.encode(_PROMPT)
+        sp = SamplingParams(max_tokens=6, temperature=0.0)
+        pre, dec, dec2 = (_engine(tiny_params) for _ in range(3))
+        pre.add_request("r", ids, sp, prefill_only=True)
+        toks, text = [], []
+        _drain(pre, toks, text)
+        exp = pre.export_handoff("r")
+        a_toks, a_text = list(toks), list(text)
+        b_toks, b_text = list(toks), list(text)
+        dec.import_sequence(InProcessChannel().transfer(exp))
+        _drain(dec, a_toks, a_text)
+        dec2.import_sequence(ProtowireChannel().transfer(exp))
+        _drain(dec2, b_toks, b_text)
+        assert a_toks == b_toks and "".join(a_text) == "".join(b_text)
+
+    def test_abort_of_handoff_ready_releases_pages(self, tiny_params):
+        eng = _engine(tiny_params)
+        free0 = eng.allocator.num_free()
+        eng.add_request("r", ByteTokenizer().encode(_PROMPT),
+                        SamplingParams(max_tokens=4, temperature=0.0),
+                        prefill_only=True)
+        while not eng.handoff_ready_ids():
+            eng.step()
+        assert eng.allocator.num_free() < free0
+        assert eng.abort("r")
+        assert eng.handoff_ready_ids() == []
+        assert not eng.has_work()
+        assert eng.allocator.num_free() == free0
+        assert eng.export_handoff("r") is None
+
+    def test_import_capacity_rejection(self, tiny_params):
+        eng = _engine(tiny_params)
+        exp = _export()
+        # seq_len inconsistent with resident tokens
+        bad = SequenceExport(**{**exp.__dict__, "seq_len": 3})
+        with pytest.raises(CacheDeserializationError):
+            eng.import_sequence(bad)
+
+
+# ---------------------------------------------------------------------------
+# Serving-level acceptance
+# ---------------------------------------------------------------------------
+
+
+class _Sink:
+    def __init__(self):
+        self.toks, self.text = [], ""
+        self.done = None
+        self.errors = []
+        self.ev = threading.Event()
+
+    def on_token(self, token_id, text, token_index, logprob=None):
+        if token_id is not None:
+            self.toks.append(token_id)
+        self.text += text
+
+    def on_done(self, finish_reason, usage):
+        self.done = (finish_reason, usage)
+        self.ev.set()
+
+    def on_error(self, message, code):
+        self.errors.append((message, code))
+        self.ev.set()
+
+
+class _FailingChannel(KVTransferChannel):
+    """Injected fault: every transfer raises (acceptance criterion —
+    the request must fall back to in-place decode, not drop)."""
+
+    name = "failing"
+
+    def __init__(self):
+        self.calls = 0
+
+    def transfer(self, exp):
+        self.calls += 1
+        raise RuntimeError("injected channel failure")
+
+
+def _run_request(srv, rid, max_tokens=10):
+    sink = _Sink()
+    srv.dispatcher.submit(ServerRequest(
+        rid, ByteTokenizer().encode(_PROMPT),
+        SamplingParams(max_tokens=max_tokens, temperature=0.0), sink,
+    ))
+    assert sink.ev.wait(90), "request did not complete"
+    return sink
+
+
+@pytest.fixture(scope="module")
+def reference_run(tiny_params):
+    srv = InferenceServer(
+        lambda: _engine(tiny_params), ByteTokenizer(), "tiny",
+        num_engines=1, auto_restart=False,
+    )
+    srv.start()
+    try:
+        sink = _run_request(srv, "ref")
+        assert not sink.errors, sink.errors
+        return sink
+    finally:
+        srv.shutdown(drain_timeout_s=5.0)
+
+
+@pytest.fixture(scope="module")
+def disagg_server(tiny_params):
+    srv = InferenceServer(
+        lambda: _engine(tiny_params), ByteTokenizer(), "tiny",
+        num_engines=2, auto_restart=False,
+        engine_roles=["prefill", "decode"],
+        disagg_settings=DisaggSettings(handoff_timeout_s=30.0),
+    )
+    srv.start()
+    yield srv
+    srv.shutdown(drain_timeout_s=5.0)
+
+
+class TestDisaggServing:
+    def test_prefill_decode_token_identical_to_unified(
+        self, disagg_server, reference_run
+    ):
+        """Acceptance: 1 prefill + 1 decode == single unified engine,
+        token for token (greedy)."""
+        got = _run_request(disagg_server, "d-identity")
+        assert not got.errors, got.errors
+        assert got.toks == reference_run.toks
+        assert got.text == reference_run.text
+        assert got.done[0] == reference_run.done[0]
+        assert got.done[1].prompt_tokens == reference_run.done[1].prompt_tokens
+        assert (got.done[1].completion_tokens
+                == reference_run.done[1].completion_tokens)
+        snap = disagg_server.metrics.snapshot(
+            tuple(disagg_server.scheduler.statuses())
+        ).to_dict()
+        assert snap["disagg"]["handoffs"].get("ok", 0) >= 1
+        assert snap["disagg"]["handoff_bytes"] > 0
+        roles = {w["engine_id"]: w["role"] for w in snap["worker_statuses"]}
+        assert roles == {"engine-0": "prefill", "engine-1": "decode"}
+
+    def test_decode_engine_finishes_the_request(self, disagg_server,
+                                                reference_run):
+        """The decode replica, not the prefill one, carries the decode:
+        total_processed lands on engine-1."""
+        _run_request(disagg_server, "d-owner")
+        statuses = {s.engine_id: s for s in disagg_server.scheduler.statuses()}
+        assert statuses["engine-1"].total_processed >= 1
+
+    def test_handoff_failure_falls_back_in_place(self, disagg_server,
+                                                 reference_run):
+        """Acceptance: injected channel error → in-place decode on the
+        prefill engine, request completes identically, fallback visible
+        in metrics."""
+        chan = disagg_server.disagg.channel
+        failing = _FailingChannel()
+        disagg_server.disagg.channel = failing
+        try:
+            got = _run_request(disagg_server, "d-fallback")
+        finally:
+            disagg_server.disagg.channel = chan
+        assert not got.errors, got.errors
+        assert got.toks == reference_run.toks
+        assert got.text == reference_run.text
+        assert failing.calls >= 1
+        snap = disagg_server.metrics.snapshot().to_dict()
+        assert snap["disagg"]["handoffs"].get("fallback", 0) >= 1
+        assert snap["disagg"]["handoffs"].get("retry", 0) >= 1
+
+    def test_prometheus_text_carries_handoff_metrics(self, disagg_server):
+        text = disagg_server.metrics.prometheus_text().decode()
+        assert "kv_handoff_latency_seconds" in text
+        assert "kv_handoff_bytes_total" in text
+        assert 'engines_by_role{role="prefill"}' in text
+
+    def test_protowire_channel_end_to_end(self, tiny_params, reference_run):
+        srv = InferenceServer(
+            lambda: _engine(tiny_params), ByteTokenizer(), "tiny",
+            num_engines=2, auto_restart=False,
+            engine_roles=["prefill", "decode"],
+            disagg_settings=DisaggSettings(channel="protowire",
+                                           handoff_timeout_s=30.0),
+        )
+        srv.start()
+        try:
+            got = _run_request(srv, "d-wire")
+            assert not got.errors, got.errors
+            assert got.toks == reference_run.toks
+            snap = srv.metrics.snapshot().to_dict()
+            assert snap["disagg"]["handoffs"].get("ok", 0) >= 1
+        finally:
+            srv.shutdown(drain_timeout_s=5.0)

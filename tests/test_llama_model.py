@@ -246,9 +246,9 @@ class TestCheckpointSaveRoundTrip:
                       "scale_embeddings", "tie_word_embeddings"):
             assert getattr(rcfg, field) == getattr(cfg, field), field
 
-        flat_a = jax.tree.leaves_with_path(params)
+        flat_a = jax.tree_util.tree_leaves_with_path(params)
         flat_b = {jax.tree_util.keystr(p): v
-                  for p, v in jax.tree.leaves_with_path(restored)}
+                  for p, v in jax.tree_util.tree_leaves_with_path(restored)}
         for path, leaf in flat_a:
             key = jax.tree_util.keystr(path)
             np.testing.assert_allclose(

@@ -387,7 +387,7 @@ def test_sharded_int8_kernel_matches_dequantized_reference():
         make_pallas_attend(PAGE, 0.0, True, interpret=True),
         mesh, True, kv_quantized=True,
     )
-    with jax.set_mesh(mesh):
+    with mesh:
         got = fn(q, QuantPool(kq, ks), QuantPool(vq, vs), tables, valid,
                  jnp.int32(0))
     want = _reference(

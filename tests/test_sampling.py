@@ -3,8 +3,9 @@
 import numpy as np
 import jax
 import jax.numpy as jnp
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+from _hypothesis_compat import given, settings, st
 
 from distributed_inference_server_tpu.ops.sampling import (
     nucleus_cutoff,

@@ -12,8 +12,9 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+from _hypothesis_compat import given, settings, st
 
 from distributed_inference_server_tpu.core.errors import QueueFull
 from distributed_inference_server_tpu.core.models import FinishReason, TokenEvent, Usage

@@ -1,0 +1,124 @@
+"""Disaggregated-serving smoke: boot a 1-prefill + 1-decode two-engine
+server on the CPU backend, stream a completion over real HTTP/SSE, and
+assert the handoff happened (ISSUE 1 CI satellite).
+
+Exercises the full production path — HTTP → handler → dispatcher →
+prefill engine → KVTransferChannel → decode engine → SSE — in one
+process, in seconds, with the tiny-llama fixture. Exit 0 = healthy.
+
+    JAX_PLATFORMS=cpu python tools/disagg_smoke.py
+    JAX_PLATFORMS=cpu python tools/disagg_smoke.py --channel protowire
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build_server(channel: str):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.disagg import DisaggSettings
+    from distributed_inference_server_tpu.serving.server import InferenceServer
+
+    params = llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+    paged = PagedCacheConfig(num_pages=192, page_size=8, max_pages_per_seq=32)
+
+    def factory():
+        return LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(max_batch=4, prefill_buckets=(16, 64), paged=paged),
+            dtype=jnp.float32,
+        )
+
+    return InferenceServer(
+        factory, ByteTokenizer(), model_name="tiny-disagg",
+        num_engines=2, auto_restart=False,
+        engine_roles=["prefill", "decode"],
+        disagg_settings=DisaggSettings(channel=channel,
+                                       handoff_timeout_s=30.0),
+    )
+
+
+async def drive(server, max_tokens: int) -> int:
+    import aiohttp
+    from aiohttp import web
+
+    runner = web.AppRunner(server.build_app())
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            t0 = time.monotonic()
+            async with session.post(
+                f"{base}/generate",
+                json={"prompt": "disaggregate me", "stream": True,
+                      "max_tokens": max_tokens, "temperature": 0.0},
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+                raw = (await resp.read()).decode()
+            frames = [f for f in raw.split("\n\n") if f]
+            assert frames[-1] == "data: [DONE]", frames[-1]
+            events = [json.loads(f[len("data: "):]) for f in frames[:-1]]
+            tokens = [e for e in events if e["type"] == "token"]
+            done = [e for e in events if e["type"] == "done"]
+            assert tokens, "no tokens streamed"
+            assert len(done) == 1, f"expected one done event, got {events}"
+            assert done[0]["usage"]["completion_tokens"] <= max_tokens
+
+            async with session.get(f"{base}/server/stats") as resp:
+                stats = await resp.json()
+        disagg = stats.get("disagg") or {}
+        ok = disagg.get("handoffs", {}).get("ok", 0)
+        roles = {w["engine_id"]: w["role"] for w in stats["worker_statuses"]}
+        assert roles == {"engine-0": "prefill", "engine-1": "decode"}, roles
+        assert ok >= 1, f"no successful handoff recorded: {disagg}"
+        print(
+            f"OK: {len(tokens)} tokens streamed in "
+            f"{time.monotonic() - t0:.2f}s; roles {roles}; "
+            f"handoffs {disagg['handoffs']}; "
+            f"{disagg['handoff_bytes']} KV bytes moved"
+        )
+        return 0
+    finally:
+        await runner.cleanup()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--channel", default="inproc",
+                    choices=["inproc", "protowire"])
+    ap.add_argument("--max-tokens", type=int, default=12)
+    args = ap.parse_args()
+    server = build_server(args.channel)
+    server.start()
+    try:
+        return asyncio.run(drive(server, args.max_tokens))
+    finally:
+        server.shutdown(drain_timeout_s=5.0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
