@@ -105,8 +105,11 @@ def _make_allocator(pcfg: PagedCacheConfig, force: Optional[bool]):
 
             if native.available():
                 return native.NativePageAllocator(pcfg)
-        except Exception:  # noqa: BLE001 — toolchain missing etc.
-            pass
+        except Exception as e:  # noqa: BLE001 — toolchain missing etc.
+            logging.getLogger(__name__).info(
+                "native allocator unavailable (%s); using the Python tier",
+                e,
+            )
         if force is True:
             raise RuntimeError(
                 "native_allocator=True but the native library is unavailable"

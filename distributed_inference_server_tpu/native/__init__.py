@@ -16,11 +16,14 @@ to the Python tier.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libdis_tpu_native.so")
@@ -37,18 +40,23 @@ def _load() -> Optional[ctypes.CDLL]:
         if _build_failed:
             return None
         # always run make: its dependency tracking rebuilds a stale .so
-        # after source edits (a no-op when up to date)
+        # after source edits (a no-op when up to date). Running under
+        # _lock is deliberate — concurrent first callers must wait for
+        # the one build, not race it.
         try:
-            subprocess.run(
+            subprocess.run(  # distlint: ignore[DL003]
                 ["make", "-C", _DIR],
                 check=True,
                 capture_output=True,
                 timeout=120,
             )
-        except Exception:
+        except Exception as e:
             if not os.path.exists(_LIB_PATH):
+                logger.info("native build failed (%s); Python tier only", e)
                 _build_failed = True
                 return None
+            logger.info("native rebuild failed (%s); using the existing "
+                        ".so", e)
         try:
             lib = ctypes.CDLL(_LIB_PATH)
             _declare(lib)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import copy
+import logging
 import os
 import threading
 import time
@@ -33,6 +34,8 @@ from distributed_inference_server_tpu.core.queue import QueueConfig
 from distributed_inference_server_tpu.core.validator import ValidatorConfig
 from distributed_inference_server_tpu.serving.batcher import BatcherConfig
 from distributed_inference_server_tpu.serving.scheduler import SchedulingStrategy
+
+logger = logging.getLogger(__name__)
 
 ENV_PREFIX = "DIS_TPU_"
 
@@ -487,13 +490,15 @@ class ConfigWatcher:
             # precedence survives the reload (Property 26)
             new = ServerConfig.load(file_path=path,
                                     cli_args=self.current.cli_args)
-        except Exception:  # noqa: BLE001 — malformed/partial file edits
+        except Exception as e:  # noqa: BLE001 — malformed/partial file edits
             # (toml parse errors, ENOENT during atomic replace) must
             # never kill hot-reload; the old config stays active. The
             # recorded mtime is NOT advanced on failure: if the writer
             # completes within the same mtime tick (coarse filesystem
             # timestamps), the next poll still retries instead of
             # treating the torn snapshot as current forever
+            logger.warning("config hot-reload: %s rejected (%s); keeping "
+                           "the active config", path, e)
             return False
         self._mtime = mtime
         diff = self.current.hot_diff(new)
@@ -503,7 +508,10 @@ class ConfigWatcher:
                 try:
                     cb(diff, new)
                 except Exception:  # noqa: BLE001 — subscriber isolation
-                    pass
+                    logger.exception(
+                        "config hot-reload subscriber %r failed; other "
+                        "subscribers still run", cb,
+                    )
         return True
 
     def start(self) -> None:
@@ -526,4 +534,4 @@ class ConfigWatcher:
             try:
                 self.check_once()
             except Exception:  # noqa: BLE001 — watcher must stay alive
-                pass
+                logger.exception("config watcher poll failed; retrying")

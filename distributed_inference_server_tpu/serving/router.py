@@ -175,7 +175,9 @@ def build_router_app(router: Router) -> web.Application:
         streaming = False
         try:
             streaming = json.loads(raw or b"{}").get("stream") is True
-        except Exception:  # noqa: BLE001 — backend will 400 it
+        # peek only decides proxy buffering; the backend parses the body
+        # authoritatively and 400s malformed JSON to the client
+        except Exception:  # noqa: BLE001  # distlint: ignore[DL004]
             pass
         tried: set = set()
         while True:

@@ -19,6 +19,7 @@ Failure semantics (``requirements.md:104-110,130-134``):
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -37,6 +38,8 @@ from distributed_inference_server_tpu.serving.metrics import (
     EngineStatus,
     MetricsCollector,
 )
+
+logger = logging.getLogger(__name__)
 
 
 class ResultSink(Protocol):
@@ -111,6 +114,12 @@ class EngineRunner:
         self._last_error: Optional[str] = None
         self._total_processed = 0
         self._inflight: Dict[RequestId, ServerRequest] = {}
+        # submit_resume callbacks not yet run by the engine thread: a
+        # crash/shutdown before the inbox drains resolves them from
+        # _fail_all (exactly-once via dict.pop), otherwise the migration
+        # job would leak in DisaggController._migrating and wedge every
+        # future drain on pending_count()
+        self._pending_resumes: Dict[RequestId, Callable] = {}
         self._pending_embeds: Dict[int, Callable] = {}
         self._embed_seq = 0
         # incremental embeddings jobs, advanced one device batch per
@@ -158,7 +167,10 @@ class EngineRunner:
         """Tear down and bring the engine back (worker self-restart,
         requirements.md:109)."""
         self.shutdown()
-        self._inbox.clear()
+        # under the lock even though the runner thread is joined: submit()
+        # may still race in from the dispatcher thread (distlint DL002)
+        with self._inbox_lock:
+            self._inbox.clear()
         self._inflight.clear()
         self.start(wait_ready=wait_ready, timeout=timeout)
 
@@ -215,26 +227,38 @@ class EngineRunner:
         once from the runner thread — or here, if the engine is already
         down. On ok=False the request has been deregistered again and the
         caller (the DisaggController) owns its fate (fallback)."""
+        # register BEFORE the health check (same crash-safe ordering as
+        # submit_embed): a crash between check and registration would
+        # otherwise strand on_done un-called and leak the migration job.
+        # _pending_resumes FIRST: a concurrent _fail_all that saw
+        # _inflight but not the callback would sink-fail the request AND
+        # let the fallback resume it — two contradictory terminal paths
+        self._pending_resumes[req.request_id] = on_done
         self._inflight[req.request_id] = req
         if not self._healthy:
             self._inflight.pop(req.request_id, None)
-            on_done(False, self._last_error or "engine unavailable")
+            cb = self._pending_resumes.pop(req.request_id, None)
+            if cb is not None:  # None: _fail_all already resolved it
+                cb(False, self._last_error or "engine unavailable")
             return
 
         def _do() -> None:
+            cb = self._pending_resumes.pop(req.request_id, None)
+            if cb is None:
+                return  # already resolved by _fail_all (crash/shutdown)
             if req.request_id not in self._inflight:
                 # aborted between registration and import: resolved (no
                 # fallback wanted), but NOT a real transfer — the
                 # "aborted" marker keeps the handoff metrics honest
-                on_done(True, "aborted")
+                cb(True, "aborted")
                 return
             try:
                 self._engine.import_sequence(exp)
             except Exception as e:  # noqa: BLE001 — import fault domain
                 self._inflight.pop(req.request_id, None)
-                on_done(False, str(e))
+                cb(False, str(e))
                 return
-            on_done(True, None)
+            cb(True, None)
 
         self._post(_do)
 
@@ -264,8 +288,8 @@ class EngineRunner:
                 try:
                     req.sink.on_error(f"KV export failed: {e}",
                                       "handoff_failed")
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as sink_exc:  # noqa: BLE001
+                    self._absorbed("sink_error", sink_exc)
                 continue
             if exp is None:
                 continue
@@ -391,6 +415,15 @@ class EngineRunner:
             self._inbox.append(fn)
         self._wake.set()
 
+    def _absorbed(self, site: str, exc: BaseException) -> None:
+        """An isolation boundary deliberately ate ``exc``; make that
+        observable — debug log + ``errors_total{site=...}`` — instead of
+        silent (distlint DL004). Must never raise itself."""
+        logger.debug("%s: absorbed error at %s: %s: %s", self.engine_id,
+                     site, type(exc).__name__, exc)
+        if self.metrics:
+            self.metrics.record_error(f"runner.{site}")
+
     # -- model hot-swap (Req 13, requirements.md:178-182) ------------------
 
     def swap_model(
@@ -482,8 +515,8 @@ class EngineRunner:
                 speculation = eng.spec_stats()
                 if speculation is not None and self.metrics:
                     self.metrics.set_speculation(self.engine_id, speculation)
-            except Exception:  # noqa: BLE001 — status must never raise
-                pass
+            except Exception as e:  # noqa: BLE001 — status must never raise
+                self._absorbed("status", e)
         return EngineStatus(
             engine_id=self.engine_id,
             role=self.role,
@@ -638,8 +671,8 @@ class EngineRunner:
                     try:
                         req.sink.on_error(f"sink failure: {e}",
                                           "server_error")
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as err_exc:  # noqa: BLE001
+                        self._absorbed("sink_error", err_exc)
                 elif out.finished:
                     # the request DID resolve — only post-terminal
                     # bookkeeping raised; keep the count honest
@@ -653,7 +686,8 @@ class EngineRunner:
             return
         try:
             s = self._engine.cache_stats()
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001
+            self._absorbed("cache_stats", e)
             return
         seen = self._cache_seen
         self.metrics.record_cache(
@@ -666,6 +700,20 @@ class EngineRunner:
         }
 
     def _fail_all(self, message: str) -> None:
+        # resolve un-run resume imports FIRST, dropping them from
+        # _inflight so they are not also sink-failed below: on_done(False)
+        # hands the request back to the DisaggController, whose in-place
+        # fallback owns its fate (a sink error here would be a second,
+        # contradictory terminal event)
+        for rid in list(self._pending_resumes):
+            cb = self._pending_resumes.pop(rid, None)
+            if cb is None:
+                continue
+            self._inflight.pop(rid, None)
+            try:
+                cb(False, message)
+            except Exception as e:  # noqa: BLE001 — callback isolation
+                self._absorbed("resume_callback", e)
         self._fail_all_of(list(self._inflight.values()), message)
         self._inflight.clear()
         for token in list(self._pending_embeds):
@@ -673,15 +721,15 @@ class EngineRunner:
             if cb is not None:
                 try:
                     cb(None, message)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    self._absorbed("embed_callback", e)
 
     def _fail_all_of(self, reqs: Sequence[ServerRequest], message: str) -> None:
         for req in reqs:
             try:
                 req.sink.on_error(message, "worker_failure")
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                self._absorbed("sink_error", e)
             if self.tracer and req.engine_span is not None:
                 self.tracer.finish(req.engine_span, status="error")
                 req.engine_span = None

@@ -22,12 +22,15 @@ engines, mirroring the reference's test approach (SURVEY.md §4.3).
 from __future__ import annotations
 
 import enum
+import logging
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
 from distributed_inference_server_tpu.serving.metrics import EngineStatus
 from distributed_inference_server_tpu.serving.runner import EngineRunner
+
+logger = logging.getLogger(__name__)
 
 
 class SchedulingStrategy(str, enum.Enum):
@@ -203,6 +206,9 @@ class AdaptiveScheduler:
         try:
             runner.restart(wait_ready=True)
         except Exception:  # noqa: BLE001 — keep retrying on next sweep
-            pass
+            logger.exception(
+                "engine %s restart failed; retrying on the next health "
+                "sweep", runner.engine_id,
+            )
         finally:
             self._restarting.discard(runner.engine_id)

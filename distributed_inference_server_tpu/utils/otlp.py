@@ -124,9 +124,13 @@ class OTLPExporter:
             )
             with urllib.request.urlopen(req, timeout=self.timeout_s):
                 pass
-            self.exported += len(spans)
+            with self._lock:
+                self.exported += len(spans)
         except Exception:  # noqa: BLE001 — telemetry is fail-open
-            self.dropped += len(spans)
+            # under the lock: the counter is read/written from the flush
+            # thread and recorders concurrently (distlint DL002)
+            with self._lock:
+                self.dropped += len(spans)
 
     # -- OTLP encoding ------------------------------------------------------
 

@@ -109,7 +109,7 @@ class Tracer:
             try:
                 export(span)
             except Exception:  # noqa: BLE001 — tracing must never break serving
-                pass
+                log.debug("span exporter %r failed", export, exc_info=True)
 
     @contextlib.contextmanager
     def span(

@@ -1,0 +1,537 @@
+"""distlint (tools/lint): per-rule positive/negative fixtures, the
+suppression and baseline machinery, the proto parser, and — the tier-1
+gate — a full run over the real repo asserting zero non-baselined
+findings (ISSUE 2 acceptance; docs/LINTS.md)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from tools.lint import proto as protodef
+from tools.lint import rules as rules_mod
+from tools.lint.core import (
+    RULES,
+    apply_baseline,
+    apply_suppressions,
+    load_baseline,
+    module_from_source,
+    run_lint,
+)
+from tools.lint.rules import compare_wire_schema
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PKG = "distributed_inference_server_tpu"
+
+
+def check(rule: str, path: str, src: str):
+    """Run one module-scope rule over fixture source, suppressions applied."""
+    mod = module_from_source(path, src)
+    findings = list(RULES[rule].check(mod))
+    active, _ = apply_suppressions({path: mod}, findings)
+    return active
+
+
+# ---------------------------------------------------------------------------
+# DL001 — blocking calls on async / serving-spine paths
+# ---------------------------------------------------------------------------
+
+
+def test_dl001_flags_sleep_in_async_def():
+    out = check("DL001", f"{PKG}/serving/app.py", (
+        "import time\n"
+        "async def handler():\n"
+        "    time.sleep(1)\n"
+    ))
+    assert [f.line for f in out] == [3]
+    assert out[0].severity == "P0"
+
+
+def test_dl001_flags_unawaited_event_wait_in_async_def():
+    out = check("DL001", f"{PKG}/engine/x.py", (
+        "async def f(ev):\n"
+        "    ev.wait(5)\n"
+    ))
+    assert len(out) == 1
+
+
+def test_dl001_flags_sync_sleep_on_serving_spine():
+    out = check("DL001", f"{PKG}/serving/dispatcher.py", (
+        "import time\n"
+        "def loop():\n"
+        "    time.sleep(0.01)\n"
+    ))
+    assert len(out) == 1 and out[0].severity == "P1"
+
+
+def test_dl001_clean():
+    # awaited sleep, Event.wait on a thread, sleep outside serving/
+    assert not check("DL001", f"{PKG}/serving/app.py", (
+        "import asyncio\n"
+        "async def handler():\n"
+        "    await asyncio.sleep(1)\n"
+    ))
+    assert not check("DL001", f"{PKG}/serving/dispatcher.py", (
+        "def loop(self):\n"
+        "    self._stop.wait(0.01)\n"
+    ))
+    assert not check("DL001", f"{PKG}/utils/profiler.py", (
+        "import time\n"
+        "def capture():\n"
+        "    time.sleep(0.5)\n"
+    ))
+
+
+def test_dl001_suppression_comment():
+    assert not check("DL001", f"{PKG}/serving/server.py", (
+        "import time\n"
+        "def drain():\n"
+        "    time.sleep(0.05)  # distlint: ignore[DL001]\n"
+    ))
+
+
+# ---------------------------------------------------------------------------
+# DL002 — guarded state mutated outside the lock
+# ---------------------------------------------------------------------------
+
+_DL002_POS = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+    def racy(self, x):
+        self._items.append(x)
+"""
+
+
+def test_dl002_flags_unlocked_mutation():
+    out = check("DL002", f"{PKG}/serving/x.py", _DL002_POS)
+    assert len(out) == 1
+    assert out[0].context == "C.racy"
+    assert "_items" in out[0].message
+
+
+def test_dl002_clean_when_locked_and_for_locked_suffix():
+    assert not check("DL002", f"{PKG}/serving/x.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = []\n"
+        "    def add(self, x):\n"
+        "        with self._lock:\n"
+        "            self._items.append(x)\n"
+        "    def also_fine(self, x):\n"
+        "        with self._lock:\n"
+        "            self._items = [x]\n"
+        # *_locked convention: caller holds the lock
+        "    def _add_locked(self, x):\n"
+        "        self._items.append(x)\n"
+    ))
+
+
+def test_dl002_ignores_classes_without_locks():
+    assert not check("DL002", f"{PKG}/serving/x.py", (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._items = []\n"
+        "    def add(self, x):\n"
+        "        self._items.append(x)\n"
+    ))
+
+
+# ---------------------------------------------------------------------------
+# DL003 — lock held across await / blocking call
+# ---------------------------------------------------------------------------
+
+
+def test_dl003_flags_sleep_under_lock():
+    out = check("DL003", f"{PKG}/serving/x.py", (
+        "import threading, time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(1)\n"
+    ))
+    assert len(out) == 1 and out[0].severity == "P0"
+
+
+def test_dl003_flags_await_under_lock():
+    out = check("DL003", f"{PKG}/serving/x.py", (
+        "async def f(self):\n"
+        "    with self._lock:\n"
+        "        await self.q.get()\n"
+    ))
+    assert len(out) == 1 and "await" in out[0].message
+
+
+def test_dl003_condition_wait_on_held_lock_is_exempt():
+    assert not check("DL003", f"{PKG}/serving/disagg.py", (
+        "class C:\n"
+        "    def worker(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.wait(0.1)\n"
+    ))
+
+
+def test_dl003_other_objects_wait_under_lock_flagged():
+    out = check("DL003", f"{PKG}/serving/x.py", (
+        "class C:\n"
+        "    def f(self):\n"
+        "        with self._cv:\n"
+        "            self._stop.wait(1.0)\n"
+    ))
+    assert len(out) == 1
+
+
+# ---------------------------------------------------------------------------
+# DL004 — silently swallowed broad excepts
+# ---------------------------------------------------------------------------
+
+
+def test_dl004_flags_silent_pass():
+    out = check("DL004", f"{PKG}/serving/x.py", (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    ))
+    assert len(out) == 1
+
+
+def test_dl004_flags_bare_except():
+    out = check("DL004", f"{PKG}/serving/x.py", (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except:\n"
+        "        return None\n"
+    ))
+    assert len(out) == 1 and "bare except" in out[0].message
+
+
+def test_dl004_clean_variants():
+    # logging, metric increment, re-raise, and forwarding `e` all count
+    assert not check("DL004", f"{PKG}/serving/x.py", (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        logger.exception('boom')\n"
+    ))
+    assert not check("DL004", f"{PKG}/serving/x.py", (
+        "def f(self):\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        self.metrics.record_error('site')\n"
+    ))
+    assert not check("DL004", f"{PKG}/serving/x.py", (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        raise RuntimeError('wrapped')\n"
+    ))
+    assert not check("DL004", f"{PKG}/serving/x.py", (
+        "def f(self, sink):\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as e:\n"
+        "        sink.on_error(str(e), 'code')\n"
+    ))
+    # narrow excepts are out of scope
+    assert not check("DL004", f"{PKG}/serving/x.py", (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except ValueError:\n"
+        "        pass\n"
+    ))
+
+
+# ---------------------------------------------------------------------------
+# DL005 — proto <-> protowire drift (pure comparator + parser)
+# ---------------------------------------------------------------------------
+
+_TOY_PROTO = """
+syntax = "proto3";
+package t;
+
+enum Color {
+  COLOR_UNSPECIFIED = 0;
+  RED = 1;           // "red"
+  DARK_BLUE = 2;     // "dark_blue"
+}
+
+message Outer {
+  string name = 1;
+  optional uint32 count = 2;
+  repeated float vals = 3;
+  Inner inner = 4;
+  Color color = 5;
+  message Inner {
+    bytes data = 1;
+  }
+  oneof kind {
+    Inner a = 6;
+    string b = 7;
+  }
+}
+"""
+
+_TOY_MESSAGES = {
+    "Outer": {
+        1: ("name", "string", "one"),
+        2: ("count", "uint32", "opt"),
+        3: ("vals", "float", "rep"),
+        4: ("inner", "msg:Outer.Inner", "opt"),
+        5: ("color", "enum:Color", "one"),
+        6: ("a", "msg:Outer.Inner", "opt"),
+        7: ("b", "string", "opt"),
+    },
+    "Outer.Inner": {1: ("data", "bytes", "one")},
+}
+_TOY_ENUMS = {"Color": {1: "red", 2: "dark_blue"}}
+
+
+def test_proto_parser_structure():
+    schema = protodef.parse(_TOY_PROTO)
+    assert set(schema.messages) == {"Outer", "Outer.Inner"}
+    outer = schema.messages["Outer"]
+    assert outer.fields[1].label == "one"
+    assert outer.fields[2].label == "opt"
+    assert outer.fields[3].label == "rep"
+    assert outer.fields[6].label == "opt"  # oneof member
+    assert schema.enums["Color"].values == {
+        0: "COLOR_UNSPECIFIED", 1: "RED", 2: "DARK_BLUE"}
+    kind, t = protodef.resolve_type(schema, "Outer", "Inner")
+    assert (kind, t) == ("msg", "msg:Outer.Inner")
+
+
+def test_dl005_clean_on_matching_tables():
+    schema = protodef.parse(_TOY_PROTO)
+    assert compare_wire_schema(schema, _TOY_MESSAGES, _TOY_ENUMS) == []
+
+
+def test_dl005_detects_drift():
+    schema = protodef.parse(_TOY_PROTO)
+    # field number missing
+    broken = {k: dict(v) for k, v in _TOY_MESSAGES.items()}
+    del broken["Outer"][3]
+    msgs = [m for _, m in compare_wire_schema(schema, broken, _TOY_ENUMS)]
+    assert any("vals = 3" in m for m in msgs)
+    # type drift
+    broken = {k: dict(v) for k, v in _TOY_MESSAGES.items()}
+    broken["Outer"][2] = ("count", "int64", "opt")
+    msgs = [m for _, m in compare_wire_schema(schema, broken, _TOY_ENUMS)]
+    assert any("type drift" in m for m in msgs)
+    # cardinality drift
+    broken = {k: dict(v) for k, v in _TOY_MESSAGES.items()}
+    broken["Outer"][2] = ("count", "uint32", "one")
+    msgs = [m for _, m in compare_wire_schema(schema, broken, _TOY_ENUMS)]
+    assert any("cardinality drift" in m for m in msgs)
+    # enum JSON-string drift
+    msgs = [m for _, m in compare_wire_schema(
+        schema, _TOY_MESSAGES, {"Color": {1: "red", 2: "blue"}})]
+    assert any("JSON string drift" in m for m in msgs)
+
+
+def test_dl005_real_schema_agrees():
+    """The repo's actual proto and codec tables (also enforced by the
+    project-scope rule inside the full run below; asserted directly here
+    so a drift failure names this test)."""
+    schema = protodef.parse_file(
+        REPO_ROOT / PKG / "serving" / "inference.proto")
+    messages, enums = rules_mod.load_protowire_tables(REPO_ROOT)
+    assert compare_wire_schema(schema, messages, enums) == []
+
+
+# ---------------------------------------------------------------------------
+# DL006 — metric hygiene (synthetic collector + usage modules)
+# ---------------------------------------------------------------------------
+
+_METRICS_SRC = """
+from prometheus_client import Counter, Gauge
+class MetricsCollector:
+    def __init__(self, registry=None):
+        self.reqs = Counter("reqs_total", "requests", registry=registry)
+        self.depth = Gauge("queue_depth", "depth", registry=registry)
+        self.ghost = Counter("ghost_total", "never emitted",
+                             registry=registry)
+    def record_request(self):
+        self.reqs.inc()
+    def set_depth(self, n):
+        self.depth.set(n)
+    def dead_method(self):
+        self.reqs.inc()
+"""
+
+_USER_SRC = """
+class Handler:
+    def __init__(self, metrics):
+        self.metrics = metrics
+    def handle(self):
+        self.metrics.record_request()
+    def update(self, n):
+        self.metrics.set_depth(n)
+"""
+
+
+def _dl006(metrics_src, user_src):
+    mpath = f"{PKG}/serving/metrics.py"
+    mods = [module_from_source(mpath, metrics_src),
+            module_from_source(f"{PKG}/serving/handler.py", user_src)]
+    return list(RULES["DL006"].check_project(mods, REPO_ROOT))
+
+
+def test_dl006_flags_unemitted_metric_and_dead_method():
+    out = _dl006(_METRICS_SRC, _USER_SRC)
+    msgs = [f.message for f in out]
+    assert any("ghost" in m and "never emitted" in m for m in msgs)
+    assert any("dead_method" in m for m in msgs)
+    assert len(out) == 2
+
+
+def test_dl006_flags_typoed_emission_site():
+    out = _dl006(_METRICS_SRC, _USER_SRC.replace(
+        "record_request()", "record_requests()"))
+    assert any("record_requests" in f.message and "does not exist"
+               in f.message for f in out)
+
+
+def test_dl006_clean():
+    clean_metrics = _METRICS_SRC.replace(
+        """        self.ghost = Counter("ghost_total", "never emitted",
+                             registry=registry)
+""", "").replace("""    def dead_method(self):
+        self.reqs.inc()
+""", "")
+    assert _dl006(clean_metrics, _USER_SRC) == []
+
+
+def test_dl006_flags_duplicate_prometheus_name():
+    dup = _METRICS_SRC.replace('Gauge("queue_depth"', 'Gauge("reqs_total"')
+    out = _dl006(dup, _USER_SRC)
+    assert any("duplicate prometheus metric name" in f.message for f in out)
+
+
+# ---------------------------------------------------------------------------
+# DL007 — device work in the per-token decode loop
+# ---------------------------------------------------------------------------
+
+
+def test_dl007_flags_jnp_in_hot_function():
+    out = check("DL007", f"{PKG}/engine/engine.py", (
+        "import jax.numpy as jnp\n"
+        "class LLMEngine:\n"
+        "    def _emit_token(self, seq, t, outputs):\n"
+        "        pad = jnp.zeros((4,))\n"
+        "        return pad\n"
+    ))
+    assert len(out) == 1 and out[0].severity == "P0"
+
+
+def test_dl007_flags_host_sync_in_hot_function():
+    out = check("DL007", f"{PKG}/engine/engine.py", (
+        "class LLMEngine:\n"
+        "    def _process_block(self, outputs):\n"
+        "        x = self.arr.block_until_ready()\n"
+        "        y = self.val.item()\n"
+    ))
+    assert len(out) == 2
+
+
+def test_dl007_clean():
+    # numpy host work in hot functions is fine; jnp outside them is fine
+    assert not check("DL007", f"{PKG}/engine/engine.py", (
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "class LLMEngine:\n"
+        "    def _process_block(self, outputs):\n"
+        "        toks = np.asarray(self.toks_d)\n"
+        "    def _launch(self):\n"
+        "        return jnp.zeros((4,))\n"
+    ))
+    # rule only applies to engine/engine.py
+    assert not check("DL007", f"{PKG}/serving/x.py", (
+        "import jax.numpy as jnp\n"
+        "def _emit_token():\n"
+        "    return jnp.zeros(1)\n"
+    ))
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_consumes_matching_findings():
+    mod = module_from_source(f"{PKG}/serving/x.py", (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    ))
+    findings = list(RULES["DL004"].check(mod))
+    assert len(findings) == 1
+    f = findings[0]
+    entry = {"rule": f.rule, "path": f.path, "context": f.context,
+             "line": f.line_text}
+    new, matched, stale = apply_baseline(findings, [entry])
+    assert new == [] and len(matched) == 1 and stale == []
+    # a second identical finding needs a second entry (multiset consume)
+    new, matched, _ = apply_baseline(findings * 2, [entry])
+    assert len(new) == 1 and len(matched) == 1
+    # stale entries surface for baseline shrinking
+    _, _, stale = apply_baseline([], [entry])
+    assert stale == [entry]
+
+
+def test_baseline_match_survives_line_motion_but_not_edit():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    f0 = list(RULES["DL004"].check(
+        module_from_source(f"{PKG}/serving/x.py", src)))[0]
+    moved = list(RULES["DL004"].check(module_from_source(
+        f"{PKG}/serving/x.py", "import os\n\n" + src)))[0]
+    assert f0.key == moved.key and f0.line != moved.line
+    edited = list(RULES["DL004"].check(module_from_source(
+        f"{PKG}/serving/x.py", src.replace("def f", "def h"))))[0]
+    assert f0.key != edited.key  # context changed -> re-triage
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the real repo is clean
+# ---------------------------------------------------------------------------
+
+
+def test_repo_has_zero_nonbaselined_findings():
+    """`python -m tools.lint.run` must exit 0: every finding is either
+    fixed, suppressed inline with a justification, or grandfathered in
+    tools/lint/baseline.json (which may only shrink — docs/LINTS.md)."""
+    active, _suppressed = run_lint(REPO_ROOT)
+    new, _matched, _stale = apply_baseline(active, load_baseline())
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_repo_p0_findings_are_never_baselined():
+    """P0 severities (async blocking, lock-across-blocking, wire drift,
+    hot-loop device work) must be fixed or suppressed-with-justification,
+    not grandfathered."""
+    baseline = load_baseline()
+    p0_rules = {n for n, r in RULES.items() if r.severity == "P0"}
+    offenders = [e for e in baseline if e.get("rule") in p0_rules]
+    assert offenders == []

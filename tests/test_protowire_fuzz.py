@@ -1,0 +1,135 @@
+"""KvHandoff wire-format fuzz: random SequenceExports round-tripped
+through the protowire codec (serving/disagg.py export_to_wire /
+export_from_wire), plus schema agreement between serving/inference.proto
+and the protowire tables — the runtime twin of distlint rule DL005.
+
+Deterministic seeded random (the image ships no hypothesis): failures
+reproduce exactly, and the test always runs in tier 1."""
+
+from __future__ import annotations
+
+import random
+
+from distributed_inference_server_tpu.engine.engine import (
+    SamplingParams,
+    SequenceExport,
+)
+from distributed_inference_server_tpu.serving import protowire
+from distributed_inference_server_tpu.serving.disagg import (
+    export_from_wire,
+    export_to_wire,
+)
+from tools.lint import proto as protodef
+from tools.lint.rules import compare_wire_schema
+
+# code points that exercise 1..4-byte UTF-8, U+FFFD, and ASCII controls
+_CHARS = (
+    "abc XYZ 0189 \t\n" "äßçñ" "中文日本語" "🙂🚀" "�" "'\"\\{}[]"
+)
+
+
+def _rand_text(rng: random.Random, max_len: int = 40) -> str:
+    return "".join(rng.choice(_CHARS) for _ in range(rng.randrange(max_len)))
+
+
+def _rand_export(rng: random.Random) -> SequenceExport:
+    n_tokens = rng.randrange(0, 60)
+    token_ids = [rng.randrange(0, 2 ** 32) for _ in range(n_tokens)]
+    return SequenceExport(
+        request_id=_rand_text(rng, 20) or "req-0",
+        token_ids=token_ids,
+        prompt_len=rng.randrange(0, 4096),
+        seq_len=n_tokens,
+        next_token=rng.randrange(0, 2 ** 31),
+        params=SamplingParams(
+            max_tokens=rng.randrange(1, 8192),
+            # full-range doubles: bit-exactness across the handoff is the
+            # whole point of the double fields (inference.proto note)
+            temperature=rng.choice(
+                [0.0, 1.0, rng.random() * 2, 7e-45, 0.6999999999999998]
+            ),
+            top_p=rng.choice([1.0, rng.random() or 0.5, 0.9]),
+            stop_sequences=tuple(
+                _rand_text(rng, 8) for _ in range(rng.randrange(3))
+            ),
+        ),
+        output_text=_rand_text(rng, 120),
+        emitted_upto=rng.randrange(0, 120),
+        emitted_tokens=rng.randrange(0, 8192),
+        pending_ids=[rng.randrange(0, 2 ** 20)
+                     for _ in range(rng.randrange(4))],
+        kv=rng.randbytes(rng.randrange(0, 256)),
+        draft_kv=(rng.randbytes(rng.randrange(1, 64))
+                  if rng.random() < 0.5 else None),
+        source_engine=rng.choice(["", "engine-0", "engine-17"]),
+    )
+
+
+def test_kvhandoff_roundtrip_fuzz():
+    rng = random.Random(0xD157)
+    for i in range(300):
+        exp = _rand_export(rng)
+        got = export_from_wire(export_to_wire(exp))
+        for attr in ("request_id", "token_ids", "prompt_len", "seq_len",
+                     "next_token", "output_text", "emitted_upto",
+                     "emitted_tokens", "pending_ids", "kv", "source_engine"):
+            assert getattr(got, attr) == getattr(exp, attr), (i, attr)
+        # draft_kv is `optional bytes`: absent stays absent (None), never
+        # collapses to b""
+        assert got.draft_kv == exp.draft_kv, i
+        p, q = got.params, exp.params
+        assert p.max_tokens == q.max_tokens, i
+        # doubles must survive BIT-EXACT (sampled-token identity across
+        # the handoff); repr equality catches any float32 truncation
+        assert repr(p.temperature) == repr(q.temperature), i
+        assert repr(p.top_p) == repr(q.top_p), i
+        assert tuple(p.stop_sequences) == tuple(q.stop_sequences), i
+
+
+def test_kvhandoff_decode_fills_proto3_defaults():
+    """An all-defaults frame (zero bytes on the wire) reconstructs the
+    full key set with proto3 zero values."""
+    d = protowire.decode("KvHandoff", b"")
+    assert d["token_ids"] == [] and d["pending_ids"] == []
+    assert d["stop_sequences"] == []
+    assert d["kv"] == b"" and "draft_kv" not in d
+    assert d["temperature"] == 0.0 and d["max_tokens"] == 0
+    assert d["request_id"] == "" and d["source_engine"] == ""
+
+
+def test_kvhandoff_unknown_fields_skipped():
+    """Forward compatibility: a frame carrying an unknown field decodes
+    cleanly (future senders may extend the message)."""
+    base = export_to_wire(_rand_export(random.Random(7)))
+    # field 100, length-delimited, 3 payload bytes
+    unknown = protowire._key(100, 2) + bytes([3, 1, 2, 3])
+    d = protowire.decode("KvHandoff", unknown + base)
+    assert d == protowire.decode("KvHandoff", base)
+
+
+def test_total_processed_uint64_roundtrip():
+    """EngineStatus.total_processed is uint64 in inference.proto; counts
+    past 2^63 must not decode negative (distlint DL005 fix)."""
+    big = 2 ** 63 + 5
+    data = protowire.encode("EngineStatus", {
+        "engine_id": "e", "healthy": True, "total_processed": big,
+    })
+    assert protowire.decode("EngineStatus", data)["total_processed"] == big
+
+
+def test_wire_schema_field_numbers_agree_with_proto():
+    """Field-number/type/cardinality agreement between inference.proto
+    and the live protowire tables — the runtime half of DL005, pinned
+    here so a drift fails even if someone disables the linter."""
+    import distributed_inference_server_tpu as pkg
+    from pathlib import Path
+
+    proto_path = (Path(pkg.__file__).parent / "serving" / "inference.proto")
+    schema = protodef.parse_file(proto_path)
+    diffs = compare_wire_schema(schema, protowire.MESSAGES, protowire.ENUMS)
+    assert diffs == [], diffs
+    # and KvHandoff specifically covers every SequenceExport field
+    kv = schema.messages["KvHandoff"]
+    names = {f.name for f in kv.fields.values()}
+    assert {"request_id", "token_ids", "kv", "draft_kv", "temperature",
+            "top_p", "stop_sequences", "source_engine"} <= names

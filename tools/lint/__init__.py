@@ -1,0 +1,28 @@
+"""distlint: project-native static analysis for the serving stack.
+
+The reference spec defines correctness properties (priority ordering,
+backpressure, batch windowing, handoff integrity) that the test suite can
+only probe dynamically; ``tools.lint`` encodes the *mechanically checkable*
+subset as AST-level rules over ``distributed_inference_server_tpu/``:
+
+    DL001  blocking calls on async / serving-spine paths
+    DL002  mutation of lock-guarded shared state outside the lock
+    DL003  lock held across await or a blocking call
+    DL004  broad ``except`` that swallows the error silently
+    DL005  wire drift between inference.proto and protowire.py
+    DL006  metric hygiene (registered <-> emitted, no phantom attrs)
+    DL007  JAX hot-path hygiene in the per-token decode loop
+
+Run ``python -m tools.lint.run`` (tier-1 via tests/test_distlint.py).
+Rule catalog and suppression syntax: docs/LINTS.md.
+"""
+
+from tools.lint.core import (  # noqa: F401
+    Finding,
+    Module,
+    Rule,
+    RULES,
+    load_baseline,
+    module_from_source,
+    run_lint,
+)

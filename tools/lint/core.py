@@ -1,0 +1,323 @@
+"""distlint core: findings, rule registry, suppressions, baseline.
+
+Design (docs/LINTS.md has the operator-facing version):
+
+- a **Rule** inspects one parsed module (``scope="module"``) or the whole
+  package at once (``scope="project"``, for cross-file checks like proto
+  drift) and yields **Finding**s;
+- a finding is silenced either by an inline suppression comment::
+
+      time.sleep(0.05)  # distlint: ignore[DL001] -- dedicated drain thread
+
+  (same line, or the line directly above when that line is a comment), or
+  by an entry in the checked-in **baseline** (``tools/lint/baseline.json``)
+  for grandfathered findings. Baseline entries match on
+  ``(rule, path, enclosing scope, stripped line text)`` — NOT line numbers
+  — so unrelated edits above a finding do not invalidate the baseline,
+  while any edit to the offending line itself forces a re-triage.
+- the baseline may only shrink over time (policy in docs/LINTS.md);
+  ``python -m tools.lint.run --update-baseline`` rewrites it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: package subtree the linter checks by default (tests and tools are
+#: deliberately out of scope: fixtures must be able to contain violations)
+DEFAULT_TARGET = "distributed_inference_server_tpu"
+
+_SUPPRESS_RE = re.compile(r"#\s*distlint:\s*ignore\[([A-Za-z0-9_,\s]+)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a line but identified by content."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int  # 1-based
+    message: str
+    severity: str = "P1"  # P0 = must fix, P1 = fix or baseline, P2 = advisory
+    context: str = ""  # enclosing ClassName.method qualname ("" = module)
+    line_text: str = ""  # stripped source of the anchored line
+
+    @property
+    def key(self) -> Tuple[str, str, str, str]:
+        """Line-number-free identity used for baseline matching."""
+        return (self.rule, self.path, self.context, self.line_text)
+
+    def render(self) -> str:
+        where = f" (in {self.context})" if self.context else ""
+        return (f"{self.path}:{self.line}: {self.rule}[{self.severity}] "
+                f"{self.message}{where}")
+
+
+@dataclass
+class Module:
+    """A parsed source file handed to rules."""
+
+    path: str  # repo-relative, posix separators
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+
+    def text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+def module_from_source(path: str, source: str) -> Module:
+    """Build a Module from an in-memory source string (test fixtures)."""
+    return Module(path=path, tree=ast.parse(source),
+                  lines=source.splitlines())
+
+
+class Rule:
+    """Base class; subclasses register themselves via ``@register``."""
+
+    name: str = ""
+    title: str = ""
+    severity: str = "P1"
+    scope: str = "module"  # "module" | "project"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        return ()
+
+    # -- helpers for subclasses -------------------------------------------
+
+    def finding(self, module: Module, node: ast.AST, message: str,
+                context: str = "", severity: Optional[str] = None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.name,
+            path=module.path,
+            line=line,
+            message=message,
+            severity=severity or self.severity,
+            context=context,
+            line_text=module.text(line),
+        )
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the global registry."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    RULES[inst.name] = inst
+    return cls
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the enclosing class/function qualname and
+    whether the innermost *function* scope is async. Subclasses call
+    ``self.qualname`` / ``self.in_async`` / ``self.func_name`` and must use
+    ``generic_visit`` (or the provided visit_* which already recurse)."""
+
+    def __init__(self) -> None:
+        self._stack: List[str] = []
+        self._async_stack: List[bool] = []
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self._stack)
+
+    @property
+    def in_async(self) -> bool:
+        return bool(self._async_stack) and self._async_stack[-1]
+
+    @property
+    def func_name(self) -> str:
+        return self._stack[-1] if self._stack else ""
+
+    def _enter(self, node, is_async: Optional[bool]) -> None:
+        self._stack.append(node.name)
+        if is_async is not None:
+            self._async_stack.append(is_async)
+        self.generic_visit(node)
+        if is_async is not None:
+            self._async_stack.pop()
+        self._stack.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._enter(node, None)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter(node, False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter(node, True)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression: ``jax.device_get`` ->
+    "jax.device_get"; non-name parts collapse to ""."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+# -- suppression ------------------------------------------------------------
+
+
+def suppressed_rules(module: Module, line: int) -> frozenset:
+    """Rules suppressed at ``line``: an ignore comment on the line itself,
+    or on the directly preceding line when that line is pure comment."""
+    out: set = set()
+    for cand in (line, line - 1):
+        if not 1 <= cand <= len(module.lines):
+            continue
+        text = module.lines[cand - 1]
+        if cand != line and not text.strip().startswith("#"):
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            out.update(r.strip() for r in m.group(1).split(",") if r.strip())
+    return frozenset(out)
+
+
+def apply_suppressions(
+    modules: Dict[str, Module], findings: Iterable[Finding]
+) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (active, suppressed-by-comment)."""
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        mod = modules.get(f.path)
+        if mod is not None and f.rule in suppressed_rules(mod, f.line):
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+# -- baseline ---------------------------------------------------------------
+
+BASELINE_PATH = Path(__file__).parent / "baseline.json"
+
+
+def load_baseline(path: Optional[Path] = None) -> List[dict]:
+    path = path or BASELINE_PATH
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    return list(data.get("entries", []))
+
+
+def save_baseline(findings: Iterable[Finding],
+                  path: Optional[Path] = None) -> None:
+    path = path or BASELINE_PATH
+    entries = [
+        {"rule": f.rule, "path": f.path, "context": f.context,
+         "line": f.line_text}
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    ]
+    path.write_text(json.dumps({
+        "policy": ("grandfathered findings only; this file may only SHRINK "
+                   "in future PRs (docs/LINTS.md)"),
+        "entries": entries,
+    }, indent=2) + "\n")
+
+
+def apply_baseline(
+    findings: Iterable[Finding], baseline: List[dict]
+) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """Split into (new, baselined, stale-baseline-entries). Matching is a
+    multiset consume on the content key, so a file with two identical
+    grandfathered lines needs two entries."""
+    pool: Dict[Tuple[str, str, str, str], int] = {}
+    for e in baseline:
+        k = (e.get("rule", ""), e.get("path", ""), e.get("context", ""),
+             e.get("line", ""))
+        pool[k] = pool.get(k, 0) + 1
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    for f in findings:
+        if pool.get(f.key, 0) > 0:
+            pool[f.key] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = [
+        {"rule": k[0], "path": k[1], "context": k[2], "line": k[3]}
+        for k, n in pool.items() for _ in range(n)
+    ]
+    return new, matched, stale
+
+
+# -- collection & driving ---------------------------------------------------
+
+
+def collect_modules(root: Path,
+                    files: Optional[Sequence[str]] = None) -> Dict[str, Module]:
+    """Parse target files. ``files`` (repo-relative) restricts the set;
+    default is every .py under DEFAULT_TARGET."""
+    if files is None:
+        paths = sorted((root / DEFAULT_TARGET).rglob("*.py"))
+    else:
+        paths = [root / f for f in files]
+    out: Dict[str, Module] = {}
+    for p in paths:
+        if not p.is_file():
+            continue
+        rel = p.relative_to(root).as_posix()
+        try:
+            src = p.read_text()
+            tree = ast.parse(src)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            out[rel] = Module(path=rel, tree=ast.parse(""), lines=[])
+            # a file the linter cannot parse is itself a finding; surfaced
+            # by run_lint via the sentinel below
+            out[rel].parse_error = str(e)  # type: ignore[attr-defined]
+            continue
+        out[rel] = Module(path=rel, tree=tree, lines=src.splitlines())
+    return out
+
+
+def run_lint(
+    root: Path,
+    files: Optional[Sequence[str]] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], List[Finding]]:
+    """Run ``rules`` (default: all) over ``files`` (default: the package).
+    Returns (active_findings, comment_suppressed_findings); baseline
+    filtering is the caller's concern (run.py / tests)."""
+    # rule registration lives in rules.py; import late so core stays
+    # importable from rules.py without a cycle
+    from tools.lint import rules as _rules  # noqa: F401
+
+    modules = collect_modules(root, files)
+    selected = [RULES[n] for n in (rules or sorted(RULES))]
+    findings: List[Finding] = []
+    for mod in modules.values():
+        err = getattr(mod, "parse_error", None)
+        if err:
+            findings.append(Finding(
+                rule="DL000", path=mod.path, line=1, severity="P0",
+                message=f"file does not parse: {err}",
+            ))
+    all_modules = list(modules.values())
+    for rule in selected:
+        if rule.scope == "project":
+            findings.extend(rule.check_project(all_modules, root))
+        else:
+            for mod in all_modules:
+                findings.extend(rule.check(mod))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return apply_suppressions(modules, findings)

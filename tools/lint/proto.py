@@ -1,0 +1,220 @@
+"""Minimal proto3 schema parser for ``serving/inference.proto``.
+
+Parses just enough of the proto3 grammar to cross-check field numbers,
+types, and cardinalities against the hand-rolled codec tables in
+``serving/protowire.py`` (rule DL005) and to drive the runtime round-trip
+fuzz test (tests/test_protowire_fuzz.py). Supported: ``message`` (nested),
+``enum``, ``oneof``, ``optional``/``repeated`` labels, ``//`` comments.
+``service`` blocks and options are skipped. Not supported (absent from the
+frozen schema): maps, groups, extensions, imports.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+#: proto scalar -> protowire table type string (serving/protowire.py)
+SCALARS = {
+    "string": "string",
+    "bytes": "bytes",
+    "uint32": "uint32",
+    "uint64": "uint64",
+    "int32": "int32",
+    "int64": "int64",
+    "bool": "bool",
+    "float": "float",
+    "double": "double",
+}
+
+
+@dataclass(frozen=True)
+class ProtoField:
+    name: str
+    number: int
+    type: str  # scalar keyword, or message/enum name as written
+    label: str  # "one" | "opt" | "rep"  (oneof members are "opt")
+
+
+@dataclass
+class ProtoMessage:
+    name: str  # qualified with dots for nested ("TokenEvent.Token")
+    fields: Dict[int, ProtoField] = field(default_factory=dict)
+
+
+@dataclass
+class ProtoEnum:
+    name: str
+    values: Dict[int, str] = field(default_factory=dict)  # number -> NAME
+
+
+@dataclass
+class ProtoSchema:
+    messages: Dict[str, ProtoMessage] = field(default_factory=dict)
+    enums: Dict[str, ProtoEnum] = field(default_factory=dict)
+
+
+_FIELD_RE = re.compile(
+    r"^(?:(optional|repeated)\s+)?([A-Za-z_][\w.]*)\s+"
+    r"([A-Za-z_]\w*)\s*=\s*(\d+)\s*(?:\[[^\]]*\])?$"
+)
+_ENUM_VALUE_RE = re.compile(r"^([A-Za-z_]\w*)\s*=\s*(\d+)$")
+
+
+def _strip_comments(text: str) -> str:
+    out = []
+    for line in text.splitlines():
+        idx = line.find("//")
+        out.append(line if idx < 0 else line[:idx])
+    return "\n".join(out)
+
+
+def _statements(text: str) -> List[str]:
+    """Split on ';' and '{'/'}' boundaries, keeping braces as their own
+    tokens so the block structure survives."""
+    toks: List[str] = []
+    buf = ""
+    for ch in text:
+        if ch in "{};":
+            if buf.strip():
+                toks.append(buf.strip())
+            buf = ""
+            if ch in "{}":
+                toks.append(ch)
+        else:
+            buf += ch
+    if buf.strip():
+        toks.append(buf.strip())
+    return toks
+
+
+def parse(text: str) -> ProtoSchema:
+    schema = ProtoSchema()
+    toks = _statements(_strip_comments(text))
+    i = 0
+
+    def skip_block(j: int) -> int:
+        """``j`` indexes the '{' token; returns index past the matching '}'."""
+        depth = 0
+        while j < len(toks):
+            if toks[j] == "{":
+                depth += 1
+            elif toks[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    return j + 1
+            j += 1
+        raise ValueError("unbalanced braces in proto file")
+
+    def parse_enum(name: str, j: int) -> int:
+        enum = ProtoEnum(name=name)
+        assert toks[j] == "{"
+        j += 1
+        while toks[j] != "}":
+            m = _ENUM_VALUE_RE.match(toks[j])
+            if m:
+                enum.values[int(m.group(2))] = m.group(1)
+            elif toks[j].startswith("option"):
+                pass
+            else:
+                raise ValueError(f"unparsed enum entry: {toks[j]!r}")
+            j += 1
+        schema.enums[name] = enum
+        return j + 1
+
+    def parse_message(qual: str, j: int) -> int:
+        msg = ProtoMessage(name=qual)
+        schema.messages[qual] = msg
+        assert toks[j] == "{"
+        j += 1
+        while toks[j] != "}":
+            t = toks[j]
+            words = t.split(None, 1)
+            head = words[0] if words else ""
+            if head == "message":
+                j = parse_message(f"{qual}.{words[1].strip()}", j + 1)
+                continue
+            if head == "enum":
+                j = parse_enum(f"{qual}.{words[1].strip()}", j + 1)
+                continue
+            if head == "oneof":
+                assert toks[j + 1] == "{"
+                k = j + 2
+                while toks[k] != "}":
+                    _add_field(msg, toks[k], oneof=True)
+                    k += 1
+                j = k + 1
+                continue
+            if head in ("option", "reserved"):
+                j += 1
+                continue
+            _add_field(msg, t, oneof=False)
+            j += 1
+        return j + 1
+
+    def _add_field(msg: ProtoMessage, stmt: str, oneof: bool) -> None:
+        m = _FIELD_RE.match(stmt)
+        if not m:
+            raise ValueError(f"unparsed field in {msg.name}: {stmt!r}")
+        label_kw, ftype, fname, num = m.groups()
+        if label_kw == "repeated":
+            label = "rep"
+        elif label_kw == "optional" or oneof:
+            label = "opt"
+        else:
+            # singular; message-typed singular fields get "opt" treatment
+            # at comparison time (resolve_type distinguishes msg vs enum)
+            label = "one"
+        n = int(num)
+        if n in msg.fields:
+            raise ValueError(f"duplicate field number {n} in {msg.name}")
+        msg.fields[n] = ProtoField(name=fname, number=n, type=ftype,
+                                   label=label)
+
+    while i < len(toks):
+        t = toks[i]
+        words = t.split(None, 1)
+        head = words[0] if words else ""
+        if head in ("syntax", "package", "option", "import"):
+            i += 1
+        elif head == "service":
+            i = skip_block(i + 1)
+        elif head == "message":
+            i = parse_message(words[1].strip(), i + 1)
+        elif head == "enum":
+            i = parse_enum(words[1].strip(), i + 1)
+        elif t in ("{", "}"):
+            raise ValueError("unexpected brace at top level")
+        else:
+            raise ValueError(f"unparsed top-level statement: {t!r}")
+    return schema
+
+
+def parse_file(path: Path) -> ProtoSchema:
+    return parse(path.read_text())
+
+
+def resolve_type(
+    schema: ProtoSchema, msg_name: str, ftype: str
+) -> Tuple[str, Optional[str]]:
+    """Map a field's written type to the protowire table convention.
+
+    Returns ``(kind, table_type)`` where kind is "scalar" | "enum" | "msg"
+    and table_type is e.g. "uint32", "enum:Role", "msg:TokenEvent.Token".
+    Nested names resolve innermost-first (proto scoping rules, restricted
+    to the forms this schema uses)."""
+    if ftype in SCALARS:
+        return "scalar", SCALARS[ftype]
+    # candidate qualified names: sibling of the message, then outer scopes
+    parts = msg_name.split(".")
+    candidates = [
+        ".".join(parts[:k] + [ftype]) for k in range(len(parts), -1, -1)
+    ]
+    for cand in candidates:
+        if cand in schema.enums:
+            return "enum", f"enum:{cand}"
+        if cand in schema.messages:
+            return "msg", f"msg:{cand}"
+    return "unknown", None

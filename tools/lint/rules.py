@@ -1,0 +1,751 @@
+"""distlint rules DL001-DL007 (catalog + rationale: docs/LINTS.md).
+
+Each rule targets a failure class this codebase has actually hit or is
+structurally exposed to: blocking calls on the serving spine, unlocked
+shared state, silent exception swallowing, proto/wire drift, metric rot,
+and host-side work leaking into the per-token decode loop.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.lint import proto as protodef
+from tools.lint.core import (
+    Finding,
+    Module,
+    Rule,
+    ScopedVisitor,
+    dotted_name,
+    register,
+)
+
+SERVING_PREFIX = "distributed_inference_server_tpu/serving/"
+
+#: calls that block the calling thread, by dotted name
+BLOCKING_DOTTED = frozenset({
+    "time.sleep",
+    "jax.device_get",
+    "subprocess.run",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "socket.create_connection",
+    "urllib.request.urlopen",
+})
+#: method names that block regardless of receiver
+BLOCKING_ATTRS = frozenset({"block_until_ready"})
+#: method names that block and are therefore forbidden un-awaited in
+#: ``async def`` bodies (threading.Event.wait, Lock.acquire, Future.result)
+ASYNC_BLOCKING_ATTRS = frozenset({"wait", "acquire", "result"})
+
+
+def _is_blocking_call(node: ast.Call) -> Optional[str]:
+    dotted = dotted_name(node.func)
+    if dotted in BLOCKING_DOTTED:
+        return dotted
+    if isinstance(node.func, ast.Attribute) and node.func.attr in BLOCKING_ATTRS:
+        return f".{node.func.attr}()"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# DL001 — blocking calls on async / serving-spine paths
+# ---------------------------------------------------------------------------
+
+
+@register
+class DL001(Rule):
+    """Blocking calls inside ``async def`` (anywhere) or raw ``time.sleep``
+    / device syncs anywhere under ``serving/`` — the serving spine's
+    threads must park on ``Event.wait`` (interruptible, shutdown-aware)
+    and its coroutines on ``asyncio.sleep``/executors."""
+
+    name = "DL001"
+    title = "blocking call on an async or serving-spine path"
+    severity = "P0"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        rule = self
+        findings: List[Finding] = []
+        in_serving = module.path.startswith(SERVING_PREFIX)
+
+        class V(ScopedVisitor):
+            def __init__(self) -> None:
+                super().__init__()
+                self._awaited: Set[int] = set()
+
+            def visit_Await(self, node: ast.Await) -> None:
+                self._awaited.add(id(node.value))
+                self.generic_visit(node)
+
+            def visit_Call(self, node: ast.Call) -> None:
+                blocked = _is_blocking_call(node)
+                if self.in_async and id(node) not in self._awaited:
+                    name = blocked
+                    if (name is None and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in ASYNC_BLOCKING_ATTRS):
+                        name = f".{node.func.attr}()"
+                    if name is not None:
+                        findings.append(rule.finding(
+                            module, node,
+                            f"blocking call {name} inside async def "
+                            f"{self.func_name} — await an async equivalent "
+                            "or offload via run_in_executor",
+                            context=self.qualname,
+                        ))
+                elif in_serving and blocked is not None:
+                    findings.append(rule.finding(
+                        module, node,
+                        f"blocking call {blocked} on the serving spine — "
+                        "use Event.wait (shutdown-aware) or move off the "
+                        "dispatch path; suppress with a justification if "
+                        "this thread legitimately sleeps",
+                        context=self.qualname,
+                        severity="P1",
+                    ))
+                self.generic_visit(node)
+
+        V().visit(module.tree)
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DL002 — mutation of lock-guarded shared state outside the lock
+# ---------------------------------------------------------------------------
+
+_LOCK_FACTORY_RE = re.compile(r"(^|\.)(Lock|RLock|Condition)$")
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "discard", "add", "clear", "update",
+    "setdefault", "sort", "reverse",
+})
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> "X" (else None)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _mutated_attrs(stmt: ast.AST) -> Set[str]:
+    """self attributes mutated by one statement: assignment to ``self.X``
+    or ``self.X[...]``, ``self.X <op>= ...``, or ``self.X.<mutator>(...)``."""
+    out: Set[str] = set()
+
+    def target_attr(t: ast.AST) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                target_attr(el)
+            return
+        a = _self_attr(t)
+        if a is not None:
+            out.add(a)
+            return
+        if isinstance(t, ast.Subscript):
+            a = _self_attr(t.value)
+            if a is not None:
+                out.add(a)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            target_attr(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        target_attr(stmt.target)
+    elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        f = stmt.value.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            a = _self_attr(f.value)
+            if a is not None:
+                out.add(a)
+    return out
+
+
+def _with_locks(node: ast.AST, lock_attrs: Set[str]) -> Set[str]:
+    """Lock attrs entered by a With statement (``with self._lock: ...``)."""
+    out: Set[str] = set()
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        for item in node.items:
+            a = _self_attr(item.context_expr)
+            if a is not None and a in lock_attrs:
+                out.add(a)
+    return out
+
+
+@register
+class DL002(Rule):
+    """For classes that own a ``threading.Lock``/``RLock``/``Condition``:
+    any attribute ever mutated under the lock is *guarded*; mutating a
+    guarded attribute outside a ``with self.<lock>:`` block (outside
+    ``__init__``) is a data race waiting for load.
+
+    Convention: methods named ``*_locked`` declare "caller holds the
+    lock" and are exempt — the analysis is intra-procedural and cannot
+    see the caller's ``with`` block."""
+
+    name = "DL002"
+    title = "guarded shared state mutated outside its lock"
+    severity = "P1"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for cls in [n for n in ast.walk(module.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            findings.extend(self._check_class(module, cls))
+        return findings
+
+    def _methods(self, cls: ast.ClassDef):
+        return [n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def _check_class(self, module: Module,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        lock_attrs: Set[str] = set()
+        for meth in self._methods(cls):
+            for stmt in ast.walk(meth):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                if not isinstance(stmt.value, ast.Call):
+                    continue
+                if not _LOCK_FACTORY_RE.search(dotted_name(stmt.value.func)):
+                    continue
+                for t in stmt.targets:
+                    a = _self_attr(t)
+                    if a is not None:
+                        lock_attrs.add(a)
+        if not lock_attrs:
+            return []
+
+        # pass 1: attrs mutated while holding any of this class's locks
+        guarded: Set[str] = set()
+        for meth in self._methods(cls):
+            for attr, _node, held in self._iter_mutations(meth, lock_attrs):
+                if held:
+                    guarded.add(attr)
+        guarded -= lock_attrs
+        if not guarded:
+            return []
+
+        # pass 2: mutations of guarded attrs with no lock held
+        findings: List[Finding] = []
+        for meth in self._methods(cls):
+            if meth.name == "__init__" or meth.name.endswith("_locked"):
+                continue
+            for attr, node, held in self._iter_mutations(meth, lock_attrs):
+                if attr in guarded and not held:
+                    findings.append(self.finding(
+                        module, node,
+                        f"self.{attr} is mutated under "
+                        f"{'/'.join(sorted(lock_attrs))} elsewhere but "
+                        f"written here without the lock",
+                        context=f"{cls.name}.{meth.name}",
+                    ))
+        return findings
+
+    def _iter_mutations(self, meth, lock_attrs: Set[str]):
+        """Yield (attr, node, lock_held) for each self-attr mutation in the
+        method body. Nested function defs are skipped: closures run later,
+        on other threads, under their own discipline."""
+
+        def walk(node: ast.AST, held: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                child_held = held or bool(_with_locks(child, lock_attrs))
+                for attr in _mutated_attrs(child):
+                    yield attr, child, child_held
+                yield from walk(child, child_held)
+
+        yield from walk(meth, False)
+
+
+# ---------------------------------------------------------------------------
+# DL003 — lock held across await / blocking call
+# ---------------------------------------------------------------------------
+
+_LOCKISH_NAME_RE = re.compile(r"lock|mutex|cond|(^|_)cv$", re.IGNORECASE)
+
+
+@register
+class DL003(Rule):
+    """Inside ``with <lock>:`` — where the context expression *names* a
+    lock (``_lock``, ``_cv``, ``mutex`` ...) — an ``await`` or a blocking
+    call serializes every other thread/task on that lock for the full
+    duration. Calls on the lock object itself (``cv.wait``) are exempt:
+    Condition.wait releases the lock."""
+
+    name = "DL003"
+    title = "lock held across await or blocking call"
+    severity = "P0"
+
+    _HELD_BLOCKING_ATTRS = frozenset(
+        {"wait", "join", "acquire", "result"} | set(BLOCKING_ATTRS)
+    )
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        rule = self
+        findings: List[Finding] = []
+
+        class V(ScopedVisitor):
+            def _visit_with(self, node) -> None:
+                lock_exprs = [
+                    item.context_expr for item in node.items
+                    if _LOCKISH_NAME_RE.search(
+                        dotted_name(item.context_expr).rsplit(".", 1)[-1])
+                ]
+                if lock_exprs:
+                    self._scan_body(node, lock_exprs)
+                self.generic_visit(node)
+
+            visit_With = _visit_with
+            visit_AsyncWith = _visit_with
+
+            def _scan_body(self, with_node, lock_exprs) -> None:
+                lock_dumps = {ast.dump(e) for e in lock_exprs}
+                lock_names = " / ".join(dotted_name(e) or "<lock>"
+                                        for e in lock_exprs)
+
+                def walk(node: ast.AST) -> None:
+                    for child in ast.iter_child_nodes(node):
+                        if isinstance(child, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.Lambda)):
+                            continue
+                        if isinstance(child, ast.Await):
+                            findings.append(rule.finding(
+                                module, child,
+                                f"await while holding {lock_names}",
+                                context=self.qualname,
+                            ))
+                        elif isinstance(child, ast.Call):
+                            self._check_call(child, lock_dumps, lock_names)
+                        walk(child)
+
+                for stmt in with_node.body:
+                    walk(stmt)
+
+            def _check_call(self, node: ast.Call, lock_dumps,
+                            lock_names) -> None:
+                blocked = _is_blocking_call(node)
+                if (blocked is None
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in rule._HELD_BLOCKING_ATTRS):
+                    # calls on the held lock itself are the exemption
+                    if ast.dump(node.func.value) in lock_dumps:
+                        return
+                    blocked = f".{node.func.attr}()"
+                if blocked is not None:
+                    findings.append(rule.finding(
+                        module, node,
+                        f"blocking call {blocked} while holding "
+                        f"{lock_names}",
+                        context=self.qualname,
+                    ))
+
+        V().visit(module.tree)
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DL004 — silently swallowed broad excepts
+# ---------------------------------------------------------------------------
+
+_LOG_METHODS = frozenset({
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+    "warn",
+})
+_COUNTERISH_RE = re.compile(r"drop|err|fail|count|total", re.IGNORECASE)
+
+
+@register
+class DL004(Rule):
+    """``except Exception`` / bare ``except`` whose handler neither
+    re-raises, nor logs, nor increments an error counter, nor *uses* the
+    caught exception (forwarding ``e`` into a sink/callback/state counts
+    as handling) — the error vanishes and only a soak test will find it."""
+
+    name = "DL004"
+    title = "broad except swallows the error silently"
+    severity = "P1"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        rule = self
+        findings: List[Finding] = []
+
+        class V(ScopedVisitor):
+            def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+                if rule._is_broad(node.type) and not rule._handled(node):
+                    kind = ("bare except" if node.type is None
+                            else "except Exception")
+                    findings.append(rule.finding(
+                        module, node,
+                        f"{kind} swallows the error: add logging, an "
+                        "errors_total increment, or a re-raise (or forward "
+                        "the exception into the failure path)",
+                        context=self.qualname,
+                    ))
+                self.generic_visit(node)
+
+        V().visit(module.tree)
+        return findings
+
+    @staticmethod
+    def _is_broad(t: Optional[ast.expr]) -> bool:
+        if t is None:
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(DL004._is_broad(el) for el in t.elts)
+        return (isinstance(t, ast.Name)
+                and t.id in ("Exception", "BaseException"))
+
+    @staticmethod
+    def _handled(handler: ast.ExceptHandler) -> bool:
+        var = handler.name
+        for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Name) and node.id == var:
+                return True  # exception object forwarded / recorded
+            if isinstance(node, ast.Call):
+                dotted = dotted_name(node.func)
+                if isinstance(node.func, ast.Attribute):
+                    if node.func.attr in _LOG_METHODS:
+                        return True
+                    if node.func.attr == "inc":
+                        return True
+                if "record_" in dotted or "metric" in dotted:
+                    return True
+                if dotted.startswith("warnings.warn"):
+                    return True
+            if (isinstance(node, ast.AugAssign)
+                    and isinstance(node.op, ast.Add)):
+                tgt = node.target
+                if (isinstance(tgt, ast.Attribute)
+                        and _COUNTERISH_RE.search(tgt.attr)):
+                    return True  # fail-open counter (e.g. otlp dropped)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# DL005 — proto <-> protowire drift
+# ---------------------------------------------------------------------------
+
+
+def compare_wire_schema(
+    schema: protodef.ProtoSchema,
+    messages: Dict[str, Dict[int, Tuple[str, str, str]]],
+    enums: Dict[str, Dict[int, Optional[str]]],
+) -> List[Tuple[str, str]]:
+    """Cross-check the parsed proto schema against protowire's tables.
+    Returns ``(anchor, message)`` pairs; anchor is the message/enum name
+    the finding attaches to. Pure so tests can inject drifted tables."""
+    out: List[Tuple[str, str]] = []
+
+    for name in sorted(set(schema.messages) - set(messages)):
+        out.append((name, f"message {name} is in inference.proto but has "
+                          "no protowire codec entry"))
+    for name in sorted(set(messages) - set(schema.messages)):
+        out.append((name, f"protowire codec defines message {name} absent "
+                          "from inference.proto"))
+
+    for name in sorted(set(schema.messages) & set(messages)):
+        pmsg = schema.messages[name]
+        table = messages[name]
+        for num in sorted(set(pmsg.fields) - set(table)):
+            f = pmsg.fields[num]
+            out.append((name, f"{name}: proto field {f.name} = {num} "
+                              "missing from the protowire table"))
+        for num in sorted(set(table) - set(pmsg.fields)):
+            out.append((name, f"{name}: protowire field number {num} "
+                              f"({table[num][0]!r}) not in inference.proto"))
+        for num in sorted(set(pmsg.fields) & set(table)):
+            pf = pmsg.fields[num]
+            tname, ttype, tcard = table[num]
+            if pf.name != tname:
+                out.append((name, f"{name}.{num}: name drift — proto "
+                                  f"{pf.name!r} vs protowire {tname!r}"))
+            kind, expect_type = protodef.resolve_type(schema, name, pf.type)
+            if kind == "unknown":
+                out.append((name, f"{name}.{pf.name}: unresolvable proto "
+                                  f"type {pf.type!r}"))
+                continue
+            if expect_type != ttype:
+                out.append((name, f"{name}.{pf.name}: type drift — proto "
+                                  f"{pf.type} (-> {expect_type}) vs "
+                                  f"protowire {ttype!r}"))
+            # proto3 singular message fields have explicit presence
+            expect_card = pf.label
+            if kind == "msg" and expect_card == "one":
+                expect_card = "opt"
+            if expect_card != tcard:
+                out.append((name, f"{name}.{pf.name}: cardinality drift — "
+                                  f"proto {expect_card!r} vs protowire "
+                                  f"{tcard!r}"))
+
+    for name in sorted(set(schema.enums) - set(enums)):
+        out.append((name, f"enum {name} missing from protowire ENUMS"))
+    for name in sorted(set(enums) - set(schema.enums)):
+        out.append((name, f"protowire enum {name} absent from "
+                          "inference.proto"))
+    for name in sorted(set(schema.enums) & set(enums)):
+        penum = schema.enums[name]
+        table = enums[name]
+        nonzero = {n: v for n, v in penum.values.items() if n != 0}
+        for num in sorted(set(nonzero) - set(k for k in table if k != 0)):
+            out.append((name, f"enum {name}: value {nonzero[num]} = {num} "
+                              "missing from protowire"))
+        for num in sorted(set(table) - set(penum.values) - {0}):
+            out.append((name, f"enum {name}: protowire value {num} not in "
+                              "inference.proto"))
+        for num, vname in sorted(nonzero.items()):
+            if num in table and table[num] != vname.lower():
+                out.append((name, f"enum {name}.{vname}: JSON string drift "
+                                  f"— expected {vname.lower()!r}, protowire "
+                                  f"has {table[num]!r}"))
+    return out
+
+
+def load_protowire_tables(root: Path):
+    """Import serving/protowire.py standalone (stdlib-only module) and
+    return its (MESSAGES, ENUMS)."""
+    path = (root / "distributed_inference_server_tpu" / "serving"
+            / "protowire.py")
+    spec = importlib.util.spec_from_file_location("_distlint_protowire", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    return mod.MESSAGES, mod.ENUMS
+
+
+@register
+class DL005(Rule):
+    """The hand-rolled codec tables in serving/protowire.py must agree
+    field-for-field with the authoritative contract in
+    serving/inference.proto — field numbers, names, types, cardinality,
+    enum values. Drift here corrupts KV handoffs and gRPC payloads
+    silently (the varint still decodes — into the wrong thing)."""
+
+    name = "DL005"
+    title = "wire drift between inference.proto and protowire.py"
+    severity = "P0"
+    scope = "project"
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        proto_path = (root / "distributed_inference_server_tpu" / "serving"
+                      / "inference.proto")
+        wire_rel = "distributed_inference_server_tpu/serving/protowire.py"
+        wire_mod = next((m for m in modules if m.path == wire_rel), None)
+        if not proto_path.exists() or wire_mod is None:
+            return []
+        schema = protodef.parse_file(proto_path)
+        messages, enums = load_protowire_tables(root)
+        findings = []
+        for anchor, msg in compare_wire_schema(schema, messages, enums):
+            findings.append(Finding(
+                rule=self.name, path=wire_rel,
+                line=self._anchor_line(wire_mod, anchor),
+                message=msg, severity=self.severity, context=anchor,
+                line_text=wire_mod.text(self._anchor_line(wire_mod, anchor)),
+            ))
+        return findings
+
+    @staticmethod
+    def _anchor_line(module: Module, name: str) -> int:
+        pat = f'"{name}"'
+        for i, line in enumerate(module.lines, 1):
+            if pat in line:
+                return i
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# DL006 — metric hygiene
+# ---------------------------------------------------------------------------
+
+_METRIC_FACTORIES = frozenset({"Counter", "Gauge", "Histogram", "Summary"})
+
+
+@register
+class DL006(Rule):
+    """Every metric registered on MetricsCollector must be emitted by some
+    recording method, every public recording method must be called from
+    the serving stack, and every ``*.metrics.<attr>`` access must resolve
+    to a real collector attribute (no phantom metrics, no typo'd
+    emission sites)."""
+
+    name = "DL006"
+    title = "metric registered/emitted mismatch"
+    severity = "P1"
+    scope = "project"
+
+    METRICS_PATH = "distributed_inference_server_tpu/serving/metrics.py"
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        mmod = next((m for m in modules if m.path == self.METRICS_PATH), None)
+        if mmod is None:
+            return []
+        cls = next((n for n in ast.walk(mmod.tree)
+                    if isinstance(n, ast.ClassDef)
+                    and n.name == "MetricsCollector"), None)
+        if cls is None:
+            return []
+
+        metric_attrs: Dict[str, ast.AST] = {}
+        prom_names: Dict[str, ast.AST] = {}
+        findings: List[Finding] = []
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        if init is not None:
+            for node in ast.walk(init):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                fname = dotted_name(node.value.func).rsplit(".", 1)[-1]
+                if fname not in _METRIC_FACTORIES:
+                    continue
+                attr = _self_attr(node.targets[0]) if node.targets else None
+                if attr is None:
+                    continue
+                metric_attrs[attr] = node
+                args = node.value.args
+                if args and isinstance(args[0], ast.Constant) \
+                        and isinstance(args[0].value, str):
+                    pname = args[0].value
+                    if pname in prom_names:
+                        findings.append(self.finding(
+                            mmod, node,
+                            f"duplicate prometheus metric name {pname!r}",
+                            context="MetricsCollector.__init__",
+                        ))
+                    prom_names[pname] = node
+
+        methods = {n.name for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        public_methods = {m for m in methods if not m.startswith("_")}
+        # module-level names of metrics.py are legal accesses through a
+        # `metrics` module alias (EngineStatus etc.)
+        module_names = {n.name for n in mmod.tree.body
+                        if isinstance(n, (ast.ClassDef, ast.FunctionDef))}
+        allowed = set(metric_attrs) | methods | module_names | {"registry"}
+
+        # reads of self.<metric attr> inside metrics.py (emission sites)
+        internal_reads: Set[str] = set()
+        for node in ast.walk(cls):
+            a = _self_attr(node)
+            if a is not None and isinstance(node.ctx, ast.Load):
+                internal_reads.add(a)
+
+        # accesses through a receiver *named* metrics, package-wide
+        external: Dict[str, List[Tuple[Module, ast.AST]]] = {}
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                recv = node.value
+                is_metrics_recv = (
+                    (isinstance(recv, ast.Name) and recv.id == "metrics")
+                    or (isinstance(recv, ast.Attribute)
+                        and recv.attr == "metrics")
+                )
+                if is_metrics_recv:
+                    external.setdefault(node.attr, []).append((mod, node))
+
+        for attr, sites in sorted(external.items()):
+            if attr not in allowed:
+                mod, node = sites[0]
+                findings.append(self.finding(
+                    mod, node,
+                    f"metrics.{attr} does not exist on MetricsCollector "
+                    "(typo'd emission site or unregistered metric)",
+                ))
+
+        for attr, node in sorted(metric_attrs.items()):
+            if attr not in internal_reads and attr not in external:
+                findings.append(self.finding(
+                    mmod, node,
+                    f"metric self.{attr} is registered but never emitted",
+                    context="MetricsCollector.__init__",
+                ))
+
+        for meth in sorted(public_methods):
+            if meth in ("snapshot", "prometheus_text"):
+                continue  # rendering surface, exercised by transports/tests
+            if meth not in external:
+                node = next(n for n in cls.body
+                            if isinstance(n, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef))
+                            and n.name == meth)
+                findings.append(self.finding(
+                    mmod, node,
+                    f"MetricsCollector.{meth} is never called from the "
+                    "serving stack — dead recording surface",
+                    context=f"MetricsCollector.{meth}",
+                ))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DL007 — JAX hot-path hygiene in the per-token decode loop
+# ---------------------------------------------------------------------------
+
+
+@register
+class DL007(Rule):
+    """The per-token emission path in engine/engine.py (HOT_FUNCTIONS)
+    runs once per generated token on the host: a ``jnp.*`` call allocates
+    device memory / dispatches XLA work there, and an explicit sync
+    (``device_get`` / ``block_until_ready`` / ``.item()``) stalls the
+    decode pipeline. Device reads belong at the block boundary
+    (``np.asarray`` on the block's outputs, once per block)."""
+
+    name = "DL007"
+    title = "device work inside the per-token decode loop"
+    severity = "P0"
+
+    TARGET = "distributed_inference_server_tpu/engine/engine.py"
+    HOT_FUNCTIONS = frozenset({
+        "_process_block", "_drain_pending", "_emit_token", "_decode_piece",
+        "_flush_pending_text", "_finish",
+    })
+    _SYNC_ATTRS = frozenset({"block_until_ready", "item"})
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.path != self.TARGET:
+            return []
+        rule = self
+        findings: List[Finding] = []
+
+        class V(ScopedVisitor):
+            def visit_Call(self, node: ast.Call) -> None:
+                if rule.HOT_FUNCTIONS & set(self._stack):
+                    dotted = dotted_name(node.func)
+                    bad = None
+                    if dotted.startswith("jnp.") \
+                            or dotted.startswith("jax.numpy."):
+                        bad = f"{dotted} (device allocation/dispatch)"
+                    elif dotted == "jax.device_get":
+                        bad = "jax.device_get (host sync)"
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr in rule._SYNC_ATTRS):
+                        bad = f".{node.func.attr}() (host sync)"
+                    if bad is not None:
+                        findings.append(rule.finding(
+                            module, node,
+                            f"{bad} inside the per-token decode loop "
+                            f"({self.func_name}) — hoist to the block "
+                            "boundary",
+                            context=self.qualname,
+                        ))
+                self.generic_visit(node)
+
+        V().visit(module.tree)
+        return findings

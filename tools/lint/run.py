@@ -1,0 +1,157 @@
+"""distlint CLI — ``python -m tools.lint.run``.
+
+Exit status: 0 when every finding is suppressed inline or matched by the
+baseline; 1 otherwise (and 1 on ``--check-stale`` when baseline entries no
+longer match anything — the baseline may only shrink, docs/LINTS.md).
+
+Modes:
+    python -m tools.lint.run                   # whole package
+    python -m tools.lint.run --changed         # only files touched in git
+    python -m tools.lint.run --update-baseline # re-grandfather P1 findings
+    python -m tools.lint.run --list-rules
+    python -m tools.lint.run --json            # machine-readable findings
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from tools.lint import rules as _rules  # noqa: F401 — populates RULES
+from tools.lint.core import (
+    BASELINE_PATH,
+    DEFAULT_TARGET,
+    RULES,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+
+def changed_files(root: Path) -> Optional[List[str]]:
+    """Package .py files touched per git (staged, unstaged, untracked).
+    None (= lint everything) when git is unavailable."""
+    try:
+        # -uall: plain porcelain collapses a new directory to one
+        # "?? dir/" entry, which would hide every .py inside it
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "-uall"], cwd=root,
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    files = []
+    for line in out.splitlines():
+        path = line[3:].split(" -> ")[-1].strip().strip('"')
+        if path.endswith(".py") and path.startswith(DEFAULT_TARGET + "/"):
+            files.append(path)
+    return files
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="distlint", description=__doc__)
+    ap.add_argument("files", nargs="*",
+                    help="repo-relative files (default: whole package)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only git-modified package files (fast "
+                         "pre-commit mode; project-scope rules still run)")
+    ap.add_argument("--rule", action="append", dest="rules",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help=f"baseline file (default {BASELINE_PATH})")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current non-P0 findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--check-stale", action="store_true",
+                    help="also fail on baseline entries that match nothing")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            r = RULES[name]
+            print(f"{name}  [{r.severity}/{r.scope}]  {r.title}")
+        return 0
+
+    files: Optional[List[str]] = args.files or None
+    if args.changed and files is None:
+        files = changed_files(REPO_ROOT)
+
+    if args.update_baseline and (files is not None or args.rules):
+        # a partial run sees only a subset of findings; rewriting the
+        # baseline from it would silently drop grandfathered entries for
+        # every unscanned file or unselected rule
+        print("distlint: --update-baseline requires a full run "
+              "(drop --changed / --rule / file arguments)")
+        return 2
+
+    if files is not None:
+        # file-restricted mode: module-scope rules see only the named
+        # files, but project-scope rules (proto drift, metric hygiene)
+        # are cross-file — they must always see the whole package or
+        # "emitted somewhere" checks false-positive on the subset
+        names = args.rules or sorted(RULES)
+        mod_rules = [n for n in names if RULES[n].scope == "module"]
+        proj_rules = [n for n in names if RULES[n].scope == "project"]
+        active, suppressed = run_lint(REPO_ROOT, files=files,
+                                      rules=mod_rules or None) \
+            if mod_rules else ([], [])
+        if proj_rules:
+            pa, ps = run_lint(REPO_ROOT, files=None, rules=proj_rules)
+            active, suppressed = active + pa, suppressed + ps
+    else:
+        active, suppressed = run_lint(REPO_ROOT, files=None,
+                                      rules=args.rules)
+
+    if args.update_baseline:
+        keep = [f for f in active if f.severity != "P0"]
+        p0 = [f for f in active if f.severity == "P0"]
+        save_baseline(keep, args.baseline)
+        print(f"baseline written: {len(keep)} entries "
+              f"({args.baseline or BASELINE_PATH})")
+        for f in p0:
+            print(f"NOT baselined (P0 must be fixed): {f.render()}")
+        return 1 if p0 else 0
+
+    baseline = [] if args.no_baseline else load_baseline(args.baseline)
+    new, grandfathered, stale = apply_baseline(active, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in new],
+            "grandfathered": [f.__dict__ for f in grandfathered],
+            "suppressed": len(suppressed),
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if new:
+            print(f"\ndistlint: {len(new)} finding(s) "
+                  f"({len(grandfathered)} baselined, "
+                  f"{len(suppressed)} suppressed inline)")
+        else:
+            print(f"distlint: clean ({len(grandfathered)} baselined, "
+                  f"{len(suppressed)} suppressed inline)")
+        if stale and args.check_stale:
+            print(f"distlint: {len(stale)} stale baseline entr(y/ies) — "
+                  "shrink tools/lint/baseline.json:")
+            for e in stale:
+                print(f"  stale: {e['rule']} {e['path']} :: {e['line']}")
+    rc = 1 if new else 0
+    if args.check_stale and stale:
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
