@@ -93,7 +93,137 @@ _MODEL_SLUGS = {
 }
 
 
+def bench_handoff() -> None:
+    """KV-handoff microbench (BENCH_HANDOFF=1; ISSUE 4): sweep sequence
+    length x channel x wire_quant x export mode on the tiny CPU fixture,
+    emitting one JSON line per config with the STALL (decode pause the
+    migrated sequence observes: switchover -> import seated) split from
+    the END-TO-END handoff time (which the streamed export mostly
+    overlaps with decoding), plus post-quantization bytes moved.
+
+    Engine-level on purpose: two LLMEngine instances and the real
+    channel/export/import code paths, no HTTP jitter — the serving-path
+    rerun lives in `tools/disagg_smoke.py --bench`.
+
+    Knobs: BENCH_HANDOFF_LENS ("128,400,1024" token sequence lengths),
+    BENCH_HANDOFF_REPS (5)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.disagg import make_channel
+
+    import jax.numpy as jnp
+
+    lens = [int(x) for x in os.environ.get(
+        "BENCH_HANDOFF_LENS", "128,400,1024").split(",") if x.strip()]
+    reps = int(os.environ.get("BENCH_HANDOFF_REPS", "5"))
+    ps = 8
+    max_pages = -(-(max(lens) + 256) // ps)
+    paged = PagedCacheConfig(num_pages=2 * max_pages + 64, page_size=ps,
+                             max_pages_per_seq=max_pages)
+    params = llama.init_params(jax.random.PRNGKey(0), TINY,
+                               dtype=jnp.float32)
+
+    def mk():
+        return LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(max_batch=4, prefill_buckets=(64, 256), paged=paged),
+            dtype=jnp.float32,
+        )
+
+    rng = np.random.default_rng(0)
+
+    def prefill(engine, rid, n, budget=512):
+        ids = rng.integers(1, min(TINY.vocab_size, 250), size=n).tolist()
+        engine.add_request(rid, ids, SamplingParams(
+            max_tokens=budget, temperature=0.0), prefill_only=True)
+        while not engine.handoff_ready_ids():
+            engine.step()
+
+    def one_monolithic(src, dst, chan, rid, n, wq):
+        prefill(src, rid, n)
+        t0 = time.monotonic()
+        exp = src.export_handoff(rid, wire_quant=wq)
+        # stall == e2e for the stop-the-world export
+        wired = chan.transfer(exp)
+        dst.import_sequence(wired)
+        t1 = time.monotonic()
+        dst.abort(rid)
+        return {"stall_s": t1 - t0, "e2e_s": t1 - t0,
+                "bytes": exp.kv_bytes(), "chunks": 0}
+
+    def one_streamed(src, dst, chan, rid, n, wq):
+        # the serving pipeline's two-phase flow, inline: prefix
+        # serializes AND imports on the target during the overlap
+        # window; the stall is only the switchover delta
+        prefill(src, rid, n)
+        t_begin = time.monotonic()
+        session = src.export_handoff_begin(rid, chunk_pages=8, wire_quant=wq)
+        assert session is not None, "streamed export refused"
+        src.step()  # the overlap window: the sequence decodes a block
+        src.export_handoff_pump(session)
+        wired_prefix = chan.transfer_chunks(rid, wq, session.chunks)
+        isess = dst.import_stream_open(rid, len(session.prefix_pages))
+        dst.import_stream_add(isess, wired_prefix)
+        src.step()  # more overlap while the target absorbs the prefix
+        exp, _outputs = src.export_handoff_finish(session)
+        assert exp is not None, "sequence resolved in place mid-bench"
+        tail = exp.kv_chunks[len(session.chunks):]
+        wired = chan.transfer_commit(exp, tail)
+        dst.import_stream_commit(isess, wired)
+        t1 = time.monotonic()
+        dst.abort(rid)
+        return {"stall_s": t1 - exp.stalled_at, "e2e_s": t1 - t_begin,
+                "bytes": exp.kv_bytes(), "chunks": len(exp.kv_chunks or [])}
+
+    for n in lens:
+        src, dst = mk(), mk()
+        seq = 0
+        for chan_name in ("inproc", "protowire"):
+            chan = make_channel(chan_name)
+            for wq in ("none", "int8"):
+                for mode, fn in (("monolithic", one_monolithic),
+                                 ("streamed", one_streamed)):
+                    stalls, e2es, rec = [], [], None
+                    for r in range(reps + 1):
+                        seq += 1
+                        rec = fn(src, dst, chan, f"h{seq}", n, wq)
+                        if r:  # rep 0 warms compile caches
+                            stalls.append(rec["stall_s"])
+                            e2es.append(rec["e2e_s"])
+                    _emit({
+                        "metric": "kv_handoff_stall_ms_tiny_cpu",
+                        "value": round(float(np.median(stalls)) * 1e3, 3),
+                        "unit": "ms",
+                        "vs_baseline": 0.0,
+                        "seq_len": n,
+                        "channel": chan_name,
+                        "wire_quant": wq,
+                        "mode": mode,
+                        "e2e_ms": round(float(np.median(e2es)) * 1e3, 3),
+                        "bytes": rec["bytes"],
+                        "chunks": rec["chunks"],
+                        "reps": reps,
+                    })
+
+
 def main() -> None:
+    if os.environ.get("BENCH_HANDOFF") == "1":
+        bench_handoff()
+        return
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
     cpu_full = os.environ.get("BENCH_CPU_FULL") == "1"
     model_name = os.environ.get("BENCH_MODEL", "llama-3.2-1b")

@@ -37,8 +37,9 @@ from __future__ import annotations
 import functools
 import logging
 import os
+import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as dc_replace
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import jax
@@ -53,6 +54,8 @@ from distributed_inference_server_tpu.core.errors import (
 from distributed_inference_server_tpu.core.models import FinishReason, Usage
 from distributed_inference_server_tpu.core.types import RequestId
 from distributed_inference_server_tpu.engine.kv_cache import (
+    KvChunk,
+    KvImportSession,
     PageAllocator,
     PagedCacheConfig,
     PagedKVState,
@@ -60,6 +63,7 @@ from distributed_inference_server_tpu.engine.kv_cache import (
     deserialize_into_allocator,
     deserialize_kv,
     serialize_kv,
+    serialize_kv_chunks,
 )
 from distributed_inference_server_tpu.engine.speculative import (
     PatternTrackers,
@@ -206,9 +210,42 @@ class SequenceExport:
     kv: bytes
     draft_kv: Optional[bytes] = None
     source_engine: str = ""
+    # streamed handoff (export_handoff_begin/finish): page-group chunks
+    # replace the monolithic ``kv`` payload; ``wire_quant`` names the
+    # per-chunk wire encoding ("none" | "int8"). ``stalled_at`` is the
+    # host-local monotonic instant the sequence stopped decoding on the
+    # source (drives kv_handoff_stall_seconds; never on the wire).
+    kv_chunks: Optional[List[KvChunk]] = None
+    wire_quant: str = "none"
+    stalled_at: float = 0.0
 
     def kv_bytes(self) -> int:
-        return len(self.kv) + len(self.draft_kv or b"")
+        n = len(self.kv) + len(self.draft_kv or b"")
+        if self.kv_chunks is not None:
+            n += sum(len(c.payload) for c in self.kv_chunks)
+        return n
+
+
+@dataclass
+class HandoffExportSession:
+    """State of one streamed (decode-overlapped) handoff export, owned by
+    the engine thread: the immutable full-page prefix snapshot taken at
+    export_handoff_begin, the chunks serialized so far, and liveness.
+    ``dead`` means the migration is off (request aborted, finished in
+    place, or preempted) — the caller drops the job; the request itself
+    is unaffected."""
+
+    seq: "_Seq"
+    prefix_pages: List[int]
+    chunk_pages: int
+    wire_quant: str
+    chunks: List[KvChunk] = field(default_factory=list)
+    prefix_done: bool = False
+    dead: bool = False
+
+    @property
+    def request_id(self) -> RequestId:
+        return self.seq.request_id
 
 
 @dataclass
@@ -236,7 +273,7 @@ class _Seq:
         "request_id", "token_ids", "prompt_len", "block_table",
         "seq_len", "next_token", "params", "output_text", "emitted_upto",
         "emitted_tokens", "dev_pos", "dev_steps_left", "freed_upto",
-        "pending_ids", "prefill_only",
+        "pending_ids", "prefill_only", "exporting",
     )
 
     def __init__(self, request_id: RequestId, prompt_ids: List[int],
@@ -265,6 +302,10 @@ class _Seq:
         # sampled token and park in the handoff-ready set instead of
         # seating for decode — the KV migrates to a decode engine
         self.prefill_only = False
+        # streamed handoff in flight (export_handoff_begin): the sequence
+        # decodes in place while its immutable prefix pages serialize;
+        # window reclaim must not free pages mid-stream
+        self.exporting = False
 
     def num_output_tokens(self) -> int:
         return len(self.token_ids) - self.prompt_len
@@ -624,13 +665,18 @@ class LLMEngine:
         parked for export (pages held, first token already emitted)."""
         return list(self._handoff_ready)
 
-    def export_handoff(self, request_id: RequestId) -> Optional[SequenceExport]:
+    def export_handoff(self, request_id: RequestId,
+                       wire_quant: str = "none"
+                       ) -> Optional[SequenceExport]:
         """Lift a handoff-ready sequence off this engine: serialize its
         paged K/V (and the draft pool's, when speculating) plus the host
         emission state, publish the prompt's full pages so this engine's
         prefix cache stays warm for future prompts sharing it, then
-        release the pages. Returns None if the request is unknown (e.g.
-        aborted between readiness and export)."""
+        release the pages. ``wire_quant="int8"`` applies the lossy wire
+        encoding to float pools (draft pools excluded — speculation
+        needs the draft cache bit-exact to keep its acceptance law).
+        Returns None if the request is unknown (e.g. aborted between
+        readiness and export)."""
         seq = self._handoff_ready.pop(request_id, None)
         if seq is None or self._by_id.get(request_id) is not seq:
             return None
@@ -644,7 +690,8 @@ class LLMEngine:
                 "handoff candidate has window-reclaimed pages"
             )
         ps = self.pcfg.page_size
-        kv = serialize_kv(self.state, seq.block_table, ps, seq.seq_len)
+        kv = serialize_kv(self.state, seq.block_table, ps, seq.seq_len,
+                          wire_quant=wire_quant)
         draft_kv = (
             serialize_kv(self.draft_state, seq.block_table, ps, seq.seq_len)
             if self.draft_state is not None
@@ -670,35 +717,198 @@ class LLMEngine:
         self._release_seq(seq)
         return exp
 
+    # -- streamed (decode-overlapped) export ----------------------------
+
+    def export_handoff_begin(
+        self, request_id: RequestId, chunk_pages: int = 8,
+        wire_quant: str = "none",
+    ) -> Optional["HandoffExportSession"]:
+        """Start a STREAMED handoff export: the sequence's full prefix
+        pages are immutable (decode only appends at new positions), so
+        they can serialize while the sequence RESUMES DECODING IN PLACE
+        — the decode pause shrinks from O(seq_len) to O(tail). The
+        parked sequence is re-queued for a decode seat (the imported-
+        sequence admission branch seats it straight into the carry) and
+        a session covering the immutable full-page prefix is returned;
+        the caller pumps it (export_handoff_pump) between steps and
+        switches over with export_handoff_finish.
+
+        Returns None — caller should use the monolithic export_handoff —
+        when streaming cannot pay for itself: the prompt has no full
+        page to stream, or the remaining token budget is too small to
+        cover the overlap window (the sequence would finish in place
+        before the switchover, turning the migration into a no-op).
+        Raises like export_handoff on a window-reclaimed candidate."""
+        seq = self._handoff_ready.get(request_id)
+        if seq is None or self._by_id.get(request_id) is not seq:
+            return None
+        if seq.freed_upto or self.pcfg.num_pages in seq.block_table:
+            raise RuntimeError(
+                "handoff candidate has window-reclaimed pages"
+            )
+        n_full = seq.seq_len // self.pcfg.page_size
+        # overlap window ~ 3 decode blocks (serialize + target open span
+        # a couple of runner iterations, each decoding one block, plus
+        # the block draining at switchover); a budget that would finish
+        # inside the window decodes to completion in place instead —
+        # cheaper than any migration
+        overlap = 3 * self.ecfg.decode_block_size
+        if n_full == 0 or (
+            seq.params.max_tokens - seq.emitted_tokens <= overlap + 2
+        ):
+            return None
+        self._handoff_ready.pop(request_id, None)
+        session = HandoffExportSession(
+            seq=seq,
+            prefix_pages=list(seq.block_table[:n_full]),
+            chunk_pages=max(1, chunk_pages),
+            wire_quant=wire_quant,
+        )
+        seq.exporting = True
+        seq.prefill_only = False
+        self.waiting.append(seq)  # decode resumes here during the stream
+        return session
+
+    def _session_alive(self, session: "HandoffExportSession") -> bool:
+        seq = session.seq
+        return (
+            self._by_id.get(seq.request_id) is seq
+            and seq.seq_len > 0
+            and seq.freed_upto == 0
+            and seq.block_table[: len(session.prefix_pages)]
+            == session.prefix_pages
+        )
+
+    def export_handoff_pump(self, session: "HandoffExportSession") -> bool:
+        """Serialize the session's immutable prefix (double-buffered
+        device→host pulls, kv_cache.serialize_kv_chunks) while the
+        sequence keeps decoding — called between steps on the engine
+        thread. Returns True once the prefix is done (or the session
+        died: aborted, finished in place, or preempted — the caller
+        drops the migration; the request is unaffected)."""
+        if session.prefix_done or session.dead:
+            return True
+        if not self._session_alive(session):
+            session.dead = True
+            session.seq.exporting = False
+            return True
+        session.chunks.extend(serialize_kv_chunks(
+            self.state, session.prefix_pages, self.pcfg.page_size,
+            chunk_pages=session.chunk_pages,
+            wire_quant=session.wire_quant,
+        ))
+        session.prefix_done = True
+        return True
+
+    def export_handoff_cancel(self, session: "HandoffExportSession") -> None:
+        """Abandon a streamed export: the sequence (if still live) simply
+        keeps decoding in place — only the exporting flag is lifted so
+        window reclaim can resume. Serialized chunks are host bytes and
+        just get dropped."""
+        session.dead = True
+        seq = session.seq
+        if self._by_id.get(seq.request_id) is seq:
+            seq.exporting = False
+
+    def export_handoff_finish(
+        self, session: "HandoffExportSession"
+    ) -> Tuple[Optional[SequenceExport], List[StepOutput]]:
+        """Switch over: drain the decode pipeline (host view exact), stop
+        the sequence, serialize the TAIL pages written during the overlap
+        window as the final delta chunks, and lift the host state off the
+        engine — publish + release exactly like export_handoff. Returns
+        (None, outputs) when the sequence finished or died during the
+        overlap (the drained outputs still carry its token/done events);
+        the request then needs no migration."""
+        outputs: List[StepOutput] = []
+        seq = session.seq
+        if session.dead:
+            return None, outputs
+        self._drain_pending(outputs)
+        if not self._session_alive(session):
+            session.dead = True
+            seq.exporting = False
+            return None, outputs
+        stalled_at = time.monotonic()
+        for i, s in enumerate(self.slots):
+            if s is seq:
+                self.slots[i] = None
+                self._deact_slot(i)
+        if seq in self.waiting:  # switchover before a seat opened
+            self.waiting.remove(seq)
+        n_prefix = len(session.prefix_pages)
+        chunks = list(session.chunks)
+        tail_pages = seq.block_table[n_prefix:]
+        if tail_pages:
+            chunks.extend(serialize_kv_chunks(
+                self.state, tail_pages, self.pcfg.page_size,
+                chunk_pages=session.chunk_pages,
+                wire_quant=session.wire_quant,
+                first_chunk_index=len(chunks),
+                first_page_index=n_prefix,
+            ))
+        total = len(chunks)
+        chunks = [dc_replace(c, total=total) for c in chunks]
+        exp = SequenceExport(
+            request_id=seq.request_id,
+            token_ids=list(seq.token_ids),
+            prompt_len=seq.prompt_len,
+            seq_len=seq.seq_len,
+            next_token=int(seq.next_token),
+            params=seq.params,
+            output_text=seq.output_text,
+            emitted_upto=seq.emitted_upto,
+            emitted_tokens=seq.emitted_tokens,
+            pending_ids=list(seq.pending_ids),
+            kv=b"",
+            kv_chunks=chunks,
+            wire_quant=session.wire_quant,
+            stalled_at=stalled_at,
+        )
+        self._by_id.pop(seq.request_id, None)
+        if seq.freed_upto == 0:
+            self.allocator.publish(seq.token_ids, seq.block_table)
+        self._release_seq(seq)
+        seq.exporting = False
+        session.dead = True
+        return exp, outputs
+
     def import_sequence(self, exp: SequenceExport) -> None:
         """Resume an exported sequence on this engine: allocate pages,
         restore the serialized K/V with prefix-cache registration
-        (kv_cache.deserialize_into_allocator), and queue the sequence for
-        an immediate decode seat — no prefill recomputation. Raises
-        CacheFull / CacheDeserializationError with the engine unchanged
-        (modulo garbage in freed pages, which is never gathered)."""
+        (kv_cache.deserialize_into_allocator for the monolithic payload,
+        an incremental KvImportSession for streamed chunks — pages
+        reserved up front, published only on a validated final chunk),
+        and queue the sequence for an immediate decode seat — no prefill
+        recomputation. Raises CacheFull / CacheDeserializationError with
+        the engine unchanged (modulo garbage in freed pages, which is
+        never gathered)."""
         n = exp.seq_len
         ps = self.pcfg.page_size
-        if n != len(exp.token_ids) or exp.next_token is None:
-            raise CacheDeserializationError(
-                "export is not at a decode boundary (seq_len != resident "
-                "tokens or no sampled token)"
-            )
-        if n + 1 > self.pcfg.max_seq_len:
-            raise CacheDeserializationError(
-                f"sequence of {n} tokens exceeds this engine's capacity "
-                f"({self.pcfg.max_seq_len} tokens)"
-            )
-        if exp.request_id in self._by_id:
-            raise CacheDeserializationError(
-                f"request {exp.request_id} is already live on this engine"
-            )
+        self._validate_import(exp)
         if (exp.draft_kv is None) != (self.draft_params is None):
             raise CacheDeserializationError(
                 "draft-model topology mismatch between source and target "
                 "engines (speculation must match across a handoff)"
             )
-        if exp.draft_kv is None:
+        if exp.kv_chunks is not None:
+            # streamed import, one-shot form: pages reserved up front,
+            # every chunk validated (crc/range/shape), publish only on a
+            # complete stream; any failure releases everything
+            # (KvImportSession). The phased form used by the serving
+            # path is import_stream_open/add/commit.
+            session = KvImportSession(self.state, self.allocator, ps)
+            try:
+                session.reserve(-(-n // ps))
+                for chunk in exp.kv_chunks:
+                    session.add_chunk(chunk)
+                self.state, pages = session.finish(self.state, exp.token_ids)
+            except Exception as e:
+                session.abort()
+                if isinstance(e, (CacheDeserializationError, CacheFull)):
+                    raise
+                raise CacheDeserializationError(str(e)) from None
+        elif exp.draft_kv is None:
             self.state, pages = deserialize_into_allocator(
                 self.state, self.allocator, exp.kv, exp.token_ids, ps
             )
@@ -724,10 +934,32 @@ class LLMEngine:
                 self.allocator.release(pages)
                 raise
             self.allocator.publish(exp.token_ids, pages)
+        self._seat_imported(exp, pages)
+
+    def _validate_import(self, exp: SequenceExport) -> None:
+        """Shared import preconditions (import_sequence and
+        import_stream_commit must accept exactly the same exports)."""
+        n = exp.seq_len
+        if n != len(exp.token_ids) or exp.next_token is None:
+            raise CacheDeserializationError(
+                "export is not at a decode boundary (seq_len != resident "
+                "tokens or no sampled token)"
+            )
+        if n + 1 > self.pcfg.max_seq_len:
+            raise CacheDeserializationError(
+                f"sequence of {n} tokens exceeds this engine's capacity "
+                f"({self.pcfg.max_seq_len} tokens)"
+            )
+        if exp.request_id in self._by_id:
+            raise CacheDeserializationError(
+                f"request {exp.request_id} is already live on this engine"
+            )
+
+    def _seat_imported(self, exp: SequenceExport, pages: List[int]) -> None:
         seq = _Seq(exp.request_id, list(exp.token_ids), exp.params)
         seq.prompt_len = exp.prompt_len  # ctor set it to len(token_ids)
         seq.block_table = list(pages)
-        seq.seq_len = n
+        seq.seq_len = exp.seq_len
         seq.next_token = int(exp.next_token)
         seq.output_text = exp.output_text
         seq.emitted_upto = int(exp.emitted_upto)
@@ -735,6 +967,74 @@ class LLMEngine:
         seq.pending_ids = list(exp.pending_ids)
         self._by_id[seq.request_id] = seq
         self.waiting.append(seq)
+
+    # -- phased (decode-overlapped) import ------------------------------
+
+    def import_stream_open(self, request_id: RequestId,
+                           prefix_pages: int) -> KvImportSession:
+        """Open an incremental import for a streamed handoff: reserve the
+        immutable-prefix pages UP FRONT (a CacheFull surfaces here, while
+        the source sequence is still decoding in place and the migration
+        can be abandoned for free) and return the session the runner
+        feeds via import_stream_add. Raises CacheDeserializationError /
+        CacheFull with the engine unchanged."""
+        if request_id in self._by_id:
+            raise CacheDeserializationError(
+                f"request {request_id} is already live on this engine"
+            )
+        if self.draft_params is not None:
+            raise CacheDeserializationError(
+                "streamed handoff carries no draft pool; this engine "
+                "speculates (topology must match across a handoff)"
+            )
+        if prefix_pages > self.pcfg.max_pages_per_seq:
+            raise CacheDeserializationError(
+                f"prefix of {prefix_pages} pages exceeds this engine's "
+                f"per-sequence capacity ({self.pcfg.max_pages_per_seq})"
+            )
+        session = KvImportSession(self.state, self.allocator,
+                                  self.pcfg.page_size)
+        try:
+            session.reserve(prefix_pages)
+        except Exception:
+            session.abort()
+            raise
+        return session
+
+    def import_stream_add(self, session: KvImportSession,
+                          chunks: List[KvChunk]) -> None:
+        """Absorb arrived chunks: validate and WRITE them into the pool
+        now (reserved pages; invisible to prefix matching until commit).
+        This is the work the overlap window hides — by commit time only
+        the tail delta remains."""
+        for chunk in chunks:
+            session.add_chunk(chunk)
+        self.state = session.apply_ready(self.state)
+
+    def import_stream_commit(self, session: KvImportSession,
+                             exp: SequenceExport) -> None:
+        """Switchover on the import side: absorb the final delta chunks,
+        validate the stream complete, publish, and seat the sequence for
+        an immediate decode resume. On ANY failure the session is
+        aborted (every reserved page released) and the error propagates
+        — the controller falls back to an in-place resume on the
+        source."""
+        try:
+            self._validate_import(exp)
+            for chunk in exp.kv_chunks or []:
+                session.add_chunk(chunk)
+            self.state, pages = session.finish(self.state, exp.token_ids)
+        except Exception as e:
+            session.abort()
+            if isinstance(e, (CacheDeserializationError, CacheFull)):
+                raise
+            raise CacheDeserializationError(str(e)) from None
+        self._seat_imported(exp, pages)
+
+    def import_stream_abort(self, session: KvImportSession) -> None:
+        """Drop a phased import (source cancelled / client disconnect):
+        every reserved page is released; nothing was published."""
+        session.abort()
 
     def warmup(self) -> None:
         """Compile every serving program before traffic arrives: one
@@ -2257,10 +2557,11 @@ class LLMEngine:
         W = self.cfg.sliding_window
         if not W or not seq.block_table:
             return
-        if seq.prefill_only:
+        if seq.prefill_only or seq.exporting:
             # a handoff candidate must keep EVERY page serializable:
             # sentinel-holed tables cannot migrate (and the import-side
-            # prefix registration would content-address garbage pages)
+            # prefix registration would content-address garbage pages);
+            # the same holds while a streamed export is in flight
             return
         if self.cfg.sliding_window_pattern:
             # Gemma-2-style alternating layers: the GLOBAL layers still

@@ -25,11 +25,12 @@ pure-XLA reference path, and the Pallas ragged-paged-attention kernel
 
 from __future__ import annotations
 
-import io
+import struct
 import time
+import zlib
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, NamedTuple, Sequence, Tuple
+from typing import Dict, Iterator, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -336,6 +337,24 @@ class PageAllocator:
 # Serialize / deserialize (Property 12) — host offload of a sequence's pages
 # ---------------------------------------------------------------------------
 
+# Payload layout (one buffer, assembled with a single join — the old
+# np.savez route copied the host arrays ~3 extra times through tobytes/
+# frombuffer/BytesIO, measurable on multi-MB handoffs):
+#   magic "KVP1" | kind u8 | dtype_len u8 | dtype name | L,S,KV,D u32 |
+#   token_count u64 | k bytes | v bytes [| k_scale f32 | v_scale f32]
+# kind: 0 = raw pool values (dtype as named, bf16 included — np.savez
+# silently degrades ml_dtypes arrays to void, which is why the format is
+# hand-rolled); 1 = wire-quantized int8 codes + f32 per-vector scales
+# (dtype names the ORIGINAL pool dtype to restore on import); 2 = native
+# QuantPool codes + scales (exact round-trip at the quantized
+# representation, Property 12 semantics).
+_KV_MAGIC = b"KVP1"
+_KIND_RAW, _KIND_WIRE8, _KIND_QPOOL = 0, 1, 2
+_HDR = struct.Struct("<4sBB")
+_DIMS = struct.Struct("<IIIIQ")
+
+WIRE_QUANTS = ("none", "int8")
+
 
 def _np_dtype(name: str) -> np.dtype:
     """Resolve a dtype name, including ml_dtypes extensions (bfloat16)."""
@@ -347,40 +366,160 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def serialize_kv(
-    state: PagedKVState, page_ids: Sequence[int], page_size: int,
-    token_count: int,
-) -> bytes:
-    """Pull a sequence's K/V pages to host and pack them with metadata.
-    K/V are stored as raw bytes + dtype name because np.savez silently
-    degrades ml_dtypes arrays (bfloat16, the engine default) to void."""
-    slots = np.concatenate(
+def _raw_view(arr: np.ndarray) -> np.ndarray:
+    """Flat uint8 view of a host array — a zero-copy bytes-like for the
+    final join (ml_dtypes arrays included, where memoryview.cast chokes
+    on the nonstandard format char)."""
+    return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+
+
+def _page_slots(page_ids: Sequence[int], page_size: int) -> np.ndarray:
+    return np.concatenate(
         [np.arange(p * page_size, (p + 1) * page_size) for p in page_ids]
     )
-    buf = io.BytesIO()
+
+
+def _encode_payload(kind: int, dtype_name: str, shape: Tuple[int, ...],
+                    token_count: int, buffers: Sequence[np.ndarray]) -> bytes:
+    dname = dtype_name.encode("ascii")
+    header = (_HDR.pack(_KV_MAGIC, kind, len(dname)) + dname
+              + _DIMS.pack(*shape, token_count))
+    # one allocation + one copy per buffer — the only host copies after
+    # the device pull itself
+    return b"".join([header] + [_raw_view(b) for b in buffers])
+
+
+def _pull_group(state: PagedKVState, slots: np.ndarray, wire_quant: str):
+    """Dispatch the device gather (and optional on-device wire
+    quantization) for one page group, then start its device→host copy
+    WITHOUT blocking — the double-buffering primitive. Returns
+    (kind, device arrays in payload order)."""
+    sl = jnp.asarray(slots)
     if isinstance(state.k, QuantPool):
-        # quantized pools serialize codes + scales; the round-trip is
-        # exact at the quantized representation (Property 12 semantics)
-        np.savez(
-            buf,
-            k=np.asarray(state.k.data[:, slots]),
-            v=np.asarray(state.v.data[:, slots]),
-            k_scale=np.asarray(state.k.scale[:, slots]),
-            v_scale=np.asarray(state.v.scale[:, slots]),
-            token_count=np.int64(token_count),
+        arrs = (state.k.data[:, sl], state.v.data[:, sl],
+                state.k.scale[:, sl], state.v.scale[:, sl])
+        kind = _KIND_QPOOL
+    elif wire_quant == "int8":
+        # quantize on device: halves (f32: quarters) the bytes crossing
+        # the host boundary as well as the wire
+        k_q, k_s = quantize_kv(state.k[:, sl])
+        v_q, v_s = quantize_kv(state.v[:, sl])
+        arrs = (k_q, v_q, k_s, v_s)
+        kind = _KIND_WIRE8
+    else:
+        arrs = (state.k[:, sl], state.v[:, sl])
+        kind = _KIND_RAW
+    for a in arrs:
+        copy_async = getattr(a, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()
+    return kind, arrs
+
+
+def _encode_group(state: PagedKVState, kind: int, arrs,
+                  token_count: int) -> bytes:
+    hosts = [np.asarray(a) for a in arrs]
+    if kind == _KIND_WIRE8:
+        dtype_name = str(state.k.dtype)
+    elif kind == _KIND_QPOOL:
+        dtype_name = "int8"
+    else:
+        dtype_name = str(hosts[0].dtype)
+    return _encode_payload(kind, dtype_name, hosts[0].shape, token_count,
+                           hosts)
+
+
+def serialize_kv(
+    state: PagedKVState, page_ids: Sequence[int], page_size: int,
+    token_count: int, wire_quant: str = "none",
+) -> bytes:
+    """Pull a sequence's K/V pages to host and pack them with metadata
+    (single-payload form; the streamed form is serialize_kv_chunks).
+    ``wire_quant="int8"`` quantizes float pools per-vector for the wire
+    (lossy — see docs/DISAGG.md); quantized pools always serialize their
+    native codes exactly."""
+    if wire_quant not in WIRE_QUANTS:
+        raise ValueError(
+            f"unknown wire_quant {wire_quant!r}; known: "
+            + "|".join(WIRE_QUANTS)
         )
-        return buf.getvalue()
-    k = np.asarray(state.k[:, slots])
-    v = np.asarray(state.v[:, slots])
-    np.savez(
-        buf,
-        k=np.frombuffer(k.tobytes(), np.uint8),
-        v=np.frombuffer(v.tobytes(), np.uint8),
-        shape=np.asarray(k.shape, np.int64),
-        dtype=np.frombuffer(str(k.dtype).encode(), np.uint8),
-        token_count=np.int64(token_count),
-    )
-    return buf.getvalue()
+    slots = _page_slots(page_ids, page_size)
+    kind, arrs = _pull_group(state, slots, wire_quant)
+    return _encode_group(state, kind, arrs, token_count)
+
+
+@dataclass(frozen=True)
+class KvChunk:
+    """One page-group of a streamed KV handoff (serving/disagg.py): a
+    self-describing payload (same layout as serialize_kv) covering
+    ``page_count`` pages starting at sequence-page index ``page_start``.
+    ``total`` is the final chunk count (patched once the export
+    completes — tail chunks are only known at switchover); ``crc32``
+    guards the payload across the wire (protowire KvChunk message)."""
+
+    index: int
+    total: int
+    page_start: int
+    page_count: int
+    payload: bytes
+    crc32: int
+
+
+def chunk_crc(payload: bytes) -> int:
+    return zlib.crc32(payload) & 0xFFFFFFFF
+
+
+def serialize_kv_chunks(
+    state: PagedKVState,
+    page_ids: Sequence[int],
+    page_size: int,
+    *,
+    chunk_pages: int = 8,
+    wire_quant: str = "none",
+    first_chunk_index: int = 0,
+    first_page_index: int = 0,
+) -> Iterator[KvChunk]:
+    """Streamed serialize: split ``page_ids`` into ``chunk_pages``-page
+    groups and yield one KvChunk per group, DOUBLE-BUFFERING the
+    device→host pulls — group N+1's gather (and wire quantization) is
+    dispatched and its host copy started before group N is encoded, so
+    the PCIe/ICI transfer of the next group hides behind the host-side
+    packing of the current one. Yielded chunks carry total=0; the caller
+    patches the true total once the tail is serialized
+    (engine.export_handoff_finish)."""
+    if wire_quant not in WIRE_QUANTS:
+        raise ValueError(
+            f"unknown wire_quant {wire_quant!r}; known: "
+            + "|".join(WIRE_QUANTS)
+        )
+    if chunk_pages <= 0:
+        raise ValueError(f"chunk_pages must be positive, got {chunk_pages}")
+    groups = [
+        list(page_ids[i : i + chunk_pages])
+        for i in range(0, len(page_ids), chunk_pages)
+    ]
+    if not groups:
+        return
+    pending = _pull_group(state, _page_slots(groups[0], page_size),
+                          wire_quant)
+    for n, group in enumerate(groups):
+        nxt = None
+        if n + 1 < len(groups):
+            # dispatch the NEXT group's pull before encoding this one
+            nxt = _pull_group(state, _page_slots(groups[n + 1], page_size),
+                              wire_quant)
+        kind, arrs = pending
+        payload = _encode_group(state, kind, arrs, 0)
+        yield KvChunk(
+            index=first_chunk_index + n,
+            total=0,
+            page_start=first_page_index
+            + n * chunk_pages,
+            page_count=len(group),
+            payload=payload,
+            crc32=chunk_crc(payload),
+        )
+        pending = nxt
 
 
 def deserialize_into_allocator(
@@ -414,40 +553,90 @@ def deserialize_into_allocator(
     return new_state, pages
 
 
-def deserialize_kv(
-    state: PagedKVState, data: bytes, page_ids: Sequence[int], page_size: int
-) -> Tuple[PagedKVState, int]:
-    """Restore serialized pages into freshly-allocated page ids. Returns the
-    updated device state and the token count."""
+def _decode_payload(state: PagedKVState, data: bytes):
+    """Parse one serialized payload into host arrays matched to the
+    target pool's representation. Returns ``(token_count, parts)`` where
+    parts is ``(k, v)`` for plain pools or ``(k, v, k_scale, v_scale)``
+    for QuantPool targets. Wire-quantized (kind 1) payloads are
+    dequantized back to the target pool dtype here; all reads are
+    zero-copy views over ``data``."""
     quant = isinstance(state.k, QuantPool)
     try:
-        with np.load(io.BytesIO(data)) as z:
+        magic, kind, dlen = _HDR.unpack_from(data, 0)
+        if magic != _KV_MAGIC:
+            raise ValueError("bad payload magic")
+        off = _HDR.size
+        dtype_name = data[off : off + dlen].decode("ascii")
+        off += dlen
+        L, S, KV, D, token_count = _DIMS.unpack_from(data, off)
+        off += _DIMS.size
+        shape = (L, S, KV, D)
+        n = L * S * KV * D
+
+        def take(dt, count, shp):
+            nonlocal off
+            dt = np.dtype(dt)
+            arr = np.frombuffer(
+                data, dt, count=count, offset=off
+            ).reshape(shp)
+            off += count * dt.itemsize
+            return arr
+
+        if kind == _KIND_RAW:
             if quant:
-                if "k_scale" not in z:
-                    raise ValueError(
-                        "payload is not a quantized-pool serialization"
-                    )
-                k = z["k"]
-                v = z["v"]
-                k_scale = z["k_scale"]
-                v_scale = z["v_scale"]
-            else:
-                shape = tuple(z["shape"])
-                dtype = _np_dtype(bytes(z["dtype"]).decode())
-                k = np.frombuffer(z["k"].tobytes(), dtype).reshape(shape)
-                v = np.frombuffer(z["v"].tobytes(), dtype).reshape(shape)
-            token_count = int(z["token_count"])
+                raise ValueError(
+                    "raw payload cannot restore into a quantized pool"
+                )
+            dt = _np_dtype(dtype_name)
+            parts = (take(dt, n, shape), take(dt, n, shape))
+        elif kind == _KIND_WIRE8:
+            if quant:
+                raise ValueError(
+                    "wire-quantized payload cannot restore into a "
+                    "quantized pool (pools quantize natively)"
+                )
+            k_q = take(np.int8, n, shape)
+            v_q = take(np.int8, n, shape)
+            k_s = take(np.float32, L * S * KV, (L, S, KV))
+            v_s = take(np.float32, L * S * KV, (L, S, KV))
+            dt = _np_dtype(dtype_name)
+            parts = (
+                (k_q.astype(np.float32) * k_s[..., None]).astype(dt),
+                (v_q.astype(np.float32) * v_s[..., None]).astype(dt),
+            )
+        elif kind == _KIND_QPOOL:
+            if not quant:
+                raise ValueError(
+                    "quantized-pool payload cannot restore into a "
+                    "float pool"
+                )
+            parts = (
+                take(np.int8, n, shape),
+                take(np.int8, n, shape),
+                take(np.float32, L * S * KV, (L, S, KV)),
+                take(np.float32, L * S * KV, (L, S, KV)),
+            )
+        else:
+            raise ValueError(f"unknown payload kind {kind}")
+        if off != len(data):
+            raise ValueError(
+                f"payload length mismatch: {len(data)} bytes, "
+                f"expected {off}"
+            )
+    except CacheDeserializationError:
+        raise
     except Exception as e:
         raise CacheDeserializationError(str(e)) from None
-    slots = np.concatenate(
-        [np.arange(p * page_size, (p + 1) * page_size) for p in page_ids]
-    )
-    if k.shape[1] != len(slots):
-        raise CacheDeserializationError(
-            f"page count mismatch: payload {k.shape[1]} slots, target {len(slots)}"
-        )
+    return token_count, parts
+
+
+def _scatter_payload(state: PagedKVState, slots: np.ndarray, parts
+                     ) -> PagedKVState:
+    """Write decoded host arrays into the pool at ``slots`` (one device
+    scatter per pool member)."""
     try:
-        if quant:
+        if isinstance(state.k, QuantPool):
+            k, v, k_scale, v_scale = parts
             new_k = QuantPool(
                 state.k.data.at[:, slots].set(jnp.asarray(k)),
                 state.k.scale.at[:, slots].set(jnp.asarray(k_scale)),
@@ -457,8 +646,185 @@ def deserialize_kv(
                 state.v.scale.at[:, slots].set(jnp.asarray(v_scale)),
             )
         else:
+            k, v = parts
             new_k = state.k.at[:, slots].set(jnp.asarray(k))
             new_v = state.v.at[:, slots].set(jnp.asarray(v))
     except Exception as e:
         raise CacheDeserializationError(str(e)) from None
-    return PagedKVState(new_k, new_v), token_count
+    return PagedKVState(new_k, new_v)
+
+
+def deserialize_kv(
+    state: PagedKVState, data: bytes, page_ids: Sequence[int], page_size: int
+) -> Tuple[PagedKVState, int]:
+    """Restore serialized pages into freshly-allocated page ids. Returns the
+    updated device state and the token count."""
+    token_count, parts = _decode_payload(state, data)
+    slots = _page_slots(page_ids, page_size)
+    if parts[0].shape[1] != len(slots):
+        raise CacheDeserializationError(
+            f"page count mismatch: payload {parts[0].shape[1]} slots, "
+            f"target {len(slots)}"
+        )
+    return _scatter_payload(state, slots, parts), token_count
+
+
+class KvImportSession:
+    """Incremental import target for a streamed KV handoff.
+
+    Pages are reserved UP FRONT (``reserve`` — before chunks land, so a
+    mid-stream CacheFull is impossible for the covered range); chunks
+    arrive in ANY order (each validated: crc, duplicate index, payload
+    shape) and are WRITTEN INTO THE POOL AS THEY ARRIVE via
+    ``apply_ready`` — that is what lets a decode engine absorb the
+    prefix while the source sequence is still decoding. Nothing is
+    published or seated until ``finish()`` validates the stream complete
+    (all indices present, page ranges tiling the sequence exactly);
+    any failure path calls ``abort()``, which releases every reserved
+    page — chunk data already scattered into reserved pages is garbage
+    in freed pages, which is never gathered, so a torn import leaves
+    the engine semantically unchanged."""
+
+    def __init__(self, state: PagedKVState, allocator: "PageAllocator",
+                 page_size: int):
+        self._state = state  # representation reference (QuantPool or not)
+        self._allocator = allocator
+        self._ps = page_size
+        self.pages: List[int] = []
+        # index -> (page_start, page_count, decoded parts)
+        self._parts: Dict[int, Tuple[int, int, tuple]] = {}
+        self._applied: set = set()
+        self._total: Optional[int] = None
+        self._closed = False
+
+    def reserve(self, total_pages: int) -> None:
+        """Grow the reservation to ``total_pages`` (idempotent; raises
+        CacheFull with the existing reservation intact — abort() still
+        releases it)."""
+        if self._closed:
+            raise CacheDeserializationError("import session already closed")
+        missing = total_pages - len(self.pages)
+        if missing > 0:
+            self.pages.extend(self._allocator.allocate(missing))
+
+    def add_chunk(self, chunk: KvChunk) -> None:
+        if self._closed:
+            raise CacheDeserializationError("import session already closed")
+        if chunk_crc(chunk.payload) != chunk.crc32:
+            raise CacheDeserializationError(
+                f"chunk {chunk.index}: crc mismatch (corrupt payload)"
+            )
+        if chunk.index < 0 or chunk.index in self._parts:
+            raise CacheDeserializationError(
+                f"chunk index {chunk.index} duplicate or negative"
+            )
+        if chunk.total:
+            if self._total is not None and self._total != chunk.total:
+                raise CacheDeserializationError(
+                    f"inconsistent chunk totals ({self._total} vs "
+                    f"{chunk.total})"
+                )
+            self._total = chunk.total
+        if chunk.page_start < 0 or chunk.page_count <= 0:
+            raise CacheDeserializationError(
+                f"chunk {chunk.index}: bad page range [{chunk.page_start}, "
+                f"{chunk.page_start + chunk.page_count})"
+            )
+        _, parts = _decode_payload(self._state, chunk.payload)
+        if parts[0].shape[1] != chunk.page_count * self._ps:
+            raise CacheDeserializationError(
+                f"chunk {chunk.index}: payload covers "
+                f"{parts[0].shape[1]} slots, header says "
+                f"{chunk.page_count * self._ps}"
+            )
+        self._parts[chunk.index] = (chunk.page_start, chunk.page_count, parts)
+
+    def apply_ready(self, state: PagedKVState) -> PagedKVState:
+        """Scatter every not-yet-applied chunk whose page range lies
+        within the current reservation into ``state`` (one batched
+        scatter per call). The caller swaps the returned state in; the
+        written pages are reserved-but-unpublished, so concurrent
+        decoding never reads them."""
+        if self._closed:
+            raise CacheDeserializationError("import session already closed")
+        ready = sorted(
+            (idx for idx, (start, count, _) in self._parts.items()
+             if idx not in self._applied
+             and start + count <= len(self.pages)),
+            key=lambda i: self._parts[i][0],
+        )
+        if not ready:
+            return state
+        slot_groups, part_groups = [], []
+        for idx in ready:
+            start, count, parts = self._parts[idx]
+            slot_groups.append(_page_slots(
+                self.pages[start : start + count], self._ps))
+            part_groups.append(parts)
+            self._applied.add(idx)
+            # decoded host arrays are released once applied
+            self._parts[idx] = (start, count, ())
+        slots = np.concatenate(slot_groups)
+        n_members = len(part_groups[0])
+        merged = tuple(
+            np.concatenate([g[m] for g in part_groups], axis=1)
+            for m in range(n_members)
+        )
+        return _scatter_payload(state, slots, merged)
+
+    def finish(self, state: PagedKVState, tokens: Sequence[int]
+               ) -> Tuple[PagedKVState, List[int]]:
+        """Validate completeness, reserve/scatter any remainder, and
+        content-address the full pages (publish — the seat gate: nothing
+        is visible to prefix matching before this). Returns
+        (new_state, pages); the caller owns one reference per page."""
+        if self._closed:
+            raise CacheDeserializationError("import session already closed")
+        n = len(tokens)
+        if n <= 0:
+            raise CacheDeserializationError("cannot import an empty sequence")
+        num_pages = -(-n // self._ps)
+        # completeness is decided by the page-range tiling below (a lost
+        # chunk leaves a gap; a lost TAIL leaves coverage short of the
+        # sequence); ``total`` — which phase-1 chunks legitimately carry
+        # as 0, the switchover may add NO tail chunks, and the patched
+        # totals then never reach this side — is only a consistency
+        # check when some chunk did carry it
+        total = self._total
+        if total is not None and total != len(self._parts):
+            raise CacheDeserializationError(
+                f"incomplete stream: {len(self._parts)} of "
+                f"{total} chunks arrived"
+            )
+        if sorted(self._parts) != list(range(len(self._parts))):
+            raise CacheDeserializationError("chunk indices are not 0..total-1")
+        ordered = sorted(self._parts.values(), key=lambda t: t[0])
+        covered = 0
+        for page_start, page_count, _ in ordered:
+            if page_start != covered:
+                raise CacheDeserializationError(
+                    f"chunk page ranges do not tile the sequence "
+                    f"(gap/overlap at page {covered})"
+                )
+            covered += page_count
+        if covered != num_pages:
+            raise CacheDeserializationError(
+                f"chunks cover {covered} pages, sequence has {num_pages}"
+            )
+        if len(self.pages) > num_pages:
+            raise CacheDeserializationError(
+                f"reservation of {len(self.pages)} pages exceeds the "
+                f"{num_pages}-page sequence"
+            )
+        self.reserve(num_pages)
+        new_state = self.apply_ready(state)
+        self._allocator.publish(list(tokens), self.pages)
+        self._closed = True
+        return new_state, list(self.pages)
+
+    def abort(self) -> None:
+        """Release every reserved page (idempotent)."""
+        if not self._closed:
+            self._closed = True
+            if self.pages:
+                self._allocator.release(self.pages)

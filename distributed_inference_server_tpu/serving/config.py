@@ -123,6 +123,16 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # "protowire" (round-trips the KvHandoff protobuf framing —
         # the cross-process wire format, exercised in-process)
         "channel": (str, "inproc"),
+        # streamed handoff (docs/DISAGG.md "Streaming handoff"): the
+        # immutable prefix serializes in page-group chunks while the
+        # sequence keeps decoding on the source; off = the monolithic
+        # stop-the-world export (A/B baseline)
+        "stream": (bool, True),
+        "chunk_pages": (int, 8),
+        # per-chunk wire encoding of float KV pools: none | int8
+        # (per-vector absmax codes + f32 scales — halves-plus the bytes
+        # moved, bounded accuracy cost; quantized pools pass through)
+        "wire_quant": (str, "none"),
     },
     "tracing": {
         # OTLP/HTTP collector URL for span export (utils/otlp.py), e.g.
@@ -333,6 +343,9 @@ class ServerConfig:
             handoff_timeout_s=d["handoff_timeout_s"],
             handoff_retries=d["handoff_retries"],
             channel=d["channel"],
+            stream=d["stream"],
+            chunk_pages=d["chunk_pages"],
+            wire_quant=d["wire_quant"],
         )
 
     # -- validation --------------------------------------------------------
@@ -409,6 +422,13 @@ class ServerConfig:
             raise ConfigError(
                 f"disagg.channel must be inproc/protowire, "
                 f"got {r['disagg']['channel']!r}"
+            )
+        if r["disagg"]["chunk_pages"] <= 0:
+            raise ConfigError("disagg.chunk_pages must be positive")
+        if r["disagg"]["wire_quant"] not in ("none", "int8"):
+            raise ConfigError(
+                f"disagg.wire_quant must be none/int8, "
+                f"got {r['disagg']['wire_quant']!r}"
             )
 
     def hot_diff(self, other: "ServerConfig") -> Dict[tuple, Any]:

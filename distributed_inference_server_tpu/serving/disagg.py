@@ -37,6 +37,7 @@ the disaggregated topology.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -49,6 +50,7 @@ from distributed_inference_server_tpu.engine.engine import (
     SamplingParams,
     SequenceExport,
 )
+from distributed_inference_server_tpu.engine.kv_cache import KvChunk
 from distributed_inference_server_tpu.serving import protowire
 from distributed_inference_server_tpu.serving.metrics import MetricsCollector
 
@@ -73,6 +75,17 @@ class DisaggSettings:
     handoff_timeout_s: float = 5.0
     handoff_retries: int = 1  # attempts beyond the first
     channel: str = "inproc"  # inproc | protowire
+    # streamed handoff (docs/DISAGG.md "Streaming handoff"): serialize
+    # page-group chunks while the sequence keeps decoding on the source,
+    # sending only the overlap-window tail at switchover. stream=False
+    # forces the monolithic stop-the-world export everywhere (the
+    # pre-streaming behavior, kept for A/B benching).
+    stream: bool = True
+    chunk_pages: int = 8  # pages per KvChunk
+    # per-chunk wire encoding of float pools: "int8" halves-plus the
+    # bytes moved (per-vector absmax codes + f32 scales) at a bounded
+    # accuracy cost; natively quantized pools pass through unchanged
+    wire_quant: str = "none"  # none | int8
 
 
 def parse_roles(spec: str, num_engines: int) -> List[str]:
@@ -122,12 +135,28 @@ def parse_roles(spec: str, num_engines: int) -> List[str]:
 class KVTransferChannel:
     """Moves a SequenceExport from a prefill engine toward a decode
     engine. ``transfer`` returns the payload as the receiver will see it
-    and raises on failure (the controller retries / falls back)."""
+    and raises on failure (the controller retries / falls back).
+
+    Streamed (two-phase) handoffs use the chunk-iterator API instead:
+    ``transfer_chunks`` moves the immutable-prefix KvChunks while the
+    source sequence is still decoding, and ``transfer_commit`` moves the
+    switchover delta (tail chunks + host state). The defaults pass
+    objects by reference (the in-process deployment)."""
 
     name = "null"
 
     def transfer(self, exp: SequenceExport) -> SequenceExport:
         raise NotImplementedError
+
+    def transfer_chunks(self, request_id, wire_quant: str,
+                        chunks: List[KvChunk]) -> List[KvChunk]:
+        return chunks
+
+    def transfer_commit(self, exp: SequenceExport,
+                        tail: List[KvChunk]) -> SequenceExport:
+        """The commit payload carries ONLY the tail chunks — the target
+        session already holds the prefix."""
+        return dataclasses.replace(exp, kv_chunks=list(tail))
 
 
 class InProcessChannel(KVTransferChannel):
@@ -191,16 +220,119 @@ def export_from_wire(data: bytes) -> SequenceExport:
     )
 
 
+# -- streamed framing (chunk-iterator wire API) -----------------------------
+#
+# A streamed handoff crosses the wire as a frame sequence:
+#   1 x KvHandoffHeader  (handoff id, request id, wire_quant)
+#   N x KvChunk          (index/total, page range, crc32, payload)
+#   1 x KvHandoff        (the host state; kv bytes empty — pages moved
+#                         in the chunks)
+# A real transport (gRPC streaming) maps the (message, bytes) pairs onto
+# its own envelope; the in-process ProtowireChannel round-trips the same
+# frames so the format is differentially tested on every migration.
+
+
+def chunks_to_frames(request_id, wire_quant: str, chunks: List[KvChunk]):
+    """Frame a chunk batch as ``(message_name, frame_bytes)`` pairs:
+    one KvHandoffHeader, then one KvChunk per chunk — the sender half of
+    the chunk-iterator channel API, framed lazily so a transport can put
+    each frame on the wire while the next serializes."""
+    hid = str(request_id)
+    yield "KvHandoffHeader", protowire.encode("KvHandoffHeader", {
+        "handoff_id": hid,
+        "request_id": str(request_id),
+        "wire_quant": wire_quant,
+    })
+    for c in chunks:
+        yield "KvChunk", protowire.encode("KvChunk", {
+            "handoff_id": hid,
+            "index": c.index,
+            "total": c.total,
+            "page_start": c.page_start,
+            "page_count": c.page_count,
+            "crc32": c.crc32,
+            "payload": c.payload,
+        })
+
+
+def stream_to_frames(exp: SequenceExport):
+    """Frame a chunked SequenceExport: header, its chunks, then the
+    terminal KvHandoff frame carrying the host state (kv bytes empty —
+    the pages moved in the chunks)."""
+    yield from chunks_to_frames(exp.request_id, exp.wire_quant,
+                                exp.kv_chunks or [])
+    yield "KvHandoff", export_to_wire(exp)
+
+
+def frames_to_parts(frames):
+    """Decode a frame sequence into ``(header, chunks, state)`` — state
+    is None for a prefix-only (phase 1) batch. Chunk frames may arrive
+    in any order. Raises HandoffError on a malformed stream."""
+    header: Optional[Dict[str, Any]] = None
+    chunks: List[KvChunk] = []
+    state: Optional[SequenceExport] = None
+    for kind, data in frames:
+        if kind == "KvHandoffHeader":
+            header = protowire.decode("KvHandoffHeader", data)
+        elif kind == "KvChunk":
+            d = protowire.decode("KvChunk", data)
+            if header is None or d["handoff_id"] != header["handoff_id"]:
+                raise HandoffError(
+                    "KvChunk before header or with a foreign handoff_id"
+                )
+            chunks.append(KvChunk(
+                index=d["index"], total=d["total"],
+                page_start=d["page_start"], page_count=d["page_count"],
+                payload=d["payload"], crc32=d["crc32"],
+            ))
+        elif kind == "KvHandoff":
+            state = export_from_wire(data)
+        else:
+            raise HandoffError(f"unknown stream frame {kind!r}")
+    if header is None:
+        raise HandoffError("truncated handoff stream (header missing)")
+    return header, sorted(chunks, key=lambda c: c.index), state
+
+
+def stream_from_frames(frames) -> SequenceExport:
+    """Reassemble a full SequenceExport (chunks + host state) from
+    streamed frames — the one-shot receiver used by
+    ProtowireChannel.transfer."""
+    header, chunks, state = frames_to_parts(frames)
+    if state is None:
+        raise HandoffError("truncated handoff stream (state missing)")
+    state.kv_chunks = chunks
+    state.wire_quant = header["wire_quant"] or "none"
+    return state
+
+
 class ProtowireChannel(KVTransferChannel):
     """Cross-process framing exercised in-process: every handoff
-    round-trips through the ``KvHandoff`` protobuf encoding, so the wire
-    format the future gRPC transport will carry is differentially tested
-    on every migration instead of rotting in a docstring."""
+    round-trips through the ``KvHandoff`` protobuf encoding — or, for
+    streamed exports, the KvHandoffHeader/KvChunk/KvHandoff frame
+    sequence — so the wire format the future gRPC transport will carry
+    is differentially tested on every migration instead of rotting in a
+    docstring."""
 
     name = "protowire"
 
     def transfer(self, exp: SequenceExport) -> SequenceExport:
+        if exp.kv_chunks is not None:
+            return stream_from_frames(stream_to_frames(exp))
         return export_from_wire(export_to_wire(exp))
+
+    def transfer_chunks(self, request_id, wire_quant: str,
+                        chunks: List[KvChunk]) -> List[KvChunk]:
+        _header, wired, _state = frames_to_parts(
+            chunks_to_frames(request_id, wire_quant, chunks)
+        )
+        return wired
+
+    def transfer_commit(self, exp: SequenceExport,
+                        tail: List[KvChunk]) -> SequenceExport:
+        return stream_from_frames(stream_to_frames(
+            dataclasses.replace(exp, kv_chunks=list(tail))
+        ))
 
 
 def make_channel(name: str) -> KVTransferChannel:
@@ -219,6 +351,28 @@ def make_channel(name: str) -> KVTransferChannel:
 
 
 @dataclass
+class _StreamJob:
+    """Phase-1 state of a two-phase streamed migration: the immutable
+    prefix is transferred and OPENED on a decode engine while the source
+    sequence is still decoding in place. The source runner polls
+    ``status`` between steps and switches over on "ready"; "failed" /
+    "cancelled" cost nothing — the sequence simply keeps decoding where
+    it is. Transitions happen under the controller's ``_cv``."""
+
+    request_id: Any
+    chunks: List[KvChunk]  # prefix chunks (source-side objects)
+    n_prefix_pages: int
+    wire_quant: str
+    req: Any
+    source: Any
+    enqueued_at: float = field(default_factory=time.monotonic)
+    deadline: float = 0.0
+    target: Any = None  # decode EngineRunner, set when opened
+    status: str = "opening"  # opening | ready | failed | cancelled
+    error: str = ""
+
+
+@dataclass
 class _MigrationJob:
     exp: SequenceExport
     req: Any  # ServerRequest (typed loosely to avoid an import cycle)
@@ -226,6 +380,9 @@ class _MigrationJob:
     enqueued_at: float = field(default_factory=time.monotonic)
     deadline: float = 0.0
     attempts: int = 0
+    # set on a phase-2 (switchover commit) job: the opened stream whose
+    # target already holds the prefix
+    stream: Optional[_StreamJob] = None
 
 
 class DisaggController:
@@ -292,11 +449,24 @@ class DisaggController:
             self._accepting = False
             leftovers = list(self._jobs)
             self._jobs.clear()
+            for job in leftovers:
+                if isinstance(job, _StreamJob):
+                    # phase-1 streams: the sequence is still decoding on
+                    # its source — flipping to cancelled makes the source
+                    # keep it in place, which IS the drain semantics
+                    job.status = "cancelled"
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(5.0)
             self._thread = None
         for job in leftovers:
+            if isinstance(job, _StreamJob):
+                if job.target is not None:
+                    job.target.submit_import_abort(job.request_id)
+                continue
+            if job.stream is not None and job.stream.target is not None:
+                job.stream.target.submit_import_abort(
+                    job.stream.request_id)
             self._fallback(job, "controller shutdown")
 
     # -- submission (runner threads) ---------------------------------------
@@ -328,15 +498,33 @@ class DisaggController:
         Mid-migration returns False on purpose: the caller
         (Dispatcher.abort) then also sweeps every runner, covering the
         window where the resume was already submitted to a target; the
-        flag covers the window where it was not."""
+        flag covers the window where it was not. Phase-1 stream jobs
+        also return False: the sequence is still DECODING on its source
+        runner, so the runner sweep must reach it — here they are only
+        flipped to cancelled (the source's pump then releases the
+        target's reserved pages via cancel_stream)."""
+        cleanup = None
+        removed = False
         with self._cv:
             for job in self._jobs:
-                if job.req.request_id == request_id:
-                    self._jobs.remove(job)
-                    return True
-            if request_id in self._migrating:
-                self._aborted.add(request_id)
-        return False
+                if job.req.request_id != request_id:
+                    continue
+                if isinstance(job, _StreamJob):
+                    job.status = "cancelled"
+                    break
+                self._jobs.remove(job)
+                if job.stream is not None:
+                    # commit job: the target session holds reserved pages
+                    job.stream.status = "cancelled"
+                    cleanup = job.stream.target
+                removed = True
+                break
+            else:
+                if request_id in self._migrating:
+                    self._aborted.add(request_id)
+        if cleanup is not None:
+            cleanup.submit_import_abort(request_id)
+        return removed
 
     def _consume_abort(self, job: _MigrationJob) -> bool:
         with self._cv:
@@ -369,12 +557,188 @@ class DisaggController:
                 if self._stop.is_set():
                     return
                 job = self._jobs.popleft()
-                self._migrating[job.req.request_id] = job
+                if isinstance(job, _StreamJob):
+                    # phase 1: the request is still LIVE (and decoding)
+                    # on the source runner — visible to the drain loop
+                    # via its active_count, so no _migrating entry
+                    if job.status == "cancelled":
+                        continue
+                else:
+                    self._migrating[job.req.request_id] = job
+            if isinstance(job, _StreamJob):
+                try:
+                    self._open_stream(job)
+                except Exception as e:  # noqa: BLE001 — worker survives
+                    logger.exception("unexpected stream-open failure")
+                    with self._cv:
+                        if job.status == "opening":
+                            job.error = str(e)
+                            job.status = "failed"
+                continue
             try:
-                self._migrate(job)
+                if job.stream is not None:
+                    self._commit_stream_job(job)
+                else:
+                    self._migrate(job)
             except Exception as e:  # noqa: BLE001 — worker must survive
                 logger.exception("unexpected migration failure")
                 self._fallback(job, str(e))
+
+    # -- streamed (two-phase) migration ------------------------------------
+
+    def open_stream(self, request_id, chunks: List[KvChunk],
+                    n_prefix_pages: int, wire_quant: str, req,
+                    source) -> Optional[_StreamJob]:
+        """Queue phase 1 of a streamed migration (called on the source
+        runner's thread once the prefix is serialized). Returns None
+        when the controller is not accepting — the sequence then simply
+        keeps decoding in place."""
+        job = _StreamJob(
+            request_id=request_id, chunks=chunks,
+            n_prefix_pages=n_prefix_pages, wire_quant=wire_quant,
+            req=req, source=source,
+            deadline=time.monotonic() + self.settings.handoff_timeout_s,
+        )
+        with self._cv:
+            if self._accepting:
+                self._jobs.append(job)
+                self._cv.notify()
+                return job
+        return None
+
+    def _open_stream(self, job: _StreamJob) -> None:
+        """Worker half of phase 1: move the prefix chunks through the
+        channel, pick a decode target, and open an import session there.
+        Failure just flips the job to "failed" — the source sequence
+        never stopped decoding, so there is nothing to fall back FROM."""
+        try:
+            wired = self.channel.transfer_chunks(
+                job.request_id, job.wire_quant, job.chunks
+            )
+            target = self.scheduler.schedule_decode(
+                exclude=job.source.engine_id
+            )
+            if target is None:
+                raise HandoffError("no healthy decode engine")
+        except Exception as e:  # noqa: BLE001 — channel/sched fault domain
+            with self._cv:
+                if job.status == "opening":
+                    job.error = str(e)
+                    job.status = "failed"
+            if self.metrics:
+                self.metrics.record_handoff("retry")
+            return
+
+        def _opened(ok: bool, err: Optional[str],
+                    job=job, target=target) -> None:
+            # runs on the target runner's thread
+            cancelled = False
+            with self._cv:
+                if job.status == "cancelled":
+                    cancelled = True  # raced an abort: undo the open
+                elif ok:
+                    job.target = target
+                    job.status = "ready"
+                else:
+                    job.error = err or "import open failed"
+                    job.status = "failed"
+            if cancelled and ok:
+                target.submit_import_abort(job.request_id)
+
+        target.submit_import_open(
+            job.request_id, job.n_prefix_pages, wired, _opened
+        )
+
+    def commit_stream(self, job: _StreamJob, exp: SequenceExport) -> None:
+        """Queue phase 2 (called on the source runner's thread right
+        after the switchover export): move the tail delta + host state
+        to the opened target and resume there. The request has left the
+        source runner, so from here the job follows the migration
+        bookkeeping (pending_count / fallback semantics)."""
+        mjob = _MigrationJob(
+            exp=exp, req=job.req, source=job.source, stream=job,
+            enqueued_at=job.enqueued_at,
+            deadline=time.monotonic() + self.settings.handoff_timeout_s,
+        )
+        with self._cv:
+            if self._accepting:
+                self._jobs.append(mjob)
+                self._cv.notify()
+                return
+        # controller shutting down: the state is already lifted off the
+        # engine — resume in place on the source, drop the target session
+        if job.target is not None:
+            job.target.submit_import_abort(job.request_id)
+        self._fallback(mjob, "controller not accepting")
+
+    def cancel_stream(self, job: _StreamJob, record: bool = True) -> None:
+        """Drop phase 1 (source cancelled: session died, open failed, or
+        deadline passed). The sequence keeps decoding in place on the
+        source; the target's reserved pages (if the open landed) are
+        released. ``record=False`` for cancels that are not fallbacks
+        (request finished in place / client abort)."""
+        with self._cv:
+            try:
+                self._jobs.remove(job)
+            except ValueError:
+                pass
+            target = job.target
+            job.status = "cancelled"
+        if target is not None:
+            target.submit_import_abort(job.request_id)
+        if record and self.metrics:
+            self.metrics.record_handoff("fallback")
+
+    def _commit_stream_job(self, mjob: _MigrationJob) -> None:
+        """Phase 2 on the worker: tail + host state through the channel,
+        commit on the already-opened target. Single attempt — the prefix
+        lives in exactly one target session, so retrying elsewhere is
+        meaningless; failure falls back to an in-place resume on the
+        source (mjob.exp carries the FULL chunk set for that)."""
+        job = mjob.stream
+        if self._consume_abort(mjob):
+            if job.target is not None:
+                job.target.submit_import_abort(job.request_id)
+            return
+        n_prefix = len(job.chunks)
+        try:
+            tail = (mjob.exp.kv_chunks or [])[n_prefix:]
+            wired = self.channel.transfer_commit(mjob.exp, tail)
+        except Exception as e:  # noqa: BLE001 — channel fault domain
+            if job.target is not None:
+                job.target.submit_import_abort(job.request_id)
+            self._fallback(mjob, f"channel {self.channel.name}: {e}")
+            return
+
+        def _done(ok: bool, err: Optional[str],
+                  mjob=mjob, target=job.target) -> None:
+            # runs on the target runner's thread
+            if ok:
+                self._finish_migration(mjob)
+                if self._consume_abort_flag(mjob.req.request_id):
+                    target.abort(mjob.req.request_id)
+                    return
+                if err == "aborted":
+                    return
+                if self.metrics:
+                    now = time.monotonic()
+                    self.metrics.record_handoff(
+                        "ok",
+                        latency_s=now - mjob.enqueued_at,
+                        nbytes=mjob.exp.kv_bytes(),
+                        stall_s=(now - mjob.exp.stalled_at
+                                 if mjob.exp.stalled_at else None),
+                        chunks=len(mjob.exp.kv_chunks or []),
+                    )
+            else:
+                logger.warning(
+                    "streamed KV commit rejected by %s (%s); decoding "
+                    "in place on %s",
+                    target.engine_id, err, mjob.source.engine_id,
+                )
+                self._fallback(mjob, err or "import commit failed")
+
+        job.target.submit_import_commit(wired, mjob.req, _done)
 
     def _migrate(self, job: _MigrationJob) -> None:
         """One migration: channel transfer + decode-engine selection,
@@ -432,10 +796,17 @@ class DisaggController:
                     if err == "aborted":
                         return  # resolved by an abort, not a transfer
                     if self.metrics:
+                        now = time.monotonic()
                         self.metrics.record_handoff(
                             "ok",
-                            latency_s=time.monotonic() - job.enqueued_at,
+                            latency_s=now - job.enqueued_at,
                             nbytes=job.exp.kv_bytes(),
+                            # decode pause the migrated sequence actually
+                            # observed: switchover (streamed) or export
+                            # start (monolithic) until the resume landed
+                            stall_s=(now - job.exp.stalled_at
+                                     if job.exp.stalled_at else None),
+                            chunks=len(job.exp.kv_chunks or []),
                         )
                 else:
                     logger.warning(
@@ -466,7 +837,11 @@ class DisaggController:
         if self._consume_abort(job):
             return
         if self.metrics:
-            self.metrics.record_handoff("fallback")
+            self.metrics.record_handoff(
+                "fallback",
+                stall_s=(time.monotonic() - job.exp.stalled_at
+                         if job.exp.stalled_at else None),
+            )
 
         def _done(ok: bool, import_err: Optional[str]) -> None:
             if not ok:

@@ -194,7 +194,24 @@ class MetricsCollector:
         )
         self.handoff_bytes = Counter(
             "kv_handoff_bytes_total",
-            "Serialized KV bytes moved over the handoff channel",
+            "Serialized KV bytes moved over the handoff channel "
+            "(post wire-quantization)",
+            registry=r,
+        )
+        # the decode pause the MIGRATED sequence observes (switchover to
+        # resume) — distinct from kv_handoff_latency_seconds, which is
+        # end-to-end and, under the streamed export, mostly overlapped
+        # with the sequence's own decoding
+        self.handoff_stall = Histogram(
+            "kv_handoff_stall_seconds",
+            "Decode pause observed by the migrated sequence",
+            registry=r,
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1,
+                     2, 5),
+        )
+        self.handoff_chunks = Counter(
+            "kv_handoff_chunks_total",
+            "KvChunk frames moved over the handoff channel",
             registry=r,
         )
         self.handoffs = Counter(
@@ -228,6 +245,9 @@ class MetricsCollector:
         self._cache_misses = 0
         self._handoffs: Dict[str, int] = {}
         self._handoff_bytes = 0
+        self._handoff_chunks = 0
+        self._stall_sum = 0.0
+        self._stall_count = 0
 
     # -- recording ---------------------------------------------------------
 
@@ -297,18 +317,29 @@ class MetricsCollector:
         self.engine_up.labels(engine_id=engine_id).set(1 if up else 0)
 
     def record_handoff(self, outcome: str, latency_s: Optional[float] = None,
-                       nbytes: int = 0) -> None:
+                       nbytes: int = 0, stall_s: Optional[float] = None,
+                       chunks: int = 0) -> None:
         """One KV-handoff event (serving/disagg.py): ``outcome`` is
         "ok" (resumed on a decode engine), "fallback" (decoded in place
-        on the source), or "retry" (a failed attempt that was retried)."""
+        on the source), or "retry" (a failed attempt that was retried).
+        ``stall_s`` is the decode pause the migrated sequence observed;
+        ``chunks`` counts streamed KvChunk frames (0 = monolithic)."""
         self.handoffs.labels(outcome=outcome).inc()
         if latency_s is not None:
             self.handoff_latency.observe(latency_s)
+        if stall_s is not None:
+            self.handoff_stall.observe(stall_s)
         if nbytes:
             self.handoff_bytes.inc(nbytes)
+        if chunks:
+            self.handoff_chunks.inc(chunks)
         with self._lock:
             self._handoffs[outcome] = self._handoffs.get(outcome, 0) + 1
             self._handoff_bytes += nbytes
+            self._handoff_chunks += chunks
+            if stall_s is not None:
+                self._stall_sum += stall_s
+                self._stall_count += 1
 
     def record_error(self, site: str) -> None:
         """Count an error absorbed at an isolation boundary (``site`` is a
@@ -360,6 +391,12 @@ class MetricsCollector:
                 disagg = {
                     "handoffs": dict(self._handoffs),
                     "handoff_bytes": self._handoff_bytes,
+                    "handoff_chunks": self._handoff_chunks,
+                    "handoff_stall_count": self._stall_count,
+                    "handoff_stall_avg_ms": round(
+                        self._stall_sum
+                        / max(1, self._stall_count) * 1000.0, 3,
+                    ),
                 }
             return MetricsSnapshot(
                 total_requests=self._total_requests,

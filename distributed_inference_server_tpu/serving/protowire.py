@@ -218,6 +218,23 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
     "ErrorResponse": {
         1: ("error", "msg:ErrorDetail", "opt"),
     },
+    # Streamed KV handoff framing (serving/disagg.py stream_to_frames):
+    # header + crc-guarded page-group chunks + a terminal KvHandoff
+    # state frame. Payloads are opaque KVP1 bytes (engine/kv_cache.py).
+    "KvHandoffHeader": {
+        1: ("handoff_id", "string", "one"),
+        2: ("request_id", "string", "one"),
+        3: ("wire_quant", "string", "one"),
+    },
+    "KvChunk": {
+        1: ("handoff_id", "string", "one"),
+        2: ("index", "uint32", "one"),
+        3: ("total", "uint32", "one"),
+        4: ("page_start", "uint32", "one"),
+        5: ("page_count", "uint32", "one"),
+        6: ("crc32", "uint32", "one"),
+        7: ("payload", "bytes", "one"),
+    },
     # Disaggregated prefill/decode serving (serving/disagg.py): a live
     # sequence lifted off a prefill engine for cross-process KV transfer.
     # ``kv`` / ``draft_kv`` carry the serialize_kv page payloads opaque;
@@ -360,6 +377,13 @@ def _encode_token_event(obj: Dict[str, Any]) -> bytes:
 # -- decode -----------------------------------------------------------------
 
 
+def _check_len(data: bytes, pos: int, length: int) -> None:
+    # slicing past the buffer would silently shorten the field (a
+    # truncated frame decoding to a plausible-but-wrong payload)
+    if pos + length > len(data):
+        raise ValueError("truncated length-delimited field")
+
+
 def _skip(wire: int, data: bytes, pos: int) -> int:
     if wire == _VARINT:
         _, pos = _dec_varint(data, pos)
@@ -370,6 +394,7 @@ def _skip(wire: int, data: bytes, pos: int) -> int:
         return pos + 4
     if wire == _LEN:
         length, pos = _dec_varint(data, pos)
+        _check_len(data, pos, length)
         return pos + length
     raise ValueError(f"unsupported wire type {wire}")
 
@@ -379,11 +404,13 @@ def _dec_scalar(ftype: str, wire: int, data: bytes, pos: int):
         if wire != _LEN:
             raise ValueError("string field must be length-delimited")
         length, pos = _dec_varint(data, pos)
+        _check_len(data, pos, length)
         return data[pos:pos + length].decode("utf-8"), pos + length
     if ftype == "bytes":
         if wire != _LEN:
             raise ValueError("bytes field must be length-delimited")
         length, pos = _dec_varint(data, pos)
+        _check_len(data, pos, length)
         return bytes(data[pos:pos + length]), pos + length
     if ftype in ("uint32", "uint64", "int64"):
         v, pos = _dec_varint(data, pos)
@@ -429,6 +456,7 @@ def decode(msg: str, data: bytes) -> Dict[str, Any]:
             if wire != _LEN:
                 raise ValueError(f"message field {name} wire type {wire}")
             length, pos = _dec_varint(data, pos)
+            _check_len(data, pos, length)
             sub = decode(ftype[4:], data[pos:pos + length])
             pos += length
             if card == "rep":
@@ -477,6 +505,7 @@ def _decode_token_event(data: bytes) -> Dict[str, Any]:
             continue
         name, ftype, _ = entry
         length, pos = _dec_varint(data, pos)
+        _check_len(data, pos, length)
         obj[name] = decode(ftype[4:], data[pos:pos + length])
         pos += length
     if "token" in obj:

@@ -120,6 +120,15 @@ class EngineRunner:
         # job would leak in DisaggController._migrating and wedge every
         # future drain on pending_count()
         self._pending_resumes: Dict[RequestId, Callable] = {}
+        # streamed handoff exports in flight (engine HandoffExportSession
+        # + the request + the controller stream job), advanced by
+        # _pump_export_jobs between steps; owned by the runner thread
+        self._export_jobs: Dict[RequestId, list] = {}
+        # phased-import state on a DECODE runner: open sessions awaiting
+        # their commit (request_id -> (KvImportSession, engine)), plus
+        # un-run open callbacks for crash-time resolution
+        self._import_sessions: Dict[RequestId, tuple] = {}
+        self._pending_opens: Dict[str, Callable] = {}
         self._pending_embeds: Dict[int, Callable] = {}
         self._embed_seq = 0
         # incremental embeddings jobs, advanced one device batch per
@@ -172,6 +181,7 @@ class EngineRunner:
         with self._inbox_lock:
             self._inbox.clear()
         self._inflight.clear()
+        self._export_jobs.clear()
         self.start(wait_ready=wait_ready, timeout=timeout)
 
     # -- submission (any thread) -------------------------------------------
@@ -262,15 +272,135 @@ class EngineRunner:
 
         self._post(_do)
 
+    def submit_import_open(self, request_id: RequestId, prefix_pages: int,
+                           chunks, on_done: Callable[[bool, Optional[str]],
+                                                     None]) -> None:
+        """Phase 1 of a streamed handoff on the TARGET runner: open an
+        incremental import session, reserve the prefix pages, and absorb
+        the prefix chunks — all while the source sequence is still
+        decoding in place. ``on_done(ok, err)`` fires exactly once (from
+        the runner thread, or here if the engine is down); ok=True means
+        the target is ready for the switchover commit."""
+        token = f"open:{request_id}"
+        self._pending_opens[token] = on_done
+        if not self._healthy:
+            cb = self._pending_opens.pop(token, None)
+            if cb is not None:
+                cb(False, self._last_error or "engine unavailable")
+            return
+
+        def _do() -> None:
+            cb = self._pending_opens.pop(token, None)
+            if cb is None:
+                return  # resolved by _fail_all
+            engine = self._engine
+            session = None
+            try:
+                session = engine.import_stream_open(request_id, prefix_pages)
+                engine.import_stream_add(session, chunks)
+            except Exception as e:  # noqa: BLE001 — import fault domain
+                if session is not None:
+                    # the open reserved pages; a chunk-validation failure
+                    # (crc, shape, duplicate) must hand them back or the
+                    # decode engine bleeds capacity on every bad stream
+                    try:
+                        engine.import_stream_abort(session)
+                    except Exception as abort_exc:  # noqa: BLE001
+                        self._absorbed("import_abort", abort_exc)
+                cb(False, str(e))
+                return
+            # bind the session to ITS engine: a hot-swap between open
+            # and commit must not scatter into the new model's pool
+            self._import_sessions[request_id] = (session, engine)
+            cb(True, None)
+
+        self._post(_do)
+
+    def submit_import_commit(self, exp, req: ServerRequest,
+                             on_done: Callable[[bool, Optional[str]],
+                                               None]) -> None:
+        """Phase 2: absorb the tail delta, validate, publish, and seat —
+        the part of the import that sits inside the migrated sequence's
+        stall window. Same registration/crash-safety contract as
+        submit_resume (on_done exactly once; ok=False hands the request
+        back to the controller's fallback)."""
+        self._pending_resumes[req.request_id] = on_done
+        self._inflight[req.request_id] = req
+        if not self._healthy:
+            self._inflight.pop(req.request_id, None)
+            cb = self._pending_resumes.pop(req.request_id, None)
+            self._drop_import_session(req.request_id)
+            if cb is not None:
+                cb(False, self._last_error or "engine unavailable")
+            return
+
+        def _do() -> None:
+            cb = self._pending_resumes.pop(req.request_id, None)
+            if cb is None:
+                return  # already resolved by _fail_all (crash/shutdown)
+            entry = self._import_sessions.pop(req.request_id, None)
+            if req.request_id not in self._inflight:
+                # aborted between registration and commit
+                if entry is not None:
+                    entry[1].import_stream_abort(entry[0])
+                cb(True, "aborted")
+                return
+            if entry is None:
+                self._inflight.pop(req.request_id, None)
+                cb(False, "no open import session (engine restarted?)")
+                return
+            session, engine = entry
+            if engine is not self._engine:
+                # hot-swapped since open: the reserved pages belong to
+                # the OLD pool; abort there and reject the commit
+                engine.import_stream_abort(session)
+                self._inflight.pop(req.request_id, None)
+                cb(False, "engine swapped mid-import")
+                return
+            try:
+                engine.import_stream_commit(session, exp)
+            except Exception as e:  # noqa: BLE001 — import fault domain
+                self._inflight.pop(req.request_id, None)
+                cb(False, str(e))
+                return
+            cb(True, None)
+
+        self._post(_do)
+
+    def submit_import_abort(self, request_id: RequestId) -> None:
+        """Drop an opened-but-uncommitted import (source cancelled the
+        stream / client disconnect): release the reserved pages."""
+        self._post(lambda: self._drop_import_session(request_id))
+
+    def _drop_import_session(self, request_id: RequestId) -> None:
+        entry = self._import_sessions.pop(request_id, None)
+        if entry is not None:
+            try:
+                entry[1].import_stream_abort(entry[0])
+            except Exception as e:  # noqa: BLE001 — cleanup isolation
+                self._absorbed("import_abort", e)
+
     def _drain_handoffs(self) -> bool:
         """Export finished prefills parked by the engine and queue their
         migration (prefill-role runners only). Runs on the runner thread
-        between steps; returns True if it moved anything."""
+        between steps; returns True if it moved anything.
+
+        With ``disagg.stream`` on (the default) each export runs as a
+        STREAMED job: the sequence resumes decoding in place while its
+        immutable prefix pages serialize (engine.export_handoff_begin),
+        and one runner-loop iteration later the switchover drains the
+        pipeline, serializes only the tail delta, and enqueues the
+        migration — the request's decode pause is O(tail), not
+        O(seq_len). Draft-model engines and too-short completions take
+        the monolithic path (engine.export_handoff)."""
         if self._disagg is None or self._engine is None:
             return False
+        worked = self._pump_export_jobs()
         ids = self._engine.handoff_ready_ids()
         if not ids:
-            return False
+            return worked
+        settings = self._disagg.settings
+        stream = settings.stream and self._engine.draft_state is None
         for rid in ids:
             req = self._inflight.get(rid)
             if req is None:
@@ -278,7 +408,24 @@ class EngineRunner:
                 self._engine.abort(rid)
                 continue
             try:
-                exp = self._engine.export_handoff(rid)
+                if stream:
+                    session = self._engine.export_handoff_begin(
+                        rid, chunk_pages=settings.chunk_pages,
+                        wire_quant=settings.wire_quant,
+                    )
+                    if session is not None:
+                        entry = [session, req, None]
+                        self._export_jobs[rid] = entry
+                        # serialize + open the target NOW (the pulls
+                        # overlap the in-flight decode pipeline) so the
+                        # overlap window stays a couple of blocks wide
+                        self._advance_export_job(rid, entry)
+                        self._advance_export_job(rid, entry)
+                        continue
+                    # not worth streaming (tiny prefix / short budget)
+                stalled_at = time.monotonic()
+                exp = self._engine.export_handoff(
+                    rid, wire_quant=settings.wire_quant)
             except Exception as e:  # noqa: BLE001 — per-request isolation
                 # the engine may still hold the sequence (and its pages);
                 # abort releases them and clears has_work, or the runner
@@ -294,9 +441,91 @@ class EngineRunner:
             if exp is None:
                 continue
             exp.source_engine = self.engine_id
+            exp.stalled_at = stalled_at
             self._inflight.pop(rid, None)
             self._disagg.enqueue(exp, req, self)
         return True
+
+    def _pump_export_jobs(self) -> bool:
+        """Advance streamed exports one stage per runner-loop iteration
+        (the sequence decodes a block between stages — that is the
+        overlap window): serialize the prefix, open the target through
+        the controller (phase 1), poll until the target is ready, then
+        switch over — export only the tail delta and commit (phase 2).
+        Any failure before the switchover costs nothing: the sequence
+        just keeps decoding in place."""
+        if not self._export_jobs:
+            return False
+        for rid, entry in list(self._export_jobs.items()):
+            self._advance_export_job(rid, entry)
+        return True
+
+    def _advance_export_job(self, rid, entry) -> None:
+        """One stage of one streamed export; exceptions are contained to
+        the request (per-request isolation)."""
+        session, req, job = entry
+        try:
+            if session.dead:
+                self._drop_export_job(rid, job, record=False)
+                return
+            if not session.prefix_done:
+                self._engine.export_handoff_pump(session)
+                return  # target opens while the next block decodes
+            if job is None:
+                job = self._disagg.open_stream(
+                    rid, session.chunks, len(session.prefix_pages),
+                    session.wire_quant, req, self,
+                )
+                if job is None:  # controller not accepting
+                    self._cancel_export(rid, session, None, record=False)
+                    return
+                entry[2] = job
+                return
+            if job.status == "opening":
+                if time.monotonic() > job.deadline:
+                    self._cancel_export(rid, session, job, record=True)
+                return
+            if job.status in ("failed", "cancelled"):
+                self._cancel_export(rid, session, job,
+                                    record=job.status == "failed")
+                return
+            # target ready -> switchover
+            exp, outputs = self._engine.export_handoff_finish(session)
+            self._dispatch(outputs)
+            self._export_jobs.pop(rid, None)
+            if exp is None or rid not in self._inflight:
+                # finished/aborted/preempted in place during the
+                # overlap: no migration, nothing to fall back from
+                logger.debug(
+                    "%s: streamed export of %s cancelled "
+                    "(sequence resolved in place)", self.engine_id, rid,
+                )
+                self._disagg.cancel_stream(job, record=False)
+                return
+            exp.source_engine = self.engine_id
+            self._inflight.pop(rid, None)
+            self._disagg.commit_stream(job, exp)
+        except Exception as e:  # noqa: BLE001 — per-request isolation
+            self._drop_export_job(rid, job, record=False)
+            self._engine.abort(rid)
+            self._inflight.pop(rid, None)
+            try:
+                req.sink.on_error(f"KV export failed: {e}",
+                                  "handoff_failed")
+            except Exception as sink_exc:  # noqa: BLE001
+                self._absorbed("sink_error", sink_exc)
+
+    def _cancel_export(self, rid, session, job, record: bool) -> None:
+        """Abandon a streamed export BEFORE the switchover: the sequence
+        keeps decoding in place (that is the whole fallback), the
+        target's reserved pages are released via the controller."""
+        self._engine.export_handoff_cancel(session)
+        self._drop_export_job(rid, job, record=record)
+
+    def _drop_export_job(self, rid, job, record: bool) -> None:
+        self._export_jobs.pop(rid, None)
+        if job is not None and self._disagg is not None:
+            self._disagg.cancel_stream(job, record=record)
 
     def evict_cache(self, target_frac: float) -> None:
         """Evict cached (refcount-0) prefix pages until used/total <=
@@ -700,6 +929,12 @@ class EngineRunner:
         }
 
     def _fail_all(self, message: str) -> None:
+        # streamed exports die with the engine: cancel their stream jobs
+        # so any target-side reserved pages are released (the requests
+        # themselves are sink-failed below with the rest of _inflight)
+        for rid, entry in list(self._export_jobs.items()):
+            self._drop_export_job(rid, entry[2], record=False)
+        self._export_jobs.clear()
         # resolve un-run resume imports FIRST, dropping them from
         # _inflight so they are not also sink-failed below: on_done(False)
         # hands the request back to the DisaggController, whose in-place
@@ -714,6 +949,20 @@ class EngineRunner:
                 cb(False, message)
             except Exception as e:  # noqa: BLE001 — callback isolation
                 self._absorbed("resume_callback", e)
+        # phased-import state dies with the engine: resolve un-run open
+        # callbacks (the controller's stream job falls back to in-place
+        # decode on the source) and drop reserved pages — the pool is
+        # gone with the engine anyway, but the allocator bookkeeping
+        # must not leak across a restart()
+        for token in list(self._pending_opens):
+            cb = self._pending_opens.pop(token, None)
+            if cb is not None:
+                try:
+                    cb(False, message)
+                except Exception as e:  # noqa: BLE001 — callback isolation
+                    self._absorbed("open_callback", e)
+        for rid in list(self._import_sessions):
+            self._drop_import_session(rid)
         self._fail_all_of(list(self._inflight.values()), message)
         self._inflight.clear()
         for token in list(self._pending_embeds):

@@ -36,12 +36,14 @@ from distributed_inference_server_tpu.engine.engine import (
     SequenceExport,
 )
 from distributed_inference_server_tpu.engine.kv_cache import (
+    KvImportSession,
     PageAllocator,
     PagedCacheConfig,
     PagedKVState,
     deserialize_into_allocator,
     deserialize_kv,
     serialize_kv,
+    serialize_kv_chunks,
 )
 from distributed_inference_server_tpu.models import llama
 from distributed_inference_server_tpu.models.configs import TINY
@@ -207,6 +209,351 @@ class TestKvRoundTrip:
                 state, alloc, blob, [1, 2, 3, 4], cfg.page_size
             )
         alloc.release(held)
+
+
+# ---------------------------------------------------------------------------
+# Streamed serialize: chunked round-trips + the incremental import session
+# ---------------------------------------------------------------------------
+
+
+def _chunks_with_totals(state, pages, page_size, **kw):
+    import dataclasses
+
+    chunks = list(serialize_kv_chunks(state, pages, page_size, **kw))
+    return [dataclasses.replace(c, total=len(chunks)) for c in chunks]
+
+
+class TestStreamedKv:
+    _state = TestKvRoundTrip._state
+
+    def test_serialize_roundtrip_byte_identical(self):
+        """ISSUE 4 satellite: the low-copy packing round-trips to the
+        BYTE — serialize(deserialize(blob)) == blob."""
+        cfg, state = self._state()
+        pages = [3, 7, 1]
+        blob = serialize_kv(state, pages, cfg.page_size, token_count=10)
+        fresh = PagedKVState.create(TINY, cfg, dtype=jnp.float32)
+        restored, _ = deserialize_kv(fresh, blob, pages, cfg.page_size)
+        assert serialize_kv(restored, pages, cfg.page_size, 10) == blob
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_chunked_roundtrip_any_order(self, dtype):
+        cfg, state = self._state(dtype)
+        pages = [3, 7, 1, 4, 9]
+        chunks = _chunks_with_totals(state, pages, cfg.page_size,
+                                     chunk_pages=2)
+        assert [c.page_start for c in chunks] == [0, 2, 4]
+        alloc = PageAllocator(cfg)
+        fresh = PagedKVState.create(TINY, cfg, dtype=dtype)
+        sess = KvImportSession(fresh, alloc, cfg.page_size)
+        sess.reserve(len(pages))
+        for c in reversed(chunks):  # arbitrary arrival order
+            sess.add_chunk(c)
+        tokens = list(range(1, len(pages) * cfg.page_size + 1))
+        restored, got = sess.finish(fresh, tokens)
+        src = np.concatenate(
+            [np.arange(p * cfg.page_size, (p + 1) * cfg.page_size)
+             for p in pages])
+        dst = np.concatenate(
+            [np.arange(p * cfg.page_size, (p + 1) * cfg.page_size)
+             for p in got])
+        np.testing.assert_array_equal(
+            np.asarray(restored.k[:, dst]), np.asarray(state.k[:, src]))
+        np.testing.assert_array_equal(
+            np.asarray(restored.v[:, dst]), np.asarray(state.v[:, src]))
+        # validated final chunk published the prefix
+        shared, matched = alloc.match_prefix(tokens + [999])
+        assert matched == len(tokens) and shared == got
+
+    def test_wire_quant_int8_halves_bytes_and_bounds_error(self):
+        cfg, state = self._state()
+        pages = [0, 1, 2, 3]
+        raw = serialize_kv(state, pages, cfg.page_size, 16)
+        quant = serialize_kv(state, pages, cfg.page_size, 16,
+                             wire_quant="int8")
+        assert len(raw) >= 2 * len(quant)  # >= 2x on f32 pools
+        fresh = PagedKVState.create(TINY, cfg, dtype=jnp.float32)
+        restored, _ = deserialize_kv(fresh, quant, pages, cfg.page_size)
+        slots = np.concatenate(
+            [np.arange(p * 4, (p + 1) * 4) for p in pages])
+        orig = np.asarray(state.k[:, slots])
+        got = np.asarray(restored.k[:, slots])
+        # per-vector absmax int8: error bounded by scale/2 per element
+        bound = np.abs(orig).max(-1, keepdims=True) / 127.0 * 0.51 + 1e-7
+        assert (np.abs(got - orig) <= bound).all()
+
+    def test_import_session_crc_corruption_rejected(self):
+        cfg, state = self._state()
+        chunks = _chunks_with_totals(state, [0, 1], cfg.page_size,
+                                     chunk_pages=1)
+        import dataclasses
+
+        bad = dataclasses.replace(
+            chunks[0],
+            payload=chunks[0].payload[:-1]
+            + bytes([chunks[0].payload[-1] ^ 0x55]),
+        )
+        alloc = PageAllocator(cfg)
+        sess = KvImportSession(state, alloc, cfg.page_size)
+        sess.reserve(2)
+        free_before = alloc.num_free()
+        with pytest.raises(CacheDeserializationError, match="crc"):
+            sess.add_chunk(bad)
+        sess.abort()
+        assert alloc.num_free() == free_before + 2
+
+    def test_import_session_missing_chunk_releases_everything(self):
+        cfg, state = self._state()
+        chunks = _chunks_with_totals(state, [0, 1, 2], cfg.page_size,
+                                     chunk_pages=1)
+        alloc = PageAllocator(cfg)
+        total_free = alloc.num_free()
+        sess = KvImportSession(state, alloc, cfg.page_size)
+        sess.reserve(3)
+        sess.add_chunk(chunks[0])
+        sess.add_chunk(chunks[2])  # chunk 1 never arrives
+        with pytest.raises(CacheDeserializationError, match="incomplete"):
+            sess.finish(state, list(range(12)))
+        sess.abort()
+        assert alloc.num_free() == total_free
+
+    def test_import_session_duplicate_and_overlap_rejected(self):
+        cfg, state = self._state()
+        chunks = _chunks_with_totals(state, [0, 1], cfg.page_size,
+                                     chunk_pages=1)
+        alloc = PageAllocator(cfg)
+        sess = KvImportSession(state, alloc, cfg.page_size)
+        sess.reserve(2)
+        sess.add_chunk(chunks[0])
+        with pytest.raises(CacheDeserializationError, match="duplicate"):
+            sess.add_chunk(chunks[0])
+        sess.abort()
+        # overlapping page ranges fail the finish-time tiling check
+        import dataclasses
+
+        sess2 = KvImportSession(state, PageAllocator(cfg), cfg.page_size)
+        sess2.reserve(2)
+        sess2.add_chunk(chunks[0])
+        sess2.add_chunk(dataclasses.replace(chunks[1], page_start=0,
+                                            index=1))
+        with pytest.raises(CacheDeserializationError, match="tile"):
+            sess2.finish(state, list(range(8)))
+        sess2.abort()
+
+    def test_one_shot_chunked_import_sequence(self, tiny_params):
+        """SequenceExport.kv_chunks through import_sequence (the in-place
+        fallback path for a streamed export)."""
+        tok = ByteTokenizer()
+        ids = tok.encode(_PROMPT)
+        sp = SamplingParams(max_tokens=8, temperature=0.0)
+        pre = _engine(tiny_params)
+        pre.add_request("r", ids, sp, prefill_only=True)
+        toks, text = [], []
+        _drain(pre, toks, text)
+        seq = pre._handoff_ready["r"]
+        chunks = _chunks_with_totals(pre.state, seq.block_table,
+                                     pre.pcfg.page_size, chunk_pages=2)
+        exp = pre.export_handoff("r")
+        import dataclasses
+
+        chunked = dataclasses.replace(exp, kv=b"", kv_chunks=chunks)
+        dec = _engine(tiny_params)
+        dec.import_sequence(chunked)
+        got_toks, got_text = list(toks), list(text)
+        _drain(dec, got_toks, got_text)
+        dec2 = _engine(tiny_params)
+        dec2.import_sequence(exp)
+        ref_toks, ref_text = list(toks), list(text)
+        _drain(dec2, ref_toks, ref_text)
+        assert got_toks == ref_toks
+
+
+# ---------------------------------------------------------------------------
+# Engine-level streamed (decode-overlapped) export
+# ---------------------------------------------------------------------------
+
+
+class TestStreamedExport:
+    def _prefill_ready(self, tiny_params, rid="r", max_tokens=96):
+        eng = _engine(tiny_params)
+        ids = ByteTokenizer().encode(_PROMPT)
+        eng.add_request(rid, ids,
+                        SamplingParams(max_tokens=max_tokens,
+                                       temperature=0.0),
+                        prefill_only=True)
+        toks, text = [], []
+        _drain(eng, toks, text)
+        return eng, ids, toks, text
+
+    def test_streamed_export_token_identical(self, tiny_params):
+        """Greedy decode across a streamed two-phase handoff (overlap
+        decode on the source, phased import on the target) is
+        token-identical to in-place decode."""
+        tok = ByteTokenizer()
+        ids = tok.encode(_PROMPT)
+        sp = SamplingParams(max_tokens=96, temperature=0.0)
+        uni = _engine(tiny_params)
+        uni.add_request("r", ids, sp)
+        ref_toks, ref_text = [], []
+        _drain(uni, ref_toks, ref_text)
+
+        src, _, got_toks, got_text = self._prefill_ready(tiny_params)
+        dst = _engine(tiny_params)
+        session = src.export_handoff_begin("r", chunk_pages=2)
+        assert session is not None
+
+        def collect(outs):
+            for o in outs:
+                assert o.error is None
+                if o.token_id is not None:
+                    got_toks.append(o.token_id)
+                got_text.append(o.text)
+
+        collect(src.step())  # overlap: the sequence decodes while the
+        src.export_handoff_pump(session)  # prefix moves
+        isess = dst.import_stream_open("r", len(session.prefix_pages))
+        dst.import_stream_add(isess, session.chunks)
+        collect(src.step())  # more overlap
+        exp, outputs = src.export_handoff_finish(session)
+        assert exp is not None
+        collect(outputs)  # overlap-window tokens surface at switchover
+        assert got_toks, "no tokens decoded during the overlap window"
+        assert not src.has_work()
+        tail = exp.kv_chunks[len(session.chunks):]
+        import dataclasses
+
+        dst.import_stream_commit(
+            isess, dataclasses.replace(exp, kv_chunks=tail))
+        _drain(dst, got_toks, got_text)
+        assert got_toks == ref_toks
+        assert "".join(got_text) == "".join(ref_text)
+
+    def test_streamed_export_int8_wire(self, tiny_params):
+        """int8 wire quantization across a streamed handoff: on the tiny
+        fixture the greedy output matches in-place decode exactly (the
+        per-vector absmax error is below every argmax margin here); the
+        general contract is bounded divergence, docs/DISAGG.md."""
+        tok = ByteTokenizer()
+        ids = tok.encode(_PROMPT)
+        sp = SamplingParams(max_tokens=96, temperature=0.0)
+        uni = _engine(tiny_params)
+        uni.add_request("r", ids, sp)
+        ref_toks, ref_text = [], []
+        _drain(uni, ref_toks, ref_text)
+
+        src, _, got_toks, got_text = self._prefill_ready(tiny_params)
+        dst = _engine(tiny_params)
+        session = src.export_handoff_begin("r", chunk_pages=2,
+                                           wire_quant="int8")
+
+        def collect(outs):
+            for o in outs:
+                assert o.error is None
+                if o.token_id is not None:
+                    got_toks.append(o.token_id)
+                got_text.append(o.text)
+
+        collect(src.step())
+        src.export_handoff_pump(session)
+        exp, outputs = src.export_handoff_finish(session)
+        assert exp is not None and exp.wire_quant == "int8"
+        collect(outputs)
+        # >= 2x byte cut vs the f32 raw encoding of the same pages
+        pages_covered = sum(c.page_count for c in exp.kv_chunks)
+        raw_bytes = (TINY.num_layers * pages_covered * src.pcfg.page_size
+                     * TINY.num_kv_heads * TINY.head_dim * 4 * 2)
+        assert exp.kv_bytes() * 2 <= raw_bytes
+        dst.import_sequence(exp)  # one-shot form exercises dequant too
+        _drain(dst, got_toks, got_text)
+        assert len(got_toks) == len(ref_toks)
+        assert got_toks == ref_toks  # holds at tiny-fixture scale
+
+    def test_streamed_commit_with_empty_tail(self, tiny_params):
+        """Regression: a page-aligned sequence that decodes NOTHING
+        during the overlap window commits with zero tail chunks — and
+        phase-1 chunks legitimately carry total=0 (the patched totals
+        only exist in the source-side export). Completeness must come
+        from page coverage, or such migrations can never succeed."""
+        ids = list(range(1, 33))  # 32 tokens = exactly 4 full pages
+        sp = SamplingParams(max_tokens=64, temperature=0.0)
+        uni = _engine(tiny_params)
+        uni.add_request("r", ids, sp)
+        ref_toks, ref_text = [], []
+        _drain(uni, ref_toks, ref_text)
+
+        src = _engine(tiny_params)
+        src.add_request("r", ids, sp, prefill_only=True)
+        got_toks, got_text = [], []
+        _drain(src, got_toks, got_text)
+        session = src.export_handoff_begin("r", chunk_pages=2)
+        assert session is not None
+        src.export_handoff_pump(session)  # no step(): zero overlap decode
+        assert all(c.total == 0 for c in session.chunks)
+        dst = _engine(tiny_params)
+        isess = dst.import_stream_open("r", len(session.prefix_pages))
+        dst.import_stream_add(isess, session.chunks)
+        exp, outputs = src.export_handoff_finish(session)
+        assert exp is not None and not outputs
+        tail = exp.kv_chunks[len(session.chunks):]
+        assert tail == []
+        import dataclasses
+
+        dst.import_stream_commit(
+            isess, dataclasses.replace(exp, kv_chunks=tail))
+        _drain(dst, got_toks, got_text)
+        assert got_toks == ref_toks
+        assert "".join(got_text) == "".join(ref_text)
+
+    def test_streamed_export_abort_midstream_releases_everything(
+            self, tiny_params):
+        src, _, _, _ = self._prefill_ready(tiny_params)
+        free0 = src.allocator.num_free()
+        session = src.export_handoff_begin("r", chunk_pages=2)
+        assert session is not None
+        src.step()
+        assert src.abort("r")
+        src.export_handoff_pump(session)  # detects the dead sequence
+        assert session.dead
+        exp, outputs = src.export_handoff_finish(session)
+        assert exp is None
+        assert not src.has_work()
+        # every page the aborted request held is allocatable again
+        assert src.allocator.num_free() >= free0
+
+    def test_streamed_export_refuses_short_budget(self, tiny_params):
+        """A budget that would finish inside the overlap window decodes
+        in place instead (begin returns None; monolithic path applies)."""
+        eng = _engine(tiny_params)
+        ids = ByteTokenizer().encode(_PROMPT)
+        eng.add_request("r", ids,
+                        SamplingParams(max_tokens=10, temperature=0.0),
+                        prefill_only=True)
+        toks, text = [], []
+        _drain(eng, toks, text)
+        assert eng.export_handoff_begin("r") is None
+        assert eng.export_handoff("r") is not None  # monolithic still works
+
+    def test_import_commit_failure_releases_pages(self, tiny_params):
+        """A commit whose stream is incomplete aborts the session: every
+        reserved page returns, nothing is published."""
+        src, ids, _, _ = self._prefill_ready(tiny_params)
+        dst = _engine(tiny_params)
+        session = src.export_handoff_begin("r", chunk_pages=2)
+        src.step()
+        src.export_handoff_pump(session)
+        free0 = dst.allocator.num_free()
+        isess = dst.import_stream_open("r", len(session.prefix_pages))
+        dst.import_stream_add(isess, session.chunks)
+        exp, _ = src.export_handoff_finish(session)
+        assert exp is not None
+        import dataclasses
+
+        with pytest.raises(CacheDeserializationError):
+            # tail chunks withheld -> incomplete stream at commit
+            dst.import_stream_commit(
+                isess, dataclasses.replace(exp, kv_chunks=[]))
+        assert dst.allocator.num_free() == free0
+        assert not dst.has_work()
 
 
 # ---------------------------------------------------------------------------
@@ -542,7 +889,50 @@ class TestDisaggServing:
         text = disagg_server.metrics.prometheus_text().decode()
         assert "kv_handoff_latency_seconds" in text
         assert "kv_handoff_bytes_total" in text
+        assert "kv_handoff_stall_seconds" in text
+        assert "kv_handoff_chunks_total" in text
         assert 'engines_by_role{role="prefill"}' in text
+
+    def test_streamed_handoff_serving_token_identical(self, tiny_params):
+        """Serving-level acceptance for the STREAMED (two-phase) path: a
+        completion long enough to stream migrates with chunks > 0 and is
+        token-identical to a unified engine; the stall metric is
+        populated."""
+        uni = InferenceServer(
+            lambda: _engine(tiny_params), ByteTokenizer(), "tiny",
+            num_engines=1, auto_restart=False,
+        )
+        uni.start()
+        try:
+            ref = _run_request(uni, "s-ref", max_tokens=96)
+        finally:
+            uni.shutdown(drain_timeout_s=5.0)
+        assert not ref.errors, ref.errors
+
+        srv = InferenceServer(
+            lambda: _engine(tiny_params), ByteTokenizer(), "tiny",
+            num_engines=2, auto_restart=False,
+            engine_roles=["prefill", "decode"],
+            disagg_settings=DisaggSettings(handoff_timeout_s=30.0,
+                                           channel="protowire"),
+        )
+        srv.start()
+        try:
+            got = _run_request(srv, "s-stream", max_tokens=96)
+            snap = srv.metrics.snapshot(
+                tuple(srv.scheduler.statuses())).to_dict()
+            statuses = {s.engine_id: s for s in srv.scheduler.statuses()}
+        finally:
+            srv.shutdown(drain_timeout_s=5.0)
+        assert not got.errors, got.errors
+        assert got.toks == ref.toks
+        assert got.text == ref.text
+        d = snap["disagg"]
+        assert d["handoffs"].get("ok", 0) >= 1, d
+        assert d["handoff_chunks"] >= 1, d
+        assert d["handoff_stall_avg_ms"] > 0, d
+        # the decode replica finished the request
+        assert statuses["engine-1"].total_processed >= 1
 
     def test_protowire_channel_end_to_end(self, tiny_params, reference_run):
         srv = InferenceServer(

@@ -343,6 +343,31 @@ def test_dl005_detects_drift():
     assert any("JSON string drift" in m for m in msgs)
 
 
+def test_dl005_flags_kvchunk_field_drift():
+    """ISSUE 4 satellite: a drift in the streamed-handoff KvChunk table
+    (type change, renumbered field, dropped crc) is caught against the
+    real inference.proto — the varint would still decode, into the wrong
+    thing, silently corrupting every streamed migration."""
+    schema = protodef.parse_file(
+        REPO_ROOT / PKG / "serving" / "inference.proto")
+    messages, enums = rules_mod.load_protowire_tables(REPO_ROOT)
+    broken = {k: dict(v) for k, v in messages.items()}
+    broken["KvChunk"][6] = ("crc32", "int64", "one")  # type drift
+    msgs = [m for a, m in compare_wire_schema(schema, broken, enums)
+            if a == "KvChunk"]
+    assert any("crc32" in m and "type drift" in m for m in msgs), msgs
+    broken = {k: dict(v) for k, v in messages.items()}
+    del broken["KvChunk"][7]  # payload dropped from the codec
+    msgs = [m for a, m in compare_wire_schema(schema, broken, enums)
+            if a == "KvChunk"]
+    assert any("payload" in m for m in msgs), msgs
+    broken = {k: dict(v) for k, v in messages.items()}
+    broken["KvHandoffHeader"][4] = ("chunk_pages", "uint32", "one")
+    msgs = [m for a, m in compare_wire_schema(schema, broken, enums)
+            if a == "KvHandoffHeader"]
+    assert any("not in inference.proto" in m for m in msgs), msgs
+
+
 def test_dl005_real_schema_agrees():
     """The repo's actual proto and codec tables (also enforced by the
     project-scope rule inside the full run below; asserted directly here
@@ -446,6 +471,48 @@ def test_dl007_flags_host_sync_in_hot_function():
         "        y = self.val.item()\n"
     ))
     assert len(out) == 2
+
+
+def test_dl007_no_false_positive_on_double_buffered_export():
+    """ISSUE 4 satellite: the streamed-handoff export machinery
+    (export_handoff_pump / _finish and kv_cache's double-buffered pull
+    loop) lives OUTSIDE the per-token hot set — np.asarray pulls and
+    copy_to_host_async dispatches there are the intended design, and
+    DL007 must not flag them. A genuinely hot-loop sync still needs an
+    inline justification to pass (suppression round-trip below)."""
+    assert not check("DL007", f"{PKG}/engine/engine.py", (
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "class LLMEngine:\n"
+        "    def export_handoff_pump(self, session):\n"
+        "        pending = self._pull(session.groups[0])\n"
+        "        for n, group in enumerate(session.groups):\n"
+        "            nxt = None\n"
+        "            if n + 1 < len(session.groups):\n"
+        "                nxt = self._pull(session.groups[n + 1])\n"
+        "            hosts = [np.asarray(a) for a in pending]\n"
+        "            session.chunks.append(self._encode(hosts))\n"
+        "            pending = nxt\n"
+        "    def _pull(self, group):\n"
+        "        arrs = (self.state.k[:, jnp.asarray(group)],)\n"
+        "        for a in arrs:\n"
+        "            a.copy_to_host_async()\n"
+        "        return arrs\n"
+    ))
+    # the same sync INSIDE a hot function is flagged, and an inline
+    # justification suppresses it
+    flagged = check("DL007", f"{PKG}/engine/engine.py", (
+        "class LLMEngine:\n"
+        "    def _process_block(self, outputs):\n"
+        "        x = self.arr.item()\n"
+    ))
+    assert len(flagged) == 1
+    assert not check("DL007", f"{PKG}/engine/engine.py", (
+        "class LLMEngine:\n"
+        "    def _process_block(self, outputs):\n"
+        "        x = self.arr.item()  "
+        "# distlint: ignore[DL007] — block boundary sync\n"
+    ))
 
 
 def test_dl007_clean():

@@ -10,14 +10,23 @@ from __future__ import annotations
 
 import random
 
+import pytest
+
 from distributed_inference_server_tpu.engine.engine import (
     SamplingParams,
     SequenceExport,
 )
+from distributed_inference_server_tpu.engine.kv_cache import (
+    KvChunk,
+    chunk_crc,
+)
 from distributed_inference_server_tpu.serving import protowire
 from distributed_inference_server_tpu.serving.disagg import (
+    HandoffError,
     export_from_wire,
     export_to_wire,
+    stream_from_frames,
+    stream_to_frames,
 )
 from tools.lint import proto as protodef
 from tools.lint.rules import compare_wire_schema
@@ -105,6 +114,120 @@ def test_kvhandoff_unknown_fields_skipped():
     unknown = protowire._key(100, 2) + bytes([3, 1, 2, 3])
     d = protowire.decode("KvHandoff", unknown + base)
     assert d == protowire.decode("KvHandoff", base)
+
+
+def _rand_chunk(rng: random.Random, index: int, total: int,
+                page_start: int) -> KvChunk:
+    payload = rng.randbytes(rng.randrange(1, 512))
+    return KvChunk(
+        index=index, total=total, page_start=page_start,
+        page_count=rng.randrange(1, 9), payload=payload,
+        crc32=chunk_crc(payload),
+    )
+
+
+def test_kvchunk_and_header_roundtrip_fuzz():
+    """Seeded random KvChunk / KvHandoffHeader frames survive the wire
+    field-for-field (crc32 is a full-range uint32 varint)."""
+    rng = random.Random(0xC4C4)
+    for i in range(200):
+        c = _rand_chunk(rng, rng.randrange(0, 2 ** 20),
+                        rng.randrange(0, 2 ** 20), rng.randrange(0, 2 ** 16))
+        d = protowire.decode("KvChunk", protowire.encode("KvChunk", {
+            "handoff_id": f"h{i}", "index": c.index, "total": c.total,
+            "page_start": c.page_start, "page_count": c.page_count,
+            "crc32": c.crc32, "payload": c.payload,
+        }))
+        assert (d["index"], d["total"], d["page_start"], d["page_count"],
+                d["crc32"], d["payload"]) == (
+            c.index, c.total, c.page_start, c.page_count, c.crc32,
+            c.payload), i
+        h = {"handoff_id": f"h{i}", "request_id": _rand_text(rng, 16),
+             "wire_quant": rng.choice(["none", "int8"])}
+        got = protowire.decode("KvHandoffHeader",
+                               protowire.encode("KvHandoffHeader", h))
+        assert got == h, i
+
+
+def _streamed_export(rng: random.Random) -> SequenceExport:
+    exp = _rand_export(rng)
+    total = rng.randrange(1, 6)
+    page_start = 0
+    chunks = []
+    for i in range(total):
+        c = _rand_chunk(rng, i, total, page_start)
+        page_start += c.page_count
+        chunks.append(c)
+    exp.kv_chunks = chunks
+    exp.kv = b""
+    exp.wire_quant = rng.choice(["none", "int8"])
+    return exp
+
+
+def test_streamed_frames_roundtrip_and_reorder():
+    """The header/chunks/state frame sequence reassembles the export
+    exactly — including when chunk frames arrive OUT OF ORDER (a real
+    transport may reorder per-chunk streams)."""
+    rng = random.Random(0x57EA)
+    for i in range(50):
+        exp = _streamed_export(rng)
+        frames = list(stream_to_frames(exp))
+        # shuffle the chunk frames only (header first, state anywhere after)
+        chunk_frames = frames[1:-1]
+        rng.shuffle(chunk_frames)
+        got = stream_from_frames(
+            [frames[0]] + chunk_frames + [frames[-1]])
+        assert [c.index for c in got.kv_chunks] == sorted(
+            c.index for c in exp.kv_chunks), i
+        assert {c.index: (c.payload, c.crc32, c.page_start, c.page_count,
+                          c.total) for c in got.kv_chunks} == {
+            c.index: (c.payload, c.crc32, c.page_start, c.page_count,
+                      c.total) for c in exp.kv_chunks}, i
+        assert got.wire_quant == exp.wire_quant
+        assert got.token_ids == exp.token_ids
+
+
+def test_streamed_frames_truncation_rejected():
+    """A stream missing its header or terminal state frame is rejected
+    (never silently reassembled), and a truncated chunk frame fails to
+    decode."""
+    exp = _streamed_export(random.Random(3))
+    frames = list(stream_to_frames(exp))
+    with pytest.raises(HandoffError):
+        stream_from_frames(frames[1:])  # header dropped
+    with pytest.raises(HandoffError):
+        stream_from_frames(frames[:-1])  # state dropped
+    kind, data = frames[1]  # a KvChunk frame cut mid-payload
+    with pytest.raises(ValueError):
+        protowire.decode("KvChunk", data[: len(data) // 2])
+
+
+def test_kvchunk_crc_corruption_detected():
+    """A flipped payload byte survives protowire (payload is opaque
+    bytes) but fails the crc check the import session applies."""
+    c = _rand_chunk(random.Random(9), 0, 1, 0)
+    wire = protowire.encode("KvChunk", {
+        "handoff_id": "h", "index": c.index, "total": c.total,
+        "page_start": c.page_start, "page_count": c.page_count,
+        "crc32": c.crc32, "payload": c.payload[:-1]
+        + bytes([c.payload[-1] ^ 0xFF]),
+    })
+    d = protowire.decode("KvChunk", wire)
+    assert chunk_crc(d["payload"]) != d["crc32"]
+
+
+def test_kvchunk_unknown_fields_skipped():
+    """Forward compatibility for the chunk frame: unknown fields are
+    skipped, known fields decode unchanged."""
+    c = _rand_chunk(random.Random(11), 2, 4, 8)
+    base = protowire.encode("KvChunk", {
+        "handoff_id": "h", "index": c.index, "total": c.total,
+        "page_start": c.page_start, "page_count": c.page_count,
+        "crc32": c.crc32, "payload": c.payload,
+    })
+    unknown = protowire._key(99, 2) + bytes([4, 9, 9, 9, 9])
+    assert protowire.decode("KvChunk", unknown + base) == \
+        protowire.decode("KvChunk", base)
 
 
 def test_total_processed_uint64_roundtrip():

@@ -1,6 +1,7 @@
 """Disaggregated-serving smoke: boot a 1-prefill + 1-decode two-engine
 server on the CPU backend, stream a completion over real HTTP/SSE, and
-assert the handoff happened (ISSUE 1 CI satellite).
+assert the handoff happened (ISSUE 1 CI satellite; streamed-handoff and
+wire-quant knobs from ISSUE 4).
 
 Exercises the full production path — HTTP → handler → dispatcher →
 prefill engine → KVTransferChannel → decode engine → SSE — in one
@@ -8,6 +9,14 @@ process, in seconds, with the tiny-llama fixture. Exit 0 = healthy.
 
     JAX_PLATFORMS=cpu python tools/disagg_smoke.py
     JAX_PLATFORMS=cpu python tools/disagg_smoke.py --channel protowire
+    JAX_PLATFORMS=cpu python tools/disagg_smoke.py --channel protowire \
+        --wire-quant int8          # streamed chunks, int8 on the wire
+    JAX_PLATFORMS=cpu python tools/disagg_smoke.py --no-stream  # monolithic
+
+``--bench`` runs the BENCH_NOTES r06/r07 scenario instead: a long and a
+short prompt submitted together against unified-2x and 1-prefill +
+1-decode topologies, reporting per-request TTFT / mean TBT / max TBT
+from SSE frame arrival times plus the server's handoff stall metric.
 """
 
 from __future__ import annotations
@@ -23,7 +32,8 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def build_server(channel: str):
+def build_server(channel: str, wire_quant: str = "none", stream: bool = True,
+                 roles=("prefill", "decode"), warmup: bool = False):
     import jax
     import jax.numpy as jnp
 
@@ -41,26 +51,28 @@ def build_server(channel: str):
     from distributed_inference_server_tpu.serving.server import InferenceServer
 
     params = llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
-    paged = PagedCacheConfig(num_pages=192, page_size=8, max_pages_per_seq=32)
+    paged = PagedCacheConfig(num_pages=256, page_size=8, max_pages_per_seq=64)
 
     def factory():
         return LLMEngine(
             params, TINY, ByteTokenizer(),
-            EngineConfig(max_batch=4, prefill_buckets=(16, 64), paged=paged),
+            EngineConfig(max_batch=4, prefill_buckets=(16, 64), paged=paged,
+                         warmup_compile=warmup),
             dtype=jnp.float32,
         )
 
     return InferenceServer(
         factory, ByteTokenizer(), model_name="tiny-disagg",
         num_engines=2, auto_restart=False,
-        engine_roles=["prefill", "decode"],
+        engine_roles=list(roles),
         disagg_settings=DisaggSettings(channel=channel,
-                                       handoff_timeout_s=30.0),
+                                       handoff_timeout_s=30.0,
+                                       stream=stream,
+                                       wire_quant=wire_quant),
     )
 
 
-async def drive(server, max_tokens: int) -> int:
-    import aiohttp
+async def _serve(server):
     from aiohttp import web
 
     runner = web.AppRunner(server.build_app())
@@ -68,20 +80,38 @@ async def drive(server, max_tokens: int) -> int:
     site = web.TCPSite(runner, "127.0.0.1", 0)
     await site.start()
     port = site._server.sockets[0].getsockname()[1]
-    base = f"http://127.0.0.1:{port}"
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def _stream_request(session, base, prompt, max_tokens):
+    """POST /generate with SSE streaming; returns (events, frame arrival
+    times relative to submit)."""
+    t0 = time.monotonic()
+    stamps, raw = [], b""
+    async with session.post(
+        f"{base}/generate",
+        json={"prompt": prompt, "stream": True,
+              "max_tokens": max_tokens, "temperature": 0.0},
+    ) as resp:
+        assert resp.status == 200, await resp.text()
+        async for chunk in resp.content.iter_any():
+            raw += chunk
+            stamps.append(time.monotonic() - t0)
+    frames = [f for f in raw.decode().split("\n\n") if f]
+    assert frames[-1] == "data: [DONE]", frames[-1]
+    events = [json.loads(f[len("data: "):]) for f in frames[:-1]]
+    return events, stamps
+
+
+async def drive(server, max_tokens: int) -> int:
+    import aiohttp
+
+    runner, base = await _serve(server)
     try:
         async with aiohttp.ClientSession() as session:
             t0 = time.monotonic()
-            async with session.post(
-                f"{base}/generate",
-                json={"prompt": "disaggregate me", "stream": True,
-                      "max_tokens": max_tokens, "temperature": 0.0},
-            ) as resp:
-                assert resp.status == 200, await resp.text()
-                raw = (await resp.read()).decode()
-            frames = [f for f in raw.split("\n\n") if f]
-            assert frames[-1] == "data: [DONE]", frames[-1]
-            events = [json.loads(f[len("data: "):]) for f in frames[:-1]]
+            events, _ = await _stream_request(
+                session, base, "disaggregate me, streamingly", max_tokens)
             tokens = [e for e in events if e["type"] == "token"]
             done = [e for e in events if e["type"] == "done"]
             assert tokens, "no tokens streamed"
@@ -99,20 +129,114 @@ async def drive(server, max_tokens: int) -> int:
             f"OK: {len(tokens)} tokens streamed in "
             f"{time.monotonic() - t0:.2f}s; roles {roles}; "
             f"handoffs {disagg['handoffs']}; "
-            f"{disagg['handoff_bytes']} KV bytes moved"
+            f"{disagg['handoff_bytes']} KV bytes moved in "
+            f"{disagg.get('handoff_chunks', 0)} chunks; "
+            f"stall avg {disagg.get('handoff_stall_avg_ms', 0)} ms"
         )
         return 0
     finally:
         await runner.cleanup()
 
 
+def _tbt_stats(stamps):
+    """(ttft, mean tbt, max tbt) from SSE frame arrival times."""
+    if not stamps:
+        return 0.0, 0.0, 0.0
+    gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+    return (stamps[0], sum(gaps) / len(gaps) if gaps else 0.0,
+            max(gaps) if gaps else 0.0)
+
+
+async def bench_scenario(channel: str, wire_quant: str, stream: bool,
+                         long_tokens: int, max_tokens: int) -> int:
+    """The r06 scenario: a long and a short prompt submitted together,
+    per-request TTFT / mean TBT / max TBT from frame arrivals, run on
+    unified-2x then 1-prefill + 1-decode."""
+    import aiohttp
+
+    long_prompt = "x" * long_tokens
+    short_prompt = "short prompt"
+    rows = []
+    for label, roles in (("unified-2x", ("unified", "unified")),
+                         ("disagg-1p1d", ("prefill", "decode"))):
+        server = build_server(channel, wire_quant, stream, roles=roles,
+                              warmup=True)
+        server.start()
+        try:
+            runner, base = await _serve(server)
+            try:
+                async with aiohttp.ClientSession() as session:
+                    # warm both topologies (compile + gather buckets +
+                    # handoff path) before measuring
+                    await asyncio.gather(
+                        _stream_request(session, base, long_prompt,
+                                        max_tokens),
+                        _stream_request(session, base, short_prompt,
+                                        max_tokens),
+                    )
+                    async with session.get(f"{base}/server/stats") as resp:
+                        warm = (await resp.json()).get("disagg") or {}
+                    results = await asyncio.gather(
+                        _stream_request(session, base, long_prompt,
+                                        max_tokens),
+                        _stream_request(session, base, short_prompt,
+                                        max_tokens),
+                    )
+                    async with session.get(f"{base}/server/stats") as resp:
+                        stats = await resp.json()
+            finally:
+                await runner.cleanup()
+        finally:
+            server.shutdown(drain_timeout_s=5.0)
+        disagg = stats.get("disagg") or {}
+        for req, (_, stamps) in zip(("long", "short"), results):
+            ttft, mean_tbt, max_tbt = _tbt_stats(stamps)
+            rows.append((label, req, ttft, mean_tbt, max_tbt))
+        if disagg:
+            # stall over the MEASURED round only: the warm round's
+            # first handoff pays one-time XLA compiles inside its stall
+            c0 = warm.get("handoff_stall_count", 0)
+            s0 = warm.get("handoff_stall_avg_ms", 0.0) * c0
+            c1 = disagg.get("handoff_stall_count", 0)
+            s1 = disagg.get("handoff_stall_avg_ms", 0.0) * c1
+            measured = ((s1 - s0) / (c1 - c0)) if c1 > c0 else float("nan")
+            print(f"[{label}] handoffs {disagg.get('handoffs')} "
+                  f"bytes {disagg.get('handoff_bytes')} "
+                  f"chunks {disagg.get('handoff_chunks')} "
+                  f"stall avg {disagg.get('handoff_stall_avg_ms')} ms "
+                  f"(measured round: {measured:.1f} ms over "
+                  f"{c1 - c0} handoffs)")
+    print(f"\nscenario: {long_tokens}-token long prompt + short prompt, "
+          f"{max_tokens} greedy tokens each; channel={channel} "
+          f"wire_quant={wire_quant} stream={stream}")
+    print(f"{'topology':<14} {'request':<8} {'TTFT':>9} {'mean TBT':>10} "
+          f"{'max TBT':>9}")
+    for label, req, ttft, mean_tbt, max_tbt in rows:
+        print(f"{label:<14} {req:<8} {ttft * 1e3:>7.1f}ms "
+              f"{mean_tbt * 1e3:>8.2f}ms {max_tbt * 1e3:>7.1f}ms")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--channel", default="inproc",
                     choices=["inproc", "protowire"])
-    ap.add_argument("--max-tokens", type=int, default=12)
+    ap.add_argument("--wire-quant", default="none", choices=["none", "int8"],
+                    help="per-chunk wire encoding of the KV payload")
+    ap.add_argument("--no-stream", action="store_true",
+                    help="force the monolithic (stop-the-world) export")
+    ap.add_argument("--max-tokens", type=int, default=48)
+    ap.add_argument("--bench", action="store_true",
+                    help="run the unified-vs-disagg TBT scenario instead")
+    ap.add_argument("--long-tokens", type=int, default=400,
+                    help="--bench: long-prompt length in tokens")
     args = ap.parse_args()
-    server = build_server(args.channel)
+    if args.bench:
+        return asyncio.run(bench_scenario(
+            args.channel, args.wire_quant, not args.no_stream,
+            args.long_tokens, args.max_tokens))
+    server = build_server(args.channel, args.wire_quant,
+                          stream=not args.no_stream)
     server.start()
     try:
         return asyncio.run(drive(server, args.max_tokens))
