@@ -220,9 +220,227 @@ def bench_handoff() -> None:
                     })
 
 
+def bench_prefix() -> None:
+    """Tiered prefix-cache microbench (BENCH_PREFIX=1; ISSUE 5): a
+    repeated-prefix workload (one long shared system prefix + unique
+    tails, interleaved with short unique "churn" traffic that cycles the
+    HBM page pool) measured AFTER an eviction cycle — the regime where
+    the HBM-only prefix cache is worthless because the pool already
+    recycled the shared pages.
+
+    Per swept config it emits one JSON line with the probe request's
+    median TTFT and prefill-tokens-recomputed (prompt length minus the
+    pages matched in either tier):
+
+    - mode "cold": never-seen prefix (full prefill — the floor);
+    - mode "hbm_only": host_tier_bytes=0 — after churn the prefix pages
+      are gone, so this re-pays ~full prefill;
+    - mode "tiered": host tier on, swept over budget (generous: holds
+      the whole working set / tight: forces front-biased partial
+      retention) x storage quant (none | int8).
+
+    Engine-level on purpose (two tiers + the real match/reload path, no
+    HTTP jitter). Knobs: BENCH_PREFIX_REPS (5), BENCH_PREFIX_PAGES (24
+    shared-prefix pages), BENCH_PREFIX_CHURN (10 unique churn prompts
+    per rep)."""
+    import gc
+
+    # single-threaded XLA CPU: the thread pool's scheduling jitter on a
+    # small host is ±2x PER REP on identical work, drowning the
+    # tiered-vs-HBM-only TTFT deltas; one thread is slower but tight
+    # (must be set before jax initializes)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_multi_thread_eigen=false"
+        + " intra_op_parallelism_threads=1"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+    reps = int(os.environ.get("BENCH_PREFIX_REPS", "5"))
+    prefix_pages = int(os.environ.get("BENCH_PREFIX_PAGES", "24"))
+    churn_n = int(os.environ.get("BENCH_PREFIX_CHURN", "10"))
+    # ~8x TINY's prefill compute (4x layers, 2x width) with only 4x the
+    # KV bytes: on TINY itself dispatch noise is the same order as the
+    # whole prefill, so the recompute savings the tier buys would drown
+    # in jitter; at this scale compute dominates and TTFT separates
+    # cleanly while the bench stays CI-runnable on CPU
+    mcfg = TINY.with_overrides(
+        name="tiny-4l", hidden_size=128, intermediate_size=512,
+        num_layers=4, num_heads=8, num_kv_heads=4, head_dim=16,
+    )
+    ps = 8
+    churn_pages = 4
+    tail = ps  # unique tail tokens after the shared prefix
+    prompt_len = prefix_pages * ps + tail
+    # pool sized so one churn phase cycles it past the shared prefix:
+    # barely larger than the longest prompt, as a loaded server runs
+    paged = PagedCacheConfig(
+        num_pages=prefix_pages + 8,
+        page_size=ps,
+        max_pages_per_seq=prefix_pages + 4,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), mcfg,
+                               dtype=jnp.float32)
+    # page bytes in the f32 pool (k+v), for budget sweeps in page units
+    page_bytes = (mcfg.num_layers * ps * mcfg.num_kv_heads * mcfg.head_dim
+                  * 4 * 2)
+    budgets = {
+        # holds the shared prefix AND the churn heads comfortably
+        "generous": (prefix_pages + churn_pages * churn_n + 8) * page_bytes,
+        # smaller than the shared prefix itself: front-biased retention
+        # keeps the chain HEAD, so the probe still skips half the prefill
+        "tight": (prefix_pages // 2) * page_bytes,
+    }
+    rng = np.random.default_rng(7)
+    hi = min(mcfg.vocab_size, 250)
+
+    def mk(host_bytes=0, quant="none"):
+        return LLMEngine(
+            params, mcfg, ByteTokenizer(),
+            EngineConfig(max_batch=2, prefill_buckets=(64, 128, 256),
+                         paged=paged, host_tier_bytes=host_bytes,
+                         host_tier_quant=quant),
+            dtype=jnp.float32,
+        )
+
+    seq = [0]
+
+    def run(engine, ids, max_tokens=2):
+        """Submit one request, drain it, return TTFT seconds."""
+        seq[0] += 1
+        rid = f"p{seq[0]}"
+        t0 = time.perf_counter()
+        engine.add_request(rid, ids, SamplingParams(
+            max_tokens=max_tokens, temperature=0.0))
+        ttft = None
+        while engine.has_work():
+            for out in engine.step():
+                if ttft is None and out.token_id is not None:
+                    ttft = time.perf_counter() - t0
+        assert ttft is not None
+        return ttft
+
+    def compile_warm(engine):
+        """Walk every prefill bucket + decode so no measured rep pays
+        XLA compile, then drop every cache the warmers left behind."""
+        run(engine, rng.integers(1, hi, size=prompt_len).tolist())
+        run(engine, rng.integers(1, hi, size=prompt_len // 2).tolist())
+        run(engine, rng.integers(1, hi, size=churn_pages * ps).tolist())
+        engine.evict_cache(0.0, drop_host_tier=True)
+
+    def probe(engine, prefix_ids):
+        """One measured repeated-prefix request after a churn cycle (GC
+        held off so a collection pause cannot land inside the TTFT)."""
+        s0 = engine.cache_stats()
+        host0 = engine.host_tier_stats() or {"hit_pages": 0}
+        ids = prefix_ids + rng.integers(1, hi, size=tail).tolist()
+        gc.collect()
+        gc.disable()
+        try:
+            ttft = run(engine, ids)
+        finally:
+            gc.enable()
+        s1 = engine.cache_stats()
+        host1 = engine.host_tier_stats() or {"hit_pages": 0}
+        hbm_pages = s1.hits - s0.hits
+        host_pages = host1["hit_pages"] - host0["hit_pages"]
+        reloads = engine.drain_reload_durations()
+        return {
+            "ttft_s": ttft,
+            "recompute_tokens": len(ids) - (hbm_pages + host_pages) * ps,
+            "hbm_pages": hbm_pages,
+            "host_pages": host_pages,
+            "reload_ms": round(sum(reloads) * 1e3, 3),
+        }
+
+    def churn(engine):
+        for _ in range(churn_n):
+            run(engine, rng.integers(
+                1, hi, size=churn_pages * ps - 2).tolist())
+
+    def measure(mode, host_bytes=0, quant="none", budget_name=None):
+        engine = mk(host_bytes=host_bytes, quant=quant)
+        compile_warm(engine)
+        prefix_ids = rng.integers(1, hi, size=prefix_pages * ps).tolist()
+        recs = []
+        if mode == "cold":
+            for _ in range(reps):
+                # never-repeated prefix: every probe is a full prefill
+                fresh = rng.integers(1, hi, size=prefix_pages * ps).tolist()
+                churn(engine)
+                recs.append(probe(engine, fresh))
+        else:
+            run(engine, prefix_ids
+                + rng.integers(1, hi, size=tail).tolist())  # warm
+            # one unmeasured cycle: the tier's chain protection needs a
+            # first match to mark the prefix chain as re-used traffic
+            # (steady state is what repeated-prefix serving runs in)
+            churn(engine)
+            probe(engine, prefix_ids)
+            for _ in range(reps):
+                churn(engine)  # cycle the pool: HBM prefix evicted
+                recs.append(probe(engine, prefix_ids))
+        s = engine.cache_stats()
+        host = engine.host_tier_stats()
+        _emit({
+            "metric": "prefix_probe_ttft_ms_cpu",
+            "value": round(
+                float(np.median([r["ttft_s"] for r in recs])) * 1e3, 3),
+            "unit": "ms",
+            "vs_baseline": 0.0,
+            "mode": mode,
+            **({"host_budget": budget_name,
+                "host_budget_bytes": host_bytes,
+                "host_quant": quant} if host_bytes else {}),
+            "prompt_len": prompt_len,
+            "recompute_tokens": int(np.median(
+                [r["recompute_tokens"] for r in recs])),
+            "matched_hbm_pages": int(np.median(
+                [r["hbm_pages"] for r in recs])),
+            "matched_host_pages": int(np.median(
+                [r["host_pages"] for r in recs])),
+            "reload_ms": float(np.median(
+                [r["reload_ms"] for r in recs])),
+            "evictions": s.evictions,
+            **({"host_tier_pages": host["pages"],
+                "host_tier_bytes": host["bytes"],
+                "host_offloads": host["offloads"],
+                "host_evictions": host["evictions"]}
+               if host is not None else {}),
+            "reps": reps,
+        })
+
+    measure("cold")
+    measure("hbm_only")
+    for budget_name, budget in budgets.items():
+        for quant in ("none", "int8"):
+            measure("tiered", host_bytes=budget, quant=quant,
+                    budget_name=budget_name)
+
+
 def main() -> None:
     if os.environ.get("BENCH_HANDOFF") == "1":
         bench_handoff()
+        return
+    if os.environ.get("BENCH_PREFIX") == "1":
+        bench_prefix()
         return
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
     cpu_full = os.environ.get("BENCH_CPU_FULL") == "1"

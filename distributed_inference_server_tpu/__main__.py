@@ -96,6 +96,9 @@ def main(argv=None) -> int:
         sp_impl=cfg.get("engine", "sp_impl"),
         warmup_compile=cfg.get("engine", "warmup_compile"),
         kv_quant=cfg.get("engine", "kv_quant"),
+        # tiered prefix cache (docs/CACHING.md): host-RAM demotion pool
+        host_tier_bytes=cfg.get("cache", "host_tier_bytes"),
+        host_tier_quant=cfg.get("cache", "host_tier_quant"),
     )
     tokenizer = load_tokenizer(model_dir)
 

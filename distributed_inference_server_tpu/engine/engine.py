@@ -54,6 +54,11 @@ from distributed_inference_server_tpu.core.errors import (
 from distributed_inference_server_tpu.core.models import FinishReason, Usage
 from distributed_inference_server_tpu.core.types import RequestId
 from distributed_inference_server_tpu.engine.kv_cache import (
+    _KIND_QPOOL,
+    _KIND_WIRE8,
+    _scatter_payload,
+    DIGEST_DEPTH,
+    HostTier,
     KvChunk,
     KvImportSession,
     PageAllocator,
@@ -62,8 +67,12 @@ from distributed_inference_server_tpu.engine.kv_cache import (
     QuantPool,
     deserialize_into_allocator,
     deserialize_kv,
+    gather_kv_parts,
+    iter_chain_hashes,
+    payload_kind,
     serialize_kv,
     serialize_kv_chunks,
+    start_host_copies,
 )
 from distributed_inference_server_tpu.engine.speculative import (
     PatternTrackers,
@@ -99,10 +108,21 @@ def _chosen_logprob(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     return chosen - lse
 
 
-def _make_allocator(pcfg: PagedCacheConfig, force: Optional[bool]):
+def _make_allocator(pcfg: PagedCacheConfig, force: Optional[bool],
+                    need_offload_hook: bool = False):
     """Pick the page-allocator tier: the native C++ implementation
     (native/allocator.cpp — the reference's serving layer is native, ours
-    matches) when available, the canonical Python one otherwise."""
+    matches) when available, the canonical Python one otherwise.
+    ``need_offload_hook`` (host-tier prefix cache) requires the Python
+    tier — the native allocator has no eviction callback surface."""
+    if need_offload_hook:
+        if force is True:
+            raise RuntimeError(
+                "native_allocator=True is incompatible with the host-tier "
+                "prefix cache (host_tier_bytes > 0): the native allocator "
+                "has no offload hook"
+            )
+        return PageAllocator(pcfg)
     if force is not False:
         try:
             from distributed_inference_server_tpu import native
@@ -186,6 +206,17 @@ class EngineConfig:
     # Forces the XLA attention path (the Pallas kernels DMA raw pages)
     # and is not supported under stage/seq mesh axes.
     kv_quant: str = "none"
+    # host-RAM second tier of the prefix cache (engine/kv_cache.py
+    # HostTier; ISSUE 5): LRU-evicted refcount-0 prefix pages demote to a
+    # bounded host pool instead of dropping, and prefix matching falls
+    # through HBM misses into it. 0 = off. Requires the Python allocator
+    # tier (the native one has no eviction hook).
+    host_tier_bytes: int = 0
+    # host-tier storage encoding for FLOAT pools: "int8" stores demoted
+    # pages as per-vector absmax codes + f32 scales (4x smaller for f32
+    # pools, lossy like the disagg wire quant); quantized pools always
+    # store their native codes exactly.
+    host_tier_quant: str = "none"
 
 
 @dataclass
@@ -476,7 +507,52 @@ class LLMEngine:
                 self.cfg = self.cfg.with_overrides(
                     moe_capacity_factor=dropless
                 )
-        self.allocator = _make_allocator(self.pcfg, self.ecfg.native_allocator)
+        self.allocator = _make_allocator(
+            self.pcfg, self.ecfg.native_allocator,
+            # draft_state check mirrors the host-tier gate below: a
+            # speculative engine never gets a tier, so it must neither
+            # reject the native allocator nor silently downgrade to the
+            # Python one for a hook nobody will install
+            need_offload_hook=(self.ecfg.host_tier_bytes > 0
+                               and self.draft_state is None),
+        )
+        # host-RAM second tier of the prefix cache (ISSUE 5): LRU-evicted
+        # refcount-0 pages demote here via the allocator's offload hook;
+        # _start_prefill falls through HBM misses into it
+        self.host_tier: Optional[HostTier] = None
+        if self.ecfg.host_tier_bytes > 0:
+            if self.draft_state is not None:
+                # a speculative engine's shared pages cover BOTH pools;
+                # demoting only the target pool would re-seat prefixes
+                # whose draft KV is garbage (silent acceptance collapse)
+                logger.warning(
+                    "host-tier prefix cache disabled: speculative engines "
+                    "would re-seat prefixes with a stale draft KV pool"
+                )
+            else:
+                self.host_tier = HostTier(
+                    self.ecfg.host_tier_bytes,
+                    quant=self.ecfg.host_tier_quant,
+                    # one full gather bucket stays in flight; bursts
+                    # larger than the window span several offer() calls
+                    # (new_burst=False continuations) and never drain
+                    # their own still-in-flight copies
+                    inflight_window=self._OFFLOAD_BUCKETS[-1],
+                )
+                self.allocator.offload_hook = self._offload_pages
+                # bucketed page-group pull as a single compiled program:
+                # an eviction burst dispatches one cached executable per
+                # ≤32-page group instead of an op-by-op eager chain per
+                # page (gather + quant can be 6-8 dispatches eagerly —
+                # the dominant term of the allocate path that triggers
+                # the demotions). quant is static arg 0.
+                self._offload_pull = jax.jit(gather_kv_parts,
+                                             static_argnums=0)
+        # host-tier traffic counters (runner._report_cache_deltas turns
+        # them into kv_prefix_hits_total{tier=host} etc.): engine-thread
+        # writes, racy-but-atomic int reads from the status path
+        self._host_hit_pages = 0
+        self._host_reload_durations: List[float] = []
         self.waiting: Deque[_Seq] = deque()
         # prefill_only sequences whose first token has been emitted: pages
         # held, waiting for the serving layer to export_handoff() them
@@ -655,6 +731,201 @@ class LLMEngine:
 
     def cache_stats(self):
         return self.allocator.stats()
+
+    # ------------------------------------------------------------------
+    # host-tier prefix cache (engine/kv_cache.py HostTier; ISSUE 5)
+    # ------------------------------------------------------------------
+
+    #: demotion gather geometry: bursts split into ≤32-page groups, each
+    #: padded up to a bucket so the jitted pull compiles once per bucket
+    #: size instead of once per burst size
+    _OFFLOAD_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+    def _offload_pages(self, victims) -> None:
+        """Allocator offload hook: demote a batch of LRU-evicted
+        refcount-0 pages to the host tier. Each ≤32-page group is gathered
+        (plus optional on-device int8 quantization) in ONE jitted program
+        and its device→host copies STARTED here — before the page ids are
+        recycled, so the gather reads the old content — but nothing
+        blocks: the HostTier's in-flight window materializes pages
+        asynchronously behind the decode loop. Batched because eviction
+        bursts ride inside allocate() on the request path: per-page pulls
+        cost one dispatch per victim, which profiles as the dominant term
+        of a tiered reload."""
+        tier = self.host_tier
+        if tier is None:
+            return
+        victims = [v for v in victims if not tier.has(v.hash)]
+        ps = self.pcfg.page_size
+        cap = self._OFFLOAD_BUCKETS[-1]
+        kind = payload_kind(self.state.k, tier.quant)
+        for start in range(0, len(victims), cap):
+            group = victims[start:start + cap]
+            bucket = next(b for b in self._OFFLOAD_BUCKETS
+                          if b >= len(group))
+            # pad by repeating the last victim: the extra slots gather
+            # real (identical) content and the tier ignores them
+            padded = group + [group[-1]] * (bucket - len(group))
+            slots = jnp.asarray(np.concatenate(
+                [np.arange(v.page_id * ps, (v.page_id + 1) * ps)
+                 for v in padded]
+            ))
+            if kind == _KIND_QPOOL:
+                arrs = self._offload_pull(
+                    tier.quant, self.state.k.data, self.state.k.scale,
+                    self.state.v.data, self.state.v.scale, slots,
+                )
+            else:
+                arrs = self._offload_pull(tier.quant, self.state.k,
+                                          self.state.v, slots)
+            start_host_copies(arrs)
+            # groups past the first are burst continuations: the window
+            # must not drain this very burst's still-in-flight copies
+            tier.offer([(v.hash, v.depth, v.root) for v in group], kind,
+                       arrs, ps, new_burst=(start == 0))
+
+    def _host_tier_reload(self, seq: "_Seq", prompt: List[int]) -> None:
+        """Prefix-match fallthrough (ISSUE 5): continue the content-hash
+        chain past the HBM match into the host tier, re-seat every
+        matched page into freshly allocated HBM pages with ONE batched
+        device scatter (the same ``_scatter_payload`` the streamed-import
+        ``KvImportSession`` uses), and content-address them so the next
+        prompt hits them in HBM directly. The scatter is dispatched
+        async — it overlaps the remaining prefill chunks' compute rather
+        than serializing before them."""
+        tier = self.host_tier
+        ps = self.pcfg.page_size
+        n = len(prompt)
+        start = len(seq.block_table)  # pages already shared from HBM
+        if tier.empty or (start + 1) * ps >= n:
+            # cold tier / HBM match already covers every matchable page:
+            # skip the hash walk entirely
+            return
+        # lazy hash chain: the walk below stops at its first tier miss,
+        # so hashing costs O(HBM match + tier match + 1) pages, not
+        # O(prompt) — a long cold prompt pays one probe, not a full walk
+        hash_it = iter_chain_hashes(prompt, ps)
+        for _ in range(start):  # skip the hashes the HBM match covered
+            next(hash_it)
+        entries = []
+        idx = start
+        # always leave >= 1 token to compute (same contract as the HBM
+        # match above)
+        while (idx + 1) * ps < n:
+            h = next(hash_it, None)
+            if h is None:
+                break
+            e = tier.get(h)
+            if e is None or (entries and e.kind != entries[0].kind):
+                break
+            entries.append(e)
+            idx += 1
+        if not entries:
+            return
+        t0 = time.monotonic()
+        try:
+            pages = self.allocator.allocate(len(entries))
+        except CacheFull:
+            return  # pool too tight to re-seat; prefill recomputes instead
+        try:
+            slots = np.concatenate(
+                [np.arange(p * ps, (p + 1) * ps) for p in pages]
+            )
+            kind = entries[0].kind
+            merged = tuple(
+                np.concatenate([e.parts[m] for e in entries], axis=1)
+                for m in range(len(entries[0].parts))
+            )
+            if kind == _KIND_WIRE8:
+                # int8 host tier into a float pool: upload the codes+scales
+                # (4x fewer bytes over PCIe than dequantized values) and
+                # dequantize on device
+                k_q, v_q, k_s, v_s = merged
+                dt = self.state.k.dtype
+                k = (jnp.asarray(k_q, jnp.float32)
+                     * jnp.asarray(k_s)[..., None]).astype(dt)
+                v = (jnp.asarray(v_q, jnp.float32)
+                     * jnp.asarray(v_s)[..., None]).astype(dt)
+                parts = (k, v)
+            else:
+                # _KIND_RAW into a float pool / _KIND_QPOOL into a QuantPool
+                parts = merged
+            self.state = _scatter_payload(self.state, slots, parts)
+        except Exception as e:  # noqa: BLE001 — reload is best-effort
+            # the pages are not yet in seq.block_table and carry no
+            # content address, so release() returns them straight to the
+            # free list; the prefill recomputes the prefix instead
+            self.allocator.release(pages)
+            logger.warning("host-tier reload of %d pages failed: %s",
+                           len(entries), e)
+            return
+        seq.block_table.extend(pages)
+        seq.seq_len = (start + len(entries)) * ps
+        # content-address the re-seated pages: the next prompt sharing
+        # this prefix hits them in HBM (and the routing digest sees them)
+        self.allocator.publish(prompt[: seq.seq_len], seq.block_table)
+        self._host_hit_pages += len(entries)
+        self._host_reload_durations.append(time.monotonic() - t0)
+        if len(self._host_reload_durations) > 1024:
+            # nobody draining (no metrics collector): keep the tail only
+            del self._host_reload_durations[:-1024]
+
+    def evict_cache(self, target_frac: float,
+                    drop_host_tier: bool = False) -> None:
+        """Degradation-ladder hook (serving/degradation.py): reclaim
+        cached pages down to ``target_frac``, DEMOTING them to the host
+        tier on the way out; ``drop_host_tier`` (the most severe rung)
+        skips demotion and clears the host tier outright."""
+        if self.host_tier is not None:
+            self.allocator.evict_below(target_frac,
+                                       demote=not drop_host_tier)
+            if drop_host_tier:
+                self.host_tier.clear()
+            else:
+                # a ladder demotion can exceed the in-flight window in
+                # ONE burst, and nothing may arrive later to drain it —
+                # leaving the gathered device arrays (HBM this eviction
+                # just tried to free) pinned. We're off the decode hot
+                # path here: materialize the overshoot now.
+                self.host_tier.drain_to_window()
+        else:
+            self.allocator.evict_below(target_frac)
+
+    def prefix_digest(self, max_depth: int = DIGEST_DEPTH) -> frozenset:
+        """Compact rolling digest of this engine's cached prefix chains
+        (first-``max_depth`` page hashes per chain, HBM + host tier) for
+        cache-aware routing. Engine-thread only; the runner snapshots it
+        into EngineStatus. Empty under the native allocator (no digest
+        surface) — the router then falls back to least-loaded."""
+        dig = getattr(self.allocator, "prefix_digest", None)
+        out = dig(max_depth) if dig is not None else frozenset()
+        if self.host_tier is not None:
+            out = frozenset(out) | frozenset(
+                self.host_tier.digest_hashes(max_depth)
+            )
+        return out
+
+    def host_tier_stats(self) -> Optional[Dict[str, int]]:
+        """Host-tier occupancy/traffic snapshot for metrics and
+        /server/stats; None when the tier is off."""
+        if self.host_tier is None:
+            return None
+        s = self.host_tier.stats()
+        return {
+            "budget_bytes": s.budget_bytes,
+            "bytes": s.bytes_used,
+            "pages": s.pages,
+            "hits": s.hits,
+            "hit_pages": self._host_hit_pages,
+            "offloads": s.offloads,
+            "evictions": s.evictions,
+        }
+
+    def drain_reload_durations(self) -> List[float]:
+        """Hand the accumulated host-tier reload durations to the caller
+        (runner thread — the same thread that appends them)."""
+        out, self._host_reload_durations = self._host_reload_durations, []
+        return out
 
     # ------------------------------------------------------------------
     # KV handoff (disaggregated prefill/decode serving, serving/disagg.py)
@@ -1144,17 +1415,22 @@ class LLMEngine:
         # only past the cached prefix.
         n = len(prompt)
 
-        # prefix reuse (Property 9) — but always leave >= 1 token to compute
-        shared_pages, shared_tokens = self.allocator.match_prefix(prompt)
-        while shared_tokens >= n:
-            self.allocator.release([shared_pages.pop()])
-            shared_tokens -= ps
+        # prefix reuse (Property 9) — match against prompt[:-1] so a
+        # fully-cached prompt still leaves >= 1 token to compute and the
+        # hit counters never count a page that would be released right back
+        shared_pages, shared_tokens = self.allocator.match_prefix(
+            prompt[: n - 1])
         seq.block_table = list(shared_pages)
         seq.seq_len = shared_tokens
         seq.next_token = None
 
+        # host-tier fallthrough (ISSUE 5): HBM misses may still be warm
+        # in host RAM — re-seat them instead of recomputing the prefill
+        if self.host_tier is not None:
+            self._host_tier_reload(seq, prompt)
+
         # allocate the remaining pages for the prompt
-        pages_needed = -(-n // ps) - len(shared_pages)
+        pages_needed = -(-n // ps) - len(seq.block_table)
         if pages_needed > 0:
             try:
                 seq.block_table.extend(self.allocator.allocate(pages_needed))

@@ -25,12 +25,24 @@ pure-XLA reference path, and the Pallas ragged-paged-attention kernel
 
 from __future__ import annotations
 
+import heapq
+import logging
 import struct
 import time
 import zlib
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, NamedTuple, Optional, Sequence, Tuple
+from typing import (
+    Callable,
+    Deque,
+    Dict,
+    Iterator,
+    List,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +56,8 @@ from distributed_inference_server_tpu.ops.quant import (
     pool_num_slots,
     quantize_kv,
 )
+
+logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -130,11 +144,58 @@ def _chunk_hash(prev: int, tokens: Tuple[int, ...]) -> int:
     return h & 0x7FFFFFFFFFFFFFFF
 
 
+#: chain depth covered by prefix digests (first-K page hashes per chain):
+#: routing only needs the head of a chain to tell warm engines from cold
+#: ones, and bounding the digest keeps EngineStatus snapshots compact.
+DIGEST_DEPTH = 8
+
+
+def iter_chain_hashes(tokens: Sequence[int], page_size: int) -> Iterator[int]:
+    """Lazy form of ``chain_hashes``: yields hash i (addressing pages
+    0..i) on demand, so a consumer probing lookups page by page — the
+    host-tier reload walk stops at its first miss — pays O(pages
+    consumed), not O(len(tokens))."""
+    h = 0
+    for start in range(0, len(tokens) - page_size + 1, page_size):
+        h = _chunk_hash(h, tuple(tokens[start : start + page_size]))
+        yield h
+
+
+def chain_hashes(
+    tokens: Sequence[int], page_size: int, max_pages: Optional[int] = None
+) -> List[int]:
+    """Content-address hash chain over the full pages of ``tokens`` —
+    hash i addresses pages 0..i of the prefix. This is the key space the
+    prefix cache (HBM and host tiers) and the cache-aware router share;
+    int hashes are process-stable (int/tuple hashing is not seeded)."""
+    it = iter_chain_hashes(tokens, page_size)
+    if max_pages is not None:
+        return [h for h, _ in zip(it, range(max_pages))]
+    return list(it)
+
+
+class PageVictim(NamedTuple):
+    """One LRU-evicted content-addressed page, as handed to the host-tier
+    offload hook (batched): identity + chain coordinates."""
+
+    page_id: int
+    hash: int
+    depth: int
+    root: int
+
+
 @dataclass
 class _CachedPage:
     page_id: int
     refcount: int = 0
     last_accessed: float = field(default_factory=time.monotonic)
+    # chain position of this page's content address (0 = first page of a
+    # prefix); drives digest truncation and the host tier's front-biased
+    # eviction
+    depth: int = 0
+    # depth-0 hash of this page's chain: the host tier protects chains
+    # (not pages) on re-use, so a hit on a chain's head shields its tail
+    root: int = 0
 
 
 @dataclass(frozen=True)
@@ -173,6 +234,17 @@ class PageAllocator:
         self._hits = 0
         self._misses = 0
         self._evictions = 0
+        # host-tier demotion hook (engine/engine.py wires it to the
+        # HostTier): called ONCE per eviction burst with the whole victim
+        # batch, BEFORE any evicted page id is handed back to allocate(),
+        # so the hook can snapshot the pages' KV off the device while the
+        # content is still intact. Batched on purpose: a per-page hook
+        # costs one device dispatch per victim, which under an allocation
+        # burst (exactly when evictions happen) stacks straight into
+        # request latency. Must never raise into the eviction path.
+        self.offload_hook: Optional[
+            Callable[[List["PageVictim"]], None]
+        ] = None
 
     # -- queries -----------------------------------------------------------
 
@@ -231,25 +303,51 @@ class PageAllocator:
     def allocate(self, n: int) -> List[int]:
         """Allocate n fresh pages, reclaiming LRU cached pages if needed.
         Raises CacheFull when not enough pages exist (Property 10: eviction
-        is LRU over refcount-0 content-addressed pages)."""
+        is LRU over refcount-0 content-addressed pages). A multi-page
+        reclaim demotes its victims as ONE batch (one hook call → one
+        device gather) instead of per page."""
         if self.num_free() < n:
             raise CacheFull()
+        deficit = n - len(self._free)
+        evicted: List[int] = (
+            self._evict_lru_batch(deficit) if deficit > 0 else []
+        )
+        # free-list pages first, reclaimed pages after — the same order
+        # the per-page loop produced (the native allocator mirrors it)
         out: List[int] = []
-        for _ in range(n):
-            if self._free:
-                out.append(self._free.pop())
-            else:
-                out.append(self._evict_lru_one())
+        while len(out) < n - len(evicted):
+            out.append(self._free.pop())
+        out.extend(evicted)
         return out
 
-    def _evict_lru_one(self) -> int:
+    def _evict_lru_batch(self, count: int, demote: bool = True) -> List[int]:
+        """Evict up to ``count`` LRU cached pages, invoking the offload
+        hook once with the whole victim batch BEFORE any id is returned
+        (the hook snapshots content ahead of recycling). Raises CacheFull
+        only when nothing is evictable at all."""
         if not self._lru:
             raise CacheFull()
-        page_id, victim_hash = self._lru.popitem(last=False)  # oldest
-        self._by_hash.pop(victim_hash, None)
-        self._by_page.pop(page_id, None)
-        self._evictions += 1
-        return page_id
+        ids: List[int] = []
+        victims: List[PageVictim] = []
+        while self._lru and len(ids) < count:
+            page_id, victim_hash = self._lru.popitem(last=False)  # oldest
+            entry = self._by_hash.pop(victim_hash, None)
+            self._by_page.pop(page_id, None)
+            self._evictions += 1
+            ids.append(page_id)
+            if entry is not None:
+                victims.append(PageVictim(page_id, victim_hash,
+                                          entry.depth, entry.root))
+        if demote and victims and self.offload_hook is not None:
+            # demote instead of drop: the hook copies the pages' KV to
+            # the host tier before the ids are recycled. Hook failures
+            # degrade to a plain drop — eviction itself must not fail.
+            try:
+                self.offload_hook(victims)
+            except Exception as e:  # noqa: BLE001 — offload is best-effort
+                logger.debug("host-tier offload hook failed for %d pages: "
+                             "%s", len(victims), e)
+        return ids
 
     # -- publishing & release ---------------------------------------------
 
@@ -263,18 +361,22 @@ class PageAllocator:
         """
         ps = self.cfg.page_size
         h = 0
+        root = 0
         now = time.monotonic()
         for i, start in enumerate(range(0, len(tokens) - ps + 1, ps)):
             if i >= len(page_ids):
                 break
             chunk = tuple(tokens[start : start + ps])
             h = _chunk_hash(h, chunk)
+            if i == 0:
+                root = h
             entry = self._by_hash.get(h)
             if entry is None:
                 page_id = page_ids[i]
                 if page_id in self._by_page:
                     continue  # already addressed under another chain
-                entry = _CachedPage(page_id=page_id, refcount=1, last_accessed=now)
+                entry = _CachedPage(page_id=page_id, refcount=1,
+                                    last_accessed=now, depth=i, root=root)
                 self._by_hash[h] = entry
                 self._by_page[page_id] = (h, entry)
             elif entry.page_id != page_ids[i]:
@@ -319,18 +421,31 @@ class PageAllocator:
                 if pid in self._lru:
                     self._lru.move_to_end(pid)
 
-    def evict_below(self, target_frac: float) -> int:
+    def evict_below(self, target_frac: float, demote: bool = True) -> int:
         """Aggressively reclaim cached pages until memory_used (incl. cached)
         is below target_frac of the pool — the graceful-degradation hook
-        (design.md:925-943 [spec]). Returns pages reclaimed."""
-        n = 0
-        while (self.cfg.num_pages - len(self._free)) / self.cfg.num_pages > target_frac:
-            try:
-                self._free.append(self._evict_lru_one())
-                n += 1
-            except CacheFull:
-                break
-        return n
+        (design.md:925-943 [spec]). Returns pages reclaimed.
+        ``demote=False`` skips the host-tier offload hook (the ladder's
+        most severe rung drops content outright instead of spending
+        device gathers on pages it is about to discard anyway)."""
+        total = self.cfg.num_pages
+        k = 0
+        while ((total - len(self._free) - k) / total > target_frac
+               and k < len(self._lru)):
+            k += 1
+        if k == 0:
+            return 0
+        ids = self._evict_lru_batch(k, demote=demote)
+        self._free.extend(ids)
+        return len(ids)
+
+    def prefix_digest(self, max_depth: int = DIGEST_DEPTH) -> frozenset:
+        """Content hashes of cached chains, truncated to the first
+        ``max_depth`` pages per chain — the HBM half of the routing
+        digest (serving/scheduler.py cache_aware). Engine-thread only."""
+        return frozenset(
+            h for h, e in self._by_hash.items() if e.depth < max_depth
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -389,30 +504,60 @@ def _encode_payload(kind: int, dtype_name: str, shape: Tuple[int, ...],
     return b"".join([header] + [_raw_view(b) for b in buffers])
 
 
+def payload_kind(pool, quant: str) -> int:
+    """Payload layout for a K (or V) pool under optional quantization —
+    the ONE definition of kind selection, shared by the disagg wire pull
+    (``_pull_group``) and the engine's host-tier offload. Quantized
+    pools always move their native codes exactly; float pools move raw
+    values or per-vector int8 codes + scales when ``quant == "int8"``."""
+    if isinstance(pool, QuantPool):
+        return _KIND_QPOOL
+    return _KIND_WIRE8 if quant == "int8" else _KIND_RAW
+
+
+def gather_kv_parts(quant: str, *args):
+    """Gather one page group's K/V in CANONICAL payload order
+    (k, v[, k_scale, v_scale]) — pure and jittable (the engine jits it
+    per offload bucket; the wire pull runs it eagerly), so payload
+    ordering has exactly one definition for ``_scatter_payload`` and the
+    host tier to agree with. 5 args = a QuantPool's fields
+    (k_data, k_scale, v_data, v_scale, slots): native codes pass through
+    exactly. 3 args = float pools (k, v, slots), quantized per-vector
+    on device when ``quant == "int8"`` — halving (f32: quartering) the
+    bytes crossing the host boundary."""
+    if len(args) == 5:
+        kd, ks, vd, vs, slots = args
+        return kd[:, slots], vd[:, slots], ks[:, slots], vs[:, slots]
+    k, v, slots = args
+    if quant == "int8":
+        k_q, k_s = quantize_kv(k[:, slots])
+        v_q, v_s = quantize_kv(v[:, slots])
+        return k_q, v_q, k_s, v_s
+    return k[:, slots], v[:, slots]
+
+
+def start_host_copies(arrs) -> None:
+    """Kick off non-blocking device→host copies for a payload group
+    (no-op per array when the backend has no async copy surface)."""
+    for a in arrs:
+        copy_async = getattr(a, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()
+
+
 def _pull_group(state: PagedKVState, slots: np.ndarray, wire_quant: str):
     """Dispatch the device gather (and optional on-device wire
     quantization) for one page group, then start its device→host copy
     WITHOUT blocking — the double-buffering primitive. Returns
     (kind, device arrays in payload order)."""
     sl = jnp.asarray(slots)
-    if isinstance(state.k, QuantPool):
-        arrs = (state.k.data[:, sl], state.v.data[:, sl],
-                state.k.scale[:, sl], state.v.scale[:, sl])
-        kind = _KIND_QPOOL
-    elif wire_quant == "int8":
-        # quantize on device: halves (f32: quarters) the bytes crossing
-        # the host boundary as well as the wire
-        k_q, k_s = quantize_kv(state.k[:, sl])
-        v_q, v_s = quantize_kv(state.v[:, sl])
-        arrs = (k_q, v_q, k_s, v_s)
-        kind = _KIND_WIRE8
+    kind = payload_kind(state.k, wire_quant)
+    if kind == _KIND_QPOOL:
+        arrs = gather_kv_parts(wire_quant, state.k.data, state.k.scale,
+                               state.v.data, state.v.scale, sl)
     else:
-        arrs = (state.k[:, sl], state.v[:, sl])
-        kind = _KIND_RAW
-    for a in arrs:
-        copy_async = getattr(a, "copy_to_host_async", None)
-        if copy_async is not None:
-            copy_async()
+        arrs = gather_kv_parts(wire_quant, state.k, state.v, sl)
+    start_host_copies(arrs)
     return kind, arrs
 
 
@@ -828,3 +973,343 @@ class KvImportSession:
             self._closed = True
             if self.pages:
                 self._allocator.release(self.pages)
+
+
+# ---------------------------------------------------------------------------
+# Host-RAM second tier of the prefix cache (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class HostTierStats:
+    """Host-tier occupancy and traffic counters (engine-thread values,
+    read racily by the status path — plain int reads are atomic)."""
+
+    budget_bytes: int
+    bytes_used: int
+    pages: int
+    hits: int
+    misses: int
+    offloads: int
+    evictions: int
+
+
+@dataclass
+class _HostPage:
+    depth: int  # chain position (0 = first page of a prefix)
+    root: int  # depth-0 hash of the chain (protection is per chain)
+    kind: int  # _KIND_RAW | _KIND_WIRE8 | _KIND_QPOOL payload layout
+    parts: Tuple[np.ndarray, ...]
+    nbytes: int
+    stamp: int  # LRU clock value of the last access
+
+
+@dataclass
+class _InflightGroup:
+    """One demotion burst awaiting materialization: ``arrs`` are device
+    arrays (async copies started) whose slot axis covers every page in
+    ``entries`` at its recorded index — padding slots beyond the real
+    pages are ignored on drain."""
+
+    entries: List[Tuple[int, int, int, int]]  # (idx, hash, depth, root)
+    kind: int
+    page_size: int
+    arrs: tuple
+    burst: int  # ingest-burst id: a burst never force-drains itself
+
+
+class HostTier:
+    """Bounded host-RAM pool of demoted prefix-cache pages.
+
+    When the HBM prefix cache LRU-evicts refcount-0 content-addressed
+    pages, the engine's offload hook gathers their K/V off the device in
+    one bucketed program per burst (optionally int8-quantized via the
+    same per-vector absmax codec the disagg wire uses) and ``offer``s
+    the device arrays here with their device→host copies already in
+    flight. A small in-flight window (``inflight_window`` pages) keeps
+    eviction non-blocking: ``offer`` only materializes (``np.asarray``,
+    the potentially-blocking host read) the OLDEST in-flight groups once
+    the window overflows, and only groups from an EARLIER ingest burst
+    (an eviction burst larger than the window spans several ``offer``
+    calls — ``new_burst=False`` continuations — and must never drain
+    its own still-in-flight copies from inside ``allocate``; the window
+    briefly overshoots instead and the NEXT burst or lookup hit drains
+    it back down, by which time the copies have long landed).
+    ``inflight_window=0`` disables the window: every offer materializes
+    synchronously (tests/bench determinism). The default window equals
+    the hook's largest gather bucket (``LLMEngine._OFFLOAD_BUCKETS[-1]``,
+    32 pages), so the common single-group burst stays fully in flight.
+
+    Eviction under the byte budget is CHAIN-AWARE, not plain LRU —
+    plain LRU is scan-poisoned here, because the HBM pool demotes a
+    chain head-first, which makes the one matchable page (the head) the
+    oldest entry exactly when churn arrives. Two rules instead:
+
+    - chains are PROTECTED once matched (``get`` counts per-chain hits):
+      one-touch churn traffic can never displace a re-used prefix —
+      probationary (never-hit) chains always evict first;
+    - within the victim class eviction is FRONT-BIASED (deepest page
+      first, ties least-recently-used): a chain is only matchable from
+      its head, so a retained tail with a dropped head would be dead
+      weight. A budget smaller than one hot chain therefore keeps the
+      chain's head — O(tail) recompute instead of O(context).
+
+    Single-owner: every method runs on the engine thread (the allocator
+    hook, ``match``/reload in ``_start_prefill``, and the degradation
+    ladder's ``clear`` all execute between engine steps). ``stats()``
+    may be read from other threads — it only reads ints."""
+
+    def __init__(self, budget_bytes: int, quant: str = "none",
+                 inflight_window: int = 32):
+        if quant not in WIRE_QUANTS:
+            raise ValueError(
+                f"unknown host-tier quant {quant!r}; known: "
+                + "|".join(WIRE_QUANTS)
+            )
+        if budget_bytes <= 0:
+            raise ValueError("host-tier budget_bytes must be positive")
+        self.budget_bytes = int(budget_bytes)
+        self.quant = quant
+        self._window = max(0, int(inflight_window))
+        self._pages: Dict[int, _HostPage] = {}
+        self._inflight: "Deque[_InflightGroup]" = deque()
+        # hashes currently in flight: O(1) has()/get() probes — ingest
+        # calls has() once per victim, and a linear scan over in-flight
+        # entries would make a large eviction burst quadratic on the
+        # engine thread
+        self._inflight_hashes: set = set()
+        # chain root -> match count: chains with hits are protected
+        self._chain_hits: Dict[int, int] = {}
+        # eviction order as two lazy heaps of (-depth, stamp, hash) —
+        # probationary chains evict before protected ones. Entries go
+        # stale when a page is evicted or its clock refreshed (stamps
+        # are unique, so a stamp mismatch detects both) and are skipped
+        # on pop: a min() scan over every resident page per eviction
+        # would make budget churn O(pages²) on the engine thread.
+        self._prob_heap: List[Tuple[int, int, int]] = []
+        self._prot_heap: List[Tuple[int, int, int]] = []
+        # chain root -> resident page count: protection GC without a
+        # full scan per eviction
+        self._root_pages: Dict[int, int] = {}
+        self._clock = 0
+        self._burst = 0
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.offloads = 0
+        self.evictions = 0
+
+    # -- ingest (allocator offload hook path) ------------------------------
+
+    @property
+    def empty(self) -> bool:
+        """True when no page is resident or in flight — lets the reload
+        path skip its hash walk entirely on a cold tier."""
+        return not self._pages and not self._inflight
+
+    def has(self, h: int) -> bool:
+        return h in self._pages or h in self._inflight_hashes
+
+    def _inflight_pages(self) -> int:
+        return sum(len(g.entries) for g in self._inflight)
+
+    def offer(self, entries: Sequence[Tuple[int, int, int]], kind: int,
+              arrs: tuple, page_size: int, new_burst: bool = True) -> None:
+        """Accept one demoted page group: ``entries`` are (hash, depth,
+        root) per page, positional against ``arrs`` — device arrays in
+        payload order (k, v[, k_scale, v_scale]) whose slot axis holds
+        page i at ``[i*page_size, (i+1)*page_size)`` and whose
+        ``copy_to_host_async`` the caller already dispatched (slots past
+        the last real page are jit-bucket padding, ignored). Window
+        overflow drains only groups from EARLIER bursts —
+        ``new_burst=False`` marks this group a continuation of the
+        previous ``offer``'s burst (one multi-group eviction burst must
+        never block on its own in-flight copies); a window of 0 drains
+        everything synchronously."""
+        if new_burst:
+            self._burst += 1
+        fresh = [
+            (i, h, depth, root)
+            for i, (h, depth, root) in enumerate(entries)
+            if not self.has(h)  # resident (hot-cycling): keep the old copy
+        ]
+        if fresh:
+            self._inflight.append(
+                _InflightGroup(fresh, kind, page_size, arrs, self._burst)
+            )
+            self._inflight_hashes.update(h for _, h, _, _ in fresh)
+            self.offloads += len(fresh)
+        # drain even when this offer dedups away entirely: a NEW burst
+        # must pull a previous burst's overshoot back down to the window
+        while (self._inflight_pages() > self._window and self._inflight
+               and (self._window == 0
+                    or self._inflight[0].burst != self._burst)):
+            self._drain_one()
+
+    def drain_to_window(self) -> None:
+        """Materialize in-flight groups (oldest first, own-burst rule
+        suspended) until the window bound holds again — for callers OFF
+        the decode hot path: the degradation ladder's demotion can
+        exceed the window in ONE burst, and with no later burst or
+        lookup hit to drain it, the overshoot (gathered DEVICE arrays —
+        HBM the ladder just tried to free) would stay pinned
+        indefinitely."""
+        while self._inflight and self._inflight_pages() > self._window:
+            self._drain_one()
+
+    def _drain_one(self) -> None:
+        g = self._inflight.popleft()
+        self._inflight_hashes.difference_update(
+            h for _, h, _, _ in g.entries
+        )
+        whole = [np.asarray(a) for a in g.arrs]
+        ps = g.page_size
+        for idx, h, depth, root in g.entries:
+            if h in self._pages:
+                continue
+            # own copies, not views: a view would pin the whole group
+            # buffer for as long as any single page stays resident
+            parts = tuple(
+                np.ascontiguousarray(p[:, idx * ps:(idx + 1) * ps])
+                for p in whole
+            )
+            nbytes = sum(int(p.nbytes) for p in parts)
+            if nbytes > self.budget_bytes:
+                self.evictions += 1  # one page exceeds the whole budget
+                continue
+            self._clock += 1
+            self._pages[h] = _HostPage(depth=depth, root=root, kind=g.kind,
+                                       parts=parts, nbytes=nbytes,
+                                       stamp=self._clock)
+            self._bytes += nbytes
+            self._root_pages[root] = self._root_pages.get(root, 0) + 1
+            heapq.heappush(
+                self._prot_heap if root in self._chain_hits
+                else self._prob_heap,
+                (-depth, self._clock, h),
+            )
+            while self._bytes > self.budget_bytes:
+                self._evict_one()
+
+    def _compact(self, heap: List[Tuple[int, int, int]]
+                 ) -> List[Tuple[int, int, int]]:
+        """Rebuild a lazy heap keeping only live entries. Stale entries
+        are normally discarded as _pop_victim pops them, but a tier that
+        never exceeds its budget never pops — while every get() hit
+        pushes a fresh entry — so without periodic compaction the heaps
+        grow with hit count, not resident pages."""
+        live = [t for t in heap
+                if (e := self._pages.get(t[2])) is not None
+                and e.stamp == t[1]]
+        heapq.heapify(live)
+        return live
+
+    def _pop_victim(self, heap: List[Tuple[int, int, int]],
+                    protected: bool) -> Optional[int]:
+        """Pop the heap's best live victim hash, discarding stale
+        entries (page evicted or clock-refreshed since the push — the
+        unique stamp detects both). A probationary entry whose chain got
+        protected since the push is re-filed, not returned."""
+        while heap:
+            negdepth, stamp, h = heapq.heappop(heap)
+            e = self._pages.get(h)
+            if e is None or e.stamp != stamp:
+                continue
+            if not protected and e.root in self._chain_hits:
+                heapq.heappush(self._prot_heap, (negdepth, stamp, h))
+                continue
+            return h
+        return None
+
+    def _evict_one(self) -> None:
+        # probationary (never-matched) chains first; within the class,
+        # deepest page first (front-biased), ties least-recently-used
+        victim = self._pop_victim(self._prob_heap, protected=False)
+        if victim is None:
+            victim = self._pop_victim(self._prot_heap, protected=True)
+        if victim is None:  # unreachable: every resident page has a
+            victim = next(iter(self._pages))  # live heap entry
+        gone = self._pages.pop(victim)
+        self._bytes -= gone.nbytes
+        self.evictions += 1
+        # a fully-evicted chain loses its protection (bounds _chain_hits)
+        left = self._root_pages.get(gone.root, 1) - 1
+        if left <= 0:
+            self._root_pages.pop(gone.root, None)
+            self._chain_hits.pop(gone.root, None)
+        else:
+            self._root_pages[gone.root] = left
+
+    def flush(self) -> None:
+        """Materialize every in-flight page (bench/test determinism; the
+        serving path relies on the window instead)."""
+        while self._inflight:
+            self._drain_one()
+
+    # -- lookup (prefix-match fallthrough path) ----------------------------
+
+    def get(self, h: int) -> Optional[_HostPage]:
+        """Look up a chain hash, refreshing its clock and PROTECTING its
+        chain (a matched chain is re-used traffic — churn must not
+        displace it). A just-demoted page is matchable: when the hash is
+        in flight, groups are drained (oldest first) until it
+        materializes. A MISS never drains — blocking a cold prompt's
+        lookup on unrelated in-flight copies would reintroduce the
+        stall the window exists to avoid."""
+        entry = self._pages.get(h)
+        if entry is None and h in self._inflight_hashes:
+            while h not in self._pages and self._inflight:
+                self._drain_one()
+            entry = self._pages.get(h)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._clock += 1
+        entry.stamp = self._clock
+        self._chain_hits[entry.root] = self._chain_hits.get(
+            entry.root, 0) + 1
+        # re-file under the refreshed stamp (the chain is protected as
+        # of this hit); the old heap entry went stale with the clock
+        heapq.heappush(self._prot_heap,
+                       (-entry.depth, entry.stamp, h))
+        if (len(self._prob_heap) + len(self._prot_heap)
+                > 4 * len(self._pages) + 64):
+            self._prob_heap = self._compact(self._prob_heap)
+            self._prot_heap = self._compact(self._prot_heap)
+        self.hits += 1
+        return entry
+
+    def digest_hashes(self, max_depth: int = DIGEST_DEPTH):
+        """Host half of the routing digest (chain heads only)."""
+        return [h for h, e in self._pages.items() if e.depth < max_depth] + [
+            h for g in self._inflight
+            for _, h, d, _ in g.entries if d < max_depth
+        ]
+
+    # -- maintenance -------------------------------------------------------
+
+    def clear(self) -> int:
+        """Drop everything (degradation ladder's most severe rung).
+        Returns pages dropped."""
+        n = len(self._pages) + self._inflight_pages()
+        self._pages.clear()
+        self._inflight.clear()
+        self._inflight_hashes.clear()
+        self._chain_hits.clear()
+        self._prob_heap.clear()
+        self._prot_heap.clear()
+        self._root_pages.clear()
+        self._bytes = 0
+        self.evictions += n
+        return n
+
+    def stats(self) -> HostTierStats:
+        return HostTierStats(
+            budget_bytes=self.budget_bytes,
+            bytes_used=self._bytes,
+            pages=len(self._pages),
+            hits=self.hits,
+            misses=self.misses,
+            offloads=self.offloads,
+            evictions=self.evictions,
+        )

@@ -114,6 +114,19 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # capacity; forces the XLA attention path)
         "kv_quant": (str, "none"),
     },
+    "cache": {
+        # host-RAM second tier of the prefix cache (docs/CACHING.md;
+        # engine/kv_cache.py HostTier): LRU-evicted refcount-0 prefix
+        # pages demote to a bounded host pool instead of dropping, and
+        # prefix matching falls through HBM misses into it. 0 = off.
+        # Pair with server.strategy=cache_aware so repeated-prefix
+        # traffic routes to the replica whose tiers are already warm.
+        "host_tier_bytes": (int, 0),
+        # host-tier storage encoding for float pools: none | int8
+        # (per-vector absmax codes + f32 scales — 4x smaller for f32
+        # pools, bounded accuracy cost like disagg.wire_quant)
+        "host_tier_quant": (str, "none"),
+    },
     "disagg": {
         # migration budget per handoff: past the deadline (or after the
         # retries) the request decodes in place on its prefill engine
@@ -429,6 +442,13 @@ class ServerConfig:
             raise ConfigError(
                 f"disagg.wire_quant must be none/int8, "
                 f"got {r['disagg']['wire_quant']!r}"
+            )
+        if r["cache"]["host_tier_bytes"] < 0:
+            raise ConfigError("cache.host_tier_bytes must be >= 0")
+        if r["cache"]["host_tier_quant"] not in ("none", "int8"):
+            raise ConfigError(
+                f"cache.host_tier_quant must be none/int8, "
+                f"got {r['cache']['host_tier_quant']!r}"
             )
 
     def hot_diff(self, other: "ServerConfig") -> Dict[tuple, Any]:

@@ -73,9 +73,14 @@ class DegradationController:
         worst = 0.0
         for status in self.scheduler.statuses():
             if status.memory_total_pages:
-                worst = max(
-                    worst, status.memory_used_pages / status.memory_total_pages
+                # live pressure: cached (refcount-0 prefix) pages are
+                # reclaimable on demand, so a pool merely full of cache
+                # must not climb the ladder (EngineStatus reports raw
+                # occupancy with the cached share broken out)
+                live = status.memory_used_pages - getattr(
+                    status, "pages_cached", 0
                 )
+                worst = max(worst, live / status.memory_total_pages)
         return worst
 
     # -- evaluation --------------------------------------------------------
@@ -92,7 +97,7 @@ class DegradationController:
             self._apply(self.level, new)
             self.level = new
         elif new >= DegradationLevel.AGGRESSIVE_CACHE_EVICTION:
-            self._evict()  # keep evicting while pressure stays high
+            self._evict(new)  # keep evicting while pressure stays high
         return self.level
 
     def _apply(self, old: DegradationLevel, new: DegradationLevel) -> None:
@@ -102,17 +107,25 @@ class DegradationController:
             2 if new >= DegradationLevel.REDUCED_BATCH_SIZE else 1
         )
         # cache eviction
-        if new >= DegradationLevel.AGGRESSIVE_CACHE_EVICTION > old:
-            self._evict()
+        if new >= DegradationLevel.AGGRESSIVE_CACHE_EVICTION > old or (
+            new >= DegradationLevel.EMERGENCY > old
+        ):
+            self._evict(new)
         # admission gates
         self.dispatcher.reject_low_priority = (
             new >= DegradationLevel.REJECT_LOW_PRIORITY
         )
         self.dispatcher.reject_all = new >= DegradationLevel.EMERGENCY
 
-    def _evict(self) -> None:
+    def _evict(self, level: DegradationLevel) -> None:
+        """AGGRESSIVE_CACHE_EVICTION demotes HBM prefix pages to the
+        host tier (the tier is exactly the pressure valve for this
+        rung); only EMERGENCY — host RAM is the next thing to run out —
+        drops the host tier as well."""
+        drop_host = level >= DegradationLevel.EMERGENCY
         for runner in self.scheduler.engines():
-            runner.evict_cache(self._evict_target)
+            runner.evict_cache(self._evict_target,
+                               drop_host_tier=drop_host)
 
     # -- background loop ---------------------------------------------------
 

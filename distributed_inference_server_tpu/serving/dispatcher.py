@@ -37,7 +37,10 @@ from distributed_inference_server_tpu.serving.runner import (
     EngineRunner,
     ServerRequest,
 )
-from distributed_inference_server_tpu.serving.scheduler import AdaptiveScheduler
+from distributed_inference_server_tpu.serving.scheduler import (
+    AdaptiveScheduler,
+    SchedulingStrategy,
+)
 
 
 def _make_queue(queue_config, force: Optional[bool] = None):
@@ -211,7 +214,33 @@ class Dispatcher:
             lens = [len(r.prompt_ids) for r in requests]
             pad = (max(lens) * len(lens) / max(sum(lens), 1) - 1.0) if lens else 0.0
             self.metrics.record_batch(len(requests), max(0.0, pad))
-        runner = self.scheduler.schedule()
+        # cache-aware routing (ISSUE 5) is per REQUEST, not per batch —
+        # two requests in one admission window may have their prefixes
+        # warm on different engines; route the window against one fleet
+        # snapshot (schedule_batch), group by chosen engine, and submit
+        # each group. Every other strategy keeps the one-engine-per-batch
+        # fast path.
+        if self.scheduler.strategy() is SchedulingStrategy.CACHE_AWARE:
+            runners = self.scheduler.schedule_batch(
+                [r.prompt_ids for r in requests]
+            )
+            by_engine: dict = {}
+            for r, runner in zip(requests, runners):
+                key = runner.engine_id if runner is not None else None
+                if key not in by_engine:
+                    by_engine[key] = (runner, [])
+                by_engine[key][1].append(r)
+            pairs = list(by_engine.values())
+        else:
+            pairs = [(self.scheduler.schedule(), requests)]
+        for runner, reqs in pairs:
+            self._submit_group(runner, reqs)
+        if self.metrics:
+            d = self.queue.queue_depth()
+            self.metrics.set_queue_depth(d.high, d.normal, d.low)
+
+    def _submit_group(self, runner: Optional[EngineRunner],
+                      requests: List[ServerRequest]) -> None:
         if runner is None:
             # no healthy engine: fail the batch (Property 20 — graceful,
             # not silent)
@@ -236,9 +265,6 @@ class Dispatcher:
                     if r.span is not None:
                         r.span.event("dispatched")
         runner.submit(requests)
-        if self.metrics:
-            d = self.queue.queue_depth()
-            self.metrics.set_queue_depth(d.high, d.normal, d.low)
 
     def _sweep(self, now: float) -> None:
         """Expire queued requests older than the timeout → 408

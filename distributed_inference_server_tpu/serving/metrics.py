@@ -42,8 +42,13 @@ class EngineStatus:
     active_requests: int
     waiting_requests: int
     total_processed: int
+    # raw page occupancy (pages not on the free list, CACHED prefix pages
+    # included); pages_cached below says how much of it is reclaimable-
+    # on-demand prefix cache, so consumers can score live pressure as
+    # used - cached (scheduler memory_aware, degradation ladder)
     memory_used_pages: int = 0
     memory_total_pages: int = 0
+    pages_cached: int = 0
     # disaggregated prefill/decode serving (serving/disagg.py): which
     # part of the pipeline this replica serves
     role: str = "unified"
@@ -51,6 +56,15 @@ class EngineStatus:
     # estimated_speedup, enabled, num_draft_tokens — None when no draft
     # model is configured
     speculation: Any = None
+    # cache-aware routing (ISSUE 5): rolling digest of cached prefix
+    # chains (first-K page content hashes, kv_cache.chain_hashes key
+    # space) and the page size the hashes were computed with. Not
+    # serialized — in-process routing state only.
+    prefix_digest: Any = None
+    page_size: int = 0
+    # host-tier prefix cache occupancy (engine.host_tier_stats()); None
+    # when the tier is off
+    host_tier: Any = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = {
@@ -61,10 +75,13 @@ class EngineStatus:
             "total_processed": self.total_processed,
             "memory_used_pages": self.memory_used_pages,
             "memory_total_pages": self.memory_total_pages,
+            "pages_cached": self.pages_cached,
             "role": self.role,
         }
         if self.speculation is not None:
             d["speculation"] = self.speculation
+        if self.host_tier is not None:
+            d["host_tier"] = self.host_tier
         return d
 
 
@@ -87,6 +104,10 @@ class MetricsSnapshot:
     # disaggregated-serving block (None when no handoff has happened and
     # every engine is unified): handoff outcome counts + bytes moved
     disagg: Optional[Dict[str, Any]] = None
+    # prefix-cache block (ISSUE 5 + the allocator counters that never
+    # reached /server/stats before): hit/miss/eviction totals, per-tier
+    # prefix hits, and host-tier reload cost
+    cache: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out = {
@@ -104,6 +125,8 @@ class MetricsSnapshot:
         }
         if self.disagg is not None:
             out["disagg"] = self.disagg
+        if self.cache is not None:
+            out["cache"] = self.cache
         return out
 
 
@@ -157,6 +180,34 @@ class MetricsCollector:
         )
         self.cache_evictions = Counter(
             "kv_cache_evictions_total", "LRU page evictions", registry=r
+        )
+        # tiered prefix cache (ISSUE 5; engine/kv_cache.py HostTier):
+        # page-granular prefix hits by tier — "hbm" pages were shared in
+        # place, "host" pages were re-seated from the host-RAM tier
+        # instead of recomputing their prefill
+        self.prefix_hits = Counter(
+            "kv_prefix_hits_total",
+            "Prefix-cache page hits by tier (hbm = shared in place, "
+            "host = re-seated from the host-RAM tier)", ["tier"],
+            registry=r,
+        )
+        self.prefix_reload = Histogram(
+            "kv_prefix_reload_seconds",
+            "Host-side time to re-seat a host-tier prefix match into "
+            "HBM (decode + batched scatter dispatch, per prefill)",
+            registry=r,
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1),
+        )
+        self.host_tier_bytes_g = Gauge(
+            "kv_host_tier_bytes",
+            "Bytes resident in the host-RAM prefix-cache tier",
+            ["engine_id"], registry=r,
+        )
+        self.host_tier_pages_g = Gauge(
+            "kv_host_tier_pages",
+            "Pages resident in the host-RAM prefix-cache tier",
+            ["engine_id"], registry=r,
         )
         self.queue_depth_g = Gauge(
             "queue_depth", "Queued requests by priority", ["priority"], registry=r
@@ -243,6 +294,11 @@ class MetricsCollector:
         self._batch_sizes: Deque[int] = deque(maxlen=_LATENCY_WINDOW)
         self._cache_hits = 0
         self._cache_misses = 0
+        self._cache_evictions = 0
+        self._prefix_hits_hbm = 0
+        self._prefix_hits_host = 0
+        self._reload_sum = 0.0
+        self._reload_count = 0
         self._handoffs: Dict[str, int] = {}
         self._handoff_bytes = 0
         self._handoff_chunks = 0
@@ -295,6 +351,32 @@ class MetricsCollector:
         with self._lock:
             self._cache_hits += hits
             self._cache_misses += misses
+            self._cache_evictions += evictions
+
+    def record_prefix_hits(self, hbm: int = 0, host: int = 0) -> None:
+        """Page-granular prefix-cache hit deltas by tier (ISSUE 5):
+        ``hbm`` pages were shared in place, ``host`` pages were re-seated
+        from the host-RAM tier."""
+        if hbm:
+            self.prefix_hits.labels(tier="hbm").inc(hbm)
+        if host:
+            self.prefix_hits.labels(tier="host").inc(host)
+        with self._lock:
+            self._prefix_hits_hbm += hbm
+            self._prefix_hits_host += host
+
+    def record_prefix_reload(self, seconds: float) -> None:
+        """One host-tier reload (host→HBM re-seat) observed by a
+        prefill."""
+        self.prefix_reload.observe(seconds)
+        with self._lock:
+            self._reload_sum += seconds
+            self._reload_count += 1
+
+    def set_host_tier(self, engine_id: str, nbytes: int, pages: int) -> None:
+        """Host-tier occupancy gauges for one engine replica."""
+        self.host_tier_bytes_g.labels(engine_id=engine_id).set(nbytes)
+        self.host_tier_pages_g.labels(engine_id=engine_id).set(pages)
 
     def set_queue_depth(self, high: int, normal: int, low: int) -> None:
         self.queue_depth_g.labels(priority="high").set(high)
@@ -384,6 +466,29 @@ class MetricsCollector:
             lat = sorted(self._latencies_ms)
             p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat else 0.0
             total_cache = self._cache_hits + self._cache_misses
+            # prefix-cache block: allocator counters (incl. evictions,
+            # which never reached the snapshot before) + tiered hits +
+            # host-tier occupancy summed over replicas
+            host_bytes = sum(
+                (s.host_tier or {}).get("bytes", 0) for s in engine_statuses
+            )
+            host_pages = sum(
+                (s.host_tier or {}).get("pages", 0) for s in engine_statuses
+            )
+            cache = {
+                "hits": self._cache_hits,
+                "misses": self._cache_misses,
+                "evictions": self._cache_evictions,
+                "prefix_hits": {"hbm": self._prefix_hits_hbm,
+                                "host": self._prefix_hits_host},
+                "reload_count": self._reload_count,
+                "reload_avg_ms": round(
+                    self._reload_sum / max(1, self._reload_count) * 1000.0,
+                    3,
+                ),
+                "host_tier_bytes": host_bytes,
+                "host_tier_pages": host_pages,
+            }
             disagg = None
             if self._handoffs or any(
                 s.role != "unified" for s in engine_statuses
@@ -417,4 +522,5 @@ class MetricsCollector:
                 worker_statuses=engine_statuses,
                 uptime_seconds=now - self._started_at,
                 disagg=disagg,
+                cache=cache,
             )

@@ -184,6 +184,8 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         # disaggregation role (serving/disagg.py); "unified" when the
         # topology is monolithic, so it is always on the wire
         8: ("role", "string", "one"),
+        # reclaimable refcount-0 prefix pages within memory_used_pages
+        9: ("pages_cached", "uint32", "one"),
     },
     "HealthResponse": {
         1: ("status", "string", "one"),

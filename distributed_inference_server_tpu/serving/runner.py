@@ -136,7 +136,14 @@ class EngineRunner:
         self._embed_jobs: Deque[dict] = deque()
         self._engine: Optional[LLMEngine] = None
         self._thread: Optional[threading.Thread] = None
-        self._cache_seen = {"hits": 0, "misses": 0, "evictions": 0}
+        self._cache_seen = {"hits": 0, "misses": 0, "evictions": 0,
+                            "host_hit_pages": 0}
+        # rolling prefix digest for cache-aware routing (ISSUE 5):
+        # refreshed on the engine thread (allocator state is single-
+        # owner), read as an immutable snapshot by status() from any
+        # thread
+        self._prefix_digest: frozenset = frozenset()
+        self._digest_ts = 0.0
         # old engines still finishing their in-flight requests after a
         # model hot-swap (Req 13.3: in-flight completes on the old model)
         self._draining: List[LLMEngine] = []
@@ -527,12 +534,18 @@ class EngineRunner:
         if job is not None and self._disagg is not None:
             self._disagg.cancel_stream(job, record=record)
 
-    def evict_cache(self, target_frac: float) -> None:
+    def evict_cache(self, target_frac: float,
+                    drop_host_tier: bool = False) -> None:
         """Evict cached (refcount-0) prefix pages until used/total <=
-        target_frac (degradation ladder, design.md:937 [spec])."""
+        target_frac (degradation ladder, design.md:937 [spec]). Evicted
+        pages DEMOTE to the host tier when one is configured;
+        ``drop_host_tier`` (the ladder's most severe rung) skips the
+        demotion and clears the host tier too."""
 
         def _do() -> None:
-            self._engine.allocator.evict_below(target_frac)
+            self._engine.evict_cache(target_frac,
+                                     drop_host_tier=drop_host_tier)
+            self._refresh_digest(force=True)
 
         self._post(_do)
 
@@ -726,21 +739,25 @@ class EngineRunner:
 
     def status(self) -> EngineStatus:
         eng = self._engine
-        used = total = 0
+        used = total = cached = page_size = 0
         waiting = 0
-        speculation = None
+        speculation = host_tier = None
         if eng is not None:
             try:
                 s = eng.cache_stats()
-                # LIVE usage: pages pinned by in-flight sequences. Cached
-                # (refcount-0 prefix) pages are effectively free capacity
-                # — allocate() reclaims them LRU on demand — so counting
-                # them as used would drive the degradation ladder to
-                # EMERGENCY (reject-all) on a pool merely FULL OF CACHE,
-                # and would mislead memory-aware scheduling the same way.
+                # RAW occupancy (pages off the free list) with the cached
+                # share broken out: cached (refcount-0 prefix) pages are
+                # effectively free capacity — allocate() reclaims them
+                # LRU on demand — so consumers score live pressure as
+                # used - cached (scheduler memory_aware, degradation
+                # ladder); counting cache as live pressure would drive
+                # the ladder to EMERGENCY on a pool merely FULL OF CACHE.
                 total = s.pages_total
-                used = total - s.pages_free - s.pages_cached
+                cached = s.pages_cached
+                used = total - s.pages_free
+                page_size = eng.pcfg.page_size
                 waiting = eng.num_waiting()
+                host_tier = eng.host_tier_stats()
                 speculation = eng.spec_stats()
                 if speculation is not None and self.metrics:
                     self.metrics.set_speculation(self.engine_id, speculation)
@@ -755,7 +772,11 @@ class EngineRunner:
             total_processed=self._total_processed,
             memory_used_pages=used,
             memory_total_pages=total,
+            pages_cached=cached,
             speculation=speculation,
+            prefix_digest=self._prefix_digest,
+            page_size=page_size,
+            host_tier=host_tier,
         )
 
     # -- runner thread ----------------------------------------------------
@@ -779,6 +800,7 @@ class EngineRunner:
         ready.set()
 
         try:
+            self._refresh_digest(force=True)
             while not self._stop.is_set():
                 self._drain_inbox()
                 worked = False
@@ -791,6 +813,7 @@ class EngineRunner:
                         self.metrics.record_inference(dt)
                     self._dispatch(outputs)
                     self._report_cache_deltas()
+                    self._refresh_digest()
                 worked |= self._drain_handoffs()
                 worked |= self._step_draining()
                 worked |= self._embed_quantum()
@@ -910,22 +933,50 @@ class EngineRunner:
         if self.metrics and tokens:
             self.metrics.record_tokens(tokens)
 
+    def _refresh_digest(self, force: bool = False,
+                        min_interval_s: float = 0.25) -> None:
+        """Snapshot the engine's prefix digest for cache-aware routing
+        (engine thread only; rate-limited — the digest is advisory)."""
+        now = time.monotonic()
+        if not force and now - self._digest_ts < min_interval_s:
+            return
+        try:
+            self._prefix_digest = self._engine.prefix_digest()
+            self._digest_ts = now
+        except Exception as e:  # noqa: BLE001 — digest is best-effort
+            self._absorbed("prefix_digest", e)
+
     def _report_cache_deltas(self) -> None:
         if not self.metrics or self._engine is None:
             return
         try:
             s = self._engine.cache_stats()
+            host = self._engine.host_tier_stats()
+            reloads = self._engine.drain_reload_durations()
         except Exception as e:  # noqa: BLE001
             self._absorbed("cache_stats", e)
             return
         seen = self._cache_seen
+        hits = max(0, s.hits - seen["hits"])
         self.metrics.record_cache(
-            hits=max(0, s.hits - seen["hits"]),
+            hits=hits,
             misses=max(0, s.misses - seen["misses"]),
             evictions=max(0, s.evictions - seen["evictions"]),
         )
+        host_hit_pages = 0
+        if host is not None:
+            host_hit_pages = max(
+                0, host["hit_pages"] - seen.get("host_hit_pages", 0)
+            )
+            self.metrics.set_host_tier(self.engine_id, host["bytes"],
+                                       host["pages"])
+        if hits or host_hit_pages:
+            self.metrics.record_prefix_hits(hbm=hits, host=host_hit_pages)
+        for dur in reloads:
+            self.metrics.record_prefix_reload(dur)
         self._cache_seen = {
             "hits": s.hits, "misses": s.misses, "evictions": s.evictions,
+            "host_hit_pages": host["hit_pages"] if host is not None else 0,
         }
 
     def _fail_all(self, message: str) -> None:

@@ -37,10 +37,27 @@ class SchedulingStrategy(str, enum.Enum):
     ROUND_ROBIN = "round_robin"
     LEAST_LOADED = "least_loaded"
     MEMORY_AWARE = "memory_aware"
+    CACHE_AWARE = "cache_aware"
 
     @classmethod
     def parse(cls, value: str) -> "SchedulingStrategy":
         return cls(value.strip().lower())
+
+
+def prefix_match_depth(status: EngineStatus,
+                       prefix_hashes: Optional[Sequence[int]]) -> int:
+    """Consecutive-from-the-head pages of ``prefix_hashes`` present in an
+    engine's published digest (EngineStatus.prefix_digest). A chain is
+    only reusable from its head, so the first miss ends the match."""
+    digest = getattr(status, "prefix_digest", None)
+    if not digest or not prefix_hashes:
+        return 0
+    depth = 0
+    for h in prefix_hashes:
+        if h not in digest:
+            break
+        depth += 1
+    return depth
 
 
 def choose_engine(
@@ -48,6 +65,7 @@ def choose_engine(
     statuses: Sequence[EngineStatus],
     rr_counter: int,
     roles: Optional[Sequence[str]] = None,
+    prefix_hashes: Optional[Sequence[int]] = None,
 ) -> Optional[str]:
     """Pure strategy core: pick an engine id from healthy statuses.
 
@@ -55,6 +73,11 @@ def choose_engine(
     least-loaded picks a minimum-load engine. Deterministic given inputs.
     ``roles`` (disaggregated serving, serving/disagg.py) restricts the
     eligible set to engines carrying one of those roles; None = all.
+    ``prefix_hashes`` (cache_aware; ISSUE 5) is the request's content-
+    hash chain (kv_cache.chain_hashes): engines are scored by matched-
+    prefix depth against their published digests, least-loaded breaking
+    ties; with no digest match anywhere the strategy degrades to
+    least-loaded exactly.
     """
     healthy = [s for s in statuses if s.healthy]
     if roles is not None:
@@ -65,16 +88,34 @@ def choose_engine(
         return None
     if strategy is SchedulingStrategy.ROUND_ROBIN:
         return healthy[rr_counter % len(healthy)].engine_id
+    if strategy is SchedulingStrategy.CACHE_AWARE:
+        depths = {
+            s.engine_id: prefix_match_depth(s, prefix_hashes)
+            for s in healthy
+        }
+        if any(depths.values()):
+            return min(
+                healthy,
+                key=lambda s: (
+                    -depths[s.engine_id],
+                    s.active_requests + s.waiting_requests,
+                    s.engine_id,
+                ),
+            ).engine_id
+        strategy = SchedulingStrategy.LEAST_LOADED  # no warm engine
     if strategy is SchedulingStrategy.LEAST_LOADED:
         return min(
             healthy, key=lambda s: (s.active_requests + s.waiting_requests,
                                     s.engine_id)
         ).engine_id
-    # memory-aware: most free pages; tie-break on load then id
+    # memory-aware: most effectively-free pages. Cached (refcount-0
+    # prefix) pages are reclaimable on demand, so they count as free
+    # capacity: score on used - cached. Tie-break on load then id.
     return min(
         healthy,
         key=lambda s: (
-            -(s.memory_total_pages - s.memory_used_pages),
+            -(s.memory_total_pages
+              - (s.memory_used_pages - getattr(s, "pages_cached", 0))),
             s.active_requests + s.waiting_requests,
             s.engine_id,
         ),
@@ -131,7 +172,8 @@ class AdaptiveScheduler:
     def statuses(self) -> List[EngineStatus]:
         return [r.status() for r in self.engines()]
 
-    def schedule(self) -> Optional[EngineRunner]:
+    def schedule(self, prompt_ids: Optional[Sequence[int]] = None
+                 ) -> Optional[EngineRunner]:
         """Pick an engine for the next admission batch, or None if no
         healthy engine exists (graceful failure, Property 20).
 
@@ -140,7 +182,24 @@ class AdaptiveScheduler:
         replicas and reach decode replicas via KV handoff. If only
         decode engines are healthy (prefill fleet down), they take
         admissions anyway: a unified-decoding decode engine beats a 503.
+
+        ``prompt_ids`` (cache_aware routing, ISSUE 5): the request's
+        token ids — its content-hash chain is scored against each
+        engine's published prefix digest, so a request lands where its
+        prefix is already warm. Disagg role restriction composes: the
+        warm engine is picked among prefill/unified candidates.
         """
+        return self.schedule_batch([prompt_ids])[0]
+
+    def schedule_batch(
+        self, prompts: Sequence[Optional[Sequence[int]]]
+    ) -> List[Optional["EngineRunner"]]:
+        """One pick per prompt against ONE fleet snapshot. Cache-aware
+        admission routes per request, and a per-request ``statuses()``
+        (engine cache/host-tier/spec stats plus metrics gauge writes,
+        per runner) would scale requests × replicas on the dispatch hot
+        path; choose_engine is pure, so every request in the window
+        scores against the same snapshot."""
         statuses = self.statuses()
         roles = None
         if any(getattr(s, "role", "unified") == "decode" and s.healthy
@@ -149,13 +208,35 @@ class AdaptiveScheduler:
             if any(s.healthy and getattr(s, "role", "unified") in non_decode
                    for s in statuses):
                 roles = non_decode
+        hash_ps = 0
+        if self._strategy is SchedulingStrategy.CACHE_AWARE:
+            from distributed_inference_server_tpu.engine.kv_cache import (
+                DIGEST_DEPTH,
+                chain_hashes,
+            )
+
+            # hash with the fleet's page size (replicas share one engine
+            # config; a 0 page_size means no engine has reported yet)
+            hash_ps = next(
+                (s.page_size for s in statuses
+                 if s.healthy and getattr(s, "page_size", 0) > 0), 0,
+            )
+        out: List[Optional["EngineRunner"]] = []
         with self._lock:
-            engine_id = choose_engine(self._strategy, statuses, self._rr,
-                                      roles=roles)
-            if engine_id is None:
-                return None
-            self._rr += 1
-            return self._engines.get(engine_id)
+            for prompt_ids in prompts:
+                prefix_hashes = None
+                if hash_ps > 0 and prompt_ids:
+                    prefix_hashes = chain_hashes(prompt_ids, hash_ps,
+                                                 max_pages=DIGEST_DEPTH)
+                engine_id = choose_engine(self._strategy, statuses,
+                                          self._rr, roles=roles,
+                                          prefix_hashes=prefix_hashes)
+                if engine_id is None:
+                    out.append(None)
+                    continue
+                self._rr += 1
+                out.append(self._engines.get(engine_id))
+        return out
 
     def schedule_decode(self, exclude: Optional[str] = None
                         ) -> Optional[EngineRunner]:

@@ -1,0 +1,616 @@
+"""Tiered prefix cache (ISSUE 5): host-RAM second tier of the paged KV
+cache plus cache-aware routing. Covers HostTier policy (byte budget,
+chain protection, front-biased eviction, in-flight window), allocator
+demotion hooks, engine-level offload→reload token identity (f32 and
+int8 host tiers), reload racing an abort, the degradation ladder's
+demote-vs-drop rungs, and the scheduler's cache_aware / rebalanced
+memory_aware strategies."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distributed_inference_server_tpu.engine.kv_cache import (
+    _KIND_RAW,
+    HostTier,
+    PageAllocator,
+    PagedCacheConfig,
+    chain_hashes,
+)
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+from distributed_inference_server_tpu.serving.degradation import (
+    DegradationController,
+    DegradationLevel,
+)
+from distributed_inference_server_tpu.serving.metrics import EngineStatus
+from distributed_inference_server_tpu.serving.scheduler import (
+    SchedulingStrategy,
+    choose_engine,
+    prefix_match_depth,
+)
+
+TOK = ByteTokenizer()
+PS = 4
+
+
+def _page(val: float, nbytes: int = 64) -> tuple:
+    """One fake demoted page: (k, v) host arrays totalling ``2*nbytes``,
+    slot axis at axis 1 (one slot — the policy tests use page_size=1)."""
+    a = np.full((nbytes // 4, 1), val, np.float32)
+    return (a, a * 2)
+
+
+def _offer(t: HostTier, h: int, depth: int, root: int, kind: int,
+           arrs: tuple) -> None:
+    """Single-page group offer through the batched ingest API."""
+    t.offer([(h, depth, root)], kind, arrs, page_size=1)
+
+
+# ---------------------------------------------------------------------------
+# HostTier policy (no engine)
+# ---------------------------------------------------------------------------
+
+
+class TestHostTierPolicy:
+    def test_offer_get_roundtrip(self):
+        t = HostTier(budget_bytes=1 << 20)
+        _offer(t, 11, 0, 11, _KIND_RAW, _page(1.0))
+        e = t.get(11)
+        assert e is not None and e.kind == _KIND_RAW
+        np.testing.assert_array_equal(e.parts[0], _page(1.0)[0])
+        assert t.get(99) is None
+        s = t.stats()
+        assert (s.hits, s.misses, s.offloads) == (1, 1, 1)
+        assert s.pages == 1 and s.bytes_used == sum(
+            p.nbytes for p in e.parts
+        )
+
+    def test_group_offer_slices_pages_ignores_padding(self):
+        """One demotion burst: the group's slot axis is sliced per entry
+        and jit-bucket padding slots past the last real page are
+        ignored."""
+        t = HostTier(budget_bytes=1 << 20, inflight_window=0)
+        ps = 2
+        # 3 real pages in a 4-slot bucket (last slot = padding)
+        k = np.concatenate(
+            [np.full((4, ps), float(d), np.float32) for d in (1, 2, 3, 3)],
+            axis=1,
+        )
+        t.offer([(1, 0, 1), (2, 1, 1), (3, 2, 1)], _KIND_RAW,
+                (k, k * 2), page_size=ps)
+        assert t.stats().pages == 3 and t.stats().offloads == 3
+        for h, val in ((1, 1.0), (2, 2.0), (3, 3.0)):
+            e = t.get(h)
+            np.testing.assert_array_equal(
+                e.parts[0], np.full((4, ps), val, np.float32)
+            )
+            np.testing.assert_array_equal(
+                e.parts[1], np.full((4, ps), 2 * val, np.float32)
+            )
+
+    def test_default_window_holds_a_full_gather_bucket(self):
+        """The default in-flight window must be at least the offload
+        hook's largest gather bucket: a full-bucket eviction burst stays
+        un-materialized, so offer() never blocks on the device→host
+        copies it just dispatched (the regression was window 4 < bucket
+        32 — every burst over 4 pages drained its own group
+        synchronously inside allocate())."""
+        cap = LLMEngine._OFFLOAD_BUCKETS[-1]
+        t = HostTier(budget_bytes=1 << 24)
+        k = np.ones((2, cap), np.float32)
+        t.offer([(100 + i, i, 100) for i in range(cap)], _KIND_RAW,
+                (k, k * 2), page_size=1)
+        assert t.stats().pages == 0  # whole burst still in flight
+        assert t.has(100) and t.has(100 + cap - 1)
+        assert t.get(100) is not None  # lookup still drains it
+
+    def test_inflight_window_defers_materialization(self):
+        """Within the window pages stay un-materialized (eviction never
+        blocks on the device→host copy); a HIT drains groups only until
+        the matched page materializes, and a MISS drains nothing — a
+        cold prompt's lookup must not block on unrelated in-flight
+        copies."""
+        t = HostTier(budget_bytes=1 << 20, inflight_window=2)
+        _offer(t, 1, 0, 1, _KIND_RAW, _page(1.0))
+        _offer(t, 2, 1, 1, _KIND_RAW, _page(2.0))
+        assert t.stats().pages == 0  # both still in flight
+        assert t.has(1) and t.has(2)  # but visible
+        _offer(t, 3, 2, 1, _KIND_RAW, _page(3.0))
+        assert t.stats().pages == 1  # window overflow drained the oldest
+        assert t.get(99) is None  # miss: nothing drained
+        assert t.stats().pages == 1
+        assert t.get(2) is not None  # hit: drains up TO the matched group
+        assert t.stats().pages == 2  # page 3 still in flight
+        assert t.get(3) is not None
+        assert t.stats().pages == 3
+
+    def test_multi_group_burst_never_drains_itself(self):
+        """An eviction burst larger than the window spans several
+        offer() calls (new_burst=False continuations) — inside
+        allocate() it must never materialize its OWN still-in-flight
+        copies, even past the window; the NEXT burst drains the
+        overshoot instead (by which time the copies have landed)."""
+        t = HostTier(budget_bytes=1 << 20, inflight_window=2)
+        _offer(t, 1, 0, 1, _KIND_RAW, _page(1.0))
+        t.offer([(2, 1, 1)], _KIND_RAW, _page(2.0), page_size=1,
+                new_burst=False)
+        t.offer([(3, 2, 1)], _KIND_RAW, _page(3.0), page_size=1,
+                new_burst=False)
+        assert t.stats().pages == 0  # 3 pages > window 2: no self-drain
+        assert t.has(1) and t.has(3)
+        _offer(t, 4, 0, 4, _KIND_RAW, _page(4.0))  # next burst
+        assert t.stats().pages == 2  # overshoot drained to the window
+        assert t.get(1) is not None and t.get(2) is not None
+
+    def test_all_duplicate_burst_still_drains_overshoot(self):
+        """A new burst whose pages all dedup away must still pull a
+        previous burst's overshoot back down to the window — the early
+        return on empty ``fresh`` must not skip the drain."""
+        t = HostTier(budget_bytes=1 << 20, inflight_window=2)
+        _offer(t, 1, 0, 1, _KIND_RAW, _page(1.0))
+        for h in (2, 3, 4):
+            t.offer([(h, h - 1, 1)], _KIND_RAW, _page(float(h)),
+                    page_size=1, new_burst=False)
+        assert t.stats().pages == 0  # one 4-page burst: overshoot
+        _offer(t, 1, 0, 1, _KIND_RAW, _page(9.0))  # all-dup new burst
+        assert t.stats().pages == 2  # drained back to the window
+        np.testing.assert_array_equal(  # and kept the first copy
+            t.get(1).parts[0], _page(1.0)[0])
+
+    def test_drain_to_window_materializes_ladder_overshoot(self):
+        """The degradation ladder demotes in ONE burst that can exceed
+        the window with no later traffic to drain it; drain_to_window
+        (called by LLMEngine.evict_cache off the hot path) must
+        materialize the overshoot so the gathered device arrays are
+        released."""
+        t = HostTier(budget_bytes=1 << 20, inflight_window=2)
+        _offer(t, 1, 0, 1, _KIND_RAW, _page(1.0))
+        for h in (2, 3, 4, 5):
+            t.offer([(h, h - 1, 1)], _KIND_RAW, _page(float(h)),
+                    page_size=1, new_burst=False)
+        assert t.stats().pages == 0
+        t.drain_to_window()
+        assert t.stats().pages == 3  # 5 in flight -> window of 2 left
+        t.flush()
+        assert t.stats().pages == 5
+
+    def test_duplicate_offer_keeps_first_copy(self):
+        t = HostTier(budget_bytes=1 << 20)
+        _offer(t, 7, 0, 7, _KIND_RAW, _page(1.0))
+        _offer(t, 7, 0, 7, _KIND_RAW, _page(9.0))
+        np.testing.assert_array_equal(t.get(7).parts[0], _page(1.0)[0])
+
+    def test_budget_eviction_is_front_biased(self):
+        """Within one (probationary) chain the DEEPEST page is the
+        victim: a chain is only matchable from its head, so a retained
+        tail behind a dropped head would be dead weight."""
+        nb = 128  # 2*128 bytes per page
+        t = HostTier(budget_bytes=3 * 2 * nb, inflight_window=0)
+        for d in range(5):  # chain of 5 pages, budget holds 3
+            _offer(t, 100 + d, d, 100, _KIND_RAW, _page(float(d), nb))
+        assert t.stats().pages == 3
+        for d in range(3):  # head survives ...
+            assert t.get(100 + d) is not None
+        for d in (3, 4):  # ... tail evicted
+            assert not t.has(100 + d)
+
+    def test_matched_chain_protected_from_churn(self):
+        """A chain that has seen a ``get`` is re-used traffic: one-touch
+        churn chains must evict first even when the protected chain is
+        older (plain LRU would be scan-poisoned here)."""
+        nb = 128
+        t = HostTier(budget_bytes=4 * 2 * nb, inflight_window=0)
+        _offer(t, 1, 0, 1, _KIND_RAW, _page(1.0, nb))
+        _offer(t, 2, 1, 1, _KIND_RAW, _page(2.0, nb))
+        assert t.get(1) is not None  # protect chain root=1
+        for d in range(6):  # churn: 6 one-touch chains
+            _offer(t, 50 + d, 0, 50 + d, _KIND_RAW, _page(float(d), nb))
+        assert t.has(1) and t.has(2)  # protected chain intact
+        assert t.stats().pages == 4
+
+    def test_repeated_hits_keep_heaps_bounded(self):
+        """get() re-files a hit under a fresh stamp, and a tier that
+        never exceeds its budget never pops stale entries — compaction
+        must bound the lazy heaps by resident pages, not by lifetime
+        hit count."""
+        t = HostTier(budget_bytes=1 << 20, inflight_window=0)
+        for d in range(4):
+            _offer(t, 100 + d, d, 100, _KIND_RAW, _page(float(d)))
+        for _ in range(1000):
+            assert t.get(100) is not None
+        assert (len(t._prob_heap) + len(t._prot_heap)
+                <= 4 * t.stats().pages + 64)
+
+    def test_single_page_over_budget_dropped(self):
+        t = HostTier(budget_bytes=16, inflight_window=0)
+        _offer(t, 1, 0, 1, _KIND_RAW, _page(1.0, 64))
+        assert t.stats().pages == 0 and t.stats().evictions == 1
+
+    def test_clear_drops_everything(self):
+        t = HostTier(budget_bytes=1 << 20, inflight_window=2)
+        _offer(t, 1, 0, 1, _KIND_RAW, _page(1.0))
+        _offer(t, 2, 0, 2, _KIND_RAW, _page(2.0))
+        _offer(t, 3, 0, 3, _KIND_RAW, _page(3.0))
+        assert t.clear() == 3
+        assert t.stats().pages == 0 and t.stats().bytes_used == 0
+        assert not t.has(1)
+
+    def test_rejects_unknown_quant_and_bad_budget(self):
+        with pytest.raises(ValueError):
+            HostTier(budget_bytes=1 << 20, quant="fp4")
+        with pytest.raises(ValueError):
+            HostTier(budget_bytes=0)
+
+
+# ---------------------------------------------------------------------------
+# Allocator demotion hook + LRU clock regression (satellite: Property 11)
+# ---------------------------------------------------------------------------
+
+PCFG = PagedCacheConfig(num_pages=8, page_size=4, max_pages_per_seq=4)
+
+
+class TestAllocatorDemotion:
+    def _cache_one(self, a, tokens):
+        p = a.allocate(-(-len(tokens) // 4))
+        a.publish(tokens, p)
+        a.release(p)
+        return p
+
+    def test_offload_hook_fires_batched_before_reuse(self):
+        """A multi-page reclaim demotes its victims as ONE batch — a
+        single hook call with every PageVictim (page_id, hash, depth,
+        root) while the pages' content is still intact, i.e. before
+        allocate() returns the recycled ids to their next owner."""
+        a = PageAllocator(PCFG)
+        calls = []
+        a.offload_hook = lambda victims: calls.append(list(victims))
+        pages = self._cache_one(a, list(range(8)))  # 2-page chain
+        a.allocate(6)  # drain free list
+        got = a.allocate(2)  # forces both evictions
+        assert sorted(got) == sorted(pages)
+        hashes = chain_hashes(list(range(8)), 4)
+        assert len(calls) == 1  # one burst -> one hook call
+        assert [(v.hash, v.depth) for v in calls[0]] == [(hashes[0], 0),
+                                                         (hashes[1], 1)]
+        assert [v.page_id for v in calls[0]] == pages
+        assert all(v.root == hashes[0] for v in calls[0])
+
+    def test_offload_hook_failure_degrades_to_drop(self):
+        a = PageAllocator(PCFG)
+
+        def boom(*args):
+            raise RuntimeError("host OOM")
+
+        a.offload_hook = boom
+        self._cache_one(a, [1] * 4)
+        a.allocate(7)
+        a.allocate(1)  # eviction survives the hook failure
+        assert a.stats().evictions == 1
+
+    def test_evict_below_demote_flag(self):
+        a = PageAllocator(PCFG)
+        calls = []
+        a.offload_hook = lambda *c: calls.append(c)
+        self._cache_one(a, [1] * 4)
+        self._cache_one(a, [2] * 4)
+        a.evict_below(0.0, demote=False)  # severe rung: drop outright
+        assert calls == []
+        self._cache_one(a, [3] * 4)
+        a.evict_below(0.0)  # default rung: demote
+        assert len(calls) == 1
+
+    def test_matched_then_released_chain_outlives_older_one(self):
+        """Satellite regression (Property 11): match_prefix must refresh
+        the matched chain's clock, so a just-matched-then-released chain
+        is evicted AFTER an older untouched one."""
+        a = PageAllocator(PCFG)
+        p_old = self._cache_one(a, [1] * 4)  # older, never matched
+        p_new = self._cache_one(a, [2] * 4)
+        shared, _ = a.match_prefix([2] * 4)  # touch the newer chain
+        assert shared == p_new
+        a.release(shared)
+        a.allocate(6)  # drain free list
+        assert a.allocate(1) == p_old  # untouched chain is the victim
+        assert a.match_prefix([1] * 4) == ([], 0)
+        s, m = a.match_prefix([2] * 4)
+        assert m == 4  # matched chain survived
+
+
+# ---------------------------------------------------------------------------
+# Engine-level offload → reload (token identity, abort race, rungs)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+
+
+def make_engine(tiny_params, host_tier_bytes=0, host_tier_quant="none",
+                num_pages=10):
+    return LLMEngine(
+        tiny_params, TINY, TOK,
+        EngineConfig(
+            max_batch=2,
+            prefill_buckets=(8, 32),
+            paged=PagedCacheConfig(
+                num_pages=num_pages, page_size=PS, max_pages_per_seq=8
+            ),
+            host_tier_bytes=host_tier_bytes,
+            host_tier_quant=host_tier_quant,
+        ),
+        dtype=jnp.float32,
+    )
+
+
+def run_one(engine, rid, prompt, max_tokens=6):
+    engine.add_request(rid, prompt, SamplingParams(max_tokens=max_tokens,
+                                                   temperature=0.0))
+    tokens = []
+    for _ in range(500):
+        if not engine.has_work():
+            break
+        for out in engine.step():
+            if out.token_id is not None:
+                tokens.append(out.token_id)
+            assert out.error is None, out.error
+    assert not engine.has_work()
+    return tokens
+
+
+PREFIX = list(range(40, 60))  # 5 full pages
+RNG = np.random.default_rng(3)
+
+
+def churn(engine, n=6):
+    """Unique 2-page prompts that cycle the 10-page pool past PREFIX."""
+    for i in range(n):
+        run_one(engine, f"churn{i}{id(engine)}",
+                RNG.integers(100, 200, size=7).tolist(), max_tokens=2)
+
+
+@pytest.mark.parametrize("quant", ["none", "int8"])
+def test_offload_reload_token_identity(tiny_params, quant):
+    """Greedy decode on a prompt whose prefix went HBM → host tier →
+    back must be token-identical to a cold engine (f32 tier exactly;
+    int8 asserts the same on this fixture — per-vector absmax over a
+    4-token page keeps argmax stable on the tiny model)."""
+    cold = make_engine(tiny_params)
+    prompt = PREFIX + [7, 8]
+    want = run_one(cold, "cold", prompt)
+
+    eng = make_engine(tiny_params, host_tier_bytes=1 << 22,
+                      host_tier_quant=quant)
+    run_one(eng, "warm", prompt)  # populate the HBM prefix cache
+    churn(eng)  # cycle the pool: prefix demotes to the host tier
+    host0 = eng.host_tier_stats()
+    assert host0["pages"] + len(eng.host_tier._inflight) > 0
+    got = run_one(eng, "probe", prompt)
+    host1 = eng.host_tier_stats()
+    assert host1["hit_pages"] > 0, "probe did not reload from host tier"
+    assert eng.drain_reload_durations(), "reload duration not recorded"
+    assert got == want
+
+
+def test_reload_reseats_into_hbm(tiny_params):
+    """A host-tier reload re-publishes the pages: the NEXT probe hits
+    them in HBM directly (no second reload)."""
+    eng = make_engine(tiny_params, host_tier_bytes=1 << 22)
+    prompt = PREFIX + [7, 8]
+    run_one(eng, "warm", prompt)
+    churn(eng)
+    run_one(eng, "p1", prompt)
+    hit_pages = eng.host_tier_stats()["hit_pages"]
+    assert hit_pages > 0
+    s0 = eng.cache_stats()
+    run_one(eng, "p2", PREFIX + [9, 10])
+    assert eng.cache_stats().hits > s0.hits  # HBM hit this time
+    assert eng.host_tier_stats()["hit_pages"] == hit_pages  # no reload
+
+
+def test_exact_rematch_counts_only_kept_pages(tiny_params):
+    """Exact re-submission of a page-aligned prompt: the final page is
+    never kept (>= 1 token is always recomputed), so it must not be
+    counted as a prefix hit either — the hit counters feed
+    kv_prefix_hits_total{tier=hbm} and must report pages actually
+    re-used."""
+    eng = make_engine(tiny_params)
+    run_one(eng, "a", PREFIX, max_tokens=2)  # publish the 5-page chain
+    s0 = eng.cache_stats()
+    run_one(eng, "b", PREFIX, max_tokens=2)
+    assert eng.cache_stats().hits - s0.hits == len(PREFIX) // PS - 1
+
+
+def test_abort_races_reload(tiny_params):
+    """Abort around the reload path: aborting a queued request before
+    its prefill, and aborting right after the first token (pages
+    released while freshly re-seated), must leak nothing — the prompt
+    still completes correctly afterwards."""
+    cold = make_engine(tiny_params)
+    prompt = PREFIX + [7, 8]
+    want = run_one(cold, "cold", prompt)
+
+    eng = make_engine(tiny_params, host_tier_bytes=1 << 22)
+    run_one(eng, "warm", prompt)
+    churn(eng)
+    # abort while queued: no step ran, nothing reloaded or leaked
+    eng.add_request("a0", prompt, SamplingParams(max_tokens=4,
+                                                 temperature=0.0))
+    assert eng.abort("a0")
+    assert not eng.has_work()
+    # abort after the first step: prefill reloaded host pages and
+    # re-seated them; releasing keeps them cached, not leaked
+    eng.add_request("a1", prompt, SamplingParams(max_tokens=4,
+                                                 temperature=0.0))
+    eng.step()
+    assert eng.abort("a1")
+    assert not eng.has_work()
+    s = eng.cache_stats()
+    assert s.pages_free + s.pages_cached == s.pages_total  # nothing pinned
+    assert run_one(eng, "after", prompt) == want
+
+
+def test_degradation_rungs_demote_vs_drop(tiny_params):
+    """Engine rungs: AGGRESSIVE eviction demotes HBM pages into the
+    host tier; the EMERGENCY rung drops the host tier too."""
+    eng = make_engine(tiny_params, host_tier_bytes=1 << 22)
+    run_one(eng, "warm", PREFIX + [7, 8])
+    assert eng.cache_stats().pages_cached > 0
+    eng.evict_cache(0.0)  # AGGRESSIVE_CACHE_EVICTION rung
+    eng.host_tier.flush()
+    assert eng.cache_stats().pages_cached == 0
+    assert eng.host_tier_stats()["pages"] > 0  # demoted, not dropped
+    eng.evict_cache(0.0, drop_host_tier=True)  # EMERGENCY rung
+    assert eng.host_tier_stats()["pages"] == 0
+
+
+class _RungRecorder:
+    engine_id = "e0"
+
+    def __init__(self):
+        self.calls = []
+
+    def evict_cache(self, target_frac, drop_host_tier=False):
+        self.calls.append((round(target_frac, 2), drop_host_tier))
+
+
+def test_controller_rungs_route_drop_flag():
+    """Ladder wiring: AGGRESSIVE_CACHE_EVICTION evicts with
+    drop_host_tier=False (demote), EMERGENCY with True (host RAM is the
+    next thing to run out)."""
+    from distributed_inference_server_tpu.serving.dispatcher import Dispatcher
+    from distributed_inference_server_tpu.serving.scheduler import (
+        AdaptiveScheduler,
+    )
+
+    sched = AdaptiveScheduler()
+    rec = _RungRecorder()
+    sched._engines["e0"] = rec
+    ctl = DegradationController(Dispatcher(sched), sched)
+    ctl.evaluate(pressure=0.85)
+    assert ctl.level == DegradationLevel.AGGRESSIVE_CACHE_EVICTION
+    assert rec.calls == [(0.7, False)]
+    ctl.evaluate(pressure=0.99)
+    assert ctl.level == DegradationLevel.EMERGENCY
+    assert rec.calls[-1] == (0.7, True)
+    ctl.evaluate(pressure=0.0)  # recovery: no further evictions
+    assert len(rec.calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: cache_aware strategy + rebalanced memory_aware (satellites)
+# ---------------------------------------------------------------------------
+
+
+def _status(eid, healthy=True, active=0, waiting=0, used=0, total=100,
+            cached=0, digest=None, page_size=PS, role="unified"):
+    return EngineStatus(
+        engine_id=eid, healthy=healthy, active_requests=active,
+        waiting_requests=waiting, total_processed=0,
+        memory_used_pages=used, memory_total_pages=total,
+        pages_cached=cached, prefix_digest=digest, page_size=page_size,
+        role=role,
+    )
+
+
+PROMPT = list(range(32))  # 8 full pages
+HASHES = chain_hashes(PROMPT, PS)
+
+
+class TestCacheAwareRouting:
+    def test_prefix_match_depth_consecutive_from_head(self):
+        full = _status("e", digest=frozenset(HASHES))
+        assert prefix_match_depth(full, HASHES) == len(HASHES)
+        # a hole ends the match even if deeper hashes are present
+        holed = _status("e", digest=frozenset(HASHES[:2] + HASHES[3:]))
+        assert prefix_match_depth(holed, HASHES) == 2
+        assert prefix_match_depth(_status("e"), HASHES) == 0
+        assert prefix_match_depth(full, None) == 0
+
+    def test_deepest_match_wins_over_load(self):
+        statuses = [
+            _status("deep", active=5, digest=frozenset(HASHES[:4])),
+            _status("shallow", active=0, digest=frozenset(HASHES[:1])),
+        ]
+        assert choose_engine(SchedulingStrategy.CACHE_AWARE, statuses, 0,
+                             prefix_hashes=HASHES) == "deep"
+
+    def test_tie_breaks_load_then_id(self):
+        statuses = [
+            _status("busy", active=3, digest=frozenset(HASHES[:2])),
+            _status("idle", active=1, digest=frozenset(HASHES[:2])),
+        ]
+        assert choose_engine(SchedulingStrategy.CACHE_AWARE, statuses, 0,
+                             prefix_hashes=HASHES) == "idle"
+        statuses = [
+            _status("b", active=1, digest=frozenset(HASHES[:2])),
+            _status("a", active=1, digest=frozenset(HASHES[:2])),
+        ]
+        assert choose_engine(SchedulingStrategy.CACHE_AWARE, statuses, 0,
+                             prefix_hashes=HASHES) == "a"
+
+    def test_no_match_degrades_to_least_loaded(self):
+        statuses = [
+            _status("e0", active=4),
+            _status("e1", active=1, digest=frozenset({123456})),
+        ]
+        got = choose_engine(SchedulingStrategy.CACHE_AWARE, statuses, 0,
+                            prefix_hashes=HASHES)
+        assert got == choose_engine(SchedulingStrategy.LEAST_LOADED,
+                                    statuses, 0) == "e1"
+
+    def test_composes_with_disagg_roles(self):
+        """The warm engine is picked among prefill/unified candidates; a
+        warm DECODE engine is invisible to admission routing."""
+        statuses = [
+            _status("decode-warm", digest=frozenset(HASHES), role="decode"),
+            _status("prefill-cold", role="prefill"),
+        ]
+        assert choose_engine(
+            SchedulingStrategy.CACHE_AWARE, statuses, 0,
+            roles=("prefill", "unified"), prefix_hashes=HASHES,
+        ) == "prefill-cold"
+
+    def test_unhealthy_excluded(self):
+        statuses = [
+            _status("warm-down", healthy=False, digest=frozenset(HASHES)),
+            _status("cold-up"),
+        ]
+        assert choose_engine(SchedulingStrategy.CACHE_AWARE, statuses, 0,
+                             prefix_hashes=HASHES) == "cold-up"
+
+
+class TestMemoryAwareCachedPages:
+    def test_cached_pages_count_as_free(self):
+        """Satellite: a pool full of reclaimable cache is effectively
+        free — memory_aware scores on used - cached."""
+        statuses = [
+            _status("cachey", used=90, cached=80),  # live 10
+            _status("lively", used=40, cached=0),  # live 40
+        ]
+        assert choose_engine(SchedulingStrategy.MEMORY_AWARE, statuses,
+                             0) == "cachey"
+
+    def test_tie_break_order_pinned(self):
+        """Effective-free ties break on load, then engine_id — in that
+        order."""
+        statuses = [
+            _status("b", used=50, cached=30, active=2),  # live 20
+            _status("a", used=20, cached=0, active=1),  # live 20
+        ]
+        assert choose_engine(SchedulingStrategy.MEMORY_AWARE, statuses,
+                             0) == "a"  # load breaks the tie
+        statuses = [
+            _status("b", used=20, active=1),
+            _status("a", used=20, active=1),
+        ]
+        assert choose_engine(SchedulingStrategy.MEMORY_AWARE, statuses,
+                             0) == "a"  # id breaks the tie
