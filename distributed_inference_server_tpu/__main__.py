@@ -40,6 +40,16 @@ def main(argv=None) -> int:
         print(f"config error: {e}", file=sys.stderr)
         return 2
 
+    # fault injection (docs/RESILIENCE.md): armed only when faults.spec
+    # is set (config file, DIS_TPU_FAULTS__SPEC env, or --faults-spec) —
+    # chaos/soak tooling only, never production
+    faults_spec = cfg.get("faults", "spec")
+    if faults_spec:
+        from distributed_inference_server_tpu.serving import faults
+
+        faults.install(faults.parse_spec(faults_spec,
+                                         cfg.get("faults", "seed")))
+
     # multi-host data plane: connect to the fleet BEFORE any backend
     # touches devices (parallel/distributed.py; SURVEY §5 two-plane design)
     nproc = cfg.get("distributed", "num_processes")
@@ -237,6 +247,9 @@ def main(argv=None) -> int:
             validator_config=validator_cfg,
             auto_restart=cfg.get("server", "auto_restart"),
             health_check_interval_s=cfg.get("server", "health_check_interval_s"),
+            restart_backoff_s=cfg.get("server", "restart_backoff_s"),
+            restart_backoff_max_s=cfg.get("server", "restart_backoff_max_s"),
+            max_redispatch=cfg.get("server", "max_redispatch"),
             otlp_endpoint=cfg.get("tracing", "otlp_endpoint"),
             otlp_service_name=cfg.get("tracing", "service_name"),
             # disaggregated prefill/decode serving (docs/DISAGG.md)

@@ -40,7 +40,7 @@ import os
 import time
 from collections import deque
 from dataclasses import dataclass, field, replace as dc_replace
-from typing import Callable, Deque, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -731,6 +731,28 @@ class LLMEngine:
 
     def cache_stats(self):
         return self.allocator.stats()
+
+    def audit_pages(self, extra_pages: Sequence[int] = ()) -> List[str]:
+        """KV-page conservation audit (docs/RESILIENCE.md): collect every
+        page id a live sequence holds — waiting, active, handoff-ready,
+        and mid-export sequences are all in ``_by_id``; sliding-window
+        sentinels are not pages — plus ``extra_pages`` (the runner passes
+        its open import sessions' reservations), and prove against the
+        allocator that every page is exactly one of free / cached /
+        live-held with matching refcounts. Engine-thread only (the
+        allocator is single-owner). Returns inconsistency strings; the
+        native allocator tier has no audit surface and reports clean."""
+        if not isinstance(self.allocator, PageAllocator):
+            return []
+        sentinel = self.pcfg.num_pages
+        live: List[int] = [
+            p
+            for s in self._by_id.values()
+            for p in s.block_table
+            if p != sentinel
+        ]
+        live.extend(extra_pages)
+        return self.allocator.audit(live)
 
     # ------------------------------------------------------------------
     # host-tier prefix cache (engine/kv_cache.py HostTier; ISSUE 5)

@@ -60,6 +60,19 @@ from distributed_inference_server_tpu.ops.quant import (
 logger = logging.getLogger(__name__)
 
 
+def _fault(point: str) -> bool:
+    """Fault-injection trampoline (serving/faults.py, docs/RESILIENCE.md).
+    The engine layer cannot import the serving package at module-import
+    time (serving/__init__ imports disagg, which imports the engine), so
+    the first runtime call resolves the real ``fire`` and rebinds this
+    name — after which an injection point costs exactly what it costs in
+    the serving layer: one global load and a None check."""
+    global _fault
+    from distributed_inference_server_tpu.serving.faults import fire
+    _fault = fire
+    return fire(point)
+
+
 # ---------------------------------------------------------------------------
 # Device-side page pool
 # ---------------------------------------------------------------------------
@@ -446,6 +459,93 @@ class PageAllocator:
         return frozenset(
             h for h, e in self._by_hash.items() if e.depth < max_depth
         )
+
+    # -- consistency audit (chaos invariant checks, docs/RESILIENCE.md) ----
+
+    def audit(self, live_pages: Optional[Sequence[int]] = None) -> List[str]:
+        """Cross-check the allocator's books; returns inconsistency
+        strings (empty = clean). Always checked: free-list uniqueness
+        and range, free ∩ content-addressed = ∅, the ``_by_hash`` ↔
+        ``_by_page`` bijection, LRU ⊆ addressed with matching hashes,
+        and refcount-0 ⇔ LRU-resident for addressed pages.
+
+        ``live_pages`` — every page id currently referenced by a live
+        holder (sequences' block tables, import sessions' reservations),
+        with multiplicity — additionally proves CONSERVATION: every page
+        is exactly one of free / cached / live-held (anything else is a
+        leak: allocated but unreachable, so it can never be released),
+        and each addressed page's refcount equals its holder count."""
+        issues: List[str] = []
+        total = self.cfg.num_pages
+
+        def bad(msg: str) -> None:
+            issues.append(msg)
+
+        free = list(self._free)
+        free_set = set(free)
+        if len(free_set) != len(free):
+            bad(f"free list holds duplicates ({len(free) - len(free_set)})")
+        for pid in free_set:
+            if not (0 <= pid < total):
+                bad(f"free page {pid} out of range [0, {total})")
+            if pid in self._by_page:
+                bad(f"page {pid} is both free and content-addressed")
+        for h, entry in self._by_hash.items():
+            back = self._by_page.get(entry.page_id)
+            if back is None or back[0] != h or back[1] is not entry:
+                bad(f"hash {h:#x} -> page {entry.page_id} has no matching "
+                    "_by_page entry")
+            if entry.refcount < 0:
+                bad(f"page {entry.page_id} refcount {entry.refcount} < 0")
+        for pid, (h, entry) in self._by_page.items():
+            if self._by_hash.get(h) is not entry:
+                bad(f"_by_page entry for page {pid} not in _by_hash")
+            in_lru = pid in self._lru
+            if entry.refcount == 0 and not in_lru:
+                bad(f"cached page {pid} (refcount 0) missing from LRU")
+            if entry.refcount > 0 and in_lru:
+                bad(f"held page {pid} (refcount {entry.refcount}) still "
+                    "in LRU")
+        for pid, h in self._lru.items():
+            entry = self._by_page.get(pid)
+            if entry is None:
+                bad(f"LRU page {pid} is not content-addressed")
+            elif entry[0] != h:
+                bad(f"LRU page {pid} hash mismatch")
+
+        if live_pages is not None:
+            held: Dict[int, int] = {}
+            for pid in live_pages:
+                held[pid] = held.get(pid, 0) + 1
+            for pid, count in held.items():
+                if not (0 <= pid < total):
+                    bad(f"live page {pid} out of range [0, {total})")
+                    continue
+                if pid in free_set:
+                    bad(f"live page {pid} is on the free list "
+                        "(use-after-free)")
+                addressed = self._by_page.get(pid)
+                if addressed is not None:
+                    if addressed[1].refcount != count:
+                        bad(f"page {pid}: refcount "
+                            f"{addressed[1].refcount} != {count} live "
+                            "holders")
+                elif count != 1:
+                    bad(f"unaddressed page {pid} held by {count} holders "
+                        "(pages can only be shared once published)")
+            for pid, (h, entry) in self._by_page.items():
+                if entry.refcount > 0 and held.get(pid, 0) == 0:
+                    bad(f"page {pid}: refcount {entry.refcount} with no "
+                        "live holder (leaked reference)")
+            accounted = (len(free_set) + len(self._lru)
+                         + len(set(held) - set(self._lru)))
+            if accounted != total:
+                bad(f"conservation: {len(free_set)} free + "
+                    f"{len(self._lru)} cached + "
+                    f"{len(set(held) - set(self._lru))} live = "
+                    f"{accounted}, pool has {total} "
+                    f"({total - accounted:+d} leaked)")
+        return issues
 
 
 # ---------------------------------------------------------------------------
@@ -855,6 +955,9 @@ class KvImportSession:
     def add_chunk(self, chunk: KvChunk) -> None:
         if self._closed:
             raise CacheDeserializationError("import session already closed")
+        # injected import-validation failure (docs/RESILIENCE.md): the
+        # session's owner must abort() and release every reserved page
+        _fault("kv.import_chunk")
         if chunk_crc(chunk.payload) != chunk.crc32:
             raise CacheDeserializationError(
                 f"chunk {chunk.index}: crc mismatch (corrupt payload)"
@@ -1126,6 +1229,10 @@ class HostTier:
         previous ``offer``'s burst (one multi-group eviction burst must
         never block on its own in-flight copies); a window of 0 drains
         everything synchronously."""
+        # injected host-copy failure (docs/RESILIENCE.md): the whole
+        # demotion burst drops instead of demoting — the allocator's
+        # hook boundary absorbs it, eviction itself never fails
+        _fault("kv.host_copy")
         if new_burst:
             self._burst += 1
         fresh = [

@@ -61,6 +61,14 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "strategy": (str, "least_loaded"),
         "auto_restart": (bool, True),
         "health_check_interval_s": (float, 1.0),
+        # failed auto-restarts back off exponentially (jittered, capped)
+        # instead of retrying every health sweep (docs/RESILIENCE.md)
+        "restart_backoff_s": (float, 1.0),
+        "restart_backoff_max_s": (float, 30.0),
+        # crash-safe redispatch budget: how many times a zero-token
+        # in-flight request may be moved off a dead engine before it
+        # fails to its client; 0 = off (docs/RESILIENCE.md)
+        "max_redispatch": (int, 2),
         "drain_timeout_s": (float, 30.0),
     },
     "model": {
@@ -146,6 +154,16 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # (per-vector absmax codes + f32 scales — halves-plus the bytes
         # moved, bounded accuracy cost; quantized pools pass through)
         "wire_quant": (str, "none"),
+    },
+    "faults": {
+        # fault injection (serving/faults.py; docs/RESILIENCE.md):
+        # semicolon-separated "point:key=val,..." rules, e.g.
+        # "disagg.chunk:nth=3;runner.step:prob=0.01". "" = disarmed (the
+        # production default — injection points are a global load + None
+        # check). Reachable via env as DIS_TPU_FAULTS__SPEC. Never arm
+        # in production.
+        "spec": (str, ""),
+        "seed": (int, 0),
     },
     "tracing": {
         # OTLP/HTTP collector URL for span export (utils/otlp.py), e.g.
@@ -443,6 +461,26 @@ class ServerConfig:
                 f"disagg.wire_quant must be none/int8, "
                 f"got {r['disagg']['wire_quant']!r}"
             )
+        if r["server"]["max_redispatch"] < 0:
+            raise ConfigError("server.max_redispatch must be >= 0")
+        if r["server"]["restart_backoff_s"] <= 0:
+            raise ConfigError("server.restart_backoff_s must be positive")
+        if (r["server"]["restart_backoff_max_s"]
+                < r["server"]["restart_backoff_s"]):
+            raise ConfigError(
+                "server.restart_backoff_max_s must be >= "
+                "server.restart_backoff_s"
+            )
+        if r["faults"]["spec"]:
+            from distributed_inference_server_tpu.serving.faults import (
+                FaultSpecError,
+                parse_spec,
+            )
+
+            try:
+                parse_spec(r["faults"]["spec"], r["faults"]["seed"])
+            except FaultSpecError as e:
+                raise ConfigError(f"faults.spec: {e}") from None
         if r["cache"]["host_tier_bytes"] < 0:
             raise ConfigError("cache.host_tier_bytes must be >= 0")
         if r["cache"]["host_tier_quant"] not in ("none", "int8"):

@@ -51,7 +51,7 @@ from distributed_inference_server_tpu.engine.engine import (
     SequenceExport,
 )
 from distributed_inference_server_tpu.engine.kv_cache import KvChunk
-from distributed_inference_server_tpu.serving import protowire
+from distributed_inference_server_tpu.serving import faults, protowire
 from distributed_inference_server_tpu.serving.metrics import MetricsCollector
 
 logger = logging.getLogger(__name__)
@@ -612,6 +612,16 @@ class DisaggController:
         Failure just flips the job to "failed" — the source sequence
         never stopped decoding, so there is nothing to fall back FROM."""
         try:
+            # injection points (docs/RESILIENCE.md): disagg.chunk hits
+            # once per chunk, so nth=N fails the transfer at its Nth
+            # chunk — the channel API is batch-synchronous (the target
+            # opens with the COMPLETE prefix or not at all), so this
+            # models "the stream died partway" from the fleet's view;
+            # target-side partial-import abort is kv.import_chunk's
+            # domain. disagg.slow_peer stalls (delay_ms rule).
+            faults.fire("disagg.slow_peer")
+            for _ in job.chunks:
+                faults.fire("disagg.chunk")
             wired = self.channel.transfer_chunks(
                 job.request_id, job.wire_quant, job.chunks
             )
@@ -703,6 +713,13 @@ class DisaggController:
         n_prefix = len(job.chunks)
         try:
             tail = (mjob.exp.kv_chunks or [])[n_prefix:]
+            # commit dropped on the channel (docs/RESILIENCE.md): the
+            # target holds the prefix but the switchover delta never
+            # lands — the source must resume in place from the full
+            # export it still carries
+            faults.fire("disagg.commit")
+            for _ in tail:
+                faults.fire("disagg.chunk")
             wired = self.channel.transfer_commit(mjob.exp, tail)
         except Exception as e:  # noqa: BLE001 — channel fault domain
             if job.target is not None:
@@ -762,6 +779,10 @@ class DisaggController:
                 return
             job.attempts += 1
             try:
+                faults.fire("disagg.slow_peer")
+                faults.fire("disagg.transfer")
+                for _ in job.exp.kv_chunks or ():
+                    faults.fire("disagg.chunk")
                 wired = self.channel.transfer(job.exp)
             except Exception as e:  # noqa: BLE001 — channel fault domain
                 last_err = f"channel {self.channel.name}: {e}"

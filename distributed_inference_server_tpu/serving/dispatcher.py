@@ -91,13 +91,19 @@ class Dispatcher:
         native_queue: Optional[bool] = None,
         tracer=None,
         disagg=None,
+        max_redispatch: int = 2,
     ):
         """``disagg``: the DisaggController when the topology is
         disaggregated (serving/disagg.py) — its migration queue counts
-        toward drain, and aborts reach requests parked there."""
+        toward drain, and aborts reach requests parked there.
+        ``max_redispatch``: crash-safe redispatch budget per request
+        (docs/RESILIENCE.md) — how many times a zero-token in-flight
+        request may be moved off a dead engine before it fails to its
+        client; 0 disables redispatch."""
         self.scheduler = scheduler
         self.disagg = disagg
         self.tracer = tracer
+        self.max_redispatch = max_redispatch
         self.queue: PriorityQueueManager[ServerRequest] = _make_queue(
             queue_config, native_queue
         )
@@ -175,6 +181,49 @@ class Dispatcher:
             d = self.queue.queue_depth()
             self.metrics.set_queue_depth(d.high, d.normal, d.low)
 
+    def redispatch(self, request: ServerRequest, from_engine: str,
+                   reason: str) -> bool:
+        """Crash-safe redispatch (docs/RESILIENCE.md): a runner died
+        with ``request`` in flight having streamed ZERO tokens — re-run
+        it from scratch on a healthy replica, invisibly to the client.
+        Called from the dead runner's ``_fail_all_of`` (any thread);
+        returns True when this dispatcher took ownership (the request
+        will reach exactly one terminal event on its new replica), False
+        when the caller must fail it to its sink (drain/shutdown,
+        attempt budget exhausted, or no healthy replica).
+
+        Exactly-once is structural: the caller already removed the
+        request from its own in-flight map, and ``runner.submit``
+        re-registers it with exactly one new owner. A submit that races
+        the new replica's own crash re-enters here with the attempt
+        counter already bumped, so the recursion is bounded by
+        ``max_redispatch`` no matter how many replicas fail."""
+        if self.max_redispatch <= 0:
+            return False  # feature off: not an "exhausted" budget
+        if not self._accepting:
+            return False  # draining: the crash error is the truth
+        if request.redispatches >= self.max_redispatch:
+            if self.metrics:
+                self.metrics.record_redispatch("exhausted")
+            return False
+        runner = self.scheduler.schedule(request.prompt_ids)
+        if runner is None:
+            if self.metrics:
+                self.metrics.record_redispatch("exhausted")
+            return False
+        request.redispatches += 1
+        if self.tracer and request.span is not None:
+            request.span.set(redispatch_from=from_engine,
+                             redispatch_to=runner.engine_id,
+                             redispatch_reason=reason)
+            request.span.event("redispatched")
+        runner.submit([request])
+        # counted only after submit took the request — a submit that
+        # raises is NOT an "ok" outcome (the caller fails the sink)
+        if self.metrics:
+            self.metrics.record_redispatch("ok")
+        return True
+
     def abort(self, request_id: RequestId) -> None:
         """Client disconnect: drop from queue or the batching window if not
         yet dispatched, else tell every engine (only the owner will find
@@ -247,7 +296,10 @@ class Dispatcher:
             if self.tracer:
                 for r in requests:
                     if r.span is not None:
-                        r.span.event("dispatch_failed", reason="no_workers")
+                        # Span.event takes only a name; the reason rides
+                        # as an attribute
+                        r.span.set(dispatch_failed="no_workers")
+                        r.span.event("dispatch_failed")
             for r in requests:
                 r.sink.on_error("no healthy inference engine available",
                                 "no_workers")
@@ -268,6 +320,15 @@ class Dispatcher:
 
     def _sweep(self, now: float) -> None:
         """Expire queued requests older than the timeout → 408
-        (Property 8; Req 3.3 requirements.md:59)."""
-        for q in self.queue.remove_expired(now):
-            q.data.sink.on_error("Request timeout", "request_timeout")
+        (Property 8; Req 3.3 requirements.md:59). The sink code is the
+        DISTINCT ``queue_timeout`` — "the fleet never even started your
+        request" is actionable (retry elsewhere / shed load) in a way a
+        generic failure is not — and every expiry counts into
+        ``requests_expired_total``."""
+        expired = self.queue.remove_expired(now)
+        for q in expired:
+            q.data.sink.on_error(
+                "request expired in queue before dispatch", "queue_timeout"
+            )
+        if expired and self.metrics:
+            self.metrics.record_expired(len(expired))

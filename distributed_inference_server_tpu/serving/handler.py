@@ -65,7 +65,10 @@ from distributed_inference_server_tpu.serving.streamer import (
 
 
 def _error_to_api(message: str, code: str) -> ApiError:
-    if code == "request_timeout":
+    if code in ("request_timeout", "queue_timeout"):
+        # queue_timeout: the dispatcher sweep expired the request before
+        # any engine started it (serving/dispatcher.py _sweep) — same
+        # 408 surface, distinct code on the error body
         return RequestTimeoutApiError()
     return InternalApiError(message)
 

@@ -108,6 +108,10 @@ class MetricsSnapshot:
     # reached /server/stats before): hit/miss/eviction totals, per-tier
     # prefix hits, and host-tier reload cost
     cache: Optional[Dict[str, Any]] = None
+    # resilience block (docs/RESILIENCE.md; None until any restart,
+    # redispatch, or queue expiry happened): per-engine restart attempts,
+    # redispatch outcomes, and queue-timeout expiries
+    resilience: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out = {
@@ -127,6 +131,8 @@ class MetricsSnapshot:
             out["disagg"] = self.disagg
         if self.cache is not None:
             out["cache"] = self.cache
+        if self.resilience is not None:
+            out["resilience"] = self.resilience
         return out
 
 
@@ -284,6 +290,28 @@ class MetricsCollector:
             "Errors absorbed at isolation boundaries, by site", ["site"],
             registry=r,
         )
+        # resilience surfaces (docs/RESILIENCE.md): restart churn and the
+        # crash-safe redispatch path must be queryable — a crash-looping
+        # engine or an exhausted redispatch budget is an operator page,
+        # not a log line
+        self.engine_restarts = Counter(
+            "engine_restarts_total",
+            "Engine replica restart attempts by the health loop",
+            ["engine_id"], registry=r,
+        )
+        self.redispatches = Counter(
+            "requests_redispatched_total",
+            "Zero-token in-flight requests moved off a dead engine "
+            "(ok = resubmitted to a healthy replica, exhausted = attempt "
+            "budget or healthy capacity ran out)", ["outcome"],
+            registry=r,
+        )
+        self.requests_expired = Counter(
+            "requests_expired_total",
+            "Queued requests expired by the dispatcher sweep before "
+            "dispatch (queue_timeout)",
+            registry=r,
+        )
 
         # snapshot internals
         self._total_requests = 0
@@ -304,6 +332,9 @@ class MetricsCollector:
         self._handoff_chunks = 0
         self._stall_sum = 0.0
         self._stall_count = 0
+        self._engine_restarts: Dict[str, int] = {}
+        self._redispatches: Dict[str, int] = {}
+        self._requests_expired = 0
 
     # -- recording ---------------------------------------------------------
 
@@ -423,6 +454,36 @@ class MetricsCollector:
                 self._stall_sum += stall_s
                 self._stall_count += 1
 
+    def record_engine_restart(self, engine_id: str) -> None:
+        """One health-loop restart attempt of ``engine_id`` (counted at
+        attempt start — a crash loop shows up even while it never
+        succeeds)."""
+        self.engine_restarts.labels(engine_id=engine_id).inc()
+        with self._lock:
+            self._engine_restarts[engine_id] = (
+                self._engine_restarts.get(engine_id, 0) + 1
+            )
+
+    def record_redispatch(self, outcome: str) -> None:
+        """One crash-safe redispatch decision (serving/dispatcher.py):
+        ``outcome`` is "ok" (request resubmitted to a healthy replica)
+        or "exhausted" (attempt budget or healthy capacity ran out and
+        the request failed to its sink)."""
+        self.redispatches.labels(outcome=outcome).inc()
+        with self._lock:
+            self._redispatches[outcome] = (
+                self._redispatches.get(outcome, 0) + 1
+            )
+
+    def record_expired(self, n: int = 1) -> None:
+        """``n`` queued requests expired by the dispatcher sweep
+        (resolved to their sinks with the ``queue_timeout`` code)."""
+        if n <= 0:
+            return
+        self.requests_expired.inc(n)
+        with self._lock:
+            self._requests_expired += n
+
     def record_error(self, site: str) -> None:
         """Count an error absorbed at an isolation boundary (``site`` is a
         stable dotted label, e.g. "runner.sink_error")."""
@@ -489,6 +550,14 @@ class MetricsCollector:
                 "host_tier_bytes": host_bytes,
                 "host_tier_pages": host_pages,
             }
+            resilience = None
+            if (self._engine_restarts or self._redispatches
+                    or self._requests_expired):
+                resilience = {
+                    "engine_restarts": dict(self._engine_restarts),
+                    "redispatched": dict(self._redispatches),
+                    "requests_expired": self._requests_expired,
+                }
             disagg = None
             if self._handoffs or any(
                 s.role != "unified" for s in engine_statuses
@@ -523,4 +592,5 @@ class MetricsCollector:
                 uptime_seconds=now - self._started_at,
                 disagg=disagg,
                 cache=cache,
+                resilience=resilience,
             )

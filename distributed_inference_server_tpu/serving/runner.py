@@ -34,6 +34,7 @@ from distributed_inference_server_tpu.engine.engine import (
     SamplingParams,
     StepOutput,
 )
+from distributed_inference_server_tpu.serving import faults
 from distributed_inference_server_tpu.serving.metrics import (
     EngineStatus,
     MetricsCollector,
@@ -59,7 +60,7 @@ class ServerRequest:
     """A validated, tokenized request handed to the serving spine."""
 
     __slots__ = ("request_id", "prompt_ids", "params", "sink", "submitted_at",
-                 "first_token_at", "span", "engine_span")
+                 "first_token_at", "span", "engine_span", "redispatches")
 
     def __init__(
         self,
@@ -79,6 +80,10 @@ class ServerRequest:
         # engine child span owned by the runner
         self.span = span
         self.engine_span = None
+        # crash-safe redispatch attempts consumed (docs/RESILIENCE.md):
+        # bounded by the dispatcher so a systemic crash cannot bounce a
+        # request around the fleet forever
+        self.redispatches = 0
 
 
 class EngineRunner:
@@ -106,6 +111,14 @@ class EngineRunner:
         self._factory = engine_factory
         self.metrics = metrics
         self.tracer = tracer
+        # crash-safe redispatch hook (docs/RESILIENCE.md): the server
+        # wires this to Dispatcher.redispatch. Called from _fail_all_of
+        # for an in-flight request that streamed ZERO tokens; returns
+        # True when it took ownership (the request will terminate on
+        # another replica — this runner must NOT also resolve its sink).
+        self.redispatch: Optional[
+            Callable[[ServerRequest, str, str], bool]
+        ] = None
         self._inbox: Deque[Callable[[], None]] = deque()
         self._inbox_lock = threading.Lock()
         self._wake = threading.Event()
@@ -731,6 +744,30 @@ class EngineRunner:
     def is_healthy(self) -> bool:
         return self._healthy
 
+    def audit(self, timeout_s: float = 30.0) -> List[str]:
+        """KV-page conservation audit (docs/RESILIENCE.md): run
+        ``LLMEngine.audit_pages`` on the engine thread (allocator state
+        is single-owner), counting open import sessions' reserved pages
+        as live holders. Returns inconsistency strings — empty = clean.
+        Unhealthy engines audit vacuously clean (their pool died with
+        them and is rebuilt on restart)."""
+        if not self._healthy:
+            return []
+        box: Dict[str, List[str]] = {}
+        done = threading.Event()
+
+        def _do() -> None:
+            extra = [p for (session, _eng) in self._import_sessions.values()
+                     for p in session.pages]
+            box["issues"] = self._engine.audit_pages(extra)
+            done.set()
+
+        self._post(_do)
+        if not done.wait(timeout_s):
+            return [f"{self.engine_id}: audit timed out after {timeout_s}s "
+                    "(engine thread wedged?)"]
+        return box["issues"]
+
     def last_error(self) -> Optional[str]:
         return self._last_error
 
@@ -808,6 +845,10 @@ class EngineRunner:
                     worked = True
                     t0 = time.monotonic()
                     outputs = self._engine.step()
+                    # crash mid-step (docs/RESILIENCE.md): outputs were
+                    # computed but none reached a sink — the nastiest
+                    # window for the exactly-once termination contract
+                    faults.fire("runner.step")
                     dt = time.monotonic() - t0
                     if self.metrics:
                         self.metrics.record_inference(dt)
@@ -855,6 +896,12 @@ class EngineRunner:
                 if not self._inbox:
                     return
                 fn = self._inbox.popleft()
+            # crash between submit and drain (docs/RESILIENCE.md):
+            # requests sit in _inflight but the engine never saw them —
+            # zero tokens streamed, so they are redispatchable. Fired
+            # OUTSIDE the per-command try: an injected fault here kills
+            # the runner, it is not a per-request failure.
+            faults.fire("runner.inbox")
             try:
                 fn()
             except Exception as e:  # noqa: BLE001 — command isolation
@@ -1025,12 +1072,39 @@ class EngineRunner:
                     self._absorbed("embed_callback", e)
 
     def _fail_all_of(self, reqs: Sequence[ServerRequest], message: str) -> None:
+        """Resolve dead in-flight requests, exactly once each, by
+        construction: every request is popped from ``_inflight`` FIRST
+        (this runner can never resolve it twice), then takes exactly one
+        of two terminal paths —
+
+        - **redispatch** (zero streamed tokens only): the dispatcher
+          takes ownership and the request terminates on another replica
+          — or fails there, once, if the fleet is really out of capacity;
+        - **sink failure**: ``worker_failure`` for zero-token requests
+          the dispatcher declined (shutdown / attempts exhausted / no
+          healthy replica), ``engine_crashed`` — a distinct, client-
+          distinguishable code — for requests that already streamed
+          tokens, which can never be transparently re-run (a re-run
+          could emit a diverging continuation mid-stream)."""
         for req in reqs:
-            try:
-                req.sink.on_error(message, "worker_failure")
-            except Exception as e:  # noqa: BLE001
-                self._absorbed("sink_error", e)
+            if self._inflight.pop(req.request_id, None) is None:
+                # another failure path already owns this request (e.g.
+                # submit() raced the engine thread's crash and both
+                # reached here) — resolving it again would double-
+                # terminate or double-redispatch
+                continue
             if self.tracer and req.engine_span is not None:
                 self.tracer.finish(req.engine_span, status="error")
                 req.engine_span = None
-            self._inflight.pop(req.request_id, None)
+            if req.first_token_at is None and self.redispatch is not None:
+                try:
+                    if self.redispatch(req, self.engine_id, message):
+                        continue  # the new owner resolves the sink
+                except Exception as e:  # noqa: BLE001 — hook isolation
+                    self._absorbed("redispatch", e)
+            code = ("worker_failure" if req.first_token_at is None
+                    else "engine_crashed")
+            try:
+                req.sink.on_error(message, code)
+            except Exception as e:  # noqa: BLE001
+                self._absorbed("sink_error", e)

@@ -23,11 +23,16 @@ from __future__ import annotations
 
 import enum
 import logging
+import random
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
-from distributed_inference_server_tpu.serving.metrics import EngineStatus
+from distributed_inference_server_tpu.serving import faults
+from distributed_inference_server_tpu.serving.metrics import (
+    EngineStatus,
+    MetricsCollector,
+)
 from distributed_inference_server_tpu.serving.runner import EngineRunner
 
 logger = logging.getLogger(__name__)
@@ -130,13 +135,28 @@ class AdaptiveScheduler:
         strategy: SchedulingStrategy = SchedulingStrategy.LEAST_LOADED,
         health_check_interval_s: float = 1.0,
         auto_restart: bool = False,
+        metrics: Optional[MetricsCollector] = None,
+        restart_backoff_s: float = 1.0,
+        restart_backoff_max_s: float = 30.0,
     ):
+        """``restart_backoff_s``/``restart_backoff_max_s``: after a
+        FAILED restart the next attempt waits ``backoff`` (doubling per
+        consecutive failure, jittered, capped at the max) instead of
+        retrying every health sweep — a crash-looping engine factory
+        must not hot-spin the health loop (docs/RESILIENCE.md)."""
         self._strategy = strategy
         self._engines: Dict[str, EngineRunner] = {}
         self._lock = threading.Lock()
         self._rr = 0
         self._interval = health_check_interval_s
         self._auto_restart = auto_restart
+        self.metrics = metrics
+        self._backoff_base = restart_backoff_s
+        self._backoff_cap = restart_backoff_max_s
+        # engine_id -> (not_before monotonic time, last delay); guarded
+        # by _lock (written from restart threads, read by the health
+        # loop)
+        self._backoff: Dict[str, Tuple[float, float]] = {}
         self._stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
         self._restarting: set = set()
@@ -273,10 +293,24 @@ class AdaptiveScheduler:
     def _health_loop(self) -> None:
         while not self._stop.wait(self._interval):
             for runner in self.engines():
-                if runner.is_healthy() or not self._auto_restart:
+                healthy = runner.is_healthy()
+                if healthy and self._auto_restart and faults.flag(
+                        "sched.health_flap"):
+                    # injected health flap (docs/RESILIENCE.md): the
+                    # loop sees a live replica as down for one sweep and
+                    # restarts it — the chaos path for "monitoring lied"
+                    logger.warning("injected health flap: restarting "
+                                   "healthy engine %s", runner.engine_id)
+                    healthy = False
+                if healthy or not self._auto_restart:
                     continue
                 if runner.engine_id in self._restarting:
                     continue
+                with self._lock:
+                    not_before = self._backoff.get(
+                        runner.engine_id, (0.0, 0.0))[0]
+                if time.monotonic() < not_before:
+                    continue  # backing off after a failed restart
                 self._restarting.add(runner.engine_id)
                 t = threading.Thread(
                     target=self._restart_one, args=(runner,), daemon=True
@@ -284,12 +318,27 @@ class AdaptiveScheduler:
                 t.start()
 
     def _restart_one(self, runner: EngineRunner) -> None:
+        eid = runner.engine_id
+        if self.metrics:
+            self.metrics.record_engine_restart(eid)
         try:
             runner.restart(wait_ready=True)
-        except Exception:  # noqa: BLE001 — keep retrying on next sweep
+        except Exception:  # noqa: BLE001 — retry after backoff
+            with self._lock:
+                last = self._backoff.get(eid, (0.0, 0.0))[1]
+                delay = (self._backoff_base if last <= 0.0
+                         else min(last * 2.0, self._backoff_cap))
+                # jitter up to +25% so a fleet of replicas that died
+                # together does not retry (and re-fail) in lockstep
+                wake = delay * (1.0 + 0.25 * random.random())
+                self._backoff[eid] = (time.monotonic() + wake, delay)
             logger.exception(
-                "engine %s restart failed; retrying on the next health "
-                "sweep", runner.engine_id,
+                "engine %s restart failed; next attempt in %.1fs "
+                "(backoff %.1fs, cap %.1fs)", eid, wake, delay,
+                self._backoff_cap,
             )
+        else:
+            with self._lock:
+                self._backoff.pop(eid, None)
         finally:
-            self._restarting.discard(runner.engine_id)
+            self._restarting.discard(eid)

@@ -62,6 +62,9 @@ class InferenceServer:
         validator_config: Optional[ValidatorConfig] = None,
         auto_restart: bool = True,
         health_check_interval_s: float = 1.0,
+        restart_backoff_s: float = 1.0,
+        restart_backoff_max_s: float = 30.0,
+        max_redispatch: int = 2,
         model_resolver: Optional[Callable[[str], Callable[[], LLMEngine]]] = None,
         otlp_endpoint: str = "",
         otlp_service_name: str = "distributed-inference-server-tpu",
@@ -99,6 +102,9 @@ class InferenceServer:
             strategy=strategy,
             health_check_interval_s=health_check_interval_s,
             auto_restart=auto_restart,
+            metrics=self.metrics,
+            restart_backoff_s=restart_backoff_s,
+            restart_backoff_max_s=restart_backoff_max_s,
         )
         from distributed_inference_server_tpu.serving.disagg import (
             DisaggController,
@@ -129,6 +135,7 @@ class InferenceServer:
             metrics=self.metrics,
             tracer=self.tracer,
             disagg=self.disagg,
+            max_redispatch=max_redispatch,
         )
         from distributed_inference_server_tpu.native import make_validator
 
@@ -195,6 +202,10 @@ class InferenceServer:
             engine_id, _bind_factory(self.engine_factory, idx), self.metrics,
             tracer=self.tracer, role=role, disagg=self.disagg,
         )
+        # crash-safe redispatch (docs/RESILIENCE.md): a dead runner hands
+        # its zero-token in-flight requests back to the dispatcher, which
+        # re-runs them on a healthy replica invisibly to the client
+        runner.redispatch = self.dispatcher.redispatch
         runner.start(wait_ready=wait_ready)
         self.scheduler.register(runner)
         return runner

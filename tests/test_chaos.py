@@ -1,0 +1,501 @@
+"""Chaos regressions (ISSUE 6): fixed-seed fault-injection scenarios for
+the resilience layer — serving/faults.py, crash-safe redispatch, restart
+backoff, the allocator audit — plus committed seeds of the
+tools/chaos_fleet.py scenario matrix.
+
+The acceptance property lives here as a tier-1 test: a fault-injected
+runner crash whose in-flight requests streamed ZERO tokens completes
+those requests successfully on another replica, token-identically and
+invisibly to the client; token-emitting requests fail fast with the
+distinct ``engine_crashed`` code.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+from distributed_inference_server_tpu.serving import faults
+from distributed_inference_server_tpu.serving.disagg import DisaggSettings
+from distributed_inference_server_tpu.serving.faults import (
+    FaultRule,
+    FaultSet,
+    FaultSpecError,
+    InjectedFault,
+    parse_spec,
+)
+from distributed_inference_server_tpu.serving.metrics import MetricsCollector
+from distributed_inference_server_tpu.serving.runner import ServerRequest
+from distributed_inference_server_tpu.serving.scheduler import AdaptiveScheduler
+from distributed_inference_server_tpu.serving.server import InferenceServer
+
+_PAGED = PagedCacheConfig(num_pages=192, page_size=8, max_pages_per_seq=32)
+_PROMPT = "hello chaos engineering world"
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Fault injection is process-global; no test may leak an armed set."""
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+
+
+def _engine(params):
+    return LLMEngine(
+        params, TINY, ByteTokenizer(),
+        EngineConfig(max_batch=4, prefill_buckets=(16, 64), paged=_PAGED),
+        dtype=jnp.float32,
+    )
+
+
+class _Sink:
+    def __init__(self):
+        self.toks, self.text = [], ""
+        self.done = None
+        self.errors = []
+        self.terminals = 0
+        self.first_token = threading.Event()
+        self.ev = threading.Event()
+
+    def on_token(self, token_id, text, token_index, logprob=None):
+        if token_id is not None:
+            self.toks.append(token_id)
+            self.first_token.set()
+        self.text += text
+
+    def on_done(self, finish_reason, usage):
+        self.done = (finish_reason, usage)
+        self.terminals += 1
+        self.ev.set()
+
+    def on_error(self, message, code):
+        self.errors.append((message, code))
+        self.terminals += 1
+        self.ev.set()
+
+
+def _run_request(srv, rid, max_tokens=10, wait=True):
+    sink = _Sink()
+    srv.dispatcher.submit(ServerRequest(
+        rid, ByteTokenizer().encode(_PROMPT),
+        SamplingParams(max_tokens=max_tokens, temperature=0.0), sink,
+    ))
+    if wait:
+        assert sink.ev.wait(90), "request did not complete"
+    return sink
+
+
+# ---------------------------------------------------------------------------
+# FaultSet semantics (pure)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultSet:
+    def test_disabled_fire_is_noop(self):
+        faults.clear()
+        assert faults.fire("runner.step") is False
+        assert faults.flag("sched.health_flap") is False
+
+    def test_nth_fires_once_on_nth_hit(self):
+        fs = FaultSet([FaultRule(point="p", nth=3)])
+        fs.fire("p")
+        fs.fire("p")
+        with pytest.raises(InjectedFault):
+            fs.fire("p")
+        # nth rules are one-shot by default
+        for _ in range(5):
+            fs.fire("p")
+        assert fs.fired_count("p") == 1
+
+    def test_times_bounds_recurrence(self):
+        fs = FaultSet([FaultRule(point="p", nth=1, times=2)])
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                fs.fire("p")
+        fs.fire("p")
+        assert fs.fired_count("p") == 2
+
+    def test_prob_is_seed_deterministic(self):
+        def burn(seed):
+            fs = FaultSet([FaultRule(point="p", prob=0.5, times=None)],
+                          seed=seed)
+            out = []
+            for _ in range(64):
+                try:
+                    fs.fire("p")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        assert burn(7) == burn(7)
+        assert burn(7) != burn(8)
+        assert sum(burn(7)) > 0
+
+    def test_delay_rule_sleeps_not_raises(self):
+        fs = FaultSet([FaultRule(point="p", nth=1, delay_ms=10.0)])
+        t0 = time.monotonic()
+        assert fs.fire("p") is True
+        assert time.monotonic() - t0 >= 0.009
+
+    def test_flag_never_raises(self):
+        fs = FaultSet([FaultRule(point="p", nth=1)])
+        assert fs.flag("p") is True
+        assert fs.flag("p") is False  # one-shot consumed
+
+    def test_parse_spec(self):
+        fs = parse_spec(
+            "runner.inbox:nth=1;disagg.chunk:prob=0.25,times=3;"
+            "disagg.slow_peer:nth=2,delay_ms=5", seed=9,
+        )
+        assert set(fs._rules) == {"runner.inbox", "disagg.chunk",
+                                  "disagg.slow_peer"}
+        assert fs._rules["disagg.chunk"].times == 3
+        assert fs._rules["disagg.slow_peer"].delay_ms == 5.0
+
+    @pytest.mark.parametrize("bad", [
+        "", "pointonly", "p:nth=x", "p:unknown=1", "p:prob=2.0", "p:",
+        "p:nth=1;p:nth=2",
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(FaultSpecError):
+            parse_spec(bad)
+
+    def test_config_gates_and_validates_spec(self):
+        from distributed_inference_server_tpu.core.errors import ConfigError
+        from distributed_inference_server_tpu.serving.config import (
+            ServerConfig,
+        )
+
+        cfg = ServerConfig.load(
+            environ={"DIS_TPU_FAULTS__SPEC": "runner.step:nth=1",
+                     "DIS_TPU_FAULTS__SEED": "5"})
+        assert cfg.get("faults", "spec") == "runner.step:nth=1"
+        assert cfg.get("faults", "seed") == 5
+        with pytest.raises(ConfigError):
+            ServerConfig.load(environ={"DIS_TPU_FAULTS__SPEC": "nonsense"})
+
+
+# ---------------------------------------------------------------------------
+# Restart backoff (satellite)
+# ---------------------------------------------------------------------------
+
+
+class _FlakyRunner:
+    def __init__(self, eid="engine-x", fail=True):
+        self.engine_id = eid
+        self.fail = fail
+        self.restarts = 0
+
+    def is_healthy(self):
+        return False
+
+    def restart(self, wait_ready=True):
+        self.restarts += 1
+        if self.fail:
+            raise RuntimeError("boom")
+
+
+class TestRestartBackoff:
+    def test_failed_restart_backs_off_exponentially(self):
+        m = MetricsCollector()
+        s = AdaptiveScheduler(auto_restart=True, metrics=m,
+                              restart_backoff_s=10.0,
+                              restart_backoff_max_s=25.0)
+        r = _FlakyRunner()
+        delays = []
+        for _ in range(4):
+            s._restart_one(r)
+            not_before, delay = s._backoff[r.engine_id]
+            delays.append(delay)
+            assert not_before > time.monotonic()
+            # jitter is bounded: delay <= wake <= 1.25 * delay
+            assert not_before - time.monotonic() <= delay * 1.25 + 0.1
+        assert delays == [10.0, 20.0, 25.0, 25.0]  # doubled, capped
+        assert r.restarts == 4
+        snap = m.snapshot().to_dict()
+        assert snap["resilience"]["engine_restarts"] == {r.engine_id: 4}
+        assert (b'engine_restarts_total{engine_id="engine-x"} 4.0'
+                in m.prometheus_text())
+
+    def test_successful_restart_resets_backoff(self):
+        s = AdaptiveScheduler(auto_restart=True, restart_backoff_s=10.0)
+        r = _FlakyRunner()
+        s._restart_one(r)
+        assert r.engine_id in s._backoff
+        r.fail = False
+        s._restart_one(r)
+        assert r.engine_id not in s._backoff
+
+    def test_health_loop_skips_engine_in_backoff(self):
+        s = AdaptiveScheduler(auto_restart=True,
+                              health_check_interval_s=0.01,
+                              restart_backoff_s=30.0)
+        r = _FlakyRunner()
+        s.register(r)
+        s.start_health_loop()
+        try:
+            deadline = time.monotonic() + 1.0
+            while r.restarts == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # one attempt happened; the 30s backoff holds every later
+            # sweep back (~100 sweeps would fit in the window otherwise)
+            time.sleep(0.3)
+            assert r.restarts == 1
+        finally:
+            s.stop_health_loop()
+
+
+# ---------------------------------------------------------------------------
+# Allocator audit (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestAllocatorAudit:
+    def _alloc(self):
+        from distributed_inference_server_tpu.engine.kv_cache import (
+            PageAllocator,
+        )
+
+        return PageAllocator(PagedCacheConfig(num_pages=8, page_size=4,
+                                              max_pages_per_seq=4))
+
+    def test_clean_books_audit_clean(self):
+        a = self._alloc()
+        pages = a.allocate(3)
+        a.publish(list(range(12)), pages)
+        assert a.audit() == []
+        assert a.audit(pages) == []
+        a.release(pages)
+        assert a.audit([]) == []
+
+    def test_leaked_page_detected(self):
+        a = self._alloc()
+        a.allocate(2)  # held by nobody we admit to -> leak
+        issues = a.audit([])
+        assert any("leaked" in i for i in issues), issues
+
+    def test_refcount_holder_mismatch_detected(self):
+        a = self._alloc()
+        pages = a.allocate(2)
+        a.publish(list(range(8)), pages)
+        issues = a.audit(list(pages) + [pages[0]])  # phantom extra holder
+        assert any("refcount" in i for i in issues), issues
+
+    def test_use_after_free_detected(self):
+        a = self._alloc()
+        pages = a.allocate(1)
+        a.release(pages)
+        issues = a.audit(pages)
+        assert any("free list" in i for i in issues), issues
+
+    def test_corrupted_lru_detected(self):
+        a = self._alloc()
+        pages = a.allocate(1)
+        a.publish(list(range(4)), pages)
+        a.release(pages)
+        a._lru[pages[0]] = 12345  # wrong hash
+        assert any("hash mismatch" in i for i in a.audit())
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe redispatch (tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="class")
+def twin_server(tiny_params):
+    srv = InferenceServer(
+        lambda: _engine(tiny_params), ByteTokenizer(), "tiny",
+        num_engines=2, auto_restart=False,
+    )
+    srv.start()
+    yield srv
+    faults.clear()
+    srv.shutdown(drain_timeout_s=5.0)
+
+
+class TestRedispatch:
+    def test_zero_token_inflight_completes_on_other_replica(
+            self, twin_server):
+        """ACCEPTANCE: the runner crashes between submit and inbox drain
+        (zero tokens streamed) — the request must complete successfully,
+        token-identically, on the other replica."""
+        ref = _run_request(twin_server, "chaos-ref")
+        assert not ref.errors, ref.errors
+
+        faults.install(parse_spec("runner.inbox:nth=1", seed=1))
+        got = _run_request(twin_server, "chaos-redispatch")
+        faults.clear()
+
+        assert not got.errors, got.errors
+        assert got.terminals == 1
+        assert got.toks == ref.toks
+        assert got.text == ref.text
+        # exactly one replica died; the survivor carried the request
+        healthy = [r for r in twin_server.scheduler.engines()
+                   if r.is_healthy()]
+        assert len(healthy) == 1
+        snap = twin_server.metrics.snapshot().to_dict()
+        assert snap["resilience"]["redispatched"].get("ok", 0) >= 1
+        assert ('requests_redispatched_total{outcome="ok"}'
+                in twin_server.metrics.prometheus_text().decode())
+        # no pages leaked anywhere (crashed replica audits vacuously)
+        for r in twin_server.scheduler.engines():
+            assert r.audit() == []
+        # heal the fleet for subsequent tests
+        for r in twin_server.scheduler.engines():
+            if not r.is_healthy():
+                r.restart()
+
+    def test_redispatch_with_traced_request(self, twin_server):
+        """Regression: the HTTP path attaches a root span to every
+        request, and redispatch annotates it — a span-API mismatch here
+        turned an invisible redispatch into a client-visible failure
+        (the hook raised, _fail_all_of absorbed it, the sink got the
+        crash error). Redispatch must succeed for traced requests too,
+        and the span must carry the redispatch annotations."""
+        span = twin_server.tracer.start("request", request_id="chaos-span")
+        sink = _Sink()
+        faults.install(parse_spec("runner.inbox:nth=1", seed=6))
+        twin_server.dispatcher.submit(ServerRequest(
+            "chaos-span", ByteTokenizer().encode(_PROMPT),
+            SamplingParams(max_tokens=10, temperature=0.0), sink, span=span,
+        ))
+        assert sink.ev.wait(90), "traced request did not complete"
+        faults.clear()
+        assert not sink.errors, sink.errors
+        assert sink.terminals == 1
+        assert "redispatched" in [n for _, n in span.events]
+        assert span.attributes["redispatch_to"]
+        for r in twin_server.scheduler.engines():
+            if not r.is_healthy():
+                r.restart()
+
+    def test_exhausted_attempts_fail_visibly_once(self, twin_server):
+        """Both replicas crash on the redispatched request: bounded
+        attempts end in ONE terminal error, never silence or a double
+        event."""
+        faults.install(parse_spec("runner.inbox:nth=1,times=10", seed=2))
+        got = _run_request(twin_server, "chaos-exhaust")
+        faults.clear()
+        assert got.terminals == 1
+        assert len(got.errors) == 1
+        assert got.errors[0][1] == "worker_failure"
+        snap = twin_server.metrics.snapshot().to_dict()
+        assert snap["resilience"]["redispatched"].get("exhausted", 0) >= 1
+        for r in twin_server.scheduler.engines():
+            if not r.is_healthy():
+                r.restart()
+
+    def test_token_emitting_request_fails_fast_engine_crashed(
+            self, twin_server):
+        """A request that already streamed tokens cannot be re-run
+        transparently — it must fail fast with the DISTINCT
+        engine_crashed code."""
+        sink = _run_request(twin_server, "chaos-midstream", max_tokens=64,
+                            wait=False)
+        assert sink.first_token.wait(60), "no first token"
+        faults.install(parse_spec("runner.step:nth=1", seed=3))
+        assert sink.ev.wait(60), "no terminal event after injected crash"
+        faults.clear()
+        assert sink.terminals == 1
+        assert len(sink.errors) == 1
+        assert sink.errors[0][1] == "engine_crashed"
+        for r in twin_server.scheduler.engines():
+            if not r.is_healthy():
+                r.restart()
+
+
+# ---------------------------------------------------------------------------
+# Disagg chaos: crash-mid-handoff and import abort (satellite coverage)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="class")
+def disagg_chaos_server(tiny_params):
+    srv = InferenceServer(
+        lambda: _engine(tiny_params), ByteTokenizer(), "tiny",
+        num_engines=2, auto_restart=False,
+        engine_roles=["prefill", "decode"],
+        disagg_settings=DisaggSettings(handoff_timeout_s=30.0),
+    )
+    srv.start()
+    yield srv
+    faults.clear()
+    srv.shutdown(drain_timeout_s=5.0)
+
+
+class TestDisaggChaos:
+    def test_commit_drop_decodes_in_place(self, disagg_chaos_server):
+        """Crash-mid-handoff: the switchover commit dies on the channel;
+        the source keeps the request and the client sees nothing."""
+        srv = disagg_chaos_server
+        faults.install(parse_spec("disagg.commit:nth=1", seed=4))
+        got = _run_request(srv, "chaos-commit", max_tokens=48)
+        faults.clear()
+        assert not got.errors, got.errors
+        assert got.terminals == 1
+        snap = srv.metrics.snapshot().to_dict()
+        assert snap["disagg"]["handoffs"].get("fallback", 0) >= 1
+        for r in srv.scheduler.engines():
+            assert r.audit() == []
+
+    def test_import_abort_releases_every_page(self, disagg_chaos_server):
+        """Crash-mid-import: chunk validation fails on the decode side —
+        the session aborts, the request decodes in place, and the
+        decode engine's pool holds ZERO stray pages (the audit proves
+        conservation)."""
+        srv = disagg_chaos_server
+        faults.install(parse_spec("kv.import_chunk:nth=1", seed=5))
+        got = _run_request(srv, "chaos-import", max_tokens=48)
+        faults.clear()
+        assert not got.errors, got.errors
+        assert got.terminals == 1
+        # allow the phase-1 abort submitted to the decode runner to drain
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if all(r.audit() == [] for r in srv.scheduler.engines()):
+                break
+            time.sleep(0.05)
+        for r in srv.scheduler.engines():
+            assert r.audit() == [], r.engine_id
+
+
+# ---------------------------------------------------------------------------
+# Committed chaos-fleet seeds (the harness's own scenario matrix)
+# ---------------------------------------------------------------------------
+
+
+class TestChaosFleetSeeds:
+    @pytest.mark.parametrize("scenario,seed", [
+        ("redispatch", 11),
+        ("crash_mid_handoff", 12),
+        ("degradation_flap", 13),
+    ])
+    def test_scenario_clean(self, scenario, seed):
+        from tools import chaos_fleet
+
+        violations, srv = chaos_fleet.run_scenario(scenario, seed)
+        try:
+            assert violations == []
+        finally:
+            srv.shutdown(drain_timeout_s=5.0)

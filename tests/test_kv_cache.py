@@ -20,6 +20,26 @@ from distributed_inference_server_tpu.models.configs import TINY
 PCFG = PagedCacheConfig(num_pages=8, page_size=4, max_pages_per_seq=4)
 
 
+@pytest.fixture(autouse=True)
+def _audit_allocators(monkeypatch):
+    """Every allocator this module constructs must end each test with
+    self-consistent books (free list, content-address maps, LRU,
+    refcounts — PageAllocator.audit, ISSUE 6 satellite). Conservation
+    against live holders is the chaos harness's job; here the invariant
+    is that no test path corrupts the allocator's internal structures."""
+    created = []
+    orig_init = PageAllocator.__init__
+
+    def init(self, cfg):
+        orig_init(self, cfg)
+        created.append(self)
+
+    monkeypatch.setattr(PageAllocator, "__init__", init)
+    yield
+    for a in created:
+        assert a.audit() == [], a.audit()
+
+
 def test_allocate_and_release_cycle():
     a = PageAllocator(PCFG)
     pages = a.allocate(8)

@@ -334,17 +334,39 @@ class TestDispatcher:
         except QueueFull:
             pass
 
-    def test_sweep_expires_to_408(self):
+    def test_sweep_expires_to_queue_timeout(self):
+        """Expired queued requests resolve with the DISTINCT
+        queue_timeout code (not a generic failure) and count into
+        requests_expired_total (ISSUE 6 satellite)."""
+        m = MetricsCollector()
         d = Dispatcher(
             AdaptiveScheduler(),
             queue_config=QueueConfig(request_timeout_s=5.0),
+            metrics=m,
         )
         d._accepting = True
         r = _req("victim")
         d.submit(r)
         d._sweep(time.monotonic() + 10.0)
-        assert r.sink.errors == [("Request timeout", "request_timeout")]
+        assert len(r.sink.errors) == 1
+        assert r.sink.errors[0][1] == "queue_timeout"
         assert d.queue.is_empty()
+        snap = m.snapshot().to_dict()
+        assert snap["resilience"]["requests_expired"] == 1
+        assert b"requests_expired_total 1.0" in m.prometheus_text()
+
+    def test_sweep_not_expired_no_error(self):
+        d = Dispatcher(
+            AdaptiveScheduler(),
+            queue_config=QueueConfig(request_timeout_s=5.0),
+            metrics=MetricsCollector(),
+        )
+        d._accepting = True
+        r = _req("fresh")
+        d.submit(r)
+        d._sweep(time.monotonic())
+        assert r.sink.errors == []
+        assert not d.queue.is_empty()
 
     def test_dispatch_without_engines_fails_batch(self):
         d = Dispatcher(AdaptiveScheduler(), metrics=MetricsCollector())

@@ -1,0 +1,479 @@
+"""Fleet chaos harness: randomized fault-injection scenarios against a
+live multi-engine server, with fleet invariants checked after every one.
+
+distlint guards the CODE's invariants; this guards the FLEET's
+(ROADMAP "Multi-host control plane + fleet chaos harness"). Each
+scenario builds (or reuses) a tiny-model fleet on the CPU backend, arms
+a seeded FaultSet (serving/faults.py), drives real requests through the
+full spine — dispatcher → scheduler → runners → disagg controller —
+and then asserts the promises docs/RESILIENCE.md makes:
+
+- **exactly-once termination**: every accepted request resolves its sink
+  with on_done XOR on_error, exactly once, and never streams a token
+  after a terminal event;
+- **no leaked KV pages**: every engine's allocator passes the
+  free/cached/live conservation audit (``LLMEngine.audit_pages``);
+- **no wedged drains**: runner inflight maps, the migration queue, and
+  the admission queue all empty out;
+- **scheduler reconvergence**: with auto-restart on, every replica is
+  healthy again once the faults are disarmed.
+
+Scenario matrix: runner crash with zero-token in-flight (redispatch),
+crash-mid-handoff (source decodes in place), crash-mid-import (no page
+leak), channel truncation, degradation-ladder flapping, and
+warm-replica death under cache-aware routing.
+
+    python tools/chaos_fleet.py [minutes]            # time-budgeted soak
+    python tools/chaos_fleet.py --seeds 20           # N fresh seeds/scenario
+    python tools/chaos_fleet.py --seed 7 --scenarios redispatch  # repro
+    python tools/chaos_fleet.py --list
+
+Exit 0 = clean; exit 1 = violation (scenario + seed printed — commit it
+as a regression in tests/test_chaos.py, which runs fixed seeds of the
+same scenarios in tier-1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+DEFAULT_SCENARIOS = (
+    "redispatch",
+    "crash_mid_handoff",
+    "crash_mid_import",
+    "channel_truncation",
+    "degradation_flap",
+    "warm_replica_death",
+)
+
+_PROMPT = "chaos is a ladder, resilience is a lattice"
+
+
+def _env_setup() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+class ChaosSink:
+    """Result sink that records the stream contract instead of text:
+    terminal events, ordering violations, and codes."""
+
+    def __init__(self, rid: str):
+        self.rid = rid
+        self.tokens = 0
+        self.dones = 0
+        self.errors = []  # (message, code)
+        self.violations = []
+        self.ev = threading.Event()
+        self._lock = threading.Lock()
+
+    def _terminal(self, kind: str) -> None:
+        with self._lock:
+            if self.ev.is_set():
+                self.violations.append(
+                    f"{self.rid}: second terminal event ({kind}) after "
+                    f"{self.dones} done / {len(self.errors)} error"
+                )
+            self.ev.set()
+
+    def on_token(self, token_id, text, token_index, logprob=None):
+        with self._lock:
+            if self.ev.is_set():
+                self.violations.append(
+                    f"{self.rid}: token streamed after a terminal event"
+                )
+            self.tokens += 1
+
+    def on_done(self, finish_reason, usage):
+        self._terminal("done")
+        self.dones += 1
+
+    def on_error(self, message, code):
+        self._terminal(f"error:{code}")
+        self.errors.append((message, code))
+
+    @property
+    def terminal_count(self) -> int:
+        return self.dones + len(self.errors)
+
+
+_PARAMS = None
+
+
+def _tiny_params():
+    global _PARAMS
+    if _PARAMS is None:
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_inference_server_tpu.models import llama
+        from distributed_inference_server_tpu.models.configs import TINY
+
+        _PARAMS = llama.init_params(jax.random.PRNGKey(0), TINY,
+                                    dtype=jnp.float32)
+    return _PARAMS
+
+
+def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
+                channel="inproc", auto_restart=True, warmup=False,
+                handoff_timeout_s=20.0):
+    """A tiny-model fleet wired exactly like production (the
+    disagg_smoke.py topology, sans HTTP): real engines, real runners,
+    real dispatcher/scheduler/controller. Health loop runs hot
+    (100 ms sweeps, 200 ms restart backoff) so chaos iterations stay
+    fast."""
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+    )
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.disagg import DisaggSettings
+    from distributed_inference_server_tpu.serving.scheduler import (
+        SchedulingStrategy,
+    )
+    from distributed_inference_server_tpu.serving.server import InferenceServer
+
+    params = _tiny_params()
+    paged = PagedCacheConfig(num_pages=192, page_size=8, max_pages_per_seq=32)
+
+    def factory():
+        return LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(max_batch=4, prefill_buckets=(16, 64), paged=paged,
+                         warmup_compile=warmup),
+            dtype=jnp.float32,
+        )
+
+    srv = InferenceServer(
+        factory, ByteTokenizer(), model_name="tiny-chaos",
+        num_engines=len(roles), engine_roles=list(roles),
+        strategy=SchedulingStrategy.parse(strategy),
+        auto_restart=auto_restart, health_check_interval_s=0.1,
+        restart_backoff_s=0.2, restart_backoff_max_s=2.0,
+        disagg_settings=DisaggSettings(channel=channel,
+                                       handoff_timeout_s=handoff_timeout_s),
+    )
+    srv.start()
+    return srv
+
+
+def submit(srv, rid: str, prompt: str = _PROMPT, max_tokens: int = 16,
+           sinks=None):
+    """Submit one request; returns its ChaosSink, or None if admission
+    rejected it (backpressure/degradation — not a violation)."""
+    from distributed_inference_server_tpu.core.errors import QueueFull
+    from distributed_inference_server_tpu.engine.engine import SamplingParams
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.runner import ServerRequest
+
+    sink = ChaosSink(rid)
+    try:
+        srv.dispatcher.submit(ServerRequest(
+            rid, ByteTokenizer().encode(prompt),
+            SamplingParams(max_tokens=max_tokens, temperature=0.0), sink,
+        ))
+    except QueueFull:
+        return None
+    if sinks is not None:
+        sinks.append(sink)
+    return sink
+
+
+def wait_terminal(sinks, timeout_s: float = 60.0):
+    """Wait until every sink saw a terminal event; returns the ids that
+    did not (wedged requests — an invariant violation)."""
+    deadline = time.monotonic() + timeout_s
+    wedged = []
+    for s in sinks:
+        if not s.ev.wait(max(0.0, deadline - time.monotonic())):
+            wedged.append(s.rid)
+    return wedged
+
+
+def check_invariants(srv, sinks, require_success=False,
+                     converge_timeout_s: float = 30.0):
+    """The fleet invariants (module docstring); returns violation
+    strings, empty = clean. Call with faults already disarmed."""
+    violations = []
+    for s in sinks:
+        violations.extend(s.violations)
+        if s.terminal_count != 1:
+            violations.append(
+                f"{s.rid}: {s.terminal_count} terminal events "
+                f"({s.dones} done, {len(s.errors)} error) — want exactly 1"
+            )
+        if require_success and s.errors:
+            violations.append(f"{s.rid}: expected success, got {s.errors}")
+    deadline = time.monotonic() + converge_timeout_s
+    auto = srv.scheduler._auto_restart
+    while time.monotonic() < deadline:
+        runners = srv.scheduler.engines()
+        healthy = all(r.is_healthy() for r in runners)
+        drained = (
+            (healthy or not auto)
+            and all(r.active_count() == 0 for r in runners)
+            and srv.dispatcher.queue.is_empty()
+            and srv.dispatcher.batcher.pending_count() == 0
+            and (srv.disagg is None or srv.disagg.pending_count() == 0)
+        )
+        if drained and (healthy or not auto):
+            break
+        time.sleep(0.05)
+    else:
+        state = {
+            r.engine_id: (r.is_healthy(), r.active_count())
+            for r in srv.scheduler.engines()
+        }
+        violations.append(
+            "fleet did not reconverge/drain within "
+            f"{converge_timeout_s}s: engines={state}, "
+            f"queue_empty={srv.dispatcher.queue.is_empty()}, "
+            f"migrations={srv.disagg.pending_count() if srv.disagg else 0}"
+        )
+    for r in srv.scheduler.engines():
+        violations.extend(r.audit())
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# Scenarios — each installs a seeded FaultSet, drives traffic, disarms,
+# and returns (sinks, require_success)
+# ---------------------------------------------------------------------------
+
+
+def _arm(spec: str, seed: int):
+    from distributed_inference_server_tpu.serving import faults
+
+    faults.install(faults.parse_spec(spec, seed))
+
+
+def scenario_redispatch(srv, seed: int):
+    """A runner crashes between submit and inbox drain: its zero-token
+    in-flight requests must complete on the other replica, invisibly."""
+    rng = random.Random(seed)
+    sinks = []
+    _arm(f"runner.inbox:nth={rng.randint(1, 2)}", seed)
+    for i in range(rng.randint(1, 3)):
+        submit(srv, f"rd-{seed}-{i}", sinks=sinks)
+    wedged = wait_terminal(sinks)
+    return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
+
+
+def scenario_crash_mid_handoff(srv, seed: int):
+    """The handoff dies mid-flight — switchover commit dropped, or the
+    decode runner crashes while the import session is open. The source
+    keeps decoding in place; the client never notices."""
+    rng = random.Random(seed)
+    spec = rng.choice([
+        "disagg.commit:nth=1",
+        # inbox hit 1 is the prefill's submit; hits 2+ land on the
+        # decode runner's import open/commit commands
+        f"runner.inbox:nth={rng.randint(2, 3)}",
+        "disagg.slow_peer:prob=1.0,delay_ms=30;disagg.commit:nth=1",
+    ])
+    sinks = []
+    _arm(spec, seed)
+    submit(srv, f"hof-{seed}", max_tokens=rng.randint(24, 48), sinks=sinks)
+    wedged = wait_terminal(sinks)
+    return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
+
+
+def scenario_crash_mid_import(srv, seed: int):
+    """Import-side chunk validation fails: the session aborts, every
+    reserved page is released (the audit proves it), and the source
+    decodes in place."""
+    rng = random.Random(seed)
+    sinks = []
+    _arm(f"kv.import_chunk:nth={rng.randint(1, 3)}", seed)
+    submit(srv, f"imp-{seed}", max_tokens=rng.randint(24, 48), sinks=sinks)
+    wedged = wait_terminal(sinks)
+    return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
+
+
+def scenario_channel_truncation(srv, seed: int):
+    """The streamed channel errors on the Nth chunk (truncation): phase-1
+    failure costs nothing, the sequence never left the source."""
+    rng = random.Random(seed)
+    sinks = []
+    _arm(f"disagg.chunk:nth={rng.randint(1, 5)},times={rng.randint(1, 2)}",
+         seed)
+    for i in range(2):
+        submit(srv, f"tr-{seed}-{i}", max_tokens=32, sinks=sinks)
+    wedged = wait_terminal(sinks)
+    return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
+
+
+def scenario_degradation_flap(srv, seed: int):
+    """The degradation ladder slams to EMERGENCY and back while traffic
+    flows, the health loop restarts healthy replicas on injected flaps,
+    and caches evict mid-decode. Success is not promised here — bounded
+    failure is: exactly-once termination, no leaks, reconvergence."""
+    rng = random.Random(seed)
+    sinks = []
+    _arm("sched.health_flap:prob=0.3,times=2", seed)
+    for i in range(3):
+        submit(srv, f"flap-{seed}-{i}", max_tokens=24, sinks=sinks)
+        srv.degradation.evaluate(pressure=rng.choice([0.97, 0.92, 0.85]))
+        time.sleep(rng.uniform(0.0, 0.05))
+        for r in srv.scheduler.engines():
+            if r.is_healthy() and rng.random() < 0.5:
+                r.evict_cache(rng.uniform(0.3, 0.8),
+                              drop_host_tier=rng.random() < 0.5)
+        srv.degradation.evaluate(pressure=0.1)
+    wedged = wait_terminal(sinks)
+    srv.degradation.evaluate(pressure=0.1)  # ladder back to NORMAL
+    extra = [f"{r}: no terminal event (wedged)" for r in wedged]
+    if srv.dispatcher.reject_all or srv.dispatcher.reject_low_priority:
+        extra.append("degradation ladder stuck above NORMAL after "
+                     "pressure dropped")
+    return sinks, False, extra
+
+
+def scenario_warm_replica_death(srv, seed: int):
+    """Cache-aware routing sends repeated-prefix traffic to the warm
+    replica; the warm replica dies with the request in flight before its
+    first token. Redispatch lands it on the cold replica — slower, but
+    correct and invisible."""
+    rng = random.Random(seed)
+    sinks = []
+    prompt = _PROMPT + " warm" * rng.randint(1, 3)
+    # warm a replica's prefix cache and let its digest publish
+    warm = [submit(srv, f"warm-{seed}-{i}", prompt=prompt, max_tokens=8)
+            for i in range(2)]
+    wait_terminal([s for s in warm if s is not None])
+    time.sleep(0.35)  # digest refresh is rate-limited to 250 ms
+    _arm("runner.inbox:nth=1", seed)
+    submit(srv, f"wrd-{seed}", prompt=prompt, max_tokens=16, sinks=sinks)
+    wedged = wait_terminal(sinks)
+    return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
+
+
+#: scenario -> (fn, fleet kwargs)
+SCENARIOS = {
+    "redispatch": (scenario_redispatch, {}),
+    "crash_mid_handoff": (scenario_crash_mid_handoff,
+                          {"roles": ("prefill", "decode")}),
+    "crash_mid_import": (scenario_crash_mid_import,
+                         {"roles": ("prefill", "decode")}),
+    "channel_truncation": (scenario_channel_truncation,
+                           {"roles": ("prefill", "decode"),
+                            "channel": "protowire"}),
+    "degradation_flap": (scenario_degradation_flap, {}),
+    "warm_replica_death": (scenario_warm_replica_death,
+                           {"strategy": "cache_aware"}),
+}
+
+
+def run_scenario(name: str, seed: int, srv=None):
+    """One scenario iteration on a fresh seed; returns (violations,
+    srv) — the fleet is reusable across seeds of the same scenario
+    (auto-restart heals crash damage between iterations). Faults are
+    ALWAYS disarmed before the invariant check."""
+    from distributed_inference_server_tpu.serving import faults
+
+    fn, fleet_kwargs = SCENARIOS[name]
+    if srv is None:
+        srv = build_fleet(**fleet_kwargs)
+    try:
+        sinks, require_success, extra = fn(srv, seed)
+    finally:
+        faults.clear()
+    violations = list(extra)
+    violations += check_invariants(srv, sinks,
+                                   require_success=require_success)
+    return violations, srv
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("minutes", nargs="?", type=float, default=None,
+                    help="time budget: loop fresh seeds until it runs out")
+    ap.add_argument("--seeds", type=int, default=20,
+                    help="fresh seeds per scenario (ignored with a time "
+                    "budget or --seed)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="run exactly this seed (reproduction)")
+    ap.add_argument("--base-seed", type=int, default=None,
+                    help="first seed of the sweep (default: wall clock)")
+    ap.add_argument("--scenarios",
+                    default=",".join(DEFAULT_SCENARIOS),
+                    help="comma-separated subset of: "
+                    + ", ".join(DEFAULT_SCENARIOS))
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args()
+    if args.list:
+        for name in SCENARIOS:
+            print(name)
+        return 0
+    _env_setup()
+    names = [s for s in args.scenarios.split(",") if s.strip()]
+    for n in names:
+        if n not in SCENARIOS:
+            print(f"unknown scenario {n!r} (see --list)", file=sys.stderr)
+            return 2
+
+    if args.seed is not None:
+        seeds = [args.seed]
+    else:
+        base = (args.base_seed if args.base_seed is not None
+                else int(time.time()) % 1_000_000)
+        seeds = [base + i for i in range(args.seeds)]
+    deadline = (time.monotonic() + args.minutes * 60
+                if args.minutes else None)
+
+    total = 0
+    t_start = time.monotonic()
+    for name in names:
+        srv = None
+        try:
+            i = 0
+            while True:
+                if deadline is None:
+                    if i >= len(seeds):
+                        break
+                    seed = seeds[i]
+                else:
+                    if time.monotonic() >= deadline:
+                        break
+                    seed = (args.base_seed or int(t_start)) * 1000 + total
+                i += 1
+                total += 1
+                violations, srv = run_scenario(name, seed, srv=srv)
+                if violations:
+                    print(f"VIOLATION scenario={name} seed={seed}:")
+                    for v in violations:
+                        print(f"  - {v}")
+                    print(f"\nreproduce: python tools/chaos_fleet.py "
+                          f"--seed {seed} --scenarios {name}")
+                    return 1
+                print(f"ok scenario={name} seed={seed}", flush=True)
+        finally:
+            from distributed_inference_server_tpu.serving import faults
+
+            faults.clear()
+            if srv is not None:
+                srv.shutdown(drain_timeout_s=5.0)
+    print(f"chaos clean: {total} iterations across {names} in "
+          f"{time.monotonic() - t_start:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
