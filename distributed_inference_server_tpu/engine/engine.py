@@ -297,6 +297,8 @@ class StepOutput:
     error: Optional[str] = None
 
 
+# distlint: thread-confined — sequences live inside their engine, which is
+# single-owner on the runner thread (see LLMEngine below)
 class _Seq:
     """Host-side state of one in-flight request."""
 
@@ -355,6 +357,10 @@ class _EmbedState:
         self.idx = 0
 
 
+# distlint: thread-confined — the engine is single-owner by contract: every
+# interaction goes through EngineRunner's inbox and runs on the runner
+# thread (serving/runner.py module docstring); DL008's cross-thread write
+# analysis does not apply inside it
 class LLMEngine:
     """Single-model continuous-batching engine (one replica = one "worker"
     in the reference's terms, ``design.md:335-342`` [spec])."""

@@ -224,6 +224,8 @@ class CacheStats:
     memory_used_frac: float
 
 
+# distlint: thread-confined — allocator state is owned by its engine, which
+# is single-owner on the runner thread (LLMEngine contract)
 class PageAllocator:
     """Host bookkeeping for the device page pool.
 
@@ -914,6 +916,8 @@ def deserialize_kv(
     return _scatter_payload(state, slots, parts), token_count
 
 
+# distlint: thread-confined — a session is driven by exactly one importing
+# engine on its runner thread (phased import, serving/runner.py)
 class KvImportSession:
     """Incremental import target for a streamed KV handoff.
 
@@ -1121,6 +1125,8 @@ class _InflightGroup:
     burst: int  # ingest-burst id: a burst never force-drains itself
 
 
+# distlint: thread-confined — the tier belongs to one engine's allocator and
+# is touched only on that engine's runner thread
 class HostTier:
     """Bounded host-RAM pool of demoted prefix-cache pages.
 

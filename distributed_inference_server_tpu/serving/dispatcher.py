@@ -112,6 +112,8 @@ class Dispatcher:
         )
         self.metrics = metrics
         self._poll_interval = poll_interval_s
+        # lock-free by design: monotonic lifecycle bool, GIL-atomic,
+        # readers tolerate one stale poll  # distlint: ignore[DL008]
         self._accepting = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -211,6 +213,9 @@ class Dispatcher:
             if self.metrics:
                 self.metrics.record_redispatch("exhausted")
             return False
+        # exactly one thread owns the request here: the dead runner's
+        # _fail_all_of popped it before calling in, and the next owner
+        # is registered only by submit() below  # distlint: ignore[DL008]
         request.redispatches += 1
         if self.tracer and request.span is not None:
             request.span.set(redispatch_from=from_engine,

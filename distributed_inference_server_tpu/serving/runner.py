@@ -126,7 +126,10 @@ class EngineRunner:
         self._healthy = False
         self._last_error: Optional[str] = None
         self._total_processed = 0
-        self._inflight: Dict[RequestId, ServerRequest] = {}
+        # lock-free by design: per-request dict ops are GIL-atomic and
+        # the exactly-once protocol is pop-first — every terminal path
+        # pops before resolving (docs/RESILIENCE.md)
+        self._inflight: Dict[RequestId, ServerRequest] = {}  # distlint: ignore[DL008]
         # submit_resume callbacks not yet run by the engine thread: a
         # crash/shutdown before the inbox drains resolves them from
         # _fail_all (exactly-once via dict.pop), otherwise the migration
@@ -135,7 +138,9 @@ class EngineRunner:
         self._pending_resumes: Dict[RequestId, Callable] = {}
         # streamed handoff exports in flight (engine HandoffExportSession
         # + the request + the controller stream job), advanced by
-        # _pump_export_jobs between steps; owned by the runner thread
+        # _pump_export_jobs between steps; owned by the runner thread —
+        # the only cross-thread touches are GIL-atomic pops at crash/
+        # restart time, after the thread died  # distlint: ignore[DL008]
         self._export_jobs: Dict[RequestId, list] = {}
         # phased-import state on a DECODE runner: open sessions awaiting
         # their commit (request_id -> (KvImportSession, engine)), plus
@@ -186,6 +191,9 @@ class EngineRunner:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout)
+        # health flag: GIL-atomic bool; writers are the runner thread and
+        # lifecycle callers, readers tolerate one stale check (the health
+        # loop re-reads every sweep)  # distlint: ignore[DL008]
         self._healthy = False
         if self.metrics:
             self.metrics.set_engine_up(self.engine_id, False)
@@ -262,7 +270,9 @@ class EngineRunner:
         # otherwise strand on_done un-called and leak the migration job.
         # _pending_resumes FIRST: a concurrent _fail_all that saw
         # _inflight but not the callback would sink-fail the request AND
-        # let the fallback resume it — two contradictory terminal paths
+        # let the fallback resume it — two contradictory terminal paths.
+        # Cross-thread by design: GIL-atomic dict ops + exactly-once via
+        # dict.pop  # distlint: ignore[DL008]
         self._pending_resumes[req.request_id] = on_done
         self._inflight[req.request_id] = req
         if not self._healthy:
@@ -1095,7 +1105,8 @@ class EngineRunner:
                 continue
             if self.tracer and req.engine_span is not None:
                 self.tracer.finish(req.engine_span, status="error")
-                req.engine_span = None
+                # the request has exactly one owner (popped above)
+                req.engine_span = None  # distlint: ignore[DL008]
             if req.first_token_at is None and self.redispatch is not None:
                 try:
                     if self.redispatch(req, self.engine_id, message):

@@ -159,6 +159,8 @@ class AdaptiveScheduler:
         self._backoff: Dict[str, Tuple[float, float]] = {}
         self._stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
+        # engines with a restart worker in flight; guarded by _lock
+        # (health loop adds, restart threads discard — distlint DL008)
         self._restarting: set = set()
 
     # -- registration ------------------------------------------------------
@@ -279,6 +281,8 @@ class AdaptiveScheduler:
         if self._health_thread is not None:
             return
         self._stop.clear()
+        # lifecycle handle: start/stop are orchestrator calls, not
+        # concurrent paths  # distlint: ignore[DL008]
         self._health_thread = threading.Thread(
             target=self._health_loop, name="scheduler-health", daemon=True
         )
@@ -304,14 +308,17 @@ class AdaptiveScheduler:
                     healthy = False
                 if healthy or not self._auto_restart:
                     continue
-                if runner.engine_id in self._restarting:
-                    continue
                 with self._lock:
+                    # membership check and add under one lock hold: a
+                    # restart worker's discard must not interleave with
+                    # the check-then-add (distlint DL008)
+                    if runner.engine_id in self._restarting:
+                        continue
                     not_before = self._backoff.get(
                         runner.engine_id, (0.0, 0.0))[0]
-                if time.monotonic() < not_before:
-                    continue  # backing off after a failed restart
-                self._restarting.add(runner.engine_id)
+                    if time.monotonic() < not_before:
+                        continue  # backing off after a failed restart
+                    self._restarting.add(runner.engine_id)
                 t = threading.Thread(
                     target=self._restart_one, args=(runner,), daemon=True
                 )
@@ -341,4 +348,5 @@ class AdaptiveScheduler:
             with self._lock:
                 self._backoff.pop(eid, None)
         finally:
-            self._restarting.discard(eid)
+            with self._lock:
+                self._restarting.discard(eid)

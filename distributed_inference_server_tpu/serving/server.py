@@ -170,6 +170,7 @@ class InferenceServer:
         self.scheduler.start_health_loop()
         self.dispatcher.start()
         self.degradation.start()
+        # lifecycle flag, orchestrator-called  # distlint: ignore[DL008]
         self._started = True
 
     def shutdown(self, drain_timeout_s: float = 30.0) -> None:

@@ -49,6 +49,9 @@ class Span:
         return self
 
     def event(self, name: str) -> None:
+        # a span is owned by one thread at a time — its context() hands
+        # off with the request (module docstring); list.append is
+        # GIL-atomic for the rare overlap  # distlint: ignore[DL008]
         self.events.append((time.monotonic_ns(), name))
 
     def context(self) -> Tuple[str, str]:
@@ -103,8 +106,10 @@ class Tracer:
         )
 
     def finish(self, span: Span, status: str = "ok") -> None:
-        span.end_ns = time.monotonic_ns()
-        span.status = status
+        # finish is called exactly once by the span's current owner
+        # (handler pops it from _spans_by_request first)
+        span.end_ns = time.monotonic_ns()  # distlint: ignore[DL008]
+        span.status = status  # distlint: ignore[DL008]
         for export in self.exporters:
             try:
                 export(span)

@@ -13,6 +13,17 @@ subset as AST-level rules over ``distributed_inference_server_tpu/``:
     DL006  metric hygiene (registered <-> emitted, no phantom attrs)
     DL007  JAX hot-path hygiene in the per-token decode loop
 
+plus the interprocedural layer (``callgraph.py`` builds an annotation-
+resolved call graph; ``threads.py`` infers thread ownership from real
+spawn roots):
+
+    DL008  attribute written from multiple threads with no common lock
+    DL009  lock-order cycles / plain-Lock re-acquisition (deadlock)
+    DL010  internal-API call conformance (Span/Tracer/metrics/faults)
+    DL011  fault-point drift vs the docs/RESILIENCE.md point catalog
+    DL012  config-key drift vs serving/config.py ``_SCHEMA``
+
+``tools/chaos_fleet.py`` and ``tools/lint`` itself are in scope too.
 Run ``python -m tools.lint.run`` (tier-1 via tests/test_distlint.py).
 Rule catalog and suppression syntax: docs/LINTS.md.
 """

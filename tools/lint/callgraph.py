@@ -1,0 +1,944 @@
+"""distlint interprocedural layer: a module-resolving call graph over the
+package, with the derived facts the DL008-DL010 rules consume.
+
+One build pass produces a picklable :class:`ProjectSummary`:
+
+- **call edges** between project functions/methods, resolved through
+  imports, ``self``, parameter/attribute/return **type annotations**
+  (the codebase is consistently annotated, so annotation-driven receiver
+  typing resolves the serving spine's cross-object calls:
+  ``runner.submit(...)`` with ``runner: Optional[EngineRunner]``), simple
+  container annotations (``Dict[K, V]`` subscript/``.get`` yields ``V``,
+  ``List[V]``/``Sequence[V]`` iteration yields ``V``), constructor calls,
+  and — as a last resort — a unique-method-name fallback (only when
+  exactly one project class defines the name and the name is not on a
+  stdlib-collision stoplist);
+- **thread spawn sites** (``threading.Thread(target=...)``) with their
+  resolved targets, plus ``# distlint: thread-root`` def markers for
+  entry points the detector cannot see (closures handed to executors);
+- **attribute write sites** — ``self.x = ...`` / ``obj.x += ...`` /
+  ``obj.x.append(...)`` with a *typed* receiver — annotated with the
+  locks held at the write (``with self.<lock>:`` blocks, identified by
+  lock-factory assignment or lockish naming) and the ``*_locked``
+  caller-holds-the-lock convention;
+- **lock acquisition order**: intra-function nested ``with`` edges plus,
+  per call site, the set of locks held — the DL009 rule closes this
+  transitively over the graph;
+- **typed attribute calls** with their argument shapes, for DL010's
+  signature conformance, plus per-class method signatures/member names
+  and per-module function signatures.
+
+Nested function bodies (closures) are skipped throughout, like DL002:
+they execute later, on whatever thread their executor runs them. A class
+whose instances are confined to one thread by design (the engine behind
+``EngineRunner``'s inbox) opts out of thread-ownership analysis with a
+``# distlint: thread-confined`` marker on (or directly above) its
+``class`` line.
+
+Builds are cached two ways: an in-process memo (every rule in one run
+shares one build) and an on-disk pickle under ``tools/lint/.cache/``
+keyed on the content hash of every analyzed file, so ``--changed`` runs
+skip the rebuild entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import pickle
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.lint.core import Module, dotted_name
+
+CACHE_VERSION = 4
+CACHE_DIR = Path(__file__).parent / ".cache"
+
+_LOCK_FACTORY_RE = re.compile(r"(^|\.)(Lock|RLock|Condition|Semaphore)$")
+_LOCKISH_NAME_RE = re.compile(r"lock|mutex|cond|(^|_)cv$", re.IGNORECASE)
+#: threading primitives whose *methods* are inherently thread-safe —
+#: ``self._stop.clear()`` is not a data race even with no lock held
+_THREADSAFE_FACTORY_RE = re.compile(
+    r"(^|\.)(Event|Lock|RLock|Condition|Semaphore|BoundedSemaphore|"
+    r"Barrier|Queue|SimpleQueue|LifoQueue|PriorityQueue)$"
+)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "discard", "add", "clear", "update",
+    "setdefault", "sort", "reverse",
+})
+#: names too stdlib-common for the unique-method-name fallback: resolving
+#: ``some_deque.clear()`` to a project class's ``clear`` would wire bogus
+#: edges through the graph
+_FALLBACK_STOPLIST = frozenset({
+    "get", "set", "pop", "add", "clear", "update", "append", "remove",
+    "start", "stop", "run", "close", "open", "wait", "submit", "send",
+    "put", "join", "items", "keys", "values", "copy", "read", "write",
+    "encode", "decode", "acquire", "release", "flush", "begin", "finish",
+    "cancel", "abort", "reset", "commit", "check", "parse", "load",
+    "next", "count", "index", "insert", "sort", "format",
+})
+_THREAD_ROOT_MARK_RE = re.compile(
+    r"#\s*distlint:\s*thread-root(?:\[([A-Za-z0-9_.-]+)\])?")
+_THREAD_CONFINED_MARK_RE = re.compile(r"#\s*distlint:\s*thread-confined")
+
+#: container generics whose single argument is the element type
+_LISTY = frozenset({"List", "list", "Sequence", "Deque", "deque", "Set",
+                    "set", "FrozenSet", "frozenset", "Iterable",
+                    "Iterator", "Tuple", "tuple"})
+_DICTY = frozenset({"Dict", "dict", "Mapping", "MutableMapping",
+                    "DefaultDict", "OrderedDict"})
+
+
+# ---------------------------------------------------------------------------
+# summary data model (plain picklable records)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Sig:
+    """One function/method signature (``self`` already stripped)."""
+
+    pos: Tuple[str, ...]
+    n_defaults: int
+    vararg: bool
+    kwonly: Tuple[Tuple[str, bool], ...]  # (name, has_default)
+    kwarg: bool
+
+
+@dataclass(frozen=True)
+class WriteSite:
+    cls: str  # class id the written attribute belongs to
+    attr: str
+    fn: str  # function id containing the write
+    path: str
+    lineno: int
+    locks: Tuple[str, ...]  # lock ids held at the write
+    caller_locked: bool  # write in a *_locked method (wildcard lock)
+    is_init: bool
+    via_method: str  # mutator method name, "" for assignment
+
+
+@dataclass(frozen=True)
+class SpawnSite:
+    fn: str  # function containing the Thread(...) call
+    target: str  # resolved target function id
+    label: str  # thread name= constant, or the target's short name
+    path: str
+    lineno: int
+
+
+@dataclass(frozen=True)
+class AttrCall:
+    """One ``<recv>.method(...)`` call with a usable receiver: ``recv``
+    is a resolved class id, ``mod:<path>`` for a module alias, or
+    ``name:<tail>`` (the receiver's final attribute/variable name) when
+    typing failed."""
+
+    recv: str
+    method: str
+    n_pos: int
+    kwnames: Tuple[str, ...]
+    has_star: bool
+    has_kwstar: bool
+    path: str
+    lineno: int
+    context: str
+    #: literal values of the first two positional args when they are
+    #: string constants (None otherwise) — config-key checks need them
+    str_args: Tuple[Optional[str], ...] = ()
+
+
+@dataclass(frozen=True)
+class LockOrderEdge:
+    held: str
+    acquired: str
+    fn: str  # function providing the example site
+    path: str
+    lineno: int
+
+
+@dataclass(frozen=True)
+class FuncNode:
+    id: str
+    path: str
+    qualname: str
+    name: str
+    cls: Optional[str]  # owning class id, None for module functions
+    lineno: int
+    is_async: bool
+
+
+@dataclass
+class ProjectSummary:
+    functions: Dict[str, FuncNode] = field(default_factory=dict)
+    calls: Dict[str, List[str]] = field(default_factory=dict)
+    #: (caller fn, callee fn, locks held at the call site, lineno)
+    calls_under_lock: List[Tuple[str, str, Tuple[str, ...], int]] = \
+        field(default_factory=list)
+    #: fn id -> [(lock id, lineno)] direct acquisitions
+    acquires: Dict[str, List[Tuple[str, int]]] = field(default_factory=dict)
+    intra_lock_edges: List[LockOrderEdge] = field(default_factory=list)
+    writes: List[WriteSite] = field(default_factory=list)
+    spawns: List[SpawnSite] = field(default_factory=list)
+    attr_calls: List[AttrCall] = field(default_factory=list)
+    class_methods: Dict[str, Dict[str, Sig]] = field(default_factory=dict)
+    class_members: Dict[str, Set[str]] = field(default_factory=dict)
+    class_locks: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    class_threadsafe_attrs: Dict[str, Set[str]] = field(default_factory=dict)
+    class_confined: Set[str] = field(default_factory=set)
+    class_lineno: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    module_funcs: Dict[str, Dict[str, Sig]] = field(default_factory=dict)
+    lock_kinds: Dict[str, str] = field(default_factory=dict)
+    thread_marks: Dict[str, str] = field(default_factory=dict)  # fn -> label
+
+
+def short(ident: str) -> str:
+    """Readable form of a function/class/lock id: drop the path."""
+    return ident.rsplit("::", 1)[-1]
+
+
+# ---------------------------------------------------------------------------
+# pass 1: module indexes (imports, classes, attribute types)
+# ---------------------------------------------------------------------------
+
+
+def _module_key(dotted: str, known: Set[str]) -> Optional[str]:
+    """Map ``a.b.c`` to the repo-relative path key, if analyzed."""
+    path = dotted.replace(".", "/") + ".py"
+    if path in known:
+        return path
+    init = dotted.replace(".", "/") + "/__init__.py"
+    return init if init in known else None
+
+
+class _ModuleIndex:
+    def __init__(self, module: Module, known_paths: Set[str]):
+        self.path = module.path
+        self.module = module
+        # alias -> ("mod", path) | ("member", path, name)
+        self.imports: Dict[str, Tuple] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.functions: Dict[str, ast.AST] = {}
+        self.var_types: Dict[str, Tuple] = {}  # module-level annotated vars
+        for node in module.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    key = _module_key(a.name, known_paths)
+                    if key:
+                        self.imports[a.asname or a.name.split(".")[0]] = \
+                            ("mod", key)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                key = _module_key(node.module, known_paths)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    # ``from pkg.serving import faults`` imports a
+                    # MODULE, not a member — resolve submodules first
+                    sub = _module_key(f"{node.module}.{a.name}",
+                                      known_paths)
+                    if sub:
+                        self.imports[a.asname or a.name] = ("mod", sub)
+                    elif key:
+                        self.imports[a.asname or a.name] = \
+                            ("member", key, a.name)
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+
+
+class _Project:
+    """Cross-module resolution context shared by the pass-2 walkers."""
+
+    def __init__(self, modules: Sequence[Module]):
+        self.modules = {m.path: m for m in modules}
+        known = set(self.modules)
+        self.index = {m.path: _ModuleIndex(m, known) for m in modules}
+        # global name tables
+        self.class_ids: Dict[str, List[str]] = {}  # ClassName -> [ids]
+        self.method_classes: Dict[str, List[str]] = {}  # meth -> [class ids]
+        for path, idx in self.index.items():
+            for cname in idx.classes:
+                self.class_ids.setdefault(cname, []).append(
+                    f"{path}::{cname}")
+        self.class_nodes: Dict[str, ast.ClassDef] = {
+            f"{path}::{cname}": node
+            for path, idx in self.index.items()
+            for cname, node in idx.classes.items()
+        }
+        for cid, node in self.class_nodes.items():
+            for meth in node.body:
+                if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.method_classes.setdefault(meth.name, []).append(cid)
+        self.attr_types: Dict[str, Dict[str, Tuple]] = {}
+        self.class_bases: Dict[str, List[str]] = {}
+        for cid, node in self.class_nodes.items():
+            path = cid.split("::", 1)[0]
+            self.class_bases[cid] = [
+                b for b in
+                (self.resolve_class_name(bb, path) for bb in node.bases)
+                if b is not None
+            ]
+        for cid, node in self.class_nodes.items():
+            self.attr_types[cid] = self._infer_attr_types(cid, node)
+        # module-level annotated variables (e.g. ``_active:
+        # Optional[FaultSet] = None``) type reads of those globals
+        for path, idx in self.index.items():
+            for node in idx.module.tree.body:
+                if isinstance(node, ast.AnnAssign) and isinstance(
+                        node.target, ast.Name):
+                    t = self.resolve_annotation(node.annotation, path)
+                    if t is not None:
+                        idx.var_types[node.target.id] = t
+
+    # -- name/type resolution ---------------------------------------------
+
+    def resolve_class_name(self, node: ast.AST, path: str) -> Optional[str]:
+        """Resolve an expression naming a class to its class id."""
+        idx = self.index.get(path)
+        if idx is None:
+            return None
+        if isinstance(node, ast.Name):
+            if node.id in idx.classes:
+                return f"{path}::{node.id}"
+            imp = idx.imports.get(node.id)
+            if imp and imp[0] == "member":
+                _, mpath, name = imp
+                if name in self.index[mpath].classes:
+                    return f"{mpath}::{name}"
+            return None
+        if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                          ast.Name):
+            imp = idx.imports.get(node.value.id)
+            if imp and imp[0] == "mod":
+                mpath = imp[1]
+                if node.attr in self.index[mpath].classes:
+                    return f"{mpath}::{node.attr}"
+        return None
+
+    def resolve_annotation(self, node: Optional[ast.AST],
+                           path: str) -> Optional[Tuple]:
+        """Annotation AST -> ("cls", id) | ("list", id) | ("dict", id)."""
+        if node is None:
+            return None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            try:
+                node = ast.parse(node.value, mode="eval").body
+            except SyntaxError:
+                return None
+        cid = self.resolve_class_name(node, path)
+        if cid is not None:
+            return ("cls", cid)
+        if isinstance(node, ast.Subscript):
+            base = dotted_name(node.value).rsplit(".", 1)[-1]
+            args = (list(node.slice.elts)
+                    if isinstance(node.slice, ast.Tuple) else [node.slice])
+            if base in ("Optional",):
+                return self.resolve_annotation(args[0], path)
+            if base in ("Union",):
+                hits = [t for t in
+                        (self.resolve_annotation(a, path) for a in args)
+                        if t is not None]
+                return hits[0] if len(hits) == 1 else None
+            if base in _LISTY and args:
+                inner = self.resolve_annotation(args[0], path)
+                if inner and inner[0] == "cls":
+                    return ("list", inner[1])
+            if base in _DICTY and len(args) == 2:
+                inner = self.resolve_annotation(args[1], path)
+                if inner and inner[0] == "cls":
+                    return ("dict", inner[1])
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+            hits = [t for t in (self.resolve_annotation(node.left, path),
+                                self.resolve_annotation(node.right, path))
+                    if t is not None]
+            return hits[0] if len(hits) == 1 else None
+        return None
+
+    def _infer_attr_types(self, cid: str, node: ast.ClassDef) -> Dict[str, Tuple]:
+        """``self.X`` types from annotated assigns, annotated-parameter
+        aliasing (``self.x = x`` with ``x: T``), and constructor calls."""
+        path = cid.split("::", 1)[0]
+        out: Dict[str, Tuple] = {}
+        for stmt in node.body:  # class-level annotations (dataclasses)
+            if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                              ast.Name):
+                t = self.resolve_annotation(stmt.annotation, path)
+                if t is not None:
+                    out.setdefault(stmt.target.id, t)
+        for meth in node.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = {
+                a.arg: self.resolve_annotation(a.annotation, path)
+                for a in meth.args.args + meth.args.kwonlyargs
+            }
+            for stmt in ast.walk(meth):
+                target = value = None
+                if isinstance(stmt, ast.AnnAssign):
+                    target, value = stmt.target, stmt.value
+                    attr = _self_attr(target)
+                    if attr is not None:
+                        t = self.resolve_annotation(stmt.annotation, path)
+                        if t is not None:
+                            out.setdefault(attr, t)
+                        continue
+                elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                    target, value = stmt.targets[0], stmt.value
+                attr = _self_attr(target) if target is not None else None
+                if attr is None or attr in out or value is None:
+                    continue
+                if isinstance(value, ast.Name):
+                    t = params.get(value.id)
+                    if t is not None:
+                        out[attr] = t
+                elif isinstance(value, ast.Call):
+                    ctor = self.resolve_class_name(value.func, path)
+                    if ctor is not None:
+                        out[attr] = ("cls", ctor)
+        return out
+
+    def mro(self, cid: str) -> List[str]:
+        """cid plus project base classes (linear, cycle-safe)."""
+        out, seen, queue = [], set(), [cid]
+        while queue:
+            c = queue.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            out.append(c)
+            queue.extend(self.class_bases.get(c, []))
+        return out
+
+    def lookup_method(self, cid: str, name: str) -> Optional[str]:
+        for c in self.mro(cid):
+            node = self.class_nodes.get(c)
+            if node is None:
+                continue
+            for meth in node.body:
+                if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and meth.name == name:
+                    return f"{c}.{name}"
+        return None
+
+
+def _self_attr(node: Optional[ast.AST]) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _signature(fn, is_method: bool) -> Sig:
+    a = fn.args
+    pos = [p.arg for p in a.posonlyargs + a.args]
+    if is_method and pos and pos[0] in ("self", "cls"):
+        pos = pos[1:]
+    return Sig(
+        pos=tuple(pos),
+        n_defaults=len(a.defaults),
+        vararg=a.vararg is not None,
+        kwonly=tuple((p.arg, d is not None)
+                     for p, d in zip(a.kwonlyargs, a.kw_defaults)),
+        kwarg=a.kwarg is not None,
+    )
+
+
+def _line_has_mark(module: Module, lineno: int, regex) -> Optional[re.Match]:
+    """Marker on the def line itself, or anywhere in the contiguous
+    comment block directly above it (markers carry justifications, which
+    often run several comment lines)."""
+    if 1 <= lineno <= len(module.lines):
+        m = regex.search(module.lines[lineno - 1])
+        if m:
+            return m
+    cand = lineno - 1
+    while 1 <= cand <= len(module.lines) \
+            and module.lines[cand - 1].strip().startswith(("#", "@")):
+        m = regex.search(module.lines[cand - 1])
+        if m:
+            return m
+        cand -= 1
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pass 2: per-function walk (calls, writes, locks, spawns)
+# ---------------------------------------------------------------------------
+
+
+class _FuncWalker:
+    """Walk one function body (nested defs skipped) with a small local
+    type environment, emitting summary records."""
+
+    def __init__(self, project: _Project, summary: ProjectSummary,
+                 module: Module, fn_id: str, fn_node,
+                 cls_id: Optional[str]):
+        self.p = project
+        self.s = summary
+        self.module = module
+        self.path = module.path
+        self.fn_id = fn_id
+        self.fn = fn_node
+        self.cls = cls_id
+        self.qual = short(fn_id)
+        self.env: Dict[str, Tuple] = {}
+        idx = project.index[self.path]
+        for name, t in idx.var_types.items():
+            self.env[name] = t
+        for a in fn_node.args.args + fn_node.args.kwonlyargs:
+            t = project.resolve_annotation(a.annotation, self.path)
+            if t is not None:
+                self.env[a.arg] = t
+        self.held: List[str] = []  # lock-id stack
+        self.edges: List[str] = []
+
+    # -- typing -----------------------------------------------------------
+
+    def type_of(self, node: ast.AST) -> Optional[Tuple]:
+        if isinstance(node, ast.Name):
+            if node.id == "self" and self.cls:
+                return ("cls", self.cls)
+            return self.env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.type_of(node.value)
+            if base and base[0] == "cls":
+                return self.p.attr_types.get(base[1], {}).get(node.attr)
+            return None
+        if isinstance(node, ast.Call):
+            return self._call_result_type(node)
+        if isinstance(node, ast.Subscript):
+            base = self.type_of(node.value)
+            if base and base[0] in ("list", "dict"):
+                return ("cls", base[1])
+            return None
+        if isinstance(node, ast.Await):
+            return self.type_of(node.value)
+        return None
+
+    def _call_result_type(self, node: ast.Call) -> Optional[Tuple]:
+        # list()/sorted()/... pass their argument's element type through
+        if isinstance(node.func, ast.Name) and node.func.id in (
+                "list", "sorted", "tuple", "set", "reversed") and node.args:
+            inner = self.type_of(node.args[0])
+            if inner and inner[0] in ("list", "dict"):
+                return inner if inner[0] == "list" else None
+            return None
+        # constructor?
+        ctor = self.p.resolve_class_name(node.func, self.path)
+        if ctor is not None:
+            return ("cls", ctor)
+        callee = self._resolve_callee(node.func)
+        if callee is None and isinstance(node.func, ast.Attribute):
+            # container protocol: d.get(...) on Dict[K, V] -> V, and
+            # .popleft/.pop on Deque[V] -> V
+            base = self.type_of(node.func.value)
+            if base and base[0] == "dict" and node.func.attr == "get":
+                return ("cls", base[1])
+            if base and base[0] == "list" and node.func.attr in (
+                    "pop", "popleft"):
+                return ("cls", base[1])
+            if base and base[0] == "dict" and node.func.attr == "values":
+                return ("list", base[1])
+            return None
+        if callee is None:
+            return None
+        fn_node = self._fn_ast(callee)
+        if fn_node is None or fn_node.returns is None:
+            return None
+        return self.p.resolve_annotation(fn_node.returns,
+                                         callee.split("::", 1)[0])
+
+    def _fn_ast(self, fn_id: str):
+        path, qual = fn_id.split("::", 1)
+        idx = self.p.index.get(path)
+        if idx is None:
+            return None
+        if "." in qual:
+            cname, mname = qual.rsplit(".", 1)
+            node = idx.classes.get(cname)
+            if node is None:
+                return None
+            for meth in node.body:
+                if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef))\
+                        and meth.name == mname:
+                    return meth
+            return None
+        return idx.functions.get(qual)
+
+    # -- callee resolution -------------------------------------------------
+
+    def _resolve_callee(self, func: ast.AST) -> Optional[str]:
+        idx = self.p.index[self.path]
+        if isinstance(func, ast.Name):
+            if func.id in idx.functions:
+                return f"{self.path}::{func.id}"
+            if func.id in idx.classes:
+                init = self.p.lookup_method(f"{self.path}::{func.id}",
+                                            "__init__")
+                return init
+            imp = idx.imports.get(func.id)
+            if imp and imp[0] == "member":
+                _, mpath, name = imp
+                midx = self.p.index[mpath]
+                if name in midx.functions:
+                    return f"{mpath}::{name}"
+                if name in midx.classes:
+                    return self.p.lookup_method(f"{mpath}::{name}",
+                                                "__init__")
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = func.value
+        # module alias: faults.fire(...)
+        if isinstance(recv, ast.Name):
+            imp = idx.imports.get(recv.id)
+            if imp and imp[0] == "mod":
+                mpath = imp[1]
+                midx = self.p.index[mpath]
+                if func.attr in midx.functions:
+                    return f"{mpath}::{func.attr}"
+                if func.attr in midx.classes:
+                    return self.p.lookup_method(f"{mpath}::{func.attr}",
+                                                "__init__")
+                return None
+        t = self.type_of(recv)
+        if t and t[0] == "cls":
+            hit = self.p.lookup_method(t[1], func.attr)
+            if hit is not None:
+                return hit
+            # fall through: the attribute may hold a bound callable
+            # (``runner.redispatch`` wired to ``Dispatcher.redispatch``)
+        # unique-method-name fallback
+        if func.attr not in _FALLBACK_STOPLIST:
+            owners = self.p.method_classes.get(func.attr, [])
+            if len(owners) == 1:
+                return f"{owners[0]}.{func.attr}"
+        return None
+
+    # -- record helpers ----------------------------------------------------
+
+    def _record_call(self, node: ast.Call) -> None:
+        callee = self._resolve_callee(node.func)
+        if callee is not None and callee in self.s.functions:
+            self.edges.append(callee)
+            if self.held:
+                self.s.calls_under_lock.append(
+                    (self.fn_id, callee, tuple(self.held), node.lineno))
+        self._record_spawn(node)
+        self._record_attr_call(node)
+
+    def _record_spawn(self, node: ast.Call) -> None:
+        dotted = dotted_name(node.func)
+        if dotted.rsplit(".", 1)[-1] != "Thread":
+            return
+        target = name_const = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target = kw.value
+            elif kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                name_const = kw.value.value
+        if target is None:
+            return
+        tid = self._resolve_callee(target) if isinstance(
+            target, (ast.Name, ast.Attribute)) else None
+        if tid is None or tid not in self.s.functions:
+            return
+        self.s.spawns.append(SpawnSite(
+            fn=self.fn_id, target=tid,
+            label=name_const or short(tid),
+            path=self.path, lineno=node.lineno,
+        ))
+
+    def _record_attr_call(self, node: ast.Call) -> None:
+        if not isinstance(node.func, ast.Attribute):
+            return
+        recv = node.func.value
+        desc = None
+        t = self.type_of(recv)
+        if t and t[0] == "cls":
+            desc = t[1]
+        elif isinstance(recv, ast.Name):
+            imp = self.p.index[self.path].imports.get(recv.id)
+            if imp and imp[0] == "mod":
+                desc = f"mod:{imp[1]}"
+            else:
+                desc = f"name:{recv.id}"
+        elif isinstance(recv, ast.Attribute):
+            desc = f"name:{recv.attr}"
+        if desc is None:
+            return
+        self.s.attr_calls.append(AttrCall(
+            recv=desc, method=node.func.attr,
+            n_pos=sum(1 for a in node.args
+                      if not isinstance(a, ast.Starred)),
+            kwnames=tuple(kw.arg for kw in node.keywords
+                          if kw.arg is not None),
+            has_star=any(isinstance(a, ast.Starred) for a in node.args),
+            has_kwstar=any(kw.arg is None for kw in node.keywords),
+            path=self.path, lineno=node.lineno, context=self.qual,
+            str_args=tuple(
+                a.value if isinstance(a, ast.Constant)
+                and isinstance(a.value, str) else None
+                for a in node.args[:2]
+            ),
+        ))
+
+    def _record_writes(self, stmt: ast.AST) -> None:
+        is_init = self.fn.name == "__init__"
+        caller_locked = self.fn.name.endswith("_locked")
+
+        def emit(recv: ast.AST, attr: str, via: str, node: ast.AST) -> None:
+            t = self.type_of(recv)
+            if not t or t[0] != "cls":
+                return
+            self.s.writes.append(WriteSite(
+                cls=t[1], attr=attr, fn=self.fn_id, path=self.path,
+                lineno=node.lineno, locks=tuple(self.held),
+                caller_locked=caller_locked, is_init=is_init,
+                via_method=via,
+            ))
+
+        def target_attr(tgt: ast.AST, node: ast.AST) -> None:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    target_attr(el, node)
+                return
+            if isinstance(tgt, ast.Attribute):
+                emit(tgt.value, tgt.attr, "", node)
+            elif isinstance(tgt, ast.Subscript) and isinstance(
+                    tgt.value, ast.Attribute):
+                emit(tgt.value.value, tgt.value.attr, "[]", node)
+
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                target_attr(tgt, stmt)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            target_attr(stmt.target, stmt)
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            f = stmt.value.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS \
+                    and isinstance(f.value, ast.Attribute):
+                emit(f.value.value, f.value.attr, f.attr, stmt)
+
+    # -- body walk ---------------------------------------------------------
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is None or self.cls is None:
+            return None
+        kinds = self.s.class_locks.get(self.cls, {})
+        if attr in kinds:
+            return f"{self.cls}.{attr}"
+        if _LOCKISH_NAME_RE.search(attr):
+            return f"{self.cls}.{attr}"
+        return None
+
+    def walk(self) -> None:
+        for stmt in self.fn.body:
+            self._walk(stmt)
+        self.s.calls[self.fn_id] = sorted(set(self.edges))
+
+    def _walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # closures run later, elsewhere
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            entered: List[str] = []
+            for item in node.items:
+                self._walk(item.context_expr)
+                lock = self._lock_id(item.context_expr)
+                if lock is not None:
+                    for h in self.held:
+                        self.s.intra_lock_edges.append(LockOrderEdge(
+                            held=h, acquired=lock, fn=self.fn_id,
+                            path=self.path, lineno=node.lineno))
+                    self.s.acquires.setdefault(self.fn_id, []).append(
+                        (lock, node.lineno))
+                    entered.append(lock)
+                    self.held.append(lock)
+                if item.optional_vars is not None:
+                    self._walk(item.optional_vars)
+            for stmt in node.body:
+                self._walk(stmt)
+            for _ in entered:
+                self.held.pop()
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node)
+        self._record_writes(node)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            t = self.type_of(node.value)
+            if t is not None:
+                self.env[node.targets[0].id] = t
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                            ast.Name):
+            t = self.p.resolve_annotation(node.annotation, self.path)
+            if t is not None:
+                self.env[node.target.id] = t
+        elif isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            t = self.type_of(node.iter)
+            if t and t[0] == "list":
+                self.env[node.target.id] = ("cls", t[1])
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+
+# ---------------------------------------------------------------------------
+# build + cache
+# ---------------------------------------------------------------------------
+
+
+def _content_key(modules: Sequence[Module]) -> str:
+    h = hashlib.sha256(f"v{CACHE_VERSION}".encode())
+    for m in sorted(modules, key=lambda m: m.path):
+        h.update(m.path.encode())
+        h.update(hashlib.sha256(
+            "\n".join(m.lines).encode("utf-8", "replace")).digest())
+    return h.hexdigest()
+
+
+_MEMO: Dict[str, ProjectSummary] = {}
+
+
+def build_summary(modules: Sequence[Module],
+                  use_disk_cache: Optional[bool] = None) -> ProjectSummary:
+    """Build (or fetch) the project summary for this exact module set."""
+    if use_disk_cache is None:
+        # only persist package-sized builds: a 2-module test fixture must
+        # not evict the whole-package cache the next --changed run needs
+        use_disk_cache = len(modules) >= 10
+    key = _content_key(modules)
+    hit = _MEMO.get(key)
+    if hit is not None:
+        return hit
+    cache_file = CACHE_DIR / f"callgraph-{key[:16]}.pkl"
+    if use_disk_cache and cache_file.exists():
+        try:
+            with cache_file.open("rb") as f:
+                stored_key, summary = pickle.load(f)
+            if stored_key == key and isinstance(summary, ProjectSummary):
+                _MEMO.clear()
+                _MEMO[key] = summary
+                return summary
+        except Exception:  # distlint: ignore[DL004] -- any unpickling
+            pass  # failure (corrupt/stale cache) falls back to a rebuild
+    summary = _build(modules)
+    _MEMO.clear()  # one live entry: fixture runs must not accumulate
+    _MEMO[key] = summary
+    if use_disk_cache:
+        try:
+            CACHE_DIR.mkdir(exist_ok=True)
+            for old in CACHE_DIR.glob("callgraph-*.pkl"):
+                old.unlink()
+            with cache_file.open("wb") as f:
+                pickle.dump((key, summary), f)
+        except OSError:
+            pass  # read-only checkout: the in-process memo still holds
+    return summary
+
+
+def _build(modules: Sequence[Module]) -> ProjectSummary:
+    project = _Project(modules)
+    s = ProjectSummary()
+
+    # class tables + function nodes
+    for path, idx in project.index.items():
+        module = project.modules[path]
+        s.module_funcs[path] = {
+            name: _signature(fn, is_method=False)
+            for name, fn in idx.functions.items()
+        }
+        for name, fn in idx.functions.items():
+            fid = f"{path}::{name}"
+            s.functions[fid] = FuncNode(
+                id=fid, path=path, qualname=name, name=name, cls=None,
+                lineno=fn.lineno,
+                is_async=isinstance(fn, ast.AsyncFunctionDef),
+            )
+            mark = _line_has_mark(module, fn.lineno, _THREAD_ROOT_MARK_RE)
+            if mark:
+                s.thread_marks[fid] = mark.group(1) or name
+        for cname, cnode in idx.classes.items():
+            cid = f"{path}::{cname}"
+            s.class_lineno[cid] = (path, cnode.lineno)
+            if _line_has_mark(module, cnode.lineno,
+                              _THREAD_CONFINED_MARK_RE):
+                s.class_confined.add(cid)
+            members: Set[str] = set()
+            methods: Dict[str, Sig] = {}
+            locks: Dict[str, str] = {}
+            safe: Set[str] = set()
+            for item in cnode.body:
+                if isinstance(item, ast.AnnAssign) and isinstance(
+                        item.target, ast.Name):
+                    members.add(item.target.id)
+                elif isinstance(item, ast.Assign):
+                    for t in item.targets:
+                        if isinstance(t, ast.Name):
+                            members.add(t.id)
+                elif isinstance(item, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    members.add(item.name)
+                    methods[item.name] = _signature(item, is_method=True)
+                    fid = f"{cid}.{item.name}"
+                    s.functions[fid] = FuncNode(
+                        id=fid, path=path, qualname=f"{cname}.{item.name}",
+                        name=item.name, cls=cid, lineno=item.lineno,
+                        is_async=isinstance(item, ast.AsyncFunctionDef),
+                    )
+                    mark = _line_has_mark(module, item.lineno,
+                                          _THREAD_ROOT_MARK_RE)
+                    if mark:
+                        s.thread_marks[fid] = mark.group(1) or item.name
+                    for stmt in ast.walk(item):
+                        if not (isinstance(stmt, ast.Assign)
+                                and isinstance(stmt.value, ast.Call)):
+                            continue
+                        factory = dotted_name(stmt.value.func)
+                        for tgt in stmt.targets:
+                            attr = _self_attr(tgt)
+                            if attr is None:
+                                continue
+                            members.add(attr)
+                            m = _LOCK_FACTORY_RE.search(factory)
+                            if m:
+                                locks[attr] = m.group(2)
+                            if _THREADSAFE_FACTORY_RE.search(factory):
+                                safe.add(attr)
+            for base in project.mro(cid)[1:]:
+                bnode = project.class_nodes.get(base)
+                if bnode is not None:
+                    members |= {
+                        m.name for m in bnode.body
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                    }
+            s.class_methods[cid] = methods
+            s.class_members[cid] = members
+            s.class_locks[cid] = locks
+            s.class_threadsafe_attrs[cid] = safe
+
+    for cid, kinds in s.class_locks.items():
+        for attr, kind in kinds.items():
+            s.lock_kinds[f"{cid}.{attr}"] = kind
+
+    # pass 2: walk every function body
+    for path, idx in project.index.items():
+        module = project.modules[path]
+        for name, fn in idx.functions.items():
+            _FuncWalker(project, s, module, f"{path}::{name}", fn,
+                        None).walk()
+        for cname, cnode in idx.classes.items():
+            cid = f"{path}::{cname}"
+            for item in cnode.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    _FuncWalker(project, s, module,
+                                f"{cid}.{item.name}", item, cid).walk()
+    return s

@@ -1,9 +1,13 @@
-"""distlint rules DL001-DL007 (catalog + rationale: docs/LINTS.md).
+"""distlint rules DL001-DL012 (catalog + rationale: docs/LINTS.md).
 
 Each rule targets a failure class this codebase has actually hit or is
 structurally exposed to: blocking calls on the serving spine, unlocked
 shared state, silent exception swallowing, proto/wire drift, metric rot,
-and host-side work leaking into the per-token decode loop.
+and host-side work leaking into the per-token decode loop (DL001-DL007,
+single-module or table-driven), plus the interprocedural layer
+(tools/lint/callgraph.py + threads.py): cross-thread write analysis,
+lock-order cycles, internal-API call conformance, fault-point drift, and
+config-key drift (DL008-DL012).
 """
 
 from __future__ import annotations
@@ -748,4 +752,587 @@ class DL007(Rule):
                 self.generic_visit(node)
 
         V().visit(module.tree)
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DL008-DL010 — interprocedural rules over the call graph
+# (tools/lint/callgraph.py builds the summary, tools/lint/threads.py the
+# thread-ownership model; docs/LINTS.md documents both)
+# ---------------------------------------------------------------------------
+
+
+def _summary_and_module(modules: Sequence[Module]):
+    from tools.lint import callgraph
+
+    return (callgraph.build_summary(modules),
+            {m.path: m for m in modules})
+
+
+def _anchored(rule: Rule, by_path: Dict[str, Module], path: str,
+              lineno: int, message: str, context: str) -> Finding:
+    mod = by_path.get(path)
+    line_text = mod.text(lineno) if mod is not None else ""
+    return Finding(rule=rule.name, path=path, line=lineno, message=message,
+                   severity=rule.severity, context=context,
+                   line_text=line_text)
+
+
+@register
+class DL008(Rule):
+    """Thread-confinement: an attribute written from two or more inferred
+    thread roots (tools/lint/threads.py) with no lock common to every
+    write site is a cross-thread race waiting for load — the class of bug
+    behind the ``_fail_all_of``/``submit`` double-resolve (PR 5).
+
+    Honors the ``*_locked`` caller-holds-the-lock convention (such writes
+    never break a common lock), skips ``__init__`` (happens-before via
+    thread start), skips method-call mutations of threading primitives
+    (``Event.clear`` is internally locked), and skips classes marked
+    ``# distlint: thread-confined`` (single-owner by design, e.g. the
+    engine behind the runner's inbox).
+
+    Suppression is scoped deliberately: an ``ignore[DL008]`` on a WRITE
+    site waives exactly that site (every other — and every future — site
+    still participates in the analysis), while an ``ignore[DL008]`` on
+    the attribute's ``__init__`` declaration waives the whole attribute
+    — the visible way to say "this attribute is lock-free by design"
+    (e.g. the runner's GIL-atomic pop-first dict protocol)."""
+
+    name = "DL008"
+    title = "attribute written from multiple threads with no common lock"
+    severity = "P1"
+    scope = "project"
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        from tools.lint import callgraph, threads
+        from tools.lint.core import suppressed_rules
+
+        summary, by_path = _summary_and_module(modules)
+        owners = threads.ownership(summary)
+
+        def site_suppressed(w) -> bool:
+            mod = by_path.get(w.path)
+            return (mod is not None
+                    and self.name in suppressed_rules(mod, w.lineno))
+
+        groups: Dict[Tuple[str, str], list] = {}
+        waived: Set[Tuple[str, str]] = set()
+        for w in summary.writes:
+            if w.cls in summary.class_confined:
+                continue
+            if w.attr in summary.class_locks.get(w.cls, {}):
+                continue
+            if w.via_method and w.attr in \
+                    summary.class_threadsafe_attrs.get(w.cls, set()):
+                continue
+            if w.is_init:
+                # an ignore on the __init__ declaration is the
+                # attribute-wide "lock-free by design" waiver
+                if site_suppressed(w):
+                    waived.add((w.cls, w.attr))
+                continue
+            groups.setdefault((w.cls, w.attr), []).append(w)
+        findings = []
+        for (cls, attr), sites in sorted(groups.items()):
+            if (cls, attr) in waived:
+                continue
+            # a suppressed write site drops out of the analysis alone; a
+            # racy site added later is NOT covered by it (the finding
+            # re-anchors to the first unsuppressed site)
+            sites = [w for w in sites if not site_suppressed(w)]
+            roots: Set[str] = set()
+            for w in sites:
+                roots |= owners.get(w.fn, set())
+            if len(roots) < 2:
+                continue
+            plain = [w for w in sites if not w.caller_locked]
+            if not plain:
+                continue  # every write declares caller-holds-the-lock
+            common = set(plain[0].locks)
+            for w in plain[1:]:
+                common &= set(w.locks)
+            if common:
+                continue
+            sites_sorted = sorted(sites, key=lambda w: (w.path, w.lineno))
+            anchor = sites_sorted[0]
+            others = ", ".join(
+                f"{w.path.rsplit('/', 1)[-1]}:{w.lineno}"
+                for w in sites_sorted[1:6])
+            findings.append(_anchored(
+                self, by_path, anchor.path, anchor.lineno,
+                f"{callgraph.short(cls)}.{attr} is written from "
+                f"{len(roots)} threads ({threads.describe_roots(roots)}) "
+                f"with no common lock"
+                + (f"; other write sites: {others}" if others else "")
+                + " — guard every site with one lock, route the write "
+                "through the owning thread, or suppress with the "
+                "safety argument",
+                context=callgraph.short(anchor.fn),
+            ))
+        return findings
+
+
+@register
+class DL009(Rule):
+    """Lock-order cycles across the serving spine: if thread 1 can hold
+    lock A while (transitively, through the call graph) acquiring lock B
+    and thread 2 the reverse, the fleet can deadlock under load. Also
+    flags self-reacquisition of a plain (non-reentrant)
+    ``threading.Lock`` through a call chain — a single-thread deadlock."""
+
+    name = "DL009"
+    title = "lock-order cycle (potential deadlock)"
+    severity = "P1"
+    scope = "project"
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        from tools.lint import callgraph, threads
+
+        summary, by_path = _summary_and_module(modules)
+        # one fixpoint serves both the cycle and self-reacquire passes
+        acq = threads.transitive_acquires(summary)
+        edges = threads.lock_order_edges(summary, acq=acq)
+        findings = []
+        for cycle in threads.find_lock_cycles(edges):
+            pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+            example = edges[pairs[0]][0]
+            order = " -> ".join(callgraph.short(c) for c in cycle)
+            sites = "; ".join(
+                f"{callgraph.short(a)}->{callgraph.short(b)} at "
+                f"{edges[(a, b)][0][1].rsplit('/', 1)[-1]}:"
+                f"{edges[(a, b)][0][2]}"
+                for a, b in pairs)
+            findings.append(_anchored(
+                self, by_path, example[1], example[2],
+                f"lock-order cycle {order} -> {callgraph.short(cycle[0])} "
+                f"(acquisition sites: {sites}) — pick one global order "
+                "or narrow a critical section",
+                context=callgraph.short(example[0]),
+            ))
+        # plain-Lock re-acquisition through a call chain
+        seen: Set[Tuple[str, str, int]] = set()
+        for caller, callee, held, lineno in summary.calls_under_lock:
+            node = summary.functions.get(caller)
+            if node is None:
+                continue
+            for lock, lpath, lline in sorted(acq.get(callee, ())):
+                if lock in held \
+                        and summary.lock_kinds.get(lock) == "Lock":
+                    key = (caller, lock, lineno)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(_anchored(
+                        self, by_path, node.path, lineno,
+                        f"call while holding {callgraph.short(lock)} "
+                        f"reaches {callgraph.short(callee)}, which "
+                        f"re-acquires it ({lpath.rsplit('/', 1)[-1]}:"
+                        f"{lline}) — a plain Lock self-deadlocks",
+                        context=callgraph.short(caller),
+                    ))
+        return findings
+
+
+@register
+class DL010(Rule):
+    """Internal-API call conformance: calls through receivers that
+    resolve (by annotation or by the documented receiver-name
+    conventions) to the project's cross-thread utility classes are
+    checked against the *actual* signatures of those classes — the
+    ``Span.event(reason=...)`` TypeError that turned PR 5's invisible
+    redispatch into a client-visible failure becomes a lint error."""
+
+    name = "DL010"
+    title = "call does not conform to the target's actual signature"
+    severity = "P0"
+    scope = "project"
+
+    PKG = "distributed_inference_server_tpu"
+    #: (module path, class) -> receiver names that conventionally hold an
+    #: instance (used when annotation-driven typing can't see the type)
+    TARGETS: Dict[Tuple[str, str], frozenset] = {
+        (f"{PKG}/utils/tracing.py", "Span"):
+            frozenset({"span", "engine_span"}),
+        (f"{PKG}/utils/tracing.py", "Tracer"): frozenset({"tracer"}),
+        (f"{PKG}/serving/metrics.py", "MetricsCollector"):
+            frozenset({"metrics"}),
+        (f"{PKG}/serving/faults.py", "FaultSet"): frozenset(),
+    }
+    #: module whose *functions* are validated when called via its alias
+    FUNC_MODULES = (f"{PKG}/serving/faults.py",)
+
+    @staticmethod
+    def _sig_errors(sig, call) -> List[str]:
+        if call.has_star or call.has_kwstar:
+            return []  # splats are untypable statically
+        errs = []
+        if call.n_pos > len(sig.pos) and not sig.vararg:
+            errs.append(f"takes {len(sig.pos)} positional argument(s), "
+                        f"got {call.n_pos}")
+        kwonly = {n for n, _ in sig.kwonly}
+        if not sig.kwarg:
+            for kw in call.kwnames:
+                if kw not in sig.pos and kw not in kwonly:
+                    errs.append(f"unexpected keyword argument {kw!r}")
+        n_required = len(sig.pos) - sig.n_defaults
+        bound_pos = set(sig.pos[:min(call.n_pos, len(sig.pos))])
+        for name in sig.pos[:n_required]:
+            if name not in bound_pos and name not in call.kwnames:
+                errs.append(f"missing required argument {name!r}")
+        for name, has_default in sig.kwonly:
+            if not has_default and name not in call.kwnames:
+                errs.append(
+                    f"missing required keyword-only argument {name!r}")
+        for kw in call.kwnames:
+            if kw in bound_pos:
+                errs.append(f"argument {kw!r} given both positionally "
+                            "and by keyword")
+        return errs
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        from tools.lint import callgraph
+
+        summary, by_path = _summary_and_module(modules)
+        class_ids = {}
+        heuristics = {}
+        for (path, cls), names in self.TARGETS.items():
+            cid = f"{path}::{cls}"
+            if cid in summary.class_methods:
+                class_ids[cid] = cls
+                for n in names:
+                    heuristics[n] = cid
+        # module-level names per targeted module: accesses through the
+        # alias (``metrics.EngineStatus``) are not collector calls
+        module_names: Dict[str, Set[str]] = {}
+        for path in {p for p, _c in self.TARGETS} | set(self.FUNC_MODULES):
+            module_names[path] = set(summary.module_funcs.get(path, ())) | {
+                callgraph.short(cid).rsplit(".", 1)[-1]
+                for cid in summary.class_methods if cid.startswith(path)
+            }
+        findings = []
+        for call in summary.attr_calls:
+            cid = sig = None
+            owner = ""
+            if call.recv in class_ids:
+                cid = call.recv
+            elif call.recv.startswith("name:"):
+                cid = heuristics.get(call.recv[5:])
+            elif call.recv.startswith("mod:"):
+                mpath = call.recv[4:]
+                if mpath in self.FUNC_MODULES:
+                    if call.method in module_names.get(mpath, ()):
+                        sig = summary.module_funcs[mpath].get(call.method)
+                        owner = mpath.rsplit("/", 1)[-1]
+                        if sig is None:
+                            continue  # a class accessed via the module
+                    else:
+                        findings.append(_anchored(
+                            self, by_path, call.path, call.lineno,
+                            f"{mpath.rsplit('/', 1)[-1]} has no "
+                            f"module-level {call.method!r}",
+                            context=call.context))
+                        continue
+            if cid is not None and sig is None:
+                owner = callgraph.short(cid)
+                mpath = cid.split("::", 1)[0]
+                sig = summary.class_methods[cid].get(call.method)
+                if sig is None:
+                    if (call.method.startswith("__")
+                            or call.method in summary.class_members.get(
+                                cid, set())
+                            or call.method in module_names.get(mpath, ())):
+                        continue  # field/property or module-alias access
+                    findings.append(_anchored(
+                        self, by_path, call.path, call.lineno,
+                        f"{owner} has no method {call.method!r} "
+                        "(typo'd internal-API call)",
+                        context=call.context))
+                    continue
+            if sig is None:
+                continue
+            for err in self._sig_errors(sig, call):
+                findings.append(_anchored(
+                    self, by_path, call.path, call.lineno,
+                    f"call to {owner}.{call.method}: {err} (signature: "
+                    f"({', '.join(sig.pos) or ''}"
+                    f"{', **kw' if sig.kwarg else ''}))",
+                    context=call.context))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DL011 — fault-point drift
+# ---------------------------------------------------------------------------
+
+# one dotted-point grammar shared by all four extractors: a catalog
+# entry every other regex cannot represent would be a permanently
+# "never fired" / "catalogs disagree" finding with no fix
+_POINT_PAT = r"[a-z_][a-z0-9_]*(?:\.[a-z_][a-z0-9_]*)+"
+_POINT_RE = re.compile(rf"^{_POINT_PAT}$")
+_SPEC_POINT_RE = re.compile(
+    rf"\b({_POINT_PAT}):(?:nth|prob|times|delay_ms)=")
+_DOCS_POINT_ROW_RE = re.compile(rf"^\|\s*`({_POINT_PAT})`\s*\|")
+_DOCSTRING_POINT_RE = re.compile(rf"^``({_POINT_PAT})``", re.MULTILINE)
+
+
+@register
+class DL011(Rule):
+    """Fault-point drift: every ``faults.fire("...")`` / ``flag`` /
+    ``_fault`` literal (and every point named in a fault-spec string)
+    must exist in the point catalog — the serving/faults.py module
+    docstring and the docs/RESILIENCE.md table — and every cataloged
+    point must be fired somewhere, or the resilience documentation and
+    the chaos harness drift away from the code they describe."""
+
+    name = "DL011"
+    title = "fault-injection point drift vs the point catalog"
+    severity = "P1"
+    scope = "project"
+
+    FAULTS_PATH = "distributed_inference_server_tpu/serving/faults.py"
+
+    def _fired_points(self, modules: Sequence[Module]):
+        """[(point, module, node)] from fire/flag/_fault call literals
+        and fault-spec strings (f-string heads included)."""
+        out = []
+        for mod in modules:
+            if mod.path == self.FAULTS_PATH:
+                continue  # the registry itself defines, not fires
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    dotted = dotted_name(node.func)
+                    tail = dotted.rsplit(".", 1)[-1]
+                    if tail in ("fire", "flag") or dotted == "_fault":
+                        if node.args and isinstance(node.args[0],
+                                                    ast.Constant) \
+                                and isinstance(node.args[0].value, str) \
+                                and _POINT_RE.match(node.args[0].value):
+                            out.append((node.args[0].value, mod, node))
+                elif isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str):
+                    for m in _SPEC_POINT_RE.finditer(node.value):
+                        out.append((m.group(1), mod, node))
+                elif isinstance(node, ast.JoinedStr):
+                    head = node.values[0] if node.values else None
+                    if isinstance(head, ast.Constant) \
+                            and isinstance(head.value, str):
+                        for m in _SPEC_POINT_RE.finditer(head.value):
+                            out.append((m.group(1), mod, node))
+        return out
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        faults_mod = next(
+            (m for m in modules if m.path == self.FAULTS_PATH), None)
+        code_catalog = None
+        if faults_mod is not None:
+            doc = ast.get_docstring(faults_mod.tree) or ""
+            code_catalog = set(_DOCSTRING_POINT_RE.findall(doc))
+        docs_path = root / "docs" / "RESILIENCE.md"
+        docs_catalog = None
+        if docs_path.exists():
+            docs_catalog = {
+                m.group(1)
+                for line in docs_path.read_text().splitlines()
+                for m in [_DOCS_POINT_ROW_RE.match(line)] if m
+            }
+        findings = []
+        fired = self._fired_points(modules)
+        for point, mod, node in fired:
+            missing = []
+            if code_catalog is not None and point not in code_catalog:
+                missing.append("serving/faults.py docstring")
+            if docs_catalog is not None and point not in docs_catalog:
+                missing.append("docs/RESILIENCE.md point catalog")
+            if missing:
+                findings.append(self.finding(
+                    mod, node,
+                    f"fault point {point!r} is not in the "
+                    f"{' or the '.join(missing)} — add it to the catalog "
+                    "or fix the literal",
+                ))
+        if faults_mod is not None and code_catalog is not None:
+            used = {p for p, _m, _n in fired}
+
+            def anchor_line(point: str) -> int:
+                for i, line in enumerate(faults_mod.lines, 1):
+                    if point in line:
+                        return i
+                return 1
+
+            for point in sorted(code_catalog - used):
+                findings.append(Finding(
+                    rule=self.name, path=faults_mod.path,
+                    line=anchor_line(point),
+                    message=f"cataloged fault point {point!r} is never "
+                            "fired/flagged anywhere — dead catalog entry "
+                            "or a lost injection site",
+                    severity=self.severity, context="point catalog",
+                    line_text=faults_mod.text(anchor_line(point)),
+                ))
+            if docs_catalog is not None:
+                for point in sorted(code_catalog ^ docs_catalog):
+                    where = ("docs/RESILIENCE.md"
+                             if point in code_catalog
+                             else "serving/faults.py docstring")
+                    findings.append(Finding(
+                        rule=self.name, path=faults_mod.path,
+                        line=anchor_line(point),
+                        message=f"point catalogs disagree: {point!r} "
+                                f"is missing from {where}",
+                        severity=self.severity, context="point catalog",
+                        line_text=faults_mod.text(anchor_line(point)),
+                    ))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DL012 — config-key drift
+# ---------------------------------------------------------------------------
+
+_ENV_KEY_RE = re.compile(r"DIS_TPU_([A-Z0-9]+)__([A-Z0-9_]+)")
+_CONFIGISH_RE = re.compile(r"(^|_)(cfg|config)$")
+
+
+@register
+class DL012(Rule):
+    """Config-key drift: ``config.get(section, key)`` calls, the raw
+    ``[section][key]`` / ``(section, key)`` literals inside
+    serving/config.py, and every ``DIS_TPU_<SECTION>__<FIELD>`` token in
+    the source must name a real ``_SCHEMA`` entry — a typo'd key
+    otherwise reads as a KeyError at boot (best case) or a silently
+    ignored override (worst case: the env var grammar).
+
+    Receiver discipline for ``.get``: a receiver *typed* (via the call
+    graph's annotation resolution) as ``ServerConfig`` is checked
+    strictly — unknown sections flag too; a merely config-*named*
+    receiver (``cfg``, ``config``, ``*_cfg``) gets the key check only
+    when the first argument already names a real section, so a plain
+    dict that happens to be called ``cfg`` (``cfg.get("bos_token", "")``
+    on tokenizer JSON) never misfires."""
+
+    name = "DL012"
+    title = "config key drift vs serving/config.py _SCHEMA"
+    severity = "P1"
+    scope = "project"
+
+    CONFIG_PATH = "distributed_inference_server_tpu/serving/config.py"
+    CONFIG_CLASS = f"{CONFIG_PATH}::ServerConfig"
+
+    @staticmethod
+    def _parse_schema(mod: Module) -> Optional[Dict[str, Set[str]]]:
+        for node in mod.tree.body:
+            if not (isinstance(node, (ast.Assign, ast.AnnAssign))):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            if not any(isinstance(t, ast.Name) and t.id == "_SCHEMA"
+                       for t in targets):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Dict):
+                return None
+            schema: Dict[str, Set[str]] = {}
+            for k, v in zip(value.keys, value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and isinstance(v, ast.Dict)):
+                    continue
+                schema[k.value] = {
+                    fk.value for fk in v.keys
+                    if isinstance(fk, ast.Constant)
+                    and isinstance(fk.value, str)
+                }
+            return schema
+        return None
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        cfg_mod = next((m for m in modules if m.path == self.CONFIG_PATH),
+                       None)
+        if cfg_mod is None:
+            return []
+        schema = self._parse_schema(cfg_mod)
+        if not schema:
+            return []
+        findings = []
+        by_path = {m.path: m for m in modules}
+
+        def check_pair(mod: Module, node: ast.AST, sec: str, key: str,
+                       require_section: bool) -> None:
+            if sec not in schema:
+                if require_section:
+                    findings.append(self.finding(
+                        mod, node,
+                        f"unknown config section {sec!r} "
+                        f"(sections: {', '.join(sorted(schema))})",
+                    ))
+                return
+            if key not in schema[sec]:
+                findings.append(self.finding(
+                    mod, node,
+                    f"config key {sec}.{key} is not in _SCHEMA "
+                    "(serving/config.py) — typo or missing schema entry",
+                ))
+
+        # .get(section, key) through the call graph's typed receivers
+        summary, _ = _summary_and_module(modules)
+        for call in summary.attr_calls:
+            if call.method != "get" or len(call.str_args) < 2 \
+                    or None in call.str_args[:2]:
+                continue
+            typed_config = call.recv == self.CONFIG_CLASS
+            named_config = (call.recv.startswith("name:")
+                            and _CONFIGISH_RE.search(call.recv[5:]))
+            if not (typed_config or named_config):
+                continue
+            mod = by_path.get(call.path)
+            if mod is None:
+                continue
+            anchor = ast.Constant(value=0)
+            anchor.lineno = call.lineno
+            check_pair(mod, anchor, call.str_args[0], call.str_args[1],
+                       require_section=typed_config)
+
+        for mod in modules:
+            in_config = mod.path == self.CONFIG_PATH
+            for node in ast.walk(mod.tree):
+                if in_config and isinstance(node, ast.Subscript) \
+                        and isinstance(node.value, ast.Subscript):
+                    outer, inner = node.slice, node.value.slice
+                    if isinstance(inner, ast.Constant) \
+                            and isinstance(inner.value, str) \
+                            and isinstance(outer, ast.Constant) \
+                            and isinstance(outer.value, str):
+                        check_pair(mod, node, inner.value, outer.value,
+                                   require_section=False)
+                elif in_config and isinstance(node, ast.Tuple) \
+                        and len(node.elts) == 2 \
+                        and all(isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)
+                                for e in node.elts):
+                    check_pair(mod, node, node.elts[0].value,
+                               node.elts[1].value, require_section=False)
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str):
+                    for m in _ENV_KEY_RE.finditer(node.value):
+                        sec, key = m.group(1).lower(), m.group(2).lower()
+                        if sec not in schema:
+                            findings.append(self.finding(
+                                mod, node,
+                                f"env var DIS_TPU_{m.group(1)}__"
+                                f"{m.group(2)} names unknown config "
+                                f"section {sec!r}",
+                            ))
+                        elif key not in schema[sec]:
+                            findings.append(self.finding(
+                                mod, node,
+                                f"env var DIS_TPU_{m.group(1)}__"
+                                f"{m.group(2)} names unknown key "
+                                f"{sec}.{key}",
+                            ))
         return findings

@@ -25,6 +25,7 @@ from tools.lint import rules as _rules  # noqa: F401 — populates RULES
 from tools.lint.core import (
     BASELINE_PATH,
     DEFAULT_TARGET,
+    EXTRA_TARGETS,
     RULES,
     apply_baseline,
     load_baseline,
@@ -35,9 +36,18 @@ from tools.lint.core import (
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 
 
+def _is_lint_target(path: str) -> bool:
+    if not path.endswith(".py"):
+        return False
+    if path.startswith(DEFAULT_TARGET + "/"):
+        return True
+    return any(path == t or path.startswith(t + "/")
+               for t in EXTRA_TARGETS)
+
+
 def changed_files(root: Path) -> Optional[List[str]]:
-    """Package .py files touched per git (staged, unstaged, untracked).
-    None (= lint everything) when git is unavailable."""
+    """Lint-target .py files touched per git (staged, unstaged,
+    untracked). None (= lint everything) when git is unavailable."""
     try:
         # -uall: plain porcelain collapses a new directory to one
         # "?? dir/" entry, which would hide every .py inside it
@@ -50,7 +60,7 @@ def changed_files(root: Path) -> Optional[List[str]]:
     files = []
     for line in out.splitlines():
         path = line[3:].split(" -> ")[-1].strip().strip('"')
-        if path.endswith(".py") and path.startswith(DEFAULT_TARGET + "/"):
+        if _is_lint_target(path):
             files.append(path)
     return files
 
@@ -73,6 +83,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--check-stale", action="store_true",
                     help="also fail on baseline entries that match nothing")
     ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--format", choices=("text", "github"), default="text",
+                    help="'github' additionally emits ::error workflow "
+                         "annotations so findings surface inline on PRs")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -135,6 +148,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         for f in new:
             print(f.render())
+            if args.format == "github":
+                # workflow-command annotation: one line, message sanitized
+                # per the docs (%, CR, LF escaped)
+                msg = (f.message.replace("%", "%25").replace("\r", "%0D")
+                       .replace("\n", "%0A"))
+                print(f"::error file={f.path},line={f.line},"
+                      f"title=distlint {f.rule}[{f.severity}]::{msg}")
         if new:
             print(f"\ndistlint: {len(new)} finding(s) "
                   f"({len(grandfathered)} baselined, "
@@ -147,6 +167,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "shrink tools/lint/baseline.json:")
             for e in stale:
                 print(f"  stale: {e['rule']} {e['path']} :: {e['line']}")
+            if args.format == "github":
+                print("::error file=tools/lint/baseline.json::"
+                      f"{len(stale)} baseline entr(y/ies) no longer match "
+                      "any finding — the baseline may only shrink "
+                      "(docs/LINTS.md)")
     rc = 1 if new else 0
     if args.check_stale and stale:
         rc = 1

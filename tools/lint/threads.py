@@ -1,0 +1,190 @@
+"""distlint thread-ownership inference over the call graph.
+
+Every thread in the serving stack enters the code at a **spawn root**:
+the engine thread (``EngineRunner._run``), the dispatcher's dispatch/
+sweep thread (``Dispatcher._loop``), the scheduler's health loop and
+restart workers, the disagg migration worker, the config watcher — all
+found automatically as ``threading.Thread(target=...)`` sites — plus the
+**asyncio** event loop, which runs every ``async def`` (the HTTP
+handlers in serving/server.py / handler.py / app.py), and any function
+carrying an explicit ``# distlint: thread-root`` marker (for entry
+points the detector cannot see, e.g. closures handed to executors).
+
+A function's **owners** are the roots that reach it through the call
+graph. Functions no root reaches are owned by ``main`` — the importing/
+test/benchmark thread that drives the public API directly. The analysis
+under-approximates (closures are skipped, dynamic dispatch may not
+resolve), so absence of a finding is not a proof — but every ownership
+set it does compute corresponds to real concurrent entry paths.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.lint.callgraph import ProjectSummary, short
+
+MAIN_ROOT = "main"
+ASYNC_ROOT = "asyncio"
+
+
+def spawn_roots(summary: ProjectSummary) -> Dict[str, Tuple[str, ...]]:
+    """root label -> entry function ids. Spawn sites with the same
+    target collapse into one root (N replicas of one thread body are one
+    ownership domain; per-instance state still races only across
+    *different* roots)."""
+    roots: Dict[str, Set[str]] = {}
+    by_target: Dict[str, str] = {}
+
+    def unique(label: str, entry: str) -> str:
+        """A label already owned by a DIFFERENT entry would merge two
+        ownership domains (and hide their races) — uniquify until the
+        label is free or already belongs to this entry."""
+        base, n = label, 2
+        while label in roots and entry not in roots[label]:
+            label = f"{base}#{n}"
+            n += 1
+        return label
+
+    for site in sorted(summary.spawns, key=lambda s: (s.path, s.lineno)):
+        label = by_target.get(site.target)
+        if label is None:
+            label = f"thread:{site.label}"
+            # two different targets may carry the same name= constant —
+            # and the qualname fallback can itself collide (same-named
+            # classes in different modules)
+            if label in roots and site.target not in roots[label]:
+                label = f"thread:{short(site.target)}"
+            label = unique(label, site.target)
+            by_target[site.target] = label
+        roots.setdefault(label, set()).add(site.target)
+    for fn, label in sorted(summary.thread_marks.items()):
+        name = f"thread:{label}"
+        if name in roots and fn not in roots[name]:
+            name = f"thread:{label}@{short(fn)}"
+        name = unique(name, fn)
+        roots.setdefault(name, set()).add(fn)
+    async_entries = {f.id for f in summary.functions.values() if f.is_async}
+    if async_entries:
+        roots[ASYNC_ROOT] = async_entries
+    return {label: tuple(sorted(fns)) for label, fns in roots.items()}
+
+
+def ownership(summary: ProjectSummary) -> Dict[str, Set[str]]:
+    """function id -> set of owning root labels (``{"main"}`` when no
+    spawned/async root reaches it)."""
+    owners: Dict[str, Set[str]] = {fid: set() for fid in summary.functions}
+    for label, entries in spawn_roots(summary).items():
+        seen: Set[str] = set()
+        queue = deque(entries)
+        while queue:
+            fn = queue.popleft()
+            if fn in seen or fn not in owners:
+                continue
+            seen.add(fn)
+            owners[fn].add(label)
+            queue.extend(summary.calls.get(fn, ()))
+    for fid, roots in owners.items():
+        if not roots:
+            roots.add(MAIN_ROOT)
+    return owners
+
+
+def describe_roots(roots: Set[str], limit: int = 4) -> str:
+    names = sorted(roots)
+    if len(names) > limit:
+        names = names[:limit] + [f"+{len(names) - limit} more"]
+    return ", ".join(names)
+
+
+def transitive_acquires(
+    summary: ProjectSummary,
+) -> Dict[str, Set[Tuple[str, str, int]]]:
+    """function id -> set of (lock id, example path, example line) the
+    function may acquire, directly or through any callee (fixpoint over
+    the call graph; cycles converge because sets only grow)."""
+    acq: Dict[str, Set[Tuple[str, str, int]]] = {
+        fid: set() for fid in summary.functions
+    }
+    for fid, sites in summary.acquires.items():
+        node = summary.functions.get(fid)
+        if node is None:
+            continue
+        for lock, lineno in sites:
+            acq[fid].add((lock, node.path, lineno))
+    changed = True
+    while changed:
+        changed = False
+        for fid, callees in summary.calls.items():
+            if fid not in acq:
+                continue
+            before = len(acq[fid])
+            for callee in callees:
+                acq[fid] |= acq.get(callee, set())
+            if len(acq[fid]) != before:
+                changed = True
+    return acq
+
+
+def lock_order_edges(
+    summary: ProjectSummary,
+    acq: Optional[Dict[str, Set[Tuple[str, str, int]]]] = None,
+) -> Dict[Tuple[str, str], List[Tuple[str, str, int]]]:
+    """(held lock, acquired lock) -> example sites, combining the
+    intra-function nested-``with`` edges with interprocedural ones: a
+    call made while holding lock A reaches, transitively, an acquisition
+    of lock B ⇒ A is ordered before B on that path. ``acq`` takes a
+    precomputed :func:`transitive_acquires` map so one run's passes
+    share one fixpoint."""
+    edges: Dict[Tuple[str, str], List[Tuple[str, str, int]]] = {}
+
+    def add(held: str, acquired: str, fn: str, path: str,
+            lineno: int) -> None:
+        if held == acquired:
+            return  # re-entry is DL009's self-deadlock case, kept apart
+        edges.setdefault((held, acquired), []).append((fn, path, lineno))
+
+    for e in summary.intra_lock_edges:
+        add(e.held, e.acquired, e.fn, e.path, e.lineno)
+    if acq is None:
+        acq = transitive_acquires(summary)
+    for caller, callee, held_locks, lineno in summary.calls_under_lock:
+        node = summary.functions.get(caller)
+        if node is None:
+            continue
+        for lock, _p, _l in acq.get(callee, ()):
+            for held in held_locks:
+                add(held, lock, caller, node.path, lineno)
+    return edges
+
+
+def find_lock_cycles(
+    edges: Dict[Tuple[str, str], List[Tuple[str, str, int]]],
+) -> List[List[str]]:
+    """Elementary cycles in the lock-order graph (each reported once,
+    rotated to start at its smallest lock id). The graphs here are tiny
+    — a DFS per node is plenty."""
+    graph: Dict[str, Set[str]] = {}
+    for held, acquired in edges:
+        graph.setdefault(held, set()).add(acquired)
+        graph.setdefault(acquired, set())
+    cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            on_path: Set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cyc = path[:]
+                pivot = cyc.index(min(cyc))
+                cycles.add(tuple(cyc[pivot:] + cyc[:pivot]))
+            elif nxt not in on_path and nxt > start:
+                # only explore nodes > start: each cycle is found from
+                # its smallest node exactly once
+                on_path.add(nxt)
+                dfs(start, nxt, path + [nxt], on_path)
+                on_path.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return [list(c) for c in sorted(cycles)]
