@@ -435,12 +435,178 @@ def bench_prefix() -> None:
                     budget_name=budget_name)
 
 
+def bench_peerfetch() -> None:
+    """Fleet peer-fetch microbench (BENCH_PEERFETCH=1; ISSUE 8): a
+    repeated-prefix request lands on a COLD replica while a warm peer
+    holds the matched chain. Per swept config — prefix depth (pages) x
+    wire quant — the probe's TTFT is measured under each of the cost
+    model's three options (docs/CACHING.md "Fleet-wide prefix
+    sharing"):
+
+    - mode "recompute": the cold replica prefills the whole prompt (the
+      floor the fetch must beat);
+    - mode "fetch": the cold replica peer-fetches the chain from the
+      warm peer (export -> protowire channel -> import_prefix) and
+      prefills only the tail; TTFT INCLUDES the whole fetch;
+    - mode "route_warm": the warm replica serves it in place (HBM
+      prefix hit — the ceiling fetch cannot beat).
+
+    Engine-level on purpose (the real export/channel/import code paths,
+    no HTTP jitter), single-threaded XLA + GC held off and the tiny-4l
+    model, exactly like BENCH_PREFIX — at TINY scale dispatch noise
+    drowns the prefill-recompute savings being measured. Knobs:
+    BENCH_PEERFETCH_REPS (5), BENCH_PEERFETCH_DEPTHS ("8,16,24")."""
+    import gc
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_multi_thread_eigen=false"
+        + " intra_op_parallelism_threads=1"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+        chain_hashes,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.disagg import make_channel
+
+    reps = int(os.environ.get("BENCH_PEERFETCH_REPS", "5"))
+    depths = [int(x) for x in os.environ.get(
+        "BENCH_PEERFETCH_DEPTHS", "8,16,24").split(",") if x.strip()]
+    mcfg = TINY.with_overrides(
+        name="tiny-4l", hidden_size=128, intermediate_size=512,
+        num_layers=4, num_heads=8, num_kv_heads=4, head_dim=16,
+    )
+    ps = 8
+    tail = ps
+    max_depth = max(depths)
+    paged = PagedCacheConfig(
+        num_pages=2 * max_depth + 16,
+        page_size=ps,
+        max_pages_per_seq=max_depth + 4,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), mcfg,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(11)
+    hi = min(mcfg.vocab_size, 250)
+    chan = make_channel("protowire")
+
+    def mk():
+        return LLMEngine(
+            params, mcfg, ByteTokenizer(),
+            EngineConfig(
+                max_batch=2,
+                prefill_buckets=(16, 64, 128, 256),
+                paged=paged, native_allocator=False,
+                # the whole swept chain must be visible to the fetch
+                digest_depth=max_depth,
+            ),
+            dtype=jnp.float32,
+        )
+
+    seq = [0]
+
+    def run(engine, ids, max_tokens=2):
+        seq[0] += 1
+        rid = f"pf{seq[0]}"
+        t0 = time.perf_counter()
+        engine.add_request(rid, ids, SamplingParams(
+            max_tokens=max_tokens, temperature=0.0))
+        ttft = None
+        while engine.has_work():
+            for out in engine.step():
+                if ttft is None and out.token_id is not None:
+                    ttft = time.perf_counter() - t0
+        assert ttft is not None
+        return ttft
+
+    def compile_warm(engine):
+        for n in (max_depth * ps + tail, 2 * ps, tail + ps):
+            run(engine, rng.integers(1, hi, size=n).tolist())
+        engine.evict_cache(0.0)
+
+    for depth in depths:
+        prefix_ids = rng.integers(1, hi, size=depth * ps).tolist()
+        warm, cold = mk(), mk()
+        compile_warm(warm)
+        compile_warm(cold)
+        run(warm, prefix_ids + rng.integers(1, hi, size=tail).tolist())
+        for wq in ("none", "int8"):
+            recs = {"recompute": [], "fetch": [], "route_warm": []}
+            fetch_ms, fetch_bytes = [], 0
+            for r in range(reps + 1):
+                probe = prefix_ids + rng.integers(1, hi,
+                                                  size=tail).tolist()
+                hashes = chain_hashes(probe, ps,
+                                      max_pages=(len(probe) - 1) // ps)
+                gc.collect()
+                gc.disable()
+                try:
+                    # recompute floor: the cold replica starts empty
+                    cold.evict_cache(0.0)
+                    t_rec = run(cold, probe)
+                    # fetch: export -> wire -> import -> prefill tail;
+                    # TTFT includes the whole fetch
+                    cold.evict_cache(0.0)
+                    t0 = time.perf_counter()
+                    served, chunks = warm.export_prefix_chunks(
+                        hashes, chunk_pages=8, wire_quant=wq)
+                    wired = chan.transfer_chunks(f"b{seq[0]}", wq, chunks)
+                    cold.import_prefix(probe[: served * ps], wired)
+                    t_fetch_done = time.perf_counter() - t0
+                    t_fet = t_fetch_done + run(cold, probe)
+                    # warm ceiling: the peer serves it in place
+                    t_warm = run(warm, probe)
+                finally:
+                    gc.enable()
+                if r:  # rep 0 warms compile caches
+                    recs["recompute"].append(t_rec)
+                    recs["fetch"].append(t_fet)
+                    recs["route_warm"].append(t_warm)
+                    fetch_ms.append(t_fetch_done * 1e3)
+                    fetch_bytes = sum(len(c.payload) for c in wired)
+                assert served == (len(probe) - 1) // ps, served
+            for mode in ("recompute", "fetch", "route_warm"):
+                _emit({
+                    "metric": "peerfetch_ttft_ms_cpu",
+                    "value": round(
+                        float(np.median(recs[mode])) * 1e3, 3),
+                    "unit": "ms",
+                    "vs_baseline": 0.0,
+                    "mode": mode,
+                    "prefix_pages": depth,
+                    "prompt_len": depth * ps + tail,
+                    "wire_quant": wq,
+                    **({"fetch_ms": round(float(np.median(fetch_ms)), 3),
+                        "fetch_bytes": fetch_bytes}
+                       if mode == "fetch" else {}),
+                    "reps": reps,
+                })
+
+
 def main() -> None:
     if os.environ.get("BENCH_HANDOFF") == "1":
         bench_handoff()
         return
     if os.environ.get("BENCH_PREFIX") == "1":
         bench_prefix()
+        return
+    if os.environ.get("BENCH_PEERFETCH") == "1":
+        bench_peerfetch()
         return
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
     cpu_full = os.environ.get("BENCH_CPU_FULL") == "1"

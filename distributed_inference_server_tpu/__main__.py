@@ -109,6 +109,8 @@ def main(argv=None) -> int:
         # tiered prefix cache (docs/CACHING.md): host-RAM demotion pool
         host_tier_bytes=cfg.get("cache", "host_tier_bytes"),
         host_tier_quant=cfg.get("cache", "host_tier_quant"),
+        # fleet prefix sharing: routing-digest chain depth
+        digest_depth=cfg.get("cache", "digest_depth"),
     )
     tokenizer = load_tokenizer(model_dir)
 
@@ -255,6 +257,9 @@ def main(argv=None) -> int:
             # disaggregated prefill/decode serving (docs/DISAGG.md)
             engine_roles=cfg.engine_roles(),
             disagg_settings=cfg.disagg_settings(),
+            # fleet prefix sharing (docs/CACHING.md): cache_aware
+            # route/fetch/recompute cost-model weights
+            fetch_costs=cfg.fetch_costs(),
         )
         server.start()
     except (ModelLoadError, RuntimeError, TimeoutError) as e:

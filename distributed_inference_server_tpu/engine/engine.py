@@ -56,7 +56,9 @@ from distributed_inference_server_tpu.core.types import RequestId
 from distributed_inference_server_tpu.engine.kv_cache import (
     _KIND_QPOOL,
     _KIND_WIRE8,
+    _encode_group,
     _scatter_payload,
+    chunk_crc,
     DIGEST_DEPTH,
     HostTier,
     KvChunk,
@@ -217,6 +219,13 @@ class EngineConfig:
     # pools, lossy like the disagg wire quant); quantized pools always
     # store their native codes exactly.
     host_tier_quant: str = "none"
+    # chain depth covered by the published routing digest (config
+    # cache.digest_depth): first-K page hashes per cached chain. Deeper
+    # digests let the fleet cost model (serving/scheduler.py plan_route)
+    # see — and peer-fetch — deep matches that a shallow digest would
+    # flatten to "identical past page K"; the price is a bigger
+    # EngineStatus snapshot per replica.
+    digest_depth: int = DIGEST_DEPTH
 
 
 @dataclass
@@ -919,12 +928,15 @@ class LLMEngine:
         else:
             self.allocator.evict_below(target_frac)
 
-    def prefix_digest(self, max_depth: int = DIGEST_DEPTH) -> frozenset:
+    def prefix_digest(self, max_depth: Optional[int] = None) -> frozenset:
         """Compact rolling digest of this engine's cached prefix chains
         (first-``max_depth`` page hashes per chain, HBM + host tier) for
-        cache-aware routing. Engine-thread only; the runner snapshots it
-        into EngineStatus. Empty under the native allocator (no digest
-        surface) — the router then falls back to least-loaded."""
+        cache-aware routing; ``None`` = the configured
+        ``ecfg.digest_depth``. Engine-thread only; the runner snapshots
+        it into EngineStatus. Empty under the native allocator (no
+        digest surface) — the router then falls back to least-loaded."""
+        if max_depth is None:
+            max_depth = self.ecfg.digest_depth
         dig = getattr(self.allocator, "prefix_digest", None)
         out = dig(max_depth) if dig is not None else frozenset()
         if self.host_tier is not None:
@@ -1334,6 +1346,121 @@ class LLMEngine:
         """Drop a phased import (source cancelled / client disconnect):
         every reserved page is released; nothing was published."""
         session.abort()
+
+    # -- fleet peer-fetch of a cached prefix (serving/disagg.py) ---------
+
+    def export_prefix_chunks(
+        self, hashes: Sequence[int], chunk_pages: int = 8,
+        wire_quant: str = "none",
+    ) -> Tuple[int, List[KvChunk]]:
+        """Fleet peer-fetch export (PrefixFetcher, docs/CACHING.md): walk
+        ``hashes`` — a request's content-hash chain — consecutively from
+        the head through this engine's prefix tiers (HBM first, host-tier
+        fallthrough) and serialize every matched page as self-describing
+        KvChunks — the same framing the streamed handoff puts on the
+        wire. Returns ``(depth, chunks)``: depth is the consecutive
+        pages served; depth < len(hashes) means the chain was (partly)
+        evicted since the routing digest was snapshotted — the caller
+        imports what it got or falls back to recompute. HBM pages pull
+        through the double-buffered ``serialize_kv_chunks`` path
+        (``wire_quant`` applies); host-tier pages ship in their stored
+        encoding (already int8 when the tier quantizes — re-encoding
+        would cost a decode for zero wire savings). Full pages are
+        immutable, so live (refcount>0) pages export safely.
+        Engine-thread only; mutates nothing beyond host-tier access
+        clocks — a peer-fetched chain is re-used traffic and earns its
+        chain protection."""
+        ps = self.pcfg.page_size
+        lookup = getattr(self.allocator, "cached_page", None)
+        # ("hbm", page_id) | ("host", _HostPage), consecutive from head
+        entries: List[Tuple[str, object]] = []
+        for h in hashes:
+            pid = lookup(h) if lookup is not None else None
+            if pid is not None:
+                entries.append(("hbm", pid))
+                continue
+            hp = (self.host_tier.get(h)
+                  if self.host_tier is not None else None)
+            if hp is None:
+                break
+            entries.append(("host", hp))
+        chunks: List[KvChunk] = []
+        chunk_pages = max(1, chunk_pages)
+        i = 0
+        while i < len(entries):
+            src = entries[i][0]
+            j = i + 1
+            if src == "hbm":
+                while j < len(entries) and entries[j][0] == "hbm":
+                    j += 1
+                chunks.extend(serialize_kv_chunks(
+                    self.state, [p for _, p in entries[i:j]], ps,
+                    chunk_pages=chunk_pages, wire_quant=wire_quant,
+                    first_chunk_index=len(chunks), first_page_index=i,
+                ))
+            else:
+                kind = entries[i][1].kind
+                while (j < len(entries) and entries[j][0] == "host"
+                       and entries[j][1].kind == kind
+                       and j - i < chunk_pages):
+                    j += 1
+                group = [e for _, e in entries[i:j]]
+                merged = tuple(
+                    np.concatenate([g.parts[m] for g in group], axis=1)
+                    for m in range(len(group[0].parts))
+                )
+                # the ONE payload encoder the handoff wire uses — the
+                # peer-fetch wire must never diverge from it
+                payload = _encode_group(self.state, kind, merged, 0)
+                chunks.append(KvChunk(
+                    index=len(chunks), total=0, page_start=i,
+                    page_count=len(group), payload=payload,
+                    crc32=chunk_crc(payload),
+                ))
+            i = j
+        return len(entries), chunks
+
+    def import_prefix(self, tokens: Sequence[int],
+                      chunks: Sequence[KvChunk]) -> int:
+        """Fleet peer-fetch import: seat a peer's exported prefix pages
+        into this engine's prefix cache so the pending request's own
+        prefill matches them instead of recomputing. Goes through the
+        same ``KvImportSession`` validate-and-scatter path as the
+        streamed handoff (pages reserved up front, every chunk
+        crc/range/shape-checked, publish only on a complete tiling), so
+        a torn fetch leaves the engine semantically unchanged — then the
+        pages are RELEASED: refcount-0 content-addressed pages are
+        exactly the CACHED state ``match_prefix`` shares from, and LRU
+        reclaims them if nothing arrives. ``tokens`` must be the whole-
+        page prefix the chunks cover (the fetcher slices the request's
+        prompt by the served depth). Returns pages seated. Raises
+        CacheFull / CacheDeserializationError with nothing leaked."""
+        ps = self.pcfg.page_size
+        n = len(tokens)
+        if n <= 0 or n % ps != 0:
+            raise CacheDeserializationError(
+                f"prefix import must cover whole pages "
+                f"(got {n} tokens, page_size {ps})"
+            )
+        if self.draft_params is not None:
+            raise CacheDeserializationError(
+                "peer-fetched prefix carries no draft pool; seating it "
+                "on a speculative engine would publish pages whose "
+                "draft KV is garbage"
+            )
+        session = KvImportSession(self.state, self.allocator, ps)
+        try:
+            session.reserve(n // ps)
+            for chunk in chunks:
+                session.add_chunk(chunk)
+            self.state, pages = session.finish(self.state, list(tokens))
+        except Exception as e:
+            session.abort()
+            if isinstance(e, (CacheDeserializationError, CacheFull)):
+                raise
+            raise CacheDeserializationError(str(e)) from None
+        self.allocator.release(pages)
+        return len(pages)
 
     def warmup(self) -> None:
         """Compile every serving program before traffic arrives: one

@@ -462,6 +462,15 @@ class PageAllocator:
             h for h, e in self._by_hash.items() if e.depth < max_depth
         )
 
+    def cached_page(self, h: int) -> Optional[int]:
+        """Page id content-addressed by ``h``, or None. Live (refcount>0)
+        pages qualify too: full pages are immutable, so a peer-fetch
+        export (engine.export_prefix_chunks) may serialize them while a
+        resident sequence still holds them. Counters untouched — this is
+        the fleet's read, not a local prefix match. Engine-thread only."""
+        entry = self._by_hash.get(h)
+        return entry.page_id if entry is not None else None
+
     # -- consistency audit (chaos invariant checks, docs/RESILIENCE.md) ----
 
     def audit(self, live_pages: Optional[Sequence[int]] = None) -> List[str]:

@@ -134,6 +134,24 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # (per-vector absmax codes + f32 scales — 4x smaller for f32
         # pools, bounded accuracy cost like disagg.wire_quant)
         "host_tier_quant": (str, "none"),
+        # chain depth of the published routing digest (first-K page
+        # hashes per cached chain): the cache_aware cost model can only
+        # score — and peer-fetch — matches it can see, so deep shared
+        # prefixes want a deeper digest (docs/CACHING.md); the price is
+        # a bigger per-replica EngineStatus snapshot
+        "digest_depth": (int, 8),
+        # fleet-wide prefix sharing (docs/CACHING.md): let the
+        # cache_aware router FETCH a matched prefix from a warm peer
+        # onto a cold replica instead of queueing behind the warm one.
+        # false = the pre-fetch two-way routing (warm | recompute).
+        "peer_fetch": (bool, True),
+        # cost-model weights (scheduler.FetchCosts), in pages of prefill
+        # recompute: minimum fetchable gain worth a wire transfer,
+        # wire cost per fetched page (< 1 or fetching never pays), and
+        # the queueing penalty per active/waiting request on a replica
+        "fetch_min_pages": (int, 2),
+        "fetch_page_cost": (float, 0.25),
+        "fetch_load_cost": (float, 4.0),
     },
     "disagg": {
         # migration budget per handoff: past the deadline (or after the
@@ -379,6 +397,21 @@ class ServerConfig:
             wire_quant=d["wire_quant"],
         )
 
+    def fetch_costs(self):
+        """cache_aware three-way cost-model weights (fleet prefix
+        sharing, serving/scheduler.py plan_route)."""
+        from distributed_inference_server_tpu.serving.scheduler import (
+            FetchCosts,
+        )
+
+        c = self.raw["cache"]
+        return FetchCosts(
+            enabled=c["peer_fetch"],
+            min_pages=c["fetch_min_pages"],
+            page_cost=c["fetch_page_cost"],
+            load_cost_pages=c["fetch_load_cost"],
+        )
+
     # -- validation --------------------------------------------------------
 
     def validate(self) -> None:
@@ -488,6 +521,14 @@ class ServerConfig:
                 f"cache.host_tier_quant must be none/int8, "
                 f"got {r['cache']['host_tier_quant']!r}"
             )
+        if r["cache"]["digest_depth"] <= 0:
+            raise ConfigError("cache.digest_depth must be positive")
+        if r["cache"]["fetch_min_pages"] < 1:
+            raise ConfigError("cache.fetch_min_pages must be >= 1")
+        if r["cache"]["fetch_page_cost"] < 0:
+            raise ConfigError("cache.fetch_page_cost must be >= 0")
+        if r["cache"]["fetch_load_cost"] < 0:
+            raise ConfigError("cache.fetch_load_cost must be >= 0")
 
     def hot_diff(self, other: "ServerConfig") -> Dict[tuple, Any]:
         """(section, key) -> new value for hot-reloadable keys that differ."""

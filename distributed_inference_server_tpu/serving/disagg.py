@@ -39,11 +39,12 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import queue
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Deque, Dict, List, Optional, Sequence
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 
 from distributed_inference_server_tpu.core.errors import ConfigError
 from distributed_inference_server_tpu.engine.engine import (
@@ -157,6 +158,14 @@ class KVTransferChannel:
         """The commit payload carries ONLY the tail chunks — the target
         session already holds the prefix."""
         return dataclasses.replace(exp, kv_chunks=list(tail))
+
+    def transfer_fetch_request(self, request_id, hashes: Sequence[int],
+                               chunk_pages: int, wire_quant: str) -> tuple:
+        """Move the fetch_prefix REQUEST half toward the peer (fleet
+        prefix sharing, PrefixFetcher): returns ``(request_id, hashes,
+        chunk_pages, wire_quant)`` as the peer will see them. The
+        response travels back as KvChunks via ``transfer_chunks``."""
+        return request_id, list(hashes), chunk_pages, wire_quant
 
 
 class InProcessChannel(KVTransferChannel):
@@ -333,6 +342,19 @@ class ProtowireChannel(KVTransferChannel):
         return stream_from_frames(stream_to_frames(
             dataclasses.replace(exp, kv_chunks=list(tail))
         ))
+
+    def transfer_fetch_request(self, request_id, hashes: Sequence[int],
+                               chunk_pages: int, wire_quant: str) -> tuple:
+        d = protowire.decode("KvPrefixFetch", protowire.encode(
+            "KvPrefixFetch", {
+                "request_id": str(request_id),
+                "hashes": [int(h) for h in hashes],
+                "chunk_pages": chunk_pages,
+                "wire_quant": wire_quant,
+            },
+        ))
+        return (d["request_id"], d["hashes"], d["chunk_pages"],
+                d["wire_quant"] or "none")
 
 
 def make_channel(name: str) -> KVTransferChannel:
@@ -902,3 +924,198 @@ class DisaggController:
         for r in roles:
             out[r] = out.get(r, 0) + 1
         return out
+
+
+# ---------------------------------------------------------------------------
+# Fleet-wide prefix sharing: the fetch_prefix RPC driver
+# ---------------------------------------------------------------------------
+
+
+class PrefixFetcher:
+    """Drives one peer-to-peer prefix fetch per routed-``fetch`` request
+    (docs/CACHING.md "Fleet-wide prefix sharing"): the scheduler's cost
+    model (scheduler.plan_route) picked a cold replica and a warm peer;
+    this moves the matched KV pages before the request is submitted —
+
+    1. the request half crosses the channel (``KvPrefixFetch`` framing,
+       differentially wire-tested per fetch under protowire);
+    2. the peer's engine thread serializes the chain — HBM and host
+       tier, consecutive from the head — as crc-guarded KvChunks
+       (``EngineRunner.submit_prefix_export``);
+    3. the chunks cross the channel (``KvHandoffHeader``/``KvChunk``
+       framing; ``kv.peer_fetch`` fires per chunk, docs/RESILIENCE.md);
+    4. the target's engine thread validate-and-scatters them into its
+       prefix cache (``submit_prefix_import`` → engine.import_prefix);
+    5. the request is submitted to the target — ALWAYS, on every
+       outcome. The fetch is an accelerator, never a gate: a dead peer,
+       a stale registry (chain evicted between score and fetch), a torn
+       stream, or an import rejection all degrade the request to plain
+       recompute on its chosen replica, exactly once.
+
+    Thread-safe: fetches start on the dispatcher thread and settle on
+    runner threads; the in-flight map is the drain/abort surface
+    (``pending_count`` counts toward dispatcher shutdown, ``abort``
+    drops a disconnected client's request instead of submitting it into
+    a closed sink)."""
+
+    def __init__(self, channel: Optional[KVTransferChannel] = None,
+                 settings: Optional[DisaggSettings] = None,
+                 metrics: Optional[MetricsCollector] = None):
+        self.channel = channel or InProcessChannel()
+        self.settings = settings or DisaggSettings()
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # request_id -> aborted? for fetches in flight (score→submit)
+        self._fetching: Dict[Any, bool] = {}
+        # ONE bounded wire worker (lazily started): the protowire round
+        # trip per fetch is GIL-bound byte work — a thread per routed-
+        # fetch request would turn a burst of fetch decisions into a
+        # burst of OS threads degrading the decode latency the fetch
+        # exists to protect; serializing them through one worker bounds
+        # that (jobs are ms-scale; a queued fetch just settles later)
+        self._wire_q: "queue.Queue" = queue.Queue()
+        self._wire_thread: Optional[threading.Thread] = None
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._fetching)
+
+    def abort(self, request_id) -> bool:
+        """Client disconnect while the prefix fetch is in flight: flag
+        it — the settle path then drops the request instead of
+        submitting it into a closed sink (same semantics as a queue
+        cancel: an abandoned request gets no terminal event). Returns
+        True when the flag landed on an in-flight fetch."""
+        with self._lock:
+            if request_id in self._fetching:
+                self._fetching[request_id] = True
+                return True
+        return False
+
+    def fetch_then_submit(self, target, peer, req, plan) -> None:
+        """Run the fetch for ``req`` per ``plan`` (a PrefixRoutePlan
+        with decision "fetch"), then submit the request to ``target``.
+        Called on the dispatcher thread; returns immediately — the
+        pipeline advances on the peer's and target's runner threads."""
+        rid = req.request_id
+        ps = max(1, plan.page_size)
+        t0 = time.monotonic()
+        with self._lock:
+            self._fetching[rid] = False
+
+        def _settle(outcome: str, nbytes: int = 0) -> None:
+            # runs on whichever thread resolved the pipeline; exactly
+            # once by construction (each stage's callback fires once and
+            # every failure arm returns after calling _settle). The
+            # in-flight entry is popped only AFTER the submit hand-off:
+            # pop-first would open a drain window where pending_count()
+            # reads 0 while the request is registered nowhere yet — a
+            # graceful shutdown could declare the fleet drained and stop
+            # the runners under a request it should have completed.
+            with self._lock:
+                aborted = self._fetching.get(rid, False)
+            if self.metrics:
+                self.metrics.record_prefix_fetch(
+                    outcome, seconds=time.monotonic() - t0, nbytes=nbytes
+                )
+            try:
+                if not aborted:
+                    target.submit([req])
+            finally:
+                with self._lock:
+                    late_abort = self._fetching.pop(rid, False)
+                if late_abort and not aborted:
+                    # client disconnected between the flag read and the
+                    # submit: the dispatcher saw the fetch in flight and
+                    # skipped its runner sweep, so forward the abort
+                    target.abort(rid)
+
+        def _on_import(ok: bool, err: Optional[str],
+                       nbytes: int = 0) -> None:
+            if not ok:
+                logger.debug("prefix fetch for %s: import rejected by "
+                             "%s (%s); recomputing", rid,
+                             target.engine_id, err)
+            _settle("ok" if ok else "fallback", nbytes)
+
+        def _wire(depth: int, chunks) -> None:
+            # dedicated short-lived wire thread: the protowire round
+            # trip (encode + decode + per-chunk crc over the whole
+            # chain) must stall NEITHER engine thread — least of all
+            # the warm peer's, which the cost model picked as the fetch
+            # source precisely because it is busy decoding
+            try:
+                # peer death mid-fetch on the wire (docs/RESILIENCE.md):
+                # one hit per chunk, so nth=N drops the Nth chunk
+                for _ in chunks:
+                    faults.fire("kv.peer_fetch")
+                wired = self.channel.transfer_chunks(
+                    rid, self.settings.wire_quant, chunks
+                )
+            except Exception as e:  # noqa: BLE001 — channel fault domain
+                logger.debug("prefix fetch for %s: channel %s failed "
+                             "(%s); recomputing", rid, self.channel.name, e)
+                _settle("fallback")
+                return
+            nbytes = sum(len(c.payload) for c in wired)
+            tokens = list(req.prompt_ids[: depth * ps])
+            target.submit_prefix_import(
+                rid, tokens, wired,
+                lambda ok, ierr: _on_import(ok, ierr, nbytes),
+            )
+
+        def _on_export(result, err: Optional[str]) -> None:
+            # peer runner's thread (or the caller's, peer already down):
+            # only hand the serialized chunks off — no wire work here
+            if result is None:
+                logger.debug("prefix fetch for %s: peer %s export failed "
+                             "(%s); recomputing", rid, peer.engine_id, err)
+                _settle("fallback")
+                return
+            depth, chunks = result
+            if depth <= plan.depth or not chunks:
+                # registry staleness: the peer evicted the chain (or
+                # holds no more of it than the target already does)
+                # between the routing score and the fetch
+                _settle("fallback")
+                return
+            self._submit_wire(lambda: _wire(depth, chunks))
+
+        try:
+            # the request half crosses the channel too, so the
+            # KvPrefixFetch wire format is exercised on every fetch
+            rid_w, hashes_w, chunk_pages, wire_quant = (
+                self.channel.transfer_fetch_request(
+                    rid, plan.prefix_hashes or (),
+                    self.settings.chunk_pages, self.settings.wire_quant,
+                )
+            )
+        except Exception as e:  # noqa: BLE001 — channel fault domain
+            logger.debug("prefix fetch for %s: request framing failed "
+                         "(%s); recomputing", rid, e)
+            _settle("fallback")
+            return
+        peer.submit_prefix_export(rid_w, hashes_w, chunk_pages,
+                                  wire_quant, _on_export)
+
+    def _submit_wire(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            if self._wire_thread is None:
+                self._wire_thread = threading.Thread(
+                    target=self._wire_worker, name="peerfetch-wire",
+                    daemon=True,
+                )
+                self._wire_thread.start()
+        self._wire_q.put(fn)
+
+    def _wire_worker(self) -> None:
+        while True:
+            fn = self._wire_q.get()
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — job isolation (the
+                # job's own failure arms settle the request; this only
+                # guards the worker loop itself from dying silently)
+                logger.exception("peer-fetch wire job failed: %s", e)
+                if self.metrics:
+                    self.metrics.record_error("disagg.peer_fetch_wire")

@@ -92,6 +92,7 @@ class Dispatcher:
         tracer=None,
         disagg=None,
         max_redispatch: int = 2,
+        prefix_fetcher=None,
     ):
         """``disagg``: the DisaggController when the topology is
         disaggregated (serving/disagg.py) — its migration queue counts
@@ -99,9 +100,15 @@ class Dispatcher:
         ``max_redispatch``: crash-safe redispatch budget per request
         (docs/RESILIENCE.md) — how many times a zero-token in-flight
         request may be moved off a dead engine before it fails to its
-        client; 0 disables redispatch."""
+        client; 0 disables redispatch.
+        ``prefix_fetcher``: the disagg.PrefixFetcher driving routed-
+        ``fetch`` decisions under cache_aware (fleet prefix sharing,
+        docs/CACHING.md); its in-flight fetches count toward drain and
+        aborts reach requests parked there. None = fetch decisions
+        degrade to plain submission."""
         self.scheduler = scheduler
         self.disagg = disagg
+        self.prefix_fetcher = prefix_fetcher
         self.tracer = tracer
         self.max_redispatch = max_redispatch
         self.queue: PriorityQueueManager[ServerRequest] = _make_queue(
@@ -146,6 +153,8 @@ class Dispatcher:
                 )
                 and (self.disagg is None
                      or self.disagg.pending_count() == 0)
+                and (self.prefix_fetcher is None
+                     or self.prefix_fetcher.pending_count() == 0)
             ):
                 break
             # interruptible drain poll: a concurrent stop request (another
@@ -237,6 +246,9 @@ class Dispatcher:
             return
         if self.batcher.cancel(request_id) is not None:
             return
+        if (self.prefix_fetcher is not None
+                and self.prefix_fetcher.abort(request_id)):
+            return
         if self.disagg is not None and self.disagg.abort(request_id):
             return
         for runner in self.scheduler.engines():
@@ -271,14 +283,55 @@ class Dispatcher:
         # cache-aware routing (ISSUE 5) is per REQUEST, not per batch —
         # two requests in one admission window may have their prefixes
         # warm on different engines; route the window against one fleet
-        # snapshot (schedule_batch), group by chosen engine, and submit
-        # each group. Every other strategy keeps the one-engine-per-batch
-        # fast path.
-        if self.scheduler.strategy() is SchedulingStrategy.CACHE_AWARE:
-            runners = self.scheduler.schedule_batch(
+        # snapshot with the three-way cost model (schedule_batch_plans:
+        # route-to-warm / fetch-to-cold / recompute, docs/CACHING.md),
+        # peel routed-``fetch`` requests off to the PrefixFetcher (the
+        # warm peer's pages land on the cold replica before the request
+        # does), group the rest by chosen engine, and submit each group.
+        # With no fetcher wired the pre-fetch two-way routing applies —
+        # planning with fetch options and then not fetching would both
+        # mislabel kv_prefix_route_total and route to a cold replica the
+        # model only chose because a fetch would make it cheap. Every
+        # other strategy keeps the one-engine-per-batch fast path.
+        strategy = self.scheduler.strategy()
+        if (strategy is SchedulingStrategy.CACHE_AWARE
+                and self.prefix_fetcher is not None):
+            plans = self.scheduler.schedule_batch_plans(
                 [r.prompt_ids for r in requests]
             )
             by_engine: dict = {}
+            for r, (runner, plan) in zip(requests, plans):
+                decision = plan.decision if plan is not None else "recompute"
+                if decision == "fetch" and runner is not None:
+                    peer = self.scheduler.get(plan.peer_id)
+                    if peer is not None:
+                        if self.metrics:
+                            self.metrics.record_prefix_route("fetch")
+                        if self.tracer and r.span is not None:
+                            # the dispatch breadcrumb for the fetch path
+                            # (fetch requests never reach _submit_group)
+                            r.span.set(prefix_fetch_from=peer.engine_id,
+                                       prefix_fetch_to=runner.engine_id)
+                            r.span.event("prefix_fetch")
+                        self.prefix_fetcher.fetch_then_submit(
+                            runner, peer, r, plan
+                        )
+                        continue
+                    # peer unregistered since the snapshot: the chosen
+                    # replica still serves, just without the fetch
+                    decision = "warm" if plan.depth else "recompute"
+                if self.metrics and runner is not None:
+                    self.metrics.record_prefix_route(decision)
+                key = runner.engine_id if runner is not None else None
+                if key not in by_engine:
+                    by_engine[key] = (runner, [])
+                by_engine[key][1].append(r)
+            pairs = list(by_engine.values())
+        elif strategy is SchedulingStrategy.CACHE_AWARE:
+            runners = self.scheduler.schedule_batch(
+                [r.prompt_ids for r in requests]
+            )
+            by_engine = {}
             for r, runner in zip(requests, runners):
                 key = runner.engine_id if runner is not None else None
                 if key not in by_engine:

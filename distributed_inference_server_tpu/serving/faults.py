@@ -46,8 +46,14 @@ Point catalog (the authoritative list lives in docs/RESILIENCE.md):
 ``kv.host_copy``        host-tier demotion copy fails (page drops, never
                         corrupts)
 ``kv.import_chunk``     import-side chunk validation failure
+``kv.peer_fetch``       peer-to-peer prefix fetch dies on the wire (one
+                        hit per chunk — ``nth`` drops the Nth chunk);
+                        the request falls back to recompute
 ``sched.health_flap``   flag: the health loop sees a healthy engine as
                         down for one sweep (restart of a live replica)
+``sched.fetch_decision``  flag: force the cache_aware cost model to pick
+                        FETCH when a fetch option exists (drives the
+                        peer-fetch path deterministically under chaos)
 ======================  ====================================================
 """
 

@@ -62,6 +62,12 @@ class EngineStatus:
     # serialized — in-process routing state only.
     prefix_digest: Any = None
     page_size: int = 0
+    # chain depth the digest covers (cache.digest_depth): the scheduler
+    # hashes prompts to the fleet's published depth, so a deeper digest
+    # widens the window the three-way cost model can score (and peer-
+    # fetch) instead of flattening matches past page 8. In-process only,
+    # like the digest itself.
+    digest_depth: int = 0
     # host-tier prefix cache occupancy (engine.host_tier_stats()); None
     # when the tier is off
     host_tier: Any = None
@@ -205,6 +211,37 @@ class MetricsCollector:
             buckets=(0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1),
         )
+        # fleet-wide prefix sharing (docs/CACHING.md): peer-to-peer
+        # prefix fetch traffic and the cache_aware three-way route
+        # decisions that drive it
+        self.prefix_fetches = Counter(
+            "kv_prefix_fetch_total",
+            "Peer-to-peer prefix fetches by outcome (ok = fetched pages "
+            "seated on the cold replica, fallback = peer death / stale "
+            "registry / torn stream degraded the request to recompute)",
+            ["outcome"], registry=r,
+        )
+        self.prefix_fetch_bytes = Counter(
+            "kv_prefix_fetch_bytes_total",
+            "Serialized KV bytes moved by peer prefix fetches "
+            "(post wire-quantization)",
+            registry=r,
+        )
+        self.prefix_fetch_latency = Histogram(
+            "kv_prefix_fetch_seconds",
+            "Peer prefix fetch latency (route decision to request "
+            "submission on the target replica)",
+            registry=r,
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1, 2),
+        )
+        self.prefix_routes = Counter(
+            "kv_prefix_route_total",
+            "cache_aware route decisions (warm = routed to a matched "
+            "replica, fetch = peer-fetch onto a cold replica, recompute "
+            "= no usable match)",
+            ["decision"], registry=r,
+        )
         self.host_tier_bytes_g = Gauge(
             "kv_host_tier_bytes",
             "Bytes resident in the host-RAM prefix-cache tier",
@@ -327,6 +364,11 @@ class MetricsCollector:
         self._prefix_hits_host = 0
         self._reload_sum = 0.0
         self._reload_count = 0
+        self._prefix_fetches: Dict[str, int] = {}
+        self._prefix_fetch_bytes = 0
+        self._fetch_sum = 0.0
+        self._fetch_count = 0
+        self._prefix_routes: Dict[str, int] = {}
         self._handoffs: Dict[str, int] = {}
         self._handoff_bytes = 0
         self._handoff_chunks = 0
@@ -403,6 +445,35 @@ class MetricsCollector:
         with self._lock:
             self._reload_sum += seconds
             self._reload_count += 1
+
+    def record_prefix_fetch(self, outcome: str,
+                            seconds: Optional[float] = None,
+                            nbytes: int = 0) -> None:
+        """One peer-to-peer prefix fetch (disagg.PrefixFetcher):
+        ``outcome`` is "ok" (pages seated on the cold replica) or
+        "fallback" (any failure — the request recomputed instead)."""
+        self.prefix_fetches.labels(outcome=outcome).inc()
+        if seconds is not None:
+            self.prefix_fetch_latency.observe(seconds)
+        if nbytes:
+            self.prefix_fetch_bytes.inc(nbytes)
+        with self._lock:
+            self._prefix_fetches[outcome] = (
+                self._prefix_fetches.get(outcome, 0) + 1
+            )
+            self._prefix_fetch_bytes += nbytes
+            if seconds is not None:
+                self._fetch_sum += seconds
+                self._fetch_count += 1
+
+    def record_prefix_route(self, decision: str) -> None:
+        """One cache_aware route decision (dispatcher):
+        warm | fetch | recompute."""
+        self.prefix_routes.labels(decision=decision).inc()
+        with self._lock:
+            self._prefix_routes[decision] = (
+                self._prefix_routes.get(decision, 0) + 1
+            )
 
     def set_host_tier(self, engine_id: str, nbytes: int, pages: int) -> None:
         """Host-tier occupancy gauges for one engine replica."""
@@ -549,6 +620,17 @@ class MetricsCollector:
                 ),
                 "host_tier_bytes": host_bytes,
                 "host_tier_pages": host_pages,
+                # fleet prefix sharing (docs/CACHING.md): peer-fetch
+                # traffic and the three-way route-decision mix
+                "peer_fetch": {
+                    **dict(self._prefix_fetches),
+                    "bytes": self._prefix_fetch_bytes,
+                    "avg_ms": round(
+                        self._fetch_sum / max(1, self._fetch_count)
+                        * 1000.0, 3,
+                    ),
+                },
+                "route_decisions": dict(self._prefix_routes),
             }
             resilience = None
             if (self._engine_restarts or self._redispatches

@@ -237,6 +237,17 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         6: ("crc32", "uint32", "one"),
         7: ("payload", "bytes", "one"),
     },
+    # Fleet-wide prefix sharing (serving/disagg.py PrefixFetcher): the
+    # request half of the fetch_prefix RPC — a cold replica asks a warm
+    # peer for a cached prefix chain by content hash; the response
+    # reuses the KvHandoffHeader/KvChunk framing above. Hashes are the
+    # 63-bit chain_hashes key space, so uint64 carries them exactly.
+    "KvPrefixFetch": {
+        1: ("request_id", "string", "one"),
+        2: ("hashes", "uint64", "rep"),
+        3: ("chunk_pages", "uint32", "one"),
+        4: ("wire_quant", "string", "one"),
+    },
     # Disaggregated prefill/decode serving (serving/disagg.py): a live
     # sequence lifted off a prefill engine for cross-process KV transfer.
     # ``kv`` / ``draft_kv`` carry the serialize_kv page payloads opaque;

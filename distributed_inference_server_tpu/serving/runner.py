@@ -146,7 +146,19 @@ class EngineRunner:
         # their commit (request_id -> (KvImportSession, engine)), plus
         # un-run open callbacks for crash-time resolution
         self._import_sessions: Dict[RequestId, tuple] = {}
+        # token -> callback maps written from submitter threads (disagg
+        # worker, dispatcher/fetcher, runner callbacks) and resolved on
+        # the runner thread or at crash time: per-token dict ops are
+        # GIL-atomic and exactly-once is pop-first by construction
+        # (docs/RESILIENCE.md)  # distlint: ignore[DL008]
         self._pending_opens: Dict[str, Callable] = {}
+        # un-run peer-fetch EXPORT callbacks (fleet prefix sharing,
+        # serving/disagg.py PrefixFetcher): a crash before the inbox
+        # drains resolves them from _fail_all — the fetcher then falls
+        # back to recompute on the target instead of waiting forever on
+        # a dead peer (same GIL-atomic pop-first exactly-once protocol)
+        # distlint: ignore[DL008]
+        self._pending_fetches: Dict[str, Callable] = {}
         self._pending_embeds: Dict[int, Callable] = {}
         self._embed_seq = 0
         # incremental embeddings jobs, advanced one device batch per
@@ -401,6 +413,75 @@ class EngineRunner:
         """Drop an opened-but-uncommitted import (source cancelled the
         stream / client disconnect): release the reserved pages."""
         self._post(lambda: self._drop_import_session(request_id))
+
+    # -- fleet prefix sharing (peer fetch, serving/disagg.py) --------------
+
+    def submit_prefix_export(
+        self, request_id: RequestId, hashes: Sequence[int],
+        chunk_pages: int, wire_quant: str,
+        on_done: Callable[[Optional[tuple], Optional[str]], None],
+    ) -> None:
+        """Peer-fetch SOURCE side: serialize this engine's cached prefix
+        chain for ``hashes`` (engine.export_prefix_chunks — HBM and
+        host tier, consecutive from the head) on the engine thread.
+        ``on_done((depth, chunks), None)`` or ``on_done(None, err)``
+        fires exactly once — from the runner thread, or here/at crash
+        time if the engine is (or becomes) unavailable, so a peer dying
+        mid-fetch degrades the caller to recompute instead of wedging
+        the request (docs/RESILIENCE.md)."""
+        token = f"pfx:{request_id}"
+        self._pending_fetches[token] = on_done
+        if not self._healthy:
+            cb = self._pending_fetches.pop(token, None)
+            if cb is not None:
+                cb(None, self._last_error or "engine unavailable")
+            return
+
+        def _do() -> None:
+            cb = self._pending_fetches.pop(token, None)
+            if cb is None:
+                return  # resolved by _fail_all (crash/shutdown)
+            try:
+                depth, chunks = self._engine.export_prefix_chunks(
+                    hashes, chunk_pages=chunk_pages, wire_quant=wire_quant
+                )
+            except Exception as e:  # noqa: BLE001 — export fault domain
+                cb(None, str(e))
+                return
+            cb((depth, chunks), None)
+
+        self._post(_do)
+
+    def submit_prefix_import(
+        self, request_id: RequestId, tokens: Sequence[int], chunks,
+        on_done: Callable[[bool, Optional[str]], None],
+    ) -> None:
+        """Peer-fetch TARGET side: validate-and-scatter the fetched
+        prefix chunks into this engine's prefix cache
+        (engine.import_prefix) so the request submitted right after
+        matches them. Same exactly-once callback contract as
+        submit_import_open (ok=False → the fetcher falls back to plain
+        recompute; the pages were released by the aborted session)."""
+        token = f"pfx-import:{request_id}"
+        self._pending_opens[token] = on_done
+        if not self._healthy:
+            cb = self._pending_opens.pop(token, None)
+            if cb is not None:
+                cb(False, self._last_error or "engine unavailable")
+            return
+
+        def _do() -> None:
+            cb = self._pending_opens.pop(token, None)
+            if cb is None:
+                return  # resolved by _fail_all
+            try:
+                self._engine.import_prefix(tokens, chunks)
+            except Exception as e:  # noqa: BLE001 — import fault domain
+                cb(False, str(e))
+                return
+            cb(True, None)
+
+        self._post(_do)
 
     def _drop_import_session(self, request_id: RequestId) -> None:
         entry = self._import_sessions.pop(request_id, None)
@@ -786,7 +867,7 @@ class EngineRunner:
 
     def status(self) -> EngineStatus:
         eng = self._engine
-        used = total = cached = page_size = 0
+        used = total = cached = page_size = digest_depth = 0
         waiting = 0
         speculation = host_tier = None
         if eng is not None:
@@ -803,6 +884,7 @@ class EngineRunner:
                 cached = s.pages_cached
                 used = total - s.pages_free
                 page_size = eng.pcfg.page_size
+                digest_depth = eng.ecfg.digest_depth
                 waiting = eng.num_waiting()
                 host_tier = eng.host_tier_stats()
                 speculation = eng.spec_stats()
@@ -823,6 +905,7 @@ class EngineRunner:
             speculation=speculation,
             prefix_digest=self._prefix_digest,
             page_size=page_size,
+            digest_depth=digest_depth,
             host_tier=host_tier,
         )
 
@@ -864,7 +947,14 @@ class EngineRunner:
                         self.metrics.record_inference(dt)
                     self._dispatch(outputs)
                     self._report_cache_deltas()
-                    self._refresh_digest()
+                    # force on the busy→idle transition: a request's
+                    # FINAL step is what publishes its prefix chain
+                    # (_release_seq), and with no further steps the
+                    # rate-limited refresh would never snapshot it —
+                    # the fleet registry (cache_aware routing + peer
+                    # fetch) would stay blind to a drained replica's
+                    # freshly warmed cache
+                    self._refresh_digest(force=not self._engine.has_work())
                 worked |= self._drain_handoffs()
                 worked |= self._step_draining()
                 worked |= self._embed_quantum()
@@ -1069,6 +1159,15 @@ class EngineRunner:
                     cb(False, message)
                 except Exception as e:  # noqa: BLE001 — callback isolation
                     self._absorbed("open_callback", e)
+        # peer-fetch exports die with the engine: the fetcher falls back
+        # to recompute on its target (the request never lived here)
+        for token in list(self._pending_fetches):
+            cb = self._pending_fetches.pop(token, None)
+            if cb is not None:
+                try:
+                    cb(None, message)
+                except Exception as e:  # noqa: BLE001 — callback isolation
+                    self._absorbed("fetch_callback", e)
         for rid in list(self._import_sessions):
             self._drop_import_session(rid)
         self._fail_all_of(list(self._inflight.values()), message)

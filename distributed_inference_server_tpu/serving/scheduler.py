@@ -26,6 +26,7 @@ import logging
 import random
 import threading
 import time
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from distributed_inference_server_tpu.serving import faults
@@ -63,6 +64,130 @@ def prefix_match_depth(status: EngineStatus,
             break
         depth += 1
     return depth
+
+
+@dataclass(frozen=True)
+class FetchCosts:
+    """Weights of the cache_aware three-way cost model (``plan_route``),
+    all in PAGE units — one page of prefill recompute is the unit cost.
+    Config section ``cache`` (``peer_fetch`` / ``fetch_min_pages`` /
+    ``fetch_page_cost`` / ``fetch_load_cost``).
+
+    With the defaults, fetch-to-cold beats route-to-warm exactly when
+    ``load_cost_pages * (load_warm - load_cold) >
+    page_cost * peer_depth`` — i.e. the warm replica is busier than
+    the cold one by enough queued work to outweigh moving the chain
+    over the wire — and beats recompute whenever ``page_cost < 1`` (a
+    page on the wire is cheaper than re-prefilling it), which is what
+    turns N per-engine caches into one fleet cache (docs/CACHING.md).
+    The wire term charges the WHOLE chain (``peer_depth`` pages), not
+    just the target's missing suffix: the import path needs a
+    contiguous head-first tiling, so head pages the target already
+    holds still cross the wire (they are dropped at publish-dedup)."""
+
+    enabled: bool = True
+    # minimum fetchable gain (pages) worth a wire transfer: tiny
+    # prefixes recompute faster than they round-trip
+    min_pages: int = 2
+    # wire cost of moving one page, in recompute-page units (< 1 or
+    # fetching never pays; int8 wire quant justifies lowering it)
+    page_cost: float = 0.25
+    # load penalty: one active/waiting request on the target replica
+    # costs this many pages of queueing delay
+    load_cost_pages: float = 4.0
+
+
+@dataclass(frozen=True)
+class PrefixRoutePlan:
+    """One cache_aware routing decision (``plan_route``): where the
+    request goes and whether the target should peer-fetch the matched
+    prefix first (serving/disagg.py PrefixFetcher)."""
+
+    engine_id: str
+    decision: str  # "warm" | "fetch" | "recompute"
+    peer_id: Optional[str] = None  # fetch source (decision == "fetch")
+    depth: int = 0  # target's own matched depth, pages
+    peer_depth: int = 0  # deepest fleet match, pages
+    page_size: int = 0  # page size the hashes were computed with
+    prefix_hashes: Optional[Tuple[int, ...]] = None
+
+
+def plan_route(
+    statuses: Sequence[EngineStatus],
+    prefix_hashes: Optional[Sequence[int]],
+    roles: Optional[Sequence[str]] = None,
+    costs: Optional[FetchCosts] = None,
+    page_size: int = 0,
+) -> Optional[PrefixRoutePlan]:
+    """Three-way cache_aware routing: route-to-warm vs fetch-to-cold vs
+    recompute, scored per admissible engine in page units —
+
+        cost_route(e) = load_cost * load(e) + (pages - depth(e))
+        cost_fetch(e) = load_cost * load(e) + (pages - peer_depth)
+                        + page_cost * peer_depth
+
+    where ``peer_depth`` is the deepest match anywhere in the healthy
+    fleet (any role — the peer only exports, it never takes the
+    request). The cheapest option wins; ties prefer route over fetch
+    (no wire work for equal cost), then load, then engine id —
+    deterministic given inputs, like choose_engine. The
+    ``sched.fetch_decision`` fault flag (docs/RESILIENCE.md) forces the
+    cheapest FETCH option when one exists, so chaos scenarios can drive
+    the fetch path deterministically under random load. Returns None
+    when no healthy admissible engine exists."""
+    costs = costs or FetchCosts()
+    healthy = [s for s in statuses if s.healthy]
+    admissible = (healthy if roles is None else
+                  [s for s in healthy
+                   if getattr(s, "role", "unified") in roles])
+    if not admissible:
+        return None
+
+    def load(s: EngineStatus) -> int:
+        return s.active_requests + s.waiting_requests
+
+    n_pages = len(prefix_hashes) if prefix_hashes else 0
+    depths = {s.engine_id: prefix_match_depth(s, prefix_hashes)
+              for s in healthy}
+    peer = min(healthy, key=lambda s: (-depths[s.engine_id], load(s),
+                                       s.engine_id))
+    peer_depth = depths[peer.engine_id]
+    if n_pages == 0 or peer_depth == 0:
+        eng = min(admissible, key=lambda s: (load(s), s.engine_id))
+        return PrefixRoutePlan(eng.engine_id, "recompute",
+                               page_size=page_size)
+    hashes = tuple(prefix_hashes)
+    # (cost, route-first tie-break, load, engine_id, kind, status, depth)
+    options: List[tuple] = []
+    for s in admissible:
+        d = depths[s.engine_id]
+        base = costs.load_cost_pages * load(s)
+        options.append((base + (n_pages - d), 0, load(s), s.engine_id,
+                        "route", s, d))
+        if (costs.enabled and s.engine_id != peer.engine_id
+                and peer_depth - d >= costs.min_pages):
+            # the wire term charges the WHOLE chain: the fetch moves
+            # pages 0..peer_depth (head-first contiguous tiling), not
+            # just the target's missing suffix
+            options.append((
+                base + (n_pages - peer_depth)
+                + costs.page_cost * peer_depth,
+                1, load(s), s.engine_id, "fetch", s, d,
+            ))
+    if faults.flag("sched.fetch_decision"):
+        forced = [o for o in options if o[4] == "fetch"]
+        if forced:
+            options = forced
+    best = min(options, key=lambda o: o[:4])
+    _, _, _, _, kind, s, d = best
+    if kind == "fetch":
+        return PrefixRoutePlan(s.engine_id, "fetch",
+                               peer_id=peer.engine_id, depth=d,
+                               peer_depth=peer_depth, page_size=page_size,
+                               prefix_hashes=hashes)
+    return PrefixRoutePlan(s.engine_id, "warm" if d > 0 else "recompute",
+                           depth=d, peer_depth=peer_depth,
+                           page_size=page_size, prefix_hashes=hashes)
 
 
 def choose_engine(
@@ -138,13 +263,17 @@ class AdaptiveScheduler:
         metrics: Optional[MetricsCollector] = None,
         restart_backoff_s: float = 1.0,
         restart_backoff_max_s: float = 30.0,
+        fetch_costs: Optional[FetchCosts] = None,
     ):
         """``restart_backoff_s``/``restart_backoff_max_s``: after a
         FAILED restart the next attempt waits ``backoff`` (doubling per
         consecutive failure, jittered, capped at the max) instead of
         retrying every health sweep — a crash-looping engine factory
-        must not hot-spin the health loop (docs/RESILIENCE.md)."""
+        must not hot-spin the health loop (docs/RESILIENCE.md).
+        ``fetch_costs``: weights of the cache_aware three-way cost model
+        (``plan_route``; None = defaults)."""
         self._strategy = strategy
+        self._fetch_costs = fetch_costs or FetchCosts()
         self._engines: Dict[str, EngineRunner] = {}
         self._lock = threading.Lock()
         self._rr = 0
@@ -213,6 +342,20 @@ class AdaptiveScheduler:
         """
         return self.schedule_batch([prompt_ids])[0]
 
+    def _admission_roles(
+        self, statuses: Sequence[EngineStatus]
+    ) -> Optional[Tuple[str, ...]]:
+        """Role restriction for admission batches (disaggregated
+        serving): decode-role engines never take admissions while a
+        prefill/unified replica is healthy."""
+        if any(getattr(s, "role", "unified") == "decode" and s.healthy
+               for s in statuses):
+            non_decode = ("prefill", "unified")
+            if any(s.healthy and getattr(s, "role", "unified") in non_decode
+                   for s in statuses):
+                return non_decode
+        return None
+
     def schedule_batch(
         self, prompts: Sequence[Optional[Sequence[int]]]
     ) -> List[Optional["EngineRunner"]]:
@@ -223,25 +366,28 @@ class AdaptiveScheduler:
         path; choose_engine is pure, so every request in the window
         scores against the same snapshot."""
         statuses = self.statuses()
-        roles = None
-        if any(getattr(s, "role", "unified") == "decode" and s.healthy
-               for s in statuses):
-            non_decode = ("prefill", "unified")
-            if any(s.healthy and getattr(s, "role", "unified") in non_decode
-                   for s in statuses):
-                roles = non_decode
-        hash_ps = 0
+        roles = self._admission_roles(statuses)
+        hash_ps = digest_depth = 0
         if self._strategy is SchedulingStrategy.CACHE_AWARE:
             from distributed_inference_server_tpu.engine.kv_cache import (
                 DIGEST_DEPTH,
                 chain_hashes,
             )
 
-            # hash with the fleet's page size (replicas share one engine
-            # config; a 0 page_size means no engine has reported yet)
+            # hash with the fleet's page size and published digest depth
+            # (replicas share one engine config; a 0 page_size means no
+            # engine has reported yet) — a cache.digest_depth deeper
+            # than the default must widen THIS path's scoring window
+            # too, or redispatch/fetcher-less routing flattens exactly
+            # the deep matches the config asked to see
             hash_ps = next(
                 (s.page_size for s in statuses
                  if s.healthy and getattr(s, "page_size", 0) > 0), 0,
+            )
+            digest_depth = next(
+                (s.digest_depth for s in statuses
+                 if s.healthy and getattr(s, "digest_depth", 0) > 0),
+                DIGEST_DEPTH,
             )
         out: List[Optional["EngineRunner"]] = []
         with self._lock:
@@ -249,7 +395,7 @@ class AdaptiveScheduler:
                 prefix_hashes = None
                 if hash_ps > 0 and prompt_ids:
                     prefix_hashes = chain_hashes(prompt_ids, hash_ps,
-                                                 max_pages=DIGEST_DEPTH)
+                                                 max_pages=digest_depth)
                 engine_id = choose_engine(self._strategy, statuses,
                                           self._rr, roles=roles,
                                           prefix_hashes=prefix_hashes)
@@ -258,6 +404,55 @@ class AdaptiveScheduler:
                     continue
                 self._rr += 1
                 out.append(self._engines.get(engine_id))
+        return out
+
+    def schedule_batch_plans(
+        self, prompts: Sequence[Optional[Sequence[int]]]
+    ) -> List[Tuple[Optional["EngineRunner"], Optional[PrefixRoutePlan]]]:
+        """Cache-aware dispatch with the three-way cost model
+        (``plan_route``): one ``(runner, plan)`` per prompt against ONE
+        fleet snapshot. ``plan.decision == "fetch"`` tells the
+        dispatcher to peer-fetch the matched prefix chain onto the
+        chosen (cold) replica before submitting (docs/CACHING.md
+        "Fleet-wide prefix sharing"); "warm"/"recompute" submit
+        directly. Prompt hashing is capped at the fleet's published
+        digest depth and at the prompt's own penultimate page (at least
+        one token is always recomputed, so a whole-prompt fetch would
+        seat a page the prefill can never share)."""
+        statuses = self.statuses()
+        roles = self._admission_roles(statuses)
+        from distributed_inference_server_tpu.engine.kv_cache import (
+            DIGEST_DEPTH,
+            chain_hashes,
+        )
+
+        hash_ps = next(
+            (s.page_size for s in statuses
+             if s.healthy and getattr(s, "page_size", 0) > 0), 0,
+        )
+        digest_depth = next(
+            (s.digest_depth for s in statuses
+             if s.healthy and getattr(s, "digest_depth", 0) > 0),
+            DIGEST_DEPTH,
+        )
+        out: List[Tuple[Optional["EngineRunner"],
+                        Optional[PrefixRoutePlan]]] = []
+        with self._lock:
+            for prompt_ids in prompts:
+                prefix_hashes = None
+                if hash_ps > 0 and prompt_ids:
+                    cap = (len(prompt_ids) - 1) // hash_ps
+                    prefix_hashes = chain_hashes(
+                        prompt_ids, hash_ps,
+                        max_pages=min(digest_depth, cap),
+                    )
+                plan = plan_route(statuses, prefix_hashes, roles=roles,
+                                  costs=self._fetch_costs,
+                                  page_size=hash_ps)
+                if plan is None:
+                    out.append((None, None))
+                    continue
+                out.append((self._engines.get(plan.engine_id), plan))
         return out
 
     def schedule_decode(self, exclude: Optional[str] = None

@@ -70,6 +70,7 @@ class InferenceServer:
         otlp_service_name: str = "distributed-inference-server-tpu",
         engine_roles: Optional[List[str]] = None,
         disagg_settings=None,
+        fetch_costs=None,
     ):
         """``model_resolver(name) -> engine_factory`` enables the admin
         model-swap endpoint (Req 13); None leaves it unconfigured (501).
@@ -82,7 +83,12 @@ class InferenceServer:
         "prefill" | "decode" | "unified". Any prefill/decode role brings
         up the DisaggController and KV-handoff channel; None/all-unified
         is exactly today's monolithic behavior. ``disagg_settings`` is a
-        disagg.DisaggSettings (timeout/retries/channel backend)."""
+        disagg.DisaggSettings (timeout/retries/channel backend) — it
+        also configures the fleet prefix-sharing channel (the
+        PrefixFetcher reuses its channel/chunk_pages/wire_quant).
+        ``fetch_costs`` is a scheduler.FetchCosts for the cache_aware
+        three-way route/fetch/recompute cost model (docs/CACHING.md);
+        None = defaults."""
         from distributed_inference_server_tpu.utils.tracing import Tracer
 
         self.engine_factory = engine_factory
@@ -105,10 +111,12 @@ class InferenceServer:
             metrics=self.metrics,
             restart_backoff_s=restart_backoff_s,
             restart_backoff_max_s=restart_backoff_max_s,
+            fetch_costs=fetch_costs,
         )
         from distributed_inference_server_tpu.serving.disagg import (
             DisaggController,
             DisaggSettings,
+            PrefixFetcher,
             make_channel,
             parse_roles,
         )
@@ -116,9 +124,9 @@ class InferenceServer:
         if engine_roles is not None and isinstance(engine_roles, str):
             engine_roles = parse_roles(engine_roles, num_engines)
         self._roles: List[str] = list(engine_roles or [])
+        settings = disagg_settings or DisaggSettings()
         self.disagg: Optional[DisaggController] = None
         if any(r in ("prefill", "decode") for r in self._roles):
-            settings = disagg_settings or DisaggSettings()
             self.disagg = DisaggController(
                 self.scheduler,
                 metrics=self.metrics,
@@ -128,6 +136,14 @@ class InferenceServer:
             self.metrics.set_engines_by_role(
                 DisaggController.role_counts(self._roles)
             )
+        # fleet prefix sharing (docs/CACHING.md): always constructed —
+        # whether it runs is the scheduler's cost-model decision, which
+        # only yields "fetch" under cache_aware with peer fetch enabled
+        self.prefix_fetcher = PrefixFetcher(
+            channel=make_channel(settings.channel),
+            settings=settings,
+            metrics=self.metrics,
+        )
         self.dispatcher = Dispatcher(
             self.scheduler,
             queue_config=queue_config,
@@ -136,6 +152,7 @@ class InferenceServer:
             tracer=self.tracer,
             disagg=self.disagg,
             max_redispatch=max_redispatch,
+            prefix_fetcher=self.prefix_fetcher,
         )
         from distributed_inference_server_tpu.native import make_validator
 

@@ -490,6 +490,13 @@ class TestChaosFleetSeeds:
         ("redispatch", 11),
         ("crash_mid_handoff", 12),
         ("degradation_flap", 13),
+        # fleet prefix sharing (docs/CACHING.md): peer dies mid-fetch →
+        # recompute fallback, exactly-once, zero page leak. Seeds 21/24
+        # crash the peer runner outright (runner.inbox); 22 drops a
+        # chunk on the wire (kv.peer_fetch).
+        ("warm_peer_fetch_death", 21),
+        ("warm_peer_fetch_death", 22),
+        ("warm_peer_fetch_death", 24),
     ])
     def test_scenario_clean(self, scenario, seed):
         from tools import chaos_fleet

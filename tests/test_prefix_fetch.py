@@ -1,0 +1,528 @@
+"""Fleet-wide prefix sharing (ISSUE 8): peer-to-peer KV prefix fetch
+with the three-way route/fetch/recompute cost model. Covers the engine
+export/import primitives (token identity over f32 and int8 wire, chunk
+reorder/truncation/crc fuzz reusing the streamed-import validation
+harness, registry staleness), the ``plan_route`` routing matrix under
+load skew, configurable digest depth, the ``KvPrefixFetch`` wire
+round-trip, and the serving path end-to-end (forced fetch, peer death
+fallback, abort-mid-fetch)."""
+
+import dataclasses
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_inference_server_tpu.core.errors import (
+    CacheDeserializationError,
+)
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distributed_inference_server_tpu.engine.kv_cache import (
+    PagedCacheConfig,
+    chain_hashes,
+)
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+from distributed_inference_server_tpu.serving import faults
+from distributed_inference_server_tpu.serving.disagg import (
+    InProcessChannel,
+    ProtowireChannel,
+)
+from distributed_inference_server_tpu.serving.metrics import EngineStatus
+from distributed_inference_server_tpu.serving.scheduler import (
+    FetchCosts,
+    plan_route,
+)
+
+TOK = ByteTokenizer()
+PS = 4
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+
+
+def make_engine(tiny_params, host_tier_bytes=0, host_tier_quant="none",
+                num_pages=32, digest_depth=8):
+    return LLMEngine(
+        tiny_params, TINY, TOK,
+        EngineConfig(
+            max_batch=2,
+            prefill_buckets=(8, 32),
+            paged=PagedCacheConfig(
+                num_pages=num_pages, page_size=PS, max_pages_per_seq=16
+            ),
+            host_tier_bytes=host_tier_bytes,
+            host_tier_quant=host_tier_quant,
+            native_allocator=False,
+            digest_depth=digest_depth,
+        ),
+        dtype=jnp.float32,
+    )
+
+
+def run_one(engine, rid, prompt, max_tokens=6):
+    engine.add_request(rid, prompt, SamplingParams(max_tokens=max_tokens,
+                                                   temperature=0.0))
+    tokens = []
+    for _ in range(500):
+        if not engine.has_work():
+            break
+        for out in engine.step():
+            if out.token_id is not None:
+                tokens.append(out.token_id)
+            assert out.error is None, out.error
+    assert not engine.has_work()
+    return tokens
+
+
+PREFIX = list(range(40, 60))  # 5 full pages at PS=4
+PROMPT = PREFIX + [7, 8]
+HASHES = chain_hashes(PROMPT, PS, max_pages=(len(PROMPT) - 1) // PS)
+
+
+# ---------------------------------------------------------------------------
+# Engine primitives: export_prefix_chunks / import_prefix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("wire_quant", ["none", "int8"])
+def test_peer_fetch_token_identity(tiny_params, wire_quant):
+    """A peer-fetched prefix decodes byte-identically to recompute —
+    the acceptance bar for the fetch path (f32 exactly; int8 wire
+    asserts the same on this fixture, like the host tier and disagg
+    wire)."""
+    cold = make_engine(tiny_params)
+    want = run_one(cold, "cold", PROMPT)
+
+    warm = make_engine(tiny_params)
+    run_one(warm, "warm", PROMPT)
+    depth, chunks = warm.export_prefix_chunks(HASHES, chunk_pages=2,
+                                              wire_quant=wire_quant)
+    assert depth == len(HASHES)
+    assert sum(c.page_count for c in chunks) == depth
+
+    target = make_engine(tiny_params)
+    seated = target.import_prefix(PROMPT[: depth * PS], chunks)
+    assert seated == depth
+    s0 = target.cache_stats()
+    assert s0.pages_cached == depth  # seated as CACHED, nothing pinned
+    got = run_one(target, "probe", PROMPT)
+    assert got == want
+    assert target.cache_stats().hits > s0.hits  # prefill matched them
+    assert target.audit_pages() == []
+
+
+def test_peer_fetch_from_host_tier(tiny_params):
+    """A chain that churned out of the peer's HBM into its host tier
+    still exports (stored int8 encoding ships as-is) and lands
+    token-identically."""
+    cold = make_engine(tiny_params)
+    want = run_one(cold, "cold", PROMPT)
+
+    warm = make_engine(tiny_params, host_tier_bytes=1 << 22,
+                       host_tier_quant="int8", num_pages=10)
+    run_one(warm, "warm", PROMPT)
+    rng = np.random.default_rng(3)
+    for i in range(8):  # cycle the 10-page pool: prefix demotes
+        run_one(warm, f"churn{i}", rng.integers(100, 200, size=7).tolist(),
+                max_tokens=2)
+    warm.host_tier.flush()
+    depth, chunks = warm.export_prefix_chunks(HASHES, chunk_pages=2)
+    assert depth > 0
+    target = make_engine(tiny_params)
+    target.import_prefix(PROMPT[: depth * PS], chunks)
+    assert run_one(target, "probe", PROMPT) == want
+    assert target.audit_pages() == []
+
+
+def test_registry_staleness_partial_and_full_eviction(tiny_params):
+    """The peer evicted the chain between the routing score and the
+    fetch: export serves whatever consecutive head it still holds —
+    possibly nothing — and never errors (the caller falls back)."""
+    warm = make_engine(tiny_params)
+    run_one(warm, "warm", PROMPT)
+    warm.evict_cache(0.0, drop_host_tier=True)  # full eviction, no tier
+    depth, chunks = warm.export_prefix_chunks(HASHES)
+    assert (depth, chunks) == (0, [])
+
+
+def test_import_prefix_fuzz_reorder_truncation_crc(tiny_params):
+    """The fetch import rides the KvImportSession validation harness:
+    reordered chunks seat fine; a dropped chunk, corrupt crc, or
+    duplicate index rejects the whole fetch with every reserved page
+    released (allocator audit clean)."""
+    warm = make_engine(tiny_params)
+    want = run_one(warm, "warm", PROMPT)
+    depth, chunks = warm.export_prefix_chunks(HASHES, chunk_pages=1)
+    assert len(chunks) == depth >= 3
+    tokens = PROMPT[: depth * PS]
+
+    # any arrival order seats token-identically
+    shuffled = list(chunks)
+    random.Random(7).shuffle(shuffled)
+    tgt = make_engine(tiny_params)
+    tgt.import_prefix(tokens, shuffled)
+    assert run_one(tgt, "probe", PROMPT) == want
+
+    def rejects(bad):
+        eng = make_engine(tiny_params)
+        with pytest.raises(CacheDeserializationError):
+            eng.import_prefix(tokens, bad)
+        s = eng.cache_stats()
+        assert s.pages_free == s.pages_total  # nothing leaked
+        assert eng.audit_pages() == []
+
+    rejects(chunks[:-1])  # truncation: coverage short of the tokens
+    rejects([dataclasses.replace(chunks[0], crc32=chunks[0].crc32 ^ 1)]
+            + chunks[1:])  # corrupt payload
+    rejects([chunks[0]] + chunks)  # duplicate index
+    rejects([dataclasses.replace(c, payload=c.payload[:-4],
+                                 crc32=__import__("zlib").crc32(
+                                     c.payload[:-4]) & 0xFFFFFFFF)
+             if i == 0 else c for i, c in enumerate(chunks)])  # short payload
+
+
+def test_import_prefix_validation(tiny_params):
+    eng = make_engine(tiny_params)
+    with pytest.raises(CacheDeserializationError):
+        eng.import_prefix(PREFIX[:3], [])  # not whole pages
+    with pytest.raises(CacheDeserializationError):
+        eng.import_prefix([], [])
+
+
+def test_digest_depth_configurable(tiny_params):
+    """cache.digest_depth widens the published digest: a 12-page chain
+    is fully visible at digest_depth=16 but flattens to 8 hashes at the
+    default — exactly the window the cost model can score."""
+    long_prefix = list(range(48))  # 12 full pages
+    prompt = long_prefix + [7, 8]
+    shallow = make_engine(tiny_params, digest_depth=8)
+    deep = make_engine(tiny_params, digest_depth=16)
+    run_one(shallow, "s", prompt)
+    run_one(deep, "d", prompt)
+    hashes = chain_hashes(prompt, PS, max_pages=12)
+    assert sum(h in shallow.prefix_digest() for h in hashes) == 8
+    assert sum(h in deep.prefix_digest() for h in hashes) == 12
+
+
+def test_digest_depth_config_validation():
+    from distributed_inference_server_tpu.core.errors import ConfigError
+    from distributed_inference_server_tpu.serving.config import ServerConfig
+
+    with pytest.raises(ConfigError):
+        ServerConfig.load(environ={"DIS_TPU_CACHE__DIGEST_DEPTH": "0"})
+    cfg = ServerConfig.load(environ={"DIS_TPU_CACHE__DIGEST_DEPTH": "16",
+                                     "DIS_TPU_CACHE__FETCH_PAGE_COST":
+                                     "0.1"})
+    assert cfg.get("cache", "digest_depth") == 16
+    costs = cfg.fetch_costs()
+    assert costs.page_cost == 0.1 and costs.enabled
+
+
+# ---------------------------------------------------------------------------
+# Routing matrix: the three-way cost model under load skew
+# ---------------------------------------------------------------------------
+
+
+def _status(eid, healthy=True, active=0, waiting=0, digest=None,
+            page_size=PS, role="unified", digest_depth=8):
+    return EngineStatus(
+        engine_id=eid, healthy=healthy, active_requests=active,
+        waiting_requests=waiting, total_processed=0,
+        memory_used_pages=0, memory_total_pages=100,
+        prefix_digest=digest, page_size=page_size, role=role,
+        digest_depth=digest_depth,
+    )
+
+
+RPROMPT = list(range(33))  # 8 full pages + 1
+RHASHES = chain_hashes(RPROMPT, PS, max_pages=8)
+
+
+class TestRoutingMatrix:
+    def test_idle_warm_replica_routes_warm(self):
+        plan = plan_route([
+            _status("warm", digest=frozenset(RHASHES)),
+            _status("cold"),
+        ], RHASHES)
+        assert (plan.engine_id, plan.decision) == ("warm", "warm")
+
+    def test_saturated_warm_replica_fetches_to_cold(self):
+        """THE acceptance case: the warm replica is saturated, so the
+        cost model provably picks fetch-to-cold over route-to-warm."""
+        plan = plan_route([
+            _status("warm", active=6, waiting=4,
+                    digest=frozenset(RHASHES)),
+            _status("cold"),
+        ], RHASHES)
+        assert plan.decision == "fetch"
+        assert plan.engine_id == "cold" and plan.peer_id == "warm"
+        assert plan.peer_depth == len(RHASHES) and plan.depth == 0
+        assert plan.prefix_hashes == tuple(RHASHES)
+
+    def test_fetch_threshold_is_the_load_differential(self):
+        """Fetch wins exactly when load_cost * (load_warm - load_cold)
+        exceeds page_cost * fetched_pages (FetchCosts docstring)."""
+        costs = FetchCosts(min_pages=2, page_cost=0.25, load_cost_pages=4.0)
+        # gain 8 pages -> wire cost 2.0 -> needs a differential > 0.5
+        # requests; load 1 vs 0 tips it
+        warm1 = plan_route([
+            _status("warm", active=1, digest=frozenset(RHASHES)),
+            _status("cold"),
+        ], RHASHES, costs=costs)
+        assert warm1.decision == "fetch"
+        warm0 = plan_route([
+            _status("warm", digest=frozenset(RHASHES)),
+            _status("cold"),
+        ], RHASHES, costs=costs)
+        assert warm0.decision == "warm"
+
+    def test_no_match_recomputes_least_loaded(self):
+        plan = plan_route([
+            _status("busy", active=3),
+            _status("idle"),
+        ], RHASHES)
+        assert (plan.engine_id, plan.decision) == ("idle", "recompute")
+
+    def test_gain_below_min_pages_never_fetches(self):
+        plan = plan_route([
+            _status("warm", active=9, digest=frozenset(RHASHES[:1])),
+            _status("cold"),
+        ], RHASHES, costs=FetchCosts(min_pages=2))
+        assert plan.decision in ("warm", "recompute")
+        assert plan.peer_id is None
+
+    def test_peer_fetch_disabled_routes_warm(self):
+        plan = plan_route([
+            _status("warm", active=9, waiting=9,
+                    digest=frozenset(RHASHES)),
+            _status("cold"),
+        ], RHASHES, costs=FetchCosts(enabled=False))
+        assert plan.decision != "fetch"
+
+    def test_partial_local_match_still_fetches_whole_chain(self):
+        """A target holding part of the chain still fetches when the
+        peer is loaded; the plan records both depths (the fetch moves
+        the whole chain — contiguous tiling — and the cost model
+        charges it accordingly)."""
+        plan = plan_route([
+            _status("warm", active=6, digest=frozenset(RHASHES)),
+            _status("cold", digest=frozenset(RHASHES[:3])),
+        ], RHASHES)
+        assert plan.decision == "fetch"
+        assert plan.depth == 3 and plan.peer_depth == len(RHASHES)
+
+    def test_decode_peer_can_source_but_not_take_the_request(self):
+        """A decode-role replica holds the deepest match (a migrated
+        sequence published there): it serves as the fetch SOURCE while
+        the request lands on an admissible replica."""
+        plan = plan_route([
+            _status("dec", role="decode", digest=frozenset(RHASHES)),
+            _status("pre", role="prefill"),
+        ], RHASHES, roles=("prefill", "unified"))
+        assert plan.engine_id == "pre"
+        assert plan.decision == "fetch" and plan.peer_id == "dec"
+
+    def test_unhealthy_peer_is_invisible(self):
+        plan = plan_route([
+            _status("dead", healthy=False, digest=frozenset(RHASHES)),
+            _status("cold"),
+        ], RHASHES)
+        assert (plan.decision, plan.peer_id) == ("recompute", None)
+        assert plan_route([_status("dead", healthy=False)], RHASHES) is None
+
+    def test_forced_fetch_flag(self):
+        """sched.fetch_decision forces the cheapest fetch option even
+        when routing warm would be cheaper (the chaos lever)."""
+        statuses = [
+            _status("warm", digest=frozenset(RHASHES)),
+            _status("cold"),
+        ]
+        faults.install(faults.parse_spec("sched.fetch_decision:nth=1", 1))
+        try:
+            plan = plan_route(statuses, RHASHES)
+        finally:
+            faults.clear()
+        assert plan.decision == "fetch" and plan.engine_id == "cold"
+        # disarmed: the same inputs route warm
+        assert plan_route(statuses, RHASHES).decision == "warm"
+
+    def test_deterministic_given_inputs(self):
+        statuses = [
+            _status("a", active=2, digest=frozenset(RHASHES[:4])),
+            _status("b", active=1, digest=frozenset(RHASHES)),
+            _status("c"),
+        ]
+        plans = {(p.engine_id, p.decision, p.peer_id)
+                 for p in (plan_route(statuses, RHASHES)
+                           for _ in range(5))}
+        assert len(plans) == 1
+
+
+# ---------------------------------------------------------------------------
+# Wire: KvPrefixFetch round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_fetch_request_wire_roundtrip():
+    inproc = InProcessChannel().transfer_fetch_request(
+        "r1", HASHES, 8, "int8")
+    wired = ProtowireChannel().transfer_fetch_request(
+        "r1", HASHES, 8, "int8")
+    assert wired == ("r1", list(HASHES), 8, "int8")
+    assert inproc == wired
+    # empty wire_quant decodes to the canonical "none"
+    assert ProtowireChannel().transfer_fetch_request(
+        "r2", [], 4, "")[3] == "none"
+
+
+# ---------------------------------------------------------------------------
+# Serving path end-to-end (chaos-fleet topology, sans HTTP)
+# ---------------------------------------------------------------------------
+
+
+def _fetch_fleet(channel="protowire"):
+    from tools import chaos_fleet
+
+    chaos_fleet._env_setup()
+    return chaos_fleet.build_fleet(
+        strategy="cache_aware", channel=channel,
+        engine_kwargs={"native_allocator": False},
+    )
+
+
+def _warm_and_probe(srv, prompt, spec, seed=0, max_tokens=8):
+    """Warm one replica, arm ``spec``, probe; returns (warm_sink,
+    probe_sink). Caller asserts on outcomes and metrics."""
+    from tools import chaos_fleet
+
+    warm = [chaos_fleet.submit(srv, f"w{i}-{seed}", prompt=prompt,
+                               max_tokens=max_tokens) for i in range(2)]
+    chaos_fleet.wait_terminal([s for s in warm if s is not None])
+    time.sleep(0.35)  # digest refresh is rate-limited to 250 ms
+    faults.install(faults.parse_spec(spec, seed))
+    sinks = []
+    chaos_fleet.submit(srv, f"probe-{seed}", prompt=prompt,
+                       max_tokens=max_tokens, sinks=sinks)
+    wedged = chaos_fleet.wait_terminal(sinks, 60)
+    faults.clear()
+    assert wedged == []
+    return warm[0], sinks[0]
+
+
+class TestServingFetch:
+    def test_forced_fetch_end_to_end(self):
+        """ACCEPTANCE: a repeated-prefix request lands on the cold
+        replica via peer fetch (protowire channel), completes with the
+        same token count as the warm run, and the fetch shows up in
+        metrics as ok with bytes moved."""
+        from tools import chaos_fleet
+
+        srv = _fetch_fleet()
+        try:
+            warm_sink, probe = _warm_and_probe(
+                srv, chaos_fleet._PROMPT + " e2e",
+                "sched.fetch_decision:nth=1")
+            assert probe.errors == [] and probe.dones == 1
+            assert probe.tokens == warm_sink.tokens
+            snap = srv.metrics.snapshot(
+                tuple(srv.scheduler.statuses())).to_dict()
+            pf = snap["cache"]["peer_fetch"]
+            assert pf.get("ok") == 1 and pf["bytes"] > 0
+            assert snap["cache"]["route_decisions"].get("fetch") == 1
+            v = chaos_fleet.check_invariants(srv, [probe],
+                                             require_success=True)
+            assert v == []
+        finally:
+            faults.clear()
+            srv.shutdown(drain_timeout_s=5.0)
+
+    def test_peer_death_mid_fetch_falls_back_to_recompute(self):
+        """ACCEPTANCE: kv.peer_fetch kills the wire mid-fetch — the
+        request recomputes on its target, exactly once, with the fetch
+        recorded as fallback and zero pages leaked."""
+        from tools import chaos_fleet
+
+        srv = _fetch_fleet()
+        try:
+            warm_sink, probe = _warm_and_probe(
+                srv, chaos_fleet._PROMPT + " death",
+                "sched.fetch_decision:nth=1;kv.peer_fetch:nth=1")
+            assert probe.errors == [] and probe.dones == 1
+            assert probe.tokens == warm_sink.tokens
+            snap = srv.metrics.snapshot(
+                tuple(srv.scheduler.statuses())).to_dict()
+            pf = snap["cache"]["peer_fetch"]
+            assert pf.get("fallback") == 1 and "ok" not in pf
+            v = chaos_fleet.check_invariants(srv, [probe],
+                                             require_success=True)
+            assert v == []
+        finally:
+            faults.clear()
+            srv.shutdown(drain_timeout_s=5.0)
+
+    def test_export_on_dead_runner_resolves_callback(self, tiny_params):
+        """submit_prefix_export on an unhealthy runner resolves its
+        callback immediately (the fetcher falls back instead of waiting
+        on a dead peer forever)."""
+        from distributed_inference_server_tpu.serving.runner import (
+            EngineRunner,
+        )
+
+        runner = EngineRunner("e0", lambda: make_engine(tiny_params))
+        got = []
+        runner.submit_prefix_export("r", HASHES, 8, "none",
+                                    lambda res, err: got.append((res, err)))
+        assert got and got[0][0] is None and got[0][1]
+
+    def test_abort_mid_fetch_drops_the_request(self, tiny_params):
+        """A client disconnect while the fetch is in flight drops the
+        request (no submit into a closed sink), and the fetcher's
+        in-flight map drains."""
+        from distributed_inference_server_tpu.serving.disagg import (
+            PrefixFetcher,
+        )
+        from distributed_inference_server_tpu.serving.scheduler import (
+            PrefixRoutePlan,
+        )
+
+        class _Runner:
+            engine_id = "x"
+
+            def __init__(self):
+                self.submitted = []
+                self.export_cb = None
+
+            def submit_prefix_export(self, rid, hashes, cp, wq, cb):
+                self.export_cb = cb  # held: fetch stays in flight
+
+            def submit(self, reqs):
+                self.submitted.extend(reqs)
+
+        class _Req:
+            request_id = "r1"
+            prompt_ids = PROMPT
+
+        fetcher = PrefixFetcher()
+        target, peer = _Runner(), _Runner()
+        plan = PrefixRoutePlan("t", "fetch", peer_id="p", depth=0,
+                               peer_depth=5, page_size=PS,
+                               prefix_hashes=tuple(HASHES))
+        fetcher.fetch_then_submit(target, peer, _Req(), plan)
+        assert fetcher.pending_count() == 1
+        assert fetcher.abort("r1") is True
+        peer.export_cb(None, "peer gone")  # settle after the abort
+        assert fetcher.pending_count() == 0
+        assert target.submitted == []  # dropped, not submitted
+        assert fetcher.abort("r1") is False  # nothing in flight anymore

@@ -51,6 +51,7 @@ DEFAULT_SCENARIOS = (
     "channel_truncation",
     "degradation_flap",
     "warm_replica_death",
+    "warm_peer_fetch_death",
 )
 
 _PROMPT = "chaos is a ladder, resilience is a lattice"
@@ -125,7 +126,7 @@ def _tiny_params():
 
 def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
                 channel="inproc", auto_restart=True, warmup=False,
-                handoff_timeout_s=20.0):
+                handoff_timeout_s=20.0, engine_kwargs=None):
     """A tiny-model fleet wired exactly like production (the
     disagg_smoke.py topology, sans HTTP): real engines, real runners,
     real dispatcher/scheduler/controller. Health loop runs hot
@@ -155,7 +156,7 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
         return LLMEngine(
             params, TINY, ByteTokenizer(),
             EngineConfig(max_batch=4, prefill_buckets=(16, 64), paged=paged,
-                         warmup_compile=warmup),
+                         warmup_compile=warmup, **(engine_kwargs or {})),
             dtype=jnp.float32,
         )
 
@@ -224,12 +225,14 @@ def check_invariants(srv, sinks, require_success=False,
     while time.monotonic() < deadline:
         runners = srv.scheduler.engines()
         healthy = all(r.is_healthy() for r in runners)
+        fetcher = getattr(srv.dispatcher, "prefix_fetcher", None)
         drained = (
             (healthy or not auto)
             and all(r.active_count() == 0 for r in runners)
             and srv.dispatcher.queue.is_empty()
             and srv.dispatcher.batcher.pending_count() == 0
             and (srv.disagg is None or srv.disagg.pending_count() == 0)
+            and (fetcher is None or fetcher.pending_count() == 0)
         )
         if drained and (healthy or not auto):
             break
@@ -363,6 +366,34 @@ def scenario_warm_replica_death(srv, seed: int):
     return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
 
 
+def scenario_warm_peer_fetch_death(srv, seed: int):
+    """Fleet prefix sharing (docs/CACHING.md): the cost model picks
+    fetch-to-cold (forced deterministic by the sched.fetch_decision
+    flag) and the warm peer dies mid-fetch — on the wire (kv.peer_fetch
+    drops a chunk) or outright (runner.inbox crashes the peer before it
+    serves the export). The request must degrade to recompute on its
+    target, terminate exactly once, and leak zero pages."""
+    rng = random.Random(seed)
+    sinks = []
+    prompt = _PROMPT + " fetch" * rng.randint(1, 3)
+    # warm one replica's prefix cache (cache_aware routes the repeats
+    # together) and let its rolling digest publish
+    warm = [submit(srv, f"pfw-{seed}-{i}", prompt=prompt, max_tokens=8)
+            for i in range(2)]
+    wait_terminal([s for s in warm if s is not None])
+    time.sleep(0.35)  # digest refresh is rate-limited to 250 ms
+    spec = rng.choice([
+        # the export dies on the wire at the Nth chunk
+        f"sched.fetch_decision:nth=1;kv.peer_fetch:nth={rng.randint(1, 2)}",
+        # the peer runner itself crashes before serving the export
+        "sched.fetch_decision:nth=1;runner.inbox:nth=1",
+    ])
+    _arm(spec, seed)
+    submit(srv, f"pf-{seed}", prompt=prompt, max_tokens=16, sinks=sinks)
+    wedged = wait_terminal(sinks)
+    return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
+
+
 #: scenario -> (fn, fleet kwargs)
 SCENARIOS = {
     "redispatch": (scenario_redispatch, {}),
@@ -376,6 +407,14 @@ SCENARIOS = {
     "degradation_flap": (scenario_degradation_flap, {}),
     "warm_replica_death": (scenario_warm_replica_death,
                            {"strategy": "cache_aware"}),
+    # fleet prefix sharing: digests need the Python allocator tier (the
+    # native allocator has no digest surface → no warm peer to fetch
+    # from), and protowire exercises the KvPrefixFetch/KvChunk framing
+    "warm_peer_fetch_death": (scenario_warm_peer_fetch_death,
+                              {"strategy": "cache_aware",
+                               "channel": "protowire",
+                               "engine_kwargs": {
+                                   "native_allocator": False}}),
 }
 
 

@@ -1,0 +1,113 @@
+"""Two-engine peer-fetch smoke: fleet-wide prefix sharing end-to-end
+over the protowire channel (docs/CACHING.md "Fleet-wide prefix
+sharing"), CI-runnable on the CPU backend.
+
+Builds a 2-replica cache_aware fleet (real engines, runners,
+dispatcher, scheduler, PrefixFetcher — the chaos_fleet topology, sans
+HTTP), warms one replica's prefix cache, then forces the cost model's
+FETCH decision (the ``sched.fetch_decision`` flag — deterministic, so
+the smoke never silently passes by routing warm) and pushes a
+repeated-prefix request through the full peer-fetch pipeline:
+KvPrefixFetch request framing → peer-side chain export → KvChunk wire
+transfer (int8 wire quantization by default) → import-side
+validate-and-scatter → prefill over the seated pages.
+
+Asserts: the probe completes cleanly with the same token count as the
+warm reference, the fetch is recorded ok with bytes moved, and the
+fleet invariants hold (exactly-once termination, zero page leak,
+reconvergence). Exit 0 = clean, 1 = violation.
+
+    JAX_PLATFORMS=cpu python tools/peerfetch_smoke.py [--wire-quant none]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--wire-quant", default="int8",
+                    choices=("none", "int8"))
+    ap.add_argument("--channel", default="protowire",
+                    choices=("inproc", "protowire"))
+    args = ap.parse_args()
+
+    from tools import chaos_fleet
+
+    chaos_fleet._env_setup()
+    from distributed_inference_server_tpu.serving import faults
+    from distributed_inference_server_tpu.serving.disagg import (
+        DisaggSettings,
+    )
+
+    srv = chaos_fleet.build_fleet(
+        strategy="cache_aware", channel=args.channel,
+        engine_kwargs={"native_allocator": False},
+    )
+    # the fetcher reuses the disagg channel settings; re-point it at the
+    # requested wire quant (build_fleet's settings default to "none")
+    srv.prefix_fetcher.settings = DisaggSettings(
+        channel=args.channel, wire_quant=args.wire_quant)
+
+    failures = []
+    try:
+        prompt = chaos_fleet._PROMPT + " peer fetch smoke"
+        warm = [chaos_fleet.submit(srv, f"warm-{i}", prompt=prompt,
+                                   max_tokens=12) for i in range(2)]
+        warm = [s for s in warm if s is not None]
+        chaos_fleet.wait_terminal(warm)
+        time.sleep(0.35)  # digest refresh is rate-limited to 250 ms
+
+        faults.install(faults.parse_spec("sched.fetch_decision:nth=1", 0))
+        sinks = []
+        chaos_fleet.submit(srv, "probe", prompt=prompt, max_tokens=12,
+                           sinks=sinks)
+        wedged = chaos_fleet.wait_terminal(sinks, 60)
+        faults.clear()
+        if wedged:
+            failures.append(f"probe wedged: {wedged}")
+        probe = sinks[0]
+        if probe.errors:
+            failures.append(f"probe errored: {probe.errors}")
+        if warm and probe.tokens != warm[0].tokens:
+            failures.append(
+                f"token count diverged: probe {probe.tokens} vs warm "
+                f"{warm[0].tokens} (greedy repeat must match)"
+            )
+        snap = srv.metrics.snapshot(
+            tuple(srv.scheduler.statuses())).to_dict()
+        pf = snap["cache"].get("peer_fetch", {})
+        routes = snap["cache"].get("route_decisions", {})
+        print(f"peer_fetch={pf} route_decisions={routes}")
+        if pf.get("ok", 0) < 1:
+            failures.append(f"no successful peer fetch recorded: {pf}")
+        if pf.get("bytes", 0) <= 0:
+            failures.append("no fetch bytes recorded")
+        if routes.get("fetch", 0) < 1:
+            failures.append(f"no fetch route decision recorded: {routes}")
+        failures.extend(chaos_fleet.check_invariants(
+            srv, sinks, require_success=True))
+    finally:
+        from distributed_inference_server_tpu.serving import faults as _f
+
+        _f.clear()
+        srv.shutdown(drain_timeout_s=5.0)
+
+    if failures:
+        print("PEER-FETCH SMOKE FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"peer-fetch smoke clean (channel={args.channel}, "
+          f"wire_quant={args.wire_quant})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
