@@ -260,11 +260,35 @@ def main(argv=None) -> int:
             # fleet prefix sharing (docs/CACHING.md): cache_aware
             # route/fetch/recompute cost-model weights
             fetch_costs=cfg.fetch_costs(),
+            # multi-host fleet control plane (docs/FLEET.md):
+            # fleet.enabled makes this the registry host; fleet.rerole
+            # arms the role balancer
+            fleet_settings=cfg.fleet_settings(),
         )
         server.start()
     except (ModelLoadError, RuntimeError, TimeoutError) as e:
         print(f"startup error: {e}", file=sys.stderr)
         return 1
+
+    fleet_worker = None
+    if cfg.get("fleet", "connect"):
+        # worker mode (docs/FLEET.md): join the registry host — local
+        # engines keep serving their own HTTP surface too
+        from distributed_inference_server_tpu.serving.remote_runner import (
+            FleetWorker,
+        )
+
+        fleet_worker = FleetWorker(
+            server.scheduler, cfg.fleet_settings(), metrics=server.metrics
+        )
+        try:
+            fleet_worker.start()
+        except OSError as e:
+            print(f"fleet join failed: {e}", file=sys.stderr)
+            server.shutdown()
+            return 1
+        print(f"joined fleet at {cfg.get('fleet', 'connect')} as "
+              f"{fleet_worker.member_id}")
 
     watcher = ConfigWatcher(cfg)
     watcher.subscribe(server.apply_hot_config)
@@ -280,6 +304,8 @@ def main(argv=None) -> int:
         pass
     finally:
         watcher.stop()
+        if fleet_worker is not None:
+            fleet_worker.stop()
         server.shutdown(drain_timeout_s=cfg.get("server", "drain_timeout_s"))
     return 0
 

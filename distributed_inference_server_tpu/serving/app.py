@@ -44,12 +44,15 @@ def build_app(
     metrics: Optional[MetricsCollector] = None,
     swap_fn=None,
     scale_fn=None,
+    fleet_fn=None,
 ) -> web.Application:
     """``swap_fn(model_name) -> (ok, error)`` enables the admin model-swap
     endpoint (Req 13.1: admin-API-triggered); ``scale_fn(n) -> (ok,
     error)`` enables the admin replica-scaling endpoint (runtime scale
     up/down, requirements.md:110). Both are blocking — they run in the
-    default executor."""
+    default executor. ``fleet_fn() -> dict`` adds the fleet control-plane
+    block (members, role map, rebalance history; serving/fleet.py) to
+    ``/server/stats``."""
     app = web.Application()
     app["handler"] = handler
     app["metrics"] = metrics
@@ -516,10 +519,12 @@ def build_app(
     async def stats(request: web.Request) -> web.Response:
         statuses = tuple(handler.dispatcher.scheduler.statuses())
         if metrics is None:
-            return web.json_response(
-                {"worker_statuses": [s.to_dict() for s in statuses]}
-            )
-        return web.json_response(metrics.snapshot(statuses).to_dict())
+            out = {"worker_statuses": [s.to_dict() for s in statuses]}
+        else:
+            out = metrics.snapshot(statuses).to_dict()
+        if fleet_fn is not None:
+            out["fleet"] = fleet_fn()
+        return web.json_response(out)
 
     async def prom(request: web.Request) -> web.Response:
         if metrics is None:

@@ -202,6 +202,42 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "low_watermark": (int, 500),
         "request_timeout_s": (float, 30.0),
         "max_queue_size": (int, 2000),
+        # per-tenant fair admission (core/queue.py; docs/FLEET.md):
+        # deficit-weighted round robin across tenants within each
+        # priority level, so one hot tenant cannot starve the fleet.
+        # Requests carry a "tenant" field; absent = "default". Forces
+        # the Python queue tier (the native tier has no tenant lanes).
+        "tenant_fairness": (bool, False),
+        # "tenantA=2,tenantB=1": relative dequeue weights; unlisted
+        # tenants weigh 1. "" = all equal.
+        "tenant_weights": (str, ""),
+    },
+    "fleet": {
+        # multi-host fleet control plane (serving/fleet.py,
+        # serving/remote_runner.py; docs/FLEET.md). enabled=true on the
+        # REGISTRY HOST starts the fleet listener; a WORKER process sets
+        # connect=host:port instead and joins by heartbeating.
+        "enabled": (bool, False),
+        "host": (str, "127.0.0.1"),
+        "port": (int, 0),  # 0 = ephemeral (tests/smoke)
+        "connect": (str, ""),
+        "member_id": (str, ""),  # "" = derived hostname:pid
+        "heartbeat_interval_s": (float, 0.5),
+        # member aging: alive -> suspect after suspect_after_s without a
+        # beat (routing avoids it), suspect -> dead after dead_after_s
+        # (in-flight requests take the crash-safe redispatch path)
+        "suspect_after_s": (float, 2.0),
+        "dead_after_s": (float, 5.0),
+        # dynamic role rebalancing (RoleBalancer): a unified engine
+        # re-roles to prefill when queued+waiting prompts per admission
+        # replica crosses rerole_high_ratio, and back below
+        # rerole_low_ratio; the band plus rerole_cooldown_s between
+        # flips is the hysteresis that stops role flapping
+        "rerole": (bool, False),
+        "rerole_high_ratio": (float, 4.0),
+        "rerole_low_ratio": (float, 1.0),
+        "rerole_cooldown_s": (float, 10.0),
+        "rerole_interval_s": (float, 0.5),
     },
     "batcher": {
         "window_ms": (float, 50.0),
@@ -222,6 +258,37 @@ HOT_RELOADABLE = {
     ("queue", "request_timeout_s"),
     ("server", "strategy"),
 }
+
+
+def parse_tenant_weights(spec: str) -> Dict[str, float]:
+    """Parse ``queue.tenant_weights`` ("tenantA=2,tenantB=1") into the
+    weight map core/queue.py's DRR dequeue uses. Raises ConfigError on
+    malformed entries or non-positive weights."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, value = part.partition("=")
+        name = name.strip()
+        if not sep or not name:
+            raise ConfigError(
+                f"queue.tenant_weights: {part!r} is not tenant=weight"
+            )
+        try:
+            weight = float(value)
+        except ValueError:
+            raise ConfigError(
+                f"queue.tenant_weights: weight {value!r} for {name!r} "
+                "is not a number"
+            ) from None
+        if weight <= 0:
+            raise ConfigError(
+                f"queue.tenant_weights: weight for {name!r} must be "
+                "positive"
+            )
+        out[name] = weight
+    return out
 
 
 def _defaults() -> Dict[str, Dict[str, Any]]:
@@ -355,6 +422,8 @@ class ServerConfig:
             low_watermark=q["low_watermark"],
             request_timeout_s=q["request_timeout_s"],
             max_queue_size=q["max_queue_size"],
+            tenant_fairness=q["tenant_fairness"],
+            tenant_weights=parse_tenant_weights(q["tenant_weights"]),
         )
 
     def batcher_config(self) -> BatcherConfig:
@@ -395,6 +464,29 @@ class ServerConfig:
             stream=d["stream"],
             chunk_pages=d["chunk_pages"],
             wire_quant=d["wire_quant"],
+        )
+
+    def fleet_settings(self):
+        """Fleet control-plane knobs (serving/fleet.py FleetSettings)."""
+        from distributed_inference_server_tpu.serving.fleet import (
+            FleetSettings,
+        )
+
+        f = self.raw["fleet"]
+        return FleetSettings(
+            enabled=f["enabled"],
+            host=f["host"],
+            port=f["port"],
+            connect=f["connect"],
+            member_id=f["member_id"],
+            heartbeat_interval_s=f["heartbeat_interval_s"],
+            suspect_after_s=f["suspect_after_s"],
+            dead_after_s=f["dead_after_s"],
+            rerole=f["rerole"],
+            rerole_high_ratio=f["rerole_high_ratio"],
+            rerole_low_ratio=f["rerole_low_ratio"],
+            rerole_cooldown_s=f["rerole_cooldown_s"],
+            rerole_interval_s=f["rerole_interval_s"],
         )
 
     def fetch_costs(self):
@@ -529,6 +621,41 @@ class ServerConfig:
             raise ConfigError("cache.fetch_page_cost must be >= 0")
         if r["cache"]["fetch_load_cost"] < 0:
             raise ConfigError("cache.fetch_load_cost must be >= 0")
+        # per-tenant fairness: weights parse + positivity
+        parse_tenant_weights(r["queue"]["tenant_weights"])
+        # fleet control plane (serving/fleet.py)
+        f = r["fleet"]
+        if f["heartbeat_interval_s"] <= 0:
+            raise ConfigError("fleet.heartbeat_interval_s must be positive")
+        if f["suspect_after_s"] <= f["heartbeat_interval_s"]:
+            raise ConfigError(
+                "fleet.suspect_after_s must exceed "
+                "fleet.heartbeat_interval_s (one missed beat is jitter, "
+                "not suspicion)"
+            )
+        if f["dead_after_s"] <= f["suspect_after_s"]:
+            raise ConfigError(
+                "fleet.dead_after_s must exceed fleet.suspect_after_s"
+            )
+        if not (0 <= f["port"] < 65536):
+            raise ConfigError("fleet.port must be in [0, 65536)")
+        if f["connect"]:
+            from distributed_inference_server_tpu.serving.fleet import (
+                parse_connect,
+            )
+
+            parse_connect(f["connect"])
+        if f["rerole_low_ratio"] >= f["rerole_high_ratio"]:
+            raise ConfigError(
+                "fleet.rerole_low_ratio must be below "
+                "fleet.rerole_high_ratio (the hysteresis band)"
+            )
+        if f["rerole_low_ratio"] < 0:
+            raise ConfigError("fleet.rerole_low_ratio must be >= 0")
+        if f["rerole_cooldown_s"] < 0:
+            raise ConfigError("fleet.rerole_cooldown_s must be >= 0")
+        if f["rerole_interval_s"] <= 0:
+            raise ConfigError("fleet.rerole_interval_s must be positive")
 
     def hot_diff(self, other: "ServerConfig") -> Dict[tuple, Any]:
         """(section, key) -> new value for hot-reloadable keys that differ."""

@@ -912,9 +912,13 @@ class DisaggController:
         (health is deliberately ignored: a transiently unhealthy decode
         engine is worth the retry/fallback path, a topology with no
         decode replicas at all is not — prefill runners then admit
-        unified and skip the per-request serialize/fallback churn)."""
+        unified and skip the per-request serialize/fallback churn).
+        Remote fleet proxies (serving/remote_runner.py) do not count:
+        KV handoff needs a local import session, so a decode replica
+        reachable only over the fleet wire is not a handoff target."""
         return any(
             getattr(r, "role", "unified") == "decode"
+            and not getattr(r, "is_remote", False)
             for r in self.scheduler.engines()
         )
 

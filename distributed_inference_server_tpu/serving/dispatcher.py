@@ -48,10 +48,21 @@ def _make_queue(queue_config, force: Optional[bool] = None):
     tests/test_native.py): the native C++ queue (native/pqueue.cpp) when
     built — the admission hot path runs native, as in the reference's Rust
     serving layer — the Python tier otherwise. ``force``: None = auto,
-    True = native or raise, False = Python. The chosen tier is logged."""
+    True = native or raise, False = Python. The chosen tier is logged.
+    Per-tenant fairness (``queue.tenant_fairness``) forces the Python
+    tier — the native queue has no tenant lanes."""
     import logging
 
     log = logging.getLogger(__name__)
+    if queue_config is not None and queue_config.tenant_fairness:
+        if force is True:
+            raise RuntimeError(
+                "native_queue=True is incompatible with "
+                "queue.tenant_fairness (the native tier has no tenant "
+                "lanes)"
+            )
+        log.info("request queue: Python tier (tenant fairness on)")
+        return PriorityQueueManager(queue_config)
     if force is not False:
         from distributed_inference_server_tpu import native
 
@@ -186,11 +197,14 @@ class Dispatcher:
         if self.reject_low_priority and priority is Priority.LOW:
             raise QueueFull()
         self.queue.enqueue(
-            QueuedRequest(id=request.request_id, data=request, priority=priority)
+            QueuedRequest(id=request.request_id, data=request,
+                          priority=priority,
+                          tenant=getattr(request, "tenant", "") or "default")
         )
         if self.metrics:
             d = self.queue.queue_depth()
             self.metrics.set_queue_depth(d.high, d.normal, d.low)
+            self._publish_tenant_depths()
 
     def redispatch(self, request: ServerRequest, from_engine: str,
                    reason: str) -> bool:
@@ -345,6 +359,13 @@ class Dispatcher:
         if self.metrics:
             d = self.queue.queue_depth()
             self.metrics.set_queue_depth(d.high, d.normal, d.low)
+            self._publish_tenant_depths()
+
+    def _publish_tenant_depths(self) -> None:
+        """Per-tenant queue occupancy gauge (queue_tenant_depth). The
+        native tier has no tenant lanes, hence the hasattr gate."""
+        if hasattr(self.queue, "tenant_depths"):
+            self.metrics.set_tenant_depths(self.queue.tenant_depths())
 
     def _submit_group(self, runner: Optional[EngineRunner],
                       requests: List[ServerRequest]) -> None:

@@ -54,6 +54,19 @@ Point catalog (the authoritative list lives in docs/RESILIENCE.md):
 ``sched.fetch_decision``  flag: force the cache_aware cost model to pick
                         FETCH when a fetch option exists (drives the
                         peer-fetch path deterministically under chaos)
+``fleet.heartbeat``     a member's heartbeat is dropped before the
+                        registry applies it (the partition model: the
+                        member ages alive -> suspect -> dead while its
+                        process lives on)
+``fleet.submit``        a forwarded FleetSubmit dies — on the registry
+                        host's wire (hit 1 per request) or as a worker
+                        crash on receipt (the member drops the
+                        connection and serves nothing); either way the
+                        request takes the crash-safe redispatch path
+``sched.rerole``        flag: force the RoleBalancer's rebalance signal
+                        high for one evaluation (drives role flips
+                        deterministically; hysteresis still bounds the
+                        actual flip rate)
 ======================  ====================================================
 """
 

@@ -1,0 +1,864 @@
+"""Multi-host fleet control plane: federated engine registry, the fleet
+wire, and dynamic role rebalancing (docs/FLEET.md).
+
+Everything below the serving spine so far scaled within one process:
+``server.engine_roles`` builds local runners and the dispatcher routes
+against one in-process fleet snapshot. This subsystem federates it:
+
+- **FleetRegistry** — membership truth for the whole fleet. Worker
+  processes join by dialing the registry host and heartbeating
+  (``FleetHeartbeat`` = member id + its full ``EngineStatus`` replica
+  set, digests included, over the protowire codec); the registry ages
+  members out on missed beats through an ``alive -> suspect -> dead``
+  state machine and feeds every consumer — scheduler routing, metrics,
+  ``/server/stats`` — the merged local+remote snapshot.
+- **the fleet wire** — one duplex TCP connection per member carrying
+  length-delimited protowire frames (u32 payload length, u8 kind, the
+  encoded message): ``FleetHeartbeat`` and ``FleetEvent`` flow worker →
+  registry host, ``FleetSubmit`` flows back. ``FleetServer`` owns the
+  listener and one reader thread per member session; each heartbeat
+  registers/refreshes a ``RemoteRunner`` proxy per remote engine
+  (serving/remote_runner.py) in the scheduler, so the entire existing
+  dispatch spine — strategies, cache_aware cost model, redispatch —
+  routes remote replicas with zero special cases.
+- **RoleBalancer** — dynamic role rebalancing: when the fleet's prompt
+  queue deepens past ``fleet.rerole_high_ratio`` (queued + waiting
+  prompts per admission-capable replica), one ``unified`` engine
+  re-roles to ``prefill`` (the disagg machinery makes the flip a single
+  attribute write — the next admission batch simply parks its prefills
+  for migration); it flips back once the signal drops below
+  ``fleet.rerole_low_ratio``. Two-sided hysteresis (signal band + a
+  flip cooldown) keeps an oscillating queue from flapping roles — the
+  ``rerole_flap`` chaos scenario pins that. The balancer only restores
+  engines IT flipped, so an operator's static topology is never
+  rewritten.
+
+Failure semantics (docs/RESILIENCE.md): a dead member's ``RemoteRunner``
+proxies map remote death onto the existing crash-safe redispatch path —
+zero-token in-flight requests re-dispatch exactly once onto healthy
+replicas, mid-stream requests fail fast as ``engine_crashed``. Fault
+points: ``fleet.heartbeat`` (registry ingest drops the beat — the
+partition model), ``fleet.submit`` (the forwarded submit dies on the
+wire / the worker crashes on receipt), ``sched.rerole`` (flag: forces
+the rebalance signal high for one evaluation — the chaos lever that
+drives reroles deterministically).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from distributed_inference_server_tpu.core.errors import ConfigError
+from distributed_inference_server_tpu.serving import faults, protowire
+from distributed_inference_server_tpu.serving.metrics import (
+    EngineStatus,
+    MetricsCollector,
+)
+
+logger = logging.getLogger(__name__)
+
+MEMBER_ALIVE = "alive"
+MEMBER_SUSPECT = "suspect"
+MEMBER_DEAD = "dead"
+MEMBER_STATES = (MEMBER_ALIVE, MEMBER_SUSPECT, MEMBER_DEAD)
+
+
+@dataclass(frozen=True)
+class FleetSettings:
+    """Knobs of the fleet control plane (config section ``fleet``)."""
+
+    enabled: bool = False
+    host: str = "127.0.0.1"
+    port: int = 0  # registry listener; 0 = ephemeral (tests/smoke)
+    connect: str = ""  # worker mode: "host:port" of the registry host
+    member_id: str = ""  # worker identity; "" = derived host:pid
+    heartbeat_interval_s: float = 0.5
+    suspect_after_s: float = 2.0
+    dead_after_s: float = 5.0
+    rerole: bool = False
+    rerole_high_ratio: float = 4.0
+    rerole_low_ratio: float = 1.0
+    rerole_cooldown_s: float = 10.0
+    rerole_interval_s: float = 0.5
+    # dead members are kept for observability, then pruned: every worker
+    # restart mints a new host:pid identity, so without eviction the
+    # member table (and fleet_members{state="dead"}) grows forever
+    dead_retention_s: float = 300.0
+
+
+# ---------------------------------------------------------------------------
+# The fleet wire: length-delimited protowire frames over one TCP stream
+# ---------------------------------------------------------------------------
+
+FRAME_KINDS: Dict[int, str] = {
+    1: "FleetHeartbeat",
+    2: "FleetSubmit",
+    3: "FleetEvent",
+}
+_KIND_BY_NAME = {name: kind for kind, name in FRAME_KINDS.items()}
+
+#: a fleet frame is control-plane small (statuses, token events, prompt
+#: ids) — anything bigger is a torn/foreign stream, not a real frame
+MAX_FRAME_BYTES = 16 * 1024 * 1024
+
+
+class FleetWireError(RuntimeError):
+    """A malformed frame on the fleet wire (foreign protocol, torn
+    stream, oversized payload). The session treats it as member death."""
+
+
+def send_frame(sock: socket.socket, name: str, obj: Dict[str, Any]) -> None:
+    """Encode ``obj`` as message ``name`` and write one frame. Callers
+    serialize sends per socket themselves (one lock per session)."""
+    payload = protowire.encode(name, obj)
+    sock.sendall(struct.pack(">IB", len(payload), _KIND_BY_NAME[name])
+                 + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None  # orderly EOF
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """Read one frame; returns ``(message_name, decoded_dict)`` or None
+    on EOF. Raises FleetWireError on a malformed frame."""
+    header = _recv_exact(sock, 5)
+    if header is None:
+        return None
+    length, kind = struct.unpack(">IB", header)
+    name = FRAME_KINDS.get(kind)
+    if name is None or length > MAX_FRAME_BYTES:
+        raise FleetWireError(f"bad fleet frame (kind={kind}, len={length})")
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    try:
+        return name, protowire.decode(name, payload)
+    except Exception as e:  # noqa: BLE001 — wire fault domain
+        raise FleetWireError(f"undecodable {name} frame: {e}") from e
+
+
+def status_to_wire(s: EngineStatus) -> Dict[str, Any]:
+    """EngineStatus -> FleetHeartbeat wire dict (the digest travels so
+    the registry host can score remote prefix matches)."""
+    host = s.host_tier or {}
+    return {
+        "engine_id": s.engine_id,
+        "healthy": s.healthy,
+        "active_requests": s.active_requests,
+        "waiting_requests": s.waiting_requests,
+        "total_processed": s.total_processed,
+        "memory_used_pages": s.memory_used_pages,
+        "memory_total_pages": s.memory_total_pages,
+        "role": s.role or "unified",
+        "pages_cached": s.pages_cached,
+        "prefix_digest": sorted(int(h) for h in (s.prefix_digest or ())),
+        "page_size": s.page_size,
+        "digest_depth": s.digest_depth,
+        "host_tier_bytes": host.get("bytes", 0),
+        "host_tier_pages": host.get("pages", 0),
+    }
+
+
+def status_from_wire(d: Dict[str, Any], member_id: str) -> EngineStatus:
+    """Wire dict -> EngineStatus namespaced under ``member_id`` (the
+    proxy id the scheduler routes on: ``<member>:<engine>``)."""
+    host = None
+    if d.get("host_tier_bytes") or d.get("host_tier_pages"):
+        host = {"bytes": d.get("host_tier_bytes", 0),
+                "pages": d.get("host_tier_pages", 0), "hit_pages": 0}
+    return EngineStatus(
+        engine_id=f"{member_id}:{d.get('engine_id', '')}",
+        healthy=bool(d.get("healthy")),
+        active_requests=d.get("active_requests", 0),
+        waiting_requests=d.get("waiting_requests", 0),
+        total_processed=d.get("total_processed", 0),
+        memory_used_pages=d.get("memory_used_pages", 0),
+        memory_total_pages=d.get("memory_total_pages", 0),
+        pages_cached=d.get("pages_cached", 0),
+        role=d.get("role") or "unified",
+        prefix_digest=frozenset(d.get("prefix_digest") or ()),
+        page_size=d.get("page_size", 0),
+        digest_depth=d.get("digest_depth", 0),
+        host_tier=host,
+        remote=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Federated engine registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FleetMember:
+    """One worker process as the registry sees it. Mutated only under
+    the registry's lock; ``snapshot()`` hands out copies."""
+
+    member_id: str
+    state: str = MEMBER_ALIVE
+    last_beat: float = field(default_factory=time.monotonic)
+    beats: int = 0
+    engines: List[EngineStatus] = field(default_factory=list)
+
+    def snapshot(self, now: float) -> Dict[str, Any]:
+        return {
+            "member_id": self.member_id,
+            "state": self.state,
+            "last_beat_age_s": round(now - self.last_beat, 3),
+            "beats": self.beats,
+            "engines": {s.engine_id: s.role for s in self.engines},
+        }
+
+
+class FleetRegistry:
+    """Membership truth: heartbeat ingest + the alive/suspect/dead state
+    machine. Thread-safe — beats arrive on member-session reader
+    threads, the sweeper ages members out, and routing snapshots read
+    from the dispatcher thread. State-change callbacks run OUTSIDE the
+    lock (they unregister runners / fail requests — lock-heavy work)."""
+
+    def __init__(
+        self,
+        settings: Optional[FleetSettings] = None,
+        metrics: Optional[MetricsCollector] = None,
+        on_state_change: Optional[Callable[[str, str, str], None]] = None,
+    ):
+        self.settings = settings or FleetSettings()
+        self.metrics = metrics
+        self.on_state_change = on_state_change
+        self._members: Dict[str, FleetMember] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- ingest (member-session reader threads) ----------------------------
+
+    def observe(self, member_id: str,
+                engines: List[EngineStatus]) -> Optional[str]:
+        """Ingest one heartbeat. Returns the member's PREVIOUS state (so
+        the caller can re-register runners on a rejoin), or None when the
+        beat was dropped by the ``fleet.heartbeat`` fault point — the
+        partition model: the wire delivered it, the registry never saw
+        it."""
+        try:
+            faults.fire("fleet.heartbeat")
+        except faults.InjectedFault:
+            if self.metrics:
+                self.metrics.record_fleet_heartbeat("dropped")
+            return None
+        transition = None
+        created = False
+        with self._lock:
+            member = self._members.get(member_id)
+            if member is None:
+                member = self._members[member_id] = FleetMember(member_id)
+                created = True
+                # the session treats a first join like a rejoin (fresh
+                # proxies, clean slate), but it is NOT a revival for
+                # metrics/callbacks — nothing existed to revive
+                prev = MEMBER_DEAD
+            else:
+                prev = member.state
+            member.last_beat = time.monotonic()
+            member.beats += 1
+            member.engines = list(engines)
+            member.state = MEMBER_ALIVE
+            if not created and prev != MEMBER_ALIVE:
+                transition = (member_id, prev, MEMBER_ALIVE)
+        if self.metrics:
+            self.metrics.record_fleet_heartbeat(
+                "rejoin" if transition else "ok")
+            self._publish_gauge()
+        if transition and self.on_state_change:
+            self.on_state_change(*transition)
+        return prev
+
+    def disconnect(self, member_id: str) -> None:
+        """Connection death: faster truth than beat aging — the member
+        is dead NOW (its in-flight requests must redispatch, not wait
+        out the suspect window)."""
+        self._transition(member_id, MEMBER_DEAD)
+
+    # -- aging (sweeper thread) --------------------------------------------
+
+    def sweep(self, now: Optional[float] = None) -> List[Tuple[str, str, str]]:
+        """Age members on missed beats: alive -> suspect after
+        ``suspect_after_s``, suspect -> dead after ``dead_after_s``.
+        Returns the transitions applied."""
+        now = time.monotonic() if now is None else now
+        transitions: List[Tuple[str, str, str]] = []
+        pruned = False
+        with self._lock:
+            for member in list(self._members.values()):
+                age = now - member.last_beat
+                if (member.state == MEMBER_ALIVE
+                        and age > self.settings.suspect_after_s):
+                    transitions.append(
+                        (member.member_id, member.state, MEMBER_SUSPECT))
+                    member.state = MEMBER_SUSPECT
+                if (member.state == MEMBER_SUSPECT
+                        and age > self.settings.dead_after_s):
+                    transitions.append(
+                        (member.member_id, member.state, MEMBER_DEAD))
+                    member.state = MEMBER_DEAD
+                if (member.state == MEMBER_DEAD
+                        and age > (self.settings.dead_after_s
+                                   + self.settings.dead_retention_s)):
+                    # restarted workers mint fresh host:pid identities;
+                    # without eviction the dead set grows forever
+                    del self._members[member.member_id]
+                    pruned = True
+        if (transitions or pruned) and self.metrics:
+            self._publish_gauge()
+        if self.on_state_change:
+            for t in transitions:
+                self.on_state_change(*t)
+        return transitions
+
+    def _transition(self, member_id: str, new_state: str) -> None:
+        with self._lock:
+            member = self._members.get(member_id)
+            if member is None or member.state == new_state:
+                return
+            prev = member.state
+            member.state = new_state
+        if self.metrics:
+            self._publish_gauge()
+        if self.on_state_change:
+            self.on_state_change(member_id, prev, new_state)
+
+    def _publish_gauge(self) -> None:
+        with self._lock:
+            counts = {state: 0 for state in MEMBER_STATES}
+            for member in self._members.values():
+                counts[member.state] += 1
+        self.metrics.set_fleet_members(counts)
+
+    # -- snapshots (any thread) --------------------------------------------
+
+    def member_state(self, member_id: str) -> Optional[str]:
+        with self._lock:
+            member = self._members.get(member_id)
+            return member.state if member else None
+
+    def members(self) -> List[Dict[str, Any]]:
+        now = time.monotonic()
+        with self._lock:
+            return [m.snapshot(now) for m in self._members.values()]
+
+    def stats(self) -> Dict[str, Any]:
+        """The ``fleet`` block of ``/server/stats``: members with state
+        and last-beat age (the role map and rebalance history ride in
+        from the server's balancer)."""
+        members = self.members()
+        counts = {state: 0 for state in MEMBER_STATES}
+        for m in members:
+            counts[m["state"]] += 1
+        return {"members": members, "member_counts": counts}
+
+    # -- sweeper lifecycle -------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        # lifecycle handle: start/stop are orchestrator calls
+        # distlint: ignore[DL008]
+        self._thread = threading.Thread(
+            target=self._sweep_loop, name="fleet-registry-sweep", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    def _sweep_loop(self) -> None:
+        # sweep at heartbeat cadence: aging resolution finer than the
+        # suspect window costs nothing and keeps detection < 1 interval
+        while not self._stop.wait(self.settings.heartbeat_interval_s):
+            try:
+                self.sweep()
+            except Exception:  # noqa: BLE001 — sweeper must stay alive
+                logger.exception("fleet registry sweep failed; retrying")
+
+
+# ---------------------------------------------------------------------------
+# Registry-host listener: member sessions feeding the registry
+# ---------------------------------------------------------------------------
+
+
+class _MemberSession:
+    """One accepted member connection on the registry host. The reader
+    thread owns the inbound half (heartbeats, events); sends are
+    serialized by ``_send_lock`` (RemoteRunner submits arrive from the
+    dispatcher and redispatch paths concurrently)."""
+
+    def __init__(self, server: "FleetServer", sock: socket.socket,
+                 peer: str):
+        self.server = server
+        self.sock = sock
+        self.peer = peer
+        self.member_id: Optional[str] = None
+        # engine_id (member-local) -> RemoteRunner proxy; written on the
+        # reader thread, read by close/detach paths — guarded by _lock
+        self.runners: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._closed = False
+
+    def send(self, name: str, obj: Dict[str, Any]) -> None:
+        with self._send_lock:
+            if self._closed:
+                raise FleetWireError("member session closed")
+            send_frame(self.sock, name, obj)
+
+    def run(self) -> None:
+        """Reader loop (one thread per session)."""
+        try:
+            while True:
+                frame = recv_frame(self.sock)
+                if frame is None:
+                    break
+                name, obj = frame
+                if name == "FleetHeartbeat":
+                    self._on_heartbeat(obj)
+                elif name == "FleetEvent":
+                    self._on_event(obj)
+                # FleetSubmit frames only flow host -> worker; one
+                # arriving here is a confused peer — ignore it
+        except (OSError, FleetWireError) as e:
+            logger.debug("fleet session %s reader ended: %s", self.peer, e)
+        finally:
+            self.close("fleet member connection lost")
+
+    def _on_heartbeat(self, obj: Dict[str, Any]) -> None:
+        member_id = obj.get("member_id") or self.peer
+        if self.member_id is None:
+            self.member_id = member_id
+            superseded = self.server._claim_member(member_id, self)
+            if superseded is not None:
+                # a reconnect replaced a half-dead session: fail the old
+                # proxies' in-flight (their connection cannot deliver
+                # events anymore) without killing the member
+                superseded.detach_runners(
+                    f"fleet member {member_id} reconnected on a new "
+                    "session")
+            logger.info("fleet member %s joined from %s", member_id,
+                        self.peer)
+        statuses = [status_from_wire(d, member_id)
+                    for d in obj.get("engines", [])]
+        prev = self.server.registry.observe(member_id, statuses)
+        if prev is None:
+            return  # beat dropped (fleet.heartbeat fault) — no refresh
+        self.server._refresh_runners(self, member_id, obj.get("engines", []),
+                                     statuses, rejoined=prev == MEMBER_DEAD)
+
+    def _on_event(self, obj: Dict[str, Any]) -> None:
+        with self._lock:
+            runner = self.runners.get(obj.get("engine_id", ""))
+        if runner is not None:
+            runner.on_event(obj)
+
+    def detach_runners(self, message: str) -> None:
+        """Unregister this member's proxies from the scheduler and fail
+        their in-flight requests onto the redispatch path. Two phases on
+        purpose: EVERY proxy leaves the routing set (and is marked
+        detached) before ANY request is failed — redispatching the first
+        proxy's requests must not land them on a dead sibling proxy of
+        the same member and burn the bounded redispatch budget there."""
+        with self._lock:
+            runners = list(self.runners.values())
+            self.runners.clear()
+        for runner in runners:
+            # identity-checked: a reconnect's fresh proxy registered
+            # under the same id must survive this session's late detach
+            self.server.scheduler.unregister_if(runner.engine_id, runner)
+            runner.mark_detached(message)
+        for runner in runners:
+            runner.fail_inflight(message)
+
+    def close(self, reason: str) -> None:
+        with self._send_lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        member = self.member_id
+        logger.info("fleet session %s (%s) closed: %s", self.peer,
+                    member or "pre-join", reason)
+        self.detach_runners(reason)
+        if member is not None and self.server._is_current(member, self):
+            # only the member's CURRENT session's death kills it — a
+            # superseded session's late EOF is just cleanup
+            self.server.registry.disconnect(member)
+        self.server._drop_session(self)
+
+
+class FleetServer:
+    """The registry host's listener: accepts member connections, feeds
+    heartbeats to the registry, and materializes one RemoteRunner proxy
+    per remote engine in the scheduler so the whole dispatch spine
+    routes the federated fleet with no special cases."""
+
+    def __init__(
+        self,
+        registry: FleetRegistry,
+        scheduler,
+        settings: Optional[FleetSettings] = None,
+        metrics: Optional[MetricsCollector] = None,
+        redispatch: Optional[Callable] = None,
+    ):
+        self.registry = registry
+        self.scheduler = scheduler
+        self.settings = settings or FleetSettings()
+        self.metrics = metrics
+        self.redispatch = redispatch
+        self._sessions: List[_MemberSession] = []
+        # member_id -> its CURRENT session: a reconnect replaces the
+        # entry, so the superseded session's late EOF can neither kill
+        # the member nor detach the new session's runners
+        self._by_member: Dict[str, _MemberSession] = {}
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self.bound_port: int = 0
+        registry.on_state_change = self._on_member_state
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.settings.host, self.settings.port))
+        sock.listen(16)
+        self._sock = sock
+        self.bound_port = sock.getsockname()[1]
+        self._stopping = False
+        # lifecycle handle  # distlint: ignore[DL008]
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="fleet-accept", daemon=True
+        )
+        self._thread.start()
+        self.registry.start()
+        logger.info("fleet registry listening on %s:%d", self.settings.host,
+                    self.bound_port)
+
+    def stop(self) -> None:
+        self._stopping = True
+        self.registry.stop()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        with self._lock:
+            sessions = list(self._sessions)
+        for session in sessions:
+            session.close("fleet server shutting down")
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            session = _MemberSession(self, conn, f"{addr[0]}:{addr[1]}")
+            with self._lock:
+                self._sessions.append(session)
+            threading.Thread(
+                target=session.run,
+                name=f"fleet-session-{addr[0]}:{addr[1]}", daemon=True,
+            ).start()
+
+    def _claim_member(self, member_id: str,
+                      session: _MemberSession) -> Optional[_MemberSession]:
+        """Make ``session`` the member's current session; returns the
+        session it superseded (a reconnect), if any."""
+        with self._lock:
+            prev = self._by_member.get(member_id)
+            self._by_member[member_id] = session
+            return prev if prev is not session else None
+
+    def _is_current(self, member_id: str, session: _MemberSession) -> bool:
+        with self._lock:
+            return self._by_member.get(member_id) is session
+
+    def _drop_session(self, session: _MemberSession) -> None:
+        with self._lock:
+            try:
+                self._sessions.remove(session)
+            except ValueError:
+                pass
+            if (session.member_id is not None
+                    and self._by_member.get(session.member_id) is session):
+                self._by_member.pop(session.member_id, None)
+
+    # -- runner materialization (session reader threads) -------------------
+
+    def _refresh_runners(self, session: _MemberSession, member_id: str,
+                         wire_engines: List[Dict[str, Any]],
+                         statuses: List[EngineStatus],
+                         rejoined: bool) -> None:
+        from distributed_inference_server_tpu.serving.remote_runner import (
+            RemoteRunner,
+        )
+
+        by_local_id = {d.get("engine_id", ""): s
+                       for d, s in zip(wire_engines, statuses)}
+        with session._lock:
+            if session._closed:
+                return
+            stale = set(session.runners) - set(by_local_id)
+            if rejoined:
+                # dead->alive: the death path detached the old proxies;
+                # fresh ones own a clean in-flight map
+                stale |= set(session.runners)
+            gone = [(eid, session.runners.pop(eid)) for eid in stale]
+            for local_id, status in by_local_id.items():
+                runner = session.runners.get(local_id)
+                if runner is None:
+                    runner = RemoteRunner(
+                        engine_id=status.engine_id,
+                        local_engine_id=local_id,
+                        send=session.send,
+                        metrics=self.metrics,
+                    )
+                    runner.redispatch = self.redispatch
+                    session.runners[local_id] = runner
+                    self.scheduler.register(runner)
+                    logger.info("fleet: registered remote engine %s "
+                                "(role=%s)", status.engine_id, status.role)
+                elif self.scheduler.get(runner.engine_id) is not runner:
+                    # a superseded session's late detach (or anything
+                    # else) evicted our registration — heal it, or the
+                    # engine silently takes no traffic while alive
+                    self.scheduler.register(runner)
+                runner.update_status(status)
+        for _eid, runner in gone:
+            self.scheduler.unregister_if(runner.engine_id, runner)
+            runner.detach("remote engine left the member's heartbeat")
+
+    # -- member state transitions (sweeper / reader threads) ---------------
+
+    def _on_member_state(self, member_id: str, old: str, new: str) -> None:
+        logger.warning("fleet member %s: %s -> %s", member_id, old, new)
+        with self._lock:
+            session = self._by_member.get(member_id)
+        if session is None:
+            return
+        if new == MEMBER_DEAD:
+            # remote death maps onto the crash-safe redispatch path:
+            # zero-token in-flight requests move to healthy replicas
+            # exactly once, mid-stream ones fail fast (RESILIENCE.md)
+            session.detach_runners(
+                f"fleet member {member_id} dead (missed heartbeats)")
+        elif new == MEMBER_SUSPECT:
+            with session._lock:
+                runners = list(session.runners.values())
+            for runner in runners:
+                runner.set_member_state(MEMBER_SUSPECT)
+        elif new == MEMBER_ALIVE and old == MEMBER_SUSPECT:
+            with session._lock:
+                runners = list(session.runners.values())
+            for runner in runners:
+                runner.set_member_state(MEMBER_ALIVE)
+        # dead -> alive rejoin is handled by the heartbeat path, which
+        # materializes fresh proxies (rejoined=True)
+
+
+# ---------------------------------------------------------------------------
+# Dynamic role rebalancing
+# ---------------------------------------------------------------------------
+
+
+class RoleBalancer:
+    """Flips ``unified`` engines to ``prefill`` when the fleet's prompt
+    queue deepens, and back when it drains — with two-sided hysteresis
+    (a signal band plus a flip cooldown) so an oscillating queue cannot
+    flap roles. Only engines the balancer itself flipped are ever
+    restored; operator-configured roles are never rewritten."""
+
+    def __init__(self, scheduler, dispatcher,
+                 settings: Optional[FleetSettings] = None,
+                 metrics: Optional[MetricsCollector] = None):
+        self.scheduler = scheduler
+        self.dispatcher = dispatcher
+        self.settings = settings or FleetSettings()
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._flipped: Dict[str, float] = {}  # engine_id -> flip time
+        self._last_flip = 0.0
+        self._last_signal = 0.0
+        self._history: Deque[Dict[str, Any]] = deque(maxlen=64)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- the decision ------------------------------------------------------
+
+    def signal(self) -> float:
+        """Fleet prompt pressure: queued + engine-waiting prompts per
+        healthy admission-capable (prefill/unified) replica."""
+        statuses = self.scheduler.statuses()
+        admission = [s for s in statuses if s.healthy
+                     and s.role in ("prefill", "unified")]
+        waiting = sum(s.waiting_requests for s in admission)
+        depth = self.dispatcher.queue.total_depth()
+        return (depth + waiting) / max(1, len(admission))
+
+    def evaluate(self, now: Optional[float] = None) -> Optional[str]:
+        """One rebalance decision; returns the flip direction applied
+        ("to_prefill" / "to_unified") or None. At most one engine flips
+        per evaluation, and never within ``rerole_cooldown_s`` of the
+        previous flip — that cooldown IS the temporal hysteresis the
+        ``rerole_flap`` chaos scenario pins."""
+        if not self.settings.rerole:
+            return None
+        now = time.monotonic() if now is None else now
+        statuses = self.scheduler.statuses()
+        # gates the to_prefill direction ONLY: restores must still run
+        # with the decode fleet gone, or a balancer-flipped engine would
+        # be stuck in the prefill role forever. LOCAL decode only:
+        # remote replicas are not KV handoff targets (disagg.py), so
+        # remote decode capacity cannot make a flip pay
+        has_decode = any(
+            s.healthy and s.role == "decode"
+            and not getattr(s, "remote", False)
+            for s in statuses
+        )
+        sig = self.signal()
+        if faults.flag("sched.rerole"):
+            # chaos lever: force the raw signal high for one evaluation
+            # (drives the flip DESIRE deterministically; hysteresis and
+            # cooldown still bound the actual flips)
+            sig = max(sig, self.settings.rerole_high_ratio)
+        direction = None
+        with self._lock:
+            self._last_signal = sig
+            if now - self._last_flip < self.settings.rerole_cooldown_s:
+                return None
+            if sig >= self.settings.rerole_high_ratio and has_decode:
+                runner = self._pick_unified()
+                if runner is not None:
+                    runner.set_role("prefill")
+                    self._flipped[runner.engine_id] = now
+                    self._last_flip = now
+                    direction = "to_prefill"
+                    self._record(runner.engine_id, direction, sig)
+            elif sig <= self.settings.rerole_low_ratio and self._flipped:
+                runner = self._pick_flipped_locked()
+                if runner is not None:
+                    runner.set_role("unified")
+                    self._flipped.pop(runner.engine_id, None)
+                    self._last_flip = now
+                    direction = "to_unified"
+                    self._record(runner.engine_id, direction, sig)
+        if direction:
+            logger.info("fleet rerole %s (signal %.2f)", direction, sig)
+            if self.metrics:
+                self.metrics.record_rerole(direction)
+                self.metrics.set_engines_by_role(self._role_counts())
+        return direction
+
+    def _pick_unified(self):
+        candidates = [
+            r for r in self.scheduler.engines()
+            if r.role == "unified" and r.is_healthy()
+            and not getattr(r, "is_remote", False)
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: r.engine_id)
+
+    def _pick_flipped_locked(self):
+        for engine_id in sorted(self._flipped):
+            runner = self.scheduler.get(engine_id)
+            if runner is not None and runner.role == "prefill":
+                return runner
+            self._flipped.pop(engine_id, None)  # unregistered/re-roled
+        return None
+
+    def _record(self, engine_id: str, direction: str, sig: float) -> None:
+        self._history.append({
+            "engine_id": engine_id, "direction": direction,
+            "signal": round(sig, 3), "t": round(time.time(), 3),
+        })
+
+    def _role_counts(self) -> Dict[str, int]:
+        # LOCAL replicas only, matching the boot-time publisher
+        # (server.py uses DisaggController.role_counts over the static
+        # role list) — the gauge's meaning must not depend on which
+        # publisher wrote last
+        counts: Dict[str, int] = {}
+        for r in self.scheduler.engines():
+            if not getattr(r, "is_remote", False):
+                counts[r.role] = counts.get(r.role, 0) + 1
+        return counts
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "signal": round(self._last_signal, 3),
+                "flipped": sorted(self._flipped),
+                "history": list(self._history),
+            }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        # lifecycle handle  # distlint: ignore[DL008]
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-rerole", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.settings.rerole_interval_s):
+            try:
+                self.evaluate()
+            except Exception:  # noqa: BLE001 — balancer must stay alive
+                logger.exception("role rebalance evaluation failed")
+
+
+def parse_connect(connect: str) -> Tuple[str, int]:
+    """Parse ``fleet.connect`` ("host:port") for worker mode."""
+    host, sep, port = connect.rpartition(":")
+    if not sep or not host or not port.isdigit():
+        raise ConfigError(
+            f"fleet.connect must be host:port, got {connect!r}"
+        )
+    return host, int(port)

@@ -64,6 +64,17 @@ from distributed_inference_server_tpu.serving.streamer import (
 )
 
 
+def _tenant_of(obj: dict) -> str:
+    """Per-tenant fair admission key (core/queue.py DRR): the request
+    body's optional ``tenant`` field; absent/blank = "default". A
+    non-string value is coerced — admission fairness must never 400 a
+    request that validated."""
+    tenant = obj.get("tenant") if isinstance(obj, dict) else None
+    if not tenant:
+        return "default"
+    return str(tenant)[:128]
+
+
 def _error_to_api(message: str, code: str) -> ApiError:
     if code in ("request_timeout", "queue_timeout"):
         # queue_timeout: the dispatcher sweep expired the request before
@@ -123,6 +134,7 @@ class InferenceHandler:
         sink,
         priority: Priority,
         endpoint: str = "generate",
+        tenant: str = "default",
     ) -> RequestId:
         request_id = new_request_id()
         span = None
@@ -133,7 +145,8 @@ class InferenceHandler:
                 f"request.{endpoint}", request_id=str(request_id),
                 prompt_tokens=len(prompt_ids), priority=priority.name,
             )
-        req = ServerRequest(request_id, prompt_ids, params, sink, span=span)
+        req = ServerRequest(request_id, prompt_ids, params, sink, span=span,
+                            tenant=tenant)
         if self.metrics:
             self.metrics.request_started()
         try:
@@ -199,7 +212,8 @@ class InferenceHandler:
         ids, params, prio = self._parse_one(obj, chat=False)
         loop = asyncio.get_running_loop()
         sink = CollectingSink(loop)
-        request_id = self._submit(ids, params, sink, prio)
+        request_id = self._submit(ids, params, sink, prio,
+                                  tenant=_tenant_of(obj))
         text, reason, usage = await self._await_completion(sink, request_id)
         return GenerateResponse(
             id=f"cmpl-{request_id}",
@@ -219,7 +233,8 @@ class InferenceHandler:
         ids, params, prio = self._parse_one(obj, chat=False)
         loop = asyncio.get_running_loop()
         sink = StreamingSink(loop)
-        request_id = self._submit(ids, params, sink, prio)
+        request_id = self._submit(ids, params, sink, prio,
+                                  tenant=_tenant_of(obj))
         return request_id, self._finalize_stream(sink, request_id)
 
     async def _finalize_stream(self, sink: StreamingSink,
@@ -260,7 +275,8 @@ class InferenceHandler:
         ids, params, prio = self._parse_one(obj, chat=True)
         loop = asyncio.get_running_loop()
         sink = CollectingSink(loop)
-        request_id = self._submit(ids, params, sink, prio, endpoint="chat")
+        request_id = self._submit(ids, params, sink, prio, endpoint="chat",
+                                  tenant=_tenant_of(obj))
         text, reason, usage = await self._await_completion(sink, request_id)
         return ChatResponse(
             id=f"chatcmpl-{request_id}",
@@ -283,7 +299,8 @@ class InferenceHandler:
         ids, params, prio = self._parse_one(obj, chat=True)
         loop = asyncio.get_running_loop()
         sink = StreamingSink(loop)
-        request_id = self._submit(ids, params, sink, prio, endpoint="chat")
+        request_id = self._submit(ids, params, sink, prio, endpoint="chat",
+                                  tenant=_tenant_of(obj))
         return request_id, self._finalize_stream(sink, request_id)
 
     # -- /v1 multi-choice fan-out ------------------------------------------
@@ -329,7 +346,9 @@ class InferenceHandler:
             for _ in range(n):
                 sink = make_sink()
                 rids.append(
-                    self._submit(ids, params, sink, prio, endpoint=endpoint)
+                    self._submit(ids, params, sink, prio,
+                                 endpoint=endpoint,
+                                 tenant=_tenant_of(obj))
                 )
                 sinks.append(sink)
         except ApiError:

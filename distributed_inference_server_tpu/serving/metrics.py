@@ -71,6 +71,12 @@ class EngineStatus:
     # host-tier prefix cache occupancy (engine.host_tier_stats()); None
     # when the tier is off
     host_tier: Any = None
+    # fleet control plane (serving/fleet.py): True for a RemoteRunner
+    # proxy's status reconstructed from a member heartbeat. Remote
+    # replicas take routed admissions but are excluded from paths that
+    # need a local engine object (KV handoff targets, peer-fetch
+    # sources/targets, health-loop restarts).
+    remote: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         d = {
@@ -88,6 +94,8 @@ class EngineStatus:
             d["speculation"] = self.speculation
         if self.host_tier is not None:
             d["host_tier"] = self.host_tier
+        if self.remote:
+            d["remote"] = True
         return d
 
 
@@ -349,6 +357,36 @@ class MetricsCollector:
             "dispatch (queue_timeout)",
             registry=r,
         )
+        # fleet control plane (serving/fleet.py; docs/FLEET.md): member
+        # liveness, heartbeat ingest outcomes, role rebalancing, and
+        # per-tenant queue occupancy
+        self.fleet_members = Gauge(
+            "fleet_members",
+            "Fleet members by registry state (alive = beating, suspect "
+            "= missed beats past fleet.suspect_after_s, dead = aged out "
+            "or connection lost)", ["state"],
+            registry=r,
+        )
+        self.fleet_heartbeats = Counter(
+            "fleet_heartbeats_total",
+            "Heartbeat ingest outcomes (ok = applied, rejoin = revived "
+            "a suspect/dead member, dropped = lost before the registry "
+            "— the fleet.heartbeat partition fault)", ["outcome"],
+            registry=r,
+        )
+        self.fleet_reroles = Counter(
+            "fleet_reroles_total",
+            "Dynamic role flips by the RoleBalancer (to_prefill = "
+            "prompt-queue pressure crossed fleet.rerole_high_ratio, "
+            "to_unified = it drained below fleet.rerole_low_ratio)",
+            ["direction"], registry=r,
+        )
+        self.queue_tenant_depth = Gauge(
+            "queue_tenant_depth",
+            "Queued requests per tenant (per-tenant fair admission, "
+            "queue.tenant_fairness)", ["tenant"],
+            registry=r,
+        )
 
         # snapshot internals
         self._total_requests = 0
@@ -377,6 +415,9 @@ class MetricsCollector:
         self._engine_restarts: Dict[str, int] = {}
         self._redispatches: Dict[str, int] = {}
         self._requests_expired = 0
+        self._fleet_heartbeats: Dict[str, int] = {}
+        self._fleet_reroles: Dict[str, int] = {}
+        self._tenants_seen: set = set()
 
     # -- recording ---------------------------------------------------------
 
@@ -560,6 +601,49 @@ class MetricsCollector:
         stable dotted label, e.g. "runner.sink_error")."""
         self.errors_total.labels(site=site).inc()
 
+    def set_fleet_members(self, counts: Dict[str, int]) -> None:
+        """Fleet members per registry state (serving/fleet.py): all
+        three states are always published so a dead member reads as
+        ``fleet_members{state="dead"} 1``, not a missing series."""
+        for state in ("alive", "suspect", "dead"):
+            self.fleet_members.labels(state=state).set(counts.get(state, 0))
+
+    def record_fleet_heartbeat(self, outcome: str) -> None:
+        """One heartbeat ingest: ok | rejoin | dropped."""
+        self.fleet_heartbeats.labels(outcome=outcome).inc()
+        with self._lock:
+            self._fleet_heartbeats[outcome] = (
+                self._fleet_heartbeats.get(outcome, 0) + 1
+            )
+
+    def record_rerole(self, direction: str) -> None:
+        """One dynamic role flip: to_prefill | to_unified."""
+        self.fleet_reroles.labels(direction=direction).inc()
+        with self._lock:
+            self._fleet_reroles[direction] = (
+                self._fleet_reroles.get(direction, 0) + 1
+            )
+
+    def set_tenant_depths(self, depths: Dict[str, int]) -> None:
+        """Per-tenant queue occupancy. A tenant that drained since the
+        last publish has its series REMOVED (after this call a scrape
+        simply doesn't see it) rather than kept at 0 forever — tenant is
+        a client-chosen string, so ever-seen bookkeeping would grow the
+        gauge write set and the /metrics payload without bound."""
+        with self._lock:
+            stale = self._tenants_seen - set(depths)
+            self._tenants_seen = set(depths)
+            # series add/remove under the collector lock: two
+            # concurrent publishes must not interleave a remove with
+            # the other's set for the same tenant
+            for tenant in stale:
+                try:
+                    self.queue_tenant_depth.remove(tenant)
+                except KeyError:
+                    pass
+            for tenant, depth in depths.items():
+                self.queue_tenant_depth.labels(tenant=tenant).set(depth)
+
     def set_engines_by_role(self, counts: Dict[str, int]) -> None:
         """Per-role replica counts (prefill / decode / unified gauges)."""
         for role in ("prefill", "decode", "unified"):
@@ -576,6 +660,15 @@ class MetricsCollector:
         self.spec_enabled.labels(engine_id=engine_id).set(
             1 if stats.get("enabled") else 0
         )
+
+    def fleet_counters(self) -> Dict[str, Any]:
+        """Heartbeat/rerole counter snapshot for the ``/server/stats``
+        fleet block (serving/server.py)."""
+        with self._lock:
+            return {
+                "heartbeats": dict(self._fleet_heartbeats),
+                "reroles": dict(self._fleet_reroles),
+            }
 
     # -- rendering ---------------------------------------------------------
 

@@ -186,6 +186,14 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         8: ("role", "string", "one"),
         # reclaimable refcount-0 prefix pages within memory_used_pages
         9: ("pages_cached", "uint32", "one"),
+        # fleet heartbeat payload (serving/fleet.py): the routing digest
+        # travels with the status so the registry host's cache_aware
+        # cost model can score a remote member's cached prefix chains
+        10: ("prefix_digest", "uint64", "rep"),
+        11: ("page_size", "uint32", "one"),
+        12: ("digest_depth", "uint32", "one"),
+        13: ("host_tier_bytes", "uint64", "one"),
+        14: ("host_tier_pages", "uint32", "one"),
     },
     "HealthResponse": {
         1: ("status", "string", "one"),
@@ -211,6 +219,42 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         1: ("token", "msg:TokenEvent.Token", "opt"),
         2: ("done", "msg:TokenEvent.Done", "opt"),
         3: ("error", "msg:TokenEvent.StreamError", "opt"),
+    },
+    # Multi-host fleet control plane (serving/fleet.py,
+    # serving/remote_runner.py; docs/FLEET.md): a worker member's
+    # heartbeat, the registry host's forwarded request, and the streamed
+    # result events — the three frame kinds of the fleet wire.
+    "FleetHeartbeat": {
+        1: ("member_id", "string", "one"),
+        2: ("seq", "uint64", "one"),
+        3: ("engines", "msg:EngineStatus", "rep"),
+    },
+    "FleetSubmit": {
+        1: ("request_id", "string", "one"),
+        2: ("engine_id", "string", "one"),
+        3: ("prompt_ids", "uint32", "rep"),
+        4: ("max_tokens", "uint32", "one"),
+        # double, not float: cross-host token identity needs the
+        # sampling params bit-exact (same rationale as KvHandoff)
+        5: ("temperature", "double", "one"),
+        6: ("top_p", "double", "one"),
+        7: ("stop_sequences", "string", "rep"),
+        8: ("tenant", "string", "one"),
+        9: ("abort", "bool", "one"),
+    },
+    "FleetEvent": {
+        1: ("request_id", "string", "one"),
+        2: ("engine_id", "string", "one"),
+        3: ("kind", "string", "one"),
+        4: ("token_id", "uint32", "opt"),
+        5: ("text", "string", "one"),
+        6: ("token_index", "uint32", "one"),
+        7: ("logprob", "float", "opt"),
+        8: ("finish_reason", "string", "one"),
+        9: ("prompt_tokens", "uint32", "one"),
+        10: ("completion_tokens", "uint32", "one"),
+        11: ("message", "string", "one"),
+        12: ("code", "string", "one"),
     },
     "ErrorDetail": {
         1: ("message", "string", "one"),

@@ -1,0 +1,580 @@
+"""Remote engine runners: the registry-host proxy and the worker-side
+executor of the fleet control plane (serving/fleet.py; docs/FLEET.md).
+
+``RemoteRunner`` satisfies the ``EngineRunner`` surface the serving
+spine routes on — submit / abort / status / active_count / audit /
+shutdown — by forwarding over the ``FleetSubmit`` / ``FleetEvent`` RPC
+pair (serving/inference.proto, protowire codec): a submit becomes one
+FleetSubmit frame per request on the member's session, and the session
+reader pumps FleetEvent frames back into the request's ResultSink. The
+scheduler cannot tell it from a local runner, so every existing policy
+— strategies, role restriction, the cache_aware cost model scoring the
+member's heartbeated digest — routes the federated fleet unchanged.
+
+Remote death maps onto the existing crash-safe redispatch path
+(docs/RESILIENCE.md ``_fail_all_of`` semantics): when the member goes
+dead (missed beats or connection loss) the proxy pops each in-flight
+request FIRST (exactly-once by construction), then zero-token requests
+re-dispatch exactly once through ``Dispatcher.redispatch`` while
+mid-stream requests fail fast with the distinct ``engine_crashed``
+code. A remote-side ``worker_failure`` event for a zero-token request
+is treated the same way — the remote fleet couldn't save it, this one
+still can.
+
+``FleetWorker`` is the other end: a worker process dials the registry
+host, heartbeats its full ``EngineStatus`` replica set (digests
+included), executes incoming FleetSubmit frames against its LOCAL
+runners through a sink that encodes FleetEvent frames back, and
+reconnects with backoff when the registry host bounces. The
+``fleet.submit`` fault point fires on both ends: on the proxy it models
+the forwarded submit dying on the wire, on the worker it models the
+member crashing on receipt (connection dropped, nothing served).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from distributed_inference_server_tpu.core.models import FinishReason, Usage
+from distributed_inference_server_tpu.engine.engine import SamplingParams
+from distributed_inference_server_tpu.serving import faults
+from distributed_inference_server_tpu.serving.fleet import (
+    FleetSettings,
+    MEMBER_ALIVE,
+    MEMBER_DEAD,
+    parse_connect,
+    recv_frame,
+    send_frame,
+    status_to_wire,
+)
+from distributed_inference_server_tpu.serving.metrics import (
+    EngineStatus,
+    MetricsCollector,
+)
+from distributed_inference_server_tpu.serving.runner import ServerRequest
+
+logger = logging.getLogger(__name__)
+
+
+class RemoteRunner:
+    """Registry-host proxy for one engine on a remote fleet member.
+
+    Thread-shape: ``submit``/``abort`` arrive from the dispatcher and
+    redispatch paths, ``on_event`` from the member session's reader
+    thread, ``detach`` from the reader/sweeper. The in-flight map uses
+    the same GIL-atomic pop-first exactly-once protocol as EngineRunner
+    (docs/RESILIENCE.md) — every terminal path pops before resolving."""
+
+    #: capability markers the rest of the spine keys on: remote proxies
+    #: are never health-loop restarted, never model-swapped, never
+    #: KV-handoff targets, and never scale_to victims
+    is_remote = True
+    supports_restart = False
+
+    def __init__(
+        self,
+        engine_id: str,
+        local_engine_id: str,
+        send: Callable[[str, Dict[str, Any]], None],
+        metrics: Optional[MetricsCollector] = None,
+    ):
+        """``engine_id`` is the fleet-namespaced proxy id
+        (``<member>:<engine>``); ``local_engine_id`` is what the member
+        itself calls the engine (what FleetSubmit frames carry);
+        ``send(name, obj)`` writes one frame on the member session and
+        raises when the transport is gone."""
+        self.engine_id = engine_id
+        self.local_engine_id = local_engine_id
+        self.metrics = metrics
+        self._send = send
+        # wired by the FleetServer to Dispatcher.redispatch
+        self.redispatch: Optional[Callable] = None
+        # pop-first exactly-once protocol, GIL-atomic dict ops
+        # (docs/RESILIENCE.md)  # distlint: ignore[DL008]
+        self._inflight: Dict[Any, ServerRequest] = {}
+        # serializes event delivery against failure: a partitioned-but-
+        # alive member can stream a late token concurrently with the
+        # sweeper failing/redispatching the same request — without this
+        # lock the dead member's token and the redispatched copy's
+        # stream could interleave on one sink
+        self._events_lock = threading.Lock()
+        self._status: Optional[EngineStatus] = None
+        # liveness flags: GIL-atomic scalar writes from the session
+        # reader and registry sweeper; readers (routing, status) tolerate
+        # one stale check — the registry re-publishes every beat, and
+        # dead is terminal for this proxy instance
+        # distlint: ignore[DL008]
+        self._member_state = MEMBER_ALIVE
+        self._detached = False
+        # distlint: ignore[DL008]
+        self._last_error: Optional[str] = None
+        self._total_processed = 0
+
+    @property
+    def role(self) -> str:
+        s = self._status
+        return s.role if s is not None else "unified"
+
+    # -- registry-side state (session reader / sweeper threads) ------------
+
+    def update_status(self, status: EngineStatus) -> None:
+        self._status = status
+
+    def set_member_state(self, state: str) -> None:
+        self._member_state = state
+
+    def mark_detached(self, message: str) -> None:
+        """Phase 1 of member death: drop out of the routing set
+        (is_healthy goes False) WITHOUT failing anything yet — the
+        session detaches every sibling proxy first so redispatch cannot
+        pick another runner of the same dead member."""
+        self._detached = True
+        self._member_state = MEMBER_DEAD
+        self._last_error = message
+
+    def fail_inflight(self, message: str) -> None:
+        """Phase 2: fail every in-flight request onto the crash-safe
+        redispatch path. Exactly once per request — pop-first, and a
+        detached proxy fails all later submits immediately."""
+        self._fail_all_of(list(self._inflight.values()), message)
+
+    def detach(self, message: str) -> None:
+        """The member died (or left): both phases for a lone proxy."""
+        self.mark_detached(message)
+        self.fail_inflight(message)
+
+    # -- EngineRunner surface ----------------------------------------------
+
+    def is_healthy(self) -> bool:
+        s = self._status
+        return (not self._detached
+                and self._member_state == MEMBER_ALIVE
+                and s is not None and s.healthy)
+
+    def status(self) -> EngineStatus:
+        s = self._status
+        if s is None:
+            return EngineStatus(
+                engine_id=self.engine_id, healthy=False, active_requests=0,
+                waiting_requests=0, total_processed=0, remote=True,
+            )
+        # overlay liveness and THIS host's view of in-flight load: the
+        # heartbeat is up to one interval stale, but requests this proxy
+        # forwarded are known-inflight right now
+        return dataclasses.replace(
+            s, healthy=self.is_healthy(),
+            active_requests=max(s.active_requests, len(self._inflight)),
+        )
+
+    def active_count(self) -> int:
+        return len(self._inflight)
+
+    def last_error(self) -> Optional[str]:
+        return self._last_error
+
+    def audit(self, timeout_s: float = 0.0) -> List[str]:
+        """Remote pools are audited by their own process; the proxy has
+        no allocator to conserve."""
+        return []
+
+    def evict_cache(self, target_frac: float,
+                    drop_host_tier: bool = False) -> None:
+        """Degradation-ladder no-op: the member's own ladder manages its
+        HBM pressure."""
+
+    def tokenizer(self):
+        return None
+
+    def shutdown(self, timeout: float = 0.0) -> None:
+        self.detach("fleet detach: registry host shutting down")
+
+    def submit(self, requests: Sequence[ServerRequest]) -> None:
+        reqs = list(requests)
+        with self._events_lock:
+            for r in reqs:
+                self._inflight[r.request_id] = r
+        if not self.is_healthy():
+            self._fail_all_of(
+                reqs, self._last_error or "fleet member unavailable")
+            return
+        try:
+            for r in reqs:
+                # forwarded submit dies on the wire (docs/RESILIENCE.md)
+                faults.fire("fleet.submit")
+                self._send("FleetSubmit", {
+                    "request_id": str(r.request_id),
+                    "engine_id": self.local_engine_id,
+                    "prompt_ids": [int(t) for t in r.prompt_ids],
+                    "max_tokens": r.params.max_tokens,
+                    "temperature": r.params.temperature,
+                    "top_p": r.params.top_p,
+                    "stop_sequences": list(r.params.stop_sequences),
+                    "tenant": getattr(r, "tenant", "") or "",
+                })
+        except Exception as e:  # noqa: BLE001 — transport fault domain
+            self._last_error = f"fleet submit failed: {e}"
+            # fail only THIS batch: already-sent requests are popped
+            # first, so any events the member still streams for them are
+            # dropped as orphans (the redispatched copy owns the sink)
+            self._fail_all_of(reqs, self._last_error)
+
+    def abort(self, request_id) -> None:
+        with self._events_lock:
+            self._inflight.pop(request_id, None)
+        try:
+            self._send("FleetSubmit", {
+                "request_id": str(request_id),
+                "engine_id": self.local_engine_id,
+                "abort": True,
+            })
+        except Exception as e:  # noqa: BLE001 — the member is dying
+            # anyway; its requests die with it
+            self._absorbed("abort_send", e)
+
+    # -- event pump (member session reader thread) -------------------------
+
+    def on_event(self, ev: Dict[str, Any]) -> None:
+        rid = ev.get("request_id", "")
+        kind = ev.get("kind")
+        if kind == "error":
+            # pop (the ownership transfer) under the events lock; the
+            # resolution — which may REDISPATCH, i.e. acquire other
+            # runners' state — runs outside it, so two dying members
+            # redispatching onto each other can never hold-and-wait
+            with self._events_lock:
+                req = (None if self._detached
+                       else self._inflight.pop(rid, None))
+            if req is not None:
+                self._resolve_error(req, ev.get("message", "remote error"),
+                                    ev.get("code") or "inference_failed")
+            return
+        with self._events_lock:
+            req = self._inflight.get(rid)
+            if req is None or self._detached:
+                return  # aborted / redispatched / dead: orphan event
+            try:
+                if kind == "token":
+                    if req.first_token_at is None:
+                        # single-owner handoff: a request is in exactly
+                        # one runner's in-flight map (pop-first), and
+                        # the events lock orders this write against
+                        # _fail_all_of's ownership snapshot
+                        # distlint: ignore[DL008]
+                        req.first_token_at = time.monotonic()
+                        if self.metrics:
+                            self.metrics.record_ttft(
+                                req.first_token_at - req.submitted_at)
+                    if ev.get("token_id") is not None and self.metrics:
+                        self.metrics.record_tokens(1)
+                    req.sink.on_token(ev.get("token_id"),
+                                      ev.get("text", ""),
+                                      ev.get("token_index", 0),
+                                      ev.get("logprob"))
+                elif kind == "done":
+                    if self._inflight.pop(rid, None) is None:
+                        return
+                    usage = Usage.of(ev.get("prompt_tokens", 0),
+                                     ev.get("completion_tokens", 0))
+                    try:
+                        reason = FinishReason(
+                            ev.get("finish_reason") or "stop")
+                    except ValueError:
+                        reason = FinishReason.STOP
+                    self._total_processed += 1
+                    req.sink.on_done(reason, usage)
+            except Exception as e:  # noqa: BLE001 — sink isolation
+                self._inflight.pop(rid, None)
+                self._absorbed("sink_error", e)
+
+    def _resolve_error(self, req: ServerRequest, message: str,
+                       code: str) -> None:
+        """A remote-side terminal error. A zero-token ``worker_failure``
+        means the member's own fleet ran out of capacity — THIS fleet
+        may still have some, so it takes the crash-safe redispatch path
+        before the error reaches the client."""
+        if (req.first_token_at is None and code == "worker_failure"
+                and self.redispatch is not None):
+            try:
+                if self.redispatch(req, self.engine_id, message):
+                    return  # the new owner resolves the sink
+            except Exception as e:  # noqa: BLE001 — hook isolation
+                self._absorbed("redispatch", e)
+        try:
+            req.sink.on_error(message, code)
+        except Exception as e:  # noqa: BLE001
+            self._absorbed("sink_error", e)
+
+    # -- failure (same contract as EngineRunner._fail_all_of) --------------
+
+    def _fail_all_of(self, reqs: Sequence[ServerRequest],
+                     message: str) -> None:
+        # ownership transfer under the events lock: once a request is
+        # popped here, a late event from the (possibly still-streaming)
+        # member can no longer reach its sink, and any token the member
+        # DID deliver landed before the pop — so the first_token_at
+        # snapshot below is the truth the redispatch decision needs.
+        # Resolution runs OUTSIDE the lock (redispatch may touch other
+        # runners — no cross-member hold-and-wait).
+        owned = []
+        with self._events_lock:
+            for req in reqs:
+                if self._inflight.pop(req.request_id, None) is None:
+                    continue  # another terminal path owns it
+                owned.append((req, req.first_token_at is None))
+        for req, zero_tokens in owned:
+            if zero_tokens and self.redispatch is not None:
+                try:
+                    if self.redispatch(req, self.engine_id, message):
+                        continue  # the new owner resolves the sink
+                except Exception as e:  # noqa: BLE001 — hook isolation
+                    self._absorbed("redispatch", e)
+            code = "worker_failure" if zero_tokens else "engine_crashed"
+            try:
+                req.sink.on_error(message, code)
+            except Exception as e:  # noqa: BLE001
+                self._absorbed("sink_error", e)
+
+    def _absorbed(self, site: str, exc: BaseException) -> None:
+        logger.debug("%s: absorbed error at %s: %s: %s", self.engine_id,
+                     site, type(exc).__name__, exc)
+        if self.metrics:
+            self.metrics.record_error(f"remote_runner.{site}")
+
+
+# ---------------------------------------------------------------------------
+# Worker side: heartbeat + submit executor
+# ---------------------------------------------------------------------------
+
+
+class _RemoteSink:
+    """ResultSink that encodes FleetEvent frames back to the registry
+    host. Runs on the worker's engine-runner threads; send failures are
+    absorbed — a dead registry connection means the host has already
+    failed the request onto its redispatch path, so there is no one to
+    tell."""
+
+    def __init__(self, worker: "FleetWorker", request_id: str,
+                 engine_id: str):
+        self._worker = worker
+        self._rid = request_id
+        self._eid = engine_id
+
+    def _event(self, obj: Dict[str, Any]) -> None:
+        obj["request_id"] = self._rid
+        obj["engine_id"] = self._eid
+        self._worker.send_event(obj)
+
+    def on_token(self, token_id, text, token_index, logprob=None) -> None:
+        ev = {"kind": "token", "text": text or "",
+              "token_index": token_index or 0}
+        if token_id is not None:
+            ev["token_id"] = int(token_id)
+        if logprob is not None:
+            ev["logprob"] = float(logprob)
+        self._event(ev)
+
+    def on_done(self, finish_reason, usage) -> None:
+        self._event({
+            "kind": "done",
+            "finish_reason": getattr(finish_reason, "value",
+                                     str(finish_reason)),
+            "prompt_tokens": getattr(usage, "prompt_tokens", 0),
+            "completion_tokens": getattr(usage, "completion_tokens", 0),
+        })
+
+    def on_error(self, message, code) -> None:
+        self._event({"kind": "error", "message": message or "",
+                     "code": code or "inference_failed"})
+
+
+class FleetWorker:
+    """Joins a fleet: dials the registry host, heartbeats the local
+    replica set, and serves forwarded requests against the local
+    runners. One duplex connection; reconnects with backoff when the
+    registry host bounces (a rejoin — the registry re-materializes
+    fresh proxies)."""
+
+    def __init__(self, scheduler, settings: FleetSettings,
+                 metrics: Optional[MetricsCollector] = None,
+                 member_id: Optional[str] = None):
+        """``scheduler`` is the worker's own AdaptiveScheduler (the
+        local runners to serve against)."""
+        self.scheduler = scheduler
+        self.settings = settings
+        self.metrics = metrics
+        import os
+
+        self.member_id = (member_id or settings.member_id
+                          or f"{socket.gethostname()}:{os.getpid()}")
+        self._sock: Optional[socket.socket] = None
+        # serializes frame writes: the heartbeat thread and every local
+        # runner thread's _RemoteSink share the socket
+        self._send_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._crashed = False  # injected fleet.submit crash: stay down
+        self._beat_thread: Optional[threading.Thread] = None
+        self._reader: Optional[threading.Thread] = None
+        self._seq = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, connect_timeout_s: float = 10.0) -> None:
+        self._connect(connect_timeout_s)
+        self._stop.clear()
+        # lifecycle handle  # distlint: ignore[DL008]
+        self._beat_thread = threading.Thread(
+            target=self._beat_loop, name="fleet-worker-beat", daemon=True
+        )
+        self._beat_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._close()
+        if self._beat_thread is not None:
+            self._beat_thread.join(5.0)
+            self._beat_thread = None
+
+    def is_connected(self) -> bool:
+        return self._sock is not None
+
+    def _connect(self, timeout_s: float) -> None:
+        host, port = parse_connect(self.settings.connect)
+        # worker-side join/reconnect thread: blocking by design with a
+        # bounded timeout; never a dispatch or asyncio path
+        sock = socket.create_connection(  # distlint: ignore[DL001]
+            (host, port), timeout=timeout_s)
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._send_lock:
+            self._sock = sock
+        # fresh reader per connection; the old one exited on its EOF
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(sock,),
+            name="fleet-worker-reader", daemon=True,
+        )
+        self._reader.start()
+        logger.info("fleet worker %s connected to %s:%d", self.member_id,
+                    host, port)
+
+    def _close(self) -> None:
+        with self._send_lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- sending (heartbeat thread + local runner threads) -----------------
+
+    def _send(self, name: str, obj: Dict[str, Any]) -> None:
+        with self._send_lock:
+            if self._sock is None:
+                raise OSError("fleet worker not connected")
+            send_frame(self._sock, name, obj)
+
+    def send_event(self, obj: Dict[str, Any]) -> None:
+        try:
+            self._send("FleetEvent", obj)
+        except Exception as e:  # noqa: BLE001 — registry link fault
+            # domain: the host's death path owns the request now
+            logger.debug("fleet worker %s: event send failed: %s",
+                         self.member_id, e)
+            if self.metrics:
+                self.metrics.record_error("fleet_worker.event_send")
+
+    def heartbeat_once(self) -> bool:
+        """Send one heartbeat; returns False when the link is down."""
+        self._seq += 1
+        try:
+            self._send("FleetHeartbeat", {
+                "member_id": self.member_id,
+                "seq": self._seq,
+                "engines": [status_to_wire(s)
+                            for s in self.scheduler.statuses()],
+            })
+            return True
+        except Exception as e:  # noqa: BLE001 — link fault domain
+            logger.debug("fleet worker %s: heartbeat failed: %s",
+                         self.member_id, e)
+            return False
+
+    def _beat_loop(self) -> None:
+        backoff = self.settings.heartbeat_interval_s
+        while not self._stop.wait(self.settings.heartbeat_interval_s):
+            if self._crashed:
+                return  # injected crash: the process is "dead"
+            if self._sock is None or not self.heartbeat_once():
+                self._close()
+                if self._stop.is_set() or self._crashed:
+                    return
+                try:
+                    self._connect(timeout_s=5.0)
+                    backoff = self.settings.heartbeat_interval_s
+                except OSError as e:
+                    logger.debug("fleet worker %s: reconnect failed: %s",
+                                 self.member_id, e)
+                    backoff = min(backoff * 2.0, 5.0)
+                    if self._stop.wait(backoff):
+                        return
+
+    # -- serving (reader thread) -------------------------------------------
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                frame = recv_frame(sock)
+                if frame is None:
+                    return
+                name, obj = frame
+                if name == "FleetSubmit":
+                    self._serve_submit(obj)
+                # heartbeats/events only flow worker -> host; ignore
+        except OSError:
+            return  # connection died; the beat loop reconnects
+        except faults.InjectedFault:
+            # fleet.submit armed on the worker: the member "crashes" on
+            # receipt — drop the connection, serve nothing, stay down
+            # (the registry host redispatches our zero-token in-flight)
+            logger.warning("fleet worker %s: injected crash on submit",
+                           self.member_id)
+            self._crashed = True
+            self._close()
+        except Exception:  # noqa: BLE001 — reader must not die silently
+            logger.exception("fleet worker %s reader failed", self.member_id)
+            self._close()
+
+    def _serve_submit(self, obj: Dict[str, Any]) -> None:
+        rid = obj.get("request_id", "")
+        engine_id = obj.get("engine_id", "")
+        runner = self.scheduler.get(engine_id)
+        if obj.get("abort"):
+            if runner is not None:
+                runner.abort(rid)
+            return
+        # the member crashing on receipt (fault domain of the REMOTE
+        # process): raises InjectedFault through to the read loop
+        faults.fire("fleet.submit")
+        sink = _RemoteSink(self, rid, engine_id)
+        if runner is None or not runner.is_healthy():
+            sink.on_error(
+                f"remote engine {engine_id!r} unavailable", "worker_failure"
+            )
+            return
+        req = ServerRequest(
+            rid, [int(t) for t in obj.get("prompt_ids", [])],
+            SamplingParams(
+                max_tokens=obj.get("max_tokens", 0) or 16,
+                temperature=obj.get("temperature", 0.0),
+                top_p=obj.get("top_p", 1.0) or 1.0,
+                stop_sequences=tuple(obj.get("stop_sequences", [])),
+            ),
+            sink,
+            tenant=obj.get("tenant") or "default",
+        )
+        runner.submit([req])

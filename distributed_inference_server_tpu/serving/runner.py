@@ -60,7 +60,8 @@ class ServerRequest:
     """A validated, tokenized request handed to the serving spine."""
 
     __slots__ = ("request_id", "prompt_ids", "params", "sink", "submitted_at",
-                 "first_token_at", "span", "engine_span", "redispatches")
+                 "first_token_at", "span", "engine_span", "redispatches",
+                 "tenant")
 
     def __init__(
         self,
@@ -69,6 +70,7 @@ class ServerRequest:
         params: SamplingParams,
         sink: ResultSink,
         span=None,
+        tenant: str = "default",
     ):
         self.request_id = request_id
         self.prompt_ids = prompt_ids
@@ -84,6 +86,8 @@ class ServerRequest:
         # bounded by the dispatcher so a systemic crash cannot bounce a
         # request around the fleet forever
         self.redispatches = 0
+        # per-tenant fair admission key (core/queue.py DRR; docs/FLEET.md)
+        self.tenant = tenant or "default"
 
 
 class EngineRunner:
@@ -223,6 +227,14 @@ class EngineRunner:
         self._inflight.clear()
         self._export_jobs.clear()
         self.start(wait_ready=wait_ready, timeout=timeout)
+
+    def set_role(self, role: str) -> None:
+        """Re-role this runner at runtime (fleet role rebalancing,
+        serving/fleet.py RoleBalancer). The flip is one attribute write:
+        ``submit`` reads the role per batch, so the NEXT admission batch
+        follows the new role while in-flight requests finish under the
+        old one (a unified→prefill flip never strands a decode)."""
+        self.role = role
 
     # -- submission (any thread) -------------------------------------------
 

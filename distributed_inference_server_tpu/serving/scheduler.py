@@ -149,10 +149,19 @@ def plan_route(
     n_pages = len(prefix_hashes) if prefix_hashes else 0
     depths = {s.engine_id: prefix_match_depth(s, prefix_hashes)
               for s in healthy}
-    peer = min(healthy, key=lambda s: (-depths[s.engine_id], load(s),
-                                       s.engine_id))
-    peer_depth = depths[peer.engine_id]
-    if n_pages == 0 or peer_depth == 0:
+    # peer-fetch needs a LOCAL engine object on both ends (the export
+    # and import run on runner threads); remote replicas (fleet proxies,
+    # serving/remote_runner.py) still take warm/recompute routes — their
+    # heartbeated digests score like anyone's — but never source a fetch
+    local = [s for s in healthy if not getattr(s, "remote", False)]
+    peer = (min(local, key=lambda s: (-depths[s.engine_id], load(s),
+                                      s.engine_id))
+            if local else None)
+    peer_depth = depths[peer.engine_id] if peer is not None else 0
+    # warm depth anywhere ADMISSIBLE (a remote replica's heartbeated
+    # digest counts for routing even though it can never source a fetch)
+    best_depth = max((depths[s.engine_id] for s in admissible), default=0)
+    if n_pages == 0 or (peer_depth == 0 and best_depth == 0):
         eng = min(admissible, key=lambda s: (load(s), s.engine_id))
         return PrefixRoutePlan(eng.engine_id, "recompute",
                                page_size=page_size)
@@ -164,7 +173,9 @@ def plan_route(
         base = costs.load_cost_pages * load(s)
         options.append((base + (n_pages - d), 0, load(s), s.engine_id,
                         "route", s, d))
-        if (costs.enabled and s.engine_id != peer.engine_id
+        if (costs.enabled and peer is not None
+                and s.engine_id != peer.engine_id
+                and not getattr(s, "remote", False)
                 and peer_depth - d >= costs.min_pages):
             # the wire term charges the WHOLE chain: the fetch moves
             # pages 0..peer_depth (head-first contiguous tiling), not
@@ -301,6 +312,17 @@ class AdaptiveScheduler:
     def unregister(self, engine_id: str) -> Optional[EngineRunner]:
         with self._lock:
             return self._engines.pop(engine_id, None)
+
+    def unregister_if(self, engine_id: str,
+                      runner: EngineRunner) -> Optional[EngineRunner]:
+        """Unregister ``engine_id`` only while it still maps to THIS
+        runner object — a detach racing a reconnect must not evict the
+        fresh proxy a new session just registered under the same id
+        (serving/fleet.py member sessions)."""
+        with self._lock:
+            if self._engines.get(engine_id) is runner:
+                return self._engines.pop(engine_id)
+            return None
 
     def engines(self) -> List[EngineRunner]:
         with self._lock:
@@ -460,8 +482,12 @@ class AdaptiveScheduler:
         """Pick the migration target for a finished prefill: the least-
         loaded healthy decode-role engine (``exclude`` drops the source,
         relevant only if an engine is both). None = no decode capacity —
-        the caller falls back to decoding in place."""
-        statuses = [s for s in self.statuses() if s.engine_id != exclude]
+        the caller falls back to decoding in place. Remote replicas are
+        excluded: KV handoff needs a local import session (cross-host
+        handoff routes through the fleet registry in a later round)."""
+        statuses = [s for s in self.statuses()
+                    if s.engine_id != exclude
+                    and not getattr(s, "remote", False)]
         engine_id = choose_engine(
             SchedulingStrategy.LEAST_LOADED, statuses, 0, roles=("decode",)
         )
@@ -492,6 +518,11 @@ class AdaptiveScheduler:
     def _health_loop(self) -> None:
         while not self._stop.wait(self._interval):
             for runner in self.engines():
+                if not getattr(runner, "supports_restart", True):
+                    # RemoteRunner proxies (serving/remote_runner.py):
+                    # their member's own health loop restarts the real
+                    # engine; the registry ages the proxy out instead
+                    continue
                 healthy = runner.is_healthy()
                 if healthy and self._auto_restart and faults.flag(
                         "sched.health_flap"):

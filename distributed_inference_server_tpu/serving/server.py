@@ -71,6 +71,7 @@ class InferenceServer:
         engine_roles: Optional[List[str]] = None,
         disagg_settings=None,
         fetch_costs=None,
+        fleet_settings=None,
     ):
         """``model_resolver(name) -> engine_factory`` enables the admin
         model-swap endpoint (Req 13); None leaves it unconfigured (501).
@@ -88,7 +89,16 @@ class InferenceServer:
         PrefixFetcher reuses its channel/chunk_pages/wire_quant).
         ``fetch_costs`` is a scheduler.FetchCosts for the cache_aware
         three-way route/fetch/recompute cost model (docs/CACHING.md);
-        None = defaults."""
+        None = defaults.
+
+        ``fleet_settings`` (multi-host fleet control plane,
+        serving/fleet.py; docs/FLEET.md): with ``enabled`` this server
+        becomes a REGISTRY HOST — it listens for worker members, ages
+        them through the alive/suspect/dead state machine, and routes
+        their engines through RemoteRunner proxies; with ``rerole`` the
+        RoleBalancer flips unified engines to prefill under prompt-queue
+        pressure (and back) with hysteresis. None/defaults = no fleet
+        networking, no rebalancing — today's behavior exactly."""
         from distributed_inference_server_tpu.utils.tracing import Tracer
 
         self.engine_factory = engine_factory
@@ -172,6 +182,32 @@ class InferenceServer:
         )
 
         self.degradation = DegradationController(self.dispatcher, self.scheduler)
+        # multi-host fleet control plane (serving/fleet.py; docs/FLEET.md)
+        from distributed_inference_server_tpu.serving.fleet import (
+            FleetRegistry,
+            FleetServer,
+            FleetSettings,
+            RoleBalancer,
+        )
+
+        self.fleet_settings = fleet_settings or FleetSettings()
+        self.fleet_registry: Optional[FleetRegistry] = None
+        self.fleet_server: Optional[FleetServer] = None
+        self.role_balancer: Optional[RoleBalancer] = None
+        if self.fleet_settings.enabled:
+            self.fleet_registry = FleetRegistry(
+                self.fleet_settings, metrics=self.metrics
+            )
+            self.fleet_server = FleetServer(
+                self.fleet_registry, self.scheduler, self.fleet_settings,
+                metrics=self.metrics,
+                redispatch=self.dispatcher.redispatch,
+            )
+        if self.fleet_settings.rerole:
+            self.role_balancer = RoleBalancer(
+                self.scheduler, self.dispatcher, self.fleet_settings,
+                metrics=self.metrics,
+            )
         self._num_engines = num_engines
         self._next_engine_idx = 0
         self._started = False
@@ -187,6 +223,10 @@ class InferenceServer:
         self.scheduler.start_health_loop()
         self.dispatcher.start()
         self.degradation.start()
+        if self.fleet_server is not None:
+            self.fleet_server.start()
+        if self.role_balancer is not None:
+            self.role_balancer.start()
         # lifecycle flag, orchestrator-called  # distlint: ignore[DL008]
         self._started = True
 
@@ -196,7 +236,13 @@ class InferenceServer:
         (disagg.pending_count); the controller then drains its queue by
         resuming any stragglers in place before the engines stop."""
         self.degradation.stop()
+        if self.role_balancer is not None:
+            self.role_balancer.stop()
         self.dispatcher.shutdown(drain_timeout_s)
+        if self.fleet_server is not None:
+            # after the drain (remote in-flight counted), before the
+            # local engines stop: detaches member sessions cleanly
+            self.fleet_server.stop()
         if self.disagg is not None:
             self.disagg.shutdown()
         self.scheduler.stop_health_loop()
@@ -232,7 +278,9 @@ class InferenceServer:
         """Add or remove engine replicas at runtime (requirements.md:110).
         Removal drains: the engine is unregistered (no new batches) and shut
         down once its in-flight requests finish."""
-        current = self.scheduler.engines()
+        # fleet proxies are not ours to scale: their member owns them
+        current = [r for r in self.scheduler.engines()
+                   if not getattr(r, "is_remote", False)]
         for _ in range(n - len(current)):
             self._spawn_engine()
         if n < len(current):
@@ -279,7 +327,10 @@ class InferenceServer:
         import threading as _t
         import time as _time
 
-        runners = self.scheduler.engines()
+        # remote proxies never swap: the member's own operator swaps its
+        # models (a partial fleet-wide swap is visible in /server/stats)
+        runners = [r for r in self.scheduler.engines()
+                   if not getattr(r, "is_remote", False)]
         results: dict = {}
         events = []
         cancelled = _t.Event()
@@ -381,8 +432,28 @@ class InferenceServer:
                 return False, str(e)
             return True, None
 
+        fleet_fn = None
+        if (self.fleet_registry is not None
+                or self.role_balancer is not None):
+            fleet_fn = self._fleet_stats
+
         return build_app(self.handler, self.metrics, swap_fn=swap_fn,
-                         scale_fn=scale_fn)
+                         scale_fn=scale_fn, fleet_fn=fleet_fn)
+
+    def _fleet_stats(self) -> dict:
+        """The ``fleet`` block of ``/server/stats`` (docs/FLEET.md):
+        members with state + last-beat age, heartbeat/rerole counters,
+        the live role map, and the rebalance history."""
+        out: dict = {}
+        if self.fleet_registry is not None:
+            out.update(self.fleet_registry.stats())
+        if self.role_balancer is not None:
+            out["rebalancer"] = self.role_balancer.stats()
+        out["role_map"] = {
+            r.engine_id: r.role for r in self.scheduler.engines()
+        }
+        out.update(self.metrics.fleet_counters())
+        return out
 
     async def serve(self, host: str = "0.0.0.0", port: int = 8000) -> web.AppRunner:
         """Bind and serve; returns the AppRunner (caller controls lifetime)."""

@@ -506,3 +506,45 @@ class TestChaosFleetSeeds:
             assert violations == []
         finally:
             srv.shutdown(drain_timeout_s=5.0)
+
+
+@pytest.fixture(scope="module")
+def fleet_chaos_cache():
+    """One fleet per FLEET scenario, reused across its committed seeds
+    (the chaos harness's own main loop does exactly this; scenarios
+    self-heal crashed members between iterations via _ensure_worker) —
+    a fresh two-server fleet per seed would cost tier-1 ~2 minutes of
+    pure engine builds."""
+    cache = {}
+    yield cache
+    faults.clear()
+    for srv in cache.values():
+        srv.shutdown(drain_timeout_s=5.0)
+
+
+class TestFleetChaosSeeds:
+    """Committed seeds of the fleet control-plane scenarios
+    (docs/FLEET.md): registry partition -> suspect -> dead -> rejoin
+    reconvergence; remote member death mid-zero-token-request (seed 31
+    kills the forwarded submit on the registry host's wire, 34/35 crash
+    the worker on receipt) -> exactly-once redispatch; and rerole
+    hysteresis holding under an oscillating signal."""
+
+    @pytest.mark.parametrize("scenario,seed", [
+        ("registry_partition", 31),
+        ("registry_partition", 32),
+        ("registry_partition", 33),
+        ("remote_runner_crash_mid_request", 31),
+        ("remote_runner_crash_mid_request", 34),
+        ("remote_runner_crash_mid_request", 35),
+        ("rerole_flap", 31),
+        ("rerole_flap", 32),
+        ("rerole_flap", 33),
+    ])
+    def test_scenario_clean(self, scenario, seed, fleet_chaos_cache):
+        from tools import chaos_fleet
+
+        violations, srv = chaos_fleet.run_scenario(
+            scenario, seed, srv=fleet_chaos_cache.get(scenario))
+        fleet_chaos_cache[scenario] = srv
+        assert violations == []

@@ -193,3 +193,207 @@ def test_cancel_removes_specific_request():
     assert q.cancel("req-1") is None
     remaining = [r.data for r in q.dequeue_batch(10)]
     assert remaining == [0, 2]
+
+
+# -- per-tenant fair admission (docs/FLEET.md) -------------------------------
+
+
+def fair_cfg(**kw):
+    defaults = dict(high_watermark=10_000, max_queue_size=20_000,
+                    tenant_fairness=True)
+    defaults.update(kw)
+    return QueueConfig(**defaults)
+
+
+def make_t(i, tenant, priority=Priority.NORMAL):
+    return QueuedRequest(id=f"req-{tenant}-{i}", data=i, priority=priority,
+                         tenant=tenant)
+
+
+def test_tenant_fair_round_robin_interleaves_equal_weights():
+    """A saturating tenant cannot starve a trickling one: with equal
+    weights, dequeues alternate 1:1 regardless of backlog skew."""
+    q = PriorityQueueManager(fair_cfg())
+    for i in range(100):
+        q.enqueue(make_t(i, "hog"))
+    for i in range(5):
+        q.enqueue(make_t(i, "mouse"))
+    out = q.dequeue_batch(10)
+    assert sum(1 for r in out if r.tenant == "mouse") == 5
+    # every mouse request lands within 2 positions of its fair slot
+    mouse_positions = [j for j, r in enumerate(out) if r.tenant == "mouse"]
+    for k, pos in enumerate(mouse_positions):
+        assert pos <= 2 * (k + 1), (k, pos, [r.tenant for r in out])
+
+
+def test_tenant_fair_bounded_wait_under_weight_ratio():
+    """ACCEPTANCE (ISSUE 9): a saturating tenant cannot push another
+    tenant's queue wait beyond the configured weight ratio — with
+    weights hog=3, mouse=1, the mouse's k-th request dequeues within
+    ~(1 + w_hog/w_mouse) * k positions."""
+    q = PriorityQueueManager(fair_cfg(
+        tenant_weights={"hog": 3.0, "mouse": 1.0}))
+    for i in range(200):
+        q.enqueue(make_t(i, "hog"))
+    for i in range(8):
+        q.enqueue(make_t(i, "mouse"))
+    out = [q.dequeue_one() for _ in range(48)]
+    positions = [j for j, r in enumerate(out) if r.tenant == "mouse"]
+    assert len(positions) == 8  # all mouse requests served in the window
+    ratio = 3.0 / 1.0
+    for k, pos in enumerate(positions):
+        assert pos <= (1 + ratio) * (k + 1) + 1, (k, pos)
+    # the hog still gets its weight share, not merely the leftovers
+    hogs = sum(1 for r in out if r.tenant == "hog")
+    assert hogs >= 0.6 * len(out)
+
+
+def test_tenant_fair_fifo_within_tenant_and_priority_across_levels():
+    q = PriorityQueueManager(fair_cfg())
+    q.enqueue(make_t(0, "a", Priority.LOW))
+    q.enqueue(make_t(0, "b"))
+    q.enqueue(make_t(1, "b"))
+    q.enqueue(make_t(0, "c", Priority.HIGH))
+    out = q.dequeue_batch(10)
+    # strict priority first
+    assert [r.priority for r in out] == [Priority.HIGH, Priority.NORMAL,
+                                         Priority.NORMAL, Priority.LOW]
+    # FIFO within tenant b
+    b = [r.data for r in out if r.tenant == "b"]
+    assert b == [0, 1]
+
+
+def test_tenant_fair_single_tenant_is_plain_fifo():
+    q = PriorityQueueManager(fair_cfg())
+    for i in range(20):
+        q.enqueue(make_t(i, "only"))
+    assert [r.data for r in q.dequeue_batch(20)] == list(range(20))
+
+
+def test_tenant_fair_expiry_cancel_and_depths():
+    import time
+
+    q = PriorityQueueManager(fair_cfg(request_timeout_s=10.0))
+    now = time.monotonic()
+    q.enqueue(QueuedRequest(id="old-a", data=0, tenant="a",
+                            enqueued_at=now - 60.0))
+    q.enqueue(make_t(1, "a"))
+    q.enqueue(make_t(0, "b"))
+    assert q.tenant_depths() == {"a": 2, "b": 1}
+    expired = q.remove_expired(now=now)
+    assert [r.id for r in expired] == ["old-a"]
+    assert q.tenant_depths() == {"a": 1, "b": 1}
+    assert q.cancel("req-b-0") is not None
+    assert q.tenant_depths() == {"a": 1}
+    assert q.dequeue_one().tenant == "a"
+    assert q.tenant_depths() == {}
+
+
+def test_tenant_fair_new_tenant_mid_stream_not_starved():
+    q = PriorityQueueManager(fair_cfg())
+    for i in range(50):
+        q.enqueue(make_t(i, "hog"))
+    q.dequeue_batch(10)  # hog is mid-drain with accumulated ring state
+    q.enqueue(make_t(0, "late"))
+    out = q.dequeue_batch(4)
+    assert any(r.tenant == "late" for r in out), [r.tenant for r in out]
+
+
+def test_tenant_default_when_unset():
+    q = PriorityQueueManager(fair_cfg())
+    q.enqueue(QueuedRequest(id="x", data=0))
+    assert q.tenant_depths() == {"default": 1}
+
+
+# -- backpressure re-evaluation on every mutation (ISSUE 9 satellite) --------
+#
+# The issue hypothesized that dequeue_batch partial drains under
+# concurrent enqueue could leave the backpressure flag stale for a full
+# poll interval. Not reproducible: every mutating method (enqueue,
+# dequeue_one, dequeue_batch, remove_expired, cancel) recomputes
+# _update_backpressure under the SAME lock hold as its mutation, so no
+# interleaving can observe a flag that disagrees with the depth it was
+# computed from. These regressions pin that property for both storage
+# modes.
+
+
+@pytest.mark.parametrize("fair", [False, True])
+def test_backpressure_reevaluated_on_every_mutation(fair):
+    import time
+
+    cfg = QueueConfig(high_watermark=6, low_watermark=3, max_queue_size=100,
+                      request_timeout_s=10.0, tenant_fairness=fair)
+    now = time.monotonic()
+
+    def fill(q, n, old=False):
+        for i in range(n):
+            q.enqueue(QueuedRequest(
+                id=f"r{i}-{old}", data=i, priority=Priority.NORMAL,
+                tenant="t", enqueued_at=now - (60.0 if old else 0.0)))
+
+    # dequeue_batch partial drain releases the flag the moment depth
+    # crosses the low watermark — not on the next poll
+    q = PriorityQueueManager(cfg)
+    fill(q, 7)
+    assert not q.is_accepting()
+    q.dequeue_batch(5)  # 7 -> 2 < low
+    assert q.is_accepting()
+
+    # dequeue_one, one mutation at a time
+    q = PriorityQueueManager(cfg)
+    fill(q, 7)
+    for _ in range(5):
+        q.dequeue_one()
+    assert q.is_accepting()
+
+    # cancel
+    q = PriorityQueueManager(cfg)
+    fill(q, 7)
+    assert not q.is_accepting()
+    for i in range(5):
+        assert q.cancel(f"r{i}-False") is not None
+    assert q.is_accepting()
+
+    # remove_expired
+    q = PriorityQueueManager(cfg)
+    fill(q, 7, old=True)
+    assert not q.is_accepting()
+    q.remove_expired(now=now)
+    assert q.is_accepting()
+
+
+@CASES
+@given(ops=st.lists(st.sampled_from(["enq", "deq", "batch", "cancel"]),
+                    min_size=1, max_size=150))
+def test_backpressure_invariants_fair_mode(ops):
+    """The legacy hysteresis-band property holds verbatim in fair mode
+    under arbitrary op interleavings."""
+    cfg = QueueConfig(high_watermark=20, low_watermark=10, max_queue_size=50,
+                      tenant_fairness=True,
+                      tenant_weights={"a": 2.0, "b": 1.0})
+    q = PriorityQueueManager(cfg)
+    counter = itertools.count()
+    live = []
+    for op in ops:
+        if op == "enq":
+            i = next(counter)
+            try:
+                q.enqueue(make_t(i, "ab"[i % 2]))
+                live.append(f"req-{'ab'[i % 2]}-{i}")
+            except QueueFull:
+                pass
+        elif op == "deq":
+            r = q.dequeue_one()
+            if r is not None and r.id in live:
+                live.remove(r.id)
+        elif op == "batch":
+            for r in q.dequeue_batch(3):
+                if r.id in live:
+                    live.remove(r.id)
+        elif live:
+            q.cancel(live.pop(0))
+        depth = q.total_depth()
+        if depth > cfg.high_watermark:
+            assert not q.is_accepting()
+        if depth < cfg.low_watermark:
+            assert q.is_accepting()

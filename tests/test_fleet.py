@@ -1,0 +1,870 @@
+"""Fleet control plane (ISSUE 9): the fleet wire, the federated
+registry's alive/suspect/dead state machine, RemoteRunner exactly-once
+failure semantics, role-rebalance hysteresis, and the serving e2e —
+join, token-identical remote serving, and death -> crash-safe
+redispatch (docs/FLEET.md).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+from distributed_inference_server_tpu.core.errors import ConfigError
+from distributed_inference_server_tpu.core.models import FinishReason
+from distributed_inference_server_tpu.engine.engine import SamplingParams
+from distributed_inference_server_tpu.serving import faults, protowire
+from distributed_inference_server_tpu.serving.config import (
+    ServerConfig,
+    parse_tenant_weights,
+)
+from distributed_inference_server_tpu.serving.fleet import (
+    FleetRegistry,
+    FleetSettings,
+    FleetWireError,
+    MEMBER_ALIVE,
+    MEMBER_DEAD,
+    MEMBER_SUSPECT,
+    RoleBalancer,
+    parse_connect,
+    recv_frame,
+    send_frame,
+    status_from_wire,
+    status_to_wire,
+)
+from distributed_inference_server_tpu.serving.metrics import (
+    EngineStatus,
+    MetricsCollector,
+)
+from distributed_inference_server_tpu.serving.remote_runner import RemoteRunner
+from distributed_inference_server_tpu.serving.runner import ServerRequest
+from distributed_inference_server_tpu.serving.scheduler import plan_route
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+class _Sink:
+    def __init__(self):
+        self.toks, self.text = [], ""
+        self.errors, self.dones = [], 0
+        self.ev = threading.Event()
+
+    def on_token(self, token_id, text, token_index, logprob=None):
+        if token_id is not None:
+            self.toks.append(token_id)
+        self.text += text
+
+    def on_done(self, reason, usage):
+        self.dones += 1
+        self.ev.set()
+
+    def on_error(self, message, code):
+        self.errors.append((message, code))
+        self.ev.set()
+
+
+def _req(rid="r1", first_token=False, prompt=(1, 2, 3)):
+    sink = _Sink()
+    req = ServerRequest(rid, list(prompt),
+                        SamplingParams(max_tokens=8, temperature=0.0), sink)
+    if first_token:
+        req.first_token_at = time.monotonic()
+    return req, sink
+
+
+def _status(engine_id="e0", healthy=True, role="unified", waiting=0,
+            active=0, remote=False, digest=()):
+    return EngineStatus(
+        engine_id=engine_id, healthy=healthy, active_requests=active,
+        waiting_requests=waiting, total_processed=0, role=role,
+        prefix_digest=frozenset(digest), page_size=8, digest_depth=8,
+        remote=remote,
+    )
+
+
+# ---------------------------------------------------------------------------
+# The fleet wire
+# ---------------------------------------------------------------------------
+
+
+class TestFleetWire:
+    def _pair(self):
+        a, b = socket.socketpair()
+        return a, b
+
+    def test_frame_round_trip_all_kinds(self):
+        a, b = self._pair()
+        try:
+            beats = {"member_id": "w1", "seq": 7,
+                     "engines": [status_to_wire(_status(digest=(11, 12)))]}
+            send_frame(a, "FleetHeartbeat", beats)
+            send_frame(a, "FleetSubmit", {
+                "request_id": "r1", "engine_id": "e0",
+                "prompt_ids": [1, 2, 3], "max_tokens": 8,
+                "temperature": 0.25, "top_p": 0.9,
+                "stop_sequences": ["x"], "tenant": "acme",
+            })
+            send_frame(a, "FleetEvent", {
+                "request_id": "r1", "engine_id": "e0", "kind": "token",
+                "token_id": 42, "text": "hi", "token_index": 3,
+            })
+            name, hb = recv_frame(b)
+            assert name == "FleetHeartbeat" and hb["member_id"] == "w1"
+            assert hb["engines"][0]["prefix_digest"] == [11, 12]
+            name, sub = recv_frame(b)
+            assert name == "FleetSubmit"
+            assert sub["prompt_ids"] == [1, 2, 3]
+            assert sub["temperature"] == 0.25  # double: bit-exact
+            assert sub["tenant"] == "acme"
+            name, ev = recv_frame(b)
+            assert name == "FleetEvent" and ev["token_id"] == 42
+        finally:
+            a.close()
+            b.close()
+
+    def test_event_without_token_id_decodes_absent(self):
+        a, b = self._pair()
+        try:
+            send_frame(a, "FleetEvent", {
+                "request_id": "r1", "engine_id": "e0", "kind": "token",
+                "text": "tail", "token_index": 9,
+            })
+            _, ev = recv_frame(b)
+            assert "token_id" not in ev  # optional: absent, not 0
+        finally:
+            a.close()
+            b.close()
+
+    def test_eof_returns_none(self):
+        a, b = self._pair()
+        a.close()
+        try:
+            assert recv_frame(b) is None
+        finally:
+            b.close()
+
+    def test_malformed_frame_raises(self):
+        a, b = self._pair()
+        try:
+            a.sendall(b"\x00\x00\x00\x04\x99abcd")  # unknown frame kind
+            with pytest.raises(FleetWireError):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_status_wire_round_trip(self):
+        s = _status(engine_id="engine-0", role="decode",
+                    digest=(5, 6, 7), waiting=3, active=2)
+        d = status_to_wire(s)
+        back = status_from_wire(
+            protowire.decode("EngineStatus",
+                             protowire.encode("EngineStatus", d)), "w1")
+        assert back.engine_id == "w1:engine-0"
+        assert back.remote is True
+        assert back.role == "decode"
+        assert back.prefix_digest == frozenset((5, 6, 7))
+        assert back.waiting_requests == 3
+        assert back.page_size == 8 and back.digest_depth == 8
+
+    def test_parse_connect(self):
+        assert parse_connect("10.0.0.2:9000") == ("10.0.0.2", 9000)
+        for bad in ("nope", ":123", "h:", "h:x"):
+            with pytest.raises(ConfigError):
+                parse_connect(bad)
+
+
+# ---------------------------------------------------------------------------
+# FleetRegistry state machine
+# ---------------------------------------------------------------------------
+
+
+class TestFleetRegistry:
+    def _registry(self, **kw):
+        settings = FleetSettings(heartbeat_interval_s=0.05,
+                                 suspect_after_s=0.2, dead_after_s=0.5, **kw)
+        m = MetricsCollector()
+        transitions = []
+        reg = FleetRegistry(settings, metrics=m,
+                            on_state_change=lambda *t: transitions.append(t))
+        return reg, m, transitions
+
+    def test_join_then_age_out_then_rejoin(self):
+        reg, m, transitions = self._registry()
+        assert reg.observe("w1", [_status()]) == MEMBER_DEAD  # join
+        assert reg.member_state("w1") == MEMBER_ALIVE
+        now = time.monotonic()
+        assert reg.sweep(now + 0.3) == [("w1", MEMBER_ALIVE, MEMBER_SUSPECT)]
+        assert reg.sweep(now + 0.6) == [("w1", MEMBER_SUSPECT, MEMBER_DEAD)]
+        assert reg.member_state("w1") == MEMBER_DEAD
+        # rejoin: the next beat revives it and reports the prior state
+        assert reg.observe("w1", [_status()]) == MEMBER_DEAD
+        assert reg.member_state("w1") == MEMBER_ALIVE
+        assert ("w1", MEMBER_DEAD, MEMBER_ALIVE) in transitions
+        prom = m.prometheus_text().decode()
+        assert 'fleet_members{state="alive"} 1.0' in prom
+        assert 'fleet_heartbeats_total{outcome="rejoin"}' in prom
+
+    def test_one_missed_beat_is_not_suspicion(self):
+        reg, _, _ = self._registry()
+        reg.observe("w1", [_status()])
+        assert reg.sweep(time.monotonic() + 0.1) == []
+        assert reg.member_state("w1") == MEMBER_ALIVE
+
+    def test_disconnect_is_immediately_dead(self):
+        reg, m, transitions = self._registry()
+        reg.observe("w1", [_status()])
+        reg.disconnect("w1")
+        assert reg.member_state("w1") == MEMBER_DEAD
+        assert ("w1", MEMBER_ALIVE, MEMBER_DEAD) in transitions
+        assert ('fleet_members{state="dead"} 1.0'
+                in m.prometheus_text().decode())
+
+    def test_heartbeat_fault_drops_the_beat(self):
+        reg, m, _ = self._registry()
+        reg.observe("w1", [_status()])
+        faults.install(faults.parse_spec("fleet.heartbeat:nth=1,times=3",
+                                         seed=1))
+        for _ in range(3):
+            assert reg.observe("w1", [_status()]) is None
+        faults.clear()
+        # dropped beats never refreshed last_beat: aging continues
+        assert reg.sweep(time.monotonic() + 0.3)
+        snap = m.prometheus_text().decode()
+        assert 'fleet_heartbeats_total{outcome="dropped"} 3.0' in snap
+
+    def test_first_join_counts_ok_not_rejoin(self):
+        """Review fix: a brand-new member's first beat is a join, not a
+        revival — operators alert on rejoin as a partition-recovery
+        signal."""
+        reg, m, transitions = self._registry()
+        reg.observe("w1", [_status()])
+        prom = m.prometheus_text().decode()
+        assert 'fleet_heartbeats_total{outcome="ok"} 1.0' in prom
+        assert 'outcome="rejoin"' not in prom
+        assert transitions == []  # nothing existed to revive
+
+    def test_dead_members_pruned_after_retention(self):
+        """Review fix: restarted workers mint fresh host:pid ids — dead
+        entries must age out of the member table and the gauge."""
+        reg, m, _ = self._registry()
+        reg.observe("w1", [_status()])
+        reg.disconnect("w1")
+        now = time.monotonic()
+        reg.sweep(now + 1.0)  # within retention: still visible
+        assert reg.member_state("w1") == MEMBER_DEAD
+        reg.sweep(now + reg.settings.dead_after_s
+                  + reg.settings.dead_retention_s + 1.0)
+        assert reg.member_state("w1") is None
+        assert ('fleet_members{state="dead"} 0.0'
+                in m.prometheus_text().decode())
+
+    def test_stats_shape(self):
+        reg, _, _ = self._registry()
+        reg.observe("w1", [_status(role="decode")])
+        stats = reg.stats()
+        assert stats["member_counts"] == {"alive": 1, "suspect": 0,
+                                          "dead": 0}
+        (member,) = stats["members"]
+        assert member["member_id"] == "w1"
+        assert member["engines"] == {"e0": "decode"}
+        assert member["last_beat_age_s"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# RemoteRunner: exactly-once failure semantics over the wire
+# ---------------------------------------------------------------------------
+
+
+class _WireLog:
+    """Collects frames a RemoteRunner sends; can be told to die."""
+
+    def __init__(self):
+        self.frames = []
+        self.dead = False
+
+    def send(self, name, obj):
+        if self.dead:
+            raise OSError("wire down")
+        self.frames.append((name, obj))
+
+
+def _remote(wire=None):
+    wire = wire or _WireLog()
+    r = RemoteRunner("w1:e0", "e0", wire.send)
+    r.update_status(_status(engine_id="w1:e0", remote=True))
+    return r, wire
+
+
+class TestRemoteRunner:
+    def test_submit_encodes_frames_and_events_resolve(self):
+        r, wire = _remote()
+        req, sink = _req()
+        r.submit([req])
+        assert wire.frames[0][0] == "FleetSubmit"
+        assert wire.frames[0][1]["engine_id"] == "e0"
+        assert r.active_count() == 1
+        r.on_event({"request_id": "r1", "kind": "token", "token_id": 9,
+                    "text": "a", "token_index": 0})
+        r.on_event({"request_id": "r1", "kind": "done",
+                    "finish_reason": "stop", "prompt_tokens": 3,
+                    "completion_tokens": 1})
+        assert sink.ev.is_set() and sink.dones == 1
+        assert sink.toks == [9]
+        assert r.active_count() == 0
+        # orphan events after the terminal are dropped, never double
+        r.on_event({"request_id": "r1", "kind": "done",
+                    "finish_reason": "stop"})
+        assert sink.dones == 1
+
+    def test_error_event_resolves_once(self):
+        r, _ = _remote()
+        req, sink = _req(first_token=True)
+        r.submit([req])
+        r.on_event({"request_id": "r1", "kind": "error",
+                    "message": "boom", "code": "inference_failed"})
+        assert sink.errors == [("boom", "inference_failed")]
+        assert r.active_count() == 0
+
+    def test_detach_redispatches_zero_token_and_fails_midstream(self):
+        r, _ = _remote()
+        taken = []
+        r.redispatch = lambda req, eid, msg: taken.append(req.request_id) or True
+        fresh, fresh_sink = _req("fresh")
+        mid, mid_sink = _req("mid", first_token=True)
+        r.submit([fresh, mid])
+        r.detach("member dead")
+        assert taken == ["fresh"]  # zero-token: the dispatcher owns it
+        assert not fresh_sink.errors
+        assert mid_sink.errors and mid_sink.errors[0][1] == "engine_crashed"
+        assert not r.is_healthy()
+        # a detached proxy fails later submits immediately (to redispatch)
+        late, late_sink = _req("late")
+        r.submit([late])
+        assert taken == ["fresh", "late"]
+
+    def test_send_failure_degrades_to_redispatch(self):
+        r, wire = _remote()
+        wire.dead = True
+        taken = []
+        r.redispatch = lambda req, eid, msg: taken.append(req.request_id) or True
+        req, sink = _req()
+        r.submit([req])
+        assert taken == ["r1"]
+        assert not sink.errors
+
+    def test_fleet_submit_fault_on_the_wire(self):
+        r, wire = _remote()
+        taken = []
+        r.redispatch = lambda req, eid, msg: taken.append(req.request_id) or True
+        faults.install(faults.parse_spec("fleet.submit:nth=1", seed=1))
+        req, _ = _req()
+        r.submit([req])
+        faults.clear()
+        assert taken == ["r1"]
+        assert wire.frames == []  # died before the frame left
+
+    def test_remote_worker_failure_takes_redispatch_path(self):
+        r, _ = _remote()
+        taken = []
+        r.redispatch = lambda req, eid, msg: taken.append(req.request_id) or True
+        req, sink = _req()
+        r.submit([req])
+        r.on_event({"request_id": "r1", "kind": "error",
+                    "message": "remote out of capacity",
+                    "code": "worker_failure"})
+        assert taken == ["r1"]
+        assert not sink.errors  # invisible to the client
+
+    def test_exhausted_redispatch_fails_visibly_once(self):
+        r, _ = _remote()
+        r.redispatch = lambda req, eid, msg: False
+        req, sink = _req()
+        r.submit([req])
+        r.detach("member dead")
+        assert sink.errors == [("member dead", "worker_failure")]
+
+    def test_abort_sends_frame_and_pops(self):
+        r, wire = _remote()
+        req, sink = _req()
+        r.submit([req])
+        r.abort("r1")
+        assert r.active_count() == 0
+        assert wire.frames[-1][1]["abort"] is True
+        # events after the abort are orphans
+        r.on_event({"request_id": "r1", "kind": "done",
+                    "finish_reason": "stop"})
+        assert sink.dones == 0
+
+    def test_status_overlays_liveness_and_inflight(self):
+        r, _ = _remote()
+        req, _ = _req()
+        r.submit([req])
+        assert r.status().active_requests == 1
+        r.set_member_state(MEMBER_SUSPECT)
+        assert not r.is_healthy()
+        assert r.status().healthy is False  # suspect leaves routing set
+        r.set_member_state(MEMBER_ALIVE)
+        assert r.is_healthy()
+        assert r.audit() == []
+
+    def test_two_phase_detach_keeps_siblings_out_of_redispatch(self):
+        """Review fix: when a member dies, EVERY sibling proxy must be
+        unhealthy before ANY request is redispatched — otherwise the
+        bounded redispatch budget burns on the same dead member."""
+        a, _ = _remote()
+        b, _ = _remote()
+        sibling_health_at_redispatch = []
+        a.redispatch = lambda req, eid, msg: (
+            sibling_health_at_redispatch.append(b.is_healthy()) or True)
+        req, _ = _req()
+        a.submit([req])
+        # the session's ordering: mark ALL, then fail
+        a.mark_detached("member dead")
+        b.mark_detached("member dead")
+        a.fail_inflight("member dead")
+        assert sibling_health_at_redispatch == [False]
+
+    def test_done_event_maps_finish_reason(self):
+        r, _ = _remote()
+        req, sink = _req()
+        r.submit([req])
+        r.on_event({"request_id": "r1", "kind": "done",
+                    "finish_reason": "length", "prompt_tokens": 3,
+                    "completion_tokens": 8})
+        assert sink.dones == 1
+
+
+# ---------------------------------------------------------------------------
+# Remote-aware routing
+# ---------------------------------------------------------------------------
+
+
+class TestRemoteRouting:
+    def test_plan_route_routes_warm_to_remote_but_never_fetches(self):
+        hashes = (11, 12, 13, 14)
+        remote_warm = _status("w1:e0", remote=True, digest=hashes)
+        local_cold = _status("local", waiting=0)
+        # the remote's heartbeated digest wins warm routing
+        plan = plan_route([remote_warm, local_cold], hashes)
+        assert plan.engine_id == "w1:e0" and plan.decision == "warm"
+        # but a remote replica never SOURCES a fetch: with the only warm
+        # copy remote, a loaded-vs-cold tradeoff must not pick "fetch"
+        busy_remote = _status("w1:e0", remote=True, digest=hashes,
+                              active=50, waiting=50)
+        plan = plan_route([busy_remote, local_cold], hashes)
+        assert plan.decision in ("warm", "recompute")  # never "fetch"
+
+    def test_plan_route_never_fetches_onto_remote_target(self):
+        hashes = (11, 12, 13, 14)
+        local_warm_busy = _status("warm", digest=hashes, active=50,
+                                  waiting=50)
+        remote_cold = _status("w1:cold", remote=True)
+        plan = plan_route([local_warm_busy, remote_cold], hashes)
+        if plan.engine_id == "w1:cold":
+            assert plan.decision != "fetch"
+
+
+# ---------------------------------------------------------------------------
+# RoleBalancer hysteresis
+# ---------------------------------------------------------------------------
+
+
+class _FakeRunner:
+    def __init__(self, engine_id, role, healthy=True, waiting=0):
+        self.engine_id = engine_id
+        self.role = role
+        self.healthy = healthy
+        self.waiting = waiting
+
+    def is_healthy(self):
+        return self.healthy
+
+    def set_role(self, role):
+        self.role = role
+
+    def status(self):
+        return _status(self.engine_id, healthy=self.healthy, role=self.role,
+                       waiting=self.waiting)
+
+
+class _FakeScheduler:
+    def __init__(self, runners):
+        self._runners = runners
+
+    def engines(self):
+        return list(self._runners)
+
+    def statuses(self):
+        return [r.status() for r in self._runners]
+
+    def get(self, engine_id):
+        return next((r for r in self._runners if r.engine_id == engine_id),
+                    None)
+
+
+class _FakeDispatcher:
+    def __init__(self, depth=0):
+        self.depth = depth
+        self.queue = self
+
+    def total_depth(self):
+        return self.depth
+
+
+def _balancer(runners, depth=0, **kw):
+    settings = FleetSettings(
+        rerole=True, rerole_high_ratio=4.0, rerole_low_ratio=1.0,
+        rerole_cooldown_s=kw.pop("cooldown", 0.0), **kw)
+    sched = _FakeScheduler(runners)
+    disp = _FakeDispatcher(depth)
+    return RoleBalancer(sched, disp, settings, metrics=MetricsCollector()), disp
+
+
+class TestRoleBalancer:
+    def test_flip_to_prefill_on_deep_queue_and_back(self):
+        u = _FakeRunner("e0", "unified")
+        d = _FakeRunner("e1", "decode")
+        bal, disp = _balancer([u, d], depth=10)
+        assert bal.evaluate() == "to_prefill"
+        assert u.role == "prefill"
+        disp.depth = 0
+        assert bal.evaluate() == "to_unified"
+        assert u.role == "unified"
+        counters = bal.metrics.fleet_counters()["reroles"]
+        assert counters == {"to_prefill": 1, "to_unified": 1}
+
+    def test_hysteresis_band_holds(self):
+        u = _FakeRunner("e0", "unified")
+        d = _FakeRunner("e1", "decode")
+        bal, disp = _balancer([u, d], depth=10)
+        bal.evaluate()
+        assert u.role == "prefill"
+        # inside the band (low < signal < high): no restore, no flap
+        disp.depth = 3
+        assert bal.evaluate() is None
+        assert u.role == "prefill"
+
+    def test_cooldown_bounds_flip_rate(self):
+        u = _FakeRunner("e0", "unified")
+        d = _FakeRunner("e1", "decode")
+        bal, disp = _balancer([u, d], depth=10, cooldown=60.0)
+        assert bal.evaluate() == "to_prefill"
+        disp.depth = 0
+        assert bal.evaluate() is None  # cooldown holds the restore
+        assert u.role == "prefill"
+
+    def test_never_rewrites_operator_roles(self):
+        op_prefill = _FakeRunner("e0", "prefill")
+        d = _FakeRunner("e1", "decode")
+        bal, disp = _balancer([op_prefill, d], depth=0)
+        assert bal.evaluate() is None  # nothing flipped, nothing restored
+        assert op_prefill.role == "prefill"
+
+    def test_no_flip_without_decode_capacity(self):
+        u = _FakeRunner("e0", "unified")
+        bal, _ = _balancer([u], depth=100)
+        assert bal.evaluate() is None
+        assert u.role == "unified"
+
+    def test_rerole_flag_forces_the_signal(self):
+        u = _FakeRunner("e0", "unified")
+        d = _FakeRunner("e1", "decode")
+        bal, _ = _balancer([u, d], depth=0)
+        faults.install(faults.parse_spec("sched.rerole:nth=1", seed=1))
+        assert bal.evaluate() == "to_prefill"
+        faults.clear()
+
+    def test_remote_decode_capacity_does_not_justify_a_flip(self):
+        """Review fix: remote replicas are not KV handoff targets, so a
+        member's decode engine must not drive a local unified engine
+        into a prefill role that has nowhere to hand off."""
+        u = _FakeRunner("e0", "unified")
+        rd = _FakeRunner("w1:e1", "decode")
+        rd.is_remote = True
+        rd.status = lambda: _status("w1:e1", role="decode", remote=True)
+        bal, _ = _balancer([u, rd], depth=100)
+        assert bal.evaluate() is None
+        assert u.role == "unified"
+
+    def test_role_counts_exclude_remote_proxies(self):
+        """Review fix: the engines_by_role gauge must mean the same
+        thing whichever publisher wrote last — local replicas only."""
+        u = _FakeRunner("e0", "unified")
+        d = _FakeRunner("e1", "decode")
+        r = _FakeRunner("w1:e9", "unified")
+        r.is_remote = True
+        bal, _ = _balancer([u, d, r], depth=0)
+        assert bal._role_counts() == {"unified": 1, "decode": 1}
+
+    def test_remote_engines_are_never_flipped(self):
+        u = _FakeRunner("w1:e0", "unified")
+        u.is_remote = True
+        d = _FakeRunner("e1", "decode")
+        bal, _ = _balancer([u, d], depth=100)
+        assert bal.evaluate() is None
+        assert u.role == "unified"
+
+    def test_restore_runs_even_with_decode_fleet_gone(self):
+        """Review fix: losing the decode fleet must not strand a
+        balancer-flipped engine in the prefill role — the no-decode
+        guard gates only the to_prefill direction."""
+        u = _FakeRunner("e0", "unified")
+        d = _FakeRunner("e1", "decode")
+        bal, disp = _balancer([u, d], depth=10)
+        assert bal.evaluate() == "to_prefill"
+        d.healthy = False  # the decode fleet dies
+        disp.depth = 0
+        assert bal.evaluate() == "to_unified"
+        assert u.role == "unified"
+
+    def test_stats_and_history(self):
+        u = _FakeRunner("e0", "unified")
+        d = _FakeRunner("e1", "decode")
+        bal, disp = _balancer([u, d], depth=10)
+        bal.evaluate()
+        stats = bal.stats()
+        assert stats["flipped"] == ["e0"]
+        assert stats["history"][0]["direction"] == "to_prefill"
+        assert stats["history"][0]["engine_id"] == "e0"
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestFleetConfig:
+    def test_fleet_settings_mapping(self):
+        cfg = ServerConfig.load(environ={
+            "DIS_TPU_FLEET__ENABLED": "true",
+            "DIS_TPU_FLEET__PORT": "7001",
+            "DIS_TPU_FLEET__REROLE": "true",
+            "DIS_TPU_FLEET__REROLE_HIGH_RATIO": "8.0",
+        })
+        s = cfg.fleet_settings()
+        assert s.enabled and s.port == 7001
+        assert s.rerole and s.rerole_high_ratio == 8.0
+
+    def test_queue_tenant_mapping(self):
+        cfg = ServerConfig.load(environ={
+            "DIS_TPU_QUEUE__TENANT_FAIRNESS": "true",
+            "DIS_TPU_QUEUE__TENANT_WEIGHTS": "acme=3,free=1",
+        })
+        q = cfg.queue_config()
+        assert q.tenant_fairness
+        assert q.tenant_weights == {"acme": 3.0, "free": 1.0}
+
+    @pytest.mark.parametrize("env", [
+        {"DIS_TPU_FLEET__SUSPECT_AFTER_S": "0.1"},  # <= heartbeat
+        {"DIS_TPU_FLEET__DEAD_AFTER_S": "1.0"},  # <= suspect
+        {"DIS_TPU_FLEET__REROLE_LOW_RATIO": "9.0"},  # >= high
+        {"DIS_TPU_FLEET__CONNECT": "nonsense"},
+        {"DIS_TPU_QUEUE__TENANT_WEIGHTS": "a=-1"},
+        {"DIS_TPU_QUEUE__TENANT_WEIGHTS": "a=x"},
+        {"DIS_TPU_QUEUE__TENANT_WEIGHTS": "justname"},
+    ])
+    def test_validation_rejects(self, env):
+        with pytest.raises(ConfigError):
+            ServerConfig.load(environ=env)
+
+    def test_parse_tenant_weights(self):
+        assert parse_tenant_weights("") == {}
+        assert parse_tenant_weights("a=2, b=0.5") == {"a": 2.0, "b": 0.5}
+
+
+# ---------------------------------------------------------------------------
+# Serving e2e: join -> remote token-identity -> death -> redispatch
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_pair():
+    """Registry host (1 local engine) + in-process member (1 engine)
+    joined over a real localhost fleet-wire connection."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.remote_runner import (
+        FleetWorker,
+    )
+    from distributed_inference_server_tpu.serving.server import (
+        InferenceServer,
+    )
+
+    params = llama.init_params(jax.random.PRNGKey(0), TINY,
+                               dtype=jnp.float32)
+    paged = PagedCacheConfig(num_pages=192, page_size=8,
+                             max_pages_per_seq=32)
+
+    def factory():
+        return LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(max_batch=4, prefill_buckets=(16, 64),
+                         paged=paged, warmup_compile=False),
+            dtype=jnp.float32,
+        )
+
+    host = InferenceServer(
+        factory, ByteTokenizer(), "tiny", num_engines=1,
+        auto_restart=False,
+        fleet_settings=FleetSettings(enabled=True,
+                                     heartbeat_interval_s=0.1,
+                                     suspect_after_s=0.4,
+                                     dead_after_s=0.9),
+    )
+    host.start()
+    member = InferenceServer(factory, ByteTokenizer(), "tiny",
+                             num_engines=1, auto_restart=False)
+    member.start()
+    worker = FleetWorker(
+        member.scheduler,
+        FleetSettings(connect=f"127.0.0.1:{host.fleet_server.bound_port}",
+                      heartbeat_interval_s=0.1),
+        member_id="t-w1",
+    )
+    worker.start()
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if any(getattr(r, "is_remote", False) and r.is_healthy()
+               for r in host.scheduler.engines()):
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("fleet member never joined")
+    yield host, member, worker
+    faults.clear()
+    worker.stop()
+    member.shutdown(drain_timeout_s=5.0)
+    host.shutdown(drain_timeout_s=5.0)
+
+
+def _serve(runner, rid, prompt="fleet e2e prompt"):
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+    req, sink = _req(rid, prompt=ByteTokenizer().encode(prompt))
+    runner.submit([req])
+    assert sink.ev.wait(90), f"{rid} never terminated"
+    return sink
+
+
+class TestFleetServingE2E:
+    def test_remote_serving_token_identical_then_death_redispatch(
+            self, fleet_pair):
+        """ACCEPTANCE (ISSUE 9): a request served through a RemoteRunner
+        is token-identical to a local run; killing the member with a
+        zero-token request in flight completes it via redispatch with
+        the registry reflecting the loss and a clean page audit."""
+        host, member, worker = fleet_pair
+        local = next(r for r in host.scheduler.engines()
+                     if not getattr(r, "is_remote", False))
+        remote = next(r for r in host.scheduler.engines()
+                      if getattr(r, "is_remote", False))
+        ref = _serve(local, "fe-ref")
+        assert not ref.errors
+        got = _serve(remote, "fe-remote")
+        assert not got.errors
+        assert got.toks == ref.toks and got.text == ref.text
+
+        # /server/stats fleet block while alive
+        stats = host._fleet_stats()
+        assert stats["member_counts"]["alive"] == 1
+        assert any(v == "unified" for v in stats["role_map"].values())
+        assert stats["heartbeats"].get("ok", 0) > 0
+
+        # kill the member mid-zero-token-request
+        from distributed_inference_server_tpu.models.tokenizer import (
+            ByteTokenizer,
+        )
+
+        kill_req, kill_sink = _req(
+            "fe-kill", prompt=ByteTokenizer().encode("fleet e2e prompt"))
+        remote.submit([kill_req])
+        worker._crashed = True
+        worker._close()
+        assert kill_sink.ev.wait(90), "killed request never terminated"
+        assert not kill_sink.errors, kill_sink.errors
+        assert kill_sink.dones == 1
+        assert kill_sink.toks == ref.toks  # redispatched, identical
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if host.fleet_registry.member_state("t-w1") == "dead":
+                break
+            time.sleep(0.05)
+        assert host.fleet_registry.member_state("t-w1") == "dead"
+        prom = host.metrics.prometheus_text().decode()
+        assert 'fleet_members{state="dead"} 1.0' in prom
+        snap = host.metrics.snapshot().to_dict()
+        assert snap["resilience"]["redispatched"].get("ok", 0) >= 1
+        assert local.audit() == []
+        # dead member's proxies left the routing set
+        assert not any(getattr(r, "is_remote", False)
+                       for r in host.scheduler.engines())
+
+    def test_done_usage_crosses_the_wire(self, fleet_pair):
+        # runs before the kill test? module-scope fixture + ordering:
+        # this test only needs the LOCAL engine, so it is order-proof
+        host, _, _ = fleet_pair
+        local = next(r for r in host.scheduler.engines()
+                     if not getattr(r, "is_remote", False))
+        sink = _serve(local, "fe-usage")
+        assert sink.dones == 1
+
+
+class TestTenantDepthGauge:
+    def test_stale_tenant_series_are_removed_not_kept(self):
+        """Review fix: tenant is a client-chosen string — a drained
+        tenant's series must leave /metrics entirely, or label
+        cardinality (and the per-publish write set) grows without
+        bound."""
+        m = MetricsCollector()
+        m.set_tenant_depths({"a": 3, "b": 1})
+        assert 'queue_tenant_depth{tenant="a"} 3.0' in (
+            m.prometheus_text().decode())
+        m.set_tenant_depths({"a": 2})
+        prom = m.prometheus_text().decode()
+        assert 'queue_tenant_depth{tenant="a"} 2.0' in prom
+        assert 'tenant="b"' not in prom
+        # publishing never touches more series than currently live + 1
+        m.set_tenant_depths({})
+        assert 'queue_tenant_depth{tenant=' not in (
+            m.prometheus_text().decode())
+
+
+class TestSchedulerUnregisterIf:
+    def test_identity_checked_unregister_spares_the_new_proxy(self):
+        """Review fix: a superseded session's late detach must not evict
+        the fresh proxy a reconnect registered under the same id."""
+        from distributed_inference_server_tpu.serving.scheduler import (
+            AdaptiveScheduler,
+        )
+
+        sched = AdaptiveScheduler()
+        old, _ = _remote()
+        new, _ = _remote()
+        sched.register(old)
+        # reconnect replaces the registration...
+        sched.register(new)
+        # ...then the old session's detach races in
+        assert sched.unregister_if(old.engine_id, old) is None
+        assert sched.get(new.engine_id) is new
+        # and the current owner CAN unregister itself
+        assert sched.unregister_if(new.engine_id, new) is new
+        assert sched.get(new.engine_id) is None

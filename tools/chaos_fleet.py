@@ -52,6 +52,9 @@ DEFAULT_SCENARIOS = (
     "degradation_flap",
     "warm_replica_death",
     "warm_peer_fetch_death",
+    "registry_partition",
+    "remote_runner_crash_mid_request",
+    "rerole_flap",
 )
 
 _PROMPT = "chaos is a ladder, resilience is a lattice"
@@ -126,12 +129,22 @@ def _tiny_params():
 
 def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
                 channel="inproc", auto_restart=True, warmup=False,
-                handoff_timeout_s=20.0, engine_kwargs=None):
+                handoff_timeout_s=20.0, engine_kwargs=None,
+                fleet=False, rerole=False):
     """A tiny-model fleet wired exactly like production (the
     disagg_smoke.py topology, sans HTTP): real engines, real runners,
     real dispatcher/scheduler/controller. Health loop runs hot
     (100 ms sweeps, 200 ms restart backoff) so chaos iterations stay
-    fast."""
+    fast.
+
+    ``fleet=True`` adds the multi-host control plane (docs/FLEET.md):
+    the server becomes a registry host and a second InferenceServer
+    (one unified engine) joins as a fleet member over a REAL localhost
+    TCP connection through a FleetWorker — the wire is real even though
+    the processes share an interpreter (tools/fleet_smoke.py covers the
+    true 2-process path). ``rerole=True`` arms the RoleBalancer with a
+    short cooldown, its poll thread stopped so scenarios drive
+    ``evaluate()`` deterministically."""
     import jax.numpy as jnp
 
     from distributed_inference_server_tpu.engine.engine import (
@@ -144,6 +157,7 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
     from distributed_inference_server_tpu.models.configs import TINY
     from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
     from distributed_inference_server_tpu.serving.disagg import DisaggSettings
+    from distributed_inference_server_tpu.serving.fleet import FleetSettings
     from distributed_inference_server_tpu.serving.scheduler import (
         SchedulingStrategy,
     )
@@ -160,6 +174,14 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
             dtype=jnp.float32,
         )
 
+    # aging windows sized for LOADED runners: a GIL stall from a
+    # concurrent engine compile must read as jitter, not death
+    fleet_settings = FleetSettings(
+        enabled=fleet, heartbeat_interval_s=0.1, suspect_after_s=0.6,
+        dead_after_s=1.5, rerole=rerole, rerole_high_ratio=2.0,
+        rerole_low_ratio=0.5, rerole_cooldown_s=0.3,
+        rerole_interval_s=60.0,  # scenarios drive evaluate() themselves
+    )
     srv = InferenceServer(
         factory, ByteTokenizer(), model_name="tiny-chaos",
         num_engines=len(roles), engine_roles=list(roles),
@@ -168,9 +190,70 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
         restart_backoff_s=0.2, restart_backoff_max_s=2.0,
         disagg_settings=DisaggSettings(channel=channel,
                                        handoff_timeout_s=handoff_timeout_s),
+        fleet_settings=fleet_settings,
     )
     srv.start()
+    srv._fleet_worker = None
+    srv._fleet_worker_srv = None
+    if fleet:
+        worker_srv = InferenceServer(
+            factory, ByteTokenizer(), model_name="tiny-chaos-member",
+            num_engines=1, auto_restart=auto_restart,
+            health_check_interval_s=0.1,
+        )
+        worker_srv.start()
+        srv._fleet_worker_srv = worker_srv
+        srv._fleet_worker_settings = FleetSettings(
+            connect=f"127.0.0.1:{srv.fleet_server.bound_port}",
+            heartbeat_interval_s=0.1,
+        )
+        _ensure_worker(srv)
+        orig_shutdown = srv.shutdown
+
+        def _shutdown(drain_timeout_s=30.0):
+            if srv._fleet_worker is not None:
+                srv._fleet_worker.stop()
+            worker_srv.shutdown(drain_timeout_s)
+            orig_shutdown(drain_timeout_s)
+
+        srv.shutdown = _shutdown
     return srv
+
+
+def _ensure_worker(srv, timeout_s: float = 20.0):
+    """Make sure the chaos member is connected, alive in the registry,
+    and its remote proxy is registered + healthy (a crashed member from
+    a previous seed rejoins under the same member id)."""
+    from distributed_inference_server_tpu.serving.remote_runner import (
+        FleetWorker,
+    )
+
+    fw = srv._fleet_worker
+    if fw is None or fw._crashed or not fw.is_connected():
+        if fw is not None:
+            fw.stop()
+        fw = FleetWorker(srv._fleet_worker_srv.scheduler,
+                         srv._fleet_worker_settings, member_id="chaos-w1")
+        fw.start()
+        srv._fleet_worker = fw
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if srv.fleet_registry.member_state("chaos-w1") == "alive" and any(
+            getattr(r, "is_remote", False) and r.is_healthy()
+            for r in srv.scheduler.engines()
+        ):
+            return fw
+        time.sleep(0.03)
+    raise RuntimeError("chaos fleet member failed to join")
+
+
+def _wait_member_state(srv, state: str, timeout_s: float = 10.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if srv.fleet_registry.member_state("chaos-w1") == state:
+            return True
+        time.sleep(0.03)
+    return False
 
 
 def submit(srv, rid: str, prompt: str = _PROMPT, max_tokens: int = 16,
@@ -394,6 +477,140 @@ def scenario_warm_peer_fetch_death(srv, seed: int):
     return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
 
 
+def scenario_registry_partition(srv, seed: int):
+    """Fleet control plane (docs/FLEET.md): heartbeats are dropped at
+    the registry (fleet.heartbeat) while the member process lives on —
+    the member must age alive -> suspect -> dead (its in-flight requests
+    taking the redispatch path, its proxies leaving the routing set),
+    then REJOIN on the first beat after the partition heals, with fresh
+    proxies serving again."""
+    rng = random.Random(seed)
+    sinks = []
+    _ensure_worker(srv)
+    # drop enough consecutive beats to cross dead_after_s (1.5s at a
+    # 100 ms beat), with headroom
+    _arm(f"fleet.heartbeat:nth=1,times={rng.randint(22, 30)}", seed)
+    extra = []
+    # traffic keeps flowing during the partition (routes to whatever is
+    # healthy; a zero-token request caught on the dying member must
+    # redispatch invisibly — one that already STREAMED on it may fail
+    # fast as engine_crashed, which is the documented bounded-failure
+    # contract, so success is not required here, only exactly-once)
+    for i in range(rng.randint(1, 3)):
+        submit(srv, f"part-{seed}-{i}", sinks=sinks)
+    if not _wait_member_state(srv, "dead", timeout_s=12.0):
+        extra.append("member never aged out to dead under dropped beats")
+    from distributed_inference_server_tpu.serving import faults as _faults
+
+    _faults.clear()  # heal the partition
+    if not _wait_member_state(srv, "alive", timeout_s=12.0):
+        extra.append("member never rejoined after the partition healed")
+    else:
+        _ensure_worker(srv)  # proxy re-registered and healthy
+        # the rejoined fleet MUST serve cleanly again, token-stream
+        # and all — reconvergence means service, not just state
+        rejoin_sink = submit(srv, f"part-{seed}-rejoin", sinks=sinks)
+        if rejoin_sink is not None:
+            rejoin_sink.ev.wait(60)
+            if rejoin_sink.errors:
+                extra.append(
+                    f"post-rejoin request failed: {rejoin_sink.errors}")
+    for s in sinks:
+        for _msg, code in s.errors:
+            if code != "engine_crashed":
+                extra.append(f"{s.rid}: unexpected failure code {code!r} "
+                             "(only mid-stream engine_crashed is a legal "
+                             "partition casualty)")
+    wedged = wait_terminal(sinks)
+    extra += [f"{r}: no terminal event (wedged)" for r in wedged]
+    return sinks, False, extra
+
+
+def scenario_remote_runner_crash_mid_request(srv, seed: int):
+    """A request is forwarded to a remote member and the member dies
+    with it in flight, zero tokens streamed — on the registry host's
+    wire (fleet.submit hit 1: the send itself fails) or as a worker
+    crash on receipt (hit 2: the frame lands, the member drops the
+    connection and serves nothing). Either way the request must complete
+    via crash-safe redispatch, exactly once, token-identically."""
+    rng = random.Random(seed)
+    from distributed_inference_server_tpu.engine.engine import SamplingParams
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.runner import ServerRequest
+
+    _ensure_worker(srv)
+    remote = next(r for r in srv.scheduler.engines()
+                  if getattr(r, "is_remote", False))
+    # hit 1 = RemoteRunner.submit (the wire), hit 2 = the worker's
+    # executor (crash on receipt)
+    _arm(f"fleet.submit:nth={rng.randint(1, 2)}", seed)
+    sinks = []
+    sink = ChaosSink(f"rrc-{seed}")
+    sinks.append(sink)
+    remote.submit([ServerRequest(
+        sink.rid, ByteTokenizer().encode(_PROMPT),
+        SamplingParams(max_tokens=16, temperature=0.0), sink,
+    )])
+    wedged = wait_terminal(sinks, timeout_s=60.0)
+    return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
+
+
+def scenario_rerole_flap(srv, seed: int):
+    """Hysteresis under an oscillating queue: the sched.rerole flag
+    forces the rebalance signal high on a random ~half of evaluations
+    (seeded), so the DESIRED role flips every few ticks — the cooldown
+    must bound the ACTUAL flips, traffic must keep completing, and the
+    fleet must converge back to its configured all-unified admission
+    topology once the oscillation stops."""
+    rng = random.Random(seed)
+    bal = srv.role_balancer
+    bal.stop()  # scenarios drive evaluate() deterministically
+    before = srv.metrics.fleet_counters()["reroles"]
+    sinks = []
+    _arm("sched.rerole:prob=0.5,times=1000", seed)
+    t0 = time.monotonic()
+    evals = rng.randint(30, 45)
+    for i in range(evals):
+        bal.evaluate()
+        if i % 10 == 0:
+            submit(srv, f"flapr-{seed}-{i}", max_tokens=8, sinks=sinks)
+        time.sleep(0.02)
+    from distributed_inference_server_tpu.serving import faults as _faults
+
+    _faults.clear()
+    elapsed = time.monotonic() - t0
+    # converge back: with the flag gone the real signal is low, so the
+    # balancer restores every engine it flipped (cooldown-paced)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and bal.stats()["flipped"]:
+        bal.evaluate()
+        time.sleep(0.05)
+    after = srv.metrics.fleet_counters()["reroles"]
+    flips = (after.get("to_prefill", 0) - before.get("to_prefill", 0)) + (
+        after.get("to_unified", 0) - before.get("to_unified", 0))
+    # the hysteresis bound: at most one flip per cooldown window (plus
+    # the first and the final restores, with slack for timer jitter)
+    bound = int((elapsed + 10.0) / bal.settings.rerole_cooldown_s) + 2
+    extra = []
+    if flips > bound:
+        extra.append(f"role flapping: {flips} flips in {elapsed:.1f}s "
+                     f"(cooldown {bal.settings.rerole_cooldown_s}s, "
+                     f"bound {bound})")
+    if flips < 2:
+        extra.append(f"rerole never exercised (flips={flips}) — the "
+                     "sched.rerole lever did not drive a flip cycle")
+    if bal.stats()["flipped"]:
+        extra.append(f"balancer did not restore flipped engines: "
+                     f"{bal.stats()['flipped']}")
+    roles = {r.engine_id: r.role for r in srv.scheduler.engines()
+             if not getattr(r, "is_remote", False)}
+    if "prefill" in roles.values():
+        extra.append(f"fleet did not converge back to unified: {roles}")
+    wedged = wait_terminal(sinks)
+    extra += [f"{r}: no terminal event (wedged)" for r in wedged]
+    return sinks, True, extra
+
+
 #: scenario -> (fn, fleet kwargs)
 SCENARIOS = {
     "redispatch": (scenario_redispatch, {}),
@@ -415,6 +632,19 @@ SCENARIOS = {
                                "channel": "protowire",
                                "engine_kwargs": {
                                    "native_allocator": False}}),
+    # fleet control plane (docs/FLEET.md): one registry host (one local
+    # unified engine) + one member (one unified engine) over a real
+    # localhost fleet-wire connection
+    "registry_partition": (scenario_registry_partition,
+                           {"roles": ("unified",), "fleet": True}),
+    "remote_runner_crash_mid_request": (
+        scenario_remote_runner_crash_mid_request,
+        {"roles": ("unified",), "fleet": True}),
+    # role rebalancing: one unified admission engine + one decode target
+    # (list-form roles skip parse_roles's static-topology check — the
+    # balancer IS the prefill source here)
+    "rerole_flap": (scenario_rerole_flap,
+                    {"roles": ("unified", "decode"), "rerole": True}),
 }
 
 

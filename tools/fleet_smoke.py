@@ -1,0 +1,263 @@
+"""Two-process fleet smoke (docs/FLEET.md; CI job ``fleet-smoke``).
+
+Boots a REAL two-process fleet — a registry host with one local engine
+and a worker process that joins over the fleet wire (TCP + protowire
+frames) — then proves the control plane end to end:
+
+1. **remote serving**: a request submitted on the registry host through
+   the worker's RemoteRunner proxy completes token-identically to a
+   local run (both processes build the same seeded tiny model, greedy
+   sampling — the wire must not perturb a single token);
+2. **remote death**: the worker process is SIGKILLed with a zero-token
+   request in flight; the request must complete via crash-safe
+   redispatch on the local engine — token-identically, exactly once,
+   invisibly — with ``fleet_members{state="dead"}`` reflecting the loss
+   and the local allocator passing a clean page audit.
+
+Exit 0 = clean. Any failed assertion exits 1 with the violation.
+
+    JAX_PLATFORMS=cpu python tools/fleet_smoke.py
+    python tools/fleet_smoke.py --worker --connect 127.0.0.1:PORT  # child
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+MEMBER_ID = "smoke-w1"
+_PROMPT = "the fleet is one machine with many rooms"
+
+
+def _env_setup() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _build_server(fleet_settings=None):
+    """One-engine InferenceServer on the seeded tiny model (both
+    processes build identical params: PRNGKey(0) is deterministic)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.server import InferenceServer
+
+    params = llama.init_params(jax.random.PRNGKey(0), TINY,
+                               dtype=jnp.float32)
+    paged = PagedCacheConfig(num_pages=192, page_size=8,
+                             max_pages_per_seq=32)
+
+    def factory():
+        return LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(max_batch=4, prefill_buckets=(16, 64), paged=paged,
+                         warmup_compile=False),
+            dtype=jnp.float32,
+        )
+
+    srv = InferenceServer(
+        factory, ByteTokenizer(), model_name="tiny-fleet-smoke",
+        num_engines=1, auto_restart=False, fleet_settings=fleet_settings,
+    )
+    srv.start()
+    return srv
+
+
+class _Sink:
+    def __init__(self):
+        self.toks, self.text = [], ""
+        self.errors = []
+        self.dones = 0
+        self.ev = threading.Event()
+
+    def on_token(self, token_id, text, token_index, logprob=None):
+        if token_id is not None:
+            self.toks.append(int(token_id))
+        self.text += text
+
+    def on_done(self, finish_reason, usage):
+        self.dones += 1
+        self.ev.set()
+
+    def on_error(self, message, code):
+        self.errors.append((message, code))
+        self.ev.set()
+
+
+def _request(rid: str):
+    from distributed_inference_server_tpu.engine.engine import SamplingParams
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.runner import ServerRequest
+
+    sink = _Sink()
+    req = ServerRequest(
+        rid, ByteTokenizer().encode(_PROMPT),
+        SamplingParams(max_tokens=16, temperature=0.0), sink,
+    )
+    return req, sink
+
+
+def run_worker(connect: str) -> int:
+    """Child process: one engine + a FleetWorker joined to ``connect``;
+    serves until killed."""
+    _env_setup()
+    from distributed_inference_server_tpu.serving.fleet import FleetSettings
+    from distributed_inference_server_tpu.serving.remote_runner import (
+        FleetWorker,
+    )
+
+    srv = _build_server()
+    worker = FleetWorker(
+        srv.scheduler,
+        FleetSettings(connect=connect, heartbeat_interval_s=0.2),
+        member_id=MEMBER_ID,
+    )
+    worker.start(connect_timeout_s=30.0)
+    print(f"fleet-smoke worker: joined {connect}", flush=True)
+    while True:  # serve until the parent kills us
+        time.sleep(1.0)
+
+
+def _fail(msg: str) -> int:
+    print(f"FLEET SMOKE VIOLATION: {msg}", file=sys.stderr, flush=True)
+    return 1
+
+
+def run_host() -> int:
+    _env_setup()
+    from distributed_inference_server_tpu.serving.fleet import FleetSettings
+    t0 = time.monotonic()
+    srv = _build_server(FleetSettings(
+        enabled=True, heartbeat_interval_s=0.2, suspect_after_s=1.0,
+        dead_after_s=2.0,
+    ))
+    port = srv.fleet_server.bound_port
+    print(f"fleet-smoke host: registry on 127.0.0.1:{port}", flush=True)
+
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--connect", f"127.0.0.1:{port}"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        # -- join: wait for the member and its healthy proxy ------------
+        deadline = time.monotonic() + 240.0
+        remote = None
+        while time.monotonic() < deadline:
+            remote = next((r for r in srv.scheduler.engines()
+                           if getattr(r, "is_remote", False)
+                           and r.is_healthy()), None)
+            if remote is not None:
+                break
+            if child.poll() is not None:
+                return _fail("worker process died before joining")
+            time.sleep(0.1)
+        if remote is None:
+            return _fail("worker never joined the registry")
+        print(f"fleet-smoke: member joined as {remote.engine_id} "
+              f"({time.monotonic() - t0:.1f}s)", flush=True)
+
+        # -- local reference run ---------------------------------------
+        local = next(r for r in srv.scheduler.engines()
+                     if not getattr(r, "is_remote", False))
+        ref_req, ref = _request("smoke-ref")
+        local.submit([ref_req])
+        if not ref.ev.wait(120.0) or ref.errors:
+            return _fail(f"local reference failed: {ref.errors}")
+
+        # -- 1. remote serving, token-identical ------------------------
+        r1_req, r1 = _request("smoke-remote")
+        remote.submit([r1_req])
+        if not r1.ev.wait(120.0):
+            return _fail("remote request never terminated")
+        if r1.errors:
+            return _fail(f"remote request errored: {r1.errors}")
+        if r1.toks != ref.toks or r1.text != ref.text:
+            return _fail(
+                f"remote stream diverged: {r1.toks} != {ref.toks}")
+        print("fleet-smoke: remote serving token-identical OK", flush=True)
+
+        # -- 2. kill the worker mid-zero-token-request ------------------
+        r2_req, r2 = _request("smoke-kill")
+        remote.submit([r2_req])
+        os.kill(child.pid, signal.SIGKILL)  # mid-request, pre-first-token
+        if not r2.ev.wait(120.0):
+            return _fail("killed request never terminated")
+        if r2.errors:
+            return _fail(f"killed request errored (redispatch should be "
+                         f"invisible): {r2.errors}")
+        if r2.dones != 1:
+            return _fail(f"killed request saw {r2.dones} done events")
+        if r2.toks != ref.toks:
+            return _fail(f"redispatched stream diverged: {r2.toks} != "
+                         f"{ref.toks}")
+        snap = srv.metrics.snapshot().to_dict()
+        redisp = (snap.get("resilience") or {}).get("redispatched", {})
+        if redisp.get("ok", 0) < 1:
+            return _fail(f"no redispatch recorded: {redisp}")
+        print("fleet-smoke: kill -> redispatch token-identical OK",
+              flush=True)
+
+        # -- registry convergence + metrics -----------------------------
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if srv.fleet_registry.member_state(MEMBER_ID) == "dead":
+                break
+            time.sleep(0.1)
+        else:
+            return _fail("registry never marked the killed member dead")
+        prom = srv.metrics.prometheus_text().decode()
+        if 'fleet_members{state="dead"} 1.0' not in prom:
+            return _fail("fleet_members{state=dead} gauge does not "
+                         "reflect the loss")
+        stats = srv._fleet_stats()
+        if stats["member_counts"]["dead"] != 1:
+            return _fail(f"/server/stats fleet block wrong: {stats}")
+
+        # -- page audit --------------------------------------------------
+        issues = local.audit()
+        if issues:
+            return _fail(f"page audit: {issues}")
+        print(f"fleet-smoke clean in {time.monotonic() - t0:.1f}s",
+              flush=True)
+        return 0
+    finally:
+        if child.poll() is None:
+            child.kill()
+        child.wait(timeout=10)
+        srv.shutdown(drain_timeout_s=5.0)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--worker", action="store_true",
+                    help="run as the joining worker process")
+    ap.add_argument("--connect", default="",
+                    help="registry host:port (worker mode)")
+    args = ap.parse_args()
+    if args.worker:
+        return run_worker(args.connect)
+    return run_host()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
