@@ -279,7 +279,11 @@ def main(argv=None) -> int:
         )
 
         fleet_worker = FleetWorker(
-            server.scheduler, cfg.fleet_settings(), metrics=server.metrics
+            server.scheduler, cfg.fleet_settings(), metrics=server.metrics,
+            # fleet-stitched tracing (docs/OBSERVABILITY.md): forwarded
+            # requests parent on the wire context and the finished spans
+            # ship back to the registry host
+            tracer=server.tracer,
         )
         try:
             fleet_worker.start()

@@ -524,6 +524,16 @@ def build_app(
             out = metrics.snapshot(statuses).to_dict()
         if fleet_fn is not None:
             out["fleet"] = fleet_fn()
+        recorder = getattr(handler, "recorder", None)
+        tracer = getattr(handler, "tracer", None)
+        if recorder is not None or tracer is not None:
+            blk = out.setdefault("tracing", {})
+            if tracer is not None:
+                # the tracer's own view (includes drops before metrics
+                # wiring); the metrics mirror is spans_dropped above
+                blk["tracer_dropped"] = tracer.dropped()
+            if recorder is not None:
+                blk["flight_recorder"] = recorder.stats()
         return web.json_response(out)
 
     async def prom(request: web.Request) -> web.Response:
@@ -574,23 +584,78 @@ def build_app(
             )
         return web.json_response({"status": "ok", "model": name})
 
+    _TRACE_N_MAX = 10_000
+
     async def trace(request: web.Request) -> web.Response:
+        """Finished spans from the in-memory ring, sorted by start time.
+        Filters: ``trace_id=`` (one stitched trace — remote members'
+        spans included once their FleetSpans frames merged) and
+        ``request_id=`` (every span carrying that request_id
+        attribute). ``n`` is validated: an integer in [1, 10000]."""
         tracer = getattr(handler, "tracer", None)
         if tracer is None:
             return web.json_response({"spans": []})
         try:
-            n = max(0, int(request.query.get("n", "100")))
+            n = int(request.query.get("n", "100"))
+            if not 1 <= n <= _TRACE_N_MAX:
+                raise ValueError
         except ValueError:
             return web.json_response(
                 {"error": {"message": "query parameter 'n' must be an "
-                           "integer", "error_type": "invalid_request_error",
+                           f"integer in [1, {_TRACE_N_MAX}]",
+                           "error_type": "invalid_request_error",
                            "code": "invalid_parameter"}},
                 status=400,
             )
         trace_id = request.query.get("trace_id")
+        request_id = request.query.get("request_id")
+        spans = tracer.recent(n, trace_id=trace_id, request_id=request_id)
         return web.json_response(
-            {"spans": [s.to_dict() for s in tracer.recent(n, trace_id)]}
+            {"spans": [s.to_dict() for s in spans]}
         )
+
+    async def request_timeline(request: web.Request) -> web.Response:
+        """GET /server/requests/<id> — the flight-recorder timeline:
+        events, derived phase attribution (phases partition the wall
+        clock), and the TTFT/TBT breakdown (docs/OBSERVABILITY.md)."""
+        recorder = getattr(handler, "recorder", None)
+        if recorder is None:
+            return web.json_response(
+                {"error": {"message": "flight recorder disabled",
+                           "error_type": "invalid_request_error",
+                           "code": "recorder_disabled"}},
+                status=404,
+            )
+        tl = recorder.timeline(request.match_info["id"])
+        if tl is None:
+            return web.json_response(
+                {"error": {"message": "no timeline for this request id "
+                           "(expired from the bounded recorder, or never "
+                           "admitted)",
+                           "error_type": "invalid_request_error",
+                           "code": "unknown_request"}},
+                status=404,
+            )
+        return web.json_response(tl)
+
+    async def request_list(request: web.Request) -> web.Response:
+        recorder = getattr(handler, "recorder", None)
+        if recorder is None:
+            return web.json_response({"requests": []})
+        try:
+            n = int(request.query.get("n", "50"))
+            if not 1 <= n <= 1000:
+                raise ValueError
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "query parameter 'n' must be an "
+                           "integer in [1, 1000]",
+                           "error_type": "invalid_request_error",
+                           "code": "invalid_parameter"}},
+                status=400,
+            )
+        return web.json_response({"requests": recorder.recent(n),
+                                  "stats": recorder.stats()})
 
     async def profile(request: web.Request) -> web.Response:
         """Device-trace capture (SURVEY §5 device-tracing bar;
@@ -710,6 +775,8 @@ def build_app(
     app.router.add_post("/admin/scale", scale)
     app.router.add_post("/server/profile", profile)
     app.router.add_get("/server/trace", trace)
+    app.router.add_get("/server/requests", request_list)
+    app.router.add_get("/server/requests/{id}", request_timeline)
     app.router.add_post("/admin/model-swap", model_swap)
     app.router.add_post("/generate", generate)
     app.router.add_post("/chat", chat)

@@ -150,7 +150,12 @@ class KVTransferChannel:
         raise NotImplementedError
 
     def transfer_chunks(self, request_id, wire_quant: str,
-                        chunks: List[KvChunk]) -> List[KvChunk]:
+                        chunks: List[KvChunk],
+                        trace: Optional[tuple] = None) -> List[KvChunk]:
+        """``trace`` is the request's ``Span.context()`` tuple (or
+        None): it rides the KvHandoffHeader so the receiving side can
+        parent its import span on the request's trace
+        (docs/OBSERVABILITY.md)."""
         return chunks
 
     def transfer_commit(self, exp: SequenceExport,
@@ -160,12 +165,17 @@ class KVTransferChannel:
         return dataclasses.replace(exp, kv_chunks=list(tail))
 
     def transfer_fetch_request(self, request_id, hashes: Sequence[int],
-                               chunk_pages: int, wire_quant: str) -> tuple:
+                               chunk_pages: int, wire_quant: str,
+                               trace: Optional[tuple] = None) -> tuple:
         """Move the fetch_prefix REQUEST half toward the peer (fleet
         prefix sharing, PrefixFetcher): returns ``(request_id, hashes,
-        chunk_pages, wire_quant)`` as the peer will see them. The
-        response travels back as KvChunks via ``transfer_chunks``."""
-        return request_id, list(hashes), chunk_pages, wire_quant
+        chunk_pages, wire_quant, trace)`` as the peer will see them —
+        ``trace`` is the (trace_id, parent_span_id) context the fetch
+        span parents on, round-tripped through the KvPrefixFetch wire
+        fields under protowire. The response travels back as KvChunks
+        via ``transfer_chunks``."""
+        return (request_id, list(hashes), chunk_pages, wire_quant,
+                tuple(trace) if trace else None)
 
 
 class InProcessChannel(KVTransferChannel):
@@ -241,17 +251,23 @@ def export_from_wire(data: bytes) -> SequenceExport:
 # frames so the format is differentially tested on every migration.
 
 
-def chunks_to_frames(request_id, wire_quant: str, chunks: List[KvChunk]):
+def chunks_to_frames(request_id, wire_quant: str, chunks: List[KvChunk],
+                     trace: Optional[tuple] = None):
     """Frame a chunk batch as ``(message_name, frame_bytes)`` pairs:
     one KvHandoffHeader, then one KvChunk per chunk — the sender half of
     the chunk-iterator channel API, framed lazily so a transport can put
-    each frame on the wire while the next serializes."""
+    each frame on the wire while the next serializes. ``trace`` is the
+    request's (trace_id, parent_span_id) context; it rides the header
+    so the receiver's spans stitch into the request's trace."""
     hid = str(request_id)
-    yield "KvHandoffHeader", protowire.encode("KvHandoffHeader", {
+    header = {
         "handoff_id": hid,
         "request_id": str(request_id),
         "wire_quant": wire_quant,
-    })
+    }
+    if trace:
+        header["trace_id"], header["parent_span_id"] = trace
+    yield "KvHandoffHeader", protowire.encode("KvHandoffHeader", header)
     for c in chunks:
         yield "KvChunk", protowire.encode("KvChunk", {
             "handoff_id": hid,
@@ -264,12 +280,12 @@ def chunks_to_frames(request_id, wire_quant: str, chunks: List[KvChunk]):
         })
 
 
-def stream_to_frames(exp: SequenceExport):
+def stream_to_frames(exp: SequenceExport, trace: Optional[tuple] = None):
     """Frame a chunked SequenceExport: header, its chunks, then the
     terminal KvHandoff frame carrying the host state (kv bytes empty —
     the pages moved in the chunks)."""
     yield from chunks_to_frames(exp.request_id, exp.wire_quant,
-                                exp.kv_chunks or [])
+                                exp.kv_chunks or [], trace=trace)
     yield "KvHandoff", export_to_wire(exp)
 
 
@@ -331,9 +347,10 @@ class ProtowireChannel(KVTransferChannel):
         return export_from_wire(export_to_wire(exp))
 
     def transfer_chunks(self, request_id, wire_quant: str,
-                        chunks: List[KvChunk]) -> List[KvChunk]:
+                        chunks: List[KvChunk],
+                        trace: Optional[tuple] = None) -> List[KvChunk]:
         _header, wired, _state = frames_to_parts(
-            chunks_to_frames(request_id, wire_quant, chunks)
+            chunks_to_frames(request_id, wire_quant, chunks, trace=trace)
         )
         return wired
 
@@ -344,17 +361,22 @@ class ProtowireChannel(KVTransferChannel):
         ))
 
     def transfer_fetch_request(self, request_id, hashes: Sequence[int],
-                               chunk_pages: int, wire_quant: str) -> tuple:
+                               chunk_pages: int, wire_quant: str,
+                               trace: Optional[tuple] = None) -> tuple:
+        obj = {
+            "request_id": str(request_id),
+            "hashes": [int(h) for h in hashes],
+            "chunk_pages": chunk_pages,
+            "wire_quant": wire_quant,
+        }
+        if trace:
+            obj["trace_id"], obj["parent_span_id"] = trace
         d = protowire.decode("KvPrefixFetch", protowire.encode(
-            "KvPrefixFetch", {
-                "request_id": str(request_id),
-                "hashes": [int(h) for h in hashes],
-                "chunk_pages": chunk_pages,
-                "wire_quant": wire_quant,
-            },
-        ))
+            "KvPrefixFetch", obj))
+        wire_trace = ((d.get("trace_id"), d.get("parent_span_id"))
+                      if d.get("trace_id") else None)
         return (d["request_id"], d["hashes"], d["chunk_pages"],
-                d["wire_quant"] or "none")
+                d["wire_quant"] or "none", wire_trace)
 
 
 def make_channel(name: str) -> KVTransferChannel:
@@ -392,6 +414,9 @@ class _StreamJob:
     target: Any = None  # decode EngineRunner, set when opened
     status: str = "opening"  # opening | ready | failed | cancelled
     error: str = ""
+    # kv.handoff span (docs/OBSERVABILITY.md), parented on the request's
+    # trace context — the same context the KvHandoffHeader carries
+    span: Any = None
 
 
 @dataclass
@@ -405,6 +430,9 @@ class _MigrationJob:
     # set on a phase-2 (switchover commit) job: the opened stream whose
     # target already holds the prefix
     stream: Optional[_StreamJob] = None
+    # kv.handoff span for MONOLITHIC migrations (streamed jobs carry it
+    # on their _StreamJob)
+    span: Any = None
 
 
 class DisaggController:
@@ -427,9 +455,19 @@ class DisaggController:
         metrics: Optional[MetricsCollector] = None,
         channel: Optional[KVTransferChannel] = None,
         settings: Optional[DisaggSettings] = None,
+        tracer=None,
+        recorder=None,
     ):
+        """``tracer``/``recorder`` (docs/OBSERVABILITY.md): migrations
+        get a ``kv.handoff`` span parented on the request's trace
+        context (the same context the KvHandoffHeader carries across
+        the channel) and note handoff phases into the request's
+        flight-recorder timeline — the stall windows feed the
+        ``handoff_stall`` phase attribution."""
         self.scheduler = scheduler
         self.metrics = metrics
+        self.tracer = tracer
+        self.recorder = recorder
         self.channel = channel or InProcessChannel()
         self.settings = settings or DisaggSettings()
         self._jobs: Deque[_MigrationJob] = deque()
@@ -491,6 +529,40 @@ class DisaggController:
                     job.stream.request_id)
             self._fallback(job, "controller shutdown")
 
+    # -- observability helpers ---------------------------------------------
+
+    def _trace_ctx(self, req) -> Optional[tuple]:
+        span = getattr(req, "span", None)
+        return span.context() if span is not None else None
+
+    def _start_handoff_span(self, req, source, streamed: bool):
+        """A ``kv.handoff`` span parented on the request's trace — the
+        SAME context the KvHandoffHeader carries, so a cross-process
+        receiver would stitch identically (docs/OBSERVABILITY.md)."""
+        if self.tracer is None:
+            return None
+        ctx = self._trace_ctx(req)
+        if ctx is None:
+            return None
+        return self.tracer.start(
+            "kv.handoff", parent=ctx, request_id=str(req.request_id),
+            source=source.engine_id, streamed=streamed,
+        )
+
+    @staticmethod
+    def _span_holder(mjob: _MigrationJob):
+        return mjob.stream if mjob.stream is not None else mjob
+
+    def _finish_handoff_span(self, holder, outcome: str, **attrs) -> None:
+        span, holder.span = getattr(holder, "span", None), None
+        if span is not None and self.tracer is not None:
+            span.set(outcome=outcome, **attrs)
+            self.tracer.finish(span)
+
+    def _note(self, req, name: str, **attrs) -> None:
+        if self.recorder is not None:
+            self.recorder.note(req.request_id, name, **attrs)
+
     # -- submission (runner threads) ---------------------------------------
 
     def enqueue(self, exp: SequenceExport, req, source) -> None:
@@ -500,7 +572,9 @@ class DisaggController:
         job = _MigrationJob(
             exp=exp, req=req, source=source,
             deadline=time.monotonic() + self.settings.handoff_timeout_s,
+            span=self._start_handoff_span(req, source, streamed=False),
         )
+        self._note(req, "handoff_export", source=source.engine_id)
         with self._cv:
             if self._accepting:
                 self._jobs.append(job)
@@ -620,12 +694,16 @@ class DisaggController:
             n_prefix_pages=n_prefix_pages, wire_quant=wire_quant,
             req=req, source=source,
             deadline=time.monotonic() + self.settings.handoff_timeout_s,
+            span=self._start_handoff_span(req, source, streamed=True),
         )
         with self._cv:
             if self._accepting:
+                self._note(req, "handoff_export",
+                           source=source.engine_id, streamed=True)
                 self._jobs.append(job)
                 self._cv.notify()
                 return job
+        self._finish_handoff_span(job, "not_accepting")
         return None
 
     def _open_stream(self, job: _StreamJob) -> None:
@@ -645,7 +723,8 @@ class DisaggController:
             for _ in job.chunks:
                 faults.fire("disagg.chunk")
             wired = self.channel.transfer_chunks(
-                job.request_id, job.wire_quant, job.chunks
+                job.request_id, job.wire_quant, job.chunks,
+                trace=self._trace_ctx(job.req),
             )
             target = self.scheduler.schedule_decode(
                 exclude=job.source.engine_id
@@ -716,10 +795,15 @@ class DisaggController:
                 pass
             target = job.target
             job.status = "cancelled"
+        self._finish_handoff_span(job,
+                                  "fallback" if record else "cancelled")
         if target is not None:
             target.submit_import_abort(job.request_id)
-        if record and self.metrics:
-            self.metrics.record_handoff("fallback")
+        if record:
+            if self.metrics:
+                self.metrics.record_handoff("fallback")
+            self._note(job.req, "handoff_fallback",
+                       reason=job.error or "cancelled")
 
     def _commit_stream_job(self, mjob: _MigrationJob) -> None:
         """Phase 2 on the worker: tail + host state through the channel,
@@ -759,14 +843,25 @@ class DisaggController:
                     return
                 if err == "aborted":
                     return
+                now = time.monotonic()
+                stall = (now - mjob.exp.stalled_at
+                         if mjob.exp.stalled_at else None)
+                self._finish_handoff_span(
+                    self._span_holder(mjob), "ok",
+                    target=target.engine_id,
+                    chunks=len(mjob.exp.kv_chunks or []),
+                )
+                self._note(mjob.req, "handoff_resume",
+                           target=target.engine_id,
+                           chunks=len(mjob.exp.kv_chunks or []),
+                           **({"stall_s": stall}
+                              if stall is not None else {}))
                 if self.metrics:
-                    now = time.monotonic()
                     self.metrics.record_handoff(
                         "ok",
                         latency_s=now - mjob.enqueued_at,
                         nbytes=mjob.exp.kv_bytes(),
-                        stall_s=(now - mjob.exp.stalled_at
-                                 if mjob.exp.stalled_at else None),
+                        stall_s=stall,
                         chunks=len(mjob.exp.kv_chunks or []),
                     )
             else:
@@ -838,17 +933,28 @@ class DisaggController:
                         return
                     if err == "aborted":
                         return  # resolved by an abort, not a transfer
+                    now = time.monotonic()
+                    # decode pause the migrated sequence actually
+                    # observed: switchover (streamed) or export start
+                    # (monolithic) until the resume landed
+                    stall = (now - job.exp.stalled_at
+                             if job.exp.stalled_at else None)
+                    self._finish_handoff_span(
+                        self._span_holder(job), "ok",
+                        target=target.engine_id,
+                        chunks=len(job.exp.kv_chunks or []),
+                    )
+                    self._note(job.req, "handoff_resume",
+                               target=target.engine_id,
+                               chunks=len(job.exp.kv_chunks or []),
+                               **({"stall_s": stall}
+                                  if stall is not None else {}))
                     if self.metrics:
-                        now = time.monotonic()
                         self.metrics.record_handoff(
                             "ok",
                             latency_s=now - job.enqueued_at,
                             nbytes=job.exp.kv_bytes(),
-                            # decode pause the migrated sequence actually
-                            # observed: switchover (streamed) or export
-                            # start (monolithic) until the resume landed
-                            stall_s=(now - job.exp.stalled_at
-                                     if job.exp.stalled_at else None),
+                            stall_s=stall,
                             chunks=len(job.exp.kv_chunks or []),
                         )
                 else:
@@ -879,12 +985,14 @@ class DisaggController:
         ``pending_count()`` or some runner's ``active_count()``."""
         if self._consume_abort(job):
             return
+        stall = (time.monotonic() - job.exp.stalled_at
+                 if job.exp.stalled_at else None)
+        self._finish_handoff_span(self._span_holder(job), "fallback",
+                                  reason=err)
+        self._note(job.req, "handoff_fallback", reason=err,
+                   **({"stall_s": stall} if stall is not None else {}))
         if self.metrics:
-            self.metrics.record_handoff(
-                "fallback",
-                stall_s=(time.monotonic() - job.exp.stalled_at
-                         if job.exp.stalled_at else None),
-            )
+            self.metrics.record_handoff("fallback", stall_s=stall)
 
         def _done(ok: bool, import_err: Optional[str]) -> None:
             if not ok:
@@ -964,10 +1072,18 @@ class PrefixFetcher:
 
     def __init__(self, channel: Optional[KVTransferChannel] = None,
                  settings: Optional[DisaggSettings] = None,
-                 metrics: Optional[MetricsCollector] = None):
+                 metrics: Optional[MetricsCollector] = None,
+                 tracer=None, recorder=None):
+        """``tracer``/``recorder`` (docs/OBSERVABILITY.md): each fetch
+        gets a ``kv.prefix_fetch`` span parented on the trace context
+        that round-tripped through the KvPrefixFetch wire fields, and
+        settles a ``prefix_fetch`` timeline event whose duration feeds
+        the ``peer_fetch`` phase attribution."""
         self.channel = channel or InProcessChannel()
         self.settings = settings or DisaggSettings()
         self.metrics = metrics
+        self.tracer = tracer
+        self.recorder = recorder
         self._lock = threading.Lock()
         # request_id -> aborted? for fetches in flight (score→submit)
         self._fetching: Dict[Any, bool] = {}
@@ -1004,6 +1120,7 @@ class PrefixFetcher:
         rid = req.request_id
         ps = max(1, plan.page_size)
         t0 = time.monotonic()
+        fetch_span = [None]  # set after the request half round-trips
         with self._lock:
             self._fetching[rid] = False
 
@@ -1018,9 +1135,20 @@ class PrefixFetcher:
             # the runners under a request it should have completed.
             with self._lock:
                 aborted = self._fetching.get(rid, False)
+            seconds = time.monotonic() - t0
+            span, fetch_span[0] = fetch_span[0], None
+            if span is not None and self.tracer is not None:
+                span.set(outcome=outcome, bytes=nbytes)
+                self.tracer.finish(span)
+            if self.recorder is not None:
+                # the seconds attr feeds the peer_fetch phase window
+                self.recorder.note(rid, "prefix_fetch", outcome=outcome,
+                                   seconds=seconds, bytes=nbytes,
+                                   peer=peer.engine_id,
+                                   target=target.engine_id)
             if self.metrics:
                 self.metrics.record_prefix_fetch(
-                    outcome, seconds=time.monotonic() - t0, nbytes=nbytes
+                    outcome, seconds=seconds, nbytes=nbytes
                 )
             try:
                 if not aborted:
@@ -1053,8 +1181,11 @@ class PrefixFetcher:
                 # one hit per chunk, so nth=N drops the Nth chunk
                 for _ in chunks:
                     faults.fire("kv.peer_fetch")
+                req_span = getattr(req, "span", None)
                 wired = self.channel.transfer_chunks(
-                    rid, self.settings.wire_quant, chunks
+                    rid, self.settings.wire_quant, chunks,
+                    trace=(req_span.context()
+                           if req_span is not None else None),
                 )
             except Exception as e:  # noqa: BLE001 — channel fault domain
                 logger.debug("prefix fetch for %s: channel %s failed "
@@ -1087,11 +1218,15 @@ class PrefixFetcher:
 
         try:
             # the request half crosses the channel too, so the
-            # KvPrefixFetch wire format is exercised on every fetch
-            rid_w, hashes_w, chunk_pages, wire_quant = (
+            # KvPrefixFetch wire format (trace context included) is
+            # exercised on every fetch
+            req_span = getattr(req, "span", None)
+            rid_w, hashes_w, chunk_pages, wire_quant, trace_w = (
                 self.channel.transfer_fetch_request(
                     rid, plan.prefix_hashes or (),
                     self.settings.chunk_pages, self.settings.wire_quant,
+                    trace=(req_span.context()
+                           if req_span is not None else None),
                 )
             )
         except Exception as e:  # noqa: BLE001 — channel fault domain
@@ -1099,6 +1234,14 @@ class PrefixFetcher:
                          "(%s); recomputing", rid, e)
             _settle("fallback")
             return
+        if self.tracer is not None and trace_w:
+            # parented on the WIRE's round-tripped context — exactly
+            # what a cross-host peer would parent on
+            fetch_span[0] = self.tracer.start(
+                "kv.prefix_fetch", parent=tuple(trace_w),
+                request_id=str(rid), peer=peer.engine_id,
+                target=target.engine_id,
+            )
         peer.submit_prefix_export(rid_w, hashes_w, chunk_pages,
                                   wire_quant, _on_export)
 

@@ -104,6 +104,7 @@ class Dispatcher:
         disagg=None,
         max_redispatch: int = 2,
         prefix_fetcher=None,
+        recorder=None,
     ):
         """``disagg``: the DisaggController when the topology is
         disaggregated (serving/disagg.py) — its migration queue counts
@@ -116,11 +117,15 @@ class Dispatcher:
         ``fetch`` decisions under cache_aware (fleet prefix sharing,
         docs/CACHING.md); its in-flight fetches count toward drain and
         aborts reach requests parked there. None = fetch decisions
-        degrade to plain submission."""
+        degrade to plain submission.
+        ``recorder``: the per-request FlightRecorder
+        (serving/flightrec.py) — routing decisions, redispatch hops, and
+        queue expiries land in request timelines. None = disabled."""
         self.scheduler = scheduler
         self.disagg = disagg
         self.prefix_fetcher = prefix_fetcher
         self.tracer = tracer
+        self.recorder = recorder
         self.max_redispatch = max_redispatch
         self.queue: PriorityQueueManager[ServerRequest] = _make_queue(
             queue_config, native_queue
@@ -244,7 +249,13 @@ class Dispatcher:
             request.span.set(redispatch_from=from_engine,
                              redispatch_to=runner.engine_id,
                              redispatch_reason=reason)
-            request.span.event("redispatched")
+            request.span.event("redispatched", from_engine=from_engine,
+                               to_engine=runner.engine_id, reason=reason)
+        if self.recorder is not None:
+            self.recorder.note(request.request_id, "redispatch",
+                               from_engine=from_engine,
+                               to_engine=runner.engine_id, reason=reason,
+                               attempt=request.redispatches)
         runner.submit([request])
         # counted only after submit took the request — a submit that
         # raises is NOT an "ok" outcome (the caller fails the sink)
@@ -316,6 +327,16 @@ class Dispatcher:
             by_engine: dict = {}
             for r, (runner, plan) in zip(requests, plans):
                 decision = plan.decision if plan is not None else "recompute"
+                if self.recorder is not None and plan is not None:
+                    # the schedule decision with its plan_route cost
+                    # terms — the timeline's "why did it go THERE"
+                    self.recorder.note(
+                        r.request_id, "route_plan",
+                        strategy="cache_aware", decision=decision,
+                        engine=plan.engine_id, depth=plan.depth,
+                        peer_depth=plan.peer_depth,
+                        **({"peer": plan.peer_id} if plan.peer_id else {}),
+                    )
                 if decision == "fetch" and runner is not None:
                     peer = self.scheduler.get(plan.peer_id)
                     if peer is not None:
@@ -375,14 +396,23 @@ class Dispatcher:
             if self.tracer:
                 for r in requests:
                     if r.span is not None:
-                        # Span.event takes only a name; the reason rides
-                        # as an attribute
                         r.span.set(dispatch_failed="no_workers")
-                        r.span.event("dispatch_failed")
+                        r.span.event("dispatch_failed",
+                                     reason="no_workers")
             for r in requests:
+                if self.recorder is not None:
+                    self.recorder.finish(r.request_id, "error",
+                                         code="no_workers")
                 r.sink.on_error("no healthy inference engine available",
                                 "no_workers")
             return
+        if self.recorder is not None:
+            for r in requests:
+                self.recorder.note(
+                    r.request_id, "schedule",
+                    engine=runner.engine_id,
+                    strategy=self.scheduler.strategy().value,
+                )
         if self.tracer:
             # batching-phase event (S12): one per admission batch; recorded
             # only for batches that actually reach an engine
@@ -406,6 +436,9 @@ class Dispatcher:
         ``requests_expired_total``."""
         expired = self.queue.remove_expired(now)
         for q in expired:
+            if self.recorder is not None:
+                self.recorder.finish(q.data.request_id, "error",
+                                     code="queue_timeout")
             q.data.sink.on_error(
                 "request expired in queue before dispatch", "queue_timeout"
             )

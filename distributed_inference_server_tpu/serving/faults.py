@@ -249,6 +249,28 @@ def parse_spec(spec: str, seed: int = 0) -> FaultSet:
 # -- module-level registry (the injection points' view) ---------------------
 
 _active: Optional[FaultSet] = None
+# arm/disarm observers (serving/flightrec.py): flight recorders note
+# fault-injection hops into their fleet-event windows so a postmortem
+# timeline shows WHEN the chaos lever moved. A LIST, not a slot: the
+# chaos fleet topology runs two InferenceServers (registry host +
+# member) in one interpreter, and the host's recorder must not lose the
+# events to the member's. Never on the fire() hot path — only
+# install/clear transitions report.
+_observers: List = []
+
+
+def add_observer(cb) -> None:
+    """Register ``cb(event, **attrs)``; called on install/clear only.
+    Pair with ``remove_observer`` (server shutdown) or the registry
+    grows across server lifetimes."""
+    _observers.append(cb)
+
+
+def remove_observer(cb) -> None:
+    try:
+        _observers.remove(cb)
+    except ValueError:
+        pass
 
 
 def install(faults: Optional[FaultSet]) -> None:
@@ -261,6 +283,16 @@ def install(faults: Optional[FaultSet]) -> None:
             "fault injection ARMED (seed=%d, points: %s) — never in "
             "production", faults.seed, ", ".join(sorted(faults._rules)),
         )
+    for cb in list(_observers):
+        try:
+            if faults is not None:
+                cb("faults_armed", seed=faults.seed,
+                   points=sorted(faults._rules))
+            else:
+                cb("faults_cleared")
+        except Exception:  # noqa: BLE001 — observability must not gate
+            # the chaos lever
+            logger.debug("fault observer failed", exc_info=True)
 
 
 def clear() -> None:

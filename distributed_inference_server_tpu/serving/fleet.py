@@ -46,6 +46,7 @@ drives reroles deterministically).
 
 from __future__ import annotations
 
+import json
 import logging
 import socket
 import struct
@@ -61,6 +62,7 @@ from distributed_inference_server_tpu.serving.metrics import (
     EngineStatus,
     MetricsCollector,
 )
+from distributed_inference_server_tpu.utils.tracing import Span
 
 logger = logging.getLogger(__name__)
 
@@ -101,6 +103,9 @@ FRAME_KINDS: Dict[int, str] = {
     1: "FleetHeartbeat",
     2: "FleetSubmit",
     3: "FleetEvent",
+    # fleet-stitched tracing (docs/OBSERVABILITY.md): finished member
+    # spans, batched at heartbeat cadence, worker -> registry host
+    4: "FleetSpans",
 }
 _KIND_BY_NAME = {name: kind for kind, name in FRAME_KINDS.items()}
 
@@ -195,6 +200,75 @@ def status_from_wire(d: Dict[str, Any], member_id: str) -> EngineStatus:
         digest_depth=d.get("digest_depth", 0),
         host_tier=host,
         remote=True,
+    )
+
+
+def _attrs_to_json(attrs: Dict[str, Any]) -> str:
+    if not attrs:
+        return ""
+    try:
+        return json.dumps(attrs, default=str)
+    except (TypeError, ValueError):
+        return json.dumps({k: str(v) for k, v in attrs.items()})
+
+
+def _attrs_from_json(blob: str) -> Dict[str, Any]:
+    if not blob:
+        return {}
+    try:
+        obj = json.loads(blob)
+        return obj if isinstance(obj, dict) else {}
+    except ValueError:
+        return {}
+
+
+def span_to_wire(s: Span, epoch_offset_ns: int) -> Dict[str, Any]:
+    """Span -> TraceSpan wire dict. Timestamps go out as EPOCH ns
+    (``epoch_offset_ns`` = time_ns() - monotonic_ns() of the SENDER), so
+    the receiver can re-base into its own monotonic domain — the only
+    residual error is wall-clock skew between hosts, same as OTLP."""
+    start = s.start_ns + epoch_offset_ns
+    return {
+        "name": s.name,
+        "trace_id": s.trace_id,
+        "span_id": s.span_id,
+        "parent_id": s.parent_id or "",
+        "start_unix_ns": max(0, start),
+        "duration_ns": max(0, (s.end_ns or s.start_ns) - s.start_ns),
+        "status": s.status or "ok",
+        "attrs_json": _attrs_to_json(s.attributes),
+        "events": [
+            {"offset_ns": max(0, t - s.start_ns), "name": n,
+             "attrs_json": _attrs_to_json(a)}
+            for t, n, a in s.events
+        ],
+    }
+
+
+def span_from_wire(d: Dict[str, Any], epoch_offset_ns: int,
+                   member_id: str = "") -> Span:
+    """TraceSpan wire dict -> Span in the RECEIVER's monotonic domain.
+    ``member_id`` is stamped as a ``member`` attribute so a stitched
+    trace shows which process each span ran in."""
+    start = max(0, d.get("start_unix_ns", 0) - epoch_offset_ns)
+    duration = max(0, d.get("duration_ns", 0))
+    attrs = _attrs_from_json(d.get("attrs_json", ""))
+    if member_id:
+        attrs.setdefault("member", member_id)
+    return Span(
+        name=d.get("name", ""),
+        trace_id=d.get("trace_id", ""),
+        span_id=d.get("span_id", ""),
+        parent_id=d.get("parent_id") or None,
+        start_ns=start,
+        end_ns=start + duration,
+        attributes=attrs,
+        events=[
+            (start + e.get("offset_ns", 0), e.get("name", ""),
+             _attrs_from_json(e.get("attrs_json", "")))
+            for e in d.get("events", [])
+        ],
+        status=d.get("status") or "ok",
     )
 
 
@@ -441,6 +515,13 @@ class _MemberSession:
                     self._on_heartbeat(obj)
                 elif name == "FleetEvent":
                     self._on_event(obj)
+                elif name == "FleetSpans":
+                    # finished member spans: merge into the host tracer
+                    # (even from a member the registry has aged out — a
+                    # dying member's last spans are exactly the ones a
+                    # postmortem needs)
+                    self.server.ingest_spans(
+                        obj, self.member_id or obj.get("member_id", ""))
                 # FleetSubmit frames only flow host -> worker; one
                 # arriving here is a confused peer — ignore it
         except (OSError, FleetWireError) as e:
@@ -527,12 +608,23 @@ class FleetServer:
         settings: Optional[FleetSettings] = None,
         metrics: Optional[MetricsCollector] = None,
         redispatch: Optional[Callable] = None,
+        tracer=None,
+        recorder=None,
     ):
+        """``tracer``: the host Tracer — remote members' FleetSpans
+        frames merge into it (one stitched cross-process trace per
+        request, docs/OBSERVABILITY.md). ``recorder``: the host
+        FlightRecorder — RemoteRunner proxies note token/terminal
+        events into per-request timelines."""
         self.registry = registry
         self.scheduler = scheduler
         self.settings = settings or FleetSettings()
         self.metrics = metrics
         self.redispatch = redispatch
+        self.tracer = tracer
+        self.recorder = recorder
+        # monotonic <-> epoch re-basing for ingested remote spans
+        self._epoch_offset_ns = time.time_ns() - time.monotonic_ns()
         self._sessions: List[_MemberSession] = []
         # member_id -> its CURRENT session: a reconnect replaces the
         # entry, so the superseded session's late EOF can neither kill
@@ -619,6 +711,32 @@ class FleetServer:
                     and self._by_member.get(session.member_id) is session):
                 self._by_member.pop(session.member_id, None)
 
+    # -- span ingest (session reader threads) ------------------------------
+
+    def ingest_spans(self, obj: Dict[str, Any], member_id: str) -> None:
+        """Merge one FleetSpans frame into the host tracer: each span is
+        re-based into this host's monotonic domain and stamped with its
+        member id, then exported through every sink (ring + OTLP) with
+        its original trace/span/parent ids intact — the operator's
+        ``/server/trace?trace_id=`` and the OTLP backend both see ONE
+        correctly-parented cross-process tree. Spans the member shed
+        before shipping count as wire drops."""
+        if self.tracer is None:
+            return
+        member = member_id or obj.get("member_id", "")
+        dropped = obj.get("dropped", 0)
+        if dropped:
+            self.tracer.record_drop("wire", int(dropped))
+        for d in obj.get("spans", []):
+            try:
+                self.tracer.ingest(
+                    span_from_wire(d, self._epoch_offset_ns, member))
+            except Exception:  # noqa: BLE001 — one bad span must not
+                # drop its whole batch
+                logger.debug("undecodable remote span from %s", member,
+                             exc_info=True)
+                self.tracer.record_drop("wire")
+
     # -- runner materialization (session reader threads) -------------------
 
     def _refresh_runners(self, session: _MemberSession, member_id: str,
@@ -648,6 +766,7 @@ class FleetServer:
                         local_engine_id=local_id,
                         send=session.send,
                         metrics=self.metrics,
+                        recorder=self.recorder,
                     )
                     runner.redispatch = self.redispatch
                     session.runners[local_id] = runner
@@ -706,11 +825,16 @@ class RoleBalancer:
 
     def __init__(self, scheduler, dispatcher,
                  settings: Optional[FleetSettings] = None,
-                 metrics: Optional[MetricsCollector] = None):
+                 metrics: Optional[MetricsCollector] = None,
+                 recorder=None):
+        """``recorder`` (serving/flightrec.py): role flips land in the
+        flight recorder's fleet-event window, so a request's timeline
+        shows a rerole that happened mid-flight."""
         self.scheduler = scheduler
         self.dispatcher = dispatcher
         self.settings = settings or FleetSettings()
         self.metrics = metrics
+        self.recorder = recorder
         self._lock = threading.Lock()
         self._flipped: Dict[str, float] = {}  # engine_id -> flip time
         self._last_flip = 0.0
@@ -780,6 +904,9 @@ class RoleBalancer:
                     self._record(runner.engine_id, direction, sig)
         if direction:
             logger.info("fleet rerole %s (signal %.2f)", direction, sig)
+            if self.recorder is not None:
+                self.recorder.note_global("rerole", direction=direction,
+                                          signal=round(sig, 3))
             if self.metrics:
                 self.metrics.record_rerole(direction)
                 self.metrics.set_engines_by_role(self._role_counts())

@@ -95,13 +95,18 @@ class InferenceHandler:
         validator: Optional[RequestValidator] = None,
         metrics: Optional[MetricsCollector] = None,
         tracer=None,
+        recorder=None,
     ):
+        """``recorder``: the per-request FlightRecorder
+        (serving/flightrec.py) — admission opens the request's timeline
+        here; the rest of the spine notes into it. None = disabled."""
         self.dispatcher = dispatcher
         self.tok = tokenizer
         self.model_name = model_name
         self.validator = validator or RequestValidator()
         self.metrics = metrics
         self.tracer = tracer
+        self.recorder = recorder
         # request_id -> (span, monotonic insert time). Entries are popped on
         # completion; the TTL sweep in _submit covers streaming generators
         # that are created but never iterated (their finally never runs).
@@ -162,6 +167,15 @@ class InferenceHandler:
         if span is not None:
             self._sweep_stale_spans()
             self._spans_by_request[request_id] = (span, time.monotonic())
+        if self.recorder is not None:
+            # the flight-recorder timeline opens at admission; the
+            # trace_id links it to the stitched span tree
+            self.recorder.admit(
+                request_id, endpoint=endpoint,
+                prompt_tokens=len(prompt_ids), priority=priority.name,
+                tenant=tenant,
+                **({"trace_id": span.trace_id} if span is not None else {}),
+            )
         return request_id
 
     def _sweep_stale_spans(self) -> None:

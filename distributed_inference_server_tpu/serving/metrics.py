@@ -126,6 +126,10 @@ class MetricsSnapshot:
     # redispatch, or queue expiry happened): per-engine restart attempts,
     # redispatch outcomes, and queue-timeout expiries
     resilience: Optional[Dict[str, Any]] = None
+    # observability block (docs/OBSERVABILITY.md; None until any span
+    # was dropped or any request's phases were attributed): span drops
+    # by reason + cumulative phase-attribution sums
+    tracing: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out = {
@@ -147,6 +151,8 @@ class MetricsSnapshot:
             out["cache"] = self.cache
         if self.resilience is not None:
             out["resilience"] = self.resilience
+        if self.tracing is not None:
+            out["tracing"] = self.tracing
         return out
 
 
@@ -387,6 +393,27 @@ class MetricsCollector:
             "queue.tenant_fairness)", ["tenant"],
             registry=r,
         )
+        # observability spine (docs/OBSERVABILITY.md): spans lost before
+        # an operator could see them — ring eviction, exporter failure,
+        # or fleet-wire buffer overflow — and the flight recorder's
+        # derived per-request phase attribution
+        self.trace_drops = Counter(
+            "trace_spans_dropped_total",
+            "Finished spans dropped before reaching an operator (ring = "
+            "evicted from the bounded in-memory ring, exporter = an "
+            "exporter failed or overflowed, wire = the fleet span buffer "
+            "overflowed before shipping)", ["reason"],
+            registry=r,
+        )
+        self.request_phases = Histogram(
+            "request_phase_seconds",
+            "Per-request wall-clock attributed to lifecycle phases by "
+            "the flight recorder (serving/flightrec.py): queue_wait | "
+            "prefill | peer_fetch | handoff_stall | decode | detok",
+            ["phase"], registry=r,
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1,
+                     2, 5, 10, 30),
+        )
 
         # snapshot internals
         self._total_requests = 0
@@ -418,6 +445,9 @@ class MetricsCollector:
         self._fleet_heartbeats: Dict[str, int] = {}
         self._fleet_reroles: Dict[str, int] = {}
         self._tenants_seen: set = set()
+        self._trace_drops: Dict[str, int] = {}
+        self._phase_sums: Dict[str, float] = {}
+        self._phase_requests = 0
 
     # -- recording ---------------------------------------------------------
 
@@ -601,6 +631,29 @@ class MetricsCollector:
         stable dotted label, e.g. "runner.sink_error")."""
         self.errors_total.labels(site=site).inc()
 
+    def record_trace_drops(self, reason: str, n: int = 1) -> None:
+        """``n`` finished spans were lost for ``reason`` (ring |
+        exporter | wire) — wired as ``Tracer.on_drop`` by the server so
+        the tracer's internal accounting surfaces in /metrics and
+        ``/server/stats`` (docs/OBSERVABILITY.md)."""
+        if n <= 0:
+            return
+        self.trace_drops.labels(reason=reason).inc(n)
+        with self._lock:
+            self._trace_drops[reason] = self._trace_drops.get(reason, 0) + n
+
+    def record_request_phases(self, phases: Dict[str, float]) -> None:
+        """One finished request's derived phase attribution
+        (serving/flightrec.py): seconds per lifecycle phase."""
+        for phase, seconds in phases.items():
+            self.request_phases.labels(phase=phase).observe(seconds)
+        with self._lock:
+            self._phase_requests += 1
+            for phase, seconds in phases.items():
+                self._phase_sums[phase] = (
+                    self._phase_sums.get(phase, 0.0) + seconds
+                )
+
     def set_fleet_members(self, counts: Dict[str, int]) -> None:
         """Fleet members per registry state (serving/fleet.py): all
         three states are always published so a dead member reads as
@@ -733,6 +786,16 @@ class MetricsCollector:
                     "redispatched": dict(self._redispatches),
                     "requests_expired": self._requests_expired,
                 }
+            tracing = None
+            if self._trace_drops or self._phase_requests:
+                tracing = {
+                    "spans_dropped": dict(self._trace_drops),
+                    "phase_requests": self._phase_requests,
+                    "phase_seconds": {
+                        k: round(v, 6)
+                        for k, v in sorted(self._phase_sums.items())
+                    },
+                }
             disagg = None
             if self._handoffs or any(
                 s.role != "unified" for s in engine_statuses
@@ -768,4 +831,5 @@ class MetricsCollector:
                 disagg=disagg,
                 cache=cache,
                 resilience=resilience,
+                tracing=tracing,
             )

@@ -241,6 +241,10 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         7: ("stop_sequences", "string", "rep"),
         8: ("tenant", "string", "one"),
         9: ("abort", "bool", "one"),
+        # distributed trace context (docs/OBSERVABILITY.md): the member
+        # parents its fleet.serve span on this; "" = untraced
+        10: ("trace_id", "string", "one"),
+        11: ("parent_span_id", "string", "one"),
     },
     "FleetEvent": {
         1: ("request_id", "string", "one"),
@@ -255,6 +259,32 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         10: ("completion_tokens", "uint32", "one"),
         11: ("message", "string", "one"),
         12: ("code", "string", "one"),
+    },
+    # Fleet-stitched distributed tracing (docs/OBSERVABILITY.md):
+    # finished member spans batched back to the registry host at
+    # heartbeat cadence (fleet-wire frame kind 4). Timestamps are EPOCH
+    # nanoseconds — each process re-bases its own monotonic clock on the
+    # wire, so the receiver can merge into its own monotonic domain.
+    "TraceEvent": {
+        1: ("offset_ns", "uint64", "one"),
+        2: ("name", "string", "one"),
+        3: ("attrs_json", "string", "one"),
+    },
+    "TraceSpan": {
+        1: ("name", "string", "one"),
+        2: ("trace_id", "string", "one"),
+        3: ("span_id", "string", "one"),
+        4: ("parent_id", "string", "one"),
+        5: ("start_unix_ns", "uint64", "one"),
+        6: ("duration_ns", "uint64", "one"),
+        7: ("status", "string", "one"),
+        8: ("attrs_json", "string", "one"),
+        9: ("events", "msg:TraceEvent", "rep"),
+    },
+    "FleetSpans": {
+        1: ("member_id", "string", "one"),
+        2: ("spans", "msg:TraceSpan", "rep"),
+        3: ("dropped", "uint64", "one"),
     },
     "ErrorDetail": {
         1: ("message", "string", "one"),
@@ -271,6 +301,9 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         1: ("handoff_id", "string", "one"),
         2: ("request_id", "string", "one"),
         3: ("wire_quant", "string", "one"),
+        # distributed trace context (docs/OBSERVABILITY.md)
+        4: ("trace_id", "string", "one"),
+        5: ("parent_span_id", "string", "one"),
     },
     "KvChunk": {
         1: ("handoff_id", "string", "one"),
@@ -291,6 +324,9 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         2: ("hashes", "uint64", "rep"),
         3: ("chunk_pages", "uint32", "one"),
         4: ("wire_quant", "string", "one"),
+        # distributed trace context (docs/OBSERVABILITY.md)
+        5: ("trace_id", "string", "one"),
+        6: ("parent_span_id", "string", "one"),
     },
     # Disaggregated prefill/decode serving (serving/disagg.py): a live
     # sequence lifted off a prefill engine for cross-process KV transfer.

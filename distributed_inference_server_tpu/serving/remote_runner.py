@@ -38,7 +38,8 @@ import logging
 import socket
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 
 from distributed_inference_server_tpu.core.models import FinishReason, Usage
 from distributed_inference_server_tpu.engine.engine import SamplingParams
@@ -50,6 +51,7 @@ from distributed_inference_server_tpu.serving.fleet import (
     parse_connect,
     recv_frame,
     send_frame,
+    span_to_wire,
     status_to_wire,
 )
 from distributed_inference_server_tpu.serving.metrics import (
@@ -82,15 +84,20 @@ class RemoteRunner:
         local_engine_id: str,
         send: Callable[[str, Dict[str, Any]], None],
         metrics: Optional[MetricsCollector] = None,
+        recorder=None,
     ):
         """``engine_id`` is the fleet-namespaced proxy id
         (``<member>:<engine>``); ``local_engine_id`` is what the member
         itself calls the engine (what FleetSubmit frames carry);
         ``send(name, obj)`` writes one frame on the member session and
-        raises when the transport is gone."""
+        raises when the transport is gone. ``recorder`` is the host's
+        FlightRecorder (serving/flightrec.py): a remote-served request's
+        token/terminal instants land in its host-side timeline here —
+        the proxy is where the host observes them."""
         self.engine_id = engine_id
         self.local_engine_id = local_engine_id
         self.metrics = metrics
+        self.recorder = recorder
         self._send = send
         # wired by the FleetServer to Dispatcher.redispatch
         self.redispatch: Optional[Callable] = None
@@ -206,7 +213,7 @@ class RemoteRunner:
             for r in reqs:
                 # forwarded submit dies on the wire (docs/RESILIENCE.md)
                 faults.fire("fleet.submit")
-                self._send("FleetSubmit", {
+                frame = {
                     "request_id": str(r.request_id),
                     "engine_id": self.local_engine_id,
                     "prompt_ids": [int(t) for t in r.prompt_ids],
@@ -215,7 +222,16 @@ class RemoteRunner:
                     "top_p": r.params.top_p,
                     "stop_sequences": list(r.params.stop_sequences),
                     "tenant": getattr(r, "tenant", "") or "",
-                })
+                }
+                span = getattr(r, "span", None)
+                if span is not None:
+                    # trace context rides the wire: the member parents
+                    # its fleet.serve span on it and ships the finished
+                    # span back — one stitched cross-process trace
+                    # (docs/OBSERVABILITY.md)
+                    frame["trace_id"], frame["parent_span_id"] = \
+                        span.context()
+                self._send("FleetSubmit", frame)
         except Exception as e:  # noqa: BLE001 — transport fault domain
             self._last_error = f"fleet submit failed: {e}"
             # fail only THIS batch: already-sent requests are popped
@@ -269,8 +285,11 @@ class RemoteRunner:
                         if self.metrics:
                             self.metrics.record_ttft(
                                 req.first_token_at - req.submitted_at)
-                    if ev.get("token_id") is not None and self.metrics:
-                        self.metrics.record_tokens(1)
+                    if ev.get("token_id") is not None:
+                        if self.metrics:
+                            self.metrics.record_tokens(1)
+                        if self.recorder is not None:
+                            self.recorder.token(rid)
                     req.sink.on_token(ev.get("token_id"),
                                       ev.get("text", ""),
                                       ev.get("token_index", 0),
@@ -286,6 +305,8 @@ class RemoteRunner:
                     except ValueError:
                         reason = FinishReason.STOP
                     self._total_processed += 1
+                    if self.recorder is not None:
+                        self.recorder.finish(rid, "ok")
                     req.sink.on_done(reason, usage)
             except Exception as e:  # noqa: BLE001 — sink isolation
                 self._inflight.pop(rid, None)
@@ -304,6 +325,8 @@ class RemoteRunner:
                     return  # the new owner resolves the sink
             except Exception as e:  # noqa: BLE001 — hook isolation
                 self._absorbed("redispatch", e)
+        if self.recorder is not None:
+            self.recorder.finish(req.request_id, "error", code=code)
         try:
             req.sink.on_error(message, code)
         except Exception as e:  # noqa: BLE001
@@ -334,6 +357,8 @@ class RemoteRunner:
                 except Exception as e:  # noqa: BLE001 — hook isolation
                     self._absorbed("redispatch", e)
             code = "worker_failure" if zero_tokens else "engine_crashed"
+            if self.recorder is not None:
+                self.recorder.finish(req.request_id, "error", code=code)
             try:
                 req.sink.on_error(message, code)
             except Exception as e:  # noqa: BLE001
@@ -356,13 +381,21 @@ class _RemoteSink:
     host. Runs on the worker's engine-runner threads; send failures are
     absorbed — a dead registry connection means the host has already
     failed the request onto its redispatch path, so there is no one to
-    tell."""
+    tell. ``span`` is the worker-side ``fleet.serve`` span (parented on
+    the wire's trace context); the sink owns finishing it — a finished
+    span is what ships back to the host."""
 
     def __init__(self, worker: "FleetWorker", request_id: str,
-                 engine_id: str):
+                 engine_id: str, span=None):
         self._worker = worker
         self._rid = request_id
         self._eid = engine_id
+        self._span = span
+
+    def _finish_span(self, status: str) -> None:
+        span, self._span = self._span, None
+        if span is not None and self._worker.tracer is not None:
+            self._worker.tracer.finish(span, status=status)
 
     def _event(self, obj: Dict[str, Any]) -> None:
         obj["request_id"] = self._rid
@@ -379,6 +412,7 @@ class _RemoteSink:
         self._event(ev)
 
     def on_done(self, finish_reason, usage) -> None:
+        self._finish_span("ok")
         self._event({
             "kind": "done",
             "finish_reason": getattr(finish_reason, "value",
@@ -388,6 +422,7 @@ class _RemoteSink:
         })
 
     def on_error(self, message, code) -> None:
+        self._finish_span("error")
         self._event({"kind": "error", "message": message or "",
                      "code": code or "inference_failed"})
 
@@ -399,14 +434,26 @@ class FleetWorker:
     registry host bounces (a rejoin — the registry re-materializes
     fresh proxies)."""
 
+    #: cap on spans buffered between heartbeats and per FleetSpans
+    #: frame — the trace channel must never amplify into the data path
+    SPAN_BUFFER = 512
+    SPANS_PER_FRAME = 256
+
     def __init__(self, scheduler, settings: FleetSettings,
                  metrics: Optional[MetricsCollector] = None,
-                 member_id: Optional[str] = None):
+                 member_id: Optional[str] = None,
+                 tracer=None):
         """``scheduler`` is the worker's own AdaptiveScheduler (the
-        local runners to serve against)."""
+        local runners to serve against). ``tracer`` (the worker
+        process's Tracer) turns on fleet-stitched tracing: forwarded
+        requests get a ``fleet.serve`` span parented on the wire's
+        trace context, and every span this process finishes ships back
+        to the registry host in bounded FleetSpans batches at heartbeat
+        cadence (docs/OBSERVABILITY.md)."""
         self.scheduler = scheduler
         self.settings = settings
         self.metrics = metrics
+        self.tracer = tracer
         import os
 
         self.member_id = (member_id or settings.member_id
@@ -420,6 +467,16 @@ class FleetWorker:
         self._beat_thread: Optional[threading.Thread] = None
         self._reader: Optional[threading.Thread] = None
         self._seq = 0
+        # finished spans awaiting shipment (beat thread drains); the
+        # buffer is bounded — overflow counts as a wire drop locally AND
+        # rides the next frame's `dropped` field so the HOST's counter
+        # stays truthful even though the spans never crossed
+        self._span_buf: Deque = deque()
+        self._span_lock = threading.Lock()
+        self._span_dropped = 0
+        self._epoch_offset_ns = time.time_ns() - time.monotonic_ns()
+        if tracer is not None:
+            tracer.exporters.append(self._buffer_span)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -438,6 +495,15 @@ class FleetWorker:
         if self._beat_thread is not None:
             self._beat_thread.join(5.0)
             self._beat_thread = None
+        # detach the span exporter: a restarted worker (chaos rebuilds
+        # one per crash iteration against the SAME tracer) must not
+        # leave dead buffers behind — each would pin 512 spans forever
+        # and inflate wire-drop counts on every finished span
+        if self.tracer is not None:
+            try:
+                self.tracer.exporters.remove(self._buffer_span)
+            except ValueError:
+                pass
 
     def is_connected(self) -> bool:
         return self._sock is not None
@@ -488,6 +554,53 @@ class FleetWorker:
             if self.metrics:
                 self.metrics.record_error("fleet_worker.event_send")
 
+    def _buffer_span(self, span) -> None:
+        """Tracer exporter: queue a finished span for the next shipment
+        (any thread; bounded — never blocks the finishing thread)."""
+        overflowed = False
+        with self._span_lock:
+            if len(self._span_buf) >= self.SPAN_BUFFER:
+                self._span_buf.popleft()
+                self._span_dropped += 1
+                overflowed = True
+            self._span_buf.append(span)
+        if overflowed and self.tracer is not None:
+            self.tracer.record_drop("wire")
+
+    def ship_spans_once(self) -> bool:
+        """Send one FleetSpans frame with everything buffered (capped at
+        SPANS_PER_FRAME; the overflow counts as dropped). Piggybacks on
+        the heartbeat cadence — the beat loop calls this right after a
+        successful beat. Returns False when the link is down (the spans
+        are counted dropped, not retried: a trace is advisory, the
+        reconnect path must not grow a replay buffer)."""
+        if self.tracer is None:
+            return True
+        with self._span_lock:
+            if not self._span_buf and not self._span_dropped:
+                return True
+            spans = list(self._span_buf)
+            self._span_buf.clear()
+            dropped, self._span_dropped = self._span_dropped, 0
+        shipped = spans[:self.SPANS_PER_FRAME]
+        dropped += len(spans) - len(shipped)
+        try:
+            self._send("FleetSpans", {
+                "member_id": self.member_id,
+                "spans": [span_to_wire(s, self._epoch_offset_ns)
+                          for s in shipped],
+                "dropped": dropped,
+            })
+            return True
+        except Exception as e:  # noqa: BLE001 — link fault domain
+            logger.debug("fleet worker %s: span ship failed: %s",
+                         self.member_id, e)
+            with self._span_lock:
+                self._span_dropped += len(shipped) + dropped
+            if self.tracer is not None:
+                self.tracer.record_drop("wire", len(shipped))
+            return False
+
     def heartbeat_once(self) -> bool:
         """Send one heartbeat; returns False when the link is down."""
         self._seq += 1
@@ -509,7 +622,8 @@ class FleetWorker:
         while not self._stop.wait(self.settings.heartbeat_interval_s):
             if self._crashed:
                 return  # injected crash: the process is "dead"
-            if self._sock is None or not self.heartbeat_once():
+            if (self._sock is None or not self.heartbeat_once()
+                    or not self.ship_spans_once()):
                 self._close()
                 if self._stop.is_set() or self._crashed:
                     return
@@ -560,7 +674,19 @@ class FleetWorker:
         # the member crashing on receipt (fault domain of the REMOTE
         # process): raises InjectedFault through to the read loop
         faults.fire("fleet.submit")
-        sink = _RemoteSink(self, rid, engine_id)
+        span = None
+        if self.tracer is not None and obj.get("trace_id"):
+            # parent on the WIRE's trace context: this span (and the
+            # engine.infer child the local runner hangs under it) ships
+            # back finished, stitching into the host's request tree
+            span = self.tracer.start(
+                "fleet.serve",
+                parent=(obj["trace_id"],
+                        obj.get("parent_span_id") or None),
+                request_id=rid, engine_id=engine_id,
+                member_id=self.member_id,
+            )
+        sink = _RemoteSink(self, rid, engine_id, span=span)
         if runner is None or not runner.is_healthy():
             sink.on_error(
                 f"remote engine {engine_id!r} unavailable", "worker_failure"
@@ -575,6 +701,7 @@ class FleetWorker:
                 stop_sequences=tuple(obj.get("stop_sequences", [])),
             ),
             sink,
+            span=span,
             tenant=obj.get("tenant") or "default",
         )
         runner.submit([req])

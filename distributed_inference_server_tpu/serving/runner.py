@@ -101,6 +101,7 @@ class EngineRunner:
         tracer=None,
         role: str = "unified",
         disagg=None,
+        recorder=None,
     ):
         """``role`` ("prefill" | "decode" | "unified") and ``disagg``
         (the DisaggController) enable disaggregated serving
@@ -108,13 +109,19 @@ class EngineRunner:
         prefill-only and exports each finished prefill to the controller
         for migration; a decode runner receives them via
         ``submit_resume``. Unified (the default) is today's monolithic
-        behavior exactly."""
+        behavior exactly.
+
+        ``recorder`` (serving/flightrec.py): first-token / decode-block
+        / terminal events land in the per-request flight-recorder
+        timeline. None (the default) keeps the per-token path free of
+        recorder work entirely."""
         self.engine_id = engine_id
         self.role = role
         self._disagg = disagg
         self._factory = engine_factory
         self.metrics = metrics
         self.tracer = tracer
+        self.recorder = recorder
         # crash-safe redispatch hook (docs/RESILIENCE.md): the server
         # wires this to Dispatcher.redispatch. Called from _fail_all_of
         # for an in-flight request that streamed ZERO tokens; returns
@@ -264,6 +271,7 @@ class EngineRunner:
                         r.engine_span = self.tracer.start(
                             "engine.infer", parent=r.span.context(),
                             engine_id=self.engine_id,
+                            request_id=str(r.request_id),
                             prompt_tokens=len(r.prompt_ids),
                         )
                     self._engine.add_request(r.request_id, r.prompt_ids,
@@ -555,6 +563,9 @@ class EngineRunner:
                 # loop would busy-spin on a zombie forever
                 self._engine.abort(rid)
                 self._inflight.pop(rid, None)
+                if self.recorder is not None:
+                    self.recorder.finish(rid, "error",
+                                         code="handoff_failed")
                 try:
                     req.sink.on_error(f"KV export failed: {e}",
                                       "handoff_failed")
@@ -632,6 +643,8 @@ class EngineRunner:
             self._drop_export_job(rid, job, record=False)
             self._engine.abort(rid)
             self._inflight.pop(rid, None)
+            if self.recorder is not None:
+                self.recorder.finish(rid, "error", code="handoff_failed")
             try:
                 req.sink.on_error(f"KV export failed: {e}",
                                   "handoff_failed")
@@ -1031,6 +1044,9 @@ class EngineRunner:
             terminal_delivered = False
             try:
                 if out.error is not None:
+                    if self.recorder is not None:
+                        self.recorder.finish(out.request_id, "error",
+                                             code="inference_failed")
                     req.sink.on_error(out.error, "inference_failed")
                     terminal_delivered = True
                 elif out.token_id is not None or out.text:
@@ -1044,6 +1060,8 @@ class EngineRunner:
                             req.engine_span.event("first_token")
                     if out.token_id is not None:
                         tokens += 1
+                        if self.recorder is not None:
+                            self.recorder.token(out.request_id)
                     if not out.finished:
                         req.sink.on_token(out.token_id, out.text,
                                           out.token_index, out.logprob)
@@ -1057,6 +1075,8 @@ class EngineRunner:
                             out.usage or Usage(),
                         )
                         terminal_delivered = True
+                        if self.recorder is not None:
+                            self.recorder.finish(out.request_id, "ok")
                     if self.tracer and req.engine_span is not None:
                         if out.usage is not None:
                             req.engine_span.set(
@@ -1079,6 +1099,9 @@ class EngineRunner:
                 # — a second terminal event would contradict the stream
                 # contract.
                 if not terminal_delivered:
+                    if self.recorder is not None:
+                        self.recorder.finish(out.request_id, "error",
+                                             code="server_error")
                     try:
                         req.sink.on_error(f"sink failure: {e}",
                                           "server_error")
@@ -1226,6 +1249,8 @@ class EngineRunner:
                     self._absorbed("redispatch", e)
             code = ("worker_failure" if req.first_token_at is None
                     else "engine_crashed")
+            if self.recorder is not None:
+                self.recorder.finish(req.request_id, "error", code=code)
             try:
                 req.sink.on_error(message, code)
             except Exception as e:  # noqa: BLE001

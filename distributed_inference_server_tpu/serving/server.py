@@ -101,10 +101,29 @@ class InferenceServer:
         networking, no rebalancing — today's behavior exactly."""
         from distributed_inference_server_tpu.utils.tracing import Tracer
 
+        from distributed_inference_server_tpu.serving.flightrec import (
+            FlightRecorder,
+        )
+
         self.engine_factory = engine_factory
         self.model_resolver = model_resolver
         self.metrics = MetricsCollector()
         self.tracer = Tracer()
+        # drop accounting (docs/OBSERVABILITY.md): ring eviction,
+        # exporter failure, and fleet-wire buffer overflow surface as
+        # trace_spans_dropped_total{reason=...} instead of a debug log
+        self.tracer.on_drop = self.metrics.record_trace_drops
+        # per-request flight recorder: the spine notes lifecycle events
+        # into bounded timelines served at GET /server/requests/<id>
+        self.recorder = FlightRecorder(metrics=self.metrics)
+        from distributed_inference_server_tpu.serving import faults as _faults
+
+        # fault arm/disarm hops land in the recorder's fleet window so a
+        # postmortem timeline shows when the chaos lever moved; the
+        # bound method is held so shutdown can unregister THIS server's
+        # observer (chaos builds several servers per interpreter)
+        self._fault_observer = self.recorder.note_global
+        _faults.add_observer(self._fault_observer)
         self.otlp = None
         if otlp_endpoint:
             from distributed_inference_server_tpu.utils.otlp import (
@@ -142,6 +161,8 @@ class InferenceServer:
                 metrics=self.metrics,
                 channel=make_channel(settings.channel),
                 settings=settings,
+                tracer=self.tracer,
+                recorder=self.recorder,
             )
             self.metrics.set_engines_by_role(
                 DisaggController.role_counts(self._roles)
@@ -153,6 +174,8 @@ class InferenceServer:
             channel=make_channel(settings.channel),
             settings=settings,
             metrics=self.metrics,
+            tracer=self.tracer,
+            recorder=self.recorder,
         )
         self.dispatcher = Dispatcher(
             self.scheduler,
@@ -163,6 +186,7 @@ class InferenceServer:
             disagg=self.disagg,
             max_redispatch=max_redispatch,
             prefix_fetcher=self.prefix_fetcher,
+            recorder=self.recorder,
         )
         from distributed_inference_server_tpu.native import make_validator
 
@@ -176,6 +200,7 @@ class InferenceServer:
             validator=make_validator(validator_config),
             metrics=self.metrics,
             tracer=self.tracer,
+            recorder=self.recorder,
         )
         from distributed_inference_server_tpu.serving.degradation import (
             DegradationController,
@@ -202,11 +227,14 @@ class InferenceServer:
                 self.fleet_registry, self.scheduler, self.fleet_settings,
                 metrics=self.metrics,
                 redispatch=self.dispatcher.redispatch,
+                tracer=self.tracer,
+                recorder=self.recorder,
             )
         if self.fleet_settings.rerole:
             self.role_balancer = RoleBalancer(
                 self.scheduler, self.dispatcher, self.fleet_settings,
                 metrics=self.metrics,
+                recorder=self.recorder,
             )
         self._num_engines = num_engines
         self._next_engine_idx = 0
@@ -250,6 +278,9 @@ class InferenceServer:
             runner.shutdown()
         if self.otlp is not None:
             self.otlp.shutdown()
+        from distributed_inference_server_tpu.serving import faults as _faults
+
+        _faults.remove_observer(self._fault_observer)
         self._started = False
 
     # -- elasticity --------------------------------------------------------
@@ -265,6 +296,7 @@ class InferenceServer:
         runner = EngineRunner(
             engine_id, _bind_factory(self.engine_factory, idx), self.metrics,
             tracer=self.tracer, role=role, disagg=self.disagg,
+            recorder=self.recorder,
         )
         # crash-safe redispatch (docs/RESILIENCE.md): a dead runner hands
         # its zero-token in-flight requests back to the dispatcher, which

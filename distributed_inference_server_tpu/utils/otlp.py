@@ -67,11 +67,16 @@ class OTLPExporter:
         self._epoch_offset_ns = time.time_ns() - time.monotonic_ns()
         self.exported = 0
         self.dropped = 0
+        # the tracer this exporter is attached to (drop accounting:
+        # queue overflow and failed flushes count as "exporter" drops in
+        # trace_spans_dropped_total instead of vanishing here)
+        self._tracer: Optional[Tracer] = None
 
     # -- lifecycle ----------------------------------------------------------
 
     def attach(self, tracer: Tracer) -> "OTLPExporter":
         tracer.exporters.append(self.export)
+        self._tracer = tracer
         self.start()
         return self
 
@@ -92,11 +97,15 @@ class OTLPExporter:
     # -- tracer sink --------------------------------------------------------
 
     def export(self, span: Span) -> None:
+        overflowed = False
         with self._lock:
             if len(self._queue) == self._queue.maxlen:
                 self.dropped += 1
+                overflowed = True
             self._queue.append(span)
             n = len(self._queue)
+        if overflowed and self._tracer is not None:
+            self._tracer.record_drop("exporter")
         if n >= self.batch_size:
             self._wake.set()
 
@@ -131,6 +140,8 @@ class OTLPExporter:
             # thread and recorders concurrently (distlint DL002)
             with self._lock:
                 self.dropped += len(spans)
+            if self._tracer is not None:
+                self._tracer.record_drop("exporter", len(spans))
 
     # -- OTLP encoding ------------------------------------------------------
 
@@ -150,8 +161,9 @@ class OTLPExporter:
                 "endTimeUnixNano": str((s.end_ns or s.start_ns) + off),
                 "attributes": _attrs(s.attributes),
                 "events": [
-                    {"timeUnixNano": str(t + off), "name": n}
-                    for t, n in s.events
+                    {"timeUnixNano": str(t + off), "name": n,
+                     **({"attributes": _attrs(a)} if a else {})}
+                    for t, n, a in s.events
                 ],
                 "status": {"code": 1 if s.status == "ok" else 2},
             })

@@ -4,14 +4,26 @@ The reference spec'd OpenTelemetry spans for request lifecycle, batching,
 inference, and streaming phases (S12; ``requirements.md:122``,
 ``tasks.md:285-288`` [spec]). The opentelemetry SDK is not in this image,
 so this module provides the same span model — trace_id/span_id/parent,
-monotonic start/end, attributes, events — with two sinks: a bounded
-in-memory ring (introspection via ``/server/trace``) and optional logging.
-If an OTel SDK is present at runtime it can be bridged by replacing the
-exporter (``Tracer.exporters``), keeping call sites unchanged.
+monotonic start/end, attributes, structured events — with two sinks: a
+bounded in-memory ring (introspection via ``/server/trace``) and optional
+logging. If an OTel SDK is present at runtime it can be bridged by
+replacing the exporter (``Tracer.exporters``), keeping call sites
+unchanged.
 
 Cross-thread propagation is explicit: the serving spine hands a span's
 ``context()`` across thread boundaries (HTTP asyncio -> dispatcher ->
 runner) instead of relying on contextvars, because requests hop threads.
+Cross-PROCESS propagation rides the wire: ``FleetSubmit`` /
+``KvHandoffHeader`` / ``KvPrefixFetch`` carry ``trace_id`` /
+``parent_span_id`` fields, remote processes parent their spans on that
+context, and finished remote spans ship back to the registry host over
+``FleetSpans`` frames to be merged via ``Tracer.ingest`` — one request,
+one stitched trace (docs/OBSERVABILITY.md).
+
+Nothing here may drop spans silently: ring overflow, exporter failures,
+and wire-buffer overflow all count into the drop table
+(``trace_spans_dropped_total{reason=ring|exporter|wire}`` once the
+server wires ``on_drop`` to the metrics collector).
 """
 
 from __future__ import annotations
@@ -23,9 +35,21 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Deque, Dict, Iterator, List, Optional, Tuple
+from typing import (
+    Callable,
+    Deque,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Tuple,
+)
 
 log = logging.getLogger(__name__)
+
+#: legal drop reasons (the metric's label set is closed on purpose —
+#: a free-form reason string would grow the series set unboundedly)
+DROP_REASONS = ("ring", "exporter", "wire")
 
 
 @dataclass
@@ -37,7 +61,10 @@ class Span:
     start_ns: int
     end_ns: int = 0
     attributes: Dict[str, object] = field(default_factory=dict)
-    events: List[Tuple[int, str]] = field(default_factory=list)
+    #: (monotonic_ns, name, attrs) — attrs is {} for bare events, so the
+    #: flight recorder and OTLP bridge can rely on the 3-tuple shape
+    events: List[Tuple[int, str, Dict[str, object]]] = field(
+        default_factory=list)
     status: str = "ok"
 
     @property
@@ -48,11 +75,15 @@ class Span:
         self.attributes.update(attrs)
         return self
 
-    def event(self, name: str) -> None:
+    def event(self, name: str, **attrs) -> None:
+        """Record a structured span event. ``attrs`` ride with the event
+        (the PR 5 postmortem: the old no-kwargs signature turned
+        ``span.event("redispatched", reason=...)`` into a runtime
+        TypeError on a path only exercised under real crashes)."""
         # a span is owned by one thread at a time — its context() hands
         # off with the request (module docstring); list.append is
         # GIL-atomic for the rare overlap  # distlint: ignore[DL008]
-        self.events.append((time.monotonic_ns(), name))
+        self.events.append((time.monotonic_ns(), name, attrs))
 
     def context(self) -> Tuple[str, str]:
         """(trace_id, span_id) to parent a child span on another thread."""
@@ -68,8 +99,9 @@ class Span:
             "duration_ms": self.duration_ms,
             "attributes": self.attributes,
             "events": [
-                {"offset_ms": (t - self.start_ns) / 1e6, "name": n}
-                for t, n in self.events
+                {"offset_ms": (t - self.start_ns) / 1e6, "name": n,
+                 **({"attributes": a} if a else {})}
+                for t, n, a in self.events
             ],
             "status": self.status,
         }
@@ -84,6 +116,11 @@ class Tracer:
         self.exporters: List[Callable[[Span], None]] = [self._to_ring]
         if log_spans:
             self.exporters.append(self._to_log)
+        # drop accounting (never silent, module docstring): reason ->
+        # count, guarded by _lock; ``on_drop(reason, n)`` additionally
+        # forwards to the metrics collector when the server wires it
+        self._dropped: Dict[str, int] = {r: 0 for r in DROP_REASONS}
+        self.on_drop: Optional[Callable[[str, int], None]] = None
 
     # -- span lifecycle ----------------------------------------------------
 
@@ -110,11 +147,23 @@ class Tracer:
         # (handler pops it from _spans_by_request first)
         span.end_ns = time.monotonic_ns()  # distlint: ignore[DL008]
         span.status = status  # distlint: ignore[DL008]
+        self._export(span)
+
+    def ingest(self, span: Span) -> None:
+        """Merge an already-FINISHED span into this tracer's sinks — the
+        registry host's entry point for remote members' spans arriving
+        over ``FleetSpans`` frames (serving/fleet.py). The span keeps its
+        own trace/span/parent ids, so the merged ring (and the OTLP
+        exporter) renders one correctly-parented cross-process tree."""
+        self._export(span)
+
+    def _export(self, span: Span) -> None:
         for export in self.exporters:
             try:
                 export(span)
             except Exception:  # noqa: BLE001 — tracing must never break serving
                 log.debug("span exporter %r failed", export, exc_info=True)
+                self.record_drop("exporter")
 
     @contextlib.contextmanager
     def span(
@@ -131,11 +180,37 @@ class Tracer:
             raise
         self.finish(s)
 
+    # -- drop accounting ---------------------------------------------------
+
+    def record_drop(self, reason: str, n: int = 1) -> None:
+        """Count ``n`` spans lost for ``reason`` ("ring" = evicted from
+        the bounded ring unread, "exporter" = an exporter raised, "wire"
+        = the fleet span buffer overflowed before shipping)."""
+        if reason not in self._dropped:
+            reason = "exporter"
+        with self._lock:
+            self._dropped[reason] += n
+        hook = self.on_drop
+        if hook is not None:
+            try:
+                hook(reason, n)
+            except Exception:  # noqa: BLE001 — accounting must not raise
+                log.debug("trace drop hook failed", exc_info=True)
+
+    def dropped(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._dropped)
+
     # -- sinks -------------------------------------------------------------
 
     def _to_ring(self, span: Span) -> None:
+        overflowed = False
         with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                overflowed = True  # the deque evicts the oldest span
             self._ring.append(span)
+        if overflowed:
+            self.record_drop("ring")
 
     @staticmethod
     def _to_log(span: Span) -> None:
@@ -147,11 +222,20 @@ class Tracer:
     # -- introspection -----------------------------------------------------
 
     def recent(self, n: int = 100,
-               trace_id: Optional[str] = None) -> List[Span]:
+               trace_id: Optional[str] = None,
+               request_id: Optional[str] = None) -> List[Span]:
+        """The last ``n`` finished spans, optionally filtered by trace id
+        or by the ``request_id`` span attribute, sorted by start time —
+        ingested remote spans arrive late (heartbeat cadence), so ring
+        order is not start order for a stitched trace."""
         with self._lock:
             spans = list(self._ring)
         if trace_id is not None:
             spans = [s for s in spans if s.trace_id == trace_id]
+        if request_id is not None:
+            spans = [s for s in spans
+                     if str(s.attributes.get("request_id")) == request_id]
+        spans.sort(key=lambda s: s.start_ns)
         return spans[-n:]
 
     def clear(self) -> None:

@@ -384,7 +384,11 @@ class TestRedispatch:
         faults.clear()
         assert not sink.errors, sink.errors
         assert sink.terminals == 1
-        assert "redispatched" in [n for _, n in span.events]
+        # events are structured 3-tuples (ts, name, attrs) since the
+        # Span.event(name, **attrs) signature landed
+        events = {n: a for _, n, a in span.events}
+        assert "redispatched" in events
+        assert events["redispatched"]["reason"]  # the hop carries why
         assert span.attributes["redispatch_to"]
         for r in twin_server.scheduler.engines():
             if not r.is_healthy():

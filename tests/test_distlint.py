@@ -362,7 +362,7 @@ def test_dl005_flags_kvchunk_field_drift():
             if a == "KvChunk"]
     assert any("payload" in m for m in msgs), msgs
     broken = {k: dict(v) for k, v in messages.items()}
-    broken["KvHandoffHeader"][4] = ("chunk_pages", "uint32", "one")
+    broken["KvHandoffHeader"][9] = ("chunk_pages", "uint32", "one")
     msgs = [m for a, m in compare_wire_schema(schema, broken, enums)
             if a == "KvHandoffHeader"]
     assert any("not in inference.proto" in m for m in msgs), msgs
@@ -903,7 +903,7 @@ import time
 class Span:
     def set(self, **attrs):
         return self
-    def event(self, name):
+    def event(self, name, **attrs):
         pass
     def context(self):
         return (self.trace_id, self.span_id)
@@ -914,13 +914,18 @@ class Tracer:
         pass
 """
 
+# the pre-structured-events signature (the PR 5 trap): kept as a fixture
+# so DL010 provably still catches kwargs against a kwargs-less target
+_TRACING_FIXTURE_LEGACY = _TRACING_FIXTURE.replace(
+    "def event(self, name, **attrs):", "def event(self, name):")
 
-def test_dl010_flags_pr5_span_event_kwargs_shape():
-    """The exact PR 5 bug: ``Span.event`` takes only a name, but the
-    redispatch hook passed ``reason=`` — a runtime TypeError that turned
-    an invisible redispatch into a client-visible failure."""
+
+def test_dl010_flags_pr5_span_event_kwargs_shape_on_legacy_signature():
+    """The exact PR 5 bug: against the OLD no-kwargs ``Span.event``, a
+    ``reason=`` kwarg is a runtime TypeError that turned an invisible
+    redispatch into a client-visible failure — DL010 flags it."""
     out = pcheck("DL010", {
-        f"{PKG}/utils/tracing.py": _TRACING_FIXTURE,
+        f"{PKG}/utils/tracing.py": _TRACING_FIXTURE_LEGACY,
         f"{PKG}/serving/dispatcher.py": """
 class Dispatcher:
     def redispatch(self, request, from_engine, reason):
@@ -933,6 +938,24 @@ class Dispatcher:
     assert "unexpected keyword argument 'reason'" in out[0].message
     assert out[0].context == "Dispatcher.redispatch"
     assert out[0].severity == "P0"
+
+
+def test_dl010_structured_event_attrs_conform():
+    """Against the CURRENT ``Span.event(name, **attrs)`` signature the
+    same kwargs shape is legal — and the old bare-name call shape still
+    lints clean too (both shapes are live in the codebase)."""
+    out = pcheck("DL010", {
+        f"{PKG}/utils/tracing.py": _TRACING_FIXTURE,
+        f"{PKG}/serving/dispatcher.py": """
+class Dispatcher:
+    def redispatch(self, request, from_engine, reason):
+        if request.span is not None:
+            request.span.event("redispatched", reason=reason)
+            request.span.event("queued")
+        return True
+""",
+    })
+    assert out == []
 
 
 def test_dl010_clean_conforming_span_calls():
@@ -1183,6 +1206,115 @@ def validate(r):
     assert any("server.prot" in m for m in msgs)
 
 
+# ---------------------------------------------------------------------------
+# DL013 — span/event-name catalog drift
+# ---------------------------------------------------------------------------
+
+_DL013_CATALOG = """# Observability
+
+| name | kind | emitted by |
+|------|------|------------|
+| `request.<endpoint>` | span | handler |
+| `engine.infer` | span | runner |
+| `queued` | event | handler |
+| `admit` | timeline | recorder |
+"""
+
+
+def _dl013_root(tmp_path, catalog=_DL013_CATALOG):
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(catalog)
+    return tmp_path
+
+
+def test_dl013_flags_uncataloged_span_and_event(tmp_path):
+    out = pcheck("DL013", {
+        f"{PKG}/serving/x.py": """
+class H:
+    def go(self, span):
+        s = self.tracer.start("mystery.span")
+        span.event("mystery_event")
+""",
+    }, root=_dl013_root(tmp_path))
+    emission = [f for f in out if f.path.endswith("x.py")]
+    msgs = sorted(f.message for f in emission)
+    assert any("'mystery.span'" in m for m in msgs)
+    assert any("'mystery_event'" in m for m in msgs)
+    assert len(emission) == 2
+    # the unused catalog rows flag as dead entries, anchored in the doc
+    assert all(f.path == "docs/OBSERVABILITY.md"
+               for f in out if f not in emission)
+
+
+def test_dl013_clean_and_fstring_head_matches_placeholder(tmp_path):
+    out = pcheck("DL013", {
+        f"{PKG}/serving/x.py": """
+class H:
+    def go(self, span, engine_span, endpoint):
+        self.tracer.start(f"request.{endpoint}")
+        with self.tracer.span("engine.infer"):
+            pass
+        span.event("queued")
+        engine_span.event("queued")
+""",
+    }, root=_dl013_root(tmp_path))
+    assert out == []
+
+
+def test_dl013_dead_catalog_entry_flagged(tmp_path):
+    out = pcheck("DL013", {
+        f"{PKG}/serving/x.py": """
+class H:
+    def go(self, span, endpoint):
+        self.tracer.start(f"request.{endpoint}")
+        span.event("queued")
+""",
+    }, root=_dl013_root(tmp_path))
+    assert len(out) == 1
+    assert "never emitted" in out[0].message
+    assert "'engine.infer'" in out[0].message
+    assert out[0].path == "docs/OBSERVABILITY.md"
+
+
+def test_dl013_timeline_rows_and_non_span_receivers_ignored(tmp_path):
+    # `admit` is a kind=timeline row (documentation only) and calls on
+    # non-span receivers (`recorder.note`, a random obj.event) are out
+    # of scope — neither may produce findings
+    out = pcheck("DL013", {
+        f"{PKG}/serving/x.py": """
+class H:
+    def go(self, span, endpoint, recorder, widget):
+        self.tracer.start(f"request.{endpoint}")
+        self.tracer.span("engine.infer")
+        span.event("queued")
+        recorder.note("r1", "something_else")
+        widget.event("not_a_span_event")
+""",
+    }, root=_dl013_root(tmp_path))
+    assert out == []
+
+
+def test_dl013_no_catalog_means_no_findings():
+    # fixture roots without docs/OBSERVABILITY.md (every other pcheck
+    # call in this file) must not explode or flag
+    out = pcheck("DL013", {
+        f"{PKG}/serving/x.py": """
+class H:
+    def go(self):
+        self.tracer.start("anything.goes")
+""",
+    })
+    assert out == []
+
+
+def test_dl013_real_repo_catalog_is_in_sync():
+    findings = list(RULES["DL013"].check_project(
+        list(run_lint.__globals__["collect_modules"](REPO_ROOT).values()),
+        REPO_ROOT,
+    ))
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_dl012_real_repo_schema_parses():
     from tools.lint.rules import DL012
     from tools.lint.core import collect_modules
@@ -1252,6 +1384,6 @@ def test_github_format_emits_workflow_annotations(tmp_path, monkeypatch,
 
 
 def test_interprocedural_rules_registered():
-    for name in ("DL008", "DL009", "DL010", "DL011", "DL012"):
+    for name in ("DL008", "DL009", "DL010", "DL011", "DL012", "DL013"):
         assert name in RULES
         assert RULES[name].scope == "project"

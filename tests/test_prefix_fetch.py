@@ -380,11 +380,25 @@ def test_fetch_request_wire_roundtrip():
         "r1", HASHES, 8, "int8")
     wired = ProtowireChannel().transfer_fetch_request(
         "r1", HASHES, 8, "int8")
-    assert wired == ("r1", list(HASHES), 8, "int8")
+    assert wired == ("r1", list(HASHES), 8, "int8", None)
     assert inproc == wired
     # empty wire_quant decodes to the canonical "none"
     assert ProtowireChannel().transfer_fetch_request(
         "r2", [], 4, "")[3] == "none"
+
+
+def test_fetch_request_trace_context_roundtrip():
+    """The KvPrefixFetch trace fields (docs/OBSERVABILITY.md) cross the
+    protowire codec intact — the fetch span parents on the wire's
+    round-tripped context, not on in-process state."""
+    ctx = ("aaaabbbbccccdddd", "1111222233334444")
+    wired = ProtowireChannel().transfer_fetch_request(
+        "r1", HASHES, 8, "int8", trace=ctx)
+    assert wired[:4] == ("r1", list(HASHES), 8, "int8")
+    assert tuple(wired[4]) == ctx
+    # untraced request: the fields stay off the wire, decode to None
+    assert ProtowireChannel().transfer_fetch_request(
+        "r1", HASHES, 8, "int8")[4] is None
 
 
 # ---------------------------------------------------------------------------

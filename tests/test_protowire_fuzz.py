@@ -143,7 +143,11 @@ def test_kvchunk_and_header_roundtrip_fuzz():
             c.index, c.total, c.page_start, c.page_count, c.crc32,
             c.payload), i
         h = {"handoff_id": f"h{i}", "request_id": _rand_text(rng, 16),
-             "wire_quant": rng.choice(["none", "int8"])}
+             "wire_quant": rng.choice(["none", "int8"]),
+             # trace context (docs/OBSERVABILITY.md): untraced headers
+             # keep the fields off the wire; decode fills the defaults
+             "trace_id": rng.choice(["", "aabbccdd11223344"]),
+             "parent_span_id": rng.choice(["", "5566778899aabbcc"])}
         got = protowire.decode("KvHandoffHeader",
                                protowire.encode("KvHandoffHeader", h))
         assert got == h, i

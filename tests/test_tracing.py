@@ -18,8 +18,10 @@ def test_span_parenting_and_ring():
         with t.span("engine.infer", parent=root.context()) as child:
             child.set(tokens=5)
     spans = t.recent()
-    assert [s.name for s in spans] == ["engine.infer", "request"]
-    child, root = spans
+    # recent() sorts by START time (ingested remote spans arrive late,
+    # so ring order is not start order): root starts before its child
+    assert [s.name for s in spans] == ["request", "engine.infer"]
+    root, child = spans
     assert child.trace_id == root.trace_id
     assert child.parent_id == root.span_id
     assert root.parent_id is None
@@ -47,6 +49,277 @@ def test_trace_filter():
         pass
     only_a = t.recent(trace_id=a.trace_id)
     assert [s.name for s in only_a] == ["a"]
+
+
+def test_structured_event_attrs():
+    """Span.event(name, **attrs): attributes ride the event through
+    to_dict (the PR 5 postmortem trap — the no-kwargs signature turned
+    crash-path events into TypeErrors)."""
+    t = Tracer()
+    with t.span("request") as s:
+        s.event("redispatched", from_engine="e0", to_engine="e1",
+                attempt=1)
+        s.event("bare")
+    d = t.recent()[0].to_dict()
+    ev = {e["name"]: e for e in d["events"]}
+    assert ev["redispatched"]["attributes"] == {
+        "from_engine": "e0", "to_engine": "e1", "attempt": 1}
+    assert "attributes" not in ev["bare"]  # bare events stay compact
+
+
+def test_request_id_filter_and_start_order():
+    t = Tracer()
+    with t.span("late", request_id="r1"):
+        pass
+    with t.span("other", request_id="r2"):
+        pass
+    spans = t.recent(request_id="r1")
+    assert [s.name for s in spans] == ["late"]
+    # ingested spans with earlier start sort before ring-later spans
+    early = t.start("early", parent=None)
+    early.start_ns = 1
+    early.end_ns = 2
+    early.set(request_id="r1")
+    t.ingest(early)
+    assert [s.name for s in t.recent(request_id="r1")] == ["early", "late"]
+
+
+class TestDropAccounting:
+    def test_ring_overflow_counts_and_hooks(self):
+        t = Tracer(capacity=2)
+        drops = []
+        t.on_drop = lambda reason, n: drops.append((reason, n))
+        for i in range(5):
+            with t.span(f"s{i}"):
+                pass
+        assert t.dropped()["ring"] == 3
+        assert drops == [("ring", 1)] * 3
+
+    def test_exporter_failure_counts(self):
+        t = Tracer()
+
+        def boom(span):
+            raise RuntimeError("exporter down")
+
+        t.exporters.append(boom)
+        with t.span("s"):
+            pass
+        assert t.dropped()["exporter"] == 1
+        assert len(t.recent()) == 1  # the ring sink still got it
+
+    def test_drop_hook_failure_never_raises(self):
+        t = Tracer(capacity=1)
+        t.on_drop = lambda *a: (_ for _ in ()).throw(RuntimeError("x"))
+        for i in range(3):
+            with t.span(f"s{i}"):
+                pass
+        assert t.dropped()["ring"] == 2
+
+
+class TestSpanWire:
+    """TraceSpan/FleetSpans wire round-trips + cross-process merge
+    (docs/OBSERVABILITY.md): the worker re-bases monotonic -> epoch,
+    the host re-bases back and stamps the member."""
+
+    def _finished_span(self, tracer, **attrs):
+        s = tracer.start("fleet.serve", **attrs)
+        s.event("first_token", index=0)
+        tracer.finish(s)
+        return s
+
+    def test_span_wire_roundtrip_via_protowire(self):
+        import time as _time
+
+        from distributed_inference_server_tpu.serving import protowire
+        from distributed_inference_server_tpu.serving.fleet import (
+            span_from_wire,
+            span_to_wire,
+        )
+
+        t = Tracer()
+        src = self._finished_span(t, request_id="r1", engine_id="e0")
+        off = _time.time_ns() - _time.monotonic_ns()
+        frame = protowire.encode("FleetSpans", {
+            "member_id": "w1",
+            "spans": [span_to_wire(src, off)],
+            "dropped": 2,
+        })
+        d = protowire.decode("FleetSpans", frame)
+        assert d["member_id"] == "w1" and d["dropped"] == 2
+        got = span_from_wire(d["spans"][0], off, member_id="w1")
+        assert got.name == src.name
+        assert got.trace_id == src.trace_id
+        assert got.span_id == src.span_id
+        assert got.parent_id == src.parent_id
+        assert got.status == src.status
+        assert got.attributes["request_id"] == "r1"
+        assert got.attributes["member"] == "w1"  # stamped on ingest
+        # same epoch offset both sides -> timestamps identical; the
+        # duration is exact regardless of offsets
+        assert got.start_ns == src.start_ns
+        assert got.end_ns - got.start_ns == src.end_ns - src.start_ns
+        (ts, name, attrs), = got.events
+        assert name == "first_token" and attrs == {"index": 0}
+        assert ts - got.start_ns == src.events[0][0] - src.start_ns
+
+    def test_remote_span_merge_and_orphans(self):
+        """FleetServer.ingest_spans merges a member's FleetSpans frame
+        into the host tracer — spans from a DEAD member (orphans whose
+        parents never arrive) still land, filterable by trace, with
+        wire drops counted."""
+        import time as _time
+
+        from distributed_inference_server_tpu.serving.fleet import (
+            FleetRegistry,
+            FleetServer,
+            span_to_wire,
+        )
+
+        host = Tracer()
+        server = FleetServer(FleetRegistry(), scheduler=None,
+                             tracer=host)
+        worker = Tracer()
+        root = worker.start("request.generate", request_id="rX")
+        child = worker.start("fleet.serve", parent=root.context(),
+                             request_id="rX")
+        worker.finish(child)
+        # orphan: its parent (root) is never shipped — the member died
+        off = _time.time_ns() - _time.monotonic_ns()
+        server.ingest_spans({
+            "member_id": "dead-w1",
+            "spans": [span_to_wire(child, off)],
+            "dropped": 3,
+        }, "dead-w1")
+        merged = host.recent(trace_id=root.trace_id)
+        assert [s.name for s in merged] == ["fleet.serve"]
+        assert merged[0].parent_id == root.span_id  # link preserved
+        assert merged[0].attributes["member"] == "dead-w1"
+        assert host.dropped()["wire"] == 3
+
+    def test_undecodable_span_drops_not_batch(self):
+        from distributed_inference_server_tpu.serving.fleet import (
+            FleetRegistry,
+            FleetServer,
+            span_to_wire,
+        )
+        import time as _time
+
+        host = Tracer()
+        server = FleetServer(FleetRegistry(), scheduler=None, tracer=host)
+        t = Tracer()
+        ok = self._finished_span(t, request_id="r2")
+        off = _time.time_ns() - _time.monotonic_ns()
+        server.ingest_spans({
+            "member_id": "w1",
+            "spans": [{"events": 42}, span_to_wire(ok, off)],
+            "dropped": 0,
+        }, "w1")
+        assert [s.name for s in host.recent()] == ["fleet.serve"]
+        assert host.dropped()["wire"] == 1
+
+    def test_worker_buffer_bounded_and_shipped(self):
+        """FleetWorker buffers finished spans (bounded, drop-counted)
+        and ships one capped FleetSpans frame per beat."""
+        from distributed_inference_server_tpu.serving.fleet import (
+            FleetSettings,
+        )
+        from distributed_inference_server_tpu.serving.remote_runner import (
+            FleetWorker,
+        )
+
+        t = Tracer()
+        w = FleetWorker(scheduler=None,
+                        settings=FleetSettings(connect="127.0.0.1:1"),
+                        member_id="w1", tracer=t)
+        sent = []
+        w._send = lambda name, obj: sent.append((name, obj))
+        for i in range(w.SPAN_BUFFER + 5):
+            with t.span(f"s{i}"):
+                pass
+        assert len(w._span_buf) == w.SPAN_BUFFER
+        assert t.dropped()["wire"] == 5
+        assert w.ship_spans_once()
+        assert len(sent) == 1
+        name, obj = sent[0]
+        assert name == "FleetSpans" and obj["member_id"] == "w1"
+        assert len(obj["spans"]) == w.SPANS_PER_FRAME
+        # 5 buffer-overflow sheds + the per-frame cap overflow
+        assert obj["dropped"] == 5 + (w.SPAN_BUFFER - w.SPANS_PER_FRAME)
+        assert not w._span_buf  # drained
+        # nothing pending -> no frame
+        sent.clear()
+        assert w.ship_spans_once() and sent == []
+
+    def test_worker_ship_failure_counts_wire_drops(self):
+        from distributed_inference_server_tpu.serving.fleet import (
+            FleetSettings,
+        )
+        from distributed_inference_server_tpu.serving.remote_runner import (
+            FleetWorker,
+        )
+
+        t = Tracer()
+        w = FleetWorker(scheduler=None,
+                        settings=FleetSettings(connect="127.0.0.1:1"),
+                        member_id="w1", tracer=t)
+        with t.span("s"):
+            pass
+        assert not w.ship_spans_once()  # not connected -> send raises
+        assert t.dropped()["wire"] == 1
+
+    def test_worker_stop_detaches_span_exporter(self):
+        """Review regression: chaos rebuilds a FleetWorker per crash
+        iteration against the SAME tracer — a stopped worker must not
+        leave its buffer exporter behind (dead 512-span pins + phantom
+        wire drops on every finished span)."""
+        from distributed_inference_server_tpu.serving.fleet import (
+            FleetSettings,
+        )
+        from distributed_inference_server_tpu.serving.remote_runner import (
+            FleetWorker,
+        )
+
+        t = Tracer()
+        before = len(t.exporters)
+        workers = [
+            FleetWorker(scheduler=None,
+                        settings=FleetSettings(connect="127.0.0.1:1"),
+                        member_id=f"w{i}", tracer=t)
+            for i in range(3)
+        ]
+        assert len(t.exporters) == before + 3
+        for w in workers:
+            w.stop()
+        assert len(t.exporters) == before
+        with t.span("s"):
+            pass
+        assert t.dropped()["wire"] == 0  # no dead buffers counting
+
+
+def test_fault_observer_registry_fans_out_and_unregisters():
+    """Review regression: the chaos fleet topology runs two servers in
+    one interpreter — fault arm/disarm events must reach EVERY
+    registered recorder, and a removed observer stops receiving."""
+    from distributed_inference_server_tpu.serving import faults
+
+    seen_a, seen_b = [], []
+    cb_a = lambda name, **attrs: seen_a.append(name)  # noqa: E731
+    cb_b = lambda name, **attrs: seen_b.append(name)  # noqa: E731
+    faults.add_observer(cb_a)
+    faults.add_observer(cb_b)
+    try:
+        faults.install(faults.parse_spec("runner.step:nth=1", seed=1))
+        faults.clear()
+        assert seen_a == ["faults_armed", "faults_cleared"]
+        assert seen_b == ["faults_armed", "faults_cleared"]
+        faults.remove_observer(cb_b)
+        faults.install(faults.parse_spec("runner.step:nth=1", seed=1))
+        faults.clear()
+        assert len(seen_a) == 4 and len(seen_b) == 2
+    finally:
+        faults.clear()
+        faults.remove_observer(cb_a)
+        faults.remove_observer(cb_b)
 
 
 @pytest.fixture(scope="module")
@@ -117,6 +390,63 @@ def test_request_produces_span_tree(server):
     assert any(e["name"] == "dispatched" for e in root["events"])
     assert any(e["name"] == "first_token" for e in engine["events"])
     assert engine["attributes"]["completion_tokens"] == 4
+
+
+def test_trace_endpoint_filters_and_validation(server):
+    async def go(client):
+        resp = await client.post(
+            "/generate",
+            json={"prompt": "filter me", "max_tokens": 3,
+                  "temperature": 0.0},
+        )
+        body = await resp.json()
+        rid = body["id"].split("-", 1)[-1]
+        by_rid = await (await client.get(
+            f"/server/trace?request_id={rid}&n=100")).json()
+        bad_n = await client.get("/server/trace?n=0")
+        bad_n2 = await client.get("/server/trace?n=999999")
+        tl = await (await client.get(f"/server/requests/{rid}")).json()
+        listing = await (await client.get("/server/requests")).json()
+        missing = await client.get("/server/requests/nope")
+        return rid, by_rid["spans"], bad_n.status, bad_n2.status, tl, \
+            listing, missing.status
+
+    rid, spans, bad_n, bad_n2, tl, listing, missing = _run(server, go)
+    # request_id filter: the root AND the engine span carry the attr
+    names = {s["name"] for s in spans}
+    assert "request.generate" in names and "engine.infer" in names
+    assert all(s["attributes"]["request_id"] == rid for s in spans)
+    starts = [s["start_ns"] for s in spans]
+    assert starts == sorted(starts)  # sorted by start
+    assert bad_n == 400 and bad_n2 == 400
+    # flight recorder: phases partition the wall clock; TTFT/TBT ride
+    assert tl["status"] == "ok" and tl["tokens"] == 3
+    total = sum(tl["phases"].values())
+    assert abs(total - tl["wall_s"]) <= 0.10 * tl["wall_s"] + 1e-6
+    assert tl["ttft_s"] > 0 and tl["trace_id"] == spans[0]["trace_id"]
+    assert any(e["name"] == "terminal" for e in tl["events"])
+    assert any(r["request_id"] == rid for r in listing["requests"])
+    assert missing == 404
+
+
+def test_stats_tracing_block(server):
+    # force a counted drop, then read it back through both surfaces
+    server.tracer.record_drop("wire", 2)
+
+    async def go(client):
+        stats = await (await client.get("/server/stats")).json()
+        prom = await (await client.get("/metrics")).text()
+        return stats, prom
+
+    stats, prom = _run(server, go)
+    blk = stats["tracing"]
+    assert blk["spans_dropped"]["wire"] >= 2
+    assert blk["tracer_dropped"]["wire"] >= 2
+    assert blk["phase_requests"] >= 1
+    assert "decode" in blk["phase_seconds"]
+    assert 'trace_spans_dropped_total{reason="wire"}' in prom
+    assert 'request_phase_seconds_bucket' in prom
+    assert blk["flight_recorder"]["tracked"] >= 1
 
 
 class TestOTLPExporter:

@@ -233,7 +233,8 @@ def _ensure_worker(srv, timeout_s: float = 20.0):
         if fw is not None:
             fw.stop()
         fw = FleetWorker(srv._fleet_worker_srv.scheduler,
-                         srv._fleet_worker_settings, member_id="chaos-w1")
+                         srv._fleet_worker_settings, member_id="chaos-w1",
+                         tracer=srv._fleet_worker_srv.tracer)
         fw.start()
         srv._fleet_worker = fw
     deadline = time.monotonic() + timeout_s
@@ -648,11 +649,29 @@ SCENARIOS = {
 }
 
 
+def dump_postmortems(srv, sinks, violations) -> None:
+    """The violating requests' stories (docs/OBSERVABILITY.md): each
+    implicated request's flight-recorder timeline + stitched trace —
+    a seeded repro now starts from a narrative, not just a seed.
+    Requests named in a violation dump first; if none are named (e.g.
+    a reconvergence failure), the scenario's requests dump instead,
+    capped so a wide scenario stays readable."""
+    from tools.fleet_smoke import dump_postmortem
+
+    named = [s.rid for s in sinks
+             if any(s.rid in v for v in violations)]
+    rids = (named or [s.rid for s in sinks])[:5]
+    for rid in rids:
+        dump_postmortem(srv, rid)
+
+
 def run_scenario(name: str, seed: int, srv=None):
     """One scenario iteration on a fresh seed; returns (violations,
     srv) — the fleet is reusable across seeds of the same scenario
     (auto-restart heals crash damage between iterations). Faults are
-    ALWAYS disarmed before the invariant check."""
+    ALWAYS disarmed before the invariant check. A violation dumps the
+    implicated requests' flight-recorder timelines + stitched traces
+    before returning (docs/OBSERVABILITY.md postmortems)."""
     from distributed_inference_server_tpu.serving import faults
 
     fn, fleet_kwargs = SCENARIOS[name]
@@ -665,6 +684,8 @@ def run_scenario(name: str, seed: int, srv=None):
     violations = list(extra)
     violations += check_invariants(srv, sinks,
                                    require_success=require_success)
+    if violations:
+        dump_postmortems(srv, sinks, violations)
     return violations, srv
 
 

@@ -8,13 +8,23 @@ frames) — then proves the control plane end to end:
    the worker's RemoteRunner proxy completes token-identically to a
    local run (both processes build the same seeded tiny model, greedy
    sampling — the wire must not perturb a single token);
-2. **remote death**: the worker process is SIGKILLed with a zero-token
+2. **stitched tracing + flight recorder** (docs/OBSERVABILITY.md): a
+   remote-served request driven through the REAL HTTP surface yields
+   ONE trace_id whose ``/server/trace?trace_id=`` tree contains spans
+   from BOTH processes with intact parent links (the worker's
+   ``fleet.serve``/``engine.infer`` spans arrive over FleetSpans frames
+   and parent under the host's root span), and
+   ``GET /server/requests/<id>`` returns a timeline whose phase
+   attribution sums to within 10% of the request's wall clock;
+3. **remote death**: the worker process is SIGKILLed with a zero-token
    request in flight; the request must complete via crash-safe
    redispatch on the local engine — token-identically, exactly once,
    invisibly — with ``fleet_members{state="dead"}`` reflecting the loss
    and the local allocator passing a clean page audit.
 
-Exit 0 = clean. Any failed assertion exits 1 with the violation.
+Any failed assertion exits 1 with the violation, after dumping the
+implicated request's flight-recorder timeline + stitched trace (the
+postmortem story, docs/OBSERVABILITY.md).
 
     JAX_PLATFORMS=cpu python tools/fleet_smoke.py
     python tools/fleet_smoke.py --worker --connect 127.0.0.1:PORT  # child
@@ -29,6 +39,7 @@ import subprocess
 import sys
 import threading
 import time
+from typing import Optional
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -130,6 +141,9 @@ def run_worker(connect: str) -> int:
         srv.scheduler,
         FleetSettings(connect=connect, heartbeat_interval_s=0.2),
         member_id=MEMBER_ID,
+        # fleet-stitched tracing: fleet.serve/engine.infer spans ship
+        # back to the registry host (docs/OBSERVABILITY.md)
+        tracer=srv.tracer,
     )
     worker.start(connect_timeout_s=30.0)
     print(f"fleet-smoke worker: joined {connect}", flush=True)
@@ -140,6 +154,148 @@ def run_worker(connect: str) -> int:
 def _fail(msg: str) -> int:
     print(f"FLEET SMOKE VIOLATION: {msg}", file=sys.stderr, flush=True)
     return 1
+
+
+def dump_postmortem(srv, request_id) -> None:
+    """The violating request's story (docs/OBSERVABILITY.md): its
+    flight-recorder timeline and its stitched trace, so a red run reads
+    as a narrative instead of a seed."""
+    import json
+
+    print(f"--- postmortem for request {request_id} ---", file=sys.stderr)
+    tl = srv.recorder.timeline(request_id)
+    print("timeline:", json.dumps(tl, indent=2, default=str),
+          file=sys.stderr)
+    spans = srv.tracer.recent(500, request_id=str(request_id))
+    trace_ids = {s.trace_id for s in spans}
+    for tid in trace_ids:
+        tree = srv.tracer.recent(500, trace_id=tid)
+        print(f"trace {tid}:", json.dumps(
+            [s.to_dict() for s in tree], indent=2, default=str),
+            file=sys.stderr)
+    if tl is None and not spans:
+        print("(no timeline or spans recorded)", file=sys.stderr)
+    print("--- end postmortem ---", file=sys.stderr, flush=True)
+
+
+def _start_http(srv):
+    """Serve the host's real HTTP app from a background event loop;
+    returns (loop, runner, port)."""
+    import asyncio
+
+    from aiohttp import web
+
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    async def _up():
+        runner = web.AppRunner(srv.build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        return runner, port
+
+    fut = asyncio.run_coroutine_threadsafe(_up(), loop)
+    runner, port = fut.result(60)
+    return loop, runner, port
+
+
+def _http_json(method: str, url: str, body=None, timeout: float = 120.0):
+    import json
+    import urllib.request
+
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _trace_leg(srv, port: int) -> Optional[str]:
+    """The stitched-trace + flight-recorder acceptance (step 2 of the
+    module docstring). Returns a violation string or None. The local
+    engine is temporarily unregistered so the HTTP request MUST route
+    to the remote member."""
+    local = next(r for r in srv.scheduler.engines()
+                 if not getattr(r, "is_remote", False))
+    srv.scheduler.unregister(local.engine_id)
+    try:
+        resp = _http_json(
+            "POST", f"http://127.0.0.1:{port}/generate",
+            {"prompt": _PROMPT, "max_tokens": 12, "temperature": 0.0},
+        )
+    finally:
+        srv.scheduler.register(local)
+    rid = resp.get("id", "").split("-", 1)[-1]
+    if not rid:
+        return f"HTTP /generate returned no id: {resp}"
+
+    # member spans arrive at heartbeat cadence — wait for the stitch
+    deadline = time.monotonic() + 30.0
+    spans = []
+    while time.monotonic() < deadline:
+        spans = _http_json(
+            "GET", f"http://127.0.0.1:{port}/server/trace"
+            f"?request_id={rid}&n=500")["spans"]
+        if any(s["attributes"].get("member") == MEMBER_ID for s in spans):
+            break
+        time.sleep(0.2)
+    by_member = [s for s in spans
+                 if s["attributes"].get("member") == MEMBER_ID]
+    if not by_member:
+        dump_postmortem(srv, rid)
+        return "no remote-member span ever merged into the host trace"
+    trace_ids = {s["trace_id"] for s in spans}
+    if len(trace_ids) != 1:
+        dump_postmortem(srv, rid)
+        return f"request produced {len(trace_ids)} trace ids: {trace_ids}"
+    trace_id = trace_ids.pop()
+
+    tree = _http_json(
+        "GET", f"http://127.0.0.1:{port}/server/trace"
+        f"?trace_id={trace_id}&n=500")["spans"]
+    by_name = {s["name"]: s for s in tree}
+    root = by_name.get("request.generate")
+    serve = by_name.get("fleet.serve")
+    if root is None or serve is None:
+        dump_postmortem(srv, rid)
+        return (f"stitched trace missing spans: have {sorted(by_name)} "
+                "(want request.generate + fleet.serve)")
+    if serve["parent_id"] != root["span_id"]:
+        dump_postmortem(srv, rid)
+        return ("parent link broken: fleet.serve.parent="
+                f"{serve['parent_id']} != root span {root['span_id']}")
+    if "member" in root["attributes"]:
+        return "host root span claims a member attribute"
+    infer = by_name.get("engine.infer")
+    if infer is not None and infer["parent_id"] != serve["span_id"]:
+        dump_postmortem(srv, rid)
+        return ("parent link broken: engine.infer.parent="
+                f"{infer['parent_id']} != fleet.serve {serve['span_id']}")
+    print(f"fleet-smoke: one stitched trace {trace_id} with "
+          f"{len(by_member)} remote span(s) OK", flush=True)
+
+    tl = _http_json("GET",
+                    f"http://127.0.0.1:{port}/server/requests/{rid}")
+    phases = tl.get("phases", {})
+    wall = tl.get("wall_s", 0.0)
+    total = sum(phases.values())
+    if wall <= 0:
+        dump_postmortem(srv, rid)
+        return f"timeline has no wall clock: {tl}"
+    if abs(total - wall) > 0.10 * wall:
+        dump_postmortem(srv, rid)
+        return (f"phase attribution does not sum to the wall clock: "
+                f"sum={total:.4f}s wall={wall:.4f}s phases={phases}")
+    if tl.get("status") != "ok" or tl.get("tokens", 0) < 1:
+        dump_postmortem(srv, rid)
+        return f"timeline did not record a served request: {tl}"
+    print(f"fleet-smoke: flight recorder phases sum {total:.3f}s vs "
+          f"wall {wall:.3f}s OK", flush=True)
+    return None
 
 
 def run_host() -> int:
@@ -196,18 +352,28 @@ def run_host() -> int:
                 f"remote stream diverged: {r1.toks} != {ref.toks}")
         print("fleet-smoke: remote serving token-identical OK", flush=True)
 
-        # -- 2. kill the worker mid-zero-token-request ------------------
+        # -- 2. stitched trace + flight recorder over real HTTP ---------
+        _loop, _http_runner, http_port = _start_http(srv)
+        violation = _trace_leg(srv, http_port)
+        if violation is not None:
+            return _fail(violation)
+
+        # -- 3. kill the worker mid-zero-token-request ------------------
         r2_req, r2 = _request("smoke-kill")
         remote.submit([r2_req])
         os.kill(child.pid, signal.SIGKILL)  # mid-request, pre-first-token
         if not r2.ev.wait(120.0):
+            dump_postmortem(srv, "smoke-kill")
             return _fail("killed request never terminated")
         if r2.errors:
+            dump_postmortem(srv, "smoke-kill")
             return _fail(f"killed request errored (redispatch should be "
                          f"invisible): {r2.errors}")
         if r2.dones != 1:
+            dump_postmortem(srv, "smoke-kill")
             return _fail(f"killed request saw {r2.dones} done events")
         if r2.toks != ref.toks:
+            dump_postmortem(srv, "smoke-kill")
             return _fail(f"redispatched stream diverged: {r2.toks} != "
                          f"{ref.toks}")
         snap = srv.metrics.snapshot().to_dict()

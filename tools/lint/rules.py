@@ -1,4 +1,4 @@
-"""distlint rules DL001-DL012 (catalog + rationale: docs/LINTS.md).
+"""distlint rules DL001-DL013 (catalog + rationale: docs/LINTS.md).
 
 Each rule targets a failure class this codebase has actually hit or is
 structurally exposed to: blocking calls on the serving spine, unlocked
@@ -1187,6 +1187,133 @@ class DL011(Rule):
                         severity=self.severity, context="point catalog",
                         line_text=faults_mod.text(anchor_line(point)),
                     ))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DL013 — span/event-name catalog drift
+# ---------------------------------------------------------------------------
+
+# catalog rows in docs/OBSERVABILITY.md: | `name` | span/event | ... |
+# (rows whose kind column is anything else — e.g. the flight recorder's
+# `timeline` entries — are documentation only, not lint-enforced)
+_SPAN_CATALOG_ROW_RE = re.compile(
+    r"^\|\s*`([a-z0-9_.<>]+)`\s*\|\s*(span|event)\s*\|")
+_SPAN_NAME_RE = re.compile(r"^[a-z_][a-z0-9_.]*$")
+_PLACEHOLDER_RE = re.compile(r"<[a-z0-9_]+>")
+
+
+def _catalog_entry_rx(entry: str) -> re.Pattern:
+    """``request.<endpoint>`` -> a regex where each ``<...>`` matches one
+    lowercase identifier segment."""
+    parts = _PLACEHOLDER_RE.split(entry)
+    return re.compile("[a-z0-9_]+".join(re.escape(p) for p in parts) + "$")
+
+
+@register
+class DL013(Rule):
+    """Span/event-name catalog drift: every span name started through a
+    tracer (``tracer.start("...")`` / ``tracer.span("...")``) and every
+    span event name (``span.event("...")`` on the documented span
+    receivers) emitted in the package must appear in the
+    docs/OBSERVABILITY.md catalog — and every cataloged span/event entry
+    must be emitted somewhere (dead-entry detection), or the trace
+    documentation and the traces themselves drift apart. Dynamic names
+    with a constant f-string head (``f"request.{endpoint}"``) match
+    catalog entries whose literal prefix before a ``<placeholder>``
+    equals that head."""
+
+    name = "DL013"
+    title = "span/event name drift vs the docs/OBSERVABILITY.md catalog"
+    severity = "P1"
+    scope = "project"
+
+    DOCS = "docs/OBSERVABILITY.md"
+    #: receiver terminal names that hold a Tracer (DL010's convention)
+    TRACER_RECV = frozenset({"tracer"})
+    #: receiver terminal names that hold a Span (DL010's convention)
+    SPAN_RECV = frozenset({"span", "engine_span"})
+
+    def _emissions(self, modules: Sequence[Module]):
+        """[(name, is_fstring_head, module, node)] for every span start
+        and span event emission in the package."""
+        out = []
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.args):
+                    continue
+                recv_tail = dotted_name(node.func.value).rsplit(".", 1)[-1]
+                is_start = (node.func.attr in ("start", "span")
+                            and recv_tail in self.TRACER_RECV)
+                is_event = (node.func.attr == "event"
+                            and recv_tail in self.SPAN_RECV)
+                if not (is_start or is_event):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    if _SPAN_NAME_RE.match(arg.value):
+                        out.append((arg.value, False, mod, node))
+                elif isinstance(arg, ast.JoinedStr) and arg.values:
+                    head = arg.values[0]
+                    if isinstance(head, ast.Constant) \
+                            and isinstance(head.value, str) \
+                            and head.value:
+                        out.append((head.value, True, mod, node))
+        return out
+
+    @staticmethod
+    def _parse_catalog(path: Path):
+        """{entry: (kind, lineno, line_text)} from the docs table."""
+        out: Dict[str, Tuple[str, int, str]] = {}
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            m = _SPAN_CATALOG_ROW_RE.match(line)
+            if m:
+                out[m.group(1)] = (m.group(2), i, line.strip())
+        return out
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        docs_path = root / self.DOCS
+        if not docs_path.exists():
+            return []  # no catalog to drift from (fixture roots)
+        catalog = self._parse_catalog(docs_path)
+        entry_rx = {e: _catalog_entry_rx(e) for e in catalog}
+        used: Set[str] = set()
+        findings: List[Finding] = []
+        for name, is_head, mod, node in self._emissions(modules):
+            matched = False
+            for entry, rx in entry_rx.items():
+                if is_head:
+                    # f-string: covered by an entry whose literal prefix
+                    # before its first placeholder equals the head
+                    if ("<" in entry
+                            and entry.split("<", 1)[0] == name):
+                        matched = True
+                        used.add(entry)
+                elif rx.match(name):
+                    matched = True
+                    used.add(entry)
+            if not matched:
+                shown = f"{name}{{...}}" if is_head else name
+                findings.append(self.finding(
+                    mod, node,
+                    f"span/event name {shown!r} is not in the "
+                    f"{self.DOCS} catalog — add a row "
+                    "(| `name` | span/event | ...) or fix the literal",
+                ))
+        for entry, (kind, lineno, text) in sorted(catalog.items()):
+            if entry not in used:
+                findings.append(Finding(
+                    rule=self.name, path=self.DOCS, line=lineno,
+                    message=f"cataloged {kind} name {entry!r} is never "
+                            "emitted anywhere in the package — dead "
+                            "catalog entry or a lost emission site",
+                    severity=self.severity, context="span catalog",
+                    line_text=text,
+                ))
         return findings
 
 
