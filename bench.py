@@ -598,9 +598,189 @@ def bench_peerfetch() -> None:
                 })
 
 
+def bench_mixed() -> None:
+    """Ragged mixed-batch step microbench (BENCH_MIXED=1; ISSUE 12): a
+    mixed long-prompt/chat workload on ONE unified engine — chat rows
+    decode continuously while a burst of long prompts arrives — measured
+    under the MIXED step (engine.mixed_step_tokens > 0: one ragged
+    dispatch per iteration serving decode rows + prefill chunks) vs the
+    QUANTUM-INTERLEAVE baseline it replaces (prefill quanta dispatched
+    between decode blocks, stalling every in-flight decode for their
+    duration).
+
+    Per swept config it emits one JSON line per mode with the chat rows'
+    TBT max/p99 observed DURING the prompt burst (the number the mixed
+    step exists to flatten), overall tokens/s at the fixed geometry, and
+    ``tokens_identical`` — whether the two modes emitted bit-identical
+    token streams (greedy workload; the acceptance criterion).
+
+    Engine-level on purpose (no HTTP jitter), single-threaded XLA + the
+    tiny-4l model exactly like BENCH_PREFIX — at TINY scale dispatch
+    noise drowns the stall being measured. Knobs: BENCH_MIXED_REPS (3),
+    BENCH_MIXED_PROMPTS ("64,128" burst prompt lengths),
+    BENCH_MIXED_TOKENS (24, the packed width)."""
+    import gc
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_multi_thread_eigen=false"
+        + " intra_op_parallelism_threads=1"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+    reps = int(os.environ.get("BENCH_MIXED_REPS", "3"))
+    prompt_lens = [int(x) for x in os.environ.get(
+        "BENCH_MIXED_PROMPTS", "128,256").split(",") if x.strip()]
+    mixed_tokens = int(os.environ.get("BENCH_MIXED_TOKENS", "24"))
+    n_burst = int(os.environ.get("BENCH_MIXED_BURST", "4"))
+    mcfg = TINY.with_overrides(
+        name="tiny-4l", hidden_size=128, intermediate_size=512,
+        num_layers=4, num_heads=8, num_kv_heads=4, head_dim=16,
+    )
+    ps = 8
+    n_chat = 3
+    chat_len, chat_tokens = ps, 64
+    max_pages = -(-(max(prompt_lens) + 64) // ps)
+    paged = PagedCacheConfig(
+        num_pages=(n_chat + n_burst + 2) * max_pages, page_size=ps,
+        max_pages_per_seq=max_pages,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), mcfg,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(23)
+    hi = min(mcfg.vocab_size, 250)
+
+    def mk(mixed: bool):
+        return LLMEngine(
+            params, mcfg, ByteTokenizer(),
+            EngineConfig(
+                max_batch=n_chat + n_burst,
+                prefill_buckets=(32, 64, 128, 256),
+                paged=paged, decode_block_size=4, pipeline_depth=1,
+                mixed_step_tokens=mixed_tokens if mixed else 0,
+            ),
+            dtype=jnp.float32,
+        )
+
+    def run_once(engine, chats, prompts):
+        """Seat the chat rows, fire the prompt burst, record every chat
+        token's wall-clock instant until the burst's prompts finish and
+        the chats hit their budget. Returns (events, toks, elapsed)."""
+        toks = {}
+        times = {f"c{i}": [] for i in range(n_chat)}
+        for i, ids in enumerate(chats):
+            engine.add_request(f"c{i}", ids, SamplingParams(
+                max_tokens=chat_tokens, temperature=0.0))
+        # chats seated and decoding before the burst lands
+        while not all(times[r] for r in times):
+            for out in engine.step():
+                if out.token_id is not None:
+                    toks.setdefault(out.request_id, []).append(out.token_id)
+                    if out.request_id in times:
+                        times[out.request_id].append(time.perf_counter())
+        t0 = time.perf_counter()
+        for i, ids in enumerate(prompts):
+            engine.add_request(f"p{i}", ids, SamplingParams(
+                max_tokens=4, temperature=0.0))
+        produced = 0
+        while engine.has_work():
+            for out in engine.step():
+                if out.token_id is not None:
+                    produced += 1
+                    toks.setdefault(out.request_id, []).append(out.token_id)
+                    if out.request_id in times:
+                        times[out.request_id].append(time.perf_counter())
+        elapsed = time.perf_counter() - t0
+        # TBT of the in-flight chats across the burst window: gaps
+        # between consecutive observed tokens from the burst's landing
+        # on — anchored at each chat's LAST pre-burst token, so the gap
+        # that spans the prompt admission (the stall the mixed step
+        # exists to flatten) is measured, not dropped
+        tbts = []
+        for r, ts in times.items():
+            before = [t for t in ts if t < t0]
+            after = [t for t in ts if t >= t0]
+            anchored = before[-1:] + after
+            tbts.extend(np.diff(anchored).tolist())
+        return tbts, toks, produced / elapsed
+
+    for n in prompt_lens:
+        chats = [rng.integers(1, hi, size=chat_len).tolist()
+                 for _ in range(n_chat)]
+        prompts = [rng.integers(1, hi, size=n).tolist()
+                   for _ in range(n_burst)]
+        results = {}
+        for mode, mixed in (("quantum", False), ("mixed", True)):
+            engine = mk(mixed)
+            all_tbts, toks, tput = [], None, []
+            for r in range(reps + 1):
+                gc.collect()
+                gc.disable()
+                try:
+                    tbts, toks, tp = run_once(engine, chats, prompts)
+                finally:
+                    gc.enable()
+                for rid in list(toks):
+                    engine.abort(rid)
+                # drop the prefix cache: a warm repeat would skip the
+                # very prefill whose stall is being measured
+                engine.evict_cache(0.0, drop_host_tier=True)
+                if r:  # rep 0 warms compile caches
+                    all_tbts.extend(tbts)
+                    tput.append(tp)
+            results[mode] = {
+                "tbt_max_ms": float(np.max(all_tbts)) * 1e3,
+                "tbt_p99_ms": float(np.percentile(all_tbts, 99)) * 1e3,
+                "tokens_per_sec": float(np.median(tput)),
+                "toks": toks,
+            }
+        identical = results["mixed"]["toks"] == results["quantum"]["toks"]
+        for mode in ("quantum", "mixed"):
+            r = results[mode]
+            _emit({
+                "metric": "mixed_step_tbt_p99_ms_cpu",
+                "value": round(r["tbt_p99_ms"], 3),
+                "unit": "ms",
+                "vs_baseline": 0.0,
+                "mode": mode,
+                "prompt_len": n,
+                "burst_prompts": n_burst,
+                "chat_rows": n_chat,
+                "mixed_step_tokens": mixed_tokens if mode == "mixed" else 0,
+                "tbt_max_ms": round(r["tbt_max_ms"], 3),
+                "tokens_per_sec": round(r["tokens_per_sec"], 2),
+                "tokens_identical": identical,
+                "reps": reps,
+            })
+        if not identical:
+            print("BENCH_MIXED: token streams DIVERGED between modes",
+                  file=sys.stderr)
+            sys.exit(3)
+
+
 def main() -> None:
     if os.environ.get("BENCH_HANDOFF") == "1":
         bench_handoff()
+        return
+    if os.environ.get("BENCH_MIXED") == "1":
+        bench_mixed()
         return
     if os.environ.get("BENCH_PREFIX") == "1":
         bench_prefix()

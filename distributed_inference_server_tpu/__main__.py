@@ -101,6 +101,9 @@ def main(argv=None) -> int:
         pipeline_depth=cfg.get("engine", "pipeline_depth"),
         prefill_batch=cfg.get("engine", "prefill_batch"),
         prefill_token_budget=cfg.get("engine", "prefill_token_budget"),
+        # ragged mixed-batch stepping (docs/PERF.md): one dispatch for
+        # decode rows + prefill chunks while prefill work is pending
+        mixed_step_tokens=cfg.get("engine", "mixed_step_tokens"),
         pp_microbatches=cfg.get("engine", "pp_microbatches"),
         cp_min_tokens=cfg.get("engine", "cp_min_tokens") or None,
         sp_impl=cfg.get("engine", "sp_impl"),

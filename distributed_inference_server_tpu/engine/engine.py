@@ -183,6 +183,20 @@ class EngineConfig:
     # blocks, so seated sequences keep decoding while a long prompt loads
     # (at least one chunk always runs, so progress is guaranteed)
     prefill_token_budget: int = 2048
+    # ragged mixed-batch stepping (ISSUE 12): > 0 enables the MIXED step
+    # — ONE jitted dispatch per engine iteration consuming a packed
+    # token-budgeted batch of decode rows (1 token each, from the device
+    # carry) plus prefill-chunk rows (PackInfer-style back-to-back, no
+    # bucket padding), attended by the ragged paged-attention kernel
+    # (ops/pallas/paged_attention.py paged_attention_ragged; XLA ragged
+    # reference off-TPU). Prefill no longer runs as separate quantum
+    # programs that stall every in-flight decode for their duration: TBT
+    # stays flat under prompt bursts on a unified replica. The value is
+    # the TOTAL packed width (decode slots + prefill budget) and must
+    # exceed max_batch. 0 = the quantum-interleave path (baseline).
+    # Does not compose with speculative decoding or stage/seq/data mesh
+    # axes (rejected at construction).
+    mixed_step_tokens: int = 0
     # GPipe microbatches per forward when the mesh has a stage axis
     # (pipeline parallelism, parallel/pp.py); must divide max_batch and
     # prefill_batch
@@ -432,6 +446,30 @@ class LLMEngine:
             # as pytrees with per-member stage specs (parallel/pp.py);
             # seq axes: ring/Ulysses prefill quantizes at the pool
             # scatter (parallel/cp.py:_scatter_pool). VERDICT r4 #4.
+        if self.ecfg.mixed_step_tokens:
+            if self.ecfg.mixed_step_tokens <= self.ecfg.max_batch:
+                raise ValueError(
+                    f"mixed_step_tokens ({self.ecfg.mixed_step_tokens}) "
+                    f"must exceed max_batch ({self.ecfg.max_batch}): the "
+                    "packed width holds every decode slot plus at least "
+                    "one prefill token"
+                )
+            if draft_params is not None:
+                raise ValueError(
+                    "mixed_step_tokens does not compose with speculative "
+                    "decoding: the mixed step owns the decode carry one "
+                    "token at a time, the spec block gamma+1 at a time"
+                )
+            if mesh is not None and (
+                mesh.shape.get("stage", 1) > 1
+                or mesh.shape.get("seq", 1) > 1
+                or mesh.shape.get("data", 1) > 1
+            ):
+                raise ValueError(
+                    "mixed_step_tokens supports single-device and "
+                    "tensor-axis meshes only (the ragged attend shards "
+                    "heads; stage/seq/data axes take the quantum path)"
+                )
         self.draft_state = (
             PagedKVState.create(draft_cfg, self.pcfg, dtype=dtype,
                                 kv_quant=kvq)
@@ -612,6 +650,18 @@ class LLMEngine:
         self._kv_quant_pallas = (
             os.environ.get("DIS_TPU_KV_QUANT_PALLAS") == "1"
         )
+        # ragged mixed-batch step (EngineConfig.mixed_step_tokens): one
+        # compiled program, built lazily at the first mixed launch (the
+        # "auto" ragged-kernel probe runs then); host-side share/traffic
+        # accounting feeds engine_mixed_step_tokens{kind} + the density
+        # gauge via mixed_stats()
+        self._mixed_fn: Optional[Callable] = None
+        self._mixed_impl: Optional[str] = None
+        self._mixed_prefill_frac = 1.0
+        self._mixed_steps = 0
+        self._mixed_prefill_tokens = 0
+        self._mixed_decode_tokens = 0
+        self._mixed_density_sum = 0.0
         self._fwd = self._make_fwd()
         self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
         self._cp_fns: Dict[int, Callable] = {}
@@ -678,12 +728,26 @@ class LLMEngine:
         steps, async), and consume the oldest pending block's tokens once
         the pipeline is full (or nothing new was launched). Token events
         therefore arrive in bursts of up to ``decode_block_size`` per
-        sequence, ``pipeline_depth`` blocks behind the device."""
+        sequence, ``pipeline_depth`` blocks behind the device.
+
+        With ``mixed_step_tokens`` set and prefill work pending, the
+        quantum+block pair is replaced by ONE ragged mixed dispatch:
+        every seated decode row advances one token while the prefill
+        backlog consumes the packed budget's remainder — a long prompt
+        no longer stalls in-flight decodes for a full quantum. With no
+        prefill backlog, decode runs the K-step block path unchanged."""
         outputs: List[StepOutput] = []
         self._prof_begin()
         self._admit(outputs)
-        self._prefill_quantum(outputs)
-        launched = self._maybe_launch(outputs)
+        if self.ecfg.mixed_step_tokens and any(
+            s is not None and s.next_token is None
+            and s.seq_len < len(s.token_ids)
+            for s in self.slots
+        ):
+            launched = self._mixed_step(outputs)
+        else:
+            self._prefill_quantum(outputs)
+            launched = self._maybe_launch(outputs)
         if self._pending and (
             len(self._pending) > self.ecfg.pipeline_depth or not launched
         ):
@@ -1752,6 +1816,379 @@ class LLMEngine:
         return self.ecfg.prefill_buckets[-1]
 
     # ------------------------------------------------------------------
+    # ragged mixed-batch step (EngineConfig.mixed_step_tokens; ISSUE 12)
+    # ------------------------------------------------------------------
+
+    def set_mixed_prefill_frac(self, frac: float) -> None:
+        """Degradation-ladder hook (serving/degradation.py): shrink the
+        prefill share of the mixed step's packed budget under memory
+        pressure — decode rows keep their slots; prompt loading slows
+        instead of decode stalling. Engine-thread only (the runner posts
+        it); floor 0.05 so prefill always progresses."""
+        self._mixed_prefill_frac = min(1.0, max(0.05, float(frac)))
+
+    def mixed_stats(self) -> Optional[Dict[str, object]]:
+        """Mixed-step traffic snapshot for /metrics and the
+        /server/stats engine block; None when the mixed step is off.
+        ``batch_density`` is the rolling mean of (real packed tokens) /
+        mixed_step_tokens — how full the MXU tiles actually ran."""
+        if not self.ecfg.mixed_step_tokens:
+            return None
+        steps = self._mixed_steps
+        return {
+            "steps": steps,
+            "prefill_tokens": self._mixed_prefill_tokens,
+            "decode_tokens": self._mixed_decode_tokens,
+            "batch_density": round(
+                self._mixed_density_sum / steps, 4) if steps else 0.0,
+            "prefill_frac": self._mixed_prefill_frac,
+        }
+
+    def _resolved_mixed_impl(self) -> str:
+        """Attention impl for the mixed step's ragged attend: the ragged
+        Pallas kernel on TPU when its AOT probe passes (same judge-is-
+        Mosaic policy as _resolved_impl, same single builder
+        ``llama.make_ragged_attend`` as serving), the XLA ragged
+        reference otherwise. Quantized pools always serve on XLA (no
+        int8 ragged kernel)."""
+        if self.ecfg.kv_quant != "none":
+            return "xla"
+        impl = self.ecfg.attention_impl
+        if impl == "xla":
+            return "xla"
+        if self._mixed_impl is None:
+            if jax.default_backend() != "tpu":
+                self._mixed_impl = "xla"
+            elif impl == "pallas":
+                self._mixed_impl = "pallas"  # explicit pin wins
+            else:
+                self._mixed_impl = (
+                    "pallas" if self._probe_ragged() else "xla"
+                )
+        return self._mixed_impl
+
+    def _probe_ragged(self) -> bool:
+        """AOT-compile the ragged mixed-batch kernel at this engine's
+        exact mixed geometry (packed width, row count, page shapes —
+        sharded form under a tensor axis) so a Mosaic rejection
+        downgrades to the XLA ragged path instead of crashing the first
+        mixed launch."""
+        from distributed_inference_server_tpu.models.llama import (
+            make_ragged_attend,
+            shard_ragged_attend,
+        )
+
+        pcfg = self.pcfg
+        S = self.ecfg.mixed_step_tokens
+        B = self.ecfg.max_batch
+        Bm = B + min(self.ecfg.prefill_batch, S - B)
+        tp = self.mesh.shape.get("tensor", 1) if self.mesh is not None else 1
+        sm = self.mesh is not None and tp > 1
+        if sm:
+            kv, heads = self.cfg.num_kv_heads, self.cfg.num_heads
+        else:
+            kv = max(1, self.cfg.num_kv_heads // tp)
+            heads = max(1, self.cfg.num_heads // tp)
+        slots = pcfg.num_pages * pcfg.page_size
+        pool = jax.ShapeDtypeStruct((slots, kv, self.cfg.head_dim),
+                                    self.dtype)
+        fn = make_ragged_attend(
+            pcfg.page_size, self.cfg.attn_logit_softcap or 0.0,
+            interpret=False,
+        )
+        if sm:
+            fn = shard_ragged_attend(fn, self.mesh)
+        try:
+            jax.jit(fn).lower(
+                jax.ShapeDtypeStruct((S, heads, self.cfg.head_dim),
+                                     self.dtype),
+                pool, pool,
+                jax.ShapeDtypeStruct((Bm, pcfg.max_pages_per_seq),
+                                     jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((Bm,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+            ).compile()
+            return True
+        except Exception as e:  # Mosaic rejection or backend failure
+            logger.warning(
+                "Pallas ragged mixed-batch kernel unavailable for this "
+                "geometry (mixed step -> xla ragged path): %s",
+                str(e).split("\n")[0],
+            )
+            return False
+
+    def _get_mixed_fn(self) -> Callable:
+        if self._mixed_fn is None:
+            self._mixed_fn = self._build_mixed_step()
+        return self._mixed_fn
+
+    def _build_mixed_step(self) -> Callable:
+        """Compile the ragged mixed step: ONE program that (a) merges the
+        host's slot overrides into the decode carry, (b) runs one packed
+        ragged forward over [decode rows | prefill chunks] with KV
+        writes staying single scatters on the carried pools (the
+        pool-carry scan contract, docs/PERF.md), (c) samples on-device
+        ONLY the rows that produced a next token — every active decode
+        row plus each prefill row's chunk-final position — and (d)
+        advances the decode carry one token with the block path's exact
+        EOS/budget freeze law. The host sees [1, B] decode ids (the same
+        pending-block framing as the K-step path) plus [Bp] first-token
+        candidates it reaps only for prompts that completed."""
+        cfg = self.cfg
+        impl = self._resolved_mixed_impl()
+        ps = self.pcfg.page_size
+        S = self.ecfg.mixed_step_tokens
+        B = self.ecfg.max_batch
+        Bp = min(self.ecfg.prefill_batch, S - B)
+        num_slots = self._num_slots_flat
+        moe_impl = self._moe_impl()
+        mesh = self.mesh
+        eos = jnp.asarray(sorted(self.tok.eos_ids), jnp.int32)
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6, 10))
+        def mixed(params, pool_k, pool_v, tokens, positions, steps_left,
+                  active, block_tables, temp, top_p, rng,
+                  set_mask, set_active, set_tokens, set_positions,
+                  set_steps, p_ids, p_pos, p_row, p_write, p_valid,
+                  p_last, p_temp, p_topp, sample_mode):
+            # merge host overrides (admissions / deactivations) into carry
+            tokens = jnp.where(set_mask, set_tokens, tokens)
+            positions = jnp.where(set_mask, set_positions, positions)
+            steps_left = jnp.where(set_mask, set_steps, steps_left)
+            active = jnp.where(set_mask, set_active, active)
+
+            rows = jnp.arange(B, dtype=jnp.int32)
+            page = block_tables[rows, positions // ps]
+            d_write = jnp.where(
+                active, page * ps + positions % ps, num_slots
+            )
+            # packed layout: decode slots 0..B-1 (their row ids ARE their
+            # packed indices), prefill chunks back-to-back after them
+            ids = jnp.concatenate([tokens, p_ids])
+            pos = jnp.concatenate([positions, p_pos])
+            tok_row = jnp.concatenate(
+                [jnp.where(active, rows, -1), p_row]
+            )
+            write = jnp.concatenate([d_write, p_write])
+            kv_valid = jnp.concatenate(
+                [jnp.where(active, positions + 1, 0), p_valid]
+            )
+            offs = jnp.arange(block_tables.shape[1] * ps, dtype=jnp.int32)
+            gather = block_tables[:, offs // ps] * ps + offs % ps
+            logits, pool_k, pool_v = llama.ragged_paged_forward(
+                params, cfg, ids[None], pos[None], pool_k, pool_v,
+                write[None], tok_row, gather, kv_valid,
+                attention_impl=impl, page_size=ps, moe_impl=moe_impl,
+                mesh=mesh,
+                logits_idx=jnp.concatenate([rows, p_last]),
+            )  # [B + Bp, V]
+            rng, sub = jax.random.split(rng)
+            all_temp = jnp.concatenate([temp, p_temp])
+            all_topp = jnp.concatenate([top_p, p_topp])
+            # same 3-way runtime sampler switch as the decode block
+            nxt = lax.switch(
+                sample_mode,
+                [
+                    lambda a: jnp.argmax(a[1], -1).astype(jnp.int32),
+                    lambda a: sample_tokens(a[0], a[1], a[2], a[3],
+                                            use_topp=False),
+                    lambda a: sample_tokens(a[0], a[1], a[2], a[3],
+                                            use_topp=True),
+                ],
+                (sub, logits, all_temp, all_topp),
+            )
+            lp = _chosen_logprob(logits, nxt)
+            d_next, p_next = nxt[:B], nxt[B:]
+            d_lp, p_lp = lp[:B], lp[B:]
+            out = jnp.where(active, d_next, -1)
+            is_eos = (
+                (d_next[:, None] == eos[None, :]).any(-1)
+                if eos.size
+                else jnp.zeros_like(active)
+            )
+            positions = jnp.where(active, positions + 1, positions)
+            steps_left = jnp.where(active, steps_left - 1, steps_left)
+            tokens = jnp.where(active, d_next, tokens)
+            active = active & ~is_eos & (steps_left > 0)
+            return (out[None], d_lp[None], p_next, p_lp, tokens,
+                    positions, steps_left, active, pool_k, pool_v, rng)
+
+        return self._with_mesh(mixed)
+
+    def _mixed_step(self, outputs: List[StepOutput]) -> bool:
+        """Launch one ragged mixed dispatch: decode rows advance a single
+        token from the carry (the [1, B] result rides the SAME pending-
+        block pipeline as K-step blocks) while the prefill backlog packs
+        chunks into the budget's remainder — no bucket padding, chunk
+        lengths exactly what fits (PackInfer). Page pressure drains the
+        pipeline then preempts, exactly like _maybe_launch."""
+        S = self.ecfg.mixed_step_tokens
+        B = self.ecfg.max_batch
+        Sp = S - B
+        Bp = min(self.ecfg.prefill_batch, Sp)
+        ps = self.pcfg.page_size
+        P = self.pcfg.max_pages_per_seq
+
+        def mid_prefill(s: _Seq) -> bool:
+            return s.next_token is None and s.seq_len < len(s.token_ids)
+
+        while True:
+            decode_seated = [
+                (i, s) for i, s in enumerate(self.slots)
+                if s is not None and not mid_prefill(s)
+            ]
+            # sliding-window reclaim for every seated row, exactly like
+            # _maybe_launch: a sustained prompt backlog keeps the engine
+            # on the mixed path, which must not suspend the O(window)
+            # KV bound
+            for i, s in enumerate(self.slots):
+                if s is not None:
+                    self._reclaim_window_pages(s)
+            advs = {
+                id(s): (1 if s.dev_steps_left > 0 else 0)
+                for _, s in decode_seated
+            }
+            try:
+                for _, s in decode_seated:
+                    self._ensure_block_pages(s, advs[id(s)])
+                break
+            except CacheFull:
+                if self._pending:
+                    self._drain_pending(outputs)
+                    continue
+                if decode_seated:
+                    self._preempt_youngest(outputs)
+                    continue
+                break  # prefill rows already hold their prompt pages
+
+        # compose the prefill share: up to Bp mid-prefill rows packed
+        # back-to-back under the (pressure-shrinkable) budget
+        group = [
+            (i, s) for i, s in enumerate(self.slots)
+            if s is not None and mid_prefill(s)
+        ][:Bp]
+        budget = max(1, min(Sp, int(Sp * self._mixed_prefill_frac)))
+        p_ids = np.zeros((Sp,), np.int32)
+        p_pos = np.zeros((Sp,), np.int32)
+        p_row = np.full((Sp,), -1, np.int32)
+        p_write = np.full((Sp,), self._num_slots_flat, np.int32)
+        p_valid = np.zeros((Bp,), np.int32)
+        p_last = np.zeros((Bp,), np.int32)
+        p_temp = np.ones((Bp,), np.float32)
+        p_topp = np.ones((Bp,), np.float32)
+        chunk_lens: List[int] = []
+        off = 0
+        for j, (_, s) in enumerate(group):
+            start = s.seq_len
+            t = min(len(s.token_ids) - start, budget - off)
+            if t <= 0:
+                chunk_lens.append(0)
+                continue
+            p_ids[off:off + t] = s.token_ids[start:start + t]
+            p_pos[off:off + t] = np.arange(start, start + t, dtype=np.int32)
+            flat = np.arange(start, start + t, dtype=np.int32)
+            table = np.asarray(s.block_table, np.int32)
+            p_write[off:off + t] = table[flat // ps] * ps + flat % ps
+            p_row[off:off + t] = B + j
+            p_valid[j] = start + t
+            p_last[j] = B + off + t - 1
+            p_temp[j] = s.params.temperature
+            p_topp[j] = s.params.top_p
+            chunk_lens.append(t)
+            off += t
+
+        for i, s in decode_seated:
+            if self._bt_pages[i] != len(s.block_table):
+                self._refresh_bt_row(i, s)
+        tables = np.zeros((B + Bp, P), np.int32)
+        tables[:B] = self._bt
+        for j, (_, s) in enumerate(group):
+            tb = s.block_table[:P]
+            tables[B + j, :len(tb)] = tb
+
+        injects = self._drain_slot_updates()
+        tokens, positions, steps_left, active, rng = self._carry
+        use_topp = any(
+            s.params.top_p < 1.0 and s.params.temperature > 0.0
+            for _, s in decode_seated + group
+        )
+        any_temp = any(
+            s.params.temperature > 0.0 for _, s in decode_seated + group
+        )
+        sample_mode = 2 if use_topp else (1 if any_temp else 0)
+
+        (outs, lps, p_toks, p_lps, tokens, positions, steps_left, active,
+         self.state.k, self.state.v, rng) = self._get_mixed_fn()(
+            self.params, self.state.k, self.state.v,
+            tokens, positions, steps_left, active,
+            jnp.asarray(tables), jnp.asarray(self._temp),
+            jnp.asarray(self._topp), rng, *injects,
+            jnp.asarray(p_ids), jnp.asarray(p_pos), jnp.asarray(p_row),
+            jnp.asarray(p_write), jnp.asarray(p_valid),
+            jnp.asarray(p_last), jnp.asarray(p_temp),
+            jnp.asarray(p_topp), jnp.asarray(sample_mode, jnp.int32),
+        )
+        self._carry = (tokens, positions, steps_left, active, rng)
+        snapshot = [(i, s) for i, s in decode_seated]
+        self._pending.append(
+            (outs, lps, None, None, None,
+             [(i, s, advs[id(s)]) for i, s in snapshot])
+        )
+        for _, s in decode_seated:
+            adv = advs[id(s)]
+            s.dev_pos += adv
+            s.dev_steps_left -= adv
+
+        prefill_tokens = sum(chunk_lens)
+        decode_tokens = sum(advs.values())
+        self._mixed_steps += 1
+        self._mixed_prefill_tokens += prefill_tokens
+        self._mixed_decode_tokens += decode_tokens
+        self._mixed_density_sum += (prefill_tokens + decode_tokens) / S
+        for j, (_, s) in enumerate(group):
+            s.seq_len += chunk_lens[j]
+        self._reap_mixed_prefill(group, chunk_lens, p_toks, p_lps, outputs)
+        return True
+
+    def _reap_mixed_prefill(self, group, chunk_lens, p_toks, p_lps,
+                            outputs: List[StepOutput]) -> None:
+        """Emit first tokens for prompts the mixed dispatch COMPLETED and
+        seat them for decode (or park them handoff-ready) — the mixed
+        step's analogue of the quantum path's reap. The single
+        np.asarray below is the block-boundary device read: nothing else
+        here may touch the device (distlint DL007 polices this function
+        exactly like the decode loop)."""
+        toks_np = lps_np = None
+        for j, (slot, s) in enumerate(group):
+            if not chunk_lens[j] or s.seq_len < len(s.token_ids):
+                continue  # mid-prompt chunk; later mixed steps finish it
+            if self._by_id.get(s.request_id) is not s:
+                continue  # aborted while the dispatch ran
+            if toks_np is None:
+                toks_np = np.asarray(p_toks)
+                lps_np = np.asarray(p_lps)
+            try:
+                self._emit_token(s, int(toks_np[j]), outputs,
+                                 float(lps_np[j]))
+            except Exception as e:  # failure isolation (Property 22)
+                self.slots[slot] = None
+                self._by_id.pop(s.request_id, None)
+                self._release_seq(s)
+                outputs.append(StepOutput(
+                    request_id=s.request_id, finished=True, error=str(e)))
+                continue
+            if self._by_id.get(s.request_id) is s:
+                if s.prefill_only:
+                    # disaggregated handoff point (same as the quantum
+                    # path): pages held, serving layer exports the seq
+                    self.slots[slot] = None
+                    self._handoff_ready[s.request_id] = s
+                else:
+                    self._stage_seat(slot, s)
+
+    # ------------------------------------------------------------------
     # context-parallel (ring attention) prefill — the long-prompt path
     # ------------------------------------------------------------------
 
@@ -2666,9 +3103,12 @@ class LLMEngine:
             s.dev_steps_left -= adv
         return True
 
-    def _launch(self, seated: List[Tuple[int, _Seq]],
-                advs: Dict[int, int], use_spec: bool,
-                spec_ok: Optional[Dict[int, bool]] = None) -> None:
+    def _drain_slot_updates(self) -> Tuple[jnp.ndarray, ...]:
+        """Drain the staged host overrides (admissions / deactivations)
+        into the inject arrays every carry-consuming launch merges, and
+        lazily create the device carry — shared by the decode block
+        (_launch) and the mixed step (_mixed_step) so the two paths'
+        staged-update encoding and carry layout cannot drift."""
         B = self.ecfg.max_batch
         set_mask = np.zeros((B,), bool)
         set_active = np.zeros((B,), bool)
@@ -2682,7 +3122,6 @@ class LLMEngine:
             set_pos[slot] = pos
             set_steps[slot] = steps
         self._slot_updates.clear()
-
         if self._carry is None:
             self._carry = (
                 jnp.zeros((B,), jnp.int32),
@@ -2691,12 +3130,17 @@ class LLMEngine:
                 jnp.zeros((B,), bool),
                 jax.random.PRNGKey(self.ecfg.seed + 1),
             )
-        tokens, positions, steps_left, active, rng = self._carry
-        injects = (
+        return (
             jnp.asarray(set_mask), jnp.asarray(set_active),
             jnp.asarray(set_tokens), jnp.asarray(set_pos),
             jnp.asarray(set_steps),
         )
+
+    def _launch(self, seated: List[Tuple[int, _Seq]],
+                advs: Dict[int, int], use_spec: bool,
+                spec_ok: Optional[Dict[int, bool]] = None) -> None:
+        injects = self._drain_slot_updates()
+        tokens, positions, steps_left, active, rng = self._carry
         live_pages = max(
             [len(s.block_table) for _, s in seated], default=1
         )

@@ -532,6 +532,59 @@ def make_pallas_attend(page_size: int, softcap: float, decode_step: bool,
     return fn
 
 
+def make_ragged_attend(page_size: int, softcap: float, interpret=None):
+    """Build the ragged mixed-batch Pallas attend callable — the ONE
+    builder both the engine's AOT probe and the mixed-step serving path
+    go through (docs/PERF.md design rule: probe and serving cannot
+    drift). Subsumes the decode and prefill kernels for the mixed step:
+    decode rows are q_len-1 segments, prefill chunks multi-window rows,
+    all served by ``paged_attention_ragged``.
+
+    ``fn(q [S, H, D], k_pool, v_pool, tables [Bm, P], tok_row [S],
+    q_pos [S], kv_valid_len [Bm], window)``."""
+    from distributed_inference_server_tpu.ops.pallas import (
+        paged_attention_ragged,
+    )
+
+    _, ppb, qb = pallas_tuning()
+
+    def fn(q3, k_layer, v_layer, tables, tok_row, q_pos, valid, w):
+        return paged_attention_ragged(
+            q3, k_layer, v_layer, tables, tok_row, q_pos, valid,
+            page_size=page_size, q_block=qb, pages_per_block=ppb,
+            sliding_window=w, attn_softcap=softcap, interpret=interpret,
+        )
+
+    return fn
+
+
+def shard_ragged_attend(fn, mesh):
+    """shard_map-wrap the ragged attend over the ``tensor`` axis: query
+    heads and the pools' KV-head axis split, every per-token/per-row
+    operand replicated (the mixed step does not shard rows — the engine
+    rejects mixed_step_tokens under a data axis). Shared by the probe
+    and the serving path like ``shard_pallas_attend``."""
+    from distributed_inference_server_tpu.utils.compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(
+            P(None, "tensor", None),  # q [S, H, D]
+            P(None, "tensor", None),  # pool layer [slots, KV, D]
+            P(None, "tensor", None),
+            P(None, None),  # page tables [Bm, P]
+            P(None),  # tok_row [S]
+            P(None),  # q_pos [S]
+            P(None),  # kv_valid_len [Bm]
+            P(),  # sliding window (replicated scalar)
+        ),
+        out_specs=P(None, "tensor", None),
+        check_vma=False,
+    )
+
+
 def shard_pallas_attend(fn, mesh, decode_step: bool,
                         kv_quantized: bool = False):
     """shard_map-wrap a per-shard Pallas attend callable over ``mesh``:
@@ -767,6 +820,113 @@ def paged_forward(
     if logits_idx is not None:
         h = h[jnp.arange(h.shape[0]), logits_idx][:, None]
     return _unembed(params, cfg, h), new_k, new_v
+
+
+def ragged_paged_forward(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    write_slots: jnp.ndarray,
+    tok_row: jnp.ndarray,
+    gather_slots: jnp.ndarray,
+    kv_valid_len: jnp.ndarray,
+    attention_impl: str = "xla",
+    page_size: int = 0,
+    moe_impl: str = "dense",
+    mesh=None,
+    logits_idx: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Forward pass over a PACKED ragged mixed batch (the engine's mixed
+    step, engine/engine.py ``_mixed_step``): one flat token axis carries
+    decode rows (one token each) and prefill chunks back-to-back, each
+    token attending its OWN row's pages — one dispatch serves both
+    phases instead of a prefill-quantum program stalling the decode
+    block.
+
+    Args:
+      input_ids, positions: [1, S] packed new tokens / absolute positions.
+      pool_k, pool_v: [L, num_slots, KV, D] flat page pools (QuantPool
+        for int8 KV — served on the XLA path).
+      write_slots: [1, S] flat pool slot per packed token (>= num_slots
+        drops — padding).
+      tok_row: [S] owning batch row per token (-1 = padding).
+      gather_slots: [Bm, S_max] flat slots covering each row's table.
+      kv_valid_len: [Bm] valid tokens per row INCLUDING its new tokens.
+      attention_impl: "xla" (ragged_gqa_attention over the gathered
+        windows) or "pallas" (the ragged mixed-batch kernel via
+        ``make_ragged_attend`` — the one builder the probe compiles).
+      logits_idx: [N] packed positions to unembed (decode slots + the
+        chunk-final tokens); required — a mixed step never wants all S.
+
+    Returns (logits [N, V] f32, new pool_k, new pool_v).
+    """
+    from distributed_inference_server_tpu.ops.attention import (
+        ragged_gqa_attention,
+    )
+    from distributed_inference_server_tpu.ops.quant import (
+        QuantPool,
+        dequantize_kv,
+        pool_num_slots,
+    )
+
+    kv_quantized = isinstance(pool_k, QuantPool)
+    use_pallas = attention_impl == "pallas"
+    if use_pallas:
+        if page_size <= 0:
+            raise ValueError("attention_impl='pallas' requires page_size")
+        if kv_quantized:
+            raise ValueError(
+                "the ragged mixed-batch kernel has no int8-pool variant; "
+                "quantized pools serve the mixed step on the XLA path "
+                "(the engine's resolution does this)"
+            )
+        page_tables = gather_slots[:, ::page_size] // page_size
+        _attend = make_ragged_attend(
+            page_size, cfg.attn_logit_softcap or 0.0
+        )
+        if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+            _attend = shard_ragged_attend(_attend, mesh)
+
+    write_fn = make_paged_write_fn(write_slots, kv_quantized)
+    flat_pos = positions[0]
+
+    def attend_fn(q, k_layer, v_layer, window):
+        if use_pallas:
+            if window is None:
+                window = jnp.int32(0)
+            return _attend(
+                q[0], k_layer, v_layer, page_tables, tok_row, flat_pos,
+                kv_valid_len, window,
+            )[None]
+        if kv_quantized:
+            kd, vd = gather_kv_window(
+                k_layer.data, v_layer.data, gather_slots, page_size
+            )
+            ks, vs = gather_kv_window(
+                k_layer.scale, v_layer.scale, gather_slots, page_size
+            )
+            k_seq = dequantize_kv(kd, ks, q.dtype)
+            v_seq = dequantize_kv(vd, vs, q.dtype)
+        else:
+            k_seq, v_seq = gather_kv_window(
+                k_layer, v_layer, gather_slots, page_size
+            )  # [Bm, S_max, KV, D]
+        return ragged_gqa_attention(
+            q[0], k_seq, v_seq, tok_row, flat_pos, kv_valid_len,
+            window, cfg.attn_logit_softcap,
+        )[None]
+
+    h, new_k, new_v = _run_layers(
+        params, cfg, input_ids, positions, pool_k, pool_v, write_fn,
+        attend_fn, moe_impl=moe_impl,
+        valid_tokens=write_slots < pool_num_slots(pool_k),
+    )
+    # unembed only the sampled positions: [1, S, H] -> [N, V]
+    h = h[0, logits_idx]
+    return _unembed(params, cfg, h[None])[0], new_k, new_v
 
 
 def hidden_states(

@@ -80,3 +80,69 @@ def gqa_attention(
         "bkgts,bskd->btkgd", probs, v_cache, preferred_element_type=jnp.float32
     )
     return out.reshape(B, T, H, D).astype(q.dtype)
+
+
+def ragged_gqa_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    tok_row: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    kv_valid_len: jnp.ndarray,
+    sliding_window=None,
+    attn_softcap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Causal GQA attention of a PACKED ragged batch against per-row caches.
+
+    The mixed-step contract (engine/engine.py ``_mixed_step``): one flat
+    token axis carries every row's new tokens back-to-back — decode rows
+    contribute one token each, prefill-chunk rows up to the chunk budget —
+    and each packed token attends its OWN row's KV. This is the numerics
+    ground truth the Pallas ragged kernel
+    (ops/pallas/paged_attention.py:paged_attention_ragged) is tested
+    against, and the CPU/fallback serving path.
+
+    Args:
+      q: [S, H, D] packed query tokens (S = the mixed-step token budget;
+        padding slots carry ``tok_row`` -1 and any q values).
+      k_cache, v_cache: [Bm, S_max, KV, D] per-row gathered cache windows
+        (the XLA gather path's dense form; must already contain the new
+        tokens' K/V).
+      tok_row: [S] row index of each packed token (-1 = padding; padding
+        outputs are garbage and discarded by the caller).
+      q_positions: [S] absolute position of each packed token in its row.
+      kv_valid_len: [Bm] valid cache slots per row.
+      sliding_window / attn_softcap: as in ``gqa_attention``.
+
+    Returns: [S, H, D] attention outputs in q.dtype.
+    """
+    S, H, D = q.shape
+    Bm, Smax, KV, _ = k_cache.shape
+    G = H // KV
+
+    row = jnp.clip(tok_row, 0, Bm - 1)
+    k_tok = jnp.take(k_cache, row, axis=0)  # [S, Smax, KV, D]
+    v_tok = jnp.take(v_cache, row, axis=0)
+    qg = q.reshape(S, KV, G, D)
+    scores = jnp.einsum(
+        "tkgd,tskd->tkgs", qg, k_tok, preferred_element_type=jnp.float32
+    )
+    scores = scores * (1.0 / jnp.sqrt(D).astype(jnp.float32))
+    if attn_softcap is not None:
+        scores = jnp.tanh(scores / attn_softcap) * attn_softcap
+
+    kv_pos = jnp.arange(Smax)
+    causal = kv_pos[None, :] <= q_positions[:, None]  # [S, Smax]
+    valid = kv_pos[None, :] < jnp.take(kv_valid_len, row)[:, None]
+    mask = causal & valid & (tok_row >= 0)[:, None]
+    if sliding_window is not None:
+        w = jnp.asarray(sliding_window, jnp.int32)
+        mask &= (w <= 0) | (kv_pos[None, :] > q_positions[:, None] - w)
+
+    scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+    probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "tkgs,tskd->tkgd", probs, v_tok, preferred_element_type=jnp.float32
+    )
+    return out.reshape(S, H, D).astype(q.dtype)

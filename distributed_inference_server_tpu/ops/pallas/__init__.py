@@ -34,11 +34,13 @@ from distributed_inference_server_tpu.ops.pallas.fused import (
 from distributed_inference_server_tpu.ops.pallas.paged_attention import (
     paged_attention_decode,
     paged_attention_prefill,
+    paged_attention_ragged,
 )
 
 __all__ = [
     "paged_attention_decode",
     "paged_attention_prefill",
+    "paged_attention_ragged",
     "rms_norm_pallas",
     "apply_rope_pallas",
     "quant_matmul_pallas",

@@ -691,3 +691,325 @@ def paged_attention_decode(
         "bkgjd,kj->bkgd", out_big.reshape(B, KV, G, KV, D), eye
     )
     return out.reshape(B, H, D)
+
+
+def _ragged_kernel(
+    # scalar-prefetch refs (SMEM)
+    tables_ref,  # [Bm, P] page id per (row, page-slot)
+    valid_ref,  # [Bm] valid token count per row (incl. its new tokens)
+    wrow_ref,  # [W] work-item row (-1 = padding item)
+    wwin_ref,  # [W] work-item packed-query window
+    wfirst_ref,  # [W] 1 = first work item of its window (init the out block)
+    window_ref,  # [1] sliding window (0 = full causal)
+    # tensor refs
+    qbd_ref,  # [1, 1, R, CD] this (window, head-chunk)'s block-diagonal
+    #           query tile; R = TQ*C*G
+    posr_ref,  # [1, R] per-q-row absolute position (token-expanded)
+    rowr_ref,  # [1, R] per-q-row owning batch row (-1 = padding token)
+    k_hbm,  # [num_pages, page_size, KV*D] full K pool (HBM)
+    v_hbm,  # [num_pages, page_size, KV*D] full V pool (HBM)
+    out_ref,  # [1, 1, R, CD] (VMEM; revisited by every segment of the window)
+    # scratch
+    k_buf,  # [2, PB, page_size, CD]
+    v_buf,
+    sem_k,
+    sem_v,
+    *,
+    page_size: int,
+    pages_per_block: int,
+    num_page_slots: int,
+    head_dim: int,
+    attn_softcap: float = 0.0,
+):
+    """Ragged mixed-batch body: each grid step is one (window, row)
+    SEGMENT — the tokens of one batch row that fall inside one TQ-wide
+    window of the packed query axis. Rows are packed back-to-back
+    (PackInfer-style), so a window can hold many decode rows (q_len 1
+    each) next to a prefill chunk's tail; segments of the same window run
+    as consecutive grid steps and read-modify-write the shared out block
+    (the first one zero-initializes it). The KV loop covers only the
+    segment's row, exactly like the decode/prefill kernels' per-row loop
+    — ragged per-row trip counts are the whole point."""
+    i = pl.program_id(1)
+    R, CD = qbd_ref.shape[2], qbd_ref.shape[3]
+    PB = pages_per_block
+    blk_tokens = PB * page_size
+
+    b = wrow_ref[i]
+    bb = jnp.maximum(b, 0)
+    valid = jnp.where(b >= 0, valid_ref[bb], 0)
+    pos_r = posr_ref[0].reshape(R, 1)
+    row_r = rowr_ref[0].reshape(R, 1)
+    belongs = (row_r == b) & (b >= 0)
+
+    # the segment's query-position span bounds the KV loop: nothing past
+    # the last query's causal horizon (or the row's valid length) is read
+    seg_hi = jnp.max(jnp.where(belongs, pos_r, -1)) + 1
+    kv_upper = jnp.minimum(valid, seg_hi)
+    num_blocks = lax.div(kv_upper + blk_tokens - 1, blk_tokens)
+    w = window_ref[0]
+    seg_lo = jnp.min(jnp.where(belongs, pos_r, jnp.int32(2**30)))
+    first_block = lax.div(
+        jnp.where(w > 0, jnp.maximum(seg_lo - w + 1, 0), 0), blk_tokens
+    )
+    eff_w = jnp.where(w > 0, w, jnp.int32(2**30))
+
+    @pl.when(wfirst_ref[i] != 0)
+    def _init():
+        out_ref[0, 0] = jnp.zeros((R, CD), out_ref.dtype)
+
+    def start_block(slot, blk):
+        for j in range(PB):
+            page = tables_ref[bb, jnp.minimum(blk * PB + j,
+                                              num_page_slots - 1)]
+            pltpu.make_async_copy(
+                k_hbm.at[page], k_buf.at[slot, j], sem_k.at[slot, j]
+            ).start()
+            pltpu.make_async_copy(
+                v_hbm.at[page], v_buf.at[slot, j], sem_v.at[slot, j]
+            ).start()
+
+    def wait_block(slot, blk):
+        for j in range(PB):
+            page = tables_ref[bb, jnp.minimum(blk * PB + j,
+                                              num_page_slots - 1)]
+            pltpu.make_async_copy(
+                k_hbm.at[page], k_buf.at[slot, j], sem_k.at[slot, j]
+            ).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[page], v_buf.at[slot, j], sem_v.at[slot, j]
+            ).wait()
+
+    m0 = jnp.full((R, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((R, 1), jnp.float32)
+    acc0 = jnp.zeros((R, CD), jnp.float32)
+    qbd = qbd_ref[0, 0] * (1.0 / (head_dim**0.5))  # [R, CD]
+
+    def loop(blk, carry):
+        m, l, acc = carry
+        slot = lax.rem(blk, 2)
+
+        @pl.when(blk + 1 < num_blocks)
+        def _prefetch():
+            start_block(lax.rem(blk + 1, 2), blk + 1)
+
+        wait_block(slot, blk)
+        start = blk * blk_tokens
+        kv_idx = start + lax.broadcasted_iota(
+            jnp.int32, (R, blk_tokens), 1
+        )
+        mask = belongs & (kv_idx <= pos_r) & (kv_idx < valid)
+        mask &= kv_idx > pos_r - eff_w
+
+        k = k_buf[slot].reshape(blk_tokens, CD)
+        v = v_buf[slot].reshape(blk_tokens, CD)
+        s = lax.dot_general(
+            qbd.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if attn_softcap:
+            s = jnp.tanh(s * (1.0 / attn_softcap)) * attn_softcap
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        probs = jnp.where(s > _NEG_INF * 0.5, jnp.exp(s - m_new), 0.0)
+        l_new = l * alpha + jnp.sum(probs, -1, keepdims=True)
+        acc_new = acc * alpha + lax.dot_general(
+            probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, acc_new)
+
+    def run():
+        start_block(lax.rem(first_block, 2), first_block)
+        return lax.fori_loop(first_block, num_blocks, loop, (m0, l0, acc0))
+
+    _, l, acc = lax.cond(
+        num_blocks > first_block, run, lambda: (m0, l0, acc0)
+    )
+    vals = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+    # RMW: only this segment's rows land; the window's other segments own
+    # (and have written / will write) the rest
+    out_ref[0, 0] = jnp.where(belongs, vals, out_ref[0, 0])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "q_block", "pages_per_block", "interpret",
+                     "attn_softcap"),
+)
+def paged_attention_ragged(
+    q: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    page_tables: jnp.ndarray,
+    tok_row: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    kv_valid_len: jnp.ndarray,
+    *,
+    page_size: int,
+    q_block: int = 128,
+    pages_per_block: int = 8,
+    interpret: bool | None = None,
+    sliding_window=0,
+    attn_softcap: float = 0.0,
+) -> jnp.ndarray:
+    """Ragged mixed-batch paged GQA attention — ONE kernel for a packed
+    batch of decode tokens (q_len 1) and prefill chunks (q_len up to the
+    chunk budget), the Ragged Paged Attention recipe (PAPERS.md) with
+    PackInfer-style packing: rows sit back-to-back on a flat token axis,
+    TQ-wide windows of it become MXU tiles, and per-(window, row)
+    segments run as grid steps whose KV loops cover only that row's
+    pages. Subsumes the decode kernel (all rows q_len 1) and the
+    chunked-prefill kernel (one row per window) — the engine's mixed
+    step launches THIS kernel for both phases so they cannot drift.
+
+    Contract: ``tok_row`` must be non-decreasing over the packed axis
+    (each row's tokens contiguous; -1 padding anywhere is masked but the
+    work-item bound assumes the packed form, so keep padding at the
+    end). ``q_pos`` is each token's absolute position in its row, and
+    positions within a row must ascend. K/V for the new tokens must
+    already be written to the pool.
+
+    Args:
+      q: [S, H, D] packed query tokens.
+      pool_k, pool_v: [num_slots, KV, D] one layer's flat page pool.
+      page_tables: [Bm, P] page ids per row.
+      tok_row: [S] owning row per packed token (-1 = padding).
+      q_pos: [S] absolute position of each packed token.
+      kv_valid_len: [Bm] valid tokens per row INCLUDING its new tokens.
+      q_block: packed-query window width (VMEM residency unit).
+
+    Returns: [S, H, D] attention outputs in q.dtype (padding and
+    fully-masked rows are garbage; callers mask by tok_row).
+    """
+    S, H, D = q.shape
+    num_slots, KV, _ = pool_k.shape
+    G = H // KV
+    num_pages = num_slots // page_size
+    Bm, P = page_tables.shape
+    PB = min(pages_per_block, P)
+    TQ = min(q_block, S)
+    while S % TQ:
+        TQ //= 2
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # head packing into 128-lane chunks, exactly as the prefill kernel
+    C = max(1, min(_LANES // D, KV))
+    while KV % C:
+        C -= 1
+    KVc = KV // C
+    CD = C * D
+    R = TQ * C * G
+    num_win = S // TQ
+
+    tok_row = tok_row.astype(jnp.int32)
+    q_pos = q_pos.astype(jnp.int32)
+
+    # ---- work-item metadata (plain XLA, tiny arrays) ----
+    # M[w, b]: window w holds tokens of row b. Segments are the set bits,
+    # ordered (w, b) so same-window segments are consecutive grid steps;
+    # with rows contiguous on the packed axis there are at most
+    # num_win + Bm of them (one boundary row per window plus one segment
+    # per window), the static work list size.
+    onehot = tok_row[:, None] == jnp.arange(Bm, dtype=jnp.int32)[None, :]
+    M = onehot.reshape(num_win, TQ, Bm).any(axis=1)  # [num_win, Bm]
+    flat = M.reshape(-1)
+    big = jnp.int32(num_win * Bm)
+    keys = jnp.where(flat, jnp.arange(num_win * Bm, dtype=jnp.int32), big)
+    W = num_win + Bm
+    # pad the key pool to W before sorting: with num_win == 1 (or
+    # Bm == 1) the set-bit pool is SMALLER than the work list, and a
+    # bare [:W] slice would leave the scalar-prefetch arrays shorter
+    # than the grid — out-of-bounds SMEM reads on real silicon (the
+    # clamping gather hides it in interpret mode)
+    keys = jnp.concatenate([keys, jnp.full((W,), big, jnp.int32)])
+    sel = jnp.sort(keys)[:W]
+    present = sel < big
+    sel = jnp.where(present, sel, 0)
+    work_row = jnp.where(present, sel % Bm, -1).astype(jnp.int32)
+    # padding items park on the LAST window: the work list is ordered so
+    # they form a suffix, and a belongs-empty RMW there is a no-op
+    work_win = jnp.where(present, sel // Bm, num_win - 1).astype(jnp.int32)
+    prev = jnp.concatenate([jnp.full((1,), -1, jnp.int32), work_win[:-1]])
+    work_first = ((work_win != prev) & present).astype(jnp.int32)
+
+    # block-diagonal query expansion per window (same trick as prefill)
+    eye = jnp.eye(C, dtype=q.dtype)
+    qbd = jnp.einsum(
+        "wtkugd,uj->wtkugjd",
+        q.reshape(num_win, TQ, KVc, C, G, D), eye,
+    )  # [num_win, TQ, KVc, C, G, C, D]
+    qbd = qbd.transpose(0, 2, 1, 3, 4, 5, 6).reshape(num_win, KVc, R, CD)
+    # per-q-row position / owning row (token-expanded to the R axis)
+    pos_r = jnp.broadcast_to(
+        q_pos.reshape(num_win, TQ, 1), (num_win, TQ, C * G)
+    ).reshape(num_win, R)
+    row_r = jnp.broadcast_to(
+        tok_row.reshape(num_win, TQ, 1), (num_win, TQ, C * G)
+    ).reshape(num_win, R)
+
+    k_pages = pool_k.reshape(num_pages, page_size, KV * D)
+    v_pages = pool_v.reshape(num_pages, page_size, KV * D)
+    tables = jnp.clip(page_tables.astype(jnp.int32), 0, num_pages - 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(KVc, W),
+        in_specs=[
+            pl.BlockSpec((1, 1, R, CD),
+                         lambda c, i, t, vl, wr, ww, wf, w: (ww[i], c, 0, 0)),
+            pl.BlockSpec((1, R),
+                         lambda c, i, t, vl, wr, ww, wf, w: (ww[i], 0)),
+            pl.BlockSpec((1, R),
+                         lambda c, i, t, vl, wr, ww, wf, w: (ww[i], 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, R, CD),
+            lambda c, i, t, vl, wr, ww, wf, w: (ww[i], c, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, PB, page_size, CD), pool_k.dtype),
+            pltpu.VMEM((2, PB, page_size, CD), pool_v.dtype),
+            pltpu.SemaphoreType.DMA((2, PB)),
+            pltpu.SemaphoreType.DMA((2, PB)),
+        ],
+    )
+
+    out_big = pl.pallas_call(
+        functools.partial(
+            _ragged_kernel,
+            page_size=page_size,
+            pages_per_block=PB,
+            num_page_slots=P,
+            head_dim=D,
+            attn_softcap=attn_softcap,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_win, KVc, R, CD), q.dtype),
+        interpret=interpret,
+        compiler_params=tpu_compiler_params(
+            # segments of one window REVISIT the same out block (RMW);
+            # both axes stay sequential
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * S * H * P * page_size * CD,
+            bytes_accessed=2 * Bm * KV * P * page_size * D
+            * pool_k.dtype.itemsize,
+            transcendentals=S * H * P * page_size,
+        ),
+    )(
+        tables, kv_valid_len.astype(jnp.int32), work_row, work_win,
+        work_first, jnp.asarray(sliding_window, jnp.int32).reshape(1),
+        qbd, pos_r, row_r, k_pages, v_pages,
+    )
+    out = jnp.einsum(
+        "wktugjd,uj->wtkugd",
+        out_big.reshape(num_win, KVc, TQ, C, G, C, D), eye,
+    )
+    return out.reshape(S, H, D)

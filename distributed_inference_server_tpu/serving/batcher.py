@@ -13,7 +13,12 @@ Preserves the reference's spec'd ``RequestBatcher`` semantics
 
 Downstream, batches go to the scheduler → engine runner, where requests
 join the continuous decode pool individually; the batch is an admission
-unit, not an execution shape.
+unit, not an execution shape. Execution-shape batching lives in the
+engine: prefill chunks share bucketed programs, and under
+``engine.mixed_step_tokens`` the engine composes RAGGED mixed batches —
+decode rows plus exact-length prefill chunks packed into one
+token-budgeted dispatch (engine/engine.py ``_mixed_step``) — so nothing
+here pads or shapes; admission stays window/size-bounded only.
 
 Deterministic for tests: ``poll(now)`` takes an explicit clock.
 """

@@ -109,6 +109,14 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "pipeline_depth": (int, 1),
         "prefill_batch": (int, 16),
         "prefill_token_budget": (int, 8192),
+        # ragged mixed-batch stepping (engine/engine.py; docs/PERF.md):
+        # > 0 replaces the prefill-quantum + decode-block pair with ONE
+        # dispatch over a packed batch of decode rows + prefill chunks
+        # whenever prefill work is pending — flat TBT under prompt
+        # bursts on a unified replica. The value is the TOTAL packed
+        # width (decode slots + prefill budget) and must exceed
+        # engine.max_batch. 0 = off (quantum-interleave baseline).
+        "mixed_step_tokens": (int, 0),
         # speculative decoding knobs (Req 12.3-12.5)
         "num_draft_tokens": (int, 4),
         "spec_disable_threshold": (float, 0.5),
@@ -540,6 +548,15 @@ class ServerConfig:
             )
         if r["batcher"]["window_ms"] < 0:
             raise ConfigError("batcher.window_ms must be >= 0")
+        if r["engine"]["mixed_step_tokens"] < 0:
+            raise ConfigError("engine.mixed_step_tokens must be >= 0")
+        if (0 < r["engine"]["mixed_step_tokens"]
+                <= r["engine"]["max_batch"]):
+            raise ConfigError(
+                "engine.mixed_step_tokens must exceed engine.max_batch "
+                "(the packed width holds every decode slot plus at "
+                "least one prefill token)"
+            )
         if not r["engine"]["prefill_buckets"]:
             raise ConfigError("engine.prefill_buckets must be non-empty")
         if sorted(r["engine"]["prefill_buckets"]) != r["engine"]["prefill_buckets"]:

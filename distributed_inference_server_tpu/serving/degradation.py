@@ -100,12 +100,31 @@ class DegradationController:
             self._evict(new)  # keep evicting while pressure stays high
         return self.level
 
+    #: mixed-step prefill share per ladder level (engine/engine.py
+    #: set_mixed_prefill_frac): under pressure, prompt loading slows
+    #: instead of decode slots stalling — decode rows keep their one
+    #: token per mixed dispatch at every rung
+    MIXED_PREFILL_FRAC = {
+        DegradationLevel.NORMAL: 1.0,
+        DegradationLevel.REDUCED_BATCH_SIZE: 0.5,
+        DegradationLevel.AGGRESSIVE_CACHE_EVICTION: 0.5,
+        DegradationLevel.REJECT_LOW_PRIORITY: 0.25,
+        DegradationLevel.EMERGENCY: 0.25,
+    }
+
     def _apply(self, old: DegradationLevel, new: DegradationLevel) -> None:
         # batch-size reduction: owns only the divisor — the config itself
         # stays owned by hot-reload, so the two compose
         self.dispatcher.batcher.size_divisor = (
             2 if new >= DegradationLevel.REDUCED_BATCH_SIZE else 1
         )
+        # mixed-step prefill share (no-op on engines without the mixed
+        # step); restored on the way back down the ladder
+        frac = self.MIXED_PREFILL_FRAC[new]
+        for runner in self.scheduler.engines():
+            setter = getattr(runner, "set_mixed_prefill_frac", None)
+            if setter is not None:
+                setter(frac)
         # cache eviction
         if new >= DegradationLevel.AGGRESSIVE_CACHE_EVICTION > old or (
             new >= DegradationLevel.EMERGENCY > old

@@ -71,6 +71,10 @@ class EngineStatus:
     # host-tier prefix cache occupancy (engine.host_tier_stats()); None
     # when the tier is off
     host_tier: Any = None
+    # ragged mixed-batch stepping (engine.mixed_stats(); docs/PERF.md):
+    # steps / prefill_tokens / decode_tokens / batch_density /
+    # prefill_frac — None when engine.mixed_step_tokens is 0
+    mixed: Any = None
     # fleet control plane (serving/fleet.py): True for a RemoteRunner
     # proxy's status reconstructed from a member heartbeat. Remote
     # replicas take routed admissions but are excluded from paths that
@@ -94,6 +98,8 @@ class EngineStatus:
             d["speculation"] = self.speculation
         if self.host_tier is not None:
             d["host_tier"] = self.host_tier
+        if self.mixed is not None:
+            d["mixed"] = self.mixed
         if self.remote:
             d["remote"] = True
         return d
@@ -265,6 +271,22 @@ class MetricsCollector:
             "kv_host_tier_pages",
             "Pages resident in the host-RAM prefix-cache tier",
             ["engine_id"], registry=r,
+        )
+        # ragged mixed-batch stepping (engine/engine.py _mixed_step;
+        # docs/PERF.md): tokens consumed by mixed dispatches per kind,
+        # and how full the packed MXU tiles actually ran
+        self.mixed_step_tokens = Counter(
+            "engine_mixed_step_tokens",
+            "Tokens consumed by ragged mixed-step dispatches (prefill = "
+            "packed prefill-chunk tokens, decode = advanced decode rows)",
+            ["kind"], registry=r,
+        )
+        self.mixed_density = Gauge(
+            "engine_mixed_batch_density",
+            "Rolling mean of real packed tokens / mixed_step_tokens per "
+            "mixed dispatch (1.0 = every MXU tile slot carried a real "
+            "token)", ["engine_id"],
+            registry=r,
         )
         self.queue_depth_g = Gauge(
             "queue_depth", "Queued requests by priority", ["priority"], registry=r
@@ -550,6 +572,20 @@ class MetricsCollector:
         """Host-tier occupancy gauges for one engine replica."""
         self.host_tier_bytes_g.labels(engine_id=engine_id).set(nbytes)
         self.host_tier_pages_g.labels(engine_id=engine_id).set(pages)
+
+    def record_mixed_step(self, prefill_tokens: int = 0,
+                          decode_tokens: int = 0) -> None:
+        """Mixed-step token deltas since the last report (runner)."""
+        if prefill_tokens:
+            self.mixed_step_tokens.labels(kind="prefill").inc(
+                prefill_tokens
+            )
+        if decode_tokens:
+            self.mixed_step_tokens.labels(kind="decode").inc(decode_tokens)
+
+    def set_mixed_density(self, engine_id: str, density: float) -> None:
+        """Rolling mixed-batch density gauge for one engine replica."""
+        self.mixed_density.labels(engine_id=engine_id).set(density)
 
     def set_queue_depth(self, high: int, normal: int, low: int) -> None:
         self.queue_depth_g.labels(priority="high").set(high)

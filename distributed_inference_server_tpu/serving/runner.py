@@ -179,6 +179,9 @@ class EngineRunner:
         self._thread: Optional[threading.Thread] = None
         self._cache_seen = {"hits": 0, "misses": 0, "evictions": 0,
                             "host_hit_pages": 0}
+        # mixed-step counter watermarks (engine.mixed_stats() reports
+        # totals; the collector wants deltas)
+        self._mixed_seen = {"prefill_tokens": 0, "decode_tokens": 0}
         # rolling prefix digest for cache-aware routing (ISSUE 5):
         # refreshed on the engine thread (allocator state is single-
         # owner), read as an immutable snapshot by status() from any
@@ -743,6 +746,16 @@ class EngineRunner:
                 cb(result, error)
         return True
 
+    def set_mixed_prefill_frac(self, frac: float) -> None:
+        """Degradation-ladder hook: shrink the mixed step's prefill
+        share under memory pressure (engine.set_mixed_prefill_frac on
+        the engine thread; a no-op when the mixed step is off)."""
+
+        def _do() -> None:
+            self._engine.set_mixed_prefill_frac(frac)
+
+        self._post(_do)
+
     def reset_speculation(self) -> None:
         """Clear every pattern's acceptance tracker (Req 12.5 explicit
         reset — e.g. the operator knows the request pattern changed);
@@ -839,6 +852,8 @@ class EngineRunner:
                     self._draining.append(old)
                 # fresh stats baseline for the new model (Req 13.5)
                 self._cache_seen = {"hits": 0, "misses": 0, "evictions": 0}
+                self._mixed_seen = {"prefill_tokens": 0,
+                                    "decode_tokens": 0}
                 if on_done:
                     on_done(True, None)
 
@@ -894,7 +909,7 @@ class EngineRunner:
         eng = self._engine
         used = total = cached = page_size = digest_depth = 0
         waiting = 0
-        speculation = host_tier = None
+        speculation = host_tier = mixed = None
         if eng is not None:
             try:
                 s = eng.cache_stats()
@@ -912,6 +927,7 @@ class EngineRunner:
                 digest_depth = eng.ecfg.digest_depth
                 waiting = eng.num_waiting()
                 host_tier = eng.host_tier_stats()
+                mixed = eng.mixed_stats()
                 speculation = eng.spec_stats()
                 if speculation is not None and self.metrics:
                     self.metrics.set_speculation(self.engine_id, speculation)
@@ -932,6 +948,7 @@ class EngineRunner:
             page_size=page_size,
             digest_depth=digest_depth,
             host_tier=host_tier,
+            mixed=mixed,
         )
 
     # -- runner thread ----------------------------------------------------
@@ -1135,9 +1152,23 @@ class EngineRunner:
             s = self._engine.cache_stats()
             host = self._engine.host_tier_stats()
             reloads = self._engine.drain_reload_durations()
+            mixed = self._engine.mixed_stats()
         except Exception as e:  # noqa: BLE001
             self._absorbed("cache_stats", e)
             return
+        if mixed is not None:
+            seen_m = self._mixed_seen
+            dp = max(0, mixed["prefill_tokens"] - seen_m["prefill_tokens"])
+            dd = max(0, mixed["decode_tokens"] - seen_m["decode_tokens"])
+            if dp or dd:
+                self.metrics.record_mixed_step(prefill_tokens=dp,
+                                               decode_tokens=dd)
+            self.metrics.set_mixed_density(self.engine_id,
+                                           mixed["batch_density"])
+            self._mixed_seen = {
+                "prefill_tokens": mixed["prefill_tokens"],
+                "decode_tokens": mixed["decode_tokens"],
+            }
         seen = self._cache_seen
         hits = max(0, s.hits - seen["hits"])
         self.metrics.record_cache(

@@ -107,11 +107,21 @@ class TestValidation:
             {"DIS_TPU_SERVER__STRATEGY": "psychic"},
             {"DIS_TPU_MODEL__DTYPE": "int4"},
             {"DIS_TPU_ENGINE__MAX_BATCH": "0"},
+            # mixed step: negative, and width not exceeding max_batch
+            {"DIS_TPU_ENGINE__MIXED_STEP_TOKENS": "-1"},
+            {"DIS_TPU_ENGINE__MIXED_STEP_TOKENS": "64"},  # == max_batch
         ],
     )
     def test_invalid_rejected(self, environ):
         with pytest.raises(ConfigError):
             ServerConfig.load(environ=environ)
+
+    def test_mixed_step_tokens_valid_and_off(self):
+        cfg = ServerConfig.load(
+            environ={"DIS_TPU_ENGINE__MIXED_STEP_TOKENS": "128"}
+        )
+        assert cfg.get("engine", "mixed_step_tokens") == 128
+        assert ServerConfig.load().get("engine", "mixed_step_tokens") == 0
 
     def test_cli_exit_nonzero_on_invalid(self):
         from distributed_inference_server_tpu.__main__ import main

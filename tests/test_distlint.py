@@ -515,6 +515,40 @@ def test_dl007_no_false_positive_on_double_buffered_export():
     ))
 
 
+def test_dl007_mixed_step_reap_is_hot():
+    """ISSUE 12 satellite: the mixed-step reap loop runs once per mixed
+    dispatch and walks completed prompts through the emission path — it
+    is policed exactly like the decode loop (no device work / host sync
+    beyond the one np.asarray block-boundary read)."""
+    out = check("DL007", f"{PKG}/engine/engine.py", (
+        "import jax.numpy as jnp\n"
+        "class LLMEngine:\n"
+        "    def _reap_mixed_prefill(self, group, chunk_lens, p_toks,\n"
+        "                            p_lps, outputs):\n"
+        "        pad = jnp.zeros((4,))\n"
+        "        x = self.arr.item()\n"
+        "        return pad, x\n"
+    ))
+    assert len(out) == 2 and all(f.severity == "P0" for f in out)
+    # the block-boundary np.asarray read is the intended design
+    assert not check("DL007", f"{PKG}/engine/engine.py", (
+        "import numpy as np\n"
+        "class LLMEngine:\n"
+        "    def _reap_mixed_prefill(self, group, chunk_lens, p_toks,\n"
+        "                            p_lps, outputs):\n"
+        "        toks = np.asarray(p_toks)\n"
+        "        return toks\n"
+    ))
+    # the mixed LAUNCH function is NOT hot: its jnp uploads are the
+    # per-dispatch design, like _launch's
+    assert not check("DL007", f"{PKG}/engine/engine.py", (
+        "import jax.numpy as jnp\n"
+        "class LLMEngine:\n"
+        "    def _mixed_step(self, outputs):\n"
+        "        return jnp.zeros((4,))\n"
+    ))
+
+
 def test_dl007_clean():
     # numpy host work in hot functions is fine; jnp outside them is fine
     assert not check("DL007", f"{PKG}/engine/engine.py", (
@@ -1323,6 +1357,33 @@ def test_dl012_real_repo_schema_parses():
                            files=[f"{PKG}/serving/config.py"])
     schema = DL012._parse_schema(mods[f"{PKG}/serving/config.py"])
     assert schema and "server" in schema and "port" in schema["server"]
+    # ISSUE 12: the mixed-step knob is a real schema entry, so every
+    # config.get("engine", "mixed_step_tokens") site is drift-checked
+    assert "mixed_step_tokens" in schema["engine"]
+
+
+def test_dl012_mixed_step_key_checked():
+    """The new engine.mixed_step_tokens key: a correct get is clean, a
+    typo'd key flags against the schema."""
+    out = pcheck("DL012", {
+        _CONFIG_FIXTURE: """
+_SCHEMA = {
+    "engine": {"mixed_step_tokens": (int, 0), "max_batch": (int, 64)},
+}
+class ServerConfig:
+    def get(self, section, key):
+        return None
+""",
+        f"{PKG}/serving/x.py": f"""
+from {PKG.replace('/', '.')}.serving.config import ServerConfig
+def f(cfg: ServerConfig):
+    ok = cfg.get("engine", "mixed_step_tokens")
+    bad = cfg.get("engine", "mixed_step_tokenz")
+    return ok, bad
+""",
+    })
+    assert len(out) == 1
+    assert "engine.mixed_step_tokenz" in out[0].message
 
 
 # ---------------------------------------------------------------------------

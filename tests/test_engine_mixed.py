@@ -1,0 +1,246 @@
+"""Ragged mixed-batch engine stepping (ISSUE 12,
+EngineConfig.mixed_step_tokens): token identity vs the quantum path it
+replaces, decode liveness during prompt loading, traffic accounting, the
+degradation prefill-share hook, and construction-time validation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distributed_inference_server_tpu.engine.kv_cache import PagedCacheConfig
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+
+
+def make_engine(tiny_params, mixed_step_tokens=0, max_batch=4,
+                num_pages=64, page_size=4, max_pages_per_seq=24, **kw):
+    return LLMEngine(
+        tiny_params,
+        TINY,
+        ByteTokenizer(),
+        EngineConfig(
+            max_batch=max_batch,
+            prefill_buckets=(8, 32),
+            paged=PagedCacheConfig(
+                num_pages=num_pages, page_size=page_size,
+                max_pages_per_seq=max_pages_per_seq,
+            ),
+            decode_block_size=4,
+            mixed_step_tokens=mixed_step_tokens,
+            **kw,
+        ),
+        dtype=jnp.float32,
+    )
+
+
+def drain(engine, toks=None, max_steps=800):
+    toks = {} if toks is None else toks
+    steps = 0
+    while engine.has_work():
+        steps += 1
+        assert steps < max_steps, "engine did not drain"
+        for out in engine.step():
+            assert out.error is None, out.error
+            if out.token_id is not None:
+                toks.setdefault(out.request_id, []).append(out.token_id)
+    return toks
+
+
+def test_long_prompt_mixed_token_identical_to_quantum(tiny_params):
+    """The acceptance-criteria identity: chat decodes in flight, a long
+    prompt arrives, and every request's emitted tokens are identical
+    between the mixed step and the quantum-interleave path."""
+    rng = np.random.default_rng(3)
+    chats = [rng.integers(1, 200, size=6).tolist() for _ in range(2)]
+    long_prompt = rng.integers(1, 200, size=60).tolist()
+
+    def run(mixed):
+        eng = make_engine(tiny_params, mixed_step_tokens=20 if mixed else 0)
+        toks = {}
+        for i, ids in enumerate(chats):
+            eng.add_request(f"c{i}", ids,
+                            SamplingParams(max_tokens=12, temperature=0.0))
+        for _ in range(3):  # chats are mid-decode when the prompt lands
+            for out in eng.step():
+                if out.token_id is not None:
+                    toks.setdefault(out.request_id, []).append(out.token_id)
+        eng.add_request("long", long_prompt,
+                        SamplingParams(max_tokens=8, temperature=0.0))
+        drain(eng, toks)
+        return toks, eng
+
+    want, _ = run(False)
+    got, eng = run(True)
+    assert got == want
+    stats = eng.mixed_stats()
+    assert stats["steps"] > 0
+    assert stats["prefill_tokens"] >= len(long_prompt) - 1
+    assert stats["decode_tokens"] > 0
+    assert 0.0 < stats["batch_density"] <= 1.0
+
+
+def test_mixed_decodes_advance_every_step_during_prefill(tiny_params):
+    """The perf contract behind flat TBT: while a long prompt loads,
+    every mixed step advances the seated decode rows — the quantum path
+    stalls them for the duration of each prefill dispatch."""
+    eng = make_engine(tiny_params, mixed_step_tokens=12)
+    rng = np.random.default_rng(5)
+    eng.add_request("chat", rng.integers(1, 200, size=6).tolist(),
+                    SamplingParams(max_tokens=40, temperature=0.0))
+    for _ in range(3):
+        eng.step()
+    eng.add_request("long", rng.integers(1, 200, size=64).tolist(),
+                    SamplingParams(max_tokens=2, temperature=0.0))
+    eng.step()  # admit + first mixed dispatch
+    before = eng.mixed_stats()
+    eng.step()
+    after = eng.mixed_stats()
+    # each step while the prompt loads is one mixed dispatch that
+    # schedules both kinds of tokens
+    assert after["steps"] == before["steps"] + 1
+    assert after["decode_tokens"] == before["decode_tokens"] + 1
+    assert after["prefill_tokens"] > before["prefill_tokens"]
+    drain(eng)
+
+
+def test_mixed_multi_prompt_batch_and_prefix_reuse(tiny_params):
+    """Several prompts prefill together inside the packed budget, and
+    prefix-cache sharing still applies underneath the mixed step."""
+    rng = np.random.default_rng(9)
+    shared = rng.integers(1, 200, size=16).tolist()
+    prompts = [shared + rng.integers(1, 200, size=4 + i).tolist()
+               for i in range(3)]
+
+    def run(mixed):
+        eng = make_engine(tiny_params, mixed_step_tokens=24 if mixed else 0)
+        toks = {}
+        # p0 completes first so its prefix pages publish; p1/p2 then
+        # prefill TOGETHER inside one packed budget, sharing them
+        eng.add_request("p0", prompts[0],
+                        SamplingParams(max_tokens=6, temperature=0.0))
+        drain(eng, toks)
+        for i, ids in enumerate(prompts[1:], start=1):
+            eng.add_request(f"p{i}", ids,
+                            SamplingParams(max_tokens=6, temperature=0.0))
+        drain(eng, toks)
+        return toks, eng.cache_stats().hits
+
+    want, _ = run(False)
+    got, hits = run(True)
+    assert got == want
+    assert hits > 0  # later prompts shared the warm prefix pages
+
+
+def test_mixed_prefill_frac_shrinks_share(tiny_params):
+    """The degradation hook: a shrunken prefill share loads fewer prompt
+    tokens per mixed dispatch (decode rows are untouched)."""
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, 200, size=64).tolist()
+
+    def tokens_first_step(frac):
+        eng = make_engine(tiny_params, mixed_step_tokens=20)
+        eng.set_mixed_prefill_frac(frac)
+        eng.add_request("p", prompt,
+                        SamplingParams(max_tokens=2, temperature=0.0))
+        eng.step()
+        n = eng.mixed_stats()["prefill_tokens"]
+        drain(eng)
+        return n
+
+    full = tokens_first_step(1.0)
+    half = tokens_first_step(0.5)
+    assert half < full
+    assert half >= 1  # progress is guaranteed at every rung
+
+
+def test_mixed_preemption_under_page_pressure(tiny_params):
+    """Page pressure inside a mixed step drains the pipeline and preempts
+    instead of wedging; every request still completes."""
+    eng = make_engine(tiny_params, mixed_step_tokens=12, max_batch=2,
+                      num_pages=14, max_pages_per_seq=10)
+    rng = np.random.default_rng(13)
+    for i in range(3):
+        eng.add_request(f"r{i}", rng.integers(1, 200, size=10).tolist(),
+                        SamplingParams(max_tokens=10, temperature=0.0))
+    toks = drain(eng)
+    assert len(toks) == 3
+    assert all(len(v) == 10 for v in toks.values())
+
+
+def test_mixed_abort_mid_prefill(tiny_params):
+    eng = make_engine(tiny_params, mixed_step_tokens=12)
+    rng = np.random.default_rng(17)
+    eng.add_request("gone", rng.integers(1, 200, size=40).tolist(),
+                    SamplingParams(max_tokens=4, temperature=0.0))
+    eng.add_request("stay", rng.integers(1, 200, size=8).tolist(),
+                    SamplingParams(max_tokens=4, temperature=0.0))
+    eng.step()  # first mixed dispatch in flight
+    assert eng.abort("gone")
+    toks = drain(eng)
+    assert "gone" not in toks and len(toks["stay"]) == 4
+    s = eng.cache_stats()
+    assert s.pages_total - s.pages_free == s.pages_cached  # all released
+
+
+def test_mixed_prefill_only_parks_handoff_ready(tiny_params):
+    """Disaggregated prefill still works under the mixed step: the first
+    token emits and the sequence parks for export."""
+    eng = make_engine(tiny_params, mixed_step_tokens=12)
+    rng = np.random.default_rng(19)
+    eng.add_request("h", rng.integers(1, 200, size=20).tolist(),
+                    SamplingParams(max_tokens=8, temperature=0.0),
+                    prefill_only=True)
+    steps = 0
+    while not eng.handoff_ready_ids():
+        eng.step()
+        steps += 1
+        assert steps < 100
+    assert eng.handoff_ready_ids() == ["h"]
+    exp = eng.export_handoff("h")
+    assert exp is not None and exp.seq_len == 20
+
+
+def test_mixed_warmup_covers_programs(tiny_params):
+    eng = make_engine(tiny_params, mixed_step_tokens=12)
+    eng.warmup()
+    assert eng._mixed_fn is not None  # the mixed program compiled
+    assert not eng.has_work()
+
+
+def test_mixed_stats_none_when_off(tiny_params):
+    eng = make_engine(tiny_params, mixed_step_tokens=0)
+    assert eng.mixed_stats() is None
+
+
+class TestConstructionValidation:
+    def test_must_exceed_max_batch(self, tiny_params):
+        with pytest.raises(ValueError, match="must exceed max_batch"):
+            make_engine(tiny_params, mixed_step_tokens=4, max_batch=4)
+
+    def test_rejects_speculation(self, tiny_params):
+        draft = llama.init_params(jax.random.PRNGKey(1), TINY,
+                                  dtype=jnp.float32)
+        with pytest.raises(ValueError, match="speculative"):
+            LLMEngine(
+                tiny_params, TINY, ByteTokenizer(),
+                EngineConfig(
+                    max_batch=2, prefill_buckets=(8, 32),
+                    paged=PagedCacheConfig(num_pages=32, page_size=4,
+                                           max_pages_per_seq=8),
+                    mixed_step_tokens=8,
+                ),
+                dtype=jnp.float32,
+                draft_params=draft, draft_cfg=TINY,
+            )

@@ -83,6 +83,36 @@ class TestPhaseModel:
         tl = rec.timeline("r1")
         assert sum(phases.values()) <= tl["wall_s"] + 1e-6
 
+    def test_mixed_step_prefill_attribution_window_subtraction(self):
+        """ISSUE 12: when prefill rides the MIXED step, a request's
+        prompt loads across several mixed dispatches while OTHER rows'
+        decode tokens interleave on the wall clock — but the per-request
+        phase model is unchanged: prefill is still dispatch ->
+        first_token minus the fetch windows inside it, the partition
+        stays exact, and a peer-fetch window that landed mid-mixed-
+        prefill subtracts from prefill, never from decode."""
+        rec = FlightRecorder()
+        rec.admit("r1", endpoint="generate")
+        rec.note("r1", "schedule", engine="e0", strategy="least_loaded")
+        # the prompt spreads over mixed dispatches: wall time passes
+        # before the first token, with a fetch window inside it
+        time.sleep(0.02)
+        rec.note("r1", "prefix_fetch", outcome="ok", seconds=0.015)
+        time.sleep(0.02)
+        rec.token("r1")  # first token: prefill complete
+        time.sleep(0.01)
+        rec.token("r1")
+        phases = rec.finish("r1", "ok")
+        tl = rec.timeline("r1")
+        # exact partition (window-subtraction did not tear it)
+        assert abs(sum(phases.values()) - tl["wall_s"]) < 1e-6
+        # the fetch window subtracted from PREFILL, exactly
+        assert abs(phases["peer_fetch"] - 0.015) < 1e-6
+        assert phases["prefill"] >= 0.04 - 0.015 - 1e-3
+        assert phases["prefill"] <= tl["ttft_s"] - 0.015 + 1e-6
+        # decode is untouched by the prefill-side window
+        assert phases["decode"] >= 0.01 - 1e-3
+
     def test_zero_token_error_request(self):
         rec = FlightRecorder()
         rec.admit("r1")

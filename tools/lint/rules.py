@@ -719,6 +719,12 @@ class DL007(Rule):
     HOT_FUNCTIONS = frozenset({
         "_process_block", "_drain_pending", "_emit_token", "_decode_piece",
         "_flush_pending_text", "_finish",
+        # the mixed-step reap (ISSUE 12): runs every mixed dispatch and
+        # walks completed prompts through the same emission path — its
+        # one np.asarray is the block-boundary read, anything jnp/sync
+        # beyond that stalls the mixed pipeline exactly like the decode
+        # loop
+        "_reap_mixed_prefill",
     })
     _SYNC_ATTRS = frozenset({"block_until_ready", "item"})
 
