@@ -246,6 +246,24 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "rerole_low_ratio": (float, 1.0),
         "rerole_cooldown_s": (float, 10.0),
         "rerole_interval_s": (float, 0.5),
+        # fleet KV data plane (serving/fleet_kv.py; docs/FLEET.md "KV
+        # data plane"): workers bind a KV data listener (kv_data_port;
+        # 0 = ephemeral) advertised per heartbeat; the registry host
+        # dials it lazily for cross-host handoff and peer prefix
+        # fetch. kv_enabled=false keeps a worker control-plane-only
+        # (no handoff target, no fetch source).
+        "kv_enabled": (bool, True),
+        "kv_data_port": (int, 0),
+        # cost of moving one page from a REMOTE peer, in recompute-page
+        # units (scheduler.FetchCosts.remote_page_cost): pricier than
+        # cache.fetch_page_cost so the route/fetch/recompute decision
+        # stays honest about the slower cross-host wire
+        "kv_page_cost": (float, 0.6),
+        # bounded in-flight bulk streams per member data channel; the
+        # (N+1)th concurrent handoff/fetch fails fast to its local
+        # fallback instead of queueing behind multi-MB transfers
+        "kv_max_streams": (int, 4),
+        "kv_connect_timeout_s": (float, 5.0),
     },
     "batcher": {
         "window_ms": (float, 50.0),
@@ -451,13 +469,18 @@ class ServerConfig:
         return SchedulingStrategy.parse(self.raw["server"]["strategy"])
 
     def engine_roles(self):
-        """Validated per-replica role list (serving/disagg.py)."""
+        """Validated per-replica role list (serving/disagg.py). Fleet
+        membership (registry host OR joined worker) relaxes the
+        single-sided-topology checks — the counterpart role may live on
+        another member, reachable over the KV data plane."""
         from distributed_inference_server_tpu.serving.disagg import (
             parse_roles,
         )
 
+        f = self.raw["fleet"]
         return parse_roles(self.raw["server"]["engine_roles"],
-                           self.raw["server"]["num_engines"])
+                           self.raw["server"]["num_engines"],
+                           fleet=bool(f["enabled"] or f["connect"]))
 
     def disagg_settings(self):
         from distributed_inference_server_tpu.serving.disagg import (
@@ -495,6 +518,10 @@ class ServerConfig:
             rerole_low_ratio=f["rerole_low_ratio"],
             rerole_cooldown_s=f["rerole_cooldown_s"],
             rerole_interval_s=f["rerole_interval_s"],
+            kv_enabled=f["kv_enabled"],
+            kv_data_port=f["kv_data_port"],
+            kv_max_streams=f["kv_max_streams"],
+            kv_connect_timeout_s=f["kv_connect_timeout_s"],
         )
 
     def fetch_costs(self):
@@ -510,6 +537,10 @@ class ServerConfig:
             min_pages=c["fetch_min_pages"],
             page_cost=c["fetch_page_cost"],
             load_cost_pages=c["fetch_load_cost"],
+            # cross-host wire rate (fleet KV data plane,
+            # serving/fleet_kv.py): the fleet section owns it because
+            # it prices the fleet wire, not the cache policy
+            remote_page_cost=self.raw["fleet"]["kv_page_cost"],
         )
 
     # -- validation --------------------------------------------------------
@@ -673,6 +704,17 @@ class ServerConfig:
             raise ConfigError("fleet.rerole_cooldown_s must be >= 0")
         if f["rerole_interval_s"] <= 0:
             raise ConfigError("fleet.rerole_interval_s must be positive")
+        # fleet KV data plane (serving/fleet_kv.py)
+        if not (0 <= f["kv_data_port"] < 65536):
+            raise ConfigError("fleet.kv_data_port must be in [0, 65536)")
+        if f["kv_page_cost"] < 0:
+            raise ConfigError("fleet.kv_page_cost must be >= 0")
+        if f["kv_max_streams"] < 1:
+            raise ConfigError("fleet.kv_max_streams must be >= 1")
+        if f["kv_connect_timeout_s"] <= 0:
+            raise ConfigError(
+                "fleet.kv_connect_timeout_s must be positive"
+            )
 
     def hot_diff(self, other: "ServerConfig") -> Dict[tuple, Any]:
         """(section, key) -> new value for hot-reloadable keys that differ."""

@@ -89,14 +89,20 @@ class DisaggSettings:
     wire_quant: str = "none"  # none | int8
 
 
-def parse_roles(spec: str, num_engines: int) -> List[str]:
+def parse_roles(spec: str, num_engines: int,
+                fleet: bool = False) -> List[str]:
     """Parse/validate ``server.engine_roles`` ("prefill,decode", ...).
 
     Empty spec = every engine ``unified`` (today's behavior). Raises
     ConfigError for unknown roles, a count mismatch with
     ``server.num_engines``, and nonsensical topologies: decode engines
     with no prefill engine would never receive work, and prefill engines
-    with no decode engine would have nowhere to hand off.
+    with no decode engine would have nowhere to hand off. ``fleet``
+    (the process is a registry host or a joined worker) RELAXES the two
+    topology checks — the counterpart role may live on another fleet
+    member, reachable over the KV data plane (serving/fleet_kv.py):
+    a prefill-only host migrates to a member's decode replicas, and a
+    decode-only member serves a remote prefill fleet.
     """
     if not spec.strip():
         return [ROLE_UNIFIED] * num_engines
@@ -114,16 +120,20 @@ def parse_roles(spec: str, num_engines: int) -> List[str]:
         )
     n_prefill = roles.count(ROLE_PREFILL)
     n_decode = roles.count(ROLE_DECODE)
-    if n_decode and not n_prefill:
+    if n_decode and not n_prefill and not fleet:
         raise ConfigError(
             "server.engine_roles: decode engines without any prefill "
             "engine would sit idle — prompts are only admitted to "
-            "prefill/unified replicas and only prefill replicas migrate"
+            "prefill/unified replicas and only prefill replicas "
+            "migrate (a decode-only topology is legal in fleet worker "
+            "mode, where the prefill fleet lives on other members)"
         )
-    if n_prefill and not n_decode:
+    if n_prefill and not n_decode and not fleet:
         raise ConfigError(
             "server.engine_roles: prefill engines need at least one "
-            "decode engine to hand off to"
+            "decode engine to hand off to (a prefill-only topology is "
+            "legal with fleet.enabled, where decode members join over "
+            "the KV data plane)"
         )
     return roles
 
@@ -709,8 +719,12 @@ class DisaggController:
     def _open_stream(self, job: _StreamJob) -> None:
         """Worker half of phase 1: move the prefix chunks through the
         channel, pick a decode target, and open an import session there.
-        Failure just flips the job to "failed" — the source sequence
-        never stopped decoding, so there is nothing to fall back FROM."""
+        A REMOTE target (a fleet member's decode replica behind a KV
+        data channel, serving/fleet_kv.py) skips the in-process channel
+        — the data channel does the real framing on its own wire
+        thread. Failure just flips the job to "failed" — the source
+        sequence never stopped decoding, so there is nothing to fall
+        back FROM."""
         try:
             # injection points (docs/RESILIENCE.md): disagg.chunk hits
             # once per chunk, so nth=N fails the transfer at its Nth
@@ -722,15 +736,18 @@ class DisaggController:
             faults.fire("disagg.slow_peer")
             for _ in job.chunks:
                 faults.fire("disagg.chunk")
-            wired = self.channel.transfer_chunks(
-                job.request_id, job.wire_quant, job.chunks,
-                trace=self._trace_ctx(job.req),
-            )
             target = self.scheduler.schedule_decode(
                 exclude=job.source.engine_id
             )
             if target is None:
                 raise HandoffError("no healthy decode engine")
+            if getattr(target, "is_remote", False):
+                wired = job.chunks  # the data channel frames for real
+            else:
+                wired = self.channel.transfer_chunks(
+                    job.request_id, job.wire_quant, job.chunks,
+                    trace=self._trace_ctx(job.req),
+                )
         except Exception as e:  # noqa: BLE001 — channel/sched fault domain
             with self._cv:
                 if job.status == "opening":
@@ -742,7 +759,8 @@ class DisaggController:
 
         def _opened(ok: bool, err: Optional[str],
                     job=job, target=target) -> None:
-            # runs on the target runner's thread
+            # runs on the target runner's thread (or the data channel's
+            # reader thread for a remote target)
             cancelled = False
             with self._cv:
                 if job.status == "cancelled":
@@ -756,9 +774,15 @@ class DisaggController:
             if cancelled and ok:
                 target.submit_import_abort(job.request_id)
 
-        target.submit_import_open(
-            job.request_id, job.n_prefix_pages, wired, _opened
-        )
+        if getattr(target, "is_remote", False):
+            target.submit_import_open(
+                job.request_id, job.n_prefix_pages, wired, _opened,
+                wire_quant=job.wire_quant, trace=self._trace_ctx(job.req),
+            )
+        else:
+            target.submit_import_open(
+                job.request_id, job.n_prefix_pages, wired, _opened
+            )
 
     def commit_stream(self, job: _StreamJob, exp: SequenceExport) -> None:
         """Queue phase 2 (called on the source runner's thread right
@@ -817,6 +841,7 @@ class DisaggController:
                 job.target.submit_import_abort(job.request_id)
             return
         n_prefix = len(job.chunks)
+        remote_target = getattr(job.target, "is_remote", False)
         try:
             tail = (mjob.exp.kv_chunks or [])[n_prefix:]
             # commit dropped on the channel (docs/RESILIENCE.md): the
@@ -826,7 +851,13 @@ class DisaggController:
             faults.fire("disagg.commit")
             for _ in tail:
                 faults.fire("disagg.chunk")
-            wired = self.channel.transfer_commit(mjob.exp, tail)
+            if remote_target:
+                # the data channel frames the tail itself; hand it the
+                # export with ONLY the tail chunks (the member already
+                # holds the prefix in its open session)
+                wired = dataclasses.replace(mjob.exp, kv_chunks=list(tail))
+            else:
+                wired = self.channel.transfer_commit(mjob.exp, tail)
         except Exception as e:  # noqa: BLE001 — channel fault domain
             if job.target is not None:
                 job.target.submit_import_abort(job.request_id)
@@ -863,6 +894,9 @@ class DisaggController:
                         nbytes=mjob.exp.kv_bytes(),
                         stall_s=stall,
                         chunks=len(mjob.exp.kv_chunks or []),
+                        scope=("remote"
+                               if getattr(target, "is_remote", False)
+                               else "local"),
                     )
             else:
                 logger.warning(
@@ -895,22 +929,27 @@ class DisaggController:
             if self._consume_abort(job):
                 return
             job.attempts += 1
-            try:
-                faults.fire("disagg.slow_peer")
-                faults.fire("disagg.transfer")
-                for _ in job.exp.kv_chunks or ():
-                    faults.fire("disagg.chunk")
-                wired = self.channel.transfer(job.exp)
-            except Exception as e:  # noqa: BLE001 — channel fault domain
-                last_err = f"channel {self.channel.name}: {e}"
-                if self.metrics:
-                    self.metrics.record_handoff("retry")
-                continue
             target = self.scheduler.schedule_decode(
                 exclude=job.source.engine_id
             )
             if target is None:
                 last_err = "no healthy decode engine"
+                if self.metrics:
+                    self.metrics.record_handoff("retry")
+                continue
+            try:
+                faults.fire("disagg.slow_peer")
+                faults.fire("disagg.transfer")
+                for _ in job.exp.kv_chunks or ():
+                    faults.fire("disagg.chunk")
+                if getattr(target, "is_remote", False):
+                    # cross-host target: the member's data channel does
+                    # the real framing (serving/fleet_kv.py)
+                    wired = job.exp
+                else:
+                    wired = self.channel.transfer(job.exp)
+            except Exception as e:  # noqa: BLE001 — channel fault domain
+                last_err = f"channel {self.channel.name}: {e}"
                 if self.metrics:
                     self.metrics.record_handoff("retry")
                 continue
@@ -956,6 +995,9 @@ class DisaggController:
                             nbytes=job.exp.kv_bytes(),
                             stall_s=stall,
                             chunks=len(job.exp.kv_chunks or []),
+                            scope=("remote"
+                                   if getattr(target, "is_remote", False)
+                                   else "local"),
                         )
                 else:
                     logger.warning(
@@ -1021,12 +1063,15 @@ class DisaggController:
         engine is worth the retry/fallback path, a topology with no
         decode replicas at all is not — prefill runners then admit
         unified and skip the per-request serialize/fallback churn).
-        Remote fleet proxies (serving/remote_runner.py) do not count:
-        KV handoff needs a local import session, so a decode replica
-        reachable only over the fleet wire is not a handoff target."""
+        Remote fleet proxies count exactly when their member carries a
+        KV data channel (``supports_kv_import``, serving/fleet_kv.py):
+        the two-phase import stream then runs over the wire; a decode
+        replica reachable only over the control wire is still not a
+        handoff target."""
         return any(
             getattr(r, "role", "unified") == "decode"
-            and not getattr(r, "is_remote", False)
+            and (not getattr(r, "is_remote", False)
+                 or getattr(r, "supports_kv_import", False))
             for r in self.scheduler.engines()
         )
 
@@ -1121,6 +1166,13 @@ class PrefixFetcher:
         ps = max(1, plan.page_size)
         t0 = time.monotonic()
         fetch_span = [None]  # set after the request half round-trips
+        # a remote peer (a fleet member behind a KV data channel,
+        # serving/fleet_kv.py): the request/response halves cross the
+        # REAL wire, so the in-process framing round-trip and the local
+        # wire-thread stage are skipped — the channel's own worker and
+        # reader threads own serialization
+        remote_peer = getattr(peer, "is_remote", False)
+        scope = "remote" if remote_peer else "local"
         with self._lock:
             self._fetching[rid] = False
 
@@ -1148,7 +1200,7 @@ class PrefixFetcher:
                                    target=target.engine_id)
             if self.metrics:
                 self.metrics.record_prefix_fetch(
-                    outcome, seconds=seconds, nbytes=nbytes
+                    outcome, seconds=seconds, nbytes=nbytes, scope=scope
                 )
             try:
                 if not aborted:
@@ -1200,8 +1252,9 @@ class PrefixFetcher:
             )
 
         def _on_export(result, err: Optional[str]) -> None:
-            # peer runner's thread (or the caller's, peer already down):
-            # only hand the serialized chunks off — no wire work here
+            # peer runner's thread (or the caller's, peer already down;
+            # the data channel's reader thread for a remote peer): only
+            # hand the serialized chunks off — no wire work here
             if result is None:
                 logger.debug("prefix fetch for %s: peer %s export failed "
                              "(%s); recomputing", rid, peer.engine_id, err)
@@ -1214,21 +1267,41 @@ class PrefixFetcher:
                 # between the routing score and the fetch
                 _settle("fallback")
                 return
+            if remote_peer:
+                # the chunks already crossed the real wire, crc-guarded
+                # per chunk — import directly (submit_prefix_import only
+                # posts to the target's inbox, cheap on this thread)
+                nbytes = sum(len(c.payload) for c in chunks)
+                tokens = list(req.prompt_ids[: depth * ps])
+                target.submit_prefix_import(
+                    rid, tokens, chunks,
+                    lambda ok, ierr: _on_import(ok, ierr, nbytes),
+                )
+                return
             self._submit_wire(lambda: _wire(depth, chunks))
 
         try:
             # the request half crosses the channel too, so the
             # KvPrefixFetch wire format (trace context included) is
-            # exercised on every fetch
+            # exercised on every fetch; a remote peer's request half is
+            # framed by the data channel itself
             req_span = getattr(req, "span", None)
-            rid_w, hashes_w, chunk_pages, wire_quant, trace_w = (
-                self.channel.transfer_fetch_request(
-                    rid, plan.prefix_hashes or (),
-                    self.settings.chunk_pages, self.settings.wire_quant,
-                    trace=(req_span.context()
-                           if req_span is not None else None),
+            req_trace = (req_span.context()
+                         if req_span is not None else None)
+            if remote_peer:
+                rid_w, hashes_w = rid, list(plan.prefix_hashes or ())
+                chunk_pages = self.settings.chunk_pages
+                wire_quant = self.settings.wire_quant
+                trace_w = req_trace
+            else:
+                rid_w, hashes_w, chunk_pages, wire_quant, trace_w = (
+                    self.channel.transfer_fetch_request(
+                        rid, plan.prefix_hashes or (),
+                        self.settings.chunk_pages,
+                        self.settings.wire_quant,
+                        trace=req_trace,
+                    )
                 )
-            )
         except Exception as e:  # noqa: BLE001 — channel fault domain
             logger.debug("prefix fetch for %s: request framing failed "
                          "(%s); recomputing", rid, e)
@@ -1242,8 +1315,13 @@ class PrefixFetcher:
                 request_id=str(rid), peer=peer.engine_id,
                 target=target.engine_id,
             )
-        peer.submit_prefix_export(rid_w, hashes_w, chunk_pages,
-                                  wire_quant, _on_export)
+        if remote_peer:
+            peer.submit_prefix_export(rid_w, hashes_w, chunk_pages,
+                                      wire_quant, _on_export,
+                                      trace=trace_w)
+        else:
+            peer.submit_prefix_export(rid_w, hashes_w, chunk_pages,
+                                      wire_quant, _on_export)
 
     def _submit_wire(self, fn: Callable[[], None]) -> None:
         with self._lock:

@@ -67,6 +67,14 @@ Point catalog (the authoritative list lives in docs/RESILIENCE.md):
                         high for one evaluation (drives role flips
                         deterministically; hysteresis still bounds the
                         actual flip rate)
+``fleet.kv_connect``    the lazy dial of a member's KV data channel
+                        fails (serving/fleet_kv.py) — the handoff
+                        degrades to decode-in-place, the fetch to
+                        recompute, exactly once
+``fleet.kv_chunk``      per-chunk wire death on a KV data channel (one
+                        hit per KvChunk frame either direction; ``nth``
+                        tears the stream at its Nth chunk) — same
+                        exactly-once degradation, zero page leak
 ======================  ====================================================
 """
 

@@ -93,6 +93,16 @@ class FleetSettings:
     # restart mints a new host:pid identity, so without eviction the
     # member table (and fleet_members{state="dead"}) grows forever
     dead_retention_s: float = 300.0
+    # fleet KV data plane (serving/fleet_kv.py; docs/FLEET.md "KV data
+    # plane"): workers bind a KV data listener (kv_data_port; 0 =
+    # ephemeral) and advertise it per heartbeat; the registry host
+    # dials it lazily for cross-host handoff and peer prefix fetch,
+    # with at most kv_max_streams bulk streams in flight per member.
+    # kv_enabled=False keeps a worker control-plane-only.
+    kv_enabled: bool = True
+    kv_data_port: int = 0
+    kv_max_streams: int = 4
+    kv_connect_timeout_s: float = 5.0
 
 
 # ---------------------------------------------------------------------------
@@ -493,6 +503,10 @@ class _MemberSession:
         # engine_id (member-local) -> RemoteRunner proxy; written on the
         # reader thread, read by close/detach paths — guarded by _lock
         self.runners: Dict[str, Any] = {}
+        # fleet KV data plane (serving/fleet_kv.py): the member's
+        # lazily-dialed data channel, created when a heartbeat
+        # advertises a data_port; guarded by _lock
+        self.kv_channel: Any = None
         self._lock = threading.Lock()
         self._send_lock = threading.Lock()
         self._closed = False
@@ -548,6 +562,8 @@ class _MemberSession:
         prev = self.server.registry.observe(member_id, statuses)
         if prev is None:
             return  # beat dropped (fleet.heartbeat fault) — no refresh
+        self.server._ensure_kv_channel(self, member_id,
+                                       obj.get("data_port", 0))
         self.server._refresh_runners(self, member_id, obj.get("engines", []),
                                      statuses, rejoined=prev == MEMBER_DEAD)
 
@@ -567,6 +583,12 @@ class _MemberSession:
         with self._lock:
             runners = list(self.runners.values())
             self.runners.clear()
+            kv_channel, self.kv_channel = self.kv_channel, None
+        if kv_channel is not None:
+            # fails every in-flight KV stream (handoffs fall back to
+            # decode-in-place, fetches to recompute) and the migrated
+            # requests whose events rode it (engine_crashed)
+            kv_channel.close(message)
         for runner in runners:
             # identity-checked: a reconnect's fresh proxy registered
             # under the same id must survive this session's late detach
@@ -737,6 +759,69 @@ class FleetServer:
                              exc_info=True)
                 self.tracer.record_drop("wire")
 
+    # -- KV data plane (session reader threads) -----------------------------
+
+    def _ensure_kv_channel(self, session: _MemberSession, member_id: str,
+                           data_port: int) -> None:
+        """Create (or retire) the member's KV data channel to match its
+        advertised ``data_port``. The channel itself dials lazily — the
+        first cross-host handoff/fetch pays the connect, not the
+        heartbeat path."""
+        from distributed_inference_server_tpu.serving.fleet_kv import (
+            KvDataChannel,
+        )
+
+        host = session.peer.rsplit(":", 1)[0]
+        with session._lock:
+            if session._closed:
+                return
+            current = session.kv_channel
+            if data_port <= 0:
+                session.kv_channel = None
+                stale = current
+            elif (current is not None
+                    and current.address == (host, data_port)):
+                return
+            else:
+                stale = current
+                session.kv_channel = KvDataChannel(
+                    member_id, host, data_port,
+                    max_streams=self.settings.kv_max_streams,
+                    connect_timeout_s=self.settings.kv_connect_timeout_s,
+                    metrics=self.metrics,
+                    on_event=session._on_event,
+                    on_lost_requests=lambda rids, reason,
+                    s=session: self._fail_kv_requests(s, rids, reason),
+                )
+            for runner in session.runners.values():
+                runner.kv_channel = session.kv_channel
+        if stale is not None:
+            stale.close("member advertised a new kv data port")
+
+    def _fail_kv_requests(self, session: _MemberSession,
+                          request_ids: List[str], reason: str) -> None:
+        """The data channel died with migrated requests mid-decode:
+        fail exactly those, fast (they streamed tokens — engine_crashed,
+        never silently re-run)."""
+        with session._lock:
+            runners = list(session.runners.values())
+        for runner in runners:
+            runner.fail_requests(request_ids, reason)
+
+    def kv_stats(self) -> Dict[str, Any]:
+        """Per-member data-channel state for the ``/server/stats``
+        fleet block (connected / in-flight streams / bytes)."""
+        with self._lock:
+            sessions = [(s.member_id, s) for s in self._sessions
+                        if s.member_id is not None]
+        out: Dict[str, Any] = {}
+        for member_id, session in sessions:
+            with session._lock:
+                channel = session.kv_channel
+            if channel is not None:
+                out[member_id] = channel.stats()
+        return out
+
     # -- runner materialization (session reader threads) -------------------
 
     def _refresh_runners(self, session: _MemberSession, member_id: str,
@@ -769,6 +854,7 @@ class FleetServer:
                         recorder=self.recorder,
                     )
                     runner.redispatch = self.redispatch
+                    runner.kv_channel = session.kv_channel
                     session.runners[local_id] = runner
                     self.scheduler.register(runner)
                     logger.info("fleet: registered remote engine %s "

@@ -77,10 +77,15 @@ class EngineStatus:
     mixed: Any = None
     # fleet control plane (serving/fleet.py): True for a RemoteRunner
     # proxy's status reconstructed from a member heartbeat. Remote
-    # replicas take routed admissions but are excluded from paths that
-    # need a local engine object (KV handoff targets, peer-fetch
-    # sources/targets, health-loop restarts).
+    # replicas take routed admissions; without a data plane they are
+    # excluded from paths that need to move KV bytes (handoff targets,
+    # peer-fetch sources) and always from health-loop restarts.
     remote: bool = False
+    # fleet KV data plane (serving/fleet_kv.py): True when the member
+    # behind a remote proxy carries a dialed-on-demand KV data channel,
+    # making it a legal handoff target and fetch source. In-process
+    # routing state only (never serialized — the member cannot know).
+    data_plane: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         d = {
@@ -102,6 +107,8 @@ class EngineStatus:
             d["mixed"] = self.mixed
         if self.remote:
             d["remote"] = True
+            if self.data_plane:
+                d["data_plane"] = True
         return d
 
 
@@ -238,14 +245,16 @@ class MetricsCollector:
             "kv_prefix_fetch_total",
             "Peer-to-peer prefix fetches by outcome (ok = fetched pages "
             "seated on the cold replica, fallback = peer death / stale "
-            "registry / torn stream degraded the request to recompute)",
-            ["outcome"], registry=r,
+            "registry / torn stream degraded the request to recompute) "
+            "and scope (local = in-process peer, remote = a fleet "
+            "member over its KV data channel)",
+            ["outcome", "scope"], registry=r,
         )
         self.prefix_fetch_bytes = Counter(
             "kv_prefix_fetch_bytes_total",
             "Serialized KV bytes moved by peer prefix fetches "
-            "(post wire-quantization)",
-            registry=r,
+            "(post wire-quantization), by peer scope (local | remote)",
+            ["scope"], registry=r,
         )
         self.prefix_fetch_latency = Histogram(
             "kv_prefix_fetch_seconds",
@@ -341,8 +350,10 @@ class MetricsCollector:
         )
         self.handoff_chunks = Counter(
             "kv_handoff_chunks_total",
-            "KvChunk frames moved over the handoff channel",
-            registry=r,
+            "KvChunk frames moved over the handoff channel, by target "
+            "scope (local = in-process decode replica, remote = a fleet "
+            "member over its KV data channel)",
+            ["scope"], registry=r,
         )
         self.handoffs = Counter(
             "kv_handoff_total",
@@ -541,15 +552,18 @@ class MetricsCollector:
 
     def record_prefix_fetch(self, outcome: str,
                             seconds: Optional[float] = None,
-                            nbytes: int = 0) -> None:
+                            nbytes: int = 0,
+                            scope: str = "local") -> None:
         """One peer-to-peer prefix fetch (disagg.PrefixFetcher):
         ``outcome`` is "ok" (pages seated on the cold replica) or
-        "fallback" (any failure — the request recomputed instead)."""
-        self.prefix_fetches.labels(outcome=outcome).inc()
+        "fallback" (any failure — the request recomputed instead);
+        ``scope`` is "local" (in-process peer) or "remote" (a fleet
+        member over its KV data channel, serving/fleet_kv.py)."""
+        self.prefix_fetches.labels(outcome=outcome, scope=scope).inc()
         if seconds is not None:
             self.prefix_fetch_latency.observe(seconds)
         if nbytes:
-            self.prefix_fetch_bytes.inc(nbytes)
+            self.prefix_fetch_bytes.labels(scope=scope).inc(nbytes)
         with self._lock:
             self._prefix_fetches[outcome] = (
                 self._prefix_fetches.get(outcome, 0) + 1
@@ -609,12 +623,14 @@ class MetricsCollector:
 
     def record_handoff(self, outcome: str, latency_s: Optional[float] = None,
                        nbytes: int = 0, stall_s: Optional[float] = None,
-                       chunks: int = 0) -> None:
+                       chunks: int = 0, scope: str = "local") -> None:
         """One KV-handoff event (serving/disagg.py): ``outcome`` is
         "ok" (resumed on a decode engine), "fallback" (decoded in place
         on the source), or "retry" (a failed attempt that was retried).
         ``stall_s`` is the decode pause the migrated sequence observed;
-        ``chunks`` counts streamed KvChunk frames (0 = monolithic)."""
+        ``chunks`` counts streamed KvChunk frames (0 = monolithic);
+        ``scope`` is "local" or "remote" (a cross-host target over the
+        fleet KV data channel, serving/fleet_kv.py)."""
         self.handoffs.labels(outcome=outcome).inc()
         if latency_s is not None:
             self.handoff_latency.observe(latency_s)
@@ -623,7 +639,7 @@ class MetricsCollector:
         if nbytes:
             self.handoff_bytes.inc(nbytes)
         if chunks:
-            self.handoff_chunks.inc(chunks)
+            self.handoff_chunks.labels(scope=scope).inc(chunks)
         with self._lock:
             self._handoffs[outcome] = self._handoffs.get(outcome, 0) + 1
             self._handoff_bytes += nbytes

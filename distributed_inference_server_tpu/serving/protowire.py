@@ -228,6 +228,9 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         1: ("member_id", "string", "one"),
         2: ("seq", "uint64", "one"),
         3: ("engines", "msg:EngineStatus", "rep"),
+        # fleet KV data plane (serving/fleet_kv.py): the member's KV
+        # data listener port; 0 = no data plane
+        4: ("data_port", "uint32", "one"),
     },
     "FleetSubmit": {
         1: ("request_id", "string", "one"),
@@ -304,6 +307,13 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         # distributed trace context (docs/OBSERVABILITY.md)
         4: ("trace_id", "string", "one"),
         5: ("parent_span_id", "string", "one"),
+        # fleet KV data plane (serving/fleet_kv.py): stream operation
+        # tag ("" = legacy in-process framing), member-local engine id,
+        # and the stream geometry the receiver assembles against
+        6: ("op", "string", "one"),
+        7: ("engine_id", "string", "one"),
+        8: ("prefix_pages", "uint32", "one"),
+        9: ("total_chunks", "uint32", "one"),
     },
     "KvChunk": {
         1: ("handoff_id", "string", "one"),
@@ -327,6 +337,20 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         # distributed trace context (docs/OBSERVABILITY.md)
         5: ("trace_id", "string", "one"),
         6: ("parent_span_id", "string", "one"),
+        # fleet KV data plane (serving/fleet_kv.py): which member
+        # engine serves the export ("" = in-process fetch)
+        7: ("engine_id", "string", "one"),
+    },
+    # Fleet KV data plane (serving/fleet_kv.py): per-stream terminal
+    # status of a member data channel — handoff open/commit/resume acks,
+    # fetch-response terminators, and host->member import aborts.
+    "KvStreamResult": {
+        1: ("stream_id", "string", "one"),
+        2: ("op", "string", "one"),
+        3: ("ok", "bool", "one"),
+        4: ("error", "string", "one"),
+        5: ("depth", "uint32", "one"),
+        6: ("engine_id", "string", "one"),
     },
     # Disaggregated prefill/decode serving (serving/disagg.py): a live
     # sequence lifted off a prefill engine for cross-process KV transfer.

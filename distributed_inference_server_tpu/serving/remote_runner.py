@@ -101,6 +101,14 @@ class RemoteRunner:
         self._send = send
         # wired by the FleetServer to Dispatcher.redispatch
         self.redispatch: Optional[Callable] = None
+        # fleet KV data plane (serving/fleet_kv.py; docs/FLEET.md "KV
+        # data plane"): the member's lazily-dialed data channel, set by
+        # the FleetServer when the member advertises a data_port. None =
+        # no data plane — this proxy is excluded from handoff targets
+        # and fetch sources, the pre-data-plane behavior exactly.
+        # Single-writer (the member session's refresh under its lock);
+        # readers tolerate one stale check  # distlint: ignore[DL008]
+        self.kv_channel = None
         # pop-first exactly-once protocol, GIL-atomic dict ops
         # (docs/RESILIENCE.md)  # distlint: ignore[DL008]
         self._inflight: Dict[Any, ServerRequest] = {}
@@ -126,6 +134,13 @@ class RemoteRunner:
     def role(self) -> str:
         s = self._status
         return s.role if s is not None else "unified"
+
+    @property
+    def supports_kv_import(self) -> bool:
+        """True when the member's KV data channel is wired: this proxy
+        can then be a handoff TARGET (cross-host prefill→decode
+        migration) and a peer-fetch SOURCE (serving/fleet_kv.py)."""
+        return self.kv_channel is not None
 
     # -- registry-side state (session reader / sweeper threads) ------------
 
@@ -172,10 +187,13 @@ class RemoteRunner:
             )
         # overlay liveness and THIS host's view of in-flight load: the
         # heartbeat is up to one interval stale, but requests this proxy
-        # forwarded are known-inflight right now
+        # forwarded are known-inflight right now. data_plane marks the
+        # member's KV data channel for the routing cost model
+        # (scheduler.plan_route fetches only from data-plane peers).
         return dataclasses.replace(
             s, healthy=self.is_healthy(),
             active_requests=max(s.active_requests, len(self._inflight)),
+            data_plane=self.kv_channel is not None,
         )
 
     def active_count(self) -> int:
@@ -242,6 +260,8 @@ class RemoteRunner:
     def abort(self, request_id) -> None:
         with self._events_lock:
             self._inflight.pop(request_id, None)
+        if self.kv_channel is not None:
+            self.kv_channel.release_request(request_id)
         try:
             self._send("FleetSubmit", {
                 "request_id": str(request_id),
@@ -251,6 +271,96 @@ class RemoteRunner:
         except Exception as e:  # noqa: BLE001 — the member is dying
             # anyway; its requests die with it
             self._absorbed("abort_send", e)
+
+    # -- fleet KV data plane (serving/fleet_kv.py) --------------------------
+    #
+    # The EngineRunner import/export surface the DisaggController and
+    # PrefixFetcher drive, satisfied over the member's data channel.
+    # Callback contracts match the local runner exactly: exactly once,
+    # from the channel's reader thread — or here, when the channel is
+    # missing/full/dead (the caller's fallback then runs immediately).
+
+    def submit_prefix_export(self, request_id, hashes, chunk_pages: int,
+                             wire_quant: str,
+                             on_done: Callable, trace=None) -> None:
+        """Peer-fetch SOURCE over the wire: the member's engine
+        serializes its cached chain and streams it back as KvChunks."""
+        ch = self.kv_channel
+        if ch is None:
+            on_done(None, "member has no kv data channel")
+            return
+        ch.fetch_prefix(request_id, self.local_engine_id, hashes,
+                        chunk_pages, wire_quant, trace, on_done)
+
+    def submit_import_open(self, request_id, prefix_pages: int, chunks,
+                           on_done: Callable, wire_quant: str = "none",
+                           trace=None) -> None:
+        """Phase 1 of a cross-host streamed handoff: the prefix chunks
+        ship while the source sequence keeps decoding; the member
+        reserves pages and validates as they arrive."""
+        ch = self.kv_channel
+        if ch is None:
+            on_done(False, "member has no kv data channel")
+            return
+        ch.import_open(request_id, self.local_engine_id, prefix_pages,
+                       wire_quant, chunks, trace, on_done)
+
+    def submit_import_commit(self, exp, req: ServerRequest,
+                             on_done: Callable) -> None:
+        """Phase 2: tail + host state cross the wire; on ok the member
+        engine owns the sequence and its decode events ride the data
+        channel back into this proxy's event pump."""
+        self._submit_sequence("import_commit", exp, req, on_done)
+
+    def submit_resume(self, exp, req: ServerRequest,
+                      on_done: Callable) -> None:
+        """Monolithic cross-host migration (same ownership contract as
+        submit_import_commit)."""
+        self._submit_sequence("resume", exp, req, on_done)
+
+    def _submit_sequence(self, op: str, exp, req: ServerRequest,
+                         on_done: Callable) -> None:
+        """Shared commit/resume ownership contract: the request is
+        registered in ``_inflight`` FIRST (so a channel death between
+        the stream's ok and the first event still fails it exactly
+        once), popped again on any failure arm — on_done fires exactly
+        once either way."""
+        ch = self.kv_channel
+        with self._events_lock:
+            self._inflight[req.request_id] = req
+        if ch is None or not self.is_healthy():
+            with self._events_lock:
+                self._inflight.pop(req.request_id, None)
+            on_done(False, self._last_error
+                    or "member has no kv data channel")
+            return
+
+        def _done(ok: bool, err, _req=req) -> None:
+            if not ok:
+                with self._events_lock:
+                    self._inflight.pop(_req.request_id, None)
+            on_done(ok, err)
+
+        span = getattr(req, "span", None)
+        trace = span.context() if span is not None else None
+        if op == "import_commit":
+            ch.import_commit(exp, self.local_engine_id, trace, _done)
+        else:
+            ch.resume(exp, self.local_engine_id, trace, _done)
+
+    def submit_import_abort(self, request_id) -> None:
+        ch = self.kv_channel
+        if ch is not None:
+            ch.import_abort(request_id, self.local_engine_id)
+
+    def fail_requests(self, request_ids, message: str) -> None:
+        """Fail a specific set of in-flight requests (the data channel
+        died under their event stream). Pop-first exactly-once like
+        every other terminal path."""
+        with self._events_lock:
+            reqs = [self._inflight[rid] for rid in request_ids
+                    if rid in self._inflight]
+        self._fail_all_of(reqs, message)
 
     # -- event pump (member session reader thread) -------------------------
 
@@ -458,6 +568,13 @@ class FleetWorker:
 
         self.member_id = (member_id or settings.member_id
                           or f"{socket.gethostname()}:{os.getpid()}")
+        # fleet KV data plane (serving/fleet_kv.py): the member's data
+        # listener, bound at start() and advertised in every heartbeat
+        # so the registry host can dial it lazily for cross-host
+        # handoff / peer prefix fetch. kv_enabled=False keeps the
+        # member control-plane-only (no handoff target, no fetch
+        # source — the pre-data-plane behavior).
+        self.kv_server = None
         self._sock: Optional[socket.socket] = None
         # serializes frame writes: the heartbeat thread and every local
         # runner thread's _RemoteSink share the socket
@@ -481,6 +598,16 @@ class FleetWorker:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self, connect_timeout_s: float = 10.0) -> None:
+        if self.settings.kv_enabled and self.kv_server is None:
+            from distributed_inference_server_tpu.serving.fleet_kv import (
+                KvDataServer,
+            )
+
+            self.kv_server = KvDataServer(
+                self.scheduler, port=self.settings.kv_data_port,
+                metrics=self.metrics,
+            )
+            self.kv_server.start()
         self._connect(connect_timeout_s)
         self._stop.clear()
         # lifecycle handle  # distlint: ignore[DL008]
@@ -492,6 +619,9 @@ class FleetWorker:
     def stop(self) -> None:
         self._stop.set()
         self._close()
+        if self.kv_server is not None:
+            self.kv_server.stop()
+            self.kv_server = None
         if self._beat_thread is not None:
             self._beat_thread.join(5.0)
             self._beat_thread = None
@@ -610,6 +740,8 @@ class FleetWorker:
                 "seq": self._seq,
                 "engines": [status_to_wire(s)
                             for s in self.scheduler.statuses()],
+                "data_port": (self.kv_server.bound_port
+                              if self.kv_server is not None else 0),
             })
             return True
         except Exception as e:  # noqa: BLE001 — link fault domain

@@ -443,6 +443,7 @@ class EngineRunner:
         self, request_id: RequestId, hashes: Sequence[int],
         chunk_pages: int, wire_quant: str,
         on_done: Callable[[Optional[tuple], Optional[str]], None],
+        trace=None,
     ) -> None:
         """Peer-fetch SOURCE side: serialize this engine's cached prefix
         chain for ``hashes`` (engine.export_prefix_chunks — HBM and
@@ -451,7 +452,9 @@ class EngineRunner:
         fires exactly once — from the runner thread, or here/at crash
         time if the engine is (or becomes) unavailable, so a peer dying
         mid-fetch degrades the caller to recompute instead of wedging
-        the request (docs/RESILIENCE.md)."""
+        the request (docs/RESILIENCE.md). ``trace`` exists for surface
+        parity with RemoteRunner (serving/fleet_kv.py carries it on the
+        wire); an in-process export has nowhere to ship it."""
         token = f"pfx:{request_id}"
         self._pending_fetches[token] = on_done
         if not self._healthy:

@@ -95,6 +95,13 @@ class FetchCosts:
     # load penalty: one active/waiting request on the target replica
     # costs this many pages of queueing delay
     load_cost_pages: float = 4.0
+    # wire cost of moving one page from a REMOTE (cross-host) peer over
+    # the fleet KV data channel (config ``fleet.kv_page_cost``): the
+    # member wire is slower than an in-process fetch, and the cost
+    # model must stay honest about it — a remote fetch wins only when
+    # the recompute/queueing gap exceeds the pricier wire term
+    # (serving/fleet_kv.py; docs/FLEET.md "KV data plane")
+    remote_page_cost: float = 0.6
 
 
 @dataclass(frozen=True)
@@ -149,15 +156,28 @@ def plan_route(
     n_pages = len(prefix_hashes) if prefix_hashes else 0
     depths = {s.engine_id: prefix_match_depth(s, prefix_hashes)
               for s in healthy}
-    # peer-fetch needs a LOCAL engine object on both ends (the export
-    # and import run on runner threads); remote replicas (fleet proxies,
-    # serving/remote_runner.py) still take warm/recompute routes — their
-    # heartbeated digests score like anyone's — but never source a fetch
-    local = [s for s in healthy if not getattr(s, "remote", False)]
-    peer = (min(local, key=lambda s: (-depths[s.engine_id], load(s),
-                                      s.engine_id))
-            if local else None)
+    # peer-fetch needs an engine that can SERVE an export: any local
+    # replica, or a remote one whose member carries a KV data channel
+    # (serving/fleet_kv.py — EngineStatus.data_plane). Control-plane-
+    # only remote replicas still take warm/recompute routes (their
+    # heartbeated digests score like anyone's) but never source a
+    # fetch. The fetch TARGET stays local: the import seats pages into
+    # this host's engine for the request this host is about to run.
+    fetchable = [s for s in healthy
+                 if not getattr(s, "remote", False)
+                 or getattr(s, "data_plane", False)]
+    # deepest match wins; a LOCAL peer beats a remote one at equal
+    # depth (cheaper wire), then load/id tie-breaks — deterministic
+    peer = (min(fetchable,
+                key=lambda s: (-depths[s.engine_id],
+                               1 if getattr(s, "remote", False) else 0,
+                               load(s), s.engine_id))
+            if fetchable else None)
     peer_depth = depths[peer.engine_id] if peer is not None else 0
+    peer_page_cost = (costs.remote_page_cost
+                      if peer is not None
+                      and getattr(peer, "remote", False)
+                      else costs.page_cost)
     # warm depth anywhere ADMISSIBLE (a remote replica's heartbeated
     # digest counts for routing even though it can never source a fetch)
     best_depth = max((depths[s.engine_id] for s in admissible), default=0)
@@ -179,10 +199,12 @@ def plan_route(
                 and peer_depth - d >= costs.min_pages):
             # the wire term charges the WHOLE chain: the fetch moves
             # pages 0..peer_depth (head-first contiguous tiling), not
-            # just the target's missing suffix
+            # just the target's missing suffix. peer_page_cost is the
+            # in-process rate for a local peer, fleet.kv_page_cost for
+            # a cross-host one.
             options.append((
                 base + (n_pages - peer_depth)
-                + costs.page_cost * peer_depth,
+                + peer_page_cost * peer_depth,
                 1, load(s), s.engine_id, "fetch", s, d,
             ))
     if faults.flag("sched.fetch_decision"):
@@ -482,12 +504,18 @@ class AdaptiveScheduler:
         """Pick the migration target for a finished prefill: the least-
         loaded healthy decode-role engine (``exclude`` drops the source,
         relevant only if an engine is both). None = no decode capacity —
-        the caller falls back to decoding in place. Remote replicas are
-        excluded: KV handoff needs a local import session (cross-host
-        handoff routes through the fleet registry in a later round)."""
-        statuses = [s for s in self.statuses()
-                    if s.engine_id != exclude
-                    and not getattr(s, "remote", False)]
+        the caller falls back to decoding in place. Remote replicas
+        qualify when their member carries a KV data channel
+        (``supports_kv_import``, serving/fleet_kv.py) — the two-phase
+        import stream then runs over the wire; control-plane-only
+        remotes stay excluded (no way to move the pages)."""
+        candidates = [
+            r for r in self.engines()
+            if r.engine_id != exclude
+            and (not getattr(r, "is_remote", False)
+                 or getattr(r, "supports_kv_import", False))
+        ]
+        statuses = [r.status() for r in candidates]
         engine_id = choose_engine(
             SchedulingStrategy.LEAST_LOADED, statuses, 0, roles=("decode",)
         )

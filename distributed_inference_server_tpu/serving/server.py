@@ -479,6 +479,10 @@ class InferenceServer:
         out: dict = {}
         if self.fleet_registry is not None:
             out.update(self.fleet_registry.stats())
+        if self.fleet_server is not None:
+            # KV data plane (serving/fleet_kv.py): per-member channel
+            # state — connected / in-flight streams / bytes moved
+            out["kv_channels"] = self.fleet_server.kv_stats()
         if self.role_balancer is not None:
             out["rebalancer"] = self.role_balancer.stats()
         out["role_map"] = {

@@ -544,6 +544,20 @@ class TestFleetChaosSeeds:
         ("rerole_flap", 31),
         ("rerole_flap", 32),
         ("rerole_flap", 33),
+        # fleet KV data plane (docs/FLEET.md "KV data plane"): the
+        # cross-host handoff stream dies — dial failure (41), member
+        # crash on the import command (43), wire torn at the Nth chunk
+        # (45) — and the request decodes in place, exactly once, zero
+        # pages leaked on either side.
+        ("cross_host_handoff_death", 41),
+        ("cross_host_handoff_death", 43),
+        ("cross_host_handoff_death", 45),
+        # the remote warm peer dies under a forced fetch — dial failure
+        # (41), response chunk torn (42, 45) — and the request degrades
+        # to recompute on its local target, exactly once.
+        ("remote_fetch_source_death", 41),
+        ("remote_fetch_source_death", 42),
+        ("remote_fetch_source_death", 45),
     ])
     def test_scenario_clean(self, scenario, seed, fleet_chaos_cache):
         from tools import chaos_fleet

@@ -362,7 +362,9 @@ def test_dl005_flags_kvchunk_field_drift():
             if a == "KvChunk"]
     assert any("payload" in m for m in msgs), msgs
     broken = {k: dict(v) for k, v in messages.items()}
-    broken["KvHandoffHeader"][9] = ("chunk_pages", "uint32", "one")
+    # field 19 is unused in the real header (9 became total_chunks when
+    # the fleet KV data plane extended it — ISSUE 13)
+    broken["KvHandoffHeader"][19] = ("chunk_pages", "uint32", "one")
     msgs = [m for a, m in compare_wire_schema(schema, broken, enums)
             if a == "KvHandoffHeader"]
     assert any("not in inference.proto" in m for m in msgs), msgs
@@ -1360,6 +1362,10 @@ def test_dl012_real_repo_schema_parses():
     # ISSUE 12: the mixed-step knob is a real schema entry, so every
     # config.get("engine", "mixed_step_tokens") site is drift-checked
     assert "mixed_step_tokens" in schema["engine"]
+    # ISSUE 13: the fleet KV data-plane knobs are real schema entries
+    for key in ("kv_enabled", "kv_data_port", "kv_page_cost",
+                "kv_max_streams", "kv_connect_timeout_s"):
+        assert key in schema["fleet"], key
 
 
 def test_dl012_mixed_step_key_checked():
@@ -1384,6 +1390,32 @@ def f(cfg: ServerConfig):
     })
     assert len(out) == 1
     assert "engine.mixed_step_tokenz" in out[0].message
+
+
+def test_dl012_fleet_kv_keys_checked():
+    """The fleet.kv_* keys (ISSUE 13, serving/fleet_kv.py): a correct
+    get (and the env-token spelling) is clean, a typo'd key flags."""
+    out = pcheck("DL012", {
+        _CONFIG_FIXTURE: """
+_SCHEMA = {
+    "fleet": {"kv_page_cost": (float, 0.6), "kv_max_streams": (int, 4)},
+}
+class ServerConfig:
+    def get(self, section, key):
+        return None
+""",
+        f"{PKG}/serving/x.py": f"""
+import os
+from {PKG.replace('/', '.')}.serving.config import ServerConfig
+def f(cfg: ServerConfig):
+    ok = cfg.get("fleet", "kv_page_cost")
+    env = os.environ.get("DIS_TPU_FLEET__KV_MAX_STREAMS")
+    bad = cfg.get("fleet", "kv_page_costs")
+    return ok, env, bad
+""",
+    })
+    assert len(out) == 1
+    assert "fleet.kv_page_costs" in out[0].message
 
 
 # ---------------------------------------------------------------------------

@@ -80,12 +80,12 @@ def _req(rid="r1", first_token=False, prompt=(1, 2, 3)):
 
 
 def _status(engine_id="e0", healthy=True, role="unified", waiting=0,
-            active=0, remote=False, digest=()):
+            active=0, remote=False, digest=(), data_plane=False):
     return EngineStatus(
         engine_id=engine_id, healthy=healthy, active_requests=active,
         waiting_requests=waiting, total_processed=0, role=role,
         prefix_digest=frozenset(digest), page_size=8, digest_depth=8,
-        remote=remote,
+        remote=remote, data_plane=data_plane,
     )
 
 
@@ -868,3 +868,716 @@ class TestSchedulerUnregisterIf:
         # and the current owner CAN unregister itself
         assert sched.unregister_if(new.engine_id, new) is new
         assert sched.get(new.engine_id) is None
+
+
+# ---------------------------------------------------------------------------
+# Fleet KV data plane (serving/fleet_kv.py; docs/FLEET.md "KV data plane")
+# ---------------------------------------------------------------------------
+
+
+class TestKvDataPlaneRouting:
+    def test_remote_data_plane_peer_sources_a_fetch(self):
+        """A remote warm peer WITH a data channel sources a fetch onto
+        the local cold target — the capability the data plane adds."""
+        hashes = (11, 12, 13, 14)
+        remote_warm = _status("w1:e0", remote=True, digest=hashes,
+                              data_plane=True, active=9)
+        local_cold = _status("local")
+        plan = plan_route([remote_warm, local_cold], hashes)
+        assert plan.decision == "fetch"
+        assert plan.engine_id == "local"
+        assert plan.peer_id == "w1:e0"
+
+    def test_control_plane_only_remote_never_sources(self):
+        """Without a data channel the old exclusion holds exactly."""
+        hashes = (11, 12, 13, 14)
+        remote_warm = _status("w1:e0", remote=True, digest=hashes,
+                              active=9)
+        local_cold = _status("local")
+        plan = plan_route([remote_warm, local_cold], hashes)
+        assert plan.decision in ("warm", "recompute")
+
+    def test_local_peer_preferred_at_equal_depth(self):
+        hashes = (11, 12, 13, 14)
+        remote_warm = _status("w1:e0", remote=True, digest=hashes,
+                              data_plane=True)
+        local_warm = _status("peer", digest=hashes, active=9)
+        local_cold = _status("local")
+        plan = plan_route([remote_warm, local_warm, local_cold], hashes)
+        if plan.decision == "fetch":
+            assert plan.peer_id == "peer"  # cheaper wire at equal depth
+
+    def test_remote_page_cost_prices_the_wire(self):
+        """fleet.kv_page_cost is the honesty knob: a pricey cross-host
+        wire flips the SAME topology from fetch to recompute."""
+        from distributed_inference_server_tpu.serving.scheduler import (
+            FetchCosts,
+        )
+
+        hashes = (11, 12, 13, 14)
+        remote_warm = _status("w1:e0", remote=True, digest=hashes,
+                              active=9, data_plane=True)
+        local_cold = _status("local")
+        cheap = plan_route([remote_warm, local_cold], hashes,
+                           costs=FetchCosts(remote_page_cost=0.5))
+        assert cheap.decision == "fetch"
+        dear = plan_route([remote_warm, local_cold], hashes,
+                          costs=FetchCosts(remote_page_cost=5.0))
+        assert dear.decision != "fetch"
+
+    def test_schedule_decode_includes_kv_capable_remote(self):
+        from distributed_inference_server_tpu.serving.scheduler import (
+            AdaptiveScheduler,
+        )
+
+        sched = AdaptiveScheduler()
+        runner, _ = _remote()
+        # feed the proxy a decode-role status under its fleet-namespaced
+        # id (what the member's heartbeat would publish)
+        runner.update_status(_status("w1:e0", role="decode", remote=True))
+        sched.register(runner)
+        # control-plane only: excluded, exactly as before
+        assert sched.schedule_decode() is None
+        runner.kv_channel = object()  # the member advertised a channel
+        assert sched.schedule_decode() is runner
+
+    def test_has_decode_targets_counts_kv_capable_remote(self):
+        from distributed_inference_server_tpu.serving.disagg import (
+            DisaggController,
+        )
+        from distributed_inference_server_tpu.serving.scheduler import (
+            AdaptiveScheduler,
+        )
+
+        sched = AdaptiveScheduler()
+        ctrl = DisaggController(sched)
+        runner, _ = _remote()
+        runner.update_status(_status("w1:e0", role="decode", remote=True))
+        sched.register(runner)
+        assert not ctrl.has_decode_targets()
+        runner.kv_channel = object()
+        assert ctrl.has_decode_targets()
+
+
+class _FakeKvRunner:
+    """Member-side runner double for wire tests: serves the KV import/
+    export surface synchronously (the real one posts to its inbox)."""
+
+    def __init__(self, engine_id="e0"):
+        self.engine_id = engine_id
+        self.opened = {}
+        self.committed = []
+        self.aborted = []
+        self.export_result = None  # (depth, chunks) | None
+        self.export_error = None
+        self.on_commit_req = None  # captures the member-side request
+
+    def is_healthy(self):
+        return True
+
+    def submit_prefix_export(self, rid, hashes, chunk_pages, wire_quant,
+                             on_done, trace=None):
+        if self.export_error is not None:
+            on_done(None, self.export_error)
+        else:
+            on_done(self.export_result, None)
+
+    def submit_import_open(self, rid, prefix_pages, chunks, on_done):
+        self.opened[rid] = (prefix_pages, list(chunks))
+        on_done(True, None)
+
+    def submit_import_commit(self, exp, req, on_done):
+        self.committed.append(exp)
+        self.on_commit_req = req
+        on_done(True, None)
+
+    def submit_resume(self, exp, req, on_done):
+        self.committed.append(exp)
+        self.on_commit_req = req
+        on_done(True, None)
+
+    def submit_import_abort(self, rid):
+        self.aborted.append(rid)
+
+    def abort(self, rid):
+        self.aborted.append(("abort", rid))
+
+
+class _FakeKvScheduler:
+    def __init__(self, runner):
+        self._runner = runner
+
+    def get(self, engine_id):
+        return self._runner if engine_id == self._runner.engine_id else None
+
+
+def _kv_chunks(n=2, payload=b"x" * 64):
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        KvChunk,
+        chunk_crc,
+    )
+
+    return [KvChunk(index=i, total=n, page_start=i, page_count=1,
+                    payload=payload, crc32=chunk_crc(payload))
+            for i in range(n)]
+
+
+@pytest.fixture()
+def kv_wire():
+    """A real KvDataServer (fake runner) + KvDataChannel over localhost
+    TCP — the data-channel wire exercised end to end without engines."""
+    from distributed_inference_server_tpu.serving.fleet_kv import (
+        KvDataChannel,
+        KvDataServer,
+    )
+
+    runner = _FakeKvRunner()
+    server = KvDataServer(_FakeKvScheduler(runner), host="127.0.0.1")
+    server.start()
+    events = []
+    lost = []
+    channel = KvDataChannel(
+        "w1", "127.0.0.1", server.bound_port, max_streams=2,
+        on_event=events.append,
+        on_lost_requests=lambda rids, reason: lost.append((rids, reason)),
+    )
+    yield channel, server, runner, events, lost
+    channel.close()
+    server.stop()
+
+
+class TestKvDataChannelWire:
+    def _wait(self, box, timeout=15.0):
+        assert box["ev"].wait(timeout), "stream never resolved"
+        return box
+
+    def _cb_box(self):
+        box = {"ev": threading.Event(), "args": None}
+
+        def cb(*args):
+            box["args"] = args
+            box["ev"].set()
+
+        return box, cb
+
+    def test_fetch_round_trip(self, kv_wire):
+        channel, _server, runner, _events, _lost = kv_wire
+        chunks = _kv_chunks(3)
+        runner.export_result = (3, chunks)
+        box, cb = self._cb_box()
+        channel.fetch_prefix("r1", "e0", [1, 2, 3], 8, "none", None, cb)
+        self._wait(box)
+        result, err = box["args"]
+        assert err is None
+        depth, got = result
+        assert depth == 3
+        assert [c.payload for c in got] == [c.payload for c in chunks]
+        assert [c.crc32 for c in got] == [c.crc32 for c in chunks]
+
+    def test_fetch_export_failure_resolves_stream(self, kv_wire):
+        channel, _server, runner, _events, _lost = kv_wire
+        runner.export_error = "chain evicted"
+        box, cb = self._cb_box()
+        channel.fetch_prefix("r1", "e0", [1], 8, "none", None, cb)
+        self._wait(box)
+        result, err = box["args"]
+        assert result is None and "chain evicted" in err
+
+    def test_open_commit_and_event_pump(self, kv_wire):
+        """The full cross-host handoff shape on the wire: open the
+        prefix, commit tail+state, then the member's sink events ride
+        back as FleetEvent frames."""
+        from distributed_inference_server_tpu.engine.engine import (
+            SamplingParams,
+            SequenceExport,
+        )
+
+        channel, _server, runner, events, _lost = kv_wire
+        prefix = _kv_chunks(2)
+        box, cb = self._cb_box()
+        channel.import_open("r1", "e0", 4, "none", prefix, None, cb)
+        self._wait(box)
+        assert box["args"][0] is True
+        assert runner.opened["r1"][0] == 4
+        assert len(runner.opened["r1"][1]) == 2
+
+        exp = SequenceExport(
+            request_id="r1", token_ids=[1, 2, 3, 4], prompt_len=3,
+            seq_len=4, next_token=9,
+            params=SamplingParams(max_tokens=8, temperature=0.0),
+            output_text="abc", emitted_upto=3, emitted_tokens=1,
+            pending_ids=[], kv=b"", kv_chunks=_kv_chunks(1),
+        )
+        box2, cb2 = self._cb_box()
+        channel.import_commit(exp, "e0", None, cb2)
+        self._wait(box2)
+        assert box2["args"][0] is True
+        got = runner.committed[0]
+        assert got.token_ids == [1, 2, 3, 4]
+        assert got.next_token == 9
+        assert len(got.kv_chunks) == 1
+        # the member-side request streams events back over the channel
+        runner.on_commit_req.sink.on_token(42, "hi", 4)
+        runner.on_commit_req.sink.on_done("stop", None)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and len(events) < 2:
+            time.sleep(0.02)
+        kinds = [e["kind"] for e in events]
+        assert kinds == ["token", "done"]
+        assert events[0]["token_id"] == 42
+        # done released the event-tracking entry
+        assert channel.stats()["event_requests"] == 0
+
+    def test_window_full_fails_fast(self, kv_wire):
+        """The bounded in-flight window: the (N+1)th stream fails to
+        its fallback instead of queueing behind bulk transfers."""
+        channel, _server, runner, _events, _lost = kv_wire
+        # stall resolution: the runner double never answers
+        runner.submit_prefix_export = lambda *a, **k: None
+        boxes = []
+        for i in range(2):
+            box, cb = self._cb_box()
+            boxes.append(box)
+            channel.fetch_prefix(f"r{i}", "e0", [1], 8, "none", None, cb)
+        box3, cb3 = self._cb_box()
+        channel.fetch_prefix("r2", "e0", [1], 8, "none", None, cb3)
+        self._wait(box3)
+        result, err = box3["args"]
+        assert result is None and "window full" in err
+        assert not boxes[0]["ev"].is_set()  # in-flight ones unaffected
+
+    def test_connect_fault_fails_stream(self, kv_wire):
+        """fleet.kv_connect (docs/RESILIENCE.md): the lazy dial dies —
+        the stream resolves failed and the caller falls back."""
+        channel, _server, _runner, _events, _lost = kv_wire
+        faults.install(faults.parse_spec("fleet.kv_connect:nth=1", 7))
+        box, cb = self._cb_box()
+        channel.fetch_prefix("r1", "e0", [1], 8, "none", None, cb)
+        self._wait(box)
+        result, err = box["args"]
+        assert result is None and err
+
+    def test_chunk_fault_tears_stream(self, kv_wire):
+        """fleet.kv_chunk: the Nth chunk dies on the wire — the stream
+        resolves failed (open never lands on the member)."""
+        channel, _server, runner, _events, _lost = kv_wire
+        faults.install(faults.parse_spec("fleet.kv_chunk:nth=1", 7))
+        box, cb = self._cb_box()
+        channel.import_open("r1", "e0", 2, "none", _kv_chunks(2), None, cb)
+        self._wait(box)
+        assert box["args"][0] is False
+        assert "r1" not in runner.opened
+
+    def test_channel_death_fails_event_requests(self, kv_wire):
+        """A data-channel death with a migrated request mid-decode
+        reports the lost request ids so the proxy can fail them fast."""
+        from distributed_inference_server_tpu.engine.engine import (
+            SamplingParams,
+            SequenceExport,
+        )
+
+        channel, server, runner, _events, lost = kv_wire
+        exp = SequenceExport(
+            request_id="r9", token_ids=[1, 2], prompt_len=1, seq_len=2,
+            next_token=3,
+            params=SamplingParams(max_tokens=8, temperature=0.0),
+            output_text="", emitted_upto=0, emitted_tokens=1,
+            pending_ids=[], kv=b"", kv_chunks=_kv_chunks(1),
+        )
+        box, cb = self._cb_box()
+        channel.resume(exp, "e0", None, cb)
+        self._wait(box)
+        assert box["args"][0] is True
+        assert channel.stats()["event_requests"] == 1
+        server.stop()  # the host link dies under the decode
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and not lost:
+            time.sleep(0.02)
+        assert lost and lost[0][0] == ["r9"]
+        # the member aborted its orphaned sequence
+        assert ("abort", "r9") in runner.aborted
+
+    def test_import_abort_reaches_member(self, kv_wire):
+        channel, _server, runner, _events, _lost = kv_wire
+        box, cb = self._cb_box()
+        channel.import_open("r1", "e0", 2, "none", _kv_chunks(2), None, cb)
+        self._wait(box)
+        channel.import_abort("r1", "e0")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and "r1" not in runner.aborted:
+            time.sleep(0.02)
+        assert "r1" in runner.aborted
+
+
+# ---------------------------------------------------------------------------
+# Cross-host handoff / remote fetch e2e (real engines, real data channel)
+# ---------------------------------------------------------------------------
+
+
+def _kv_pair(host_roles, member_roles, strategy=None, engine_kwargs=None):
+    """Registry host + in-process member joined over real TCP (control
+    wire AND KV data channel), with configurable topologies."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.remote_runner import (
+        FleetWorker,
+    )
+    from distributed_inference_server_tpu.serving.scheduler import (
+        SchedulingStrategy,
+    )
+    from distributed_inference_server_tpu.serving.server import (
+        InferenceServer,
+    )
+
+    params = llama.init_params(jax.random.PRNGKey(0), TINY,
+                               dtype=jnp.float32)
+    paged = PagedCacheConfig(num_pages=192, page_size=8,
+                             max_pages_per_seq=32)
+
+    def factory():
+        return LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(max_batch=4, prefill_buckets=(16, 64),
+                         paged=paged, warmup_compile=False,
+                         **(engine_kwargs or {})),
+            dtype=jnp.float32,
+        )
+
+    host = InferenceServer(
+        factory, ByteTokenizer(), "tiny", num_engines=len(host_roles),
+        engine_roles=list(host_roles), auto_restart=False,
+        strategy=(SchedulingStrategy.parse(strategy) if strategy
+                  else SchedulingStrategy.LEAST_LOADED),
+        fleet_settings=FleetSettings(enabled=True,
+                                     heartbeat_interval_s=0.1,
+                                     suspect_after_s=0.6,
+                                     dead_after_s=1.5),
+    )
+    host.start()
+    member = InferenceServer(
+        factory, ByteTokenizer(), "tiny", num_engines=len(member_roles),
+        engine_roles=list(member_roles), auto_restart=False,
+    )
+    member.start()
+    worker = FleetWorker(
+        member.scheduler,
+        FleetSettings(connect=f"127.0.0.1:{host.fleet_server.bound_port}",
+                      heartbeat_interval_s=0.1),
+        member_id="kv-w1",
+    )
+    worker.start()
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        remote = next((r for r in host.scheduler.engines()
+                       if getattr(r, "is_remote", False)
+                       and r.is_healthy()
+                       and getattr(r, "supports_kv_import", False)), None)
+        if remote is not None:
+            return host, member, worker
+        time.sleep(0.05)
+    pytest.fail("kv fleet member never joined with a data channel")
+
+
+@pytest.fixture(scope="module")
+def kv_handoff_pair():
+    """Host: one PREFILL engine. Member: one DECODE engine. Every
+    host-admitted request wants a cross-host migration over the data
+    channel (docs/FLEET.md "KV data plane")."""
+    host, member, worker = _kv_pair(["prefill"], ["decode"])
+    yield host, member, worker
+    faults.clear()
+    worker.stop()
+    member.shutdown(drain_timeout_s=5.0)
+    host.shutdown(drain_timeout_s=5.0)
+
+
+def _serve_tokens(runner, rid, prompt, max_tokens=48):
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+    sink = _Sink()
+    req = ServerRequest(
+        rid, ByteTokenizer().encode(prompt),
+        SamplingParams(max_tokens=max_tokens, temperature=0.0), sink)
+    runner.submit([req])
+    assert sink.ev.wait(120), f"{rid} never terminated"
+    return sink
+
+
+def _remote_handoffs(host):
+    prom = host.metrics.prometheus_text().decode()
+    import re
+
+    m = re.search(r'kv_handoff_chunks_total\{scope="remote"\} ([0-9.]+)',
+                  prom)
+    return float(m.group(1)) if m else 0.0
+
+
+class TestCrossHostHandoffE2E:
+    """ACCEPTANCE (ISSUE 13): cross-host prefill→decode handoff over
+    the member data channel — bit-identical to the local greedy stream,
+    f32 and int8 wire; a mid-stream peer death degrades to
+    decode-in-place exactly once with zero page leak."""
+
+    PROMPT = "the kv bytes take the long way home"
+
+    def _migrated_serve(self, host, rid, max_tokens=48, attempts=4):
+        """Serve via the host's prefill runner until a migration lands
+        (a fast in-place completion during the open window is a CORRECT
+        degradation, not a failure — identity asserted every time)."""
+        local = next(r for r in host.scheduler.engines()
+                     if not getattr(r, "is_remote", False))
+        before = _remote_handoffs(host)
+        for i in range(attempts):
+            sink = _serve_tokens(local, f"{rid}-{i}", self.PROMPT,
+                                 max_tokens)
+            assert not sink.errors, sink.errors
+            if _remote_handoffs(host) > before:
+                return sink, f"{rid}-{i}"
+        pytest.fail(f"no cross-host migration in {attempts} attempts")
+
+    def test_remote_handoff_token_identity_f32(self, kv_handoff_pair):
+        host, member, _ = kv_handoff_pair
+        # reference: the member's own engine decoding in place (same
+        # seeded params — the wire must not perturb a single token)
+        member_local = member.scheduler.engines()[0]
+        ref = _serve_tokens(member_local, "kvho-ref", self.PROMPT)
+        assert not ref.errors
+        sink, rid = self._migrated_serve(host, "kvho-f32")
+        assert sink.toks == ref.toks and sink.text == ref.text
+        assert sink.dones == 1
+        # phase attribution covers the REMOTE handoff_stall window
+        tl = host.recorder.timeline(rid)
+        assert tl is not None
+        assert any(e["name"] == "handoff_resume" for e in tl["events"])
+        assert tl["phases"]["handoff_stall"] > 0
+        # metrics: ok outcome with remote-scoped chunks
+        snap = host.metrics.snapshot().to_dict()
+        assert snap["disagg"]["handoffs"].get("ok", 0) >= 1
+
+    def test_remote_handoff_token_identity_int8_wire(self,
+                                                     kv_handoff_pair):
+        import dataclasses as _dc
+
+        host, member, _ = kv_handoff_pair
+        member_local = member.scheduler.engines()[0]
+        ref = _serve_tokens(member_local, "kvho-ref8", self.PROMPT)
+        old = host.disagg.settings
+        host.disagg.settings = _dc.replace(old, wire_quant="int8")
+        try:
+            sink, _rid = self._migrated_serve(host, "kvho-int8")
+        finally:
+            host.disagg.settings = old
+        # int8 wire quantization is exact for greedy tiny-f32 streams
+        # (the same tolerance contract the in-process int8 tests pin)
+        assert sink.toks == ref.toks and sink.text == ref.text
+
+    def test_peer_death_mid_stream_decodes_in_place(self,
+                                                    kv_handoff_pair):
+        host, member, _ = kv_handoff_pair
+        member_local = member.scheduler.engines()[0]
+        ref = _serve_tokens(member_local, "kvho-refd", self.PROMPT)
+        local = next(r for r in host.scheduler.engines()
+                     if not getattr(r, "is_remote", False))
+        faults.install(faults.parse_spec("fleet.kv_chunk:nth=1", 5))
+        try:
+            sink = _serve_tokens(local, "kvho-dead", self.PROMPT)
+        finally:
+            faults.clear()
+        # exactly once, token-identical, in place
+        assert not sink.errors and sink.dones == 1
+        assert sink.toks == ref.toks and sink.text == ref.text
+        # zero page leak on either side
+        assert local.audit() == []
+        assert member.scheduler.engines()[0].audit() == []
+
+
+@pytest.fixture(scope="module")
+def kv_fetch_pair():
+    """Host: one unified cache_aware engine (the fetch target). Member:
+    one unified engine (the warm fetch source). Python allocator tier —
+    digests need its export surface."""
+    host, member, worker = _kv_pair(
+        ["unified"], ["unified"], strategy="cache_aware",
+        engine_kwargs={"native_allocator": False},
+    )
+    yield host, member, worker
+    faults.clear()
+    worker.stop()
+    member.shutdown(drain_timeout_s=5.0)
+    host.shutdown(drain_timeout_s=5.0)
+
+
+def _warm_member(host, member, prompt):
+    """Warm the member's prefix cache over the control wire and wait
+    until THIS prompt's chain head is in the heartbeated digest (a
+    non-empty digest from an earlier prompt is not enough — routing
+    would see depth 0 and never plan a fetch)."""
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        chain_hashes,
+    )
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+    remote = next(r for r in host.scheduler.engines()
+                  if getattr(r, "is_remote", False))
+    for i in range(2):
+        sink = _serve_tokens(remote, f"warm-{abs(hash(prompt)) % 997}-{i}",
+                             prompt, max_tokens=8)
+        assert not sink.errors
+    head = chain_hashes(ByteTokenizer().encode(prompt), 8, max_pages=1)[0]
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        s = remote.status()
+        if (s.prefix_digest and head in s.prefix_digest
+                and getattr(s, "data_plane", False)):
+            return remote
+        time.sleep(0.05)
+    pytest.fail("member digest never reached the routing snapshot")
+
+
+def _dispatch_request(host, rid, prompt, max_tokens=16):
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+    sink = _Sink()
+    host.dispatcher.submit(ServerRequest(
+        rid, ByteTokenizer().encode(prompt),
+        SamplingParams(max_tokens=max_tokens, temperature=0.0), sink))
+    assert sink.ev.wait(120), f"{rid} never terminated"
+    return sink
+
+
+class TestRemoteFetchE2E:
+    """ACCEPTANCE (ISSUE 13): cross-host peer prefix fetch — a remote
+    warm member sources the chain onto the cold local target over the
+    data channel, token-identically; peer death degrades to recompute
+    exactly once with zero page leak."""
+
+    def _fetch_counts(self, host):
+        snap = host.metrics.snapshot().to_dict()
+        return dict((snap.get("cache") or {}).get("peer_fetch") or {})
+
+    def test_remote_fetch_token_identity_f32(self, kv_fetch_pair):
+        host, member, _ = kv_fetch_pair
+        prompt = "warm chains cross the wire " * 2
+        _warm_member(host, member, prompt)
+        before = self._fetch_counts(host).get("ok", 0)
+        faults.install(faults.parse_spec("sched.fetch_decision:nth=1", 3))
+        try:
+            sink = _dispatch_request(host, "kvpf-f32", prompt)
+        finally:
+            faults.clear()
+        assert not sink.errors and sink.dones == 1
+        assert self._fetch_counts(host).get("ok", 0) == before + 1
+        # identity: the member decodes the same prompt in place
+        ref = _serve_tokens(member.scheduler.engines()[0], "kvpf-ref",
+                            prompt, max_tokens=16)
+        assert sink.toks == ref.toks and sink.text == ref.text
+        # phase attribution covers the REMOTE peer_fetch window
+        tl = host.recorder.timeline("kvpf-f32")
+        assert tl is not None and tl["phases"]["peer_fetch"] > 0
+        # scope=remote on the wire counters
+        prom = host.metrics.prometheus_text().decode()
+        assert 'kv_prefix_fetch_total{outcome="ok",scope="remote"}' in prom
+        assert 'kv_prefix_fetch_bytes_total{scope="remote"}' in prom
+
+    def test_remote_fetch_token_identity_int8_wire(self, kv_fetch_pair):
+        import dataclasses as _dc
+
+        host, member, _ = kv_fetch_pair
+        prompt = "int8 codes ride the member wire " * 2
+        _warm_member(host, member, prompt)
+        before = self._fetch_counts(host).get("ok", 0)
+        fetcher = host.prefix_fetcher
+        old = fetcher.settings
+        fetcher.settings = _dc.replace(old, wire_quant="int8")
+        faults.install(faults.parse_spec("sched.fetch_decision:nth=1", 3))
+        try:
+            sink = _dispatch_request(host, "kvpf-int8", prompt)
+        finally:
+            faults.clear()
+            fetcher.settings = old
+        assert not sink.errors and sink.dones == 1
+        assert self._fetch_counts(host).get("ok", 0) == before + 1
+        ref = _serve_tokens(member.scheduler.engines()[0], "kvpf-ref8",
+                            prompt, max_tokens=16)
+        assert sink.toks == ref.toks and sink.text == ref.text
+
+    def test_remote_source_death_degrades_to_recompute(self,
+                                                       kv_fetch_pair):
+        host, member, _ = kv_fetch_pair
+        prompt = "the peer dies and the target recomputes " * 2
+        _warm_member(host, member, prompt)
+        before = self._fetch_counts(host).get("fallback", 0)
+        faults.install(faults.parse_spec(
+            "sched.fetch_decision:nth=1;fleet.kv_chunk:nth=1", 5))
+        try:
+            sink = _dispatch_request(host, "kvpf-dead", prompt)
+        finally:
+            faults.clear()
+        assert not sink.errors and sink.dones == 1
+        assert self._fetch_counts(host).get("fallback", 0) == before + 1
+        ref = _serve_tokens(member.scheduler.engines()[0], "kvpf-refd",
+                            prompt, max_tokens=16)
+        assert sink.toks == ref.toks and sink.text == ref.text
+        local = next(r for r in host.scheduler.engines()
+                     if not getattr(r, "is_remote", False))
+        assert local.audit() == []
+        assert member.scheduler.engines()[0].audit() == []
+
+
+class TestKvFleetConfig:
+    def test_kv_settings_mapping(self):
+        cfg = ServerConfig.load(environ={
+            "DIS_TPU_FLEET__KV_DATA_PORT": "40100",
+            "DIS_TPU_FLEET__KV_PAGE_COST": "0.9",
+            "DIS_TPU_FLEET__KV_MAX_STREAMS": "2",
+            "DIS_TPU_FLEET__KV_CONNECT_TIMEOUT_S": "2.5",
+            "DIS_TPU_FLEET__KV_ENABLED": "false",
+        })
+        fs = cfg.fleet_settings()
+        assert fs.kv_data_port == 40100
+        assert fs.kv_max_streams == 2
+        assert fs.kv_connect_timeout_s == 2.5
+        assert fs.kv_enabled is False
+        # the cross-host wire rate lands in the routing cost model
+        assert cfg.fetch_costs().remote_page_cost == 0.9
+
+    @pytest.mark.parametrize("env", [
+        {"DIS_TPU_FLEET__KV_DATA_PORT": "70000"},
+        {"DIS_TPU_FLEET__KV_PAGE_COST": "-1"},
+        {"DIS_TPU_FLEET__KV_MAX_STREAMS": "0"},
+        {"DIS_TPU_FLEET__KV_CONNECT_TIMEOUT_S": "0"},
+    ])
+    def test_kv_validation_rejects(self, env):
+        with pytest.raises(ConfigError):
+            ServerConfig.load(environ=env)
+
+    def test_fleet_relaxes_single_sided_role_topologies(self):
+        """A prefill-only registry host / decode-only worker is a LEGAL
+        production config once the process is part of a fleet — the
+        counterpart role lives on another member over the KV data
+        plane. Standalone processes keep the strict check."""
+        with pytest.raises(ConfigError):
+            ServerConfig.load(environ={
+                "DIS_TPU_SERVER__ENGINE_ROLES": "prefill"})
+        with pytest.raises(ConfigError):
+            ServerConfig.load(environ={
+                "DIS_TPU_SERVER__ENGINE_ROLES": "decode"})
+        host = ServerConfig.load(environ={
+            "DIS_TPU_SERVER__ENGINE_ROLES": "prefill",
+            "DIS_TPU_FLEET__ENABLED": "true"})
+        assert host.engine_roles() == ["prefill"]
+        worker = ServerConfig.load(environ={
+            "DIS_TPU_SERVER__ENGINE_ROLES": "decode",
+            "DIS_TPU_FLEET__CONNECT": "127.0.0.1:9999"})
+        assert worker.engine_roles() == ["decode"]

@@ -147,7 +147,13 @@ def test_kvchunk_and_header_roundtrip_fuzz():
              # trace context (docs/OBSERVABILITY.md): untraced headers
              # keep the fields off the wire; decode fills the defaults
              "trace_id": rng.choice(["", "aabbccdd11223344"]),
-             "parent_span_id": rng.choice(["", "5566778899aabbcc"])}
+             "parent_span_id": rng.choice(["", "5566778899aabbcc"]),
+             # fleet KV data plane (serving/fleet_kv.py): stream op tag
+             # + geometry; "" / 0 = the legacy in-process framing
+             "op": rng.choice(["", "open", "commit", "resume", "fetch"]),
+             "engine_id": rng.choice(["", "engine-0"]),
+             "prefix_pages": rng.randrange(0, 2 ** 16),
+             "total_chunks": rng.randrange(0, 2 ** 12)}
         got = protowire.decode("KvHandoffHeader",
                                protowire.encode("KvHandoffHeader", h))
         assert got == h, i
@@ -232,6 +238,73 @@ def test_kvchunk_unknown_fields_skipped():
     unknown = protowire._key(99, 2) + bytes([4, 9, 9, 9, 9])
     assert protowire.decode("KvChunk", unknown + base) == \
         protowire.decode("KvChunk", base)
+
+
+def test_kv_stream_result_roundtrip_fuzz():
+    """KvStreamResult — the data-channel per-stream terminal status
+    frame (serving/fleet_kv.py) — survives the wire field-for-field."""
+    rng = random.Random(0xDA7A)
+    for i in range(200):
+        msg = {
+            "stream_id": _rand_text(rng, 24) or f"s{i}",
+            "op": rng.choice(["open", "commit", "resume", "fetch",
+                              "abort"]),
+            "ok": rng.random() < 0.5,
+            "error": rng.choice(["", "torn stream", _rand_text(rng, 40)]),
+            "depth": rng.randrange(0, 2 ** 20),
+            "engine_id": rng.choice(["", "engine-0", "engine-17"]),
+        }
+        got = protowire.decode("KvStreamResult",
+                               protowire.encode("KvStreamResult", msg))
+        assert got == msg, i
+
+
+def test_kv_stream_result_truncation_and_unknown_fields():
+    """Data-channel framing hardening: a result frame cut mid-field is
+    rejected (never a plausible-but-wrong decode), and unknown fields
+    skip cleanly (forward compatibility for future stream ops)."""
+    base = protowire.encode("KvStreamResult", {
+        "stream_id": "req-77", "op": "fetch", "ok": True,
+        "error": "", "depth": 9, "engine_id": "engine-1",
+    })
+    with pytest.raises(ValueError):
+        protowire.decode("KvStreamResult", base[: len(base) - 3])
+    unknown = protowire._key(90, 2) + bytes([2, 7, 7])
+    assert protowire.decode("KvStreamResult", unknown + base) == \
+        protowire.decode("KvStreamResult", base)
+
+
+def test_kv_stream_result_decode_fills_defaults():
+    d = protowire.decode("KvStreamResult", b"")
+    assert d == {"stream_id": "", "op": "", "ok": False, "error": "",
+                 "depth": 0, "engine_id": ""}
+
+
+def test_fleet_heartbeat_data_port_roundtrip():
+    """The member's KV data listener port rides every heartbeat
+    (serving/fleet_kv.py); 0 (no data plane) stays off the wire and
+    decodes back as the proto3 default."""
+    on = protowire.decode("FleetHeartbeat", protowire.encode(
+        "FleetHeartbeat",
+        {"member_id": "w1", "seq": 3, "engines": [], "data_port": 40123},
+    ))
+    assert on["data_port"] == 40123
+    off = protowire.decode("FleetHeartbeat", protowire.encode(
+        "FleetHeartbeat", {"member_id": "w1", "seq": 4, "engines": []},
+    ))
+    assert off["data_port"] == 0
+
+
+def test_kv_prefix_fetch_engine_id_roundtrip():
+    """The data-plane fetch request targets a member-local engine;
+    legacy (in-process) requests leave the field off the wire."""
+    d = protowire.decode("KvPrefixFetch", protowire.encode(
+        "KvPrefixFetch",
+        {"request_id": "r1", "hashes": [1, 2 ** 63 + 1], "chunk_pages": 8,
+         "wire_quant": "int8", "engine_id": "engine-2"},
+    ))
+    assert d["engine_id"] == "engine-2"
+    assert d["hashes"] == [1, 2 ** 63 + 1]
 
 
 def test_total_processed_uint64_roundtrip():

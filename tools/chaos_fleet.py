@@ -55,6 +55,8 @@ DEFAULT_SCENARIOS = (
     "registry_partition",
     "remote_runner_crash_mid_request",
     "rerole_flap",
+    "cross_host_handoff_death",
+    "remote_fetch_source_death",
 )
 
 _PROMPT = "chaos is a ladder, resilience is a lattice"
@@ -130,7 +132,7 @@ def _tiny_params():
 def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
                 channel="inproc", auto_restart=True, warmup=False,
                 handoff_timeout_s=20.0, engine_kwargs=None,
-                fleet=False, rerole=False):
+                fleet=False, rerole=False, member_roles=("unified",)):
     """A tiny-model fleet wired exactly like production (the
     disagg_smoke.py topology, sans HTTP): real engines, real runners,
     real dispatcher/scheduler/controller. Health loop runs hot
@@ -139,12 +141,14 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
 
     ``fleet=True`` adds the multi-host control plane (docs/FLEET.md):
     the server becomes a registry host and a second InferenceServer
-    (one unified engine) joins as a fleet member over a REAL localhost
-    TCP connection through a FleetWorker — the wire is real even though
-    the processes share an interpreter (tools/fleet_smoke.py covers the
-    true 2-process path). ``rerole=True`` arms the RoleBalancer with a
-    short cooldown, its poll thread stopped so scenarios drive
-    ``evaluate()`` deterministically."""
+    joins as a fleet member over a REAL localhost TCP connection
+    through a FleetWorker — the wire is real (KV data channel
+    included, serving/fleet_kv.py) even though the processes share an
+    interpreter (tools/fleet_smoke.py covers the true 2-process path).
+    ``member_roles`` sets the member's replica roles — ``("decode",)``
+    makes it a cross-host handoff target. ``rerole=True`` arms the
+    RoleBalancer with a short cooldown, its poll thread stopped so
+    scenarios drive ``evaluate()`` deterministically."""
     import jax.numpy as jnp
 
     from distributed_inference_server_tpu.engine.engine import (
@@ -198,7 +202,9 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
     if fleet:
         worker_srv = InferenceServer(
             factory, ByteTokenizer(), model_name="tiny-chaos-member",
-            num_engines=1, auto_restart=auto_restart,
+            num_engines=len(member_roles),
+            engine_roles=list(member_roles),
+            auto_restart=auto_restart,
             health_check_interval_s=0.1,
         )
         worker_srv.start()
@@ -304,10 +310,13 @@ def check_invariants(srv, sinks, require_success=False,
             )
         if require_success and s.errors:
             violations.append(f"{s.rid}: expected success, got {s.errors}")
+    member_srv = getattr(srv, "_fleet_worker_srv", None)
     deadline = time.monotonic() + converge_timeout_s
     auto = srv.scheduler._auto_restart
     while time.monotonic() < deadline:
         runners = srv.scheduler.engines()
+        if member_srv is not None:
+            runners = runners + member_srv.scheduler.engines()
         healthy = all(r.is_healthy() for r in runners)
         fetcher = getattr(srv.dispatcher, "prefix_fetcher", None)
         drained = (
@@ -334,6 +343,11 @@ def check_invariants(srv, sinks, require_success=False,
         )
     for r in srv.scheduler.engines():
         violations.extend(r.audit())
+    if member_srv is not None:
+        # zero page leak on BOTH sides of the data plane: a torn
+        # cross-host stream must release the member's reserved pages too
+        for r in member_srv.scheduler.engines():
+            violations.extend(r.audit())
     return violations
 
 
@@ -612,6 +626,75 @@ def scenario_rerole_flap(srv, seed: int):
     return sinks, True, extra
 
 
+def scenario_cross_host_handoff_death(srv, seed: int):
+    """Fleet KV data plane (docs/FLEET.md "KV data plane"): the host's
+    prefill engine migrates every sequence to the member's decode
+    replica over the data channel — and the stream dies mid-flight: the
+    dial fails (fleet.kv_connect), the wire tears at the Nth chunk
+    (fleet.kv_chunk), or the member crashes on the import command
+    (runner.inbox). Every death is PRE-switchover, so the request must
+    complete by decoding in place on the host, exactly once, with zero
+    pages leaked on either side."""
+    rng = random.Random(seed)
+    _ensure_worker(srv)
+    sinks = []
+    spec = rng.choice([
+        "fleet.kv_connect:nth=1",
+        f"fleet.kv_chunk:nth={rng.randint(1, 3)}",
+        # inbox hit 1 is the host prefill's submit; hits 2+ land on the
+        # member runner's import open/commit commands
+        f"runner.inbox:nth={rng.randint(2, 3)}",
+    ])
+    _arm(spec, seed)
+    submit(srv, f"xh-{seed}", max_tokens=rng.randint(32, 48), sinks=sinks)
+    wedged = wait_terminal(sinks, timeout_s=90.0)
+    return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
+
+
+def scenario_remote_fetch_source_death(srv, seed: int):
+    """Fleet KV data plane: the cost model picks a REMOTE warm peer as
+    the fetch source (forced deterministic via sched.fetch_decision)
+    and the data channel dies under the fetch — dial failure or a chunk
+    torn off the response stream. The request must degrade to plain
+    recompute on its local target, terminate exactly once, and leak
+    zero pages on either side."""
+    rng = random.Random(seed)
+    from distributed_inference_server_tpu.engine.engine import SamplingParams
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.runner import ServerRequest
+
+    _ensure_worker(srv)
+    remote = next(r for r in srv.scheduler.engines()
+                  if getattr(r, "is_remote", False))
+    prompt = _PROMPT + " remote" * rng.randint(2, 3)
+    # warm the MEMBER's prefix cache through the control wire, then
+    # wait for its digest to ride a heartbeat into the routing snapshot
+    warm = []
+    for i in range(2):
+        sink = ChaosSink(f"rfw-{seed}-{i}")
+        remote.submit([ServerRequest(
+            sink.rid, ByteTokenizer().encode(prompt),
+            SamplingParams(max_tokens=8, temperature=0.0), sink,
+        )])
+        warm.append(sink)
+    wait_terminal(warm)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        s = remote.status()
+        if s.prefix_digest and getattr(s, "data_plane", False):
+            break
+        time.sleep(0.05)
+    sinks = []
+    spec = rng.choice([
+        "sched.fetch_decision:nth=1;fleet.kv_connect:nth=1",
+        f"sched.fetch_decision:nth=1;fleet.kv_chunk:nth={rng.randint(1, 2)}",
+    ])
+    _arm(spec, seed)
+    submit(srv, f"rf-{seed}", prompt=prompt, max_tokens=16, sinks=sinks)
+    wedged = wait_terminal(sinks, timeout_s=90.0)
+    return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
+
+
 #: scenario -> (fn, fleet kwargs)
 SCENARIOS = {
     "redispatch": (scenario_redispatch, {}),
@@ -646,6 +729,23 @@ SCENARIOS = {
     # balancer IS the prefill source here)
     "rerole_flap": (scenario_rerole_flap,
                     {"roles": ("unified", "decode"), "rerole": True}),
+    # fleet KV data plane (docs/FLEET.md "KV data plane"): the host's
+    # only engine is prefill-role, the member's only engine decode-role
+    # — every admission wants a cross-host migration over the data
+    # channel (list-form roles skip the static-topology check: the
+    # decode capacity lives on the member)
+    "cross_host_handoff_death": (scenario_cross_host_handoff_death,
+                                 {"roles": ("prefill",), "fleet": True,
+                                  "member_roles": ("decode",)}),
+    # remote fetch source: digests need the Python allocator tier (no
+    # digest surface on the native allocator — same constraint as
+    # warm_peer_fetch_death)
+    "remote_fetch_source_death": (scenario_remote_fetch_source_death,
+                                  {"roles": ("unified",), "fleet": True,
+                                   "strategy": "cache_aware",
+                                   "member_roles": ("unified",),
+                                   "engine_kwargs": {
+                                       "native_allocator": False}}),
 }
 
 

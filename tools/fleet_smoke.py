@@ -54,9 +54,12 @@ def _env_setup() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def _build_server(fleet_settings=None):
+def _build_server(fleet_settings=None, engine_roles=None):
     """One-engine InferenceServer on the seeded tiny model (both
-    processes build identical params: PRNGKey(0) is deterministic)."""
+    processes build identical params: PRNGKey(0) is deterministic).
+    ``engine_roles`` (a LIST, e.g. ``["prefill"]`` / ``["decode"]``)
+    shapes the cross-host-handoff leg: the host prefills, a decode-role
+    worker is the migration target over the KV data channel."""
     import jax
     import jax.numpy as jnp
 
@@ -87,7 +90,9 @@ def _build_server(fleet_settings=None):
 
     srv = InferenceServer(
         factory, ByteTokenizer(), model_name="tiny-fleet-smoke",
-        num_engines=1, auto_restart=False, fleet_settings=fleet_settings,
+        num_engines=len(engine_roles) if engine_roles else 1,
+        engine_roles=engine_roles,
+        auto_restart=False, fleet_settings=fleet_settings,
     )
     srv.start()
     return srv
@@ -127,26 +132,44 @@ def _request(rid: str):
     return req, sink
 
 
-def run_worker(connect: str) -> int:
+def run_worker(connect: str, role: str = "",
+               member_id: str = MEMBER_ID) -> int:
     """Child process: one engine + a FleetWorker joined to ``connect``;
-    serves until killed."""
+    serves until killed. ``role`` ("decode") makes this member the
+    cross-host handoff target over its KV data channel. SIGTERM runs a
+    page-conservation audit and exits with its verdict — the host's
+    "clean audits both sides" check."""
     _env_setup()
     from distributed_inference_server_tpu.serving.fleet import FleetSettings
     from distributed_inference_server_tpu.serving.remote_runner import (
         FleetWorker,
     )
 
-    srv = _build_server()
+    srv = _build_server(engine_roles=[role] if role else None)
     worker = FleetWorker(
         srv.scheduler,
         FleetSettings(connect=connect, heartbeat_interval_s=0.2),
-        member_id=MEMBER_ID,
+        member_id=member_id,
         # fleet-stitched tracing: fleet.serve/engine.infer spans ship
         # back to the registry host (docs/OBSERVABILITY.md)
         tracer=srv.tracer,
     )
     worker.start(connect_timeout_s=30.0)
-    print(f"fleet-smoke worker: joined {connect}", flush=True)
+    print(f"fleet-smoke worker: joined {connect} (role={role or 'unified'})",
+          flush=True)
+
+    def _on_term(_sig, _frame):
+        issues = []
+        for runner in srv.scheduler.engines():
+            issues.extend(runner.audit())
+        if issues:
+            print(f"fleet-smoke worker AUDIT VIOLATION: {issues}",
+                  file=sys.stderr, flush=True)
+            os._exit(3)
+        print("fleet-smoke worker: audit clean, exiting", flush=True)
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
     while True:  # serve until the parent kills us
         time.sleep(1.0)
 
@@ -298,14 +321,103 @@ def _trace_leg(srv, port: int) -> Optional[str]:
     return None
 
 
+def _handoff_leg(srv, port: int, registry_port: int,
+                 ref_text: str) -> Optional[str]:
+    """The cross-host-handoff acceptance (docs/FLEET.md "KV data
+    plane"): a SECOND worker joins with a decode-role engine, so the
+    host's prefill engine migrates the next HTTP request's live KV to
+    it over the member's data channel — token-identically, with
+    ``kv_handoff_chunks_total{scope="remote"}`` moving and clean page
+    audits on BOTH processes (the worker audits on SIGTERM). Returns a
+    violation string or None."""
+    import re
+
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--connect", f"127.0.0.1:{registry_port}", "--role", "decode",
+         "--member-id", "smoke-w2"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            decode_remote = next(
+                (r for r in srv.scheduler.engines()
+                 if getattr(r, "is_remote", False)
+                 and r.is_healthy() and r.role == "decode"
+                 and getattr(r, "supports_kv_import", False)), None)
+            if decode_remote is not None:
+                break
+            if child.poll() is not None:
+                return "decode worker died before joining"
+            time.sleep(0.1)
+        else:
+            return "decode worker never joined with a kv data channel"
+        if not srv.disagg.has_decode_targets():
+            return ("remote decode replica not counted as a handoff "
+                    "target")
+        # a short completion can finish decoding in place during the
+        # cross-process open window (which is the CORRECT degradation,
+        # not a failure) — so every attempt asserts token identity, and
+        # the leg passes once a migration actually lands on the member
+        migrated = False
+        for attempt in range(5):
+            resp = _http_json(
+                "POST", f"http://127.0.0.1:{port}/generate",
+                {"prompt": _PROMPT, "max_tokens": 96, "temperature": 0.0},
+            )
+            text = resp.get("choices", [{}])[0].get("text", "")
+            if text != ref_text:
+                rid = resp.get("id", "").split("-", 1)[-1]
+                dump_postmortem(srv, rid)
+                return (f"cross-host-migrated stream diverged (attempt "
+                        f"{attempt}): {text!r} != {ref_text!r}")
+            prom = srv.metrics.prometheus_text().decode()
+            m = re.search(
+                r'kv_handoff_chunks_total\{scope="remote"\} ([0-9.]+)',
+                prom)
+            if m is not None and float(m.group(1)) > 0:
+                migrated = True
+                break
+        if not migrated:
+            return ("kv_handoff_chunks_total{scope=remote} never moved "
+                    "across 5 token-identical attempts")
+        m = re.search(r'kv_handoff_total\{outcome="ok"\} ([0-9.]+)', prom)
+        if m is None or float(m.group(1)) < 1:
+            return "no successful handoff recorded"
+        local = next(r for r in srv.scheduler.engines()
+                     if not getattr(r, "is_remote", False))
+        issues = local.audit()
+        if issues:
+            return f"host page audit after cross-host handoff: {issues}"
+        # the worker side of "clean audits both sides": SIGTERM makes
+        # it audit its runners and exit 0 (clean) or 3 (violation)
+        child.terminate()
+        rc = child.wait(timeout=30)
+        if rc != 0:
+            return f"decode worker audit exited {rc}"
+        print("fleet-smoke: cross-host handoff token-identical, "
+              "chunks{scope=remote} moved, audits clean both sides OK",
+              flush=True)
+        return None
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=10)
+
+
 def run_host() -> int:
     _env_setup()
     from distributed_inference_server_tpu.serving.fleet import FleetSettings
     t0 = time.monotonic()
+    # the host's engine is PREFILL-role: once a decode-role member
+    # joins (the handoff leg), every admission migrates cross-host;
+    # until then prefill admits unified — the earlier legs see exactly
+    # the old behavior
     srv = _build_server(FleetSettings(
         enabled=True, heartbeat_interval_s=0.2, suspect_after_s=1.0,
         dead_after_s=2.0,
-    ))
+    ), engine_roles=["prefill"])
     port = srv.fleet_server.bound_port
     print(f"fleet-smoke host: registry on 127.0.0.1:{port}", flush=True)
 
@@ -358,6 +470,21 @@ def run_host() -> int:
         if violation is not None:
             return _fail(violation)
 
+        # -- 2.5 cross-host handoff over the KV data plane --------------
+        # HTTP reference FIRST, while no decode replica exists anywhere:
+        # the prefill engine decodes in place — the baseline the
+        # migrated run must match byte-for-byte
+        ref_resp = _http_json(
+            "POST", f"http://127.0.0.1:{http_port}/generate",
+            {"prompt": _PROMPT, "max_tokens": 96, "temperature": 0.0},
+        )
+        ref_text = ref_resp.get("choices", [{}])[0].get("text", "")
+        if not ref_text:
+            return _fail(f"HTTP reference returned no text: {ref_resp}")
+        violation = _handoff_leg(srv, http_port, port, ref_text)
+        if violation is not None:
+            return _fail(violation)
+
         # -- 3. kill the worker mid-zero-token-request ------------------
         r2_req, r2 = _request("smoke-kill")
         remote.submit([r2_req])
@@ -391,12 +518,17 @@ def run_host() -> int:
             time.sleep(0.1)
         else:
             return _fail("registry never marked the killed member dead")
+        import re
+
         prom = srv.metrics.prometheus_text().decode()
-        if 'fleet_members{state="dead"} 1.0' not in prom:
+        m = re.search(r'fleet_members\{state="dead"\} ([0-9.]+)', prom)
+        # >= 1: the SIGKILLed worker (the terminated decode worker of
+        # the handoff leg may count too, depending on prune timing)
+        if m is None or float(m.group(1)) < 1:
             return _fail("fleet_members{state=dead} gauge does not "
                          "reflect the loss")
         stats = srv._fleet_stats()
-        if stats["member_counts"]["dead"] != 1:
+        if stats["member_counts"]["dead"] < 1:
             return _fail(f"/server/stats fleet block wrong: {stats}")
 
         # -- page audit --------------------------------------------------
@@ -419,9 +551,15 @@ def main() -> int:
                     help="run as the joining worker process")
     ap.add_argument("--connect", default="",
                     help="registry host:port (worker mode)")
+    ap.add_argument("--role", default="",
+                    help="worker engine role ('' = unified; 'decode' "
+                    "makes it a cross-host handoff target)")
+    ap.add_argument("--member-id", default=MEMBER_ID,
+                    help="worker member identity")
     args = ap.parse_args()
     if args.worker:
-        return run_worker(args.connect)
+        return run_worker(args.connect, role=args.role,
+                          member_id=args.member_id)
     return run_host()
 
 
