@@ -775,9 +775,180 @@ def bench_mixed() -> None:
             sys.exit(3)
 
 
+def bench_telem() -> None:
+    """Telemetry-overhead microbench (BENCH_TELEM=1; ISSUE 14): decode
+    tokens/s through a REAL EngineRunner with the performance-telemetry
+    plane ON — MetricsCollector (step-clock delta reports + windowed
+    digests) plus FlightRecorder with an armed SLO — vs OFF (metrics
+    and recorder both None, the identity-check fast path). CPU anchor
+    like the other microbenches (single-threaded XLA, tiny-4l, greedy);
+    at TINY scale the host-side per-step cost is a LARGER share of the
+    step than on real silicon, so the measured overhead upper-bounds
+    production. Acceptance: <= 2% decode tokens/s cost.
+
+    Knobs: BENCH_TELEM_REPS (5), BENCH_TELEM_ROWS (4 concurrent
+    requests), BENCH_TELEM_TOKENS (192 decode tokens per request)."""
+    import gc
+    import threading
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_multi_thread_eigen=false"
+        + " intra_op_parallelism_threads=1"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.flightrec import (
+        FlightRecorder,
+    )
+    from distributed_inference_server_tpu.serving.metrics import (
+        MetricsCollector,
+    )
+    from distributed_inference_server_tpu.serving.runner import (
+        EngineRunner,
+        ServerRequest,
+    )
+    from distributed_inference_server_tpu.serving.teledigest import (
+        SloSettings,
+    )
+
+    reps = int(os.environ.get("BENCH_TELEM_REPS", "5"))
+    rows = int(os.environ.get("BENCH_TELEM_ROWS", "4"))
+    tokens = int(os.environ.get("BENCH_TELEM_TOKENS", "192"))
+    mcfg = TINY.with_overrides(
+        name="tiny-4l", hidden_size=128, intermediate_size=512,
+        num_layers=4, num_heads=8, num_kv_heads=4, head_dim=16,
+    )
+    ps = 8
+    max_pages = -(-(16 + tokens + ps) // ps)
+    paged = PagedCacheConfig(num_pages=(rows + 2) * max_pages,
+                             page_size=ps, max_pages_per_seq=max_pages)
+    params = llama.init_params(jax.random.PRNGKey(0), mcfg,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(14)
+    hi = min(mcfg.vocab_size, 250)
+    prompts = [[int(t) for t in rng.integers(1, hi, size=16)]
+               for _ in range(rows)]
+
+    def factory():
+        return LLMEngine(
+            params, mcfg, ByteTokenizer(),
+            EngineConfig(max_batch=rows, prefill_buckets=(16, 32),
+                         paged=paged, decode_block_size=8,
+                         warmup_compile=False),
+            dtype=jnp.float32,
+        )
+
+    class _Sink:
+        def __init__(self):
+            self.tokens = 0
+            self.ev = threading.Event()
+
+        def on_token(self, token_id, text, token_index, logprob=None):
+            if token_id is not None:
+                self.tokens += 1
+
+        def on_done(self, finish_reason, usage):
+            self.ev.set()
+
+        def on_error(self, message, code):
+            self.ev.set()
+
+    def run_batch(runner, tag: str) -> float:
+        sinks = []
+        reqs = []
+        for i, prompt in enumerate(prompts):
+            sink = _Sink()
+            sinks.append(sink)
+            reqs.append(ServerRequest(
+                f"{tag}-{i}", list(prompt),
+                SamplingParams(max_tokens=tokens, temperature=0.0),
+                sink))
+        t0 = time.perf_counter()
+        runner.submit(reqs)
+        for sink in sinks:
+            assert sink.ev.wait(300.0), "bench request wedged"
+        wall = time.perf_counter() - t0
+        emitted = sum(s.tokens for s in sinks)
+        assert emitted >= rows * (tokens - 1), emitted
+        return emitted / wall
+
+    results = {"off": [], "on": []}
+    runners = {}
+    metrics_on = MetricsCollector()
+    recorder_on = FlightRecorder(
+        metrics=metrics_on,
+        slo=SloSettings(ttft_ms=60_000.0, tbt_p99_ms=60_000.0))
+    runners["off"] = EngineRunner("bench-off", factory, None)
+    runners["on"] = EngineRunner("bench-on", factory, metrics_on,
+                                 recorder=recorder_on)
+    try:
+        for mode, runner in runners.items():
+            runner.start(wait_ready=True)
+            run_batch(runner, f"warm-{mode}")  # compile + warm path
+        gc.disable()
+        try:
+            for rep in range(reps):
+                # alternate order so drift penalizes neither mode
+                order = (["off", "on"] if rep % 2 == 0
+                         else ["on", "off"])
+                for mode in order:
+                    results[mode].append(
+                        run_batch(runners[mode], f"r{rep}-{mode}"))
+        finally:
+            gc.enable()
+    finally:
+        for runner in runners.values():
+            runner.shutdown()
+
+    med_off = sorted(results["off"])[reps // 2]
+    med_on = sorted(results["on"])[reps // 2]
+    overhead = (med_off - med_on) / med_off * 100.0
+    for mode in ("off", "on"):
+        print(json.dumps({
+            "bench": "telem_overhead", "mode": mode,
+            "decode_tokens_per_sec_median": round(
+                sorted(results[mode])[reps // 2], 1),
+            "runs": [round(x, 1) for x in results[mode]],
+            "rows": rows, "tokens": tokens, "reps": reps,
+        }))
+    print(json.dumps({
+        "bench": "telem_overhead", "mode": "summary",
+        "overhead_pct": round(overhead, 2),
+        "budget_pct": 2.0,
+        "within_budget": overhead <= 2.0,
+    }))
+    # sanity: the ON plane actually recorded — a vacuously fast
+    # telemetry path that records nothing would be a broken bench
+    perf = metrics_on.perf.wire_digests()
+    assert "step_ms.decode_block" in perf, sorted(perf)
+    assert "ttft_ms" in perf
+    counts, _ = metrics_on.slo_counts()
+    assert sum(counts.get("default", {}).values()) >= rows * reps
+
+
 def main() -> None:
     if os.environ.get("BENCH_HANDOFF") == "1":
         bench_handoff()
+        return
+    if os.environ.get("BENCH_TELEM") == "1":
+        bench_telem()
         return
     if os.environ.get("BENCH_MIXED") == "1":
         bench_mixed()

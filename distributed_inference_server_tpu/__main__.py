@@ -267,6 +267,9 @@ def main(argv=None) -> int:
             # fleet.enabled makes this the registry host; fleet.rerole
             # arms the role balancer
             fleet_settings=cfg.fleet_settings(),
+            # SLO / performance telemetry (docs/OBSERVABILITY.md
+            # "Performance telemetry"): verdicts + /server/perf windows
+            slo_settings=cfg.slo_settings(),
         )
         server.start()
     except (ModelLoadError, RuntimeError, TimeoutError) as e:

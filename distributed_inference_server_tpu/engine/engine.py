@@ -662,6 +662,24 @@ class LLMEngine:
         self._mixed_prefill_tokens = 0
         self._mixed_decode_tokens = 0
         self._mixed_density_sum = 0.0
+        # engine step clock (docs/OBSERVABILITY.md "Performance
+        # telemetry"): host-side wall time, dispatch counts, tokens and
+        # batch rows per dispatch kind, plus step-loop pressure events.
+        # HOST timestamps only — time.monotonic around the host sections
+        # of each dispatch path, never a device sync (DL007-safe); the
+        # runner delta-reports these cumulative counters like the mixed
+        # block, and drains _sc_samples into the windowed digests.
+        self._sc_kinds: Dict[str, Dict[str, float]] = {
+            k: {"dispatches": 0, "wall_s": 0.0, "tokens": 0, "rows": 0}
+            for k in ("prefill", "decode_block", "mixed")
+        }
+        self._sc_events: Dict[str, int] = {
+            "cache_full": 0, "preempt": 0, "reclaim": 0, "retrace": 0,
+        }
+        self._sc_samples: List[Tuple[str, float]] = []
+        # warmup() compiles every serving program up front — those are
+        # boot cost, not the mid-serving "retrace" pressure event
+        self._in_warmup = False
         self._fwd = self._make_fwd()
         self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
         self._cp_fns: Dict[int, Callable] = {}
@@ -1554,21 +1572,28 @@ class LLMEngine:
         if thr is not None:
             lengths.append(min(self._cp_bucket(thr),
                                self.pcfg.max_seq_len - steps - 2))
-        for i, n in enumerate(lengths):
-            if n < 1:
-                continue
-            # distinct leading token per warmup: prefix reuse against an
-            # earlier warmup would shrink the chunk into a smaller
-            # bucket's program and leave this one cold
-            tok_id = 1 + i % max(1, self.cfg.vocab_size - 1)
-            self.add_request(
-                f"__warmup_{i}", [tok_id] * n,
-                SamplingParams(max_tokens=steps, temperature=0.0),
-            )
-            # drain one at a time: co-seated warmups would share the
-            # largest bucket's program and leave the others cold
-            while self.has_work():
-                self.step()  # outputs discarded
+        # boot-time compiles are not the "retrace" pressure signal (a
+        # new geometry compiled MID-SERVING); gate the event so every
+        # clean warmup boot doesn't read as N retraces
+        self._in_warmup = True
+        try:
+            for i, n in enumerate(lengths):
+                if n < 1:
+                    continue
+                # distinct leading token per warmup: prefix reuse
+                # against an earlier warmup would shrink the chunk into
+                # a smaller bucket's program and leave this one cold
+                tok_id = 1 + i % max(1, self.cfg.vocab_size - 1)
+                self.add_request(
+                    f"__warmup_{i}", [tok_id] * n,
+                    SamplingParams(max_tokens=steps, temperature=0.0),
+                )
+                # drain one at a time: co-seated warmups would share
+                # the largest bucket's program and leave the others cold
+                while self.has_work():
+                    self.step()  # outputs discarded
+        finally:
+            self._in_warmup = False
 
     # ------------------------------------------------------------------
     # admission / prefill
@@ -1612,6 +1637,7 @@ class LLMEngine:
             try:
                 self._start_prefill(seq)
             except CacheFull:
+                self._event("cache_full")
                 return  # no pages; retry next step
             except Exception as e:  # failure isolation (Property 22)
                 self.waiting.popleft()
@@ -1666,6 +1692,8 @@ class LLMEngine:
         staged into the decode carry."""
         budget = self.ecfg.prefill_token_budget
         Bp = self.ecfg.prefill_batch
+        sc_t0 = time.monotonic()  # step clock: host wall only
+        sc_tokens = sc_rows = sc_disp = 0
         thr = self._cp_threshold()
         if thr is not None:
             # at most ONE ring prefill per step, and it consumes the whole
@@ -1677,8 +1705,12 @@ class LLMEngine:
                     s is not None and s.next_token is None
                     and len(s.token_ids) >= thr
                 ):
+                    remaining = len(s.token_ids) - s.seq_len
                     try:
                         self._cp_prefill_seq(slot, s, outputs)
+                        sc_tokens += remaining
+                        sc_rows += 1
+                        sc_disp += 1
                     except Exception as e:  # failure isolation (Property 22)
                         self.slots[slot] = None
                         self._by_id.pop(s.request_id, None)
@@ -1765,6 +1797,9 @@ class LLMEngine:
                     self.params, *args
                 )
             budget -= Bp * bucket
+            sc_tokens += sum(chunk_lens)
+            sc_rows += len(group)
+            sc_disp += 1
             done: List[bool] = []
             for j, (_, s) in enumerate(group):
                 s.seq_len += chunk_lens[j]  # host view advances now so the
@@ -1808,6 +1843,9 @@ class LLMEngine:
                         self._stage_seat(slot, s)
                 # else: finished during its very first token (EOS or
                 # max_tokens=1) — _finish already cleared the slot
+        if sc_disp:
+            self._clock("prefill", time.monotonic() - sc_t0,
+                        tokens=sc_tokens, rows=sc_rows, dispatches=sc_disp)
 
     def _pick_bucket(self, remaining: int) -> int:
         for b in self.ecfg.prefill_buckets:
@@ -1843,6 +1881,46 @@ class LLMEngine:
                 self._mixed_density_sum / steps, 4) if steps else 0.0,
             "prefill_frac": self._mixed_prefill_frac,
         }
+
+    # ------------------------------------------------------------------
+    # engine step clock (docs/OBSERVABILITY.md "Performance telemetry")
+    # ------------------------------------------------------------------
+
+    def _clock(self, kind: str, wall_s: float, tokens: int = 0,
+               rows: int = 0, dispatches: int = 0) -> None:
+        """Attribute one host-side wall-time segment to a dispatch kind.
+        Engine-thread only; pure dict bumps (no device work, DL007-safe
+        in every hot set)."""
+        c = self._sc_kinds[kind]
+        c["dispatches"] += dispatches
+        c["wall_s"] += wall_s
+        c["tokens"] += tokens
+        c["rows"] += rows
+        self._sc_samples.append((kind, wall_s))
+        if len(self._sc_samples) > 4096:
+            # the runner drains every loop; a headless engine (tests,
+            # bench) must still stay bounded
+            del self._sc_samples[:-2048]
+
+    def _event(self, name: str, n: int = 1) -> None:
+        if name == "retrace" and self._in_warmup:
+            return  # boot-time compile, not a mid-serving retrace
+        self._sc_events[name] = self._sc_events.get(name, 0) + n
+
+    def step_clock_stats(self) -> Dict[str, Dict[str, float]]:
+        """Cumulative step-clock counters (engine-thread writes; the
+        runner's status path reads copies — delta-reporting like
+        mixed_stats)."""
+        return {
+            "kinds": {k: dict(v) for k, v in self._sc_kinds.items()},
+            "events": dict(self._sc_events),
+        }
+
+    def drain_step_samples(self) -> List[Tuple[str, float]]:
+        """Per-segment (kind, wall_s) samples since the last drain —
+        the runner feeds them into the step_ms.<kind> windowed digests."""
+        out, self._sc_samples = self._sc_samples, []
+        return out
 
     def _resolved_mixed_impl(self) -> str:
         """Attention impl for the mixed step's ragged attend: the ragged
@@ -1921,6 +1999,7 @@ class LLMEngine:
 
     def _get_mixed_fn(self) -> Callable:
         if self._mixed_fn is None:
+            self._event("retrace")
             self._mixed_fn = self._build_mixed_step()
         return self._mixed_fn
 
@@ -2024,6 +2103,8 @@ class LLMEngine:
         chunks into the budget's remainder — no bucket padding, chunk
         lengths exactly what fits (PackInfer). Page pressure drains the
         pipeline then preempts, exactly like _maybe_launch."""
+        sc_t0 = time.monotonic()  # step clock: host wall only
+        sc_excl = 0.0  # drained-frame seconds (clocked by their frames)
         S = self.ecfg.mixed_step_tokens
         B = self.ecfg.max_batch
         Sp = S - B
@@ -2055,8 +2136,13 @@ class LLMEngine:
                     self._ensure_block_pages(s, advs[id(s)])
                 break
             except CacheFull:
+                self._event("cache_full")
                 if self._pending:
+                    # drained frames clock their own processing —
+                    # exclude it from this dispatch's window
+                    drain_t0 = time.monotonic()
                     self._drain_pending(outputs)
+                    sc_excl += time.monotonic() - drain_t0
                     continue
                 if decode_seated:
                     self._preempt_youngest(outputs)
@@ -2134,7 +2220,7 @@ class LLMEngine:
         snapshot = [(i, s) for i, s in decode_seated]
         self._pending.append(
             (outs, lps, None, None, None,
-             [(i, s, advs[id(s)]) for i, s in snapshot])
+             [(i, s, advs[id(s)]) for i, s in snapshot], "mixed")
         )
         for _, s in decode_seated:
             adv = advs[id(s)]
@@ -2150,6 +2236,15 @@ class LLMEngine:
         for j, (_, s) in enumerate(group):
             s.seq_len += chunk_lens[j]
         self._reap_mixed_prefill(group, chunk_lens, p_toks, p_lps, outputs)
+        # step clock: packed tokens/rows counted at dispatch (the [1, B]
+        # pending frame's reconcile adds its wall time under this kind
+        # too, but never re-counts the tokens)
+        self._clock("mixed",
+                    max(0.0, time.monotonic() - sc_t0 - sc_excl),
+                    tokens=prefill_tokens + decode_tokens,
+                    rows=len(decode_seated)
+                    + sum(1 for t in chunk_lens if t),
+                    dispatches=1)
         return True
 
     def _reap_mixed_prefill(self, group, chunk_lens, p_toks, p_lps,
@@ -2247,6 +2342,7 @@ class LLMEngine:
         speculative rounds can attend the full prompt."""
         fn = self._cp_fns.get(T)
         if fn is None:
+            self._event("retrace")
             from distributed_inference_server_tpu.parallel.cp import (
                 cp_paged_prefill_any,
             )
@@ -2590,6 +2686,7 @@ class LLMEngine:
         key = (batch, bucket)
         fn = self._prefill_fns.get(key)
         if fn is None:
+            self._event("retrace")
             cfg = self.cfg
             moe_impl = self._moe_impl()
             impl = self._resolved_impl()
@@ -3062,6 +3159,8 @@ class LLMEngine:
         host override is staged. Handles page pressure by draining the
         pipeline (finished rows release pages) and then preempting the
         youngest sequence, exactly once per launch attempt."""
+        sc_t0 = time.monotonic()  # step clock: host wall only
+        sc_excl = 0.0  # drained-frame seconds (clocked by their frames)
         use_spec = False
         while True:
             seated = [(i, s) for i, s in enumerate(self.slots)
@@ -3085,8 +3184,14 @@ class LLMEngine:
                     self._ensure_block_pages(s, advs[id(s)])
                 break
             except CacheFull:
+                self._event("cache_full")
                 if self._pending:
+                    # the drained frames clock their own processing
+                    # under their kinds — exclude it here or those
+                    # seconds count twice across kinds
+                    drain_t0 = time.monotonic()
                     self._drain_pending(outputs)
+                    sc_excl += time.monotonic() - drain_t0
                     continue  # finished rows may have released pages
                 if seated:
                     self._preempt_youngest(outputs)
@@ -3101,6 +3206,9 @@ class LLMEngine:
             # no floor: negatives reconcile exactly when blocks complete
             s.dev_pos += adv
             s.dev_steps_left -= adv
+        self._clock("decode_block",
+                    max(0.0, time.monotonic() - sc_t0 - sc_excl),
+                    rows=len(seated), dispatches=1)
         return True
 
     def _drain_slot_updates(self) -> Tuple[jnp.ndarray, ...]:
@@ -3177,7 +3285,8 @@ class LLMEngine:
                 *uploads, jnp.asarray(ok_arr), rng, *injects,
                 jnp.asarray(any_temp),
             )
-            self._pending.append((toks, lps, counts, acc, prop, snapshot))
+            self._pending.append((toks, lps, counts, acc, prop, snapshot,
+                                  "decode_block"))
         else:
             (outs, lps, tokens, positions, steps_left, active,
              self.state.k, self.state.v, rng) = self._block_fn(
@@ -3186,7 +3295,8 @@ class LLMEngine:
                 *uploads, rng, *injects,
                 jnp.asarray(sample_mode, jnp.int32),
             )
-            self._pending.append((outs, lps, None, None, None, snapshot))
+            self._pending.append((outs, lps, None, None, None, snapshot,
+                                  "decode_block"))
         self._carry = (tokens, positions, steps_left, active, rng)
 
     def _drain_pending(self, outputs: List[StepOutput]) -> None:
@@ -3206,8 +3316,9 @@ class LLMEngine:
         counts and acceptance stats. Live sequences reconcile the launch's
         assumed advance against what was actually emitted (speculative
         rounds emit a variable number of tokens)."""
+        sc_t0 = time.monotonic()  # step clock: host wall incl. the read
         (toks_d, lps_d, counts_d, acc_d, prop_d,
-         snapshot) = self._pending.popleft()
+         snapshot, sc_kind) = self._pending.popleft()
         # the block's two blocking device reads (token ids + their
         # logprobs; the logprob tensor is [K, B] f32 — trivial next to
         # the step compute, and computed on-device by one fused
@@ -3245,6 +3356,7 @@ class LLMEngine:
                         sig, acc_n, prop_n, rows=rows_n
                     )
         R = toks3.shape[0]
+        sc_emitted = 0
         for slot, seq, assumed in snapshot:
             if self._by_id.get(seq.request_id) is not seq:
                 continue  # finished or aborted while the block was in flight
@@ -3282,10 +3394,16 @@ class LLMEngine:
                 outputs.append(StepOutput(
                     request_id=seq.request_id, finished=True, error=str(e)))
                 continue
+            sc_emitted += emitted_here
             if self._by_id.get(seq.request_id) is seq:
                 delta = assumed - emitted_here
                 seq.dev_pos -= delta
                 seq.dev_steps_left += delta
+        # reconcile wall time lands under the LAUNCHING kind; tokens
+        # for mixed frames and rows for BOTH kinds were counted at
+        # dispatch — re-counting here would double rows-per-dispatch
+        self._clock(sc_kind, time.monotonic() - sc_t0,
+                    tokens=sc_emitted if sc_kind == "decode_block" else 0)
 
     # ------------------------------------------------------------------
     # token emission & completion
@@ -3455,6 +3573,7 @@ class LLMEngine:
             j += 1
         seq.freed_upto = j
         if freed:
+            self._event("reclaim", len(freed))
             self.allocator.release(freed)
 
     # ------------------------------------------------------------------
@@ -3476,6 +3595,7 @@ class LLMEngine:
     def _preempt(self, seq: _Seq, outputs: List[StepOutput]) -> None:
         # only called with the pipeline drained (_maybe_launch), so the host
         # state below is exact, not a lagging projection
+        self._event("preempt")
         for i, s in enumerate(self.slots):
             if s is seq:
                 self.slots[i] = None

@@ -45,6 +45,7 @@ def build_app(
     swap_fn=None,
     scale_fn=None,
     fleet_fn=None,
+    perf_fn=None,
 ) -> web.Application:
     """``swap_fn(model_name) -> (ok, error)`` enables the admin model-swap
     endpoint (Req 13.1: admin-API-triggered); ``scale_fn(n) -> (ok,
@@ -52,7 +53,9 @@ def build_app(
     up/down, requirements.md:110). Both are blocking — they run in the
     default executor. ``fleet_fn() -> dict`` adds the fleet control-plane
     block (members, role map, rebalance history; serving/fleet.py) to
-    ``/server/stats``."""
+    ``/server/stats``. ``perf_fn() -> dict`` serves ``GET /server/perf``
+    (per-engine step clock, windowed percentiles, SLO burn, and the
+    fleet-merged digest view; docs/OBSERVABILITY.md)."""
     app = web.Application()
     app["handler"] = handler
     app["metrics"] = metrics
@@ -654,8 +657,36 @@ def build_app(
                            "code": "invalid_parameter"}},
                 status=400,
             )
-        return web.json_response({"requests": recorder.recent(n),
-                                  "stats": recorder.stats()})
+        # SLO triage (docs/OBSERVABILITY.md "Performance telemetry"):
+        # ?verdict=violated lists exactly the timelines burning the SLO
+        verdict = request.query.get("verdict")
+        if verdict is not None and verdict not in ("ok", "violated"):
+            return web.json_response(
+                {"error": {"message": "query parameter 'verdict' must "
+                           "be 'ok' or 'violated'",
+                           "error_type": "invalid_request_error",
+                           "code": "invalid_parameter"}},
+                status=400,
+            )
+        return web.json_response(
+            {"requests": recorder.recent(n, verdict=verdict),
+             "stats": recorder.stats()})
+
+    async def perf(request: web.Request) -> web.Response:
+        """GET /server/perf — the performance-telemetry surface
+        (docs/OBSERVABILITY.md): per-engine step-clock counters,
+        windowed TTFT/TBT/queue-wait percentiles, SLO burn, the raw
+        mergeable digests, and (registry host) the per-member +
+        fleet-merged view."""
+        if perf_fn is None:
+            return web.json_response(
+                {"error": {"message": "performance telemetry not "
+                           "configured",
+                           "error_type": "invalid_request_error",
+                           "code": "perf_unavailable"}},
+                status=404,
+            )
+        return web.json_response(perf_fn())
 
     async def profile(request: web.Request) -> web.Response:
         """Device-trace capture (SURVEY §5 device-tracing bar;
@@ -775,6 +806,7 @@ def build_app(
     app.router.add_post("/admin/scale", scale)
     app.router.add_post("/server/profile", profile)
     app.router.add_get("/server/trace", trace)
+    app.router.add_get("/server/perf", perf)
     app.router.add_get("/server/requests", request_list)
     app.router.add_get("/server/requests/{id}", request_timeline)
     app.router.add_post("/admin/model-swap", model_swap)

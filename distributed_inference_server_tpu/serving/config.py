@@ -265,6 +265,27 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "kv_max_streams": (int, 4),
         "kv_connect_timeout_s": (float, 5.0),
     },
+    "slo": {
+        # SLO / goodput accounting (serving/teledigest.py SloSettings;
+        # docs/OBSERVABILITY.md "Performance telemetry"): request-level
+        # latency objectives. 0 = that objective unset (requests with
+        # no applicable objective get no verdict and never count
+        # toward the burn rate). flightrec.finish() derives the verdict
+        # from the exact phase partition; violations feed
+        # slo_requests_total{tenant,verdict} and the windowed burn rate
+        # at GET /server/perf.
+        "ttft_ms": (float, 0.0),
+        "tbt_p99_ms": (float, 0.0),
+        # per-tenant overrides, "tenantA=500,tenantB=250" (ms); an
+        # override wins over the global objective for that tenant
+        "tenant_ttft_ms": (str, ""),
+        "tenant_tbt_ms": (str, ""),
+        # windowed-digest geometry shared by /server/perf percentiles,
+        # the /server/stats sliding p99, and SLO burn rates: epochs of
+        # epoch_s seconds, percentiles over the trailing window_s
+        "window_s": (float, 60.0),
+        "epoch_s": (float, 5.0),
+    },
     "batcher": {
         "window_ms": (float, 50.0),
         "max_batch_size": (int, 32),
@@ -286,11 +307,20 @@ HOT_RELOADABLE = {
 }
 
 
-def parse_tenant_weights(spec: str) -> Dict[str, float]:
-    """Parse ``queue.tenant_weights`` ("tenantA=2,tenantB=1") into the
-    weight map core/queue.py's DRR dequeue uses. Raises ConfigError on
-    malformed entries or non-positive weights."""
+def parse_tenant_weights(spec: str,
+                         key: str = "queue.tenant_weights",
+                         allow_zero: bool = False) -> Dict[str, float]:
+    """Parse a ``"tenantA=2,tenantB=1"`` map — ``queue.tenant_weights``
+    (core/queue.py DRR weights) and the per-tenant SLO overrides
+    (``slo.tenant_ttft_ms``/``slo.tenant_tbt_ms``, milliseconds) share
+    the grammar. Raises ConfigError (attributed to ``key``) on
+    malformed entries or out-of-range values. ``allow_zero`` (the SLO
+    maps): 0 is a legal override meaning "objective unset for this
+    tenant" — the only way to exempt one tenant from a global
+    objective; a DRR weight of 0 stays illegal (it would starve the
+    tenant entirely)."""
     out: Dict[str, float] = {}
+    floor = -1.0 if allow_zero else 0.0
     for part in (spec or "").split(","):
         part = part.strip()
         if not part:
@@ -299,19 +329,19 @@ def parse_tenant_weights(spec: str) -> Dict[str, float]:
         name = name.strip()
         if not sep or not name:
             raise ConfigError(
-                f"queue.tenant_weights: {part!r} is not tenant=weight"
+                f"{key}: {part!r} is not tenant=value"
             )
         try:
             weight = float(value)
         except ValueError:
             raise ConfigError(
-                f"queue.tenant_weights: weight {value!r} for {name!r} "
-                "is not a number"
+                f"{key}: value {value!r} for {name!r} is not a number"
             ) from None
-        if weight <= 0:
+        if weight <= floor:
             raise ConfigError(
-                f"queue.tenant_weights: weight for {name!r} must be "
-                "positive"
+                f"{key}: value for {name!r} must be "
+                + (">= 0 (0 = objective unset)" if allow_zero
+                   else "positive")
             )
         out[name] = weight
     return out
@@ -524,6 +554,28 @@ class ServerConfig:
             kv_connect_timeout_s=f["kv_connect_timeout_s"],
         )
 
+    def slo_settings(self):
+        """SLO / performance-telemetry knobs (teledigest.SloSettings);
+        always constructed — the window/epoch geometry shapes the
+        /server/perf digests even with no objective set."""
+        from distributed_inference_server_tpu.serving.teledigest import (
+            SloSettings,
+        )
+
+        s = self.raw["slo"]
+        return SloSettings(
+            ttft_ms=s["ttft_ms"],
+            tbt_p99_ms=s["tbt_p99_ms"],
+            tenant_ttft_ms=parse_tenant_weights(
+                s["tenant_ttft_ms"], key="slo.tenant_ttft_ms",
+                allow_zero=True),
+            tenant_tbt_ms=parse_tenant_weights(
+                s["tenant_tbt_ms"], key="slo.tenant_tbt_ms",
+                allow_zero=True),
+            window_s=s["window_s"],
+            epoch_s=s["epoch_s"],
+        )
+
     def fetch_costs(self):
         """cache_aware three-way cost-model weights (fleet prefix
         sharing, serving/scheduler.py plan_route)."""
@@ -671,6 +723,23 @@ class ServerConfig:
             raise ConfigError("cache.fetch_load_cost must be >= 0")
         # per-tenant fairness: weights parse + positivity
         parse_tenant_weights(r["queue"]["tenant_weights"])
+        # SLO / performance telemetry (serving/teledigest.py)
+        s = r["slo"]
+        if s["ttft_ms"] < 0:
+            raise ConfigError("slo.ttft_ms must be >= 0 (0 = unset)")
+        if s["tbt_p99_ms"] < 0:
+            raise ConfigError("slo.tbt_p99_ms must be >= 0 (0 = unset)")
+        parse_tenant_weights(s["tenant_ttft_ms"],
+                             key="slo.tenant_ttft_ms", allow_zero=True)
+        parse_tenant_weights(s["tenant_tbt_ms"],
+                             key="slo.tenant_tbt_ms", allow_zero=True)
+        if s["epoch_s"] <= 0:
+            raise ConfigError("slo.epoch_s must be positive")
+        if s["window_s"] < s["epoch_s"]:
+            raise ConfigError(
+                "slo.window_s must be >= slo.epoch_s (the window is a "
+                "whole number of epochs)"
+            )
         # fleet control plane (serving/fleet.py)
         f = r["fleet"]
         if f["heartbeat_interval_s"] <= 0:

@@ -116,6 +116,9 @@ FRAME_KINDS: Dict[int, str] = {
     # fleet-stitched tracing (docs/OBSERVABILITY.md): finished member
     # spans, batched at heartbeat cadence, worker -> registry host
     4: "FleetSpans",
+    # fleet-federated performance telemetry (serving/teledigest.py):
+    # member digests + step-clock counters, heartbeat-piggybacked
+    5: "FleetTelemetry",
 }
 _KIND_BY_NAME = {name: kind for kind, name in FRAME_KINDS.items()}
 
@@ -536,6 +539,11 @@ class _MemberSession:
                     # postmortem needs)
                     self.server.ingest_spans(
                         obj, self.member_id or obj.get("member_id", ""))
+                elif name == "FleetTelemetry":
+                    # member perf digests + step-clock counters: stored
+                    # per member, merged on demand at GET /server/perf
+                    self.server.ingest_telemetry(
+                        obj, self.member_id or obj.get("member_id", ""))
                 # FleetSubmit frames only flow host -> worker; one
                 # arriving here is a confused peer — ignore it
         except (OSError, FleetWireError) as e:
@@ -652,6 +660,10 @@ class FleetServer:
         # entry, so the superseded session's late EOF can neither kill
         # the member nor detach the new session's runners
         self._by_member: Dict[str, _MemberSession] = {}
+        # member_id -> last ingested FleetTelemetry frame (digests +
+        # counters, serving/teledigest.py), merged at GET /server/perf;
+        # guarded by _lock, pruned by age at snapshot time
+        self._telemetry: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
@@ -758,6 +770,114 @@ class FleetServer:
                 logger.debug("undecodable remote span from %s", member,
                              exc_info=True)
                 self.tracer.record_drop("wire")
+
+    # -- telemetry ingest (session reader threads) --------------------------
+
+    def ingest_telemetry(self, obj: Dict[str, Any], member_id: str) -> None:
+        """Store one FleetTelemetry frame (replacing the member's
+        previous one — digests are cumulative windows, not deltas, so
+        last-frame-wins is exact) and publish the fleet_*{member}
+        series: cumulative step-clock tokens per dispatch kind and the
+        member's windowed TTFT p99 (docs/OBSERVABILITY.md)."""
+        from distributed_inference_server_tpu.serving import teledigest
+
+        member = member_id or obj.get("member_id", "")
+        if not member:
+            return
+        digests = {d.get("name", ""): d for d in obj.get("digests", [])
+                   if d.get("name")}
+        foreign: List[str] = []
+        if self.metrics is not None:
+            # epoch geometry is part of the merge key space: a member
+            # configured with a different slo.epoch_s ships epoch
+            # indices in a different time unit — merging them would
+            # silently corrupt the fleet windows, so drop them LOUDLY
+            local_epoch_s = self.metrics.perf_epoch_s()
+            foreign = [n for n, d in digests.items()
+                       if float(d.get("epoch_s", 0.0)) != local_epoch_s]
+            if foreign:
+                logger.warning(
+                    "fleet telemetry from %s dropped %d digest(s) with "
+                    "foreign epoch_s (member slo.epoch_s disagrees with "
+                    "this host's %.3gs): %s", member, len(foreign),
+                    local_epoch_s, sorted(foreign),
+                )
+                for name in foreign:
+                    del digests[name]
+        counters = {c.get("name", ""): c.get("value", 0.0)
+                    for c in obj.get("counters", []) if c.get("name")}
+        with self._lock:
+            self._telemetry[member] = {
+                "digests": digests,
+                "counters": counters,
+                "at": time.monotonic(),
+            }
+            pruned = self._prune_telemetry_locked(time.monotonic())
+        self._drop_member_series(pruned)
+        if self.metrics is not None:
+            # exactly ONE outcome per frame: a frame that lost digests
+            # to the epoch guard must not also read as cleanly ingested
+            # (sum-over-outcomes == frames, and the mismatch stays loud)
+            self.metrics.record_telemetry_frame(
+                "epoch_mismatch" if foreign else "ingested")
+            step_tokens: Dict[str, float] = {}
+            for name, value in counters.items():
+                parts = name.split(".")
+                if (parts[0] == "step" and len(parts) == 4
+                        and parts[3] == "tokens"):
+                    step_tokens[parts[2]] = (
+                        step_tokens.get(parts[2], 0.0) + value
+                    )
+            ttft_p99 = None
+            ttft = digests.get("ttft_ms")
+            if ttft is not None:
+                stats = teledigest.window_stats(
+                    ttft, self.metrics.perf_window_s())
+                ttft_p99 = stats.get("p99")
+            self.metrics.set_member_telemetry(member, step_tokens,
+                                              ttft_p99)
+
+    def _prune_telemetry_locked(self, now: float) -> List[str]:
+        """Drop members silent past the dead-retention window (a
+        restarted worker mints a fresh id, same rationale as the
+        registry's member table). Runs on every ingest — an unpolled
+        registry host must not grow one digest frame per dead worker
+        forever. Returns the pruned member ids (caller drops their
+        gauge series outside the lock)."""
+        horizon = self.settings.dead_after_s + self.settings.dead_retention_s
+        stale = [m for m, v in self._telemetry.items()
+                 if now - v["at"] > horizon]
+        for member in stale:
+            del self._telemetry[member]
+        return stale
+
+    def _drop_member_series(self, members: List[str]) -> None:
+        """Remove pruned members' fleet_member_* gauge series: a dead
+        member's last TTFT p99 must stop reading as live, and per-
+        restart member ids must not grow /metrics without bound (same
+        policy as the tenant-depth gauge)."""
+        if self.metrics is None:
+            return
+        for member in members:
+            self.metrics.remove_member_telemetry(member)
+
+    def telemetry_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-member telemetry for GET /server/perf: last frame per
+        member with its age (stale members pruned here too, so a quiet
+        control plane still converges on read)."""
+        now = time.monotonic()
+        with self._lock:
+            pruned = self._prune_telemetry_locked(now)
+            out = {
+                member: {
+                    "digests": dict(v["digests"]),
+                    "counters": dict(v["counters"]),
+                    "age_s": now - v["at"],
+                }
+                for member, v in self._telemetry.items()
+            }
+        self._drop_member_series(pruned)
+        return out
 
     # -- KV data plane (session reader threads) -----------------------------
 

@@ -49,6 +49,10 @@ from collections import OrderedDict, deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from distributed_inference_server_tpu.serving.metrics import MetricsCollector
+from distributed_inference_server_tpu.serving.teledigest import (
+    SloSettings,
+    slo_verdict,
+)
 
 PHASES = ("queue_wait", "prefill", "peer_fetch", "handoff_stall",
           "decode", "detok")
@@ -63,7 +67,7 @@ class _Timeline:
         "request_id", "admitted_at", "events", "events_dropped", "tokens",
         "first_token_at", "last_token_at", "dispatch_at", "terminal_at",
         "status", "code", "peer_fetch_s", "handoff_stall_s", "trace_id",
-        "attrs", "_block_anchor",
+        "attrs", "slo", "_block_anchor",
     )
 
     def __init__(self, request_id, now: float):
@@ -82,6 +86,9 @@ class _Timeline:
         self.handoff_stall_s = 0.0
         self.trace_id: Optional[str] = None
         self.attrs: Dict[str, Any] = {}
+        # SLO verdict block, derived once at finish() (None = no
+        # applicable objective; docs/OBSERVABILITY.md)
+        self.slo: Optional[Dict[str, Any]] = None
         self._block_anchor = 0  # tokens already folded into block events
 
 
@@ -90,8 +97,15 @@ class FlightRecorder:
 
     def __init__(self, metrics: Optional[MetricsCollector] = None,
                  max_requests: int = 256, max_events: int = 96,
-                 block_tokens: int = 16, max_global_events: int = 128):
+                 block_tokens: int = 16, max_global_events: int = 128,
+                 slo: Optional[SloSettings] = None):
+        """``slo`` (serving/teledigest.py SloSettings) arms SLO
+        accounting: ``finish()`` derives an ok/violated verdict from
+        the request's exact phase partition, stamps it on the timeline,
+        and feeds ``slo_requests_total{tenant,verdict}`` + the goodput
+        counters (docs/OBSERVABILITY.md "Performance telemetry")."""
         self.metrics = metrics
+        self.slo = slo
         self.max_requests = max_requests
         self.max_events = max_events
         self.block_tokens = max(1, block_tokens)
@@ -186,8 +200,33 @@ class FlightRecorder:
                 (now, "terminal",
                  {"status": status, **({"code": code} if code else {})}))
             phases = self._phases_locked(tl, now)
+            # SLO inputs, exactly from the phase model: TTFT is the
+            # admit->first-token span (queue_wait + prefill +
+            # peer_fetch, exactly), TBT the mean first->last inter-token
+            # gap (decode + handoff stalls — the client observes the
+            # stall, so the objective charges it)
+            ttft_s = (tl.first_token_at - tl.admitted_at
+                      if tl.first_token_at is not None else None)
+            tbt_s = None
+            if (tl.tokens > 1 and tl.first_token_at is not None
+                    and tl.last_token_at is not None):
+                tbt_s = ((tl.last_token_at - tl.first_token_at)
+                         / (tl.tokens - 1))
+            tenant = str(tl.attrs.get("tenant") or "default")
+            tokens = tl.tokens
+        verdict = None
+        if self.slo is not None:
+            verdict = slo_verdict(self.slo, tenant, ttft_s, tbt_s, status)
+            if verdict is not None:
+                # single assignment after the terminal landed: the
+                # request has exactly one finisher (first call wins
+                # above), so no second writer exists
+                tl.slo = verdict
         if self.metrics is not None:
-            self.metrics.record_request_phases(phases)
+            self.metrics.record_request_phases(phases, tbt_s=tbt_s)
+            if verdict is not None:
+                self.metrics.record_slo(tenant, verdict["verdict"],
+                                        tokens=tokens)
         return phases
 
     def note_global(self, name: str, **attrs) -> None:
@@ -309,6 +348,8 @@ class FlightRecorder:
                 out["code"] = tl.code
             if tl.trace_id:
                 out["trace_id"] = tl.trace_id
+            if tl.slo is not None:
+                out["slo"] = dict(tl.slo)
             if ttft is not None:
                 out["ttft_s"] = round(ttft, 6)
             if tbt is not None:
@@ -317,14 +358,25 @@ class FlightRecorder:
                 out["fleet_events"] = fleet_events
             return out
 
-    def recent(self, n: int = 50) -> List[Dict[str, Any]]:
-        """Newest-first summaries for ``GET /server/requests``."""
+    def recent(self, n: int = 50,
+               verdict: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Newest-first summaries for ``GET /server/requests``.
+        ``verdict`` ("ok" | "violated") keeps only timelines whose SLO
+        verdict matches — the operator's "show me what burned the SLO"
+        query (docs/OBSERVABILITY.md)."""
         with self._lock:
-            items = list(self._timelines.values())[-n:]
+            items = list(self._timelines.values())
+            if verdict is not None:
+                items = [tl for tl in items
+                         if tl.slo is not None
+                         and tl.slo.get("verdict") == verdict]
+            items = items[-n:]
         return [
             {"request_id": str(tl.request_id), "status": tl.status,
              "tokens": tl.tokens,
-             **({"trace_id": tl.trace_id} if tl.trace_id else {})}
+             **({"trace_id": tl.trace_id} if tl.trace_id else {}),
+             **({"verdict": tl.slo["verdict"]}
+                if tl.slo is not None else {})}
             for tl in reversed(items)
         ]
 

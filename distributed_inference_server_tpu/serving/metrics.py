@@ -27,9 +27,19 @@ from prometheus_client import (
     generate_latest,
 )
 
+from distributed_inference_server_tpu.serving.teledigest import (
+    PerfTelemetry,
+    window_stats,
+)
+
 # rolling windows for the snapshot's derived rates
 _TOKEN_WINDOW_S = 10.0
-_LATENCY_WINDOW = 1024
+_TTFT_WINDOW = 1024
+#: distinct SLO tenant label values before new tenants fold into
+#: "other" — tenant is a client-chosen string and counter series are
+#: forever, so the label set must be bounded (unlike the tenant GAUGE,
+#: which removes drained series)
+_SLO_TENANT_CAP = 32
 
 
 @dataclass(frozen=True)
@@ -447,14 +457,86 @@ class MetricsCollector:
             buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1,
                      2, 5, 10, 30),
         )
+        # engine step clock (docs/OBSERVABILITY.md "Performance
+        # telemetry"): host-side wall time, dispatch counts, and tokens
+        # per dispatch kind, delta-reported by the runner from the
+        # engine's cumulative counters (like the `mixed` block)
+        self.step_seconds = Counter(
+            "engine_step_seconds_total",
+            "Host wall-clock seconds attributed to engine dispatches by "
+            "kind (prefill = chunk quantum, decode_block = K-step block "
+            "launch + reconcile, mixed = ragged mixed dispatch)",
+            ["engine_id", "kind"], registry=r,
+        )
+        self.step_dispatches = Counter(
+            "engine_step_dispatches_total",
+            "Engine dispatches by kind (the step clock's denominator)",
+            ["engine_id", "kind"], registry=r,
+        )
+        self.step_tokens = Counter(
+            "engine_step_tokens_total",
+            "Tokens moved per dispatch kind (prefill = prompt tokens "
+            "computed, decode_block/mixed = sampled tokens reconciled)",
+            ["engine_id", "kind"], registry=r,
+        )
+        self.step_events = Counter(
+            "engine_step_events_total",
+            "Step-loop pressure events (cache_full = allocation failed "
+            "and the step degraded, preempt = youngest sequence evicted, "
+            "reclaim = sliding-window pages released, retrace = a new "
+            "program geometry compiled mid-serving)",
+            ["engine_id", "event"], registry=r,
+        )
+        # SLO / goodput accounting (serving/teledigest.py SloSettings;
+        # fed by flightrec.finish() from the exact phase partition)
+        self.slo_requests = Counter(
+            "slo_requests_total",
+            "Finished requests with an applicable SLO, by tenant and "
+            "verdict (ok | violated); tenants beyond a bounded label "
+            "set fold into \"other\"",
+            ["tenant", "verdict"], registry=r,
+        )
+        self.slo_goodput = Counter(
+            "slo_goodput_tokens_total",
+            "Output tokens of requests that MET their SLO (goodput; "
+            "compare against tokens_generated_total for the waste share)",
+            ["tenant"], registry=r,
+        )
+        # fleet telemetry federation (serving/fleet.py ingest +
+        # serving/remote_runner.py ship): frame traffic accounting
+        self.fleet_telemetry_frames = Counter(
+            "fleet_telemetry_frames_total",
+            "FleetTelemetry frames by outcome (sent/failed on a worker, "
+            "ingested on the registry host)",
+            ["outcome"], registry=r,
+        )
+        # per-member series merged from ingested member digests: the
+        # registry host's /metrics answers \"which member is burning "
+        # "the fleet p99\" without touching any member
+        self.fleet_member_step_tokens = Gauge(
+            "fleet_member_step_tokens",
+            "A member's cumulative step-clock tokens by dispatch kind "
+            "(from its last FleetTelemetry frame)",
+            ["member", "kind"], registry=r,
+        )
+        self.fleet_member_ttft_p99 = Gauge(
+            "fleet_member_ttft_p99_ms",
+            "A member's windowed TTFT p99 (ms) from its last shipped "
+            "digest (0 until it has a windowed sample)",
+            ["member"], registry=r,
+        )
+
+        # windowed performance digests (serving/teledigest.py): the
+        # sliding-epoch store behind GET /server/perf, the snapshot's
+        # windowed p99, and the member half of FleetTelemetry frames
+        self.perf = PerfTelemetry()
 
         # snapshot internals
         self._total_requests = 0
         self._active_requests = 0
         self._token_events: Deque[Tuple[float, int]] = deque()
-        self._latencies_ms: Deque[float] = deque(maxlen=_LATENCY_WINDOW)
-        self._ttfts_ms: Deque[float] = deque(maxlen=_LATENCY_WINDOW)
-        self._batch_sizes: Deque[int] = deque(maxlen=_LATENCY_WINDOW)
+        self._ttfts_ms: Deque[float] = deque(maxlen=_TTFT_WINDOW)
+        self._batch_sizes: Deque[int] = deque(maxlen=_TTFT_WINDOW)
         self._cache_hits = 0
         self._cache_misses = 0
         self._cache_evictions = 0
@@ -481,6 +563,14 @@ class MetricsCollector:
         self._trace_drops: Dict[str, int] = {}
         self._phase_sums: Dict[str, float] = {}
         self._phase_requests = 0
+        # SLO accounting (teledigest.slo_verdict via flightrec.finish)
+        self._slo_counts: Dict[str, Dict[str, int]] = {}
+        self._slo_goodput: Dict[str, int] = {}
+        # member -> step-token kinds published, so a pruned member's
+        # gauge series can be REMOVED (dead members must not keep
+        # reporting their last p99 as live, and per-restart member ids
+        # must not grow the label set forever — tenant-gauge policy)
+        self._member_kinds: Dict[str, set] = {}
 
     # -- recording ---------------------------------------------------------
 
@@ -488,9 +578,11 @@ class MetricsCollector:
         self.request_latency.labels(endpoint=endpoint, status=str(status)).observe(
             latency_s
         )
+        # the windowed digest replaces the process-lifetime raw-latency
+        # buffer: /server/stats p99 is now a SLIDING-window percentile
+        self.perf.observe("latency_ms", latency_s * 1000.0)
         with self._lock:
             self._total_requests += 1
-            self._latencies_ms.append(latency_s * 1000.0)
 
     def record_batch(self, size: int, padding_ratio: float = 0.0) -> None:
         self.batch_size.observe(size)
@@ -514,6 +606,7 @@ class MetricsCollector:
 
     def record_ttft(self, seconds: float) -> None:
         self.ttft.observe(seconds)
+        self.perf.observe("ttft_ms", seconds * 1000.0)
         with self._lock:
             self._ttfts_ms.append(seconds * 1000.0)
 
@@ -694,17 +787,159 @@ class MetricsCollector:
         with self._lock:
             self._trace_drops[reason] = self._trace_drops.get(reason, 0) + n
 
-    def record_request_phases(self, phases: Dict[str, float]) -> None:
+    def record_request_phases(self, phases: Dict[str, float],
+                              tbt_s: Optional[float] = None) -> None:
         """One finished request's derived phase attribution
-        (serving/flightrec.py): seconds per lifecycle phase."""
+        (serving/flightrec.py): seconds per lifecycle phase. The
+        queue-wait phase and the request's mean TBT (when it streamed
+        more than one token) also feed the windowed digests behind
+        ``GET /server/perf``."""
         for phase, seconds in phases.items():
             self.request_phases.labels(phase=phase).observe(seconds)
+        self.perf.observe("queue_wait_ms",
+                          phases.get("queue_wait", 0.0) * 1000.0)
+        if tbt_s is not None:
+            self.perf.observe("tbt_ms", tbt_s * 1000.0)
         with self._lock:
             self._phase_requests += 1
             for phase, seconds in phases.items():
                 self._phase_sums[phase] = (
                     self._phase_sums.get(phase, 0.0) + seconds
                 )
+
+    def record_step_clock(self, engine_id: str, kind: str,
+                          dispatches: int = 0, wall_s: float = 0.0,
+                          tokens: int = 0, rows: int = 0) -> None:
+        """Step-clock deltas for one dispatch kind since the runner's
+        last report (docs/OBSERVABILITY.md \"Performance telemetry\").
+        Feeds both the Prometheus counters and the /server/perf
+        cumulative store (which also rides FleetTelemetry frames)."""
+        if dispatches:
+            self.step_dispatches.labels(engine_id=engine_id,
+                                        kind=kind).inc(dispatches)
+        if wall_s:
+            self.step_seconds.labels(engine_id=engine_id,
+                                     kind=kind).inc(wall_s)
+        if tokens:
+            self.step_tokens.labels(engine_id=engine_id,
+                                    kind=kind).inc(tokens)
+        base = f"step.{engine_id}.{kind}"
+        if dispatches:
+            self.perf.add_counter(f"{base}.dispatches", dispatches)
+        if wall_s:
+            self.perf.add_counter(f"{base}.wall_s", wall_s)
+        if tokens:
+            self.perf.add_counter(f"{base}.tokens", tokens)
+        if rows:
+            self.perf.add_counter(f"{base}.rows", rows)
+
+    def record_step_events(self, engine_id: str,
+                           deltas: Dict[str, int]) -> None:
+        """Step-loop pressure-event deltas (cache_full / preempt /
+        reclaim / retrace) since the runner's last report."""
+        for event, n in deltas.items():
+            if n <= 0:
+                continue
+            self.step_events.labels(engine_id=engine_id,
+                                    event=event).inc(n)
+            self.perf.add_counter(f"events.{engine_id}.{event}", n)
+
+    def observe_step(self, kind: str, seconds: float) -> None:
+        """One dispatch's host wall time into the per-kind windowed
+        digest (p50/p90/p99 dispatch time at GET /server/perf)."""
+        self.perf.observe(f"step_ms.{kind}", seconds * 1000.0)
+
+    def _slo_tenant_label_locked(self, tenant: str) -> str:
+        # bounded label set: counter series never go away, so a
+        # client-chosen tenant string must not grow /metrics unboundedly
+        if tenant in self._slo_counts or len(self._slo_counts) < _SLO_TENANT_CAP:
+            return tenant
+        return "other"
+
+    def record_slo(self, tenant: str, verdict: str, tokens: int = 0) -> None:
+        """One finished request's SLO verdict (flightrec.finish →
+        teledigest.slo_verdict): counts + goodput tokens + the windowed
+        burn-rate digests."""
+        with self._lock:
+            tenant = self._slo_tenant_label_locked(tenant)
+            per = self._slo_counts.setdefault(tenant, {})
+            per[verdict] = per.get(verdict, 0) + 1
+            if verdict == "ok" and tokens:
+                self._slo_goodput[tenant] = (
+                    self._slo_goodput.get(tenant, 0) + tokens
+                )
+        self.slo_requests.labels(tenant=tenant, verdict=verdict).inc()
+        if verdict == "ok" and tokens:
+            self.slo_goodput.labels(tenant=tenant).inc(tokens)
+        self.perf.count("slo.violated" if verdict == "violated"
+                        else "slo.ok")
+
+    def slo_counts(self) -> Tuple[Dict[str, Dict[str, int]],
+                                  Dict[str, int]]:
+        """(per-tenant verdict counts, per-tenant goodput tokens) for
+        the /server/perf slo block."""
+        with self._lock:
+            return ({t: dict(v) for t, v in self._slo_counts.items()},
+                    dict(self._slo_goodput))
+
+    def configure_perf(self, epoch_s: float, window_s: float) -> None:
+        """Boot-time digest-ring geometry (config slo.epoch_s /
+        slo.window_s); see PerfTelemetry.configure."""
+        self.perf.configure(epoch_s, window_s)
+
+    def perf_store(self) -> PerfTelemetry:
+        """The windowed-digest store (GET /server/perf assembly)."""
+        return self.perf
+
+    def perf_wire(self) -> Dict[str, Any]:
+        """The FleetTelemetry frame body (worker heartbeat shipping)."""
+        return self.perf.wire()
+
+    def perf_window_s(self) -> float:
+        """The configured percentile window (fleet telemetry ingest)."""
+        return self.perf.window_s
+
+    def perf_epoch_s(self) -> float:
+        """The configured epoch resolution — the fleet ingest drops
+        member digests whose epoch_s disagrees (a foreign time unit
+        would corrupt the merged windows)."""
+        return self.perf.epoch_s
+
+    def record_telemetry_frame(self, outcome: str) -> None:
+        """One FleetTelemetry frame: sent | failed (worker side),
+        ingested | epoch_mismatch (registry host)."""
+        self.fleet_telemetry_frames.labels(outcome=outcome).inc()
+
+    def set_member_telemetry(self, member: str,
+                             step_tokens: Dict[str, float],
+                             ttft_p99_ms: Optional[float]) -> None:
+        """Per-member gauges from an ingested FleetTelemetry frame
+        (serving/fleet.py): the fleet_*{member} series."""
+        with self._lock:
+            # series add/remove under the collector lock (the tenant-
+            # gauge discipline): an ingest racing a prune for the same
+            # member must not interleave a remove with this set
+            self._member_kinds.setdefault(member,
+                                          set()).update(step_tokens)
+            for kind, tokens in step_tokens.items():
+                self.fleet_member_step_tokens.labels(
+                    member=member, kind=kind).set(tokens)
+            self.fleet_member_ttft_p99.labels(member=member).set(
+                ttft_p99_ms or 0.0)
+
+    def remove_member_telemetry(self, member: str) -> None:
+        """Drop a pruned member's fleet_member_* series (its last
+        values must stop reading as live, serving/fleet.py)."""
+        with self._lock:
+            for kind in self._member_kinds.pop(member, set()):
+                try:
+                    self.fleet_member_step_tokens.remove(member, kind)
+                except KeyError:
+                    pass
+            try:
+                self.fleet_member_ttft_p99.remove(member)
+            except KeyError:
+                pass
 
     def set_fleet_members(self, counts: Dict[str, int]) -> None:
         """Fleet members per registry state (serving/fleet.py): all
@@ -793,8 +1028,15 @@ class MetricsCollector:
                 span = max(now - self._token_events[0][0], 1e-3)
             else:
                 span = _TOKEN_WINDOW_S
-            lat = sorted(self._latencies_ms)
-            p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat else 0.0
+            # sliding-window latency stats from the teledigest store:
+            # p99 answers "now", not "since boot" (a quiet hour no
+            # longer hides behind a morning burst's tail)
+            lat_stats = window_stats(
+                self.perf.wire_digest("latency_ms"),
+                self.perf.window_s,
+            )
+            p99 = lat_stats.get("p99", 0.0)
+            avg_latency = lat_stats.get("mean", 0.0)
             total_cache = self._cache_hits + self._cache_misses
             # prefix-cache block: allocator counters (incl. evictions,
             # which never reached the snapshot before) + tiered hits +
@@ -869,7 +1111,7 @@ class MetricsCollector:
                 average_ttft_ms=(
                     sum(self._ttfts_ms) / len(self._ttfts_ms) if self._ttfts_ms else 0.0
                 ),
-                average_latency_ms=sum(lat) / len(lat) if lat else 0.0,
+                average_latency_ms=avg_latency,
                 p99_latency_ms=p99,
                 average_batch_size=(
                     sum(self._batch_sizes) / len(self._batch_sizes)

@@ -289,6 +289,38 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         2: ("spans", "msg:TraceSpan", "rep"),
         3: ("dropped", "uint64", "one"),
     },
+    # Fleet-federated performance telemetry (serving/teledigest.py;
+    # docs/OBSERVABILITY.md "Performance telemetry"): a member's
+    # windowed log-bucket digests + cumulative step-clock counters,
+    # piggybacked per heartbeat on fleet-wire frame kind 5. Epoch
+    # indices are wall-clock aligned (time // epoch_s) so the registry
+    # host merges member epochs exactly; bucket/count arrays are
+    # parallel and sorted (canonical form — equal contents encode
+    # equal bytes).
+    "TeleEpoch": {
+        1: ("index", "uint64", "one"),
+        2: ("buckets", "uint32", "rep"),
+        3: ("counts", "uint64", "rep"),
+        4: ("n", "uint64", "one"),
+        # integer microseconds, not a double: float addition is
+        # order-dependent in its last bits, which would break the
+        # bit-equality of merged views under re-grouping
+        5: ("sum_us", "uint64", "one"),
+    },
+    "TeleDigest": {
+        1: ("name", "string", "one"),
+        2: ("epoch_s", "double", "one"),
+        3: ("epochs", "msg:TeleEpoch", "rep"),
+    },
+    "TeleCounter": {
+        1: ("name", "string", "one"),
+        2: ("value", "double", "one"),
+    },
+    "FleetTelemetry": {
+        1: ("member_id", "string", "one"),
+        2: ("digests", "msg:TeleDigest", "rep"),
+        3: ("counters", "msg:TeleCounter", "rep"),
+    },
     "ErrorDetail": {
         1: ("message", "string", "one"),
         2: ("error_type", "string", "one"),

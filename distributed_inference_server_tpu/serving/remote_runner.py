@@ -731,6 +731,31 @@ class FleetWorker:
                 self.tracer.record_drop("wire", len(shipped))
             return False
 
+    def ship_telemetry_once(self) -> bool:
+        """Send one FleetTelemetry frame: the full current digest
+        windows + cumulative step-clock counters (serving/teledigest.py).
+        Piggybacked after each successful beat, like spans. Stateless by
+        design — digests are cumulative sliding windows, so a dropped
+        frame needs no replay buffer: the NEXT frame carries everything
+        the window still remembers (bounded + drop-counted via
+        fleet_telemetry_frames_total{outcome=failed}). Returns False
+        when the link is down."""
+        if self.metrics is None:
+            return True
+        body = self.metrics.perf_wire()
+        if not body["digests"] and not body["counters"]:
+            return True
+        try:
+            self._send("FleetTelemetry",
+                       {"member_id": self.member_id, **body})
+            self.metrics.record_telemetry_frame("sent")
+            return True
+        except Exception as e:  # noqa: BLE001 — link fault domain
+            logger.debug("fleet worker %s: telemetry ship failed: %s",
+                         self.member_id, e)
+            self.metrics.record_telemetry_frame("failed")
+            return False
+
     def heartbeat_once(self) -> bool:
         """Send one heartbeat; returns False when the link is down."""
         self._seq += 1
@@ -755,7 +780,8 @@ class FleetWorker:
             if self._crashed:
                 return  # injected crash: the process is "dead"
             if (self._sock is None or not self.heartbeat_once()
-                    or not self.ship_spans_once()):
+                    or not self.ship_spans_once()
+                    or not self.ship_telemetry_once()):
                 self._close()
                 if self._stop.is_set() or self._crashed:
                     return

@@ -182,6 +182,10 @@ class EngineRunner:
         # mixed-step counter watermarks (engine.mixed_stats() reports
         # totals; the collector wants deltas)
         self._mixed_seen = {"prefill_tokens": 0, "decode_tokens": 0}
+        # step-clock watermarks (engine.step_clock_stats() reports
+        # cumulative kind/event counters; the collector wants deltas —
+        # same shape as the mixed block, docs/OBSERVABILITY.md)
+        self._sc_seen: Dict[str, Dict] = {"kinds": {}, "events": {}}
         # rolling prefix digest for cache-aware routing (ISSUE 5):
         # refreshed on the engine thread (allocator state is single-
         # owner), read as an immutable snapshot by status() from any
@@ -857,6 +861,7 @@ class EngineRunner:
                 self._cache_seen = {"hits": 0, "misses": 0, "evictions": 0}
                 self._mixed_seen = {"prefill_tokens": 0,
                                     "decode_tokens": 0}
+                self._sc_seen = {"kinds": {}, "events": {}}
                 if on_done:
                     on_done(True, None)
 
@@ -1156,9 +1161,12 @@ class EngineRunner:
             host = self._engine.host_tier_stats()
             reloads = self._engine.drain_reload_durations()
             mixed = self._engine.mixed_stats()
+            step_clock = self._engine.step_clock_stats()
+            step_samples = self._engine.drain_step_samples()
         except Exception as e:  # noqa: BLE001
             self._absorbed("cache_stats", e)
             return
+        self._report_step_clock(step_clock, step_samples)
         if mixed is not None:
             seen_m = self._mixed_seen
             dp = max(0, mixed["prefill_tokens"] - seen_m["prefill_tokens"])
@@ -1194,6 +1202,35 @@ class EngineRunner:
             "hits": s.hits, "misses": s.misses, "evictions": s.evictions,
             "host_hit_pages": host["hit_pages"] if host is not None else 0,
         }
+
+    def _report_step_clock(self, step_clock: Dict, samples) -> None:
+        """Delta-report the engine step clock into the collector
+        (docs/OBSERVABILITY.md "Performance telemetry"): cumulative
+        kind/event counters diffed against the last report, per-segment
+        wall-time samples fed to the step_ms.<kind> windowed digests."""
+        seen_kinds = self._sc_seen.get("kinds", {})
+        for kind, cur in step_clock["kinds"].items():
+            prev = seen_kinds.get(kind, {})
+            d_disp = int(cur["dispatches"] - prev.get("dispatches", 0))
+            d_wall = cur["wall_s"] - prev.get("wall_s", 0.0)
+            d_tok = int(cur["tokens"] - prev.get("tokens", 0))
+            d_rows = int(cur["rows"] - prev.get("rows", 0))
+            if d_disp > 0 or d_wall > 0 or d_tok > 0:
+                self.metrics.record_step_clock(
+                    self.engine_id, kind, dispatches=max(0, d_disp),
+                    wall_s=max(0.0, d_wall), tokens=max(0, d_tok),
+                    rows=max(0, d_rows),
+                )
+        seen_events = self._sc_seen.get("events", {})
+        deltas = {
+            event: int(total - seen_events.get(event, 0))
+            for event, total in step_clock["events"].items()
+        }
+        if any(n > 0 for n in deltas.values()):
+            self.metrics.record_step_events(self.engine_id, deltas)
+        self._sc_seen = step_clock
+        for kind, wall_s in samples:
+            self.metrics.observe_step(kind, wall_s)
 
     def _fail_all(self, message: str) -> None:
         # streamed exports die with the engine: cancel their stream jobs

@@ -72,6 +72,7 @@ class InferenceServer:
         disagg_settings=None,
         fetch_costs=None,
         fleet_settings=None,
+        slo_settings=None,
     ):
         """``model_resolver(name) -> engine_factory`` enables the admin
         model-swap endpoint (Req 13); None leaves it unconfigured (501).
@@ -98,7 +99,13 @@ class InferenceServer:
         their engines through RemoteRunner proxies; with ``rerole`` the
         RoleBalancer flips unified engines to prefill under prompt-queue
         pressure (and back) with hysteresis. None/defaults = no fleet
-        networking, no rebalancing — today's behavior exactly."""
+        networking, no rebalancing — today's behavior exactly.
+
+        ``slo_settings`` (serving/teledigest.py SloSettings; config
+        section ``slo``): arms per-request SLO verdicts in the flight
+        recorder and shapes the windowed-digest rings behind
+        ``GET /server/perf`` (docs/OBSERVABILITY.md "Performance
+        telemetry"). None = no SLO accounting, default windows."""
         from distributed_inference_server_tpu.utils.tracing import Tracer
 
         from distributed_inference_server_tpu.serving.flightrec import (
@@ -109,13 +116,21 @@ class InferenceServer:
         self.model_resolver = model_resolver
         self.metrics = MetricsCollector()
         self.tracer = Tracer()
+        self.slo_settings = slo_settings
+        if slo_settings is not None:
+            # boot-time only: the rings are empty here, so re-shaping
+            # them discards nothing
+            self.metrics.configure_perf(slo_settings.epoch_s,
+                                        slo_settings.window_s)
         # drop accounting (docs/OBSERVABILITY.md): ring eviction,
         # exporter failure, and fleet-wire buffer overflow surface as
         # trace_spans_dropped_total{reason=...} instead of a debug log
         self.tracer.on_drop = self.metrics.record_trace_drops
         # per-request flight recorder: the spine notes lifecycle events
-        # into bounded timelines served at GET /server/requests/<id>
-        self.recorder = FlightRecorder(metrics=self.metrics)
+        # into bounded timelines served at GET /server/requests/<id>;
+        # slo_settings arms its verdict derivation
+        self.recorder = FlightRecorder(metrics=self.metrics,
+                                       slo=slo_settings)
         from distributed_inference_server_tpu.serving import faults as _faults
 
         # fault arm/disarm hops land in the recorder's fleet window so a
@@ -470,7 +485,29 @@ class InferenceServer:
             fleet_fn = self._fleet_stats
 
         return build_app(self.handler, self.metrics, swap_fn=swap_fn,
-                         scale_fn=scale_fn, fleet_fn=fleet_fn)
+                         scale_fn=scale_fn, fleet_fn=fleet_fn,
+                         perf_fn=self._perf_stats)
+
+    def _perf_stats(self) -> dict:
+        """The ``GET /server/perf`` payload (docs/OBSERVABILITY.md
+        "Performance telemetry"): per-engine step clock, windowed
+        latency percentiles, SLO burn, and — on a registry host — the
+        per-member digests plus the fleet-merged view. Assembled by
+        teledigest.build_perf_payload so the merge/percentile path is
+        the exact one an operator re-merging member digests uses."""
+        from distributed_inference_server_tpu.serving.teledigest import (
+            build_perf_payload,
+        )
+
+        slo_counts, goodput = self.metrics.slo_counts()
+        fleet_members = None
+        if self.fleet_server is not None:
+            fleet_members = self.fleet_server.telemetry_snapshot()
+        return build_perf_payload(
+            self.metrics.perf_store(), self.slo_settings,
+            slo_counts=slo_counts, goodput=goodput,
+            fleet_members=fleet_members,
+        )
 
     def _fleet_stats(self) -> dict:
         """The ``fleet`` block of ``/server/stats`` (docs/FLEET.md):

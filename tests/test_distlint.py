@@ -1480,3 +1480,118 @@ def test_interprocedural_rules_registered():
     for name in ("DL008", "DL009", "DL010", "DL011", "DL012", "DL013"):
         assert name in RULES
         assert RULES[name].scope == "project"
+
+
+# ---------------------------------------------------------------------------
+# DL014 — performance-telemetry catalog drift
+# ---------------------------------------------------------------------------
+
+_DL014_CATALOG = """# Observability
+
+## Performance telemetry
+
+| name | kind | meaning |
+|------|------|---------|
+| `engines` | perf-field | per-engine step clock |
+| `windows` | perf-field | windowed stats |
+| `slo_requests_total` | metric | verdict counts |
+| `ttft_ms` | digest | windowed TTFT |
+"""
+
+_DL014_TELEDIGEST = '''
+PERF_FIELDS = ("engines", "windows")
+TELEMETRY_METRICS = ("slo_requests_total",)
+DIGEST_NAMES = ("ttft_ms",)
+'''
+
+_DL014_METRICS = '''
+from prometheus_client import Counter
+class MetricsCollector:
+    def __init__(self, r=None):
+        self.slo_requests = Counter(
+            "slo_requests_total", "d", ["tenant", "verdict"], registry=r)
+'''
+
+
+def _dl014_root(tmp_path, catalog=_DL014_CATALOG):
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(catalog)
+    return tmp_path
+
+
+def test_dl014_clean(tmp_path):
+    out = pcheck("DL014", {
+        f"{PKG}/serving/teledigest.py": _DL014_TELEDIGEST,
+        f"{PKG}/serving/metrics.py": _DL014_METRICS,
+    }, root=_dl014_root(tmp_path))
+    assert out == []
+
+
+def test_dl014_flags_undocumented_code_entry(tmp_path):
+    out = pcheck("DL014", {
+        f"{PKG}/serving/teledigest.py": _DL014_TELEDIGEST.replace(
+            '("engines", "windows")', '("engines", "windows", "mystery")'
+        ),
+        f"{PKG}/serving/metrics.py": _DL014_METRICS,
+    }, root=_dl014_root(tmp_path))
+    assert len(out) == 1
+    assert "'mystery'" in out[0].message
+    assert out[0].path.endswith("teledigest.py")
+
+
+def test_dl014_flags_dead_catalog_row(tmp_path):
+    out = pcheck("DL014", {
+        f"{PKG}/serving/teledigest.py": _DL014_TELEDIGEST,
+        f"{PKG}/serving/metrics.py": _DL014_METRICS,
+    }, root=_dl014_root(
+        tmp_path,
+        _DL014_CATALOG + "| `ghost_field` | perf-field | gone |\n"))
+    assert len(out) == 1
+    assert "'ghost_field'" in out[0].message
+    assert out[0].path == "docs/OBSERVABILITY.md"
+
+
+def test_dl014_flags_kind_disagreement(tmp_path):
+    # cataloged as a digest, declared as a perf-field
+    out = pcheck("DL014", {
+        f"{PKG}/serving/teledigest.py": _DL014_TELEDIGEST.replace(
+            'DIGEST_NAMES = ("ttft_ms",)',
+            'DIGEST_NAMES = ()\nPERF_FIELDS2 = ()'
+        ).replace('("engines", "windows")',
+                  '("engines", "windows", "ttft_ms")'),
+        f"{PKG}/serving/metrics.py": _DL014_METRICS,
+    }, root=_dl014_root(tmp_path))
+    assert any("catalogs disagree" in f.message for f in out)
+
+
+def test_dl014_flags_unregistered_cataloged_metric(tmp_path):
+    out = pcheck("DL014", {
+        f"{PKG}/serving/teledigest.py": _DL014_TELEDIGEST,
+        f"{PKG}/serving/metrics.py": (
+            "class MetricsCollector:\n"
+            "    pass\n"),
+    }, root=_dl014_root(tmp_path))
+    assert any("never registered" in f.message for f in out)
+
+
+def test_dl014_no_teledigest_or_docs_means_no_findings(tmp_path):
+    # fixture roots without the module or the catalog must not flag
+    assert pcheck("DL014", {
+        f"{PKG}/serving/metrics.py": _DL014_METRICS,
+    }, root=_dl014_root(tmp_path)) == []
+    assert pcheck("DL014", {
+        f"{PKG}/serving/teledigest.py": _DL014_TELEDIGEST,
+    }) == []
+
+
+def test_dl014_real_repo_catalog_is_in_sync():
+    findings = list(RULES["DL014"].check_project(
+        list(run_lint.__globals__["collect_modules"](REPO_ROOT).values()),
+        REPO_ROOT,
+    ))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_dl014_registered():
+    assert "DL014" in RULES
+    assert RULES["DL014"].scope == "project"

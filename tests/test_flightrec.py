@@ -297,3 +297,91 @@ class TestSpineFastPath:
         assert tl["status"] == "error"
         assert tl["code"] == "inference_failed"
         assert sink.errors == ["inference_failed"]
+
+
+class TestSloVerdicts:
+    """SLO accounting at finish() (serving/teledigest.py SloSettings;
+    docs/OBSERVABILITY.md "Performance telemetry")."""
+
+    def _slo(self, **kw):
+        from distributed_inference_server_tpu.serving.teledigest import (
+            SloSettings,
+        )
+
+        return SloSettings(**kw)
+
+    def test_verdict_stamped_and_counted(self):
+        m = MetricsCollector()
+        rec = FlightRecorder(metrics=m,
+                             slo=self._slo(ttft_ms=10_000.0))
+        _drive_request(rec, tokens=8)
+        tl = rec.timeline("r1")
+        assert tl["slo"]["verdict"] == "ok"
+        counts, goodput = m.slo_counts()
+        assert counts == {"default": {"ok": 1}}
+        assert goodput == {"default": 8}
+        text = m.prometheus_text().decode()
+        assert ('slo_requests_total{tenant="default",verdict="ok"} 1.0'
+                in text)
+        assert 'slo_goodput_tokens_total{tenant="default"} 8.0' in text
+
+    def test_violation_and_listing_filter(self):
+        m = MetricsCollector()
+        # 0ms TTFT objective: everything violates
+        rec = FlightRecorder(metrics=m, slo=self._slo(ttft_ms=1e-9))
+        _drive_request(rec, rid="bad", tokens=4)
+        rec.admit("never-slo")  # live request: no verdict yet
+        tl = rec.timeline("bad")
+        assert tl["slo"]["verdict"] == "violated"
+        assert tl["slo"]["ttft_violated"] is True
+        # goodput counts only SLO-met requests
+        _, goodput = m.slo_counts()
+        assert goodput == {}
+        # ?verdict= filter: only the violated timeline lists
+        listed = rec.recent(50, verdict="violated")
+        assert [e["request_id"] for e in listed] == ["bad"]
+        assert listed[0]["verdict"] == "violated"
+        assert rec.recent(50, verdict="ok") == []
+        # unfiltered listing still carries the verdict field
+        allr = {e["request_id"]: e for e in rec.recent(50)}
+        assert allr["bad"]["verdict"] == "violated"
+        assert "verdict" not in allr["never-slo"]
+
+    def test_tenant_rides_admit_attrs(self):
+        m = MetricsCollector()
+        rec = FlightRecorder(
+            metrics=m, slo=self._slo(tenant_ttft_ms={"gold": 1e-9}))
+        rec.admit("g1", tenant="gold")
+        rec.token("g1")
+        rec.finish("g1", "ok")
+        rec.admit("d1", tenant="silver")  # no applicable objective
+        rec.token("d1")
+        assert rec.finish("d1", "ok") is not None
+        counts, _ = m.slo_counts()
+        assert counts == {"gold": {"violated": 1}}
+        assert rec.timeline("d1").get("slo") is None
+
+    def test_error_request_with_slo_is_violation(self):
+        m = MetricsCollector()
+        rec = FlightRecorder(metrics=m, slo=self._slo(ttft_ms=60_000.0))
+        rec.admit("e1")
+        rec.note("e1", "schedule", engine="e0")
+        rec.token("e1")
+        rec.finish("e1", "error", code="engine_crashed")
+        assert rec.timeline("e1")["slo"]["verdict"] == "violated"
+
+    def test_no_slo_config_means_no_verdicts(self):
+        m = MetricsCollector()
+        rec = FlightRecorder(metrics=m)
+        _drive_request(rec, tokens=4)
+        assert "slo" not in rec.timeline("r1")
+        counts, _ = m.slo_counts()
+        assert counts == {}
+
+    def test_tbt_digest_fed_at_finish(self):
+        m = MetricsCollector()
+        rec = FlightRecorder(metrics=m)
+        _drive_request(rec, tokens=16)
+        wires = m.perf.wire_digests()
+        assert wires["tbt_ms"]["epochs"]
+        assert wires["queue_wait_ms"]["epochs"]

@@ -333,3 +333,84 @@ def test_wire_schema_field_numbers_agree_with_proto():
     names = {f.name for f in kv.fields.values()}
     assert {"request_id", "token_ids", "kv", "draft_kv", "temperature",
             "top_p", "stop_sequences", "source_engine"} <= names
+
+
+def _rand_telemetry(rng: random.Random) -> dict:
+    """A random FleetTelemetry frame in the canonical wire-dict form
+    (serving/teledigest.py: sorted epochs, sorted parallel arrays)."""
+    digests = []
+    for d in range(rng.randrange(0, 5)):
+        epochs = []
+        base_epoch = rng.randrange(0, 2 ** 40)
+        for k in sorted(rng.sample(range(16), rng.randrange(0, 5))):
+            buckets = sorted(rng.sample(range(300), rng.randrange(0, 6)))
+            counts = [rng.randrange(1, 2 ** 50) for _ in buckets]
+            epochs.append({
+                "index": base_epoch + k,
+                "buckets": buckets,
+                "counts": counts,
+                "n": sum(counts) + rng.randrange(0, 10),
+                "sum_us": rng.randrange(0, 2 ** 60),
+            })
+        digests.append({
+            "name": rng.choice(["ttft_ms", "tbt_ms", "step_ms.mixed",
+                                f"series_{d}"]),
+            "epoch_s": rng.choice([1.0, 5.0, 30.0]),
+            "epochs": epochs,
+        })
+    counters = [
+        {"name": f"step.engine-{i}.prefill.tokens",
+         "value": rng.random() * 1e12}
+        for i in range(rng.randrange(0, 4))
+    ]
+    return {"member_id": _rand_text(rng, 16) or "m0",
+            "digests": digests, "counters": counters}
+
+
+def test_fleet_telemetry_roundtrip_fuzz():
+    """FleetTelemetry — the heartbeat-piggybacked perf-digest frame
+    (fleet-wire kind 5, serving/teledigest.py) — survives the wire
+    field-for-field: epoch indices, bucket/count arrays, exact sums."""
+    rng = random.Random(0x7E1E)
+    for i in range(120):
+        msg = _rand_telemetry(rng)
+        got = protowire.decode("FleetTelemetry",
+                               protowire.encode("FleetTelemetry", msg))
+        assert got == msg, i
+
+
+def test_fleet_telemetry_truncation_and_unknown_fields():
+    """A telemetry frame cut mid-field is rejected (never a
+    plausible-but-wrong digest), and unknown fields skip cleanly."""
+    rng = random.Random(0x7E1F)
+    msg = _rand_telemetry(rng)
+    while not msg["digests"]:
+        msg = _rand_telemetry(rng)
+    base = protowire.encode("FleetTelemetry", msg)
+    with pytest.raises(ValueError):
+        protowire.decode("FleetTelemetry", base[: len(base) - 2])
+    unknown = protowire._key(88, 2) + bytes([3, 1, 2, 3])
+    assert protowire.decode("FleetTelemetry", unknown + base) == \
+        protowire.decode("FleetTelemetry", base)
+
+
+def test_tele_digest_wire_matches_live_digest():
+    """A live WindowedDigest's to_wire() dict IS the TeleDigest wire
+    message: encode/decode returns it unchanged (canonical sorted
+    arrays survive), so merge identity holds across the wire."""
+    from distributed_inference_server_tpu.serving.teledigest import (
+        WindowedDigest,
+        merge_digests,
+    )
+
+    rng = random.Random(0x7E20)
+    dig = WindowedDigest(epoch_s=5.0, window_s=60.0)
+    for _ in range(300):
+        dig.observe(rng.random() * 1000.0,
+                    now=1_000_000.0 + rng.random() * 40.0)
+    wire = dig.to_wire("ttft_ms")
+    got = protowire.decode("TeleDigest",
+                           protowire.encode("TeleDigest", wire))
+    assert got == wire
+    # and a wire round-trip is transparent to the merge algebra
+    assert merge_digests([got, got]) == merge_digests([wire, wire])

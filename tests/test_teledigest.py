@@ -1,0 +1,572 @@
+"""Fleet-federated performance telemetry (serving/teledigest.py;
+docs/OBSERVABILITY.md "Performance telemetry"): log-bucket layout
+determinism, the merge-identity acceptance (merging member digests is
+bit-equal to any re-grouping — fuzzed over epochs/buckets), windowed
+stats, SLO verdict derivation, the PerfTelemetry store, the
+/server/perf payload's enforced field catalog, and the metrics-layer
+integration (sliding p99, step clock, slo counters).
+
+Deterministic seeded random (no hypothesis in the image)."""
+
+from __future__ import annotations
+
+import random
+import time
+
+from distributed_inference_server_tpu.serving.metrics import (
+    MetricsCollector,
+)
+from distributed_inference_server_tpu.serving.teledigest import (
+    DIGEST_NAMES,
+    MAX_BUCKET,
+    PERF_FIELDS,
+    PerfTelemetry,
+    SloSettings,
+    TELEMETRY_METRICS,
+    WindowedDigest,
+    bucket_of,
+    bucket_value_ms,
+    build_perf_payload,
+    merge_digests,
+    slo_verdict,
+    window_stats,
+    windowed_count,
+)
+
+NOW = 1_700_000_000.0  # fixed wall-clock anchor for determinism
+
+
+# ---------------------------------------------------------------------------
+# bucket layout
+# ---------------------------------------------------------------------------
+
+
+class TestBuckets:
+    def test_monotonic_and_bounded(self):
+        prev = -1
+        for v in [0.0, 1e-6, 1e-3, 0.01, 0.5, 1.0, 7.3, 99.0, 1e4, 1e7,
+                  1e12]:
+            b = bucket_of(v)
+            assert 0 <= b <= MAX_BUCKET
+            assert b >= prev, v
+            prev = b
+
+    def test_zero_and_negative_land_in_bucket_zero(self):
+        assert bucket_of(0.0) == 0
+        assert bucket_of(-5.0) == 0
+        assert bucket_value_ms(0) == 0.0
+
+    def test_midpoint_within_relative_error(self):
+        # 8 buckets/octave: the geometric midpoint is within ~4.4% of
+        # any value that mapped into the bucket
+        rng = random.Random(7)
+        for _ in range(500):
+            v = 10 ** rng.uniform(-2.5, 6.5)
+            mid = bucket_value_ms(bucket_of(v))
+            assert abs(mid - v) / v < 0.05, v
+
+
+# ---------------------------------------------------------------------------
+# merge identity (the tentpole property)
+# ---------------------------------------------------------------------------
+
+
+def _rand_digest(rng: random.Random, epoch_s: float = 5.0,
+                 window_s: float = 60.0) -> WindowedDigest:
+    d = WindowedDigest(epoch_s=epoch_s, window_s=window_s)
+    for _ in range(rng.randrange(0, 200)):
+        # spread observations over ~6 epochs
+        d.observe(10 ** rng.uniform(-1, 4),
+                  now=NOW + rng.random() * 30.0)
+    for _ in range(rng.randrange(0, 20)):
+        d.count(rng.randrange(1, 4), now=NOW + rng.random() * 30.0)
+    return d
+
+
+class TestMergeIdentity:
+    def test_merge_is_grouping_invariant_fuzz(self):
+        """THE acceptance property: merge(all members) is bit-equal to
+        merge(merge(any partition)) — so the registry's fleet view and
+        an operator's offline re-merge of per-member digests can never
+        disagree, fuzzed over member counts, epochs, and buckets."""
+        rng = random.Random(0x5EED)
+        for trial in range(30):
+            members = [_rand_digest(rng).to_wire("ttft_ms")
+                       for _ in range(rng.randrange(1, 6))]
+            flat = merge_digests(members)
+            cut = rng.randrange(0, len(members) + 1)
+            grouped = merge_digests([
+                merge_digests(members[:cut]),
+                merge_digests(members[cut:]),
+            ])
+            assert grouped == flat, trial
+            # order invariance
+            shuffled = list(members)
+            rng.shuffle(shuffled)
+            assert merge_digests(shuffled) == flat, trial
+            # and the windowed percentiles are therefore identical
+            as_of = int((NOW + 30.0) // 5.0)
+            assert window_stats(grouped, 60.0, as_of) == \
+                window_stats(flat, 60.0, as_of), trial
+
+    def test_merge_counts_are_sums(self):
+        a = WindowedDigest(5.0, 60.0)
+        b = WindowedDigest(5.0, 60.0)
+        for _ in range(10):
+            a.observe(12.0, now=NOW)
+            b.observe(12.0, now=NOW)
+        merged = merge_digests([a.to_wire("x"), b.to_wire("x")])
+        as_of = int(NOW // 5.0)
+        assert window_stats(merged, 60.0, as_of)["count"] == 20
+
+    def test_wire_form_is_canonical(self):
+        """Equal contents produce equal dicts regardless of insertion
+        order (sorted epochs + sorted parallel arrays)."""
+        rng = random.Random(3)
+        values = [(10 ** rng.uniform(-1, 3), NOW + rng.random() * 20)
+                  for _ in range(100)]
+        d1 = WindowedDigest(5.0, 60.0)
+        for v, t in values:
+            d1.observe(v, now=t)
+        d2 = WindowedDigest(5.0, 60.0)
+        for v, t in reversed(values):
+            d2.observe(v, now=t)
+        assert d1.to_wire("s") == d2.to_wire("s")
+
+
+class TestWindowing:
+    def test_old_epochs_fall_out_of_the_window(self):
+        d = WindowedDigest(epoch_s=5.0, window_s=10.0)
+        d.observe(100.0, now=NOW)
+        d.observe(100.0, now=NOW + 100.0)  # much later epoch
+        late = int((NOW + 100.0) // 5.0)
+        assert window_stats(d.to_wire("x"), 10.0, late)["count"] == 1
+
+    def test_ring_is_bounded(self):
+        d = WindowedDigest(epoch_s=1.0, window_s=10.0)
+        for k in range(500):
+            d.observe(1.0, now=NOW + k)
+        assert len(d._epochs) <= d.ring_epochs
+
+    def test_quantiles_ordered_and_plausible(self):
+        d = WindowedDigest(5.0, 60.0)
+        for v in range(1, 101):
+            d.observe(float(v), now=NOW)
+        s = window_stats(d.to_wire("x"), 60.0, int(NOW // 5.0))
+        assert s["count"] == 100
+        assert s["p50"] <= s["p90"] <= s["p99"]
+        assert abs(s["p50"] - 50.0) / 50.0 < 0.10
+        assert abs(s["p99"] - 99.0) / 99.0 < 0.10
+        assert abs(s["mean"] - 50.5) < 0.01  # exact sums, not buckets
+
+    def test_windowed_count_only_series(self):
+        d = WindowedDigest(5.0, 60.0)
+        d.count(3, now=NOW)
+        d.count(2, now=NOW + 1.0)
+        assert windowed_count(d.to_wire("slo.ok"), 60.0,
+                              int(NOW // 5.0)) == 5
+        assert "p99" not in window_stats(d.to_wire("slo.ok"), 60.0,
+                                         int(NOW // 5.0))
+
+
+# ---------------------------------------------------------------------------
+# SLO verdicts
+# ---------------------------------------------------------------------------
+
+
+class TestSloVerdict:
+    SLO = SloSettings(ttft_ms=500.0, tbt_p99_ms=50.0,
+                      tenant_ttft_ms={"gold": 200.0})
+
+    def test_ok_within_objectives(self):
+        v = slo_verdict(self.SLO, "default", 0.3, 0.02, "ok")
+        assert v["verdict"] == "ok"
+        assert v["ttft_violated"] is False
+        assert v["tbt_violated"] is False
+
+    def test_ttft_violation(self):
+        v = slo_verdict(self.SLO, "default", 0.9, 0.02, "ok")
+        assert v["verdict"] == "violated" and v["ttft_violated"]
+
+    def test_tbt_violation(self):
+        v = slo_verdict(self.SLO, "default", 0.1, 0.2, "ok")
+        assert v["verdict"] == "violated" and v["tbt_violated"]
+
+    def test_tenant_override_wins(self):
+        # 300ms TTFT: fine globally (500), violates gold's 200
+        assert slo_verdict(self.SLO, "default", 0.3, None,
+                           "ok")["verdict"] == "ok"
+        assert slo_verdict(self.SLO, "gold", 0.3, None,
+                           "ok")["verdict"] == "violated"
+
+    def test_error_with_applicable_slo_is_violation(self):
+        v = slo_verdict(self.SLO, "default", 0.1, 0.01, "error")
+        assert v["verdict"] == "violated" and v["errored"]
+
+    def test_no_applicable_objective_no_verdict(self):
+        assert slo_verdict(SloSettings(), "default", 0.1, 0.01,
+                           "ok") is None
+
+    def test_no_first_token_violates_ttft(self):
+        v = slo_verdict(self.SLO, "default", None, None, "error")
+        assert v["verdict"] == "violated" and v["ttft_violated"]
+
+    def test_enabled(self):
+        assert self.SLO.enabled()
+        assert not SloSettings().enabled()
+        assert SloSettings(tenant_tbt_ms={"a": 1.0}).enabled()
+
+
+# ---------------------------------------------------------------------------
+# PerfTelemetry store + /server/perf payload
+# ---------------------------------------------------------------------------
+
+
+class TestPerfTelemetry:
+    def test_observe_counter_wire_stats(self):
+        p = PerfTelemetry(epoch_s=5.0, window_s=60.0)
+        p.observe("ttft_ms", 120.0)
+        p.count("slo.ok")
+        p.add_counter("step.engine-0.prefill.tokens", 64)
+        p.add_counter("step.engine-0.prefill.tokens", 36)
+        wire = p.wire()
+        assert {d["name"] for d in wire["digests"]} == {"ttft_ms",
+                                                        "slo.ok"}
+        assert wire["counters"] == [
+            {"name": "step.engine-0.prefill.tokens", "value": 100.0}
+        ]
+        assert p.stats()["ttft_ms"]["count"] == 1
+
+    def test_payload_fields_are_cataloged(self):
+        """Every top-level /server/perf key is a PERF_FIELDS entry —
+        the runtime half of distlint DL014."""
+        p = PerfTelemetry()
+        p.observe("ttft_ms", 50.0)
+        p.count("slo.violated")
+        p.add_counter("step.engine-0.decode_block.wall_s", 1.5)
+        p.add_counter("events.engine-0.preempt", 2)
+        payload = build_perf_payload(
+            p, SloSettings(ttft_ms=100.0),
+            slo_counts={"default": {"ok": 3, "violated": 1}},
+            goodput={"default": 120},
+            fleet_members={"w1": {"digests": {}, "counters": {},
+                                  "age_s": 0.2}},
+        )
+        assert set(payload) <= set(PERF_FIELDS), payload.keys()
+        assert payload["engines"]["engine-0"]["events"]["preempt"] == 2
+        assert payload["engines"]["engine-0"]["kinds"]["decode_block"][
+            "wall_s"] == 1.5
+        assert payload["slo"]["requests"]["default"]["violated"] == 1
+        assert payload["slo"]["goodput_tokens"]["default"] == 120
+        assert "w1" in payload["fleet"]["members"]
+        # burn rate counts only the windowed slo digests
+        assert payload["slo"]["window_requests"]["violated"] == 1
+        assert payload["slo"]["burn_rate"] == 1.0
+
+    def test_fleet_merge_in_payload_equals_offline_remerge(self):
+        """The two-process acceptance, in miniature: the payload's
+        fleet-merged p99 equals re-merging the payload's own member
+        digests with the local ones at the payload's as_of_epoch."""
+        host = PerfTelemetry(epoch_s=5.0, window_s=60.0)
+        member = PerfTelemetry(epoch_s=5.0, window_s=60.0)
+        rng = random.Random(11)
+        for _ in range(150):
+            host.observe("ttft_ms", 10 ** rng.uniform(0, 3))
+            member.observe("ttft_ms", 10 ** rng.uniform(0, 3))
+        member_wire = member.wire_digests()
+        payload = build_perf_payload(
+            host, None,
+            fleet_members={"w1": {"digests": member_wire,
+                                  "counters": {}, "age_s": 0.1}},
+        )
+        remerged = merge_digests(
+            [payload["digests"]["ttft_ms"],
+             payload["fleet"]["members"]["w1"]["digests"]["ttft_ms"]])
+        expect = window_stats(remerged, payload["window_s"],
+                              payload["as_of_epoch"])
+        assert payload["fleet"]["merged"]["ttft_ms"] == expect
+        assert expect["count"] == 300
+
+    def test_configure_reshapes_rings(self):
+        p = PerfTelemetry()
+        p.observe("ttft_ms", 1.0)
+        p.configure(epoch_s=1.0, window_s=10.0)
+        assert p.wire_digests() == {}
+        assert p.epoch_s == 1.0 and p.window_s == 10.0
+
+
+# ---------------------------------------------------------------------------
+# metrics-layer integration
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsIntegration:
+    def test_telemetry_metric_names_all_registered(self):
+        """TELEMETRY_METRICS (the DL014 catalog constant) matches what
+        a fresh collector actually registers."""
+        m = MetricsCollector()
+        registered = {metric.name for metric in
+                      m.registry.collect()}
+        for name in TELEMETRY_METRICS:
+            # prometheus_client strips the _total suffix on counters
+            base = (name[:-6] if name.endswith("_total") else name)
+            assert base in registered, name
+
+    def test_digest_names_fed_by_collector(self):
+        """Every DIGEST_NAMES series has a live feeding path through
+        the collector (+ flightrec for the slo counters)."""
+        m = MetricsCollector()
+        m.record_request("/generate", 200, 0.25)
+        m.record_ttft(0.05)
+        m.record_request_phases(
+            {"queue_wait": 0.01, "prefill": 0.02, "peer_fetch": 0.0,
+             "handoff_stall": 0.0, "decode": 0.1, "detok": 0.001},
+            tbt_s=0.02,
+        )
+        for kind in ("prefill", "decode_block", "mixed"):
+            m.observe_step(kind, 0.003)
+        m.record_slo("default", "ok", tokens=10)
+        m.record_slo("default", "violated")
+        assert set(m.perf.wire_digests()) == set(DIGEST_NAMES)
+
+    def test_sliding_p99_replaces_lifetime_sort(self):
+        """/server/stats p99 now reads the windowed digest: lifetime
+        history outside the window no longer shapes it."""
+        m = MetricsCollector()
+        for _ in range(50):
+            m.record_request("/generate", 200, 0.1)
+        snap = m.snapshot()
+        assert abs(snap.average_latency_ms - 100.0) < 1e-6
+        assert abs(snap.p99_latency_ms - 100.0) / 100.0 < 0.05
+        assert not hasattr(m, "_latencies_ms")
+
+    def test_step_clock_recording(self):
+        m = MetricsCollector()
+        m.record_step_clock("engine-0", "prefill", dispatches=2,
+                            wall_s=0.01, tokens=128, rows=3)
+        m.record_step_events("engine-0", {"cache_full": 1, "preempt": 0})
+        counters = m.perf.counters()
+        assert counters["step.engine-0.prefill.tokens"] == 128
+        assert counters["events.engine-0.cache_full"] == 1
+        assert "events.engine-0.preempt" not in counters
+        text = m.prometheus_text().decode()
+        assert ('engine_step_tokens_total{engine_id="engine-0",'
+                'kind="prefill"} 128.0') in text
+        assert ('engine_step_events_total{engine_id="engine-0",'
+                'event="cache_full"} 1.0') in text
+
+    def test_slo_tenant_label_set_is_bounded(self):
+        m = MetricsCollector()
+        for i in range(100):
+            m.record_slo(f"tenant-{i}", "ok", tokens=1)
+        counts, _ = m.slo_counts()
+        assert len(counts) <= 33  # cap + "other"
+        assert "other" in counts
+
+    def test_member_telemetry_gauges(self):
+        m = MetricsCollector()
+        m.record_telemetry_frame("ingested")
+        m.set_member_telemetry("w1", {"prefill": 512.0}, 42.0)
+        text = m.prometheus_text().decode()
+        assert ('fleet_member_step_tokens{kind="prefill",'
+                'member="w1"} 512.0') in text
+        assert 'fleet_member_ttft_p99_ms{member="w1"} 42.0' in text
+        assert ('fleet_telemetry_frames_total{outcome="ingested"} 1.0'
+                in text)
+
+
+# ---------------------------------------------------------------------------
+# fleet ingest (host side)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetIngest:
+    def _server(self):
+        from distributed_inference_server_tpu.serving.fleet import (
+            FleetRegistry,
+            FleetServer,
+            FleetSettings,
+        )
+
+        m = MetricsCollector()
+        settings = FleetSettings()
+        registry = FleetRegistry(settings, metrics=m)
+        return FleetServer(registry, scheduler=None, settings=settings,
+                           metrics=m), m
+
+    def test_ingest_stores_and_publishes_member_series(self):
+        srv, m = self._server()
+        dig = WindowedDigest(5.0, 60.0)
+        for _ in range(20):
+            dig.observe(30.0, now=time.time())
+        srv.ingest_telemetry({
+            "member_id": "w1",
+            "digests": [dig.to_wire("ttft_ms")],
+            "counters": [
+                {"name": "step.engine-0.prefill.tokens", "value": 64.0},
+                {"name": "step.engine-1.prefill.tokens", "value": 36.0},
+            ],
+        }, "w1")
+        snap = srv.telemetry_snapshot()
+        assert set(snap) == {"w1"}
+        assert "ttft_ms" in snap["w1"]["digests"]
+        text = m.prometheus_text().decode()
+        # per-engine counters of one kind sum into the member series
+        assert ('fleet_member_step_tokens{kind="prefill",'
+                'member="w1"} 100.0') in text
+        assert 'fleet_member_ttft_p99_ms{member="w1"}' in text
+
+    def test_last_frame_wins(self):
+        srv, _ = self._server()
+        srv.ingest_telemetry({"digests": [], "counters": [
+            {"name": "step.e.prefill.tokens", "value": 1.0}]}, "w1")
+        srv.ingest_telemetry({"digests": [], "counters": [
+            {"name": "step.e.prefill.tokens", "value": 5.0}]}, "w1")
+        snap = srv.telemetry_snapshot()
+        assert snap["w1"]["counters"]["step.e.prefill.tokens"] == 5.0
+
+    def test_anonymous_frame_dropped(self):
+        srv, _ = self._server()
+        srv.ingest_telemetry({"digests": [], "counters": []}, "")
+        assert srv.telemetry_snapshot() == {}
+
+
+class TestReviewFixes:
+    """Regressions for the review pass: foreign epoch geometry never
+    mis-merges, and pruned members' gauge series are removed."""
+
+    def test_merge_excludes_foreign_epoch_s(self):
+        a = WindowedDigest(epoch_s=5.0, window_s=60.0)
+        b = WindowedDigest(epoch_s=10.0, window_s=60.0)
+        for _ in range(4):
+            a.observe(10.0, now=NOW)
+            b.observe(10.0, now=NOW)
+        merged = merge_digests([a.to_wire("x"), b.to_wire("x")])
+        assert merged["epoch_s"] == 5.0
+        # the foreign-unit digest contributed nothing
+        assert window_stats(merged, 60.0,
+                            int(NOW // 5.0))["count"] == 4
+
+    def test_ingest_drops_foreign_epoch_digests(self):
+        srv, m = TestFleetIngest()._server()  # host perf epoch_s = 5.0
+        foreign = WindowedDigest(epoch_s=10.0, window_s=60.0)
+        native = WindowedDigest(epoch_s=5.0, window_s=60.0)
+        foreign.observe(5.0, now=time.time())
+        native.observe(5.0, now=time.time())
+        srv.ingest_telemetry({
+            "digests": [foreign.to_wire("ttft_ms"),
+                        native.to_wire("tbt_ms")],
+            "counters": [],
+        }, "w1")
+        snap = srv.telemetry_snapshot()
+        assert set(snap["w1"]["digests"]) == {"tbt_ms"}
+        text = m.prometheus_text().decode()
+        assert ('fleet_telemetry_frames_total{outcome="epoch_mismatch"}'
+                ' 1.0') in text
+
+    def test_pruned_member_gauge_series_removed(self):
+        srv, m = TestFleetIngest()._server()
+        srv.ingest_telemetry({"digests": [], "counters": [
+            {"name": "step.e.prefill.tokens", "value": 7.0}]}, "old")
+        assert 'member="old"' in m.prometheus_text().decode()
+        # age the frame past dead_after_s + dead_retention_s
+        with srv._lock:
+            srv._telemetry["old"]["at"] -= (
+                srv.settings.dead_after_s
+                + srv.settings.dead_retention_s + 1.0)
+        assert srv.telemetry_snapshot() == {}
+        text = m.prometheus_text().decode()
+        assert 'fleet_member_step_tokens{kind="prefill",member="old"' \
+            not in text
+        assert 'fleet_member_ttft_p99_ms{member="old"' not in text
+
+    def test_ingest_prunes_even_without_snapshot_polls(self):
+        srv, _ = TestFleetIngest()._server()
+        srv.ingest_telemetry({"digests": [], "counters": []}, "old")
+        with srv._lock:
+            srv._telemetry["old"]["at"] -= (
+                srv.settings.dead_after_s
+                + srv.settings.dead_retention_s + 1.0)
+        # a DIFFERENT member's ingest sweeps the stale entry
+        srv.ingest_telemetry({"digests": [], "counters": []}, "new")
+        with srv._lock:
+            assert set(srv._telemetry) == {"new"}
+
+    def test_frame_counts_exactly_one_outcome(self):
+        srv, m = TestFleetIngest()._server()
+        foreign = WindowedDigest(epoch_s=10.0, window_s=60.0)
+        foreign.observe(5.0, now=time.time())
+        srv.ingest_telemetry({"digests": [foreign.to_wire("ttft_ms")],
+                              "counters": []}, "w1")
+        srv.ingest_telemetry({"digests": [], "counters": []}, "w2")
+        text = m.prometheus_text().decode()
+        assert ('fleet_telemetry_frames_total{outcome="epoch_mismatch"}'
+                ' 1.0') in text
+        assert ('fleet_telemetry_frames_total{outcome="ingested"} 1.0'
+                in text)
+
+    def test_slo_tenant_zero_override_exempts(self):
+        """A tenant=0 override is the opt-out from a global objective
+        (parse accepts it; limits_for yields no applicable limit)."""
+        from distributed_inference_server_tpu.serving.config import (
+            ServerConfig,
+            parse_tenant_weights,
+        )
+
+        assert parse_tenant_weights("batch=0", key="slo.tenant_ttft_ms",
+                                    allow_zero=True) == {"batch": 0.0}
+        cfg = ServerConfig.load(cli_args=[
+            "--slo-ttft-ms", "500", "--slo-tenant-ttft-ms", "batch=0"])
+        slo = cfg.slo_settings()
+        assert slo.limits_for("batch") == (0.0, 0.0)
+        assert slo_verdict(slo, "batch", 99.0, None, "ok") is None
+        assert slo_verdict(slo, "default", 99.0, None,
+                           "ok")["verdict"] == "violated"
+        # the DRR weight grammar still rejects 0 (a zero weight starves)
+        import pytest
+        from distributed_inference_server_tpu.core.errors import (
+            ConfigError,
+        )
+
+        with pytest.raises(ConfigError):
+            parse_tenant_weights("a=0")
+
+    def test_warmup_compiles_do_not_count_as_retrace(self):
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_inference_server_tpu.engine.engine import (
+            EngineConfig,
+            LLMEngine,
+            SamplingParams,
+        )
+        from distributed_inference_server_tpu.engine.kv_cache import (
+            PagedCacheConfig,
+        )
+        from distributed_inference_server_tpu.models import llama
+        from distributed_inference_server_tpu.models.configs import TINY
+        from distributed_inference_server_tpu.models.tokenizer import (
+            ByteTokenizer,
+        )
+
+        params = llama.init_params(jax.random.PRNGKey(0), TINY,
+                                   dtype=jnp.float32)
+        eng = LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(max_batch=2, prefill_buckets=(16,),
+                         paged=PagedCacheConfig(num_pages=64, page_size=8,
+                                                max_pages_per_seq=8),
+                         warmup_compile=False),
+            dtype=jnp.float32,
+        )
+        eng.warmup()
+        assert eng.step_clock_stats()["events"]["retrace"] == 0
+        # a post-warmup request hitting a NEW bucket does count
+        eng.add_request("r1", [3] * 30,
+                        SamplingParams(max_tokens=4, temperature=0.0))
+        while eng.has_work():
+            eng.step()
+        # (same bucket as warmup -> 0 is fine; the invariant under test
+        # is only that warmup itself contributed nothing)
+        stats = eng.step_clock_stats()
+        assert stats["kinds"]["prefill"]["dispatches"] >= 1

@@ -16,6 +16,12 @@ frames) — then proves the control plane end to end:
    and parent under the host's root span), and
    ``GET /server/requests/<id>`` returns a timeline whose phase
    attribution sums to within 10% of the request's wall clock;
+2b. **performance telemetry** (docs/OBSERVABILITY.md "Performance
+   telemetry"): the registry host's ``GET /server/perf`` shows the
+   member's step-clock counters MOVING as it serves, its fleet-merged
+   TTFT p99 EXACTLY equals an offline re-merge of the member digests
+   fetched from each process, and ``fleet_*{member}`` series appear in
+   host ``/metrics``;
 3. **remote death**: the worker process is SIGKILLed with a zero-token
    request in flight; the request must complete via crash-safe
    redispatch on the local engine — token-identically, exactly once,
@@ -133,10 +139,12 @@ def _request(rid: str):
 
 
 def run_worker(connect: str, role: str = "",
-               member_id: str = MEMBER_ID) -> int:
+               member_id: str = MEMBER_ID, http_port: int = 0) -> int:
     """Child process: one engine + a FleetWorker joined to ``connect``;
     serves until killed. ``role`` ("decode") makes this member the
-    cross-host handoff target over its KV data channel. SIGTERM runs a
+    cross-host handoff target over its KV data channel. ``http_port``
+    > 0 serves the member's own HTTP surface there (the perf leg
+    fetches its /server/perf digests). SIGTERM runs a
     page-conservation audit and exits with its verdict — the host's
     "clean audits both sides" check."""
     _env_setup()
@@ -153,8 +161,13 @@ def run_worker(connect: str, role: str = "",
         # fleet-stitched tracing: fleet.serve/engine.infer spans ship
         # back to the registry host (docs/OBSERVABILITY.md)
         tracer=srv.tracer,
+        # performance telemetry: digests + step-clock counters ship as
+        # heartbeat-piggybacked FleetTelemetry frames
+        metrics=srv.metrics,
     )
     worker.start(connect_timeout_s=30.0)
+    if http_port:
+        _start_http(srv, port=http_port)
     print(f"fleet-smoke worker: joined {connect} (role={role or 'unified'})",
           flush=True)
 
@@ -201,8 +214,8 @@ def dump_postmortem(srv, request_id) -> None:
     print("--- end postmortem ---", file=sys.stderr, flush=True)
 
 
-def _start_http(srv):
-    """Serve the host's real HTTP app from a background event loop;
+def _start_http(srv, port: int = 0):
+    """Serve a server's real HTTP app from a background event loop;
     returns (loop, runner, port)."""
     import asyncio
 
@@ -214,14 +227,26 @@ def _start_http(srv):
     async def _up():
         runner = web.AppRunner(srv.build_app())
         await runner.setup()
-        site = web.TCPSite(runner, "127.0.0.1", 0)
+        site = web.TCPSite(runner, "127.0.0.1", port)
         await site.start()
-        port = site._server.sockets[0].getsockname()[1]
-        return runner, port
+        bound = site._server.sockets[0].getsockname()[1]
+        return runner, bound
 
     fut = asyncio.run_coroutine_threadsafe(_up(), loop)
-    runner, port = fut.result(60)
-    return loop, runner, port
+    runner, bound = fut.result(60)
+    return loop, runner, bound
+
+
+def _free_port() -> int:
+    """Pick an ephemeral port for a child's HTTP surface (bind/close:
+    a tiny race is acceptable for a smoke)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def _http_json(method: str, url: str, body=None, timeout: float = 120.0):
@@ -318,6 +343,111 @@ def _trace_leg(srv, port: int) -> Optional[str]:
         return f"timeline did not record a served request: {tl}"
     print(f"fleet-smoke: flight recorder phases sum {total:.3f}s vs "
           f"wall {wall:.3f}s OK", flush=True)
+    return None
+
+
+def _member_step_tokens(perf: dict, member: str) -> float:
+    """Total step-clock tokens the host's /server/perf reports for one
+    member (summed over its engines and dispatch kinds)."""
+    counters = (perf.get("fleet", {}).get("members", {})
+                .get(member, {}).get("counters", {}))
+    return sum(v for name, v in counters.items()
+               if name.startswith("step.") and name.endswith(".tokens"))
+
+
+def _perf_leg(srv, port: int, worker_port: int) -> Optional[str]:
+    """The performance-telemetry acceptance (docs/OBSERVABILITY.md
+    "Performance telemetry"): the registry host's /server/perf shows
+    the member's step-clock counters MOVING as it serves, its
+    fleet-merged TTFT p99 EXACTLY equals re-merging the member digests
+    fetched from each process (host + worker, one merge code path), and
+    the fleet_*{member} series are present in host /metrics. Returns a
+    violation string or None."""
+    import re
+
+    from distributed_inference_server_tpu.serving import teledigest
+
+    # -- step-clock counters present, then moving under traffic --------
+    deadline = time.monotonic() + 30.0
+    before = 0.0
+    while time.monotonic() < deadline:
+        p = _http_json("GET", f"http://127.0.0.1:{port}/server/perf")
+        before = _member_step_tokens(p, MEMBER_ID)
+        if before > 0:
+            break
+        time.sleep(0.2)
+    if before <= 0:
+        return ("host /server/perf never showed member step-clock "
+                "counters")
+    # drive one more remote request (local engine unregistered so the
+    # member must serve it), then the counters must advance
+    local = next(r for r in srv.scheduler.engines()
+                 if not getattr(r, "is_remote", False))
+    srv.scheduler.unregister(local.engine_id)
+    try:
+        _http_json("POST", f"http://127.0.0.1:{port}/generate",
+                   {"prompt": _PROMPT, "max_tokens": 8,
+                    "temperature": 0.0})
+    finally:
+        srv.scheduler.register(local)
+    deadline = time.monotonic() + 30.0
+    after = before
+    while time.monotonic() < deadline:
+        p = _http_json("GET", f"http://127.0.0.1:{port}/server/perf")
+        after = _member_step_tokens(p, MEMBER_ID)
+        if after > before:
+            break
+        time.sleep(0.2)
+    if after <= before:
+        return (f"member step-clock counters never moved "
+                f"({before} -> {after})")
+    print(f"fleet-smoke: member step-clock counters moving "
+          f"({before:.0f} -> {after:.0f} tokens) OK", flush=True)
+
+    # -- merge identity: host merged p99 == re-merge of fetched digests
+    # (idle first so the member's last shipped frame equals its live
+    # digest; retried — an observation landing mid-leg re-races it)
+    violation = "merge-identity leg never ran"
+    for _attempt in range(5):
+        time.sleep(1.0)  # ~5 heartbeat intervals of idle
+        host_perf = _http_json("GET",
+                               f"http://127.0.0.1:{port}/server/perf")
+        member_perf = _http_json(
+            "GET", f"http://127.0.0.1:{worker_port}/server/perf")
+        merged_reported = (host_perf.get("fleet", {})
+                           .get("merged", {}).get("ttft_ms"))
+        member_ttft = member_perf.get("digests", {}).get("ttft_ms")
+        host_ttft = host_perf.get("digests", {}).get("ttft_ms")
+        if not merged_reported or not member_ttft or not host_ttft:
+            violation = (f"missing ttft digests: merged="
+                         f"{merged_reported} member={bool(member_ttft)} "
+                         f"host={bool(host_ttft)}")
+            continue
+        remerged = teledigest.merge_digests([host_ttft, member_ttft])
+        expect = teledigest.window_stats(
+            remerged, host_perf["window_s"], host_perf["as_of_epoch"])
+        if expect == merged_reported:
+            violation = None
+            break
+        violation = (f"fleet-merged ttft p99 != re-merge of member "
+                     f"digests: reported={merged_reported} "
+                     f"remerged={expect}")
+    if violation is not None:
+        return violation
+    print(f"fleet-smoke: fleet-merged TTFT p99 "
+          f"{merged_reported.get('p99', 0):.2f}ms == offline re-merge "
+          "(bit-equal) OK", flush=True)
+
+    # -- fleet_*{member} series in host /metrics -----------------------
+    prom = srv.metrics.prometheus_text().decode()
+    if not re.search(
+            r'fleet_member_step_tokens\{.*member="' + MEMBER_ID + '"',
+            prom):
+        return "fleet_member_step_tokens{member=...} missing in /metrics"
+    if ('fleet_member_ttft_p99_ms{member="' + MEMBER_ID + '"') not in prom:
+        return "fleet_member_ttft_p99_ms{member=...} missing in /metrics"
+    print("fleet-smoke: fleet_*{member} series present in /metrics OK",
+          flush=True)
     return None
 
 
@@ -421,9 +551,13 @@ def run_host() -> int:
     port = srv.fleet_server.bound_port
     print(f"fleet-smoke host: registry on 127.0.0.1:{port}", flush=True)
 
+    # the worker serves its own HTTP surface too: the perf leg fetches
+    # its /server/perf digests for the merge-identity acceptance
+    worker_http_port = _free_port()
     child = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--worker",
-         "--connect", f"127.0.0.1:{port}"],
+         "--connect", f"127.0.0.1:{port}",
+         "--http-port", str(worker_http_port)],
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     try:
@@ -467,6 +601,11 @@ def run_host() -> int:
         # -- 2. stitched trace + flight recorder over real HTTP ---------
         _loop, _http_runner, http_port = _start_http(srv)
         violation = _trace_leg(srv, http_port)
+        if violation is not None:
+            return _fail(violation)
+
+        # -- 2.2 performance telemetry: step clock + merge identity -----
+        violation = _perf_leg(srv, http_port, worker_http_port)
         if violation is not None:
             return _fail(violation)
 
@@ -556,10 +695,15 @@ def main() -> int:
                     "makes it a cross-host handoff target)")
     ap.add_argument("--member-id", default=MEMBER_ID,
                     help="worker member identity")
+    ap.add_argument("--http-port", type=int, default=0,
+                    help="worker mode: serve the member's HTTP surface "
+                    "on this port (0 = none; the perf leg fetches its "
+                    "/server/perf)")
     args = ap.parse_args()
     if args.worker:
         return run_worker(args.connect, role=args.role,
-                          member_id=args.member_id)
+                          member_id=args.member_id,
+                          http_port=args.http_port)
     return run_host()
 
 

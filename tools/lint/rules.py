@@ -1,4 +1,4 @@
-"""distlint rules DL001-DL013 (catalog + rationale: docs/LINTS.md).
+"""distlint rules DL001-DL014 (catalog + rationale: docs/LINTS.md).
 
 Each rule targets a failure class this codebase has actually hit or is
 structurally exposed to: blocking calls on the serving spine, unlocked
@@ -1468,4 +1468,159 @@ class DL012(Rule):
                                 f"{m.group(2)} names unknown key "
                                 f"{sec}.{key}",
                             ))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DL014 — performance-telemetry catalog drift
+# ---------------------------------------------------------------------------
+
+# catalog rows in docs/OBSERVABILITY.md "Performance telemetry":
+# | `name` | perf-field | ... |  /  | `name` | metric | ... |  /
+# | `name` | digest | ... |
+_PERF_CATALOG_ROW_RE = re.compile(
+    r"^\|\s*`([a-z0-9_.{}<>]+)`\s*\|\s*(perf-field|metric|digest)\s*\|")
+
+
+@register
+class DL014(Rule):
+    """Performance-telemetry catalog drift: the ``GET /server/perf``
+    top-level fields, the telemetry metric names, and the digest series
+    names are declared once in ``serving/teledigest.py`` (PERF_FIELDS /
+    TELEMETRY_METRICS / DIGEST_NAMES — the constants the endpoint and
+    tests are built against) and documented in the
+    docs/OBSERVABILITY.md "Performance telemetry" tables. Both
+    directions are enforced, like DL011's dual catalogs: a name in code
+    but not in the docs is undocumented telemetry; a docs row with no
+    code constant is a dead catalog entry. Every TELEMETRY_METRICS name
+    must additionally be registered by a metric factory call in
+    serving/metrics.py — a cataloged metric nobody registers is
+    documentation describing a series that can never exist."""
+
+    name = "DL014"
+    title = "perf-telemetry catalog drift vs docs/OBSERVABILITY.md"
+    severity = "P1"
+    scope = "project"
+
+    DOCS = "docs/OBSERVABILITY.md"
+    TELEDIGEST_PATH = (
+        "distributed_inference_server_tpu/serving/teledigest.py"
+    )
+    METRICS_PATH = "distributed_inference_server_tpu/serving/metrics.py"
+    #: constant name -> catalog kind column
+    CONSTS = {
+        "PERF_FIELDS": "perf-field",
+        "TELEMETRY_METRICS": "metric",
+        "DIGEST_NAMES": "digest",
+    }
+
+    @staticmethod
+    def _module_consts(mod: Module) -> Dict[str, Tuple[List[str], int]]:
+        """{const_name: ([entries...], lineno)} for tuple/list string
+        constants assigned at module level."""
+        out: Dict[str, Tuple[List[str], int]] = {}
+        for node in mod.tree.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            value = node.value
+            if not names or not isinstance(value, (ast.Tuple, ast.List)):
+                continue
+            entries = [e.value for e in value.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, str)]
+            for name in names:
+                out[name] = (entries, node.lineno)
+        return out
+
+    @staticmethod
+    def _registered_metric_names(mod: Module) -> Set[str]:
+        """Prometheus metric names registered anywhere in metrics.py
+        (first string arg of a Counter/Gauge/Histogram/Summary call)."""
+        names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func).rsplit(".", 1)[-1]
+            if fname not in _METRIC_FACTORIES:
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                names.add(node.args[0].value)
+        return names
+
+    @staticmethod
+    def _parse_catalog(path: Path) -> Dict[str, Tuple[str, int, str]]:
+        out: Dict[str, Tuple[str, int, str]] = {}
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            m = _PERF_CATALOG_ROW_RE.match(line)
+            if m:
+                out[m.group(1)] = (m.group(2), i, line.strip())
+        return out
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        tmod = next((m for m in modules
+                     if m.path == self.TELEDIGEST_PATH), None)
+        docs_path = root / self.DOCS
+        if tmod is None or not docs_path.exists():
+            return []  # nothing to drift (fixture roots)
+        consts = self._module_consts(tmod)
+        catalog = self._parse_catalog(docs_path)
+        findings: List[Finding] = []
+
+        def anchor(lineno: int) -> ast.AST:
+            node = ast.Constant(value=0)
+            node.lineno = lineno
+            return node
+
+        code_names: Dict[str, str] = {}
+        for const, kind in self.CONSTS.items():
+            entries, lineno = consts.get(const, ([], 1))
+            for name in entries:
+                code_names[name] = kind
+                row = catalog.get(name)
+                if row is None:
+                    findings.append(self.finding(
+                        tmod, anchor(lineno),
+                        f"telemetry name {name!r} ({const}) is not in "
+                        f"the {self.DOCS} \"Performance telemetry\" "
+                        f"catalog — add a | `{name}` | {kind} | row or "
+                        "drop the constant entry",
+                    ))
+                elif row[0] != kind:
+                    findings.append(self.finding(
+                        tmod, anchor(lineno),
+                        f"telemetry name {name!r} is cataloged as kind "
+                        f"{row[0]!r} but declared in {const} "
+                        f"(kind {kind!r}) — the catalogs disagree",
+                    ))
+        for name, (kind, lineno, text) in sorted(catalog.items()):
+            if name not in code_names:
+                findings.append(Finding(
+                    rule=self.name, path=self.DOCS, line=lineno,
+                    message=f"cataloged {kind} name {name!r} is not "
+                            "declared in serving/teledigest.py "
+                            f"({', '.join(sorted(self.CONSTS))}) — dead "
+                            "catalog entry or a lost declaration",
+                    severity=self.severity, context="perf catalog",
+                    line_text=text,
+                ))
+
+        mmod = next((m for m in modules if m.path == self.METRICS_PATH),
+                    None)
+        if mmod is not None:
+            registered = self._registered_metric_names(mmod)
+            entries, lineno = consts.get("TELEMETRY_METRICS", ([], 1))
+            for name in entries:
+                if name not in registered:
+                    findings.append(self.finding(
+                        tmod, anchor(lineno),
+                        f"telemetry metric {name!r} is declared in "
+                        "TELEMETRY_METRICS but never registered in "
+                        "serving/metrics.py — the documented series "
+                        "can never exist",
+                    ))
         return findings
