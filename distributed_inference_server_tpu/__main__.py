@@ -270,6 +270,11 @@ def main(argv=None) -> int:
             # SLO / performance telemetry (docs/OBSERVABILITY.md
             # "Performance telemetry"): verdicts + /server/perf windows
             slo_settings=cfg.slo_settings(),
+            # gray-failure defense (docs/RESILIENCE.md "Gray failures
+            # and overload"): latency-scored health + circuit breakers
+            # + deadline-aware admission + the shared retry budget
+            health_settings=cfg.health_settings(),
+            admission_settings=cfg.admission_settings(),
         )
         server.start()
     except (ModelLoadError, RuntimeError, TimeoutError) as e:

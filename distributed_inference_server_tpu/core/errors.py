@@ -153,6 +153,31 @@ class QueueFullApiError(ApiError):
         return "queue_full"
 
 
+class AdmissionShedApiError(ApiError):
+    """HTTP 503 / rate_limit_error with a ``Retry-After`` hint and the
+    DISTINCT ``admission_shed`` code: deadline-aware admission control
+    (serving/health.py) decided the request's queue-wait estimate
+    already blows its SLO-derived deadline — "the fleet declined you in
+    microseconds, retry after the backlog drains" is actionable in a
+    way the generic ``queue_full`` backpressure is not."""
+
+    def __init__(self, retry_after_s: float = 1.0):
+        super().__init__(
+            "Request shed at admission: the current queue-wait estimate "
+            "exceeds this request's latency deadline"
+        )
+        self.retry_after_s = max(1.0, retry_after_s)
+
+    def status_code(self) -> int:
+        return 503
+
+    def error_type(self) -> str:
+        return "rate_limit_error"
+
+    def code(self) -> str:
+        return "admission_shed"
+
+
 class RequestTimeoutApiError(ApiError):
     """HTTP 408 / timeout_error (error.rs:43,53)."""
 

@@ -34,8 +34,16 @@ from distributed_inference_server_tpu.serving.streamer import SSE_DONE, sse_enco
 
 def _error_response(err: ApiError) -> web.Response:
     body = ErrorResponse.of(str(err), err.error_type(), err.code())
+    headers = None
+    retry_after = getattr(err, "retry_after_s", None)
+    if retry_after is not None:
+        # deadline-aware admission shed (serving/health.py): the
+        # standard backoff hint rides the 503 so well-behaved clients
+        # retry after the backlog drains instead of hammering it
+        headers = {"Retry-After": str(int(max(1, round(retry_after))))}
     return web.json_response(
-        body.to_dict(), status=err.status_code(), dumps=json.dumps
+        body.to_dict(), status=err.status_code(), dumps=json.dumps,
+        headers=headers,
     )
 
 
@@ -46,6 +54,7 @@ def build_app(
     scale_fn=None,
     fleet_fn=None,
     perf_fn=None,
+    health_fn=None,
 ) -> web.Application:
     """``swap_fn(model_name) -> (ok, error)`` enables the admin model-swap
     endpoint (Req 13.1: admin-API-triggered); ``scale_fn(n) -> (ok,
@@ -55,7 +64,10 @@ def build_app(
     block (members, role map, rebalance history; serving/fleet.py) to
     ``/server/stats``. ``perf_fn() -> dict`` serves ``GET /server/perf``
     (per-engine step clock, windowed percentiles, SLO burn, and the
-    fleet-merged digest view; docs/OBSERVABILITY.md)."""
+    fleet-merged digest view; docs/OBSERVABILITY.md). ``health_fn() ->
+    dict`` adds the gray-failure ``health`` block (per-engine scored
+    state, breaker states, retry budget, admission estimator;
+    serving/health.py) to ``/server/stats``."""
     app = web.Application()
     app["handler"] = handler
     app["metrics"] = metrics
@@ -527,6 +539,11 @@ def build_app(
             out = metrics.snapshot(statuses).to_dict()
         if fleet_fn is not None:
             out["fleet"] = fleet_fn()
+        if health_fn is not None:
+            # gray-failure block (serving/health.py): scored per-engine
+            # states, data-channel breaker states, the shared retry
+            # budget, and the admission estimator
+            out["health"] = health_fn()
         recorder = getattr(handler, "recorder", None)
         tracer = getattr(handler, "tracer", None)
         if recorder is not None or tracer is not None:

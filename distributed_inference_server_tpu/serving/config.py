@@ -265,6 +265,67 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "kv_max_streams": (int, 4),
         "kv_connect_timeout_s": (float, 5.0),
     },
+    "health": {
+        # gray-failure defense (serving/health.py HealthScorer;
+        # docs/RESILIENCE.md "Gray failures and overload"): a periodic
+        # scorer demotes engines healthy -> degraded -> ejected on
+        # telemetry evidence (step-clock wedge, windowed p99 far above
+        # the fleet median, repeated wire failures) with two-sided
+        # hysteresis; routing deprioritizes degraded replicas and
+        # excludes ejected ones while any alternative exists.
+        "enabled": (bool, True),
+        "interval_s": (float, 1.0),
+        # wedge: no step-clock dispatch progress while work is queued
+        # for this long (armed only after the engine dispatched once)
+        "stall_s": (float, 5.0),
+        # latency demotion band: bad above latency_ratio x the median
+        # of the other sources' p99s, clean below recover_ratio x it
+        "latency_ratio": (float, 3.0),
+        "recover_ratio": (float, 1.5),
+        # consecutive bad/clean evaluations to move one level down/up
+        "demote_after": (int, 3),
+        "recover_after": (int, 3),
+        # windowed samples required before a latency verdict is trusted
+        "min_window_requests": (int, 8),
+        # consecutive wire failures ejecting a member's engines (also
+        # the KV data channel breaker's closed -> open threshold)
+        "wire_failures": (int, 3),
+        # breaker open -> half-open probe delay
+        "breaker_open_s": (float, 5.0),
+        # shared retry budget (redispatch / handoff retry / kv
+        # reconnect): retries per window as a fraction of admits,
+        # floored at retry_budget_min
+        "retry_budget_ratio": (float, 0.1),
+        "retry_budget_min": (int, 3),
+        "retry_window_s": (float, 10.0),
+        # SLO burn-rate escalation input to the degradation ladder
+        # (serving/degradation.py): burn >= slo_burn_high escalates to
+        # REJECT_LOW_PRIORITY (>= half of it to REDUCED_BATCH_SIZE)
+        # once the window holds slo_burn_min_requests verdicts
+        "slo_burn_high": (float, 0.5),
+        "slo_burn_min_requests": (int, 20),
+    },
+    "admission": {
+        # deadline-aware admission shedding (serving/health.py
+        # AdmissionControl): requests shed AT ADMISSION — 503 +
+        # Retry-After + the distinct admission_shed code — when the
+        # windowed queue-wait estimate already blows their deadline,
+        # instead of queueing doomed work toward queue_timeout.
+        "shed_enabled": (bool, True),
+        # explicit deadline (ms); 0 = derive from the applicable
+        # (per-tenant) slo.ttft_ms objective
+        "deadline_ms": (float, 0.0),
+        # deadline = deadline_factor x the applicable TTFT objective
+        "deadline_factor": (float, 1.0),
+        # brownout ordering on the DRR weights (queue.tenant_weights):
+        # tenant weight w sheds at estimate > deadline * w / w_max, so
+        # the lowest-weight tenants brown out first
+        "brownout": (bool, True),
+        # cold-estimator guard: no shedding until the window holds this
+        # many queue-wait samples
+        "min_window_requests": (int, 8),
+        "retry_after_cap_s": (float, 30.0),
+    },
     "slo": {
         # SLO / goodput accounting (serving/teledigest.py SloSettings;
         # docs/OBSERVABILITY.md "Performance telemetry"): request-level
@@ -576,6 +637,49 @@ class ServerConfig:
             epoch_s=s["epoch_s"],
         )
 
+    def health_settings(self):
+        """Gray-failure defense knobs (serving/health.py
+        HealthSettings; docs/RESILIENCE.md)."""
+        from distributed_inference_server_tpu.serving.health import (
+            HealthSettings,
+        )
+
+        h = self.raw["health"]
+        return HealthSettings(
+            enabled=h["enabled"],
+            interval_s=h["interval_s"],
+            stall_s=h["stall_s"],
+            latency_ratio=h["latency_ratio"],
+            recover_ratio=h["recover_ratio"],
+            demote_after=h["demote_after"],
+            recover_after=h["recover_after"],
+            min_window_requests=h["min_window_requests"],
+            wire_failures=h["wire_failures"],
+            breaker_open_s=h["breaker_open_s"],
+            retry_budget_ratio=h["retry_budget_ratio"],
+            retry_budget_min=h["retry_budget_min"],
+            retry_window_s=h["retry_window_s"],
+            slo_burn_high=h["slo_burn_high"],
+            slo_burn_min_requests=h["slo_burn_min_requests"],
+        )
+
+    def admission_settings(self):
+        """Deadline-aware admission knobs (serving/health.py
+        AdmissionSettings)."""
+        from distributed_inference_server_tpu.serving.health import (
+            AdmissionSettings,
+        )
+
+        a = self.raw["admission"]
+        return AdmissionSettings(
+            shed_enabled=a["shed_enabled"],
+            deadline_ms=a["deadline_ms"],
+            deadline_factor=a["deadline_factor"],
+            brownout=a["brownout"],
+            min_window_requests=a["min_window_requests"],
+            retry_after_cap_s=a["retry_after_cap_s"],
+        )
+
     def fetch_costs(self):
         """cache_aware three-way cost-model weights (fleet prefix
         sharing, serving/scheduler.py plan_route)."""
@@ -740,6 +844,44 @@ class ServerConfig:
                 "slo.window_s must be >= slo.epoch_s (the window is a "
                 "whole number of epochs)"
             )
+        # gray-failure defense (serving/health.py)
+        h = r["health"]
+        for key in ("interval_s", "stall_s", "breaker_open_s",
+                    "retry_window_s"):
+            if h[key] <= 0:
+                raise ConfigError(f"health.{key} must be positive")
+        for key in ("demote_after", "recover_after", "wire_failures",
+                    "retry_budget_min", "min_window_requests",
+                    "slo_burn_min_requests"):
+            if h[key] < 1:
+                raise ConfigError(f"health.{key} must be >= 1")
+        if h["recover_ratio"] <= 1.0:
+            raise ConfigError("health.recover_ratio must exceed 1.0")
+        if h["latency_ratio"] <= h["recover_ratio"]:
+            raise ConfigError(
+                "health.latency_ratio must exceed health.recover_ratio "
+                "(the two-sided hysteresis band)"
+            )
+        if not (0.0 <= h["retry_budget_ratio"] <= 1.0):
+            raise ConfigError(
+                "health.retry_budget_ratio must be in [0, 1]"
+            )
+        if not (0.0 < h["slo_burn_high"] <= 1.0):
+            raise ConfigError("health.slo_burn_high must be in (0, 1]")
+        a = r["admission"]
+        if a["deadline_ms"] < 0:
+            raise ConfigError(
+                "admission.deadline_ms must be >= 0 (0 = derive from "
+                "the TTFT SLO)"
+            )
+        if a["deadline_factor"] <= 0:
+            raise ConfigError("admission.deadline_factor must be positive")
+        if a["min_window_requests"] < 1:
+            raise ConfigError(
+                "admission.min_window_requests must be >= 1"
+            )
+        if a["retry_after_cap_s"] < 1:
+            raise ConfigError("admission.retry_after_cap_s must be >= 1")
         # fleet control plane (serving/fleet.py)
         f = r["fleet"]
         if f["heartbeat_interval_s"] <= 0:

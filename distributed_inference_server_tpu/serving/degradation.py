@@ -58,9 +58,27 @@ class DegradationController:
         scheduler: AdaptiveScheduler,
         check_interval_s: float = 0.5,
         evict_target_frac: float = 0.70,
+        metrics=None,
+        burn_high: float = 0.5,
+        burn_min_requests: int = 20,
     ):
+        """``metrics``/``burn_high``/``burn_min_requests`` arm the SLO
+        burn rate as a second escalation input alongside memory
+        pressure (serving/health.py settings ``health.slo_burn_high`` /
+        ``health.slo_burn_min_requests``; docs/RESILIENCE.md "Gray
+        failures and overload"): once the trailing window holds
+        ``burn_min_requests`` SLO verdicts, a burn rate at or above
+        ``burn_high`` escalates the ladder to at least
+        REJECT_LOW_PRIORITY (at or above half of it, to at least
+        REDUCED_BATCH_SIZE) — a fleet burning its latency objective
+        sheds load even while memory looks fine. The rung lifts as the
+        windowed verdicts decay. None = memory-only (the pre-gray
+        behavior exactly)."""
         self.dispatcher = dispatcher
         self.scheduler = scheduler
+        self.metrics = metrics
+        self.burn_high = burn_high
+        self.burn_min_requests = burn_min_requests
         self.level = DegradationLevel.NORMAL
         self._interval = check_interval_s
         self._evict_target = evict_target_frac
@@ -83,16 +101,55 @@ class DegradationController:
                 worst = max(worst, live / status.memory_total_pages)
         return worst
 
+    def slo_burn_rate(self) -> Optional[float]:
+        """Windowed SLO burn rate (violated / total) from the slo.ok /
+        slo.violated count digests (serving/teledigest.py), or None
+        while the window holds fewer than ``burn_min_requests``
+        verdicts — a handful of early violations must not slam the
+        ladder."""
+        if self.metrics is None:
+            return None
+        from distributed_inference_server_tpu.serving.teledigest import (
+            windowed_count,
+        )
+
+        perf = self.metrics.perf_store()
+        ok = windowed_count(perf.wire_digest("slo.ok"), perf.window_s)
+        bad = windowed_count(perf.wire_digest("slo.violated"),
+                             perf.window_s)
+        total = ok + bad
+        if total < self.burn_min_requests:
+            return None
+        return bad / total
+
+    def level_for_burn(self, burn: Optional[float]) -> DegradationLevel:
+        """SLO-burn escalation floor: >= burn_high ->
+        REJECT_LOW_PRIORITY, >= burn_high/2 -> REDUCED_BATCH_SIZE.
+        Burn alone never reaches EMERGENCY — a latency fire sheds load,
+        only a memory fire turns everyone away."""
+        if burn is None:
+            return DegradationLevel.NORMAL
+        if burn >= self.burn_high:
+            return DegradationLevel.REJECT_LOW_PRIORITY
+        if burn >= self.burn_high / 2.0:
+            return DegradationLevel.REDUCED_BATCH_SIZE
+        return DegradationLevel.NORMAL
+
     # -- evaluation --------------------------------------------------------
 
     def evaluate(self, pressure: Optional[float] = None) -> DegradationLevel:
-        """One ladder evaluation; applies side effects on level change."""
+        """One ladder evaluation; applies side effects on level change.
+        The level is the MAX of the memory rung and the SLO-burn rung
+        (each lifts independently as its signal decays)."""
         pressure = self.memory_pressure() if pressure is None else pressure
-        new = level_for_pressure(pressure)
+        burn = self.slo_burn_rate()
+        new = max(level_for_pressure(pressure), self.level_for_burn(burn))
         if new != self.level:
             logger.warning(
-                "degradation level %s -> %s (memory pressure %.2f)",
+                "degradation level %s -> %s (memory pressure %.2f, "
+                "slo burn %s)",
                 self.level.name, new.name, pressure,
+                f"{burn:.2f}" if burn is not None else "n/a",
             )
             self._apply(self.level, new)
             self.level = new

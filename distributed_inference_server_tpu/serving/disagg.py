@@ -480,6 +480,12 @@ class DisaggController:
         self.recorder = recorder
         self.channel = channel or InProcessChannel()
         self.settings = settings or DisaggSettings()
+        # shared retry budget (serving/health.py RetryBudget), wired by
+        # the server: retry attempts beyond the first draw from it, so
+        # a sick decode fleet cannot turn every migration into retry
+        # amplification (exhaustion = straight to the in-place
+        # fallback). Single-writer at boot  # distlint: ignore[DL008]
+        self.retry_budget = None
         self._jobs: Deque[_MigrationJob] = deque()
         self._cv = threading.Condition()
         # requests between dequeue and resume-submit: counted by
@@ -917,6 +923,13 @@ class DisaggController:
         max_attempts = 1 + max(0, self.settings.handoff_retries)
         while job.attempts < max_attempts and time.monotonic() < job.deadline:
             if job.attempts:
+                if (self.retry_budget is not None
+                        and not self.retry_budget.acquire("handoff_retry")):
+                    # the shared retry budget is dry (serving/health.py):
+                    # skip the retry and fall back to decoding in place
+                    # now — exactly-once either way
+                    last_err = "handoff retry budget exhausted"
+                    break
                 # exponential backoff between attempts, bounded by the
                 # deadline: a decode replica mid-restart gets a real
                 # chance to come back before the in-place fallback

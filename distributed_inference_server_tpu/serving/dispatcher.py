@@ -105,6 +105,8 @@ class Dispatcher:
         max_redispatch: int = 2,
         prefix_fetcher=None,
         recorder=None,
+        admission=None,
+        retry_budget=None,
     ):
         """``disagg``: the DisaggController when the topology is
         disaggregated (serving/disagg.py) — its migration queue counts
@@ -120,12 +122,19 @@ class Dispatcher:
         degrade to plain submission.
         ``recorder``: the per-request FlightRecorder
         (serving/flightrec.py) — routing decisions, redispatch hops, and
-        queue expiries land in request timelines. None = disabled."""
+        queue expiries land in request timelines. None = disabled.
+        ``admission``: the health.AdmissionControl driving deadline-
+        aware shedding at submit (docs/RESILIENCE.md "Gray failures and
+        overload"); None = no shedding. ``retry_budget``: the shared
+        health.RetryBudget — admits feed its window, and redispatch
+        draws from it before amplifying load; None = unbudgeted."""
         self.scheduler = scheduler
         self.disagg = disagg
         self.prefix_fetcher = prefix_fetcher
         self.tracer = tracer
         self.recorder = recorder
+        self.admission = admission
+        self.retry_budget = retry_budget
         self.max_redispatch = max_redispatch
         self.queue: PriorityQueueManager[ServerRequest] = _make_queue(
             queue_config, native_queue
@@ -196,15 +205,40 @@ class Dispatcher:
     def submit(self, request: ServerRequest,
                priority: Priority = Priority.NORMAL) -> None:
         """Enqueue; raises QueueFull → 503 when backpressure is active or
-        the server is draining."""
+        the server is draining, and its AdmissionShed subclass → 503 +
+        Retry-After when deadline-aware admission sheds the request
+        (serving/health.py; docs/RESILIENCE.md "Gray failures and
+        overload") — failing fast instead of queueing work the windowed
+        queue-wait estimate says is already doomed to queue_timeout."""
         if not self._accepting or self.reject_all:
             raise QueueFull()
         if self.reject_low_priority and priority is Priority.LOW:
             raise QueueFull()
+        tenant = getattr(request, "tenant", "") or "default"
+        if self.admission is not None:
+            shed = self.admission.check(tenant)
+            if shed is not None:
+                if self.metrics:
+                    self.metrics.record_shed(tenant, shed.reason)
+                if self.recorder is not None:
+                    # the shed IS the request's whole timeline: one
+                    # structured event with the decision's inputs, then
+                    # the distinct terminal code
+                    self.recorder.note(
+                        request.request_id, "admission_shed",
+                        tenant=tenant, reason=shed.reason,
+                        estimate_ms=round(shed.estimate_ms, 3),
+                        deadline_ms=round(shed.deadline_ms, 3),
+                        retry_after_s=shed.retry_after_s,
+                    )
+                    self.recorder.finish(request.request_id, "error",
+                                         code="admission_shed")
+                raise shed
+        if self.retry_budget is not None:
+            self.retry_budget.note_admit()
         self.queue.enqueue(
             QueuedRequest(id=request.request_id, data=request,
-                          priority=priority,
-                          tenant=getattr(request, "tenant", "") or "default")
+                          priority=priority, tenant=tenant)
         )
         if self.metrics:
             d = self.queue.queue_depth()
@@ -233,6 +267,14 @@ class Dispatcher:
         if not self._accepting:
             return False  # draining: the crash error is the truth
         if request.redispatches >= self.max_redispatch:
+            if self.metrics:
+                self.metrics.record_redispatch("exhausted")
+            return False
+        if (self.retry_budget is not None
+                and not self.retry_budget.acquire("redispatch")):
+            # the shared retry budget is dry (serving/health.py): a
+            # sick fleet must not amplify its own load — degrade to the
+            # caller's exactly-once sink failure instead of re-running
             if self.metrics:
                 self.metrics.record_redispatch("exhausted")
             return False

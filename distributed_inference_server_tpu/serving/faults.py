@@ -75,6 +75,19 @@ Point catalog (the authoritative list lives in docs/RESILIENCE.md):
                         hit per KvChunk frame either direction; ``nth``
                         tears the stream at its Nth chunk) — same
                         exactly-once degradation, zero page leak
+``fleet.slow_member``   delay-style (pair with ``delay_ms``): a fleet
+                        member serves SLOWLY while heartbeating
+                        healthily — the gray-failure model. Fired on
+                        the member's serve path after the request's
+                        arrival clock starts, so the member's own TTFT
+                        telemetry carries the slowness the host's
+                        HealthScorer demotes it on
+``fleet.wire_timeout``  a send on the fleet control wire
+                        (RemoteRunner.submit) or the KV data wire
+                        (KvDataChannel wire worker) wedges/times out —
+                        repeated hits are the scorer's wire-failure
+                        eject evidence and walk the data channel's
+                        circuit breaker closed → open
 ======================  ====================================================
 """
 

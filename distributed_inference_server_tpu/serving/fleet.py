@@ -640,12 +640,22 @@ class FleetServer:
         redispatch: Optional[Callable] = None,
         tracer=None,
         recorder=None,
+        health_settings=None,
+        retry_budget=None,
     ):
         """``tracer``: the host Tracer — remote members' FleetSpans
         frames merge into it (one stitched cross-process trace per
         request, docs/OBSERVABILITY.md). ``recorder``: the host
         FlightRecorder — RemoteRunner proxies note token/terminal
-        events into per-request timelines."""
+        events into per-request timelines. ``health_settings``
+        (serving/health.py HealthSettings) shapes each member data
+        channel's circuit breaker; ``retry_budget`` (health.RetryBudget)
+        budgets its reconnect attempts (docs/RESILIENCE.md "Gray
+        failures and overload")."""
+        from distributed_inference_server_tpu.serving.health import (
+            HealthSettings,
+        )
+
         self.registry = registry
         self.scheduler = scheduler
         self.settings = settings or FleetSettings()
@@ -653,6 +663,8 @@ class FleetServer:
         self.redispatch = redispatch
         self.tracer = tracer
         self.recorder = recorder
+        self.health_settings = health_settings or HealthSettings()
+        self.retry_budget = retry_budget
         # monotonic <-> epoch re-basing for ingested remote spans
         self._epoch_offset_ns = time.time_ns() - time.monotonic_ns()
         self._sessions: List[_MemberSession] = []
@@ -912,6 +924,11 @@ class FleetServer:
                     on_event=session._on_event,
                     on_lost_requests=lambda rids, reason,
                     s=session: self._fail_kv_requests(s, rids, reason),
+                    # gray-failure defense (serving/health.py): the
+                    # wire's circuit breaker + budgeted reconnects
+                    breaker_threshold=self.health_settings.wire_failures,
+                    breaker_open_s=self.health_settings.breaker_open_s,
+                    retry_budget=self.retry_budget,
                 )
             for runner in session.runners.values():
                 runner.kv_channel = session.kv_channel

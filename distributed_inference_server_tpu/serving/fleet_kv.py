@@ -240,11 +240,26 @@ class KvDataChannel:
         metrics: Optional[MetricsCollector] = None,
         on_event: Optional[Callable[[Dict[str, Any]], None]] = None,
         on_lost_requests: Optional[Callable[[List[str], str], None]] = None,
+        breaker_threshold: int = 3,
+        breaker_open_s: float = 5.0,
+        retry_budget=None,
     ):
         """``on_event(obj)`` receives FleetEvent frames (decode tokens
         of migrated requests) on the reader thread. ``on_lost_requests``
         fires when the connection dies with migrated requests still
-        streaming — the caller fails them fast (engine_crashed)."""
+        streaming — the caller fails them fast (engine_crashed).
+        ``breaker_threshold``/``breaker_open_s`` (serving/health.py
+        CircuitBreaker; config ``health.wire_failures`` /
+        ``health.breaker_open_s``): consecutive wire failures open the
+        breaker — new streams fail fast and handoff/fetch election
+        skips this member (``wire_available``) until a half-open probe
+        succeeds. ``retry_budget`` (health.RetryBudget): reconnects
+        after a failure draw from the shared budget, so a fleet of
+        broken wires cannot amplify dial load."""
+        from distributed_inference_server_tpu.serving.health import (
+            CircuitBreaker,
+        )
+
         self.member_id = member_id
         self.address = (host, port)
         self.max_streams = max(1, max_streams)
@@ -252,6 +267,16 @@ class KvDataChannel:
         self.metrics = metrics
         self.on_event = on_event
         self.on_lost_requests = on_lost_requests
+        self.retry_budget = retry_budget
+        self.breaker = CircuitBreaker(
+            threshold=breaker_threshold, open_s=breaker_open_s,
+            on_transition=(metrics.record_breaker_transition
+                           if metrics is not None else None),
+        )
+        # a failed dial/send happened since the last good connect: the
+        # NEXT dial is a retry and must draw from the shared budget.
+        # GIL-atomic bool, wire-worker-owned  # distlint: ignore[DL008]
+        self._reconnecting = False
         self._lock = threading.Lock()
         self._streams: Dict[str, _KvStream] = {}
         # request ids of migrated sequences whose decode events ride
@@ -364,20 +389,29 @@ class KvDataChannel:
         with self._lock:
             self._event_rids.discard(str(rid))
 
+    def wire_available(self) -> bool:
+        """Election gate (serving/health.py): False while the breaker is
+        OPEN — handoff targets and fetch sources skip this member
+        instead of discovering the broken wire one failed stream at a
+        time (RemoteRunner.supports_kv_import / EngineStatus.data_plane)."""
+        return self.breaker.available()
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "connected": self._sock is not None,
                 "streams": len(self._streams),
                 "event_requests": len(self._event_rids),
                 "bytes_sent": self._bytes_sent,
                 "bytes_received": self._bytes_received,
             }
+        out["breaker"] = self.breaker.stats()
+        return out
 
     def close(self, reason: str = "channel closed") -> None:
         with self._lock:
             self._closed = True
-        self._drop_connection(reason)
+        self._drop_connection(reason, count_failure=False)
         self._jobs.put(None)  # wake the worker so it can exit
 
     # -- internals ----------------------------------------------------------
@@ -398,6 +432,14 @@ class KvDataChannel:
 
     def _start_stream(self, stream: _KvStream,
                       frames: List[Tuple[str, Dict[str, Any]]]) -> None:
+        if not self.breaker.try_acquire():
+            # circuit OPEN (or a half-open probe already in flight):
+            # fail fast to the caller's local fallback — the member's
+            # wire is judged broken, and hammering it would only delay
+            # the fallback the request is going to take anyway
+            stream.cb(False, "kv data channel circuit open "
+                      f"(member {self.member_id} wire unhealthy)", stream)
+            return
         with self._lock:
             if self._closed:
                 reject = "kv data channel closed"
@@ -411,6 +453,9 @@ class KvDataChannel:
                 reject = None
                 self._streams[stream.key] = stream
         if reject is not None:
+            # the attempt never ran: hand back a consumed half-open
+            # probe, or it would wedge the breaker half-open forever
+            self.breaker.release()
             stream.cb(False, reject, stream)
             return
         self._enqueue_frames(stream, frames)
@@ -449,6 +494,10 @@ class KvDataChannel:
                     continue
             try:
                 sock = self._ensure_connected()
+                # the data wire wedges/times out mid-send
+                # (docs/RESILIENCE.md fleet.wire_timeout): repeated
+                # hits walk the circuit breaker closed -> open
+                faults.fire("fleet.wire_timeout")
                 for name, obj in frames:
                     if name == "KvChunk":
                         # per-chunk wire death (docs/RESILIENCE.md):
@@ -464,8 +513,15 @@ class KvDataChannel:
                              self.member_id, e)
                 if self.metrics:
                     self.metrics.record_error("fleet_kv.send")
+                self.breaker.record_failure()
+                self._reconnecting = True
                 self._resolve_stream(stream, False, str(e))
-                self._drop_connection(f"send failed: {e}")
+                # count_failure=False: THIS incident is already recorded
+                # above — letting the drop count it again would halve
+                # the effective health.wire_failures threshold whenever
+                # other streams/event requests are live
+                self._drop_connection(f"send failed: {e}",
+                                      count_failure=False)
 
     def _ensure_connected(self) -> socket.socket:
         with self._lock:
@@ -478,6 +534,15 @@ class KvDataChannel:
                 f"kv data channel to {self.member_id} backing off "
                 f"({self._not_before - now:.2f}s left)"
             )
+        if (self._reconnecting and self.retry_budget is not None
+                and not self.retry_budget.acquire("kv_reconnect")):
+            # a RE-dial after a failure is a retry: the shared budget
+            # (serving/health.py) is dry, so degrade this stream to its
+            # local fallback instead of amplifying dial load
+            raise OSError(
+                f"kv data channel to {self.member_id}: retry budget "
+                "exhausted"
+            )
         # injected dial failure (docs/RESILIENCE.md fleet.kv_connect)
         faults.fire("fleet.kv_connect")
         try:
@@ -488,10 +553,12 @@ class KvDataChannel:
         except OSError:
             self._not_before = now + self._backoff_s
             self._backoff_s = min(self._backoff_s * 2.0, 5.0)
+            self._reconnecting = True
             raise
         sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._backoff_s = 0.25
+        self._reconnecting = False
         with self._lock:
             if self._closed:
                 sock.close()
@@ -540,6 +607,10 @@ class KvDataChannel:
             stream = self._streams.pop(key, None)
         if stream is None:
             return  # already resolved (send failure / channel death)
+        # a result frame — ok or not — proves the WIRE round-tripped:
+        # member-side rejects (validation, engine unavailable) are not
+        # wire failures and must not open the breaker
+        self.breaker.record_success()
         stream.result_depth = obj.get("depth", 0)
         try:
             stream.cb(bool(obj.get("ok")),
@@ -559,13 +630,19 @@ class KvDataChannel:
         except Exception as e:  # noqa: BLE001 — callback isolation
             self._absorbed("stream_callback", e)
 
-    def _drop_connection(self, reason: str) -> None:
+    def _drop_connection(self, reason: str,
+                         count_failure: bool = True) -> None:
         with self._lock:
             sock, self._sock = self._sock, None
             streams = list(self._streams.values())
             self._streams.clear()
             lost = sorted(self._event_rids)
             self._event_rids.clear()
+        if count_failure and (streams or lost):
+            # the connection died UNDER work: wire-failure evidence for
+            # the breaker (an idle orderly EOF is not)
+            self.breaker.record_failure()
+            self._reconnecting = True
         if sock is not None:
             try:
                 # shutdown BEFORE close: a close() under a reader thread

@@ -214,6 +214,15 @@ class FlightRecorder:
                          / (tl.tokens - 1))
             tenant = str(tl.attrs.get("tenant") or "default")
             tokens = tl.tokens
+        if code == "admission_shed":
+            # the request was never ADMITTED (serving/health.py): its
+            # timeline is the whole artifact. Exporting its ~0s
+            # queue_wait would drag the very estimate that shed it back
+            # under the deadline (admission oscillates open under a
+            # standing backlog), and an SLO verdict would burn the
+            # objective for work the fleet declined in microseconds —
+            # both signals must track admitted traffic only.
+            return phases
         verdict = None
         if self.slo is not None:
             verdict = slo_verdict(self.slo, tenant, ttft_s, tbt_s, status)

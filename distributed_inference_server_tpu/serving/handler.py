@@ -20,6 +20,7 @@ import time
 from typing import AsyncIterator, List, Optional, Tuple
 
 from distributed_inference_server_tpu.core.errors import (
+    AdmissionShedApiError,
     ApiError,
     InternalApiError,
     QueueFull,
@@ -28,6 +29,7 @@ from distributed_inference_server_tpu.core.errors import (
     ValidationApiError,
     ValidationError,
 )
+from distributed_inference_server_tpu.serving.health import AdmissionShed
 from distributed_inference_server_tpu.core.models import (
     ChatMessage,
     ChatChoice,
@@ -158,6 +160,16 @@ class InferenceHandler:
             self.dispatcher.submit(req, priority)
             if span is not None:
                 span.event("queued")
+        except AdmissionShed as e:
+            # deadline-aware shed (serving/health.py): 503 with the
+            # DISTINCT admission_shed code and a Retry-After hint — the
+            # dispatcher already recorded the flight-recorder terminal
+            # and requests_shed_total{tenant,reason}
+            if self.metrics:
+                self.metrics.request_finished()
+            if span is not None:
+                self.tracer.finish(span, status="shed")
+            raise AdmissionShedApiError(e.retry_after_s) from None
         except QueueFull:
             if self.metrics:
                 self.metrics.request_finished()

@@ -96,6 +96,12 @@ class EngineStatus:
     # making it a legal handoff target and fetch source. In-process
     # routing state only (never serialized — the member cannot know).
     data_plane: bool = False
+    # gray-failure verdict (serving/health.py HealthScorer): "healthy" |
+    # "degraded" | "ejected", stamped by AdaptiveScheduler.statuses().
+    # Routing prefers healthy replicas, falls back to degraded, and
+    # admits ejected ones only when nothing else exists (Property 20).
+    # In-process routing state only — each process scores its own view.
+    health: str = "healthy"
 
     def to_dict(self) -> Dict[str, Any]:
         d = {
@@ -119,6 +125,8 @@ class EngineStatus:
             d["remote"] = True
             if self.data_plane:
                 d["data_plane"] = True
+        if self.health != "healthy":
+            d["health"] = self.health
         return d
 
 
@@ -406,6 +414,44 @@ class MetricsCollector:
             "dispatch (queue_timeout)",
             registry=r,
         )
+        # gray-failure defense (serving/health.py; docs/RESILIENCE.md
+        # "Gray failures and overload"): deadline-aware admission
+        # shedding, latency-scored health transitions, circuit-breaker
+        # flips, and retry-budget exhaustion
+        self.requests_shed = Counter(
+            "requests_shed_total",
+            "Requests shed at admission by deadline-aware control "
+            "(deadline = the windowed queue-wait estimate blows the "
+            "tenant's SLO-derived deadline, brownout = a low-weight "
+            "tenant shed early as the backlog grows); tenants beyond "
+            "a bounded label set fold into \"other\"",
+            ["tenant", "reason"], registry=r,
+        )
+        self.engine_health = Gauge(
+            "engine_health_state",
+            "Latency-scored health verdict per engine "
+            "(0 healthy, 1 degraded, 2 ejected)",
+            ["engine_id"], registry=r,
+        )
+        self.health_transitions = Counter(
+            "health_transitions_total",
+            "Health-state transitions applied by the scorer, by the "
+            "state entered (healthy | degraded | ejected)",
+            ["state"], registry=r,
+        )
+        self.breaker_transitions = Counter(
+            "fleet_breaker_transitions_total",
+            "KV data-channel circuit-breaker transitions, by the state "
+            "entered (closed | open | half_open)",
+            ["state"], registry=r,
+        )
+        self.retry_denied = Counter(
+            "retry_budget_exhausted_total",
+            "Retries declined by the shared windowed retry budget, by "
+            "consumer site (redispatch | handoff_retry | kv_reconnect) "
+            "— each denial degraded to its exactly-once fallback",
+            ["site"], registry=r,
+        )
         # fleet control plane (serving/fleet.py; docs/FLEET.md): member
         # liveness, heartbeat ingest outcomes, role rebalancing, and
         # per-tenant queue occupancy
@@ -557,6 +603,14 @@ class MetricsCollector:
         self._engine_restarts: Dict[str, int] = {}
         self._redispatches: Dict[str, int] = {}
         self._requests_expired = 0
+        # gray-failure surfaces (serving/health.py): shed counts keyed
+        # (tenant, reason) with the tenant label bounded like the SLO
+        # counters; health/breaker transition and retry-denial tallies
+        self._requests_shed: Dict[Tuple[str, str], int] = {}
+        self._shed_tenants: set = set()
+        self._health_transitions: Dict[str, int] = {}
+        self._breaker_transitions: Dict[str, int] = {}
+        self._retry_denied: Dict[str, int] = {}
         self._fleet_heartbeats: Dict[str, int] = {}
         self._fleet_reroles: Dict[str, int] = {}
         self._tenants_seen: set = set()
@@ -604,9 +658,19 @@ class MetricsCollector:
     def record_inference(self, duration_s: float) -> None:
         self.inference_seconds.inc(duration_s)
 
-    def record_ttft(self, seconds: float) -> None:
+    def record_ttft(self, seconds: float, local: bool = True) -> None:
+        """``local=False`` (RemoteRunner proxies): the host-observed
+        histogram and snapshot average still record, but the windowed
+        ``ttft_ms`` digest does NOT — that digest carries locally-SERVED
+        requests only. Each member ships its own digest in its
+        telemetry frames, so counting a remote-served request here too
+        would double-weight it in every fleet-merged view AND poison
+        the HealthScorer's local-vs-member latency comparison (a slow
+        member would drag the host's own series up with it, hiding
+        exactly the gray failure the comparison exists to catch)."""
         self.ttft.observe(seconds)
-        self.perf.observe("ttft_ms", seconds * 1000.0)
+        if local:
+            self.perf.observe("ttft_ms", seconds * 1000.0)
         with self._lock:
             self._ttfts_ms.append(seconds * 1000.0)
 
@@ -770,6 +834,59 @@ class MetricsCollector:
         self.requests_expired.inc(n)
         with self._lock:
             self._requests_expired += n
+
+    def record_shed(self, tenant: str, reason: str) -> None:
+        """One request shed at admission (serving/health.py
+        AdmissionControl): ``reason`` is "deadline" (the tenant's own
+        deadline was blown by the queue-wait estimate) or "brownout"
+        (a low-weight tenant shed early). Tenant label bounded like
+        the SLO counters (client-chosen strings, counter series are
+        forever)."""
+        with self._lock:
+            if (tenant not in self._shed_tenants
+                    and len(self._shed_tenants) >= _SLO_TENANT_CAP):
+                tenant = "other"
+            self._shed_tenants.add(tenant)
+            key = (tenant, reason)
+            self._requests_shed[key] = self._requests_shed.get(key, 0) + 1
+        self.requests_shed.labels(tenant=tenant, reason=reason).inc()
+
+    def record_health_transition(self, engine_id: str, state: str) -> None:
+        """One health-state transition (serving/health.py HealthScorer):
+        the per-engine gauge follows the state entered (0/1/2) and the
+        transition counts by destination state."""
+        rank = {"healthy": 0, "degraded": 1, "ejected": 2}.get(state, 0)
+        self.engine_health.labels(engine_id=engine_id).set(rank)
+        self.health_transitions.labels(state=state).inc()
+        with self._lock:
+            self._health_transitions[state] = (
+                self._health_transitions.get(state, 0) + 1
+            )
+
+    def remove_engine_health(self, engine_id: str) -> None:
+        """Drop an unregistered engine's health gauge series (restarted
+        fleet members mint fresh proxy ids — the member-gauge policy)."""
+        with self._lock:
+            try:
+                self.engine_health.remove(engine_id)
+            except KeyError:
+                pass
+
+    def record_breaker_transition(self, state: str) -> None:
+        """One KV data-channel circuit-breaker transition
+        (serving/health.py CircuitBreaker), by state entered."""
+        self.breaker_transitions.labels(state=state).inc()
+        with self._lock:
+            self._breaker_transitions[state] = (
+                self._breaker_transitions.get(state, 0) + 1
+            )
+
+    def record_retry_denied(self, site: str) -> None:
+        """One retry declined by the shared retry budget
+        (serving/health.py RetryBudget)."""
+        self.retry_denied.labels(site=site).inc()
+        with self._lock:
+            self._retry_denied[site] = self._retry_denied.get(site, 0) + 1
 
     def record_error(self, site: str) -> None:
         """Count an error absorbed at an isolation boundary (``site`` is a
@@ -1074,12 +1191,23 @@ class MetricsCollector:
             }
             resilience = None
             if (self._engine_restarts or self._redispatches
-                    or self._requests_expired):
+                    or self._requests_expired or self._requests_shed
+                    or self._retry_denied or self._breaker_transitions):
                 resilience = {
                     "engine_restarts": dict(self._engine_restarts),
                     "redispatched": dict(self._redispatches),
                     "requests_expired": self._requests_expired,
                 }
+                if self._requests_shed:
+                    shed: Dict[str, Dict[str, int]] = {}
+                    for (tenant, reason), n in self._requests_shed.items():
+                        shed.setdefault(tenant, {})[reason] = n
+                    resilience["requests_shed"] = shed
+                if self._retry_denied:
+                    resilience["retry_denied"] = dict(self._retry_denied)
+                if self._breaker_transitions:
+                    resilience["breaker_transitions"] = dict(
+                        self._breaker_transitions)
             tracing = None
             if self._trace_drops or self._phase_requests:
                 tracing = {

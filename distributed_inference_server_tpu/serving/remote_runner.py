@@ -129,6 +129,11 @@ class RemoteRunner:
         # distlint: ignore[DL008]
         self._last_error: Optional[str] = None
         self._total_processed = 0
+        # consecutive control-wire send failures (reset on success):
+        # the HealthScorer reads this as eject evidence once it crosses
+        # health.wire_failures (serving/health.py). GIL-atomic int,
+        # submit-path-owned  # distlint: ignore[DL008]
+        self.consecutive_wire_failures = 0
 
     @property
     def role(self) -> str:
@@ -137,10 +142,14 @@ class RemoteRunner:
 
     @property
     def supports_kv_import(self) -> bool:
-        """True when the member's KV data channel is wired: this proxy
-        can then be a handoff TARGET (cross-host prefill→decode
-        migration) and a peer-fetch SOURCE (serving/fleet_kv.py)."""
-        return self.kv_channel is not None
+        """True when the member's KV data channel is wired AND its
+        circuit breaker is not open (serving/health.py): this proxy can
+        then be a handoff TARGET (cross-host prefill→decode migration)
+        and a peer-fetch SOURCE (serving/fleet_kv.py). An open breaker
+        pulls the member out of election instead of letting every
+        handoff discover the broken wire one failed stream at a time."""
+        ch = self.kv_channel
+        return ch is not None and ch.wire_available()
 
     # -- registry-side state (session reader / sweeper threads) ------------
 
@@ -193,7 +202,9 @@ class RemoteRunner:
         return dataclasses.replace(
             s, healthy=self.is_healthy(),
             active_requests=max(s.active_requests, len(self._inflight)),
-            data_plane=self.kv_channel is not None,
+            # breaker-aware: an open data-channel breaker drops this
+            # member from fetch sources too (scheduler.plan_route)
+            data_plane=self.supports_kv_import,
         )
 
     def active_count(self) -> int:
@@ -231,6 +242,10 @@ class RemoteRunner:
             for r in reqs:
                 # forwarded submit dies on the wire (docs/RESILIENCE.md)
                 faults.fire("fleet.submit")
+                # the control wire wedges/times out on a send — repeated
+                # hits are the HealthScorer's wire-failure eject
+                # evidence (docs/RESILIENCE.md fleet.wire_timeout)
+                faults.fire("fleet.wire_timeout")
                 frame = {
                     "request_id": str(r.request_id),
                     "engine_id": self.local_engine_id,
@@ -250,8 +265,10 @@ class RemoteRunner:
                     frame["trace_id"], frame["parent_span_id"] = \
                         span.context()
                 self._send("FleetSubmit", frame)
+            self.consecutive_wire_failures = 0
         except Exception as e:  # noqa: BLE001 — transport fault domain
             self._last_error = f"fleet submit failed: {e}"
+            self.consecutive_wire_failures += 1
             # fail only THIS batch: already-sent requests are popped
             # first, so any events the member still streams for them are
             # dropped as orphans (the redispatched copy owns the sink)
@@ -393,8 +410,13 @@ class RemoteRunner:
                         # distlint: ignore[DL008]
                         req.first_token_at = time.monotonic()
                         if self.metrics:
+                            # local=False: the member's OWN telemetry
+                            # digest carries this request's TTFT — see
+                            # record_ttft (double-count + scorer
+                            # contamination otherwise)
                             self.metrics.record_ttft(
-                                req.first_token_at - req.submitted_at)
+                                req.first_token_at - req.submitted_at,
+                                local=False)
                     if ev.get("token_id") is not None:
                         if self.metrics:
                             self.metrics.record_tokens(1)
@@ -862,4 +884,12 @@ class FleetWorker:
             span=span,
             tenant=obj.get("tenant") or "default",
         )
+        # gray-failure lever (docs/RESILIENCE.md fleet.slow_member,
+        # delay-style): the member serves SLOWLY while heartbeating
+        # healthily — fired after the request's arrival clock started,
+        # so the member's own TTFT telemetry carries the slowness the
+        # host's HealthScorer demotes it on. Head-of-line by design
+        # (the reader thread stalls): a gray-failing box is slow for
+        # everything behind the slow request too.
+        faults.fire("fleet.slow_member")
         runner.submit([req])

@@ -30,6 +30,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from distributed_inference_server_tpu.serving import faults
+from distributed_inference_server_tpu.serving.health import health_rank
 from distributed_inference_server_tpu.serving.metrics import (
     EngineStatus,
     MetricsCollector,
@@ -48,6 +49,25 @@ class SchedulingStrategy(str, enum.Enum):
     @classmethod
     def parse(cls, value: str) -> "SchedulingStrategy":
         return cls(value.strip().lower())
+
+
+def health_tier(statuses: Sequence[EngineStatus]) -> List[EngineStatus]:
+    """Gray-failure tiering (serving/health.py; docs/RESILIENCE.md
+    "Gray failures and overload"): keep only the best health tier
+    present — healthy replicas when any exist, else degraded, else
+    ejected. Strict preference (not a tie-break) so a degraded replica
+    takes NO new traffic while a healthy one can serve, yet Property 20
+    holds absolutely: when every admissible replica is ejected they are
+    all re-admitted — a possibly-sick replica beats a certain 503."""
+    pool = list(statuses)
+    if not pool:
+        return pool
+    best = min(health_rank(getattr(s, "health", "healthy")) for s in pool)
+    if best == 0 and all(
+            getattr(s, "health", "healthy") == "healthy" for s in pool):
+        return pool  # common case: nothing demoted, no filtering cost
+    return [s for s in pool
+            if health_rank(getattr(s, "health", "healthy")) == best]
 
 
 def prefix_match_depth(status: EngineStatus,
@@ -149,6 +169,9 @@ def plan_route(
                    if getattr(s, "role", "unified") in roles])
     if not admissible:
         return None
+    # gray-failure tiering (serving/health.py): degraded replicas are
+    # deprioritized, ejected ones excluded while any alternative exists
+    admissible = health_tier(admissible)
 
     def load(s: EngineStatus) -> int:
         return s.active_requests + s.waiting_requests
@@ -163,9 +186,12 @@ def plan_route(
     # heartbeated digests score like anyone's) but never source a
     # fetch. The fetch TARGET stays local: the import seats pages into
     # this host's engine for the request this host is about to run.
+    # ejected peers never source a fetch either: their wire (or their
+    # engine) is exactly what the scorer judged broken
     fetchable = [s for s in healthy
-                 if not getattr(s, "remote", False)
-                 or getattr(s, "data_plane", False)]
+                 if (not getattr(s, "remote", False)
+                     or getattr(s, "data_plane", False))
+                 and health_rank(getattr(s, "health", "healthy")) < 2]
     # deepest match wins; a LOCAL peer beats a remote one at equal
     # depth (cheaper wire), then load/id tie-breaks — deterministic
     peer = (min(fetchable,
@@ -249,6 +275,9 @@ def choose_engine(
         ]
     if not healthy:
         return None
+    # gray-failure tiering (serving/health.py): prefer healthy, fall
+    # back to degraded, admit ejected only when nothing else exists
+    healthy = health_tier(healthy)
     if strategy is SchedulingStrategy.ROUND_ROBIN:
         return healthy[rr_counter % len(healthy)].engine_id
     if strategy is SchedulingStrategy.CACHE_AWARE:
@@ -324,6 +353,11 @@ class AdaptiveScheduler:
         # engines with a restart worker in flight; guarded by _lock
         # (health loop adds, restart threads discard — distlint DL008)
         self._restarting: set = set()
+        # gray-failure scorer (serving/health.py), wired by the server:
+        # statuses() stamps its verdicts so every strategy applies the
+        # health tiering. Single-writer (server boot), read per snapshot
+        # distlint: ignore[DL008]
+        self.health_scorer = None
 
     # -- registration ------------------------------------------------------
 
@@ -365,7 +399,10 @@ class AdaptiveScheduler:
     # -- routing -----------------------------------------------------------
 
     def statuses(self) -> List[EngineStatus]:
-        return [r.status() for r in self.engines()]
+        out = [r.status() for r in self.engines()]
+        if self.health_scorer is not None:
+            out = self.health_scorer.stamp(out)
+        return out
 
     def schedule(self, prompt_ids: Optional[Sequence[int]] = None
                  ) -> Optional[EngineRunner]:
@@ -516,6 +553,11 @@ class AdaptiveScheduler:
                  or getattr(r, "supports_kv_import", False))
         ]
         statuses = [r.status() for r in candidates]
+        if self.health_scorer is not None:
+            # health tiering applies to migration targets too — and
+            # supports_kv_import above already excludes members whose
+            # data-channel breaker is OPEN (serving/health.py)
+            statuses = self.health_scorer.stamp(statuses)
         engine_id = choose_engine(
             SchedulingStrategy.LEAST_LOADED, statuses, 0, roles=("decode",)
         )

@@ -73,6 +73,8 @@ class InferenceServer:
         fetch_costs=None,
         fleet_settings=None,
         slo_settings=None,
+        health_settings=None,
+        admission_settings=None,
     ):
         """``model_resolver(name) -> engine_factory`` enables the admin
         model-swap endpoint (Req 13); None leaves it unconfigured (501).
@@ -105,7 +107,17 @@ class InferenceServer:
         section ``slo``): arms per-request SLO verdicts in the flight
         recorder and shapes the windowed-digest rings behind
         ``GET /server/perf`` (docs/OBSERVABILITY.md "Performance
-        telemetry"). None = no SLO accounting, default windows."""
+        telemetry"). None = no SLO accounting, default windows.
+
+        ``health_settings`` / ``admission_settings`` (serving/health.py;
+        docs/RESILIENCE.md "Gray failures and overload"): the gray-
+        failure control plane — latency-scored health demotion with
+        routing tiering, KV data-channel circuit breakers, deadline-
+        aware admission shedding (503 + Retry-After, ``admission_shed``),
+        and the shared retry budget. None = defaults (scorer ON with
+        conservative thresholds; shedding armed but inert until a TTFT
+        SLO or explicit ``admission.deadline_ms`` gives requests a
+        deadline)."""
         from distributed_inference_server_tpu.utils.tracing import Tracer
 
         from distributed_inference_server_tpu.serving.flightrec import (
@@ -157,6 +169,39 @@ class InferenceServer:
             restart_backoff_max_s=restart_backoff_max_s,
             fetch_costs=fetch_costs,
         )
+        # gray-failure defense (serving/health.py; docs/RESILIENCE.md
+        # "Gray failures and overload"): the latency-scored health
+        # scorer (routing tiering rides scheduler.statuses()), the
+        # shared retry budget, and deadline-aware admission control
+        from distributed_inference_server_tpu.serving.health import (
+            AdmissionControl,
+            AdmissionSettings,
+            HealthScorer,
+            HealthSettings,
+            RetryBudget,
+        )
+
+        self.health_settings = health_settings or HealthSettings()
+        self.retry_budget = RetryBudget(
+            ratio=self.health_settings.retry_budget_ratio,
+            min_retries=self.health_settings.retry_budget_min,
+            window_s=self.health_settings.retry_window_s,
+            metrics=self.metrics,
+        )
+        self.health: Optional[HealthScorer] = None
+        if self.health_settings.enabled:
+            self.health = HealthScorer(
+                self.health_settings, self.scheduler,
+                metrics=self.metrics, recorder=self.recorder,
+            )
+            self.scheduler.health_scorer = self.health
+        self.admission = AdmissionControl(
+            admission_settings or AdmissionSettings(),
+            slo=slo_settings,
+            metrics=self.metrics,
+            tenant_weights=(queue_config.tenant_weights
+                            if queue_config is not None else None),
+        )
         from distributed_inference_server_tpu.serving.disagg import (
             DisaggController,
             DisaggSettings,
@@ -202,7 +247,14 @@ class InferenceServer:
             max_redispatch=max_redispatch,
             prefix_fetcher=self.prefix_fetcher,
             recorder=self.recorder,
+            admission=self.admission,
+            retry_budget=self.retry_budget,
         )
+        if self.disagg is not None:
+            # the handoff retry loop draws from the shared retry budget
+            # (serving/health.py): a sick decode fleet must not turn
+            # every migration into retry amplification
+            self.disagg.retry_budget = self.retry_budget
         from distributed_inference_server_tpu.native import make_validator
 
         self.handler = InferenceHandler(
@@ -221,7 +273,14 @@ class InferenceServer:
             DegradationController,
         )
 
-        self.degradation = DegradationController(self.dispatcher, self.scheduler)
+        self.degradation = DegradationController(
+            self.dispatcher, self.scheduler,
+            # SLO burn rate as an escalation input alongside memory
+            # pressure (serving/health.py settings; docs/RESILIENCE.md)
+            metrics=self.metrics,
+            burn_high=self.health_settings.slo_burn_high,
+            burn_min_requests=self.health_settings.slo_burn_min_requests,
+        )
         # multi-host fleet control plane (serving/fleet.py; docs/FLEET.md)
         from distributed_inference_server_tpu.serving.fleet import (
             FleetRegistry,
@@ -244,7 +303,15 @@ class InferenceServer:
                 redispatch=self.dispatcher.redispatch,
                 tracer=self.tracer,
                 recorder=self.recorder,
+                health_settings=self.health_settings,
+                retry_budget=self.retry_budget,
             )
+            if self.health is not None:
+                # per-member latency comparison: the scorer reads the
+                # same telemetry frames GET /server/perf merges
+                self.health.telemetry_fn = (
+                    self.fleet_server.telemetry_snapshot
+                )
         if self.fleet_settings.rerole:
             self.role_balancer = RoleBalancer(
                 self.scheduler, self.dispatcher, self.fleet_settings,
@@ -266,6 +333,8 @@ class InferenceServer:
         self.scheduler.start_health_loop()
         self.dispatcher.start()
         self.degradation.start()
+        if self.health is not None:
+            self.health.start()
         if self.fleet_server is not None:
             self.fleet_server.start()
         if self.role_balancer is not None:
@@ -279,6 +348,8 @@ class InferenceServer:
         (disagg.pending_count); the controller then drains its queue by
         resuming any stragglers in place before the engines stop."""
         self.degradation.stop()
+        if self.health is not None:
+            self.health.stop()
         if self.role_balancer is not None:
             self.role_balancer.stop()
         self.dispatcher.shutdown(drain_timeout_s)
@@ -486,7 +557,27 @@ class InferenceServer:
 
         return build_app(self.handler, self.metrics, swap_fn=swap_fn,
                          scale_fn=scale_fn, fleet_fn=fleet_fn,
-                         perf_fn=self._perf_stats)
+                         perf_fn=self._perf_stats,
+                         health_fn=self._health_stats)
+
+    def _health_stats(self) -> dict:
+        """The ``health`` block of ``/server/stats`` (serving/health.py;
+        docs/RESILIENCE.md "Gray failures and overload"): scored
+        per-engine states with their evidence, KV data-channel breaker
+        states, the shared retry budget, and the admission estimator."""
+        out: dict = {}
+        if self.health is not None:
+            out.update(self.health.stats())
+        out["retry_budget"] = self.retry_budget.stats()
+        out["admission"] = self.admission.stats()
+        if self.fleet_server is not None:
+            breakers = {}
+            for member, stats in self.fleet_server.kv_stats().items():
+                if "breaker" in stats:
+                    breakers[member] = stats["breaker"]
+            if breakers:
+                out["kv_breakers"] = breakers
+        return out
 
     def _perf_stats(self) -> dict:
         """The ``GET /server/perf`` payload (docs/OBSERVABILITY.md

@@ -501,6 +501,14 @@ class TestChaosFleetSeeds:
         ("warm_peer_fetch_death", 21),
         ("warm_peer_fetch_death", 22),
         ("warm_peer_fetch_death", 24),
+        # deadline-aware admission under synthetic overload
+        # (docs/RESILIENCE.md "Gray failures and overload"): shed
+        # requests get the distinct admission_shed terminal fast,
+        # admitted traffic completes, zero pages leak, and admission
+        # recovers as the short window decays
+        ("overload_shed", 51),
+        ("overload_shed", 52),
+        ("overload_shed", 53),
     ])
     def test_scenario_clean(self, scenario, seed):
         from tools import chaos_fleet
@@ -558,6 +566,19 @@ class TestFleetChaosSeeds:
         ("remote_fetch_source_death", 41),
         ("remote_fetch_source_death", 42),
         ("remote_fetch_source_death", 45),
+        # gray-failure defense (docs/RESILIENCE.md "Gray failures and
+        # overload"): a fleet.slow_member-delayed member is demoted by
+        # the latency-scored HealthScorer and drained without a client
+        # error, then recovers through the two-sided hysteresis
+        ("slow_member_brownout", 51),
+        ("slow_member_brownout", 52),
+        ("slow_member_brownout", 53),
+        # a flapping data wire (fleet.wire_timeout): the channel
+        # breaker opens, probes no earlier than the cooldown, and
+        # re-closes once the wire heals — every stream exactly-once
+        ("breaker_flap", 51),
+        ("breaker_flap", 52),
+        ("breaker_flap", 53),
     ])
     def test_scenario_clean(self, scenario, seed, fleet_chaos_cache):
         from tools import chaos_fleet

@@ -1366,6 +1366,46 @@ def test_dl012_real_repo_schema_parses():
     for key in ("kv_enabled", "kv_data_port", "kv_page_cost",
                 "kv_max_streams", "kv_connect_timeout_s"):
         assert key in schema["fleet"], key
+    # ISSUE 15: the gray-failure sections are real schema entries, so
+    # every health.* / admission.* get site is drift-checked
+    for key in ("enabled", "stall_s", "latency_ratio", "wire_failures",
+                "breaker_open_s", "retry_budget_ratio", "slo_burn_high"):
+        assert key in schema["health"], key
+    for key in ("shed_enabled", "deadline_ms", "deadline_factor",
+                "brownout", "retry_after_cap_s"):
+        assert key in schema["admission"], key
+
+
+def test_dl012_health_admission_keys_checked():
+    """The gray-failure config keys (ISSUE 15, serving/health.py): a
+    correct get (and the env-token spelling) is clean, typo'd keys in
+    either new section flag."""
+    out = pcheck("DL012", {
+        _CONFIG_FIXTURE: """
+_SCHEMA = {
+    "health": {"stall_s": (float, 5.0), "wire_failures": (int, 3)},
+    "admission": {"deadline_ms": (float, 0.0), "brownout": (bool, True)},
+}
+class ServerConfig:
+    def get(self, section, key):
+        return None
+""",
+        f"{PKG}/serving/x.py": f"""
+import os
+from {PKG.replace('/', '.')}.serving.config import ServerConfig
+def f(cfg: ServerConfig):
+    ok = cfg.get("health", "stall_s")
+    ok2 = cfg.get("admission", "brownout")
+    env = os.environ.get("DIS_TPU_HEALTH__WIRE_FAILURES")
+    bad = cfg.get("health", "stall_seconds")
+    bad2 = cfg.get("admission", "deadline_mss")
+    return ok, ok2, env, bad, bad2
+""",
+    })
+    assert len(out) == 2
+    msgs = "\n".join(f.message for f in out)
+    assert "health.stall_seconds" in msgs
+    assert "admission.deadline_mss" in msgs
 
 
 def test_dl012_mixed_step_key_checked():

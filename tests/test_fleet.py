@@ -303,6 +303,18 @@ def _remote(wire=None):
     return r, wire
 
 
+class _StubKvChannel:
+    """KvDataChannel double for routing-gate tests: the capability
+    surface RemoteRunner.supports_kv_import consults (an OPEN circuit
+    breaker reads wire_available() False, serving/health.py)."""
+
+    def __init__(self, available=True):
+        self.available = available
+
+    def wire_available(self):
+        return self.available
+
+
 class TestRemoteRunner:
     def test_submit_encodes_frames_and_events_resolve(self):
         r, wire = _remote()
@@ -938,7 +950,14 @@ class TestKvDataPlaneRouting:
         sched.register(runner)
         # control-plane only: excluded, exactly as before
         assert sched.schedule_decode() is None
-        runner.kv_channel = object()  # the member advertised a channel
+        channel = _StubKvChannel()  # the member advertised a channel
+        runner.kv_channel = channel
+        assert sched.schedule_decode() is runner
+        # gray-failure gate (serving/health.py): an OPEN data-channel
+        # breaker pulls the member out of handoff-target election
+        channel.available = False
+        assert sched.schedule_decode() is None
+        channel.available = True
         assert sched.schedule_decode() is runner
 
     def test_has_decode_targets_counts_kv_capable_remote(self):
@@ -955,8 +974,12 @@ class TestKvDataPlaneRouting:
         runner.update_status(_status("w1:e0", role="decode", remote=True))
         sched.register(runner)
         assert not ctrl.has_decode_targets()
-        runner.kv_channel = object()
+        channel = _StubKvChannel()
+        runner.kv_channel = channel
         assert ctrl.has_decode_targets()
+        # an OPEN breaker removes the member's decode capacity too
+        channel.available = False
+        assert not ctrl.has_decode_targets()
 
 
 class _FakeKvRunner:

@@ -1,0 +1,768 @@
+"""Gray-failure defense (ISSUE 15, serving/health.py;
+docs/RESILIENCE.md "Gray failures and overload"): the circuit breaker
+state machine, the shared retry budget, deadline-aware admission
+shedding (503 + Retry-After + the distinct ``admission_shed`` code,
+brownout ordering on DRR weights), the latency-scored HealthScorer's
+two-sided hysteresis (wedge / latency / wire evidence), the routing
+tiering that consumes its verdicts without ever stranding a request
+(Property 20), and the SLO burn rate escalating the degradation
+ladder."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from distributed_inference_server_tpu.core.errors import (
+    AdmissionShedApiError,
+    ConfigError,
+)
+from distributed_inference_server_tpu.serving.config import ServerConfig
+from distributed_inference_server_tpu.serving.health import (
+    AdmissionControl,
+    AdmissionSettings,
+    AdmissionShed,
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    HEALTH_DEGRADED,
+    HEALTH_EJECTED,
+    HEALTH_HEALTHY,
+    HealthScorer,
+    HealthSettings,
+    RetryBudget,
+    health_rank,
+)
+from distributed_inference_server_tpu.serving.metrics import (
+    EngineStatus,
+    MetricsCollector,
+)
+from distributed_inference_server_tpu.serving.scheduler import (
+    SchedulingStrategy,
+    choose_engine,
+    health_tier,
+    plan_route,
+)
+from distributed_inference_server_tpu.serving.teledigest import (
+    SloSettings,
+    WindowedDigest,
+)
+
+
+def _status(eid, health="healthy", healthy=True, load=0, role="unified",
+            **kw):
+    return EngineStatus(
+        engine_id=eid, healthy=healthy, active_requests=load,
+        waiting_requests=0, total_processed=0, role=role, health=health,
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_consecutive_failures(self):
+        b = CircuitBreaker(threshold=3, open_s=10.0)
+        b.record_failure(now=0.0)
+        b.record_failure(now=0.1)
+        assert b.state(now=0.2) == BREAKER_CLOSED
+        b.record_failure(now=0.2)
+        assert b.state(now=0.3) == BREAKER_OPEN
+        assert not b.available(now=0.3)
+        assert not b.try_acquire(now=0.3)
+
+    def test_success_resets_the_failure_streak(self):
+        b = CircuitBreaker(threshold=2, open_s=10.0)
+        b.record_failure(now=0.0)
+        b.record_success()
+        b.record_failure(now=0.1)
+        assert b.state(now=0.2) == BREAKER_CLOSED
+
+    def test_half_open_probe_after_cooldown_then_close(self):
+        b = CircuitBreaker(threshold=1, open_s=1.0)
+        b.record_failure(now=0.0)
+        assert not b.try_acquire(now=0.5)  # inside the cooldown
+        assert b.state(now=1.1) == BREAKER_HALF_OPEN
+        assert b.available(now=1.1)  # election may consider it again
+        assert b.try_acquire(now=1.1)  # THE probe
+        assert not b.try_acquire(now=1.2)  # only one probe at a time
+        b.record_success()
+        assert b.state(now=1.3) == BREAKER_CLOSED
+
+    def test_failed_probe_reopens(self):
+        b = CircuitBreaker(threshold=1, open_s=1.0)
+        b.record_failure(now=0.0)
+        assert b.try_acquire(now=1.1)
+        b.record_failure(now=1.2)
+        assert b.state(now=1.3) == BREAKER_OPEN
+        # a fresh cooldown starts at the re-open
+        assert not b.try_acquire(now=1.9)
+        assert b.try_acquire(now=2.3)
+
+    def test_release_unwedges_an_unused_probe(self):
+        b = CircuitBreaker(threshold=1, open_s=1.0)
+        b.record_failure(now=0.0)
+        assert b.try_acquire(now=1.1)
+        b.release()  # the attempt never ran (e.g. window full)
+        assert b.try_acquire(now=1.2)  # probe available again
+
+    def test_unanswered_probe_times_out_back_to_open(self):
+        """Review regression: a probe whose stream is sent but NEVER
+        answered (the wedged-member gray failure) must not pin the
+        breaker half-open with the probe consumed — after another
+        cooldown the unanswered probe counts as a failure and the
+        breaker re-opens (election drops the member again)."""
+        b = CircuitBreaker(threshold=1, open_s=1.0)
+        b.record_failure(now=0.0)
+        assert b.try_acquire(now=1.1)  # the probe goes out... silence
+        assert b.state(now=1.5) == BREAKER_HALF_OPEN
+        assert b.state(now=2.2) == BREAKER_OPEN  # probe timed out
+        assert not b.available(now=2.2)
+        # and the cycle continues: a LATER probe can still close it
+        assert b.try_acquire(now=3.3)
+        b.record_success()
+        assert b.state(now=3.4) == BREAKER_CLOSED
+
+    def test_history_and_transition_callback(self):
+        seen = []
+        b = CircuitBreaker(threshold=1, open_s=1.0,
+                           on_transition=seen.append)
+        b.record_failure(now=0.0)
+        b.state(now=1.1)  # open -> half_open
+        b.record_success()
+        assert seen == [BREAKER_OPEN, BREAKER_HALF_OPEN, BREAKER_CLOSED]
+        assert [s for _, s in b.history()] == seen
+        assert b.stats()["transitions"] == 3
+
+
+# ---------------------------------------------------------------------------
+# RetryBudget
+# ---------------------------------------------------------------------------
+
+
+class TestRetryBudget:
+    def test_floor_allows_min_retries_with_no_admits(self):
+        rb = RetryBudget(ratio=0.1, min_retries=2, window_s=10.0)
+        assert rb.acquire("redispatch", now=0.0)
+        assert rb.acquire("redispatch", now=0.1)
+        assert not rb.acquire("redispatch", now=0.2)
+
+    def test_ratio_scales_with_windowed_admits(self):
+        rb = RetryBudget(ratio=0.5, min_retries=1, window_s=10.0)
+        for i in range(10):
+            rb.note_admit(now=float(i) * 0.1)
+        grants = sum(rb.acquire("x", now=2.0) for _ in range(10))
+        assert grants == 5  # floor(0.5 * 10)
+
+    def test_window_decay_replenishes(self):
+        rb = RetryBudget(ratio=0.0, min_retries=1, window_s=1.0)
+        assert rb.acquire("x", now=0.0)
+        assert not rb.acquire("x", now=0.5)
+        assert rb.acquire("x", now=1.6)  # the old retry fell out
+
+    def test_denials_count_into_metrics(self):
+        mc = MetricsCollector()
+        rb = RetryBudget(ratio=0.0, min_retries=1, window_s=10.0,
+                         metrics=mc)
+        rb.acquire("redispatch", now=0.0)
+        rb.acquire("redispatch", now=0.1)
+        snap = mc.snapshot().to_dict()
+        assert snap["resilience"]["retry_denied"] == {"redispatch": 1}
+        assert rb.stats()["denied_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Deadline-aware admission
+# ---------------------------------------------------------------------------
+
+
+def _overloaded_metrics(wait_ms=2000.0, n=12, window=60.0):
+    mc = MetricsCollector()
+    mc.configure_perf(5.0, window)
+    for _ in range(n):
+        mc.perf.observe("queue_wait_ms", wait_ms)
+    return mc
+
+
+class TestAdmission:
+    def test_deadline_from_slo_with_tenant_override(self):
+        slo = SloSettings(ttft_ms=500.0, tenant_ttft_ms={"vip": 2000.0})
+        ac = AdmissionControl(AdmissionSettings(deadline_factor=2.0),
+                              slo=slo)
+        assert ac.deadline_ms("default") == 1000.0
+        assert ac.deadline_ms("vip") == 4000.0
+
+    def test_explicit_deadline_wins(self):
+        ac = AdmissionControl(AdmissionSettings(deadline_ms=750.0),
+                              slo=SloSettings(ttft_ms=500.0))
+        assert ac.deadline_ms("default") == 750.0
+
+    def test_no_deadline_never_sheds(self):
+        ac = AdmissionControl(AdmissionSettings(),
+                              metrics=_overloaded_metrics())
+        assert ac.check("default") is None
+
+    def test_cold_estimator_never_sheds(self):
+        mc = _overloaded_metrics(n=3)
+        ac = AdmissionControl(AdmissionSettings(min_window_requests=8),
+                              slo=SloSettings(ttft_ms=100.0), metrics=mc)
+        assert ac.check("default") is None
+
+    def test_sheds_when_estimate_blows_deadline(self):
+        ac = AdmissionControl(AdmissionSettings(),
+                              slo=SloSettings(ttft_ms=500.0),
+                              metrics=_overloaded_metrics(wait_ms=2000.0))
+        shed = ac.check("default")
+        assert isinstance(shed, AdmissionShed)
+        assert shed.reason == "deadline"
+        assert shed.retry_after_s >= 1.0
+        assert shed.estimate_ms > shed.deadline_ms
+
+    def test_admits_under_the_deadline(self):
+        ac = AdmissionControl(AdmissionSettings(),
+                              slo=SloSettings(ttft_ms=5000.0),
+                              metrics=_overloaded_metrics(wait_ms=100.0))
+        assert ac.check("default") is None
+
+    def test_brownout_sheds_low_weight_tenant_first(self):
+        """At an intermediate backlog, the low-weight tenant sheds
+        (reason "brownout") while the heavy tenant still admits — the
+        DRR weights order the brownout."""
+        mc = _overloaded_metrics(wait_ms=600.0)
+        ac = AdmissionControl(
+            AdmissionSettings(),
+            slo=SloSettings(ttft_ms=1000.0),
+            metrics=mc,
+            tenant_weights={"gold": 4.0, "bronze": 1.0},
+        )
+        # estimate ~600ms: gold's threshold is 1000, bronze's is 250
+        assert ac.check("gold") is None
+        shed = ac.check("bronze")
+        assert shed is not None and shed.reason == "brownout"
+
+    def test_brownout_off_treats_tenants_equally(self):
+        mc = _overloaded_metrics(wait_ms=600.0)
+        ac = AdmissionControl(
+            AdmissionSettings(brownout=False),
+            slo=SloSettings(ttft_ms=1000.0),
+            metrics=mc,
+            tenant_weights={"gold": 4.0, "bronze": 1.0},
+        )
+        assert ac.check("bronze") is None
+
+    def test_retry_after_capped(self):
+        ac = AdmissionControl(
+            AdmissionSettings(retry_after_cap_s=5.0),
+            slo=SloSettings(ttft_ms=100.0),
+            metrics=_overloaded_metrics(wait_ms=60000.0),
+        )
+        shed = ac.check("default")
+        assert shed is not None and shed.retry_after_s == 5.0
+
+    def test_shed_is_a_queue_full_subclass(self):
+        # every existing backpressure handler keeps working
+        from distributed_inference_server_tpu.core.errors import QueueFull
+
+        assert issubclass(AdmissionShed, QueueFull)
+
+    def test_api_error_maps_503_with_retry_after_header(self):
+        from distributed_inference_server_tpu.serving.app import (
+            _error_response,
+        )
+
+        err = AdmissionShedApiError(retry_after_s=7.0)
+        assert err.status_code() == 503
+        assert err.code() == "admission_shed"
+        resp = _error_response(err)
+        assert resp.status == 503
+        assert resp.headers["Retry-After"] == "7"
+
+
+class TestDispatcherShed:
+    def _dispatcher(self, ac):
+        from distributed_inference_server_tpu.serving.dispatcher import (
+            Dispatcher,
+        )
+        from distributed_inference_server_tpu.serving.flightrec import (
+            FlightRecorder,
+        )
+        from distributed_inference_server_tpu.serving.scheduler import (
+            AdaptiveScheduler,
+        )
+
+        mc = ac.metrics
+        rec = FlightRecorder(metrics=mc)
+        d = Dispatcher(AdaptiveScheduler(), metrics=mc, recorder=rec,
+                       admission=ac, retry_budget=RetryBudget(metrics=mc))
+        d._accepting = True  # no dispatch thread needed for submit
+        return d, rec
+
+    def _request(self, rid="shed-1", tenant="default"):
+        from distributed_inference_server_tpu.engine.engine import (
+            SamplingParams,
+        )
+        from distributed_inference_server_tpu.serving.runner import (
+            ServerRequest,
+        )
+
+        class _Sink:
+            def on_token(self, *a, **k): ...
+            def on_done(self, *a, **k): ...
+            def on_error(self, *a, **k): ...
+
+        return ServerRequest(rid, [1, 2, 3], SamplingParams(max_tokens=4),
+                             _Sink(), tenant=tenant)
+
+    def test_submit_sheds_with_terminal_and_metric(self):
+        ac = AdmissionControl(AdmissionSettings(),
+                              slo=SloSettings(ttft_ms=100.0),
+                              metrics=_overloaded_metrics())
+        d, rec = self._dispatcher(ac)
+        with pytest.raises(AdmissionShed) as ei:
+            d.submit(self._request())
+        assert ei.value.reason == "deadline"
+        tl = rec.timeline("shed-1")
+        assert tl["code"] == "admission_shed"
+        assert tl["status"] == "error"
+        assert any(e["name"] == "admission_shed" for e in tl["events"])
+        snap = ac.metrics.snapshot().to_dict()
+        assert snap["resilience"]["requests_shed"] == {
+            "default": {"deadline": 1}
+        }
+        assert d.queue.is_empty()  # shed never touches the queue
+
+    def test_admitted_requests_feed_the_retry_budget_window(self):
+        ac = AdmissionControl(AdmissionSettings(), metrics=MetricsCollector())
+        d, _rec = self._dispatcher(ac)
+        d.submit(self._request("ok-1"))
+        assert d.retry_budget.stats()["window_admits"] == 1
+
+    def test_shed_does_not_poison_estimator_or_slo(self):
+        """Review regression: a shed request's flightrec terminal must
+        NOT export its ~0s queue_wait into the very digest the
+        estimator reads (admission would oscillate open under a
+        standing backlog), and must NOT count an SLO verdict (the burn
+        rate tracks admitted traffic only)."""
+        from distributed_inference_server_tpu.serving.flightrec import (
+            FlightRecorder,
+        )
+        from distributed_inference_server_tpu.serving.teledigest import (
+            window_stats,
+        )
+
+        mc = _overloaded_metrics(wait_ms=2000.0, n=12)
+        rec = FlightRecorder(metrics=mc, slo=SloSettings(ttft_ms=100.0))
+        before = window_stats(mc.perf_store().wire_digest("queue_wait_ms"),
+                              mc.perf_store().window_s)
+        rec.note("shed-p", "admission_shed", tenant="t", reason="deadline")
+        rec.finish("shed-p", "error", code="admission_shed")
+        after = window_stats(mc.perf_store().wire_digest("queue_wait_ms"),
+                             mc.perf_store().window_s)
+        assert after == before  # no 0ms sample landed
+        counts, _goodput = mc.slo_counts()
+        assert counts == {}  # no verdict for a never-admitted request
+        # the timeline itself still carries the full story
+        tl = rec.timeline("shed-p")
+        assert tl["code"] == "admission_shed" and "slo" not in tl
+
+
+# ---------------------------------------------------------------------------
+# HealthScorer
+# ---------------------------------------------------------------------------
+
+
+class _FakeRunner:
+    is_remote = False
+
+    def __init__(self, eid, active=0, waiting=0, remote=False,
+                 wire_failures=0, kv_channel=None):
+        self.engine_id = eid
+        self.is_remote = remote
+        self.consecutive_wire_failures = wire_failures
+        self.kv_channel = kv_channel
+        self._active = active
+        self._waiting = waiting
+
+    def status(self):
+        return EngineStatus(
+            engine_id=self.engine_id, healthy=True,
+            active_requests=self._active, waiting_requests=self._waiting,
+            total_processed=0, remote=self.is_remote,
+        )
+
+
+class _FakeScheduler:
+    def __init__(self, runners):
+        self._runners = runners
+
+    def engines(self):
+        return list(self._runners)
+
+
+def _ttft_wire(values_ms, epoch_s=5.0, window_s=60.0):
+    d = WindowedDigest(epoch_s, window_s)
+    for v in values_ms:
+        d.observe(v)
+    return d.to_wire("ttft_ms")
+
+
+class TestHealthScorer:
+    def _scorer(self, runners, telemetry=None, metrics=None, **kw):
+        kw.setdefault("stall_s", 1.0)
+        settings = HealthSettings(
+            demote_after=2, recover_after=2, min_window_requests=3,
+            latency_ratio=3.0, recover_ratio=1.5,
+            wire_failures=2, **kw,
+        )
+        return HealthScorer(settings, _FakeScheduler(runners),
+                            metrics=metrics,
+                            telemetry_fn=(lambda: telemetry)
+                            if telemetry is not None else None)
+
+    def test_latency_demotes_member_after_demote_after_evals(self):
+        mc = MetricsCollector()
+        for _ in range(5):
+            mc.perf.observe("ttft_ms", 100.0)
+        runner = _FakeRunner("m1:engine-0", remote=True)
+        telemetry = {"m1": {"digests": {
+            "ttft_ms": _ttft_wire([1000.0] * 5)}}}
+        s = self._scorer([runner], telemetry=telemetry, metrics=mc)
+        assert s.evaluate() == []  # streak 1 of 2
+        assert s.state("m1:engine-0") == HEALTH_HEALTHY
+        assert s.evaluate() == [("m1:engine-0", HEALTH_HEALTHY,
+                                 HEALTH_DEGRADED)]
+        assert s.state("m1:engine-0") == HEALTH_DEGRADED
+        assert s.stats()["engines"]["m1:engine-0"]["reasons"] == [
+            "latency"]
+
+    def test_latency_band_holds_neither_streak(self):
+        """Between recover_ratio and latency_ratio x the baseline, a
+        demoted source neither recovers nor demotes further — the
+        two-sided hysteresis band."""
+        mc = MetricsCollector()
+        for _ in range(5):
+            mc.perf.observe("ttft_ms", 100.0)
+        runner = _FakeRunner("m1:engine-0", remote=True)
+        bad = {"m1": {"digests": {"ttft_ms": _ttft_wire([1000.0] * 5)}}}
+        band = {"m1": {"digests": {"ttft_ms": _ttft_wire([200.0] * 5)}}}
+        state = {"t": bad}
+        s = HealthScorer(
+            HealthSettings(demote_after=2, recover_after=2,
+                           min_window_requests=3),
+            _FakeScheduler([runner]), metrics=mc,
+            telemetry_fn=lambda: state["t"],
+        )
+        s.evaluate()
+        s.evaluate()
+        assert s.state("m1:engine-0") == HEALTH_DEGRADED
+        state["t"] = band  # 2x the baseline: inside the band
+        for _ in range(5):
+            s.evaluate()
+        assert s.state("m1:engine-0") == HEALTH_DEGRADED  # held
+        state["t"] = {"m1": {"digests": {
+            "ttft_ms": _ttft_wire([100.0] * 5)}}}
+        s.evaluate()
+        s.evaluate()
+        assert s.state("m1:engine-0") == HEALTH_HEALTHY  # recovered
+
+    def test_single_source_never_compares(self):
+        mc = MetricsCollector()
+        for _ in range(5):
+            mc.perf.observe("ttft_ms", 5000.0)
+        runner = _FakeRunner("engine-0")
+        s = self._scorer([runner], metrics=mc)
+        s.evaluate()
+        s.evaluate()
+        assert s.state("engine-0") == HEALTH_HEALTHY
+
+    def test_wire_failures_eject(self):
+        runner = _FakeRunner("m1:engine-0", remote=True, wire_failures=2)
+        s = self._scorer([runner])
+        s.evaluate()
+        s.evaluate()
+        assert s.state("m1:engine-0") == HEALTH_DEGRADED
+        s.evaluate()  # eject-class evidence keeps its streak alive
+        assert s.state("m1:engine-0") == HEALTH_EJECTED
+        runner.consecutive_wire_failures = 0
+        s.evaluate()
+        s.evaluate()
+        assert s.state("m1:engine-0") == HEALTH_DEGRADED  # one level up
+
+    def test_kv_breaker_open_degrades(self):
+        class _Ch:
+            def __init__(self):
+                # long cooldown: stays OPEN for the whole test
+                self.breaker = CircuitBreaker(threshold=1, open_s=600.0)
+
+        ch = _Ch()
+        ch.breaker.record_failure()
+        runner = _FakeRunner("m1:engine-0", remote=True, kv_channel=ch)
+        s = self._scorer([runner])
+        s.evaluate()
+        s.evaluate()
+        assert s.state("m1:engine-0") == HEALTH_DEGRADED
+        assert "kv_breaker_open" in (
+            s.stats()["engines"]["m1:engine-0"]["reasons"])
+
+    def test_wedge_ejects_only_after_progress_then_stall(self):
+        mc = MetricsCollector()
+        runner = _FakeRunner("engine-0", active=2)
+        s = self._scorer([runner], metrics=mc, stall_s=0.05)
+        # never dispatched: queued work alone is NOT a wedge (a cold
+        # replica mid-compile must not read as wedged)
+        time.sleep(0.08)
+        s.evaluate()
+        s.evaluate()
+        assert s.state("engine-0") == HEALTH_HEALTHY
+        # progress, then a stall past stall_s with work queued
+        mc.perf.add_counter("step.engine-0.decode_block.dispatches", 5)
+        s.evaluate()
+        time.sleep(0.08)
+        s.evaluate()
+        s.evaluate()
+        s.evaluate()
+        assert s.state("engine-0") == HEALTH_EJECTED
+        reasons = s.stats()["engines"]["engine-0"]["reasons"]
+        assert "eject:stalled" in reasons
+        # progress resumes -> recovery walks back up
+        mc.perf.add_counter("step.engine-0.decode_block.dispatches", 1)
+        for _ in range(4):
+            s.evaluate()
+        assert s.state("engine-0") == HEALTH_HEALTHY
+
+    def test_wedge_clock_restarts_when_work_arrives_after_idle(self):
+        """Review regression: idle time is not stall time — an engine
+        that sat idle past stall_s must get the FULL stall window after
+        work arrives before it can read as wedged."""
+        mc = MetricsCollector()
+        runner = _FakeRunner("engine-0", active=0)
+        s = self._scorer([runner], metrics=mc, stall_s=0.2)
+        mc.perf.add_counter("step.engine-0.decode_block.dispatches", 3)
+        s.evaluate()  # progress observed, then a long idle stretch
+        time.sleep(0.3)
+        s.evaluate()  # still idle: clock keeps aging, but no work
+        runner._active = 2  # work arrives NOW
+        s.evaluate()
+        s.evaluate()
+        s.evaluate()
+        # evaluations are back-to-back: nowhere near stall_s since the
+        # work arrived, so no wedge despite the long idle gap
+        assert s.state("engine-0") == HEALTH_HEALTHY
+
+    def test_stamp_overlays_and_transitions_counted(self):
+        mc = MetricsCollector()
+        runner = _FakeRunner("m1:engine-0", remote=True, wire_failures=5)
+        s = self._scorer([runner], metrics=mc)
+        s.evaluate()
+        s.evaluate()
+        stamped = s.stamp([_status("m1:engine-0"), _status("other")])
+        assert stamped[0].health == HEALTH_DEGRADED
+        assert stamped[1].health == HEALTH_HEALTHY
+        snap = mc.snapshot().to_dict()
+        # transition counted (the gauge rides /metrics, not the snapshot)
+        assert "health" not in snap  # health block is served by the app
+        assert s.stats()["engines"]["m1:engine-0"]["state"] == (
+            HEALTH_DEGRADED)
+
+    def test_unregistered_engines_pruned(self):
+        runner = _FakeRunner("m1:engine-0", remote=True, wire_failures=5)
+        sched = _FakeScheduler([runner])
+        s = HealthScorer(HealthSettings(demote_after=1), sched)
+        s.evaluate()
+        assert s.state("m1:engine-0") == HEALTH_DEGRADED
+        sched._runners = []
+        s.evaluate()
+        assert s.state("m1:engine-0") == HEALTH_HEALTHY  # gone = default
+
+
+# ---------------------------------------------------------------------------
+# Routing tiering (Property 20 preserved)
+# ---------------------------------------------------------------------------
+
+
+class TestHealthTiering:
+    def test_rank_order(self):
+        assert (health_rank(HEALTH_HEALTHY) < health_rank(HEALTH_DEGRADED)
+                < health_rank(HEALTH_EJECTED))
+
+    def test_tier_prefers_healthy(self):
+        pool = [_status("a", "degraded"), _status("b"), _status("c")]
+        assert {s.engine_id for s in health_tier(pool)} == {"b", "c"}
+
+    def test_tier_falls_back_to_degraded_then_ejected(self):
+        pool = [_status("a", "degraded"), _status("b", "ejected")]
+        assert [s.engine_id for s in health_tier(pool)] == ["a"]
+        pool = [_status("b", "ejected")]
+        assert [s.engine_id for s in health_tier(pool)] == ["b"]
+
+    def test_choose_engine_avoids_degraded(self):
+        statuses = [_status("a", "degraded", load=0), _status("b", load=9)]
+        got = choose_engine(SchedulingStrategy.LEAST_LOADED, statuses, 0)
+        assert got == "b"  # degraded loses even at much lower load
+
+    def test_choose_engine_never_strands(self):
+        statuses = [_status("a", "ejected"), _status("b", "ejected")]
+        got = choose_engine(SchedulingStrategy.LEAST_LOADED, statuses, 0)
+        assert got == "a"  # Property 20: ejected beats a 503
+
+    def test_plan_route_excludes_ejected_peer_as_fetch_source(self):
+        digest = frozenset([11, 22, 33])
+        warm_ejected = _status("warm", "ejected", load=0,
+                               prefix_digest=digest, page_size=4)
+        cold = _status("cold", load=0, page_size=4)
+        plan = plan_route([warm_ejected, cold], [11, 22, 33])
+        assert plan is not None
+        # the ejected replica neither takes the request nor sources a
+        # fetch: the cold replica recomputes
+        assert plan.engine_id == "cold"
+        assert plan.decision == "recompute"
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rate -> degradation ladder
+# ---------------------------------------------------------------------------
+
+
+class TestBurnEscalation:
+    def _controller(self, mc, burn_min=5):
+        from distributed_inference_server_tpu.serving.degradation import (
+            DegradationController,
+        )
+        from distributed_inference_server_tpu.serving.dispatcher import (
+            Dispatcher,
+        )
+        from distributed_inference_server_tpu.serving.scheduler import (
+            AdaptiveScheduler,
+        )
+
+        d = Dispatcher(AdaptiveScheduler(), metrics=mc)
+        return DegradationController(
+            d, d.scheduler, metrics=mc, burn_high=0.5,
+            burn_min_requests=burn_min,
+        )
+
+    def test_burn_escalates_and_rung_lifts_on_decay(self):
+        """THE regression pin: a violated-heavy window floors the ladder
+        at REJECT_LOW_PRIORITY with memory pressure at zero; the rung
+        lifts once the short window decays."""
+        from distributed_inference_server_tpu.serving.degradation import (
+            DegradationLevel,
+        )
+
+        mc = MetricsCollector()
+        mc.configure_perf(0.05, 0.1)  # tiny window so decay is testable
+        ctl = self._controller(mc)
+        for _ in range(6):
+            mc.record_slo("default", "violated")
+        assert ctl.slo_burn_rate() == 1.0
+        assert ctl.evaluate(pressure=0.1) == (
+            DegradationLevel.REJECT_LOW_PRIORITY)
+        assert ctl.dispatcher.reject_low_priority
+        time.sleep(0.3)  # the window forgets the violations
+        assert ctl.slo_burn_rate() is None
+        assert ctl.evaluate(pressure=0.1) == DegradationLevel.NORMAL
+        assert not ctl.dispatcher.reject_low_priority
+
+    def test_half_burn_reduces_batch_size(self):
+        from distributed_inference_server_tpu.serving.degradation import (
+            DegradationLevel,
+        )
+
+        mc = MetricsCollector()
+        mc.configure_perf(5.0, 60.0)
+        ctl = self._controller(mc)
+        for _ in range(3):
+            mc.record_slo("default", "violated")
+        for _ in range(7):
+            mc.record_slo("default", "ok")
+        assert ctl.slo_burn_rate() == pytest.approx(0.3)
+        assert ctl.evaluate(pressure=0.1) == (
+            DegradationLevel.REDUCED_BATCH_SIZE)
+
+    def test_below_min_requests_never_escalates(self):
+        from distributed_inference_server_tpu.serving.degradation import (
+            DegradationLevel,
+        )
+
+        mc = MetricsCollector()
+        mc.configure_perf(5.0, 60.0)
+        ctl = self._controller(mc, burn_min=20)
+        for _ in range(6):
+            mc.record_slo("default", "violated")
+        assert ctl.slo_burn_rate() is None
+        assert ctl.evaluate(pressure=0.1) == DegradationLevel.NORMAL
+
+    def test_memory_still_wins_when_worse(self):
+        from distributed_inference_server_tpu.serving.degradation import (
+            DegradationLevel,
+        )
+
+        mc = MetricsCollector()
+        mc.configure_perf(5.0, 60.0)
+        ctl = self._controller(mc)
+        for _ in range(6):
+            mc.record_slo("default", "violated")
+        assert ctl.evaluate(pressure=0.97) == DegradationLevel.EMERGENCY
+
+
+# ---------------------------------------------------------------------------
+# Redispatch draws from the shared budget
+# ---------------------------------------------------------------------------
+
+
+class TestRedispatchBudget:
+    def test_dry_budget_declines_redispatch(self):
+        from distributed_inference_server_tpu.serving.dispatcher import (
+            Dispatcher,
+        )
+        from distributed_inference_server_tpu.serving.scheduler import (
+            AdaptiveScheduler,
+        )
+
+        mc = MetricsCollector()
+        rb = RetryBudget(ratio=0.0, min_retries=0, window_s=10.0,
+                         metrics=mc)
+        d = Dispatcher(AdaptiveScheduler(), metrics=mc, retry_budget=rb)
+        d._accepting = True
+        req = TestDispatcherShed()._request("rb-1")
+        assert d.redispatch(req, "engine-0", "crash") is False
+        snap = mc.snapshot().to_dict()
+        assert snap["resilience"]["redispatched"] == {"exhausted": 1}
+        assert snap["resilience"]["retry_denied"] == {"redispatch": 1}
+
+
+# ---------------------------------------------------------------------------
+# Config mapping + validation
+# ---------------------------------------------------------------------------
+
+
+class TestHealthConfig:
+    def test_settings_mapping(self):
+        cfg = ServerConfig.load(environ={
+            "DIS_TPU_HEALTH__STALL_S": "9.0",
+            "DIS_TPU_HEALTH__WIRE_FAILURES": "5",
+            "DIS_TPU_ADMISSION__DEADLINE_MS": "1234",
+            "DIS_TPU_ADMISSION__BROWNOUT": "false",
+        })
+        h = cfg.health_settings()
+        assert h.stall_s == 9.0 and h.wire_failures == 5
+        a = cfg.admission_settings()
+        assert a.deadline_ms == 1234.0 and a.brownout is False
+
+    @pytest.mark.parametrize("env,frag", [
+        ({"DIS_TPU_HEALTH__RECOVER_RATIO": "0.9"}, "recover_ratio"),
+        ({"DIS_TPU_HEALTH__LATENCY_RATIO": "1.2"}, "latency_ratio"),
+        ({"DIS_TPU_HEALTH__RETRY_BUDGET_RATIO": "1.5"},
+         "retry_budget_ratio"),
+        ({"DIS_TPU_HEALTH__DEMOTE_AFTER": "0"}, "demote_after"),
+        ({"DIS_TPU_ADMISSION__DEADLINE_FACTOR": "0"}, "deadline_factor"),
+        ({"DIS_TPU_ADMISSION__DEADLINE_MS": "-1"}, "deadline_ms"),
+    ])
+    def test_validation_rejects(self, env, frag):
+        with pytest.raises(ConfigError, match=frag):
+            ServerConfig.load(environ=env)

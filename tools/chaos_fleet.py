@@ -57,6 +57,9 @@ DEFAULT_SCENARIOS = (
     "rerole_flap",
     "cross_host_handoff_death",
     "remote_fetch_source_death",
+    "slow_member_brownout",
+    "breaker_flap",
+    "overload_shed",
 )
 
 _PROMPT = "chaos is a ladder, resilience is a lattice"
@@ -132,7 +135,8 @@ def _tiny_params():
 def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
                 channel="inproc", auto_restart=True, warmup=False,
                 handoff_timeout_s=20.0, engine_kwargs=None,
-                fleet=False, rerole=False, member_roles=("unified",)):
+                fleet=False, rerole=False, member_roles=("unified",),
+                health=None, admission=None, slo=None):
     """A tiny-model fleet wired exactly like production (the
     disagg_smoke.py topology, sans HTTP): real engines, real runners,
     real dispatcher/scheduler/controller. Health loop runs hot
@@ -148,7 +152,16 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
     ``member_roles`` sets the member's replica roles — ``("decode",)``
     makes it a cross-host handoff target. ``rerole=True`` arms the
     RoleBalancer with a short cooldown, its poll thread stopped so
-    scenarios drive ``evaluate()`` deterministically."""
+    scenarios drive ``evaluate()`` deterministically.
+
+    ``health`` / ``admission`` / ``slo`` (serving/health.py /
+    serving/teledigest.py settings objects) arm the gray-failure
+    defense scenarios: a chaos-paced HealthScorer (scenarios drive
+    ``evaluate()`` themselves — set a long interval), deadline-aware
+    admission, and short SLO digest windows so latency evidence decays
+    inside a scenario. ``slo`` is applied to the member server too —
+    digest epochs must agree or the host drops the member's telemetry
+    frames as foreign."""
     import jax.numpy as jnp
 
     from distributed_inference_server_tpu.engine.engine import (
@@ -195,6 +208,9 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
         disagg_settings=DisaggSettings(channel=channel,
                                        handoff_timeout_s=handoff_timeout_s),
         fleet_settings=fleet_settings,
+        health_settings=health,
+        admission_settings=admission,
+        slo_settings=slo,
     )
     srv.start()
     srv._fleet_worker = None
@@ -206,6 +222,7 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
             engine_roles=list(member_roles),
             auto_restart=auto_restart,
             health_check_interval_s=0.1,
+            slo_settings=slo,
         )
         worker_srv.start()
         srv._fleet_worker_srv = worker_srv
@@ -240,6 +257,7 @@ def _ensure_worker(srv, timeout_s: float = 20.0):
             fw.stop()
         fw = FleetWorker(srv._fleet_worker_srv.scheduler,
                          srv._fleet_worker_settings, member_id="chaos-w1",
+                         metrics=srv._fleet_worker_srv.metrics,
                          tracer=srv._fleet_worker_srv.tracer)
         fw.start()
         srv._fleet_worker = fw
@@ -695,6 +713,285 @@ def scenario_remote_fetch_source_death(srv, seed: int):
     return sinks, True, [f"{r}: no terminal event (wedged)" for r in wedged]
 
 
+def _drive_remote(srv, rid: str, prompt: str = _PROMPT,
+                  max_tokens: int = 8, sinks=None):
+    """Submit one request straight at the member's remote proxy (the
+    deterministic way to put TTFT samples in the MEMBER's digests)."""
+    from distributed_inference_server_tpu.engine.engine import SamplingParams
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.runner import ServerRequest
+
+    remote = next(r for r in srv.scheduler.engines()
+                  if getattr(r, "is_remote", False))
+    sink = ChaosSink(rid)
+    remote.submit([ServerRequest(
+        rid, ByteTokenizer().encode(prompt),
+        SamplingParams(max_tokens=max_tokens, temperature=0.0), sink,
+    )])
+    if sinks is not None:
+        sinks.append(sink)
+    return sink
+
+
+def _remote_health(srv) -> str:
+    remote = next(r for r in srv.scheduler.engines()
+                  if getattr(r, "is_remote", False))
+    return srv.health.state(remote.engine_id)
+
+
+def scenario_slow_member_brownout(srv, seed: int):
+    """The gray failure itself (docs/RESILIENCE.md "Gray failures and
+    overload"): a member serves every forwarded request through a
+    fleet.slow_member delay while heartbeating healthily. Its own TTFT
+    telemetry carries the slowness to the host, whose HealthScorer must
+    demote it (healthy -> degraded) so routing drains it WITHOUT a
+    single client error — and once the delay clears and the windowed
+    evidence decays, promote it back to healthy."""
+    rng = random.Random(seed)
+    _ensure_worker(srv)
+    sinks = []
+    extra = []
+
+    def traffic(tag, n_local, n_remote, wait=True):
+        batch = []
+        for i in range(n_local):
+            s = submit(srv, f"smb-{seed}-{tag}-l{i}", max_tokens=8,
+                       sinks=sinks)
+            if s is not None:
+                batch.append(s)
+        for i in range(n_remote):
+            batch.append(_drive_remote(srv, f"smb-{seed}-{tag}-r{i}",
+                                       sinks=sinks))
+        if wait:
+            wait_terminal(batch, timeout_s=90.0)
+        return batch
+
+    # phase 1: both sources collect windowed TTFT samples while the
+    # member is SLOW (delay >> the tiny model's local TTFT)
+    _arm(f"fleet.slow_member:prob=1.0,delay_ms={rng.randint(350, 450)},"
+         "times=1000", seed)
+    traffic("warm", 4, 4)
+    # the member's digests ride its next heartbeat; demotion needs
+    # demote_after consecutive bad evaluations on fresh telemetry
+    deadline = time.monotonic() + 20.0
+    while (time.monotonic() < deadline
+           and _remote_health(srv) != "degraded"):
+        traffic("evid", 1, 1)
+        srv.health.evaluate()
+        time.sleep(0.15)
+    if _remote_health(srv) != "degraded":
+        extra.append(
+            f"slow member never demoted (health={_remote_health(srv)}, "
+            f"stats={srv.health.stats()})")
+    else:
+        # degraded member drained: new admissions must complete clean
+        # (routing tiers them onto the healthy local replica)
+        traffic("drain", 3, 0)
+    from distributed_inference_server_tpu.serving import faults as _faults
+
+    _faults.clear()  # the member is fast again
+    # recovery: fresh fast samples push the member's windowed p99 back
+    # under recover_ratio x the median as the slow epochs fall out of
+    # the short chaos window; recover_after clean evals promote it
+    deadline = time.monotonic() + 30.0
+    while (time.monotonic() < deadline
+           and _remote_health(srv) != "healthy"):
+        traffic("recov", 1, 1)
+        srv.health.evaluate()
+        time.sleep(0.25)
+    if _remote_health(srv) != "healthy":
+        extra.append(
+            f"member never recovered (health={_remote_health(srv)}, "
+            f"stats={srv.health.stats()})")
+    wedged = wait_terminal(sinks, timeout_s=90.0)
+    extra += [f"{r}: no terminal event (wedged)" for r in wedged]
+    return sinks, True, extra
+
+
+def scenario_breaker_flap(srv, seed: int):
+    """A flapping KV data wire (fleet.wire_timeout) under cross-host
+    handoffs: the channel's circuit breaker must open after
+    health.wire_failures consecutive failures (handoffs degrade to
+    decode-in-place, exactly once — and ELECTION skips the member, so
+    streams stop being attempted at all), re-probe after
+    breaker_open_s, and close once the wire heals. The flip count must
+    stay bounded by the cooldown — a flapping wire must not flap the
+    breaker faster than its hysteresis allows."""
+    rng = random.Random(seed)
+    _ensure_worker(srv)
+    sinks = []
+    extra = []
+
+    def breaker():
+        stats = srv.fleet_server.kv_stats().get("chaos-w1", {})
+        return stats.get("breaker", {})
+
+    def breaker_history():
+        with srv.fleet_server._lock:
+            sessions = list(srv.fleet_server._sessions)
+        for session in sessions:
+            with session._lock:
+                ch = session.kv_channel
+            if ch is not None and session.member_id == "chaos-w1":
+                return ch.breaker.history()
+        return []
+
+    fires = rng.randint(4, 6)
+    _arm(f"fleet.wire_timeout:prob=1.0,times={fires}", seed)
+    # every admission wants a cross-host migration (host prefill ->
+    # member decode); each failed stream walks the breaker toward open
+    for i in range(4):
+        submit(srv, f"bf-{seed}-{i}", max_tokens=rng.randint(24, 40),
+               sinks=sinks)
+        wait_terminal(sinks[-1:], timeout_s=90.0)
+        if breaker().get("state") == "open":
+            break
+    if breaker().get("state") != "open":
+        extra.append(f"breaker never opened: {breaker()}")
+    from distributed_inference_server_tpu.serving import faults as _faults
+
+    _faults.clear()  # the wire heals
+    # half-open probe: after the cooldown the next handoff is allowed
+    # through and must close the breaker
+    open_s = srv.health_settings.breaker_open_s
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and breaker().get("state") != "closed":
+        time.sleep(max(0.05, open_s / 4))
+        submit(srv, f"bf-{seed}-p{int(time.monotonic() * 1000)}",
+               max_tokens=16, sinks=sinks)
+        wait_terminal(sinks[-1:], timeout_s=90.0)
+    stats = breaker()
+    if stats.get("state") != "closed":
+        extra.append(f"breaker never re-closed after heal: {stats}")
+    # THE hysteresis property: no half-open probe window opens before
+    # the cooldown elapsed since the breaker opened (flip rate is
+    # bounded by open_s, however hard the wire flaps)
+    history = breaker_history()
+    last_open = None
+    probes = 0
+    for t, state in history:
+        if state == "open":
+            last_open = t
+        elif state == "half_open":
+            probes += 1
+            if last_open is not None and t - last_open < open_s * 0.85:
+                extra.append(
+                    f"breaker half-opened {t - last_open:.3f}s after "
+                    f"opening (cooldown {open_s}s) — hysteresis broken")
+    if probes < 1:
+        extra.append(f"breaker never probed half-open: {history}")
+    wedged = wait_terminal(sinks, timeout_s=90.0)
+    extra += [f"{r}: no terminal event (wedged)" for r in wedged]
+    return sinks, True, extra
+
+
+def scenario_overload_shed(srv, seed: int):
+    """Deadline-aware admission under synthetic overload: with the
+    windowed queue-wait estimate blown past the TTFT-SLO deadline,
+    new submissions must shed AT ADMISSION — AdmissionShed (503 +
+    Retry-After upstream), decided fast, with the distinct terminal in
+    the flight recorder and requests_shed_total counted — while already
+    admitted traffic completes and, once the short window decays,
+    admission recovers. Shed requests never touch an engine: the page
+    audit proves zero leak."""
+    rng = random.Random(seed)
+    from distributed_inference_server_tpu.engine.engine import SamplingParams
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.health import AdmissionShed
+    from distributed_inference_server_tpu.serving.runner import ServerRequest
+
+    sinks = []
+    extra = []
+    # phase 1: normal service
+    for i in range(2):
+        submit(srv, f"os-{seed}-a{i}", max_tokens=8, sinks=sinks)
+    wait_terminal(sinks, timeout_s=90.0)
+    # phase 2: synthetic overload — the queue-wait digest reads like a
+    # fleet whose backlog already exceeds every deadline (the organic
+    # feeder is flightrec's phase partition; the digest is the contract)
+    for _ in range(12):
+        srv.metrics.perf_store().observe("queue_wait_ms",
+                                         rng.uniform(1500, 2500))
+    time.sleep(0.35)  # the admission estimator caches ~250 ms
+    shed = 0
+    for i in range(3):
+        sink = ChaosSink(f"os-{seed}-s{i}")
+        t0 = time.monotonic()
+        try:
+            srv.dispatcher.submit(ServerRequest(
+                sink.rid, ByteTokenizer().encode(_PROMPT),
+                SamplingParams(max_tokens=8, temperature=0.0), sink,
+            ))
+        except AdmissionShed as e:
+            shed += 1
+            decide_ms = (time.monotonic() - t0) * 1000.0
+            if decide_ms > 50.0:
+                extra.append(f"shed decision took {decide_ms:.1f}ms "
+                             "(want < 50ms)")
+            if e.retry_after_s < 1.0:
+                extra.append(f"Retry-After hint {e.retry_after_s} < 1s")
+            tl = srv.recorder.timeline(sink.rid)
+            if tl is None or tl.get("code") != "admission_shed":
+                extra.append(f"{sink.rid}: no admission_shed terminal "
+                             f"in the flight recorder (got {tl})")
+        else:
+            # admitted against a blown estimate: a violation — but the
+            # request is live, so track its sink for exactly-once
+            sinks.append(sink)
+            extra.append(f"{sink.rid}: admitted despite overload")
+    if shed == 0:
+        extra.append("no requests shed under synthetic overload")
+    snap = srv.metrics.snapshot().to_dict()
+    shed_counts = (snap.get("resilience") or {}).get("requests_shed", {})
+    if not shed_counts:
+        extra.append("requests_shed_total never counted")
+    # phase 3: the short chaos SLO window decays; admission recovers
+    deadline = time.monotonic() + 15.0
+    recovered = None
+    while time.monotonic() < deadline and recovered is None:
+        time.sleep(0.5)
+        s = submit(srv, f"os-{seed}-r{int(time.monotonic() * 1000)}",
+                   max_tokens=8, sinks=sinks)
+        recovered = s
+    if recovered is None:
+        extra.append("admission never recovered after the window decayed")
+    wedged = wait_terminal(sinks, timeout_s=90.0)
+    extra += [f"{r}: no terminal event (wedged)" for r in wedged]
+    return sinks, True, extra
+
+
+#: chaos-paced gray-failure settings (serving/health.py): scenarios
+#: drive evaluate() themselves (interval_s=60), evidence windows short
+#: enough to decay inside one scenario, thresholds low enough for a
+#: tiny CPU fleet's jitter
+def _chaos_health():
+    from distributed_inference_server_tpu.serving.health import (
+        HealthSettings,
+    )
+
+    return HealthSettings(
+        interval_s=60.0, stall_s=10.0, latency_ratio=2.5,
+        recover_ratio=1.2, demote_after=2, recover_after=2,
+        min_window_requests=3, wire_failures=2, breaker_open_s=0.4,
+    )
+
+
+def _chaos_slo():
+    from distributed_inference_server_tpu.serving.teledigest import (
+        SloSettings,
+    )
+
+    return SloSettings(ttft_ms=300.0, window_s=4.0, epoch_s=0.5)
+
+
+def _chaos_admission():
+    from distributed_inference_server_tpu.serving.health import (
+        AdmissionSettings,
+    )
+
+    return AdmissionSettings(min_window_requests=4)
+
+
 #: scenario -> (fn, fleet kwargs)
 SCENARIOS = {
     "redispatch": (scenario_redispatch, {}),
@@ -746,6 +1043,30 @@ SCENARIOS = {
                                    "member_roles": ("unified",),
                                    "engine_kwargs": {
                                        "native_allocator": False}}),
+    # gray-failure defense (docs/RESILIENCE.md "Gray failures and
+    # overload"): a slow-but-alive member demoted and drained by the
+    # latency-scored HealthScorer, then recovered (the two-sided
+    # hysteresis); short SLO windows so the evidence decays in-scenario
+    "slow_member_brownout": (scenario_slow_member_brownout,
+                             {"roles": ("unified",), "fleet": True,
+                              "member_roles": ("unified",),
+                              "health": _chaos_health(),
+                              "slo": _chaos_slo()}),
+    # the data-channel circuit breaker under a flapping wire: host
+    # prefill -> member decode, every admission wants a cross-host
+    # migration stream (the cross_host_handoff_death topology)
+    "breaker_flap": (scenario_breaker_flap,
+                     {"roles": ("prefill",), "fleet": True,
+                      "member_roles": ("decode",),
+                      "health": _chaos_health()}),
+    # deadline-aware admission shedding under synthetic overload: TTFT
+    # SLO armed so requests HAVE a deadline, short windows so the
+    # overload evidence decays and admission recovers in-scenario
+    "overload_shed": (scenario_overload_shed,
+                      {"roles": ("unified",),
+                       "health": _chaos_health(),
+                       "slo": _chaos_slo(),
+                       "admission": _chaos_admission()}),
 }
 
 

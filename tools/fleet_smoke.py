@@ -60,12 +60,26 @@ def _env_setup() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def _build_server(fleet_settings=None, engine_roles=None):
+def _smoke_slo():
+    """One SLO digest geometry for EVERY smoke process: the host drops
+    member telemetry whose epoch_s disagrees, and the degrade-and-
+    recover leg needs a window short enough for latency evidence to
+    decay inside the smoke."""
+    from distributed_inference_server_tpu.serving.teledigest import (
+        SloSettings,
+    )
+
+    return SloSettings(window_s=8.0, epoch_s=1.0)
+
+
+def _build_server(fleet_settings=None, engine_roles=None, health=None):
     """One-engine InferenceServer on the seeded tiny model (both
     processes build identical params: PRNGKey(0) is deterministic).
     ``engine_roles`` (a LIST, e.g. ``["prefill"]`` / ``["decode"]``)
     shapes the cross-host-handoff leg: the host prefills, a decode-role
-    worker is the migration target over the KV data channel."""
+    worker is the migration target over the KV data channel. ``health``
+    (serving/health.py HealthSettings) paces the host's gray-failure
+    scorer for the degrade-and-recover leg."""
     import jax
     import jax.numpy as jnp
 
@@ -99,6 +113,7 @@ def _build_server(fleet_settings=None, engine_roles=None):
         num_engines=len(engine_roles) if engine_roles else 1,
         engine_roles=engine_roles,
         auto_restart=False, fleet_settings=fleet_settings,
+        slo_settings=_smoke_slo(), health_settings=health,
     )
     srv.start()
     return srv
@@ -139,21 +154,27 @@ def _request(rid: str):
 
 
 def run_worker(connect: str, role: str = "",
-               member_id: str = MEMBER_ID, http_port: int = 0) -> int:
+               member_id: str = MEMBER_ID, http_port: int = 0,
+               fault_spec: str = "") -> int:
     """Child process: one engine + a FleetWorker joined to ``connect``;
     serves until killed. ``role`` ("decode") makes this member the
     cross-host handoff target over its KV data channel. ``http_port``
     > 0 serves the member's own HTTP surface there (the perf leg
-    fetches its /server/perf digests). SIGTERM runs a
-    page-conservation audit and exits with its verdict — the host's
-    "clean audits both sides" check."""
+    fetches its /server/perf digests). ``fault_spec`` arms a seeded
+    FaultSet in THIS process (the degrade-and-recover leg's
+    fleet.slow_member delay; a bounded ``times=`` makes the fault
+    self-clearing). SIGTERM runs a page-conservation audit and exits
+    with its verdict — the host's "clean audits both sides" check."""
     _env_setup()
+    from distributed_inference_server_tpu.serving import faults
     from distributed_inference_server_tpu.serving.fleet import FleetSettings
     from distributed_inference_server_tpu.serving.remote_runner import (
         FleetWorker,
     )
 
     srv = _build_server(engine_roles=[role] if role else None)
+    if fault_spec:
+        faults.install(faults.parse_spec(fault_spec, seed=0))
     worker = FleetWorker(
         srv.scheduler,
         FleetSettings(connect=connect, heartbeat_interval_s=0.2),
@@ -536,18 +557,144 @@ def _handoff_leg(srv, port: int, registry_port: int,
             child.wait(timeout=10)
 
 
+def _degrade_leg(srv, port: int, registry_port: int) -> Optional[str]:
+    """The gray-failure degrade-and-recover acceptance
+    (docs/RESILIENCE.md "Gray failures and overload"): a THIRD worker
+    joins with ``fleet.slow_member`` armed (every serve delayed 300 ms,
+    self-clearing after a bounded ``times=``). The host must DEMOTE it
+    on its own shipped latency telemetry — visible in the
+    ``/server/stats`` health block — concurrent HTTP traffic must stay
+    within 2× the healthy-fleet p99 baseline while the slow member is
+    routed around (vs unbounded if it kept taking traffic), and once
+    the delay exhausts and the windowed evidence decays the member must
+    return to healthy routing. Returns a violation string or None."""
+    slow_id = "smoke-w3"
+    delay_fires = 16
+
+    def lat_of(n):
+        """Client-observed wall times of n serial HTTP /generate calls
+        (the 'concurrent traffic' the acceptance bounds)."""
+        out = []
+        for _ in range(n):
+            t = time.monotonic()
+            _http_json("POST", f"http://127.0.0.1:{port}/generate",
+                       {"prompt": _PROMPT, "max_tokens": 8,
+                        "temperature": 0.0})
+            out.append(time.monotonic() - t)
+        return out
+
+    def slow_state():
+        stats = _http_json("GET",
+                           f"http://127.0.0.1:{port}/server/stats")
+        engines = (stats.get("health") or {}).get("engines", {})
+        return engines.get(f"{slow_id}:engine-0", {}).get("state")
+
+    # healthy-fleet baseline BEFORE the slow member exists
+    baseline = sorted(lat_of(6))
+    base_p99 = baseline[-1]
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--connect", f"127.0.0.1:{registry_port}",
+         "--member-id", slow_id,
+         "--fault-spec",
+         f"fleet.slow_member:prob=1.0,delay_ms=300,times={delay_fires}"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        deadline = time.monotonic() + 240.0
+        slow = None
+        while time.monotonic() < deadline:
+            slow = next(
+                (r for r in srv.scheduler.engines()
+                 if getattr(r, "is_remote", False) and r.is_healthy()
+                 and r.engine_id.startswith(slow_id + ":")), None)
+            if slow is not None:
+                break
+            if child.poll() is not None:
+                return "slow worker died before joining"
+            time.sleep(0.1)
+        if slow is None:
+            return "slow worker never joined the registry"
+
+        # evidence: the slow member serves (delayed) requests so its
+        # shipped TTFT digest carries the slowness; local traffic keeps
+        # the host's own digest warm for the median comparison
+        fires = 0
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and slow_state() != "degraded":
+            req, sink = _request(f"smoke-slow-{fires}")
+            slow.submit([req])
+            sink.ev.wait(30.0)
+            fires += 1
+            lat_of(1)
+        if slow_state() != "degraded":
+            stats = _http_json("GET",
+                               f"http://127.0.0.1:{port}/server/stats")
+            return ("slow member never demoted; health block = "
+                    f"{stats.get('health')}")
+        print(f"fleet-smoke: slow member demoted to degraded after "
+              f"{fires} slow serves (visible in /server/stats) OK",
+              flush=True)
+
+        # concurrent traffic routes AROUND the degraded member: p99
+        # stays within 2x the healthy baseline (a round through the
+        # 300 ms-delayed member would blow it)
+        degraded = sorted(lat_of(6))
+        if degraded[-1] > 2.0 * max(base_p99, 0.05):
+            return (f"p99 under a degraded member {degraded[-1]:.3f}s "
+                    f"> 2x healthy baseline {base_p99:.3f}s — traffic "
+                    "was not routed around it")
+        print(f"fleet-smoke: degraded-fleet p99 {degraded[-1]:.3f}s "
+              f"within 2x baseline {base_p99:.3f}s OK", flush=True)
+
+        # recovery: burn the remaining delay fires (the fault is
+        # self-clearing), then fast serves + window decay promote the
+        # member back to healthy routing
+        deadline = time.monotonic() + 90.0
+        i = 0
+        while time.monotonic() < deadline and slow_state() != "healthy":
+            req, sink = _request(f"smoke-recov-{i}")
+            slow.submit([req])
+            sink.ev.wait(30.0)
+            i += 1
+            lat_of(1)
+        if slow_state() != "healthy":
+            stats = _http_json("GET",
+                               f"http://127.0.0.1:{port}/server/stats")
+            return ("slow member never recovered after the fault "
+                    f"cleared; health block = {stats.get('health')}")
+        print("fleet-smoke: member recovered to healthy routing after "
+              "the fault cleared OK", flush=True)
+        child.terminate()
+        rc = child.wait(timeout=30)
+        if rc != 0:
+            return f"slow worker audit exited {rc}"
+        return None
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=10)
+
+
 def run_host() -> int:
     _env_setup()
     from distributed_inference_server_tpu.serving.fleet import FleetSettings
+    from distributed_inference_server_tpu.serving.health import (
+        HealthSettings,
+    )
     t0 = time.monotonic()
     # the host's engine is PREFILL-role: once a decode-role member
     # joins (the handoff leg), every admission migrates cross-host;
     # until then prefill admits unified — the earlier legs see exactly
-    # the old behavior
+    # the old behavior. The health scorer runs smoke-paced (fast
+    # evaluations, small windows) for the degrade-and-recover leg.
     srv = _build_server(FleetSettings(
         enabled=True, heartbeat_interval_s=0.2, suspect_after_s=1.0,
         dead_after_s=2.0,
-    ), engine_roles=["prefill"])
+    ), engine_roles=["prefill"], health=HealthSettings(
+        interval_s=0.25, demote_after=2, recover_after=2,
+        min_window_requests=4, latency_ratio=2.5, recover_ratio=1.2,
+    ))
     port = srv.fleet_server.bound_port
     print(f"fleet-smoke host: registry on 127.0.0.1:{port}", flush=True)
 
@@ -621,6 +768,11 @@ def run_host() -> int:
         if not ref_text:
             return _fail(f"HTTP reference returned no text: {ref_resp}")
         violation = _handoff_leg(srv, http_port, port, ref_text)
+        if violation is not None:
+            return _fail(violation)
+
+        # -- 2.7 gray-failure degrade-and-recover -----------------------
+        violation = _degrade_leg(srv, http_port, port)
         if violation is not None:
             return _fail(violation)
 
@@ -699,11 +851,16 @@ def main() -> int:
                     help="worker mode: serve the member's HTTP surface "
                     "on this port (0 = none; the perf leg fetches its "
                     "/server/perf)")
+    ap.add_argument("--fault-spec", default="",
+                    help="worker mode: arm this fault spec in the "
+                    "worker process (the degrade-and-recover leg's "
+                    "fleet.slow_member delay)")
     args = ap.parse_args()
     if args.worker:
         return run_worker(args.connect, role=args.role,
                           member_id=args.member_id,
-                          http_port=args.http_port)
+                          http_port=args.http_port,
+                          fault_spec=args.fault_spec)
     return run_host()
 
 
