@@ -264,6 +264,21 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # fallback instead of queueing behind multi-MB transfers
         "kv_max_streams": (int, 4),
         "kv_connect_timeout_s": (float, 5.0),
+        # member<->member KV mesh (serving/fleet_mesh.py; docs/FLEET.md
+        # "KV mesh"): the registry brokers introductions (KvIntro
+        # frames) and members dial each other's data listeners
+        # directly, so fetch bytes scale with member count instead of
+        # relaying through the registry host
+        "mesh_enabled": (bool, False),
+        # learned wire rates (MeshWireRates): observed chunk
+        # bytes/seconds aggregate in a sliding window this wide; a
+        # wire with no observation in the window is COLD and charges
+        # kv_page_cost as the prior. kv_rate_prior is the byte rate
+        # kv_page_cost is assumed to price (default ~1 Gbit/s) — the
+        # learned cost is kv_page_cost * prior/learned, clamped.
+        # kv_rate_prior=0 disables learned pricing (constant only).
+        "kv_rate_window_s": (float, 30.0),
+        "kv_rate_prior": (float, 125000000.0),
     },
     "health": {
         # gray-failure defense (serving/health.py HealthScorer;
@@ -613,6 +628,9 @@ class ServerConfig:
             kv_data_port=f["kv_data_port"],
             kv_max_streams=f["kv_max_streams"],
             kv_connect_timeout_s=f["kv_connect_timeout_s"],
+            mesh_enabled=f["mesh_enabled"],
+            kv_rate_window_s=f["kv_rate_window_s"],
+            kv_rate_prior=f["kv_rate_prior"],
         )
 
     def slo_settings(self):
@@ -925,6 +943,14 @@ class ServerConfig:
         if f["kv_connect_timeout_s"] <= 0:
             raise ConfigError(
                 "fleet.kv_connect_timeout_s must be positive"
+            )
+        # KV mesh learned wire costs (serving/fleet_mesh.py)
+        if f["kv_rate_window_s"] <= 0:
+            raise ConfigError("fleet.kv_rate_window_s must be positive")
+        if f["kv_rate_prior"] < 0:
+            raise ConfigError(
+                "fleet.kv_rate_prior must be >= 0 (0 disables learned "
+                "pricing)"
             )
 
     def hot_diff(self, other: "ServerConfig") -> Dict[tuple, Any]:

@@ -743,7 +743,10 @@ class DisaggController:
             for _ in job.chunks:
                 faults.fire("disagg.chunk")
             target = self.scheduler.schedule_decode(
-                exclude=job.source.engine_id
+                exclude=job.source.engine_id,
+                # the election charges each remote candidate's learned
+                # wire rate for THESE pages (serving/fleet_mesh.py)
+                pages=job.n_prefix_pages,
             )
             if target is None:
                 raise HandoffError("no healthy decode engine")
@@ -943,7 +946,11 @@ class DisaggController:
                 return
             job.attempts += 1
             target = self.scheduler.schedule_decode(
-                exclude=job.source.engine_id
+                exclude=job.source.engine_id,
+                # pages the move would put on the wire (0 for a
+                # monolithic export: the election stays least-loaded)
+                pages=sum(c.page_count
+                          for c in job.exp.kv_chunks or ()),
             )
             if target is None:
                 last_err = "no healthy decode engine"
@@ -1131,17 +1138,27 @@ class PrefixFetcher:
     def __init__(self, channel: Optional[KVTransferChannel] = None,
                  settings: Optional[DisaggSettings] = None,
                  metrics: Optional[MetricsCollector] = None,
-                 tracer=None, recorder=None):
+                 tracer=None, recorder=None, mesh_route=None):
         """``tracer``/``recorder`` (docs/OBSERVABILITY.md): each fetch
         gets a ``kv.prefix_fetch`` span parented on the trace context
         that round-tripped through the KvPrefixFetch wire fields, and
         settles a ``prefix_fetch`` timeline event whose duration feeds
-        the ``peer_fetch`` phase attribution."""
+        the ``peer_fetch`` phase attribution.
+
+        ``mesh_route`` (``(target_member, peer_member) -> bool``,
+        docs/FLEET.md "KV mesh"): when both the fetch target and the
+        warm peer are fleet members and the registry has introduced
+        that wire, the fetch is DELEGATED — the request is submitted to
+        the target with a fetch hint and the target's member pulls the
+        chunks directly from the peer over its own data channel. The
+        bulk bytes never touch the registry."""
         self.channel = channel or InProcessChannel()
         self.settings = settings or DisaggSettings()
         self.metrics = metrics
         self.tracer = tracer
         self.recorder = recorder
+        self.mesh_route = mesh_route  # distlint: ignore[DL008] — set
+        # once by server wiring before traffic; read-only afterwards
         self._lock = threading.Lock()
         # request_id -> aborted? for fetches in flight (score→submit)
         self._fetching: Dict[Any, bool] = {}
@@ -1185,6 +1202,38 @@ class PrefixFetcher:
         # wire-thread stage are skipped — the channel's own worker and
         # reader threads own serialization
         remote_peer = getattr(peer, "is_remote", False)
+        if (remote_peer and getattr(target, "is_remote", False)
+                and self.mesh_route is not None):
+            # member->member mesh delegation (docs/FLEET.md "KV mesh"):
+            # both ends are fleet members and the registry brokered the
+            # wire — ship the fetch PLAN to the target instead of the
+            # bytes through this host. The target's member dials the
+            # peer directly; any failure over there degrades to plain
+            # recompute on the member, exactly once, so the request is
+            # never gated on the mesh. Not registered in _fetching: the
+            # submit happens NOW (the hint rides the FleetSubmit frame)
+            # and the member settles its own fetch metrics.
+            t_member = target.engine_id.rsplit(":", 1)[0]
+            p_member = peer.engine_id.rsplit(":", 1)[0]
+            if t_member != p_member and self.mesh_route(t_member,
+                                                        p_member):
+                if self.metrics:
+                    self.metrics.record_prefix_fetch(
+                        "delegated", scope="mesh")
+                if self.recorder is not None:
+                    self.recorder.note(rid, "prefix_fetch",
+                                       outcome="delegated", seconds=0.0,
+                                       bytes=0, peer=peer.engine_id,
+                                       target=target.engine_id)
+                target.submit([req], fetch_hint={
+                    "fetch_member": p_member,
+                    "fetch_source_engine": getattr(
+                        peer, "local_engine_id", peer.engine_id),
+                    "fetch_hashes": list(plan.prefix_hashes or ()),
+                    "fetch_chunk_pages": self.settings.chunk_pages,
+                    "fetch_wire_quant": self.settings.wire_quant,
+                })
+                return
         scope = "remote" if remote_peer else "local"
         with self._lock:
             self._fetching[rid] = False

@@ -88,6 +88,18 @@ Point catalog (the authoritative list lives in docs/RESILIENCE.md):
                         repeated hits are the scorer's wire-failure
                         eject evidence and walk the data channel's
                         circuit breaker closed → open
+``fleet.kv_intro``      a KvIntro introduction frame dies on the
+                        registry's control wire (serving/fleet.py
+                        ``_send_intro``) — the pair is never
+                        introduced, mesh fetch hints for it degrade to
+                        plain recompute on the member, and the intro
+                        is re-brokered when the endpoint next changes
+``fleet.kv_peer_dial``  the lazy dial of a MEMBER's peer data channel
+                        fails (serving/fleet_mesh.py MeshClient wires;
+                        the member->member analogue of
+                        ``fleet.kv_connect``) — the hinted mesh fetch
+                        degrades to recompute exactly once, zero page
+                        leak, and the wire's breaker walks toward open
 ======================  ====================================================
 """
 

@@ -103,6 +103,18 @@ class FleetSettings:
     kv_data_port: int = 0
     kv_max_streams: int = 4
     kv_connect_timeout_s: float = 5.0
+    # KV mesh (serving/fleet_mesh.py; docs/FLEET.md "KV mesh"): the
+    # registry brokers member endpoints over KvIntro frames and members
+    # dial each other directly — bulk fetch bytes skip the registry.
+    # Off by default: the relay topology is the compatible baseline.
+    mesh_enabled: bool = False
+    # learned wire-rate window and prior (serving/fleet_mesh.py): rates
+    # older than the window are forgotten; kv_rate_prior (bytes/s) is
+    # the rate kv_page_cost is assumed to price — a wire measured at
+    # the prior costs exactly the constant. <= 0 disables learned
+    # pricing (every wire charges the constant).
+    kv_rate_window_s: float = 30.0
+    kv_rate_prior: float = 125000000.0
 
 
 # ---------------------------------------------------------------------------
@@ -119,6 +131,9 @@ FRAME_KINDS: Dict[int, str] = {
     # fleet-federated performance telemetry (serving/teledigest.py):
     # member digests + step-clock counters, heartbeat-piggybacked
     5: "FleetTelemetry",
+    # KV mesh introduction (serving/fleet_mesh.py): registry host ->
+    # worker, brokering member-to-member data-plane endpoints
+    6: "KvIntro",
 }
 _KIND_BY_NAME = {name: kind for kind, name in FRAME_KINDS.items()}
 
@@ -572,6 +587,8 @@ class _MemberSession:
             return  # beat dropped (fleet.heartbeat fault) — no refresh
         self.server._ensure_kv_channel(self, member_id,
                                        obj.get("data_port", 0))
+        self.server._broker_intros(self, member_id,
+                                   obj.get("data_port", 0))
         self.server._refresh_runners(self, member_id, obj.get("engines", []),
                                      statuses, rejoined=prev == MEMBER_DEAD)
 
@@ -676,6 +693,27 @@ class FleetServer:
         # counters, serving/teledigest.py), merged at GET /server/perf;
         # guarded by _lock, pruned by age at snapshot time
         self._telemetry: Dict[str, Dict[str, Any]] = {}
+        # learned per-wire transfer rates (serving/fleet_mesh.py): the
+        # host's own channels observe locally; member-to-member wires
+        # arrive as cumulative kvwire counters on fleet telemetry.
+        # Always on — cold wires price at the configured constant, so
+        # nothing changes until bytes actually flow.
+        from distributed_inference_server_tpu.serving.fleet_mesh import (
+            MeshWireRates,
+        )
+
+        self.mesh_rates = MeshWireRates(
+            window_s=self.settings.kv_rate_window_s,
+            prior_rate=self.settings.kv_rate_prior,
+            metrics=metrics,
+        )
+        # KV mesh broker state (guarded by _lock): member_id -> its
+        # last-published (host, data_port) endpoint, and the cumulative
+        # kvwire counter values last seen per (member, src, dst) so the
+        # telemetry ingest can feed DELTAS into the rate window
+        self._intro_endpoints: Dict[str, Tuple[str, int]] = {}
+        self._kvwire_last: Dict[Tuple[str, str, str],
+                                Tuple[float, float, float]] = {}
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
@@ -826,6 +864,7 @@ class FleetServer:
             }
             pruned = self._prune_telemetry_locked(time.monotonic())
         self._drop_member_series(pruned)
+        self._ingest_wire_counters(member, counters)
         if self.metrics is not None:
             # exactly ONE outcome per frame: a frame that lost digests
             # to the epoch guard must not also read as cleanly ingested
@@ -849,6 +888,45 @@ class FleetServer:
             self.metrics.set_member_telemetry(member, step_tokens,
                                               ttft_p99)
 
+    def _ingest_wire_counters(self, member: str,
+                              counters: Dict[str, float]) -> None:
+        """Feed the member's cumulative ``kvwire|src|dst|*`` counters
+        (serving/fleet_mesh.py — its mesh channels' observed bulk
+        bytes/seconds/chunks) into the host's learned-rate windows as
+        DELTAS against the last frame. A counter running backwards
+        means the member's telemetry restarted: the current value IS
+        the delta then (same reasoning as any cumulative-counter
+        scrape)."""
+        wires: Dict[Tuple[str, str], Dict[str, float]] = {}
+        from distributed_inference_server_tpu.serving.fleet_mesh import (
+            WIRE_COUNTER_PREFIX,
+        )
+
+        for name, value in counters.items():
+            if not name.startswith(WIRE_COUNTER_PREFIX):
+                continue
+            parts = name.split("|")
+            if len(parts) != 4 or parts[3] not in ("bytes", "seconds",
+                                                   "chunks"):
+                continue
+            wires.setdefault((parts[1], parts[2]), {})[parts[3]] = value
+        if not wires:
+            return
+        for (src, dst), vals in wires.items():
+            cur = (vals.get("bytes", 0.0), vals.get("seconds", 0.0),
+                   vals.get("chunks", 0.0))
+            key = (member, src, dst)
+            with self._lock:
+                last = self._kvwire_last.get(key, (0.0, 0.0, 0.0))
+                self._kvwire_last[key] = cur
+            if any(c < p for c, p in zip(cur, last)):
+                last = (0.0, 0.0, 0.0)  # member telemetry restarted
+            d_bytes, d_secs, d_chunks = (c - p
+                                         for c, p in zip(cur, last))
+            if d_bytes > 0 and d_secs > 0:
+                self.mesh_rates.observe(src, dst, int(d_bytes), d_secs,
+                                        chunks=int(d_chunks))
+
     def _prune_telemetry_locked(self, now: float) -> List[str]:
         """Drop members silent past the dead-retention window (a
         restarted worker mints a fresh id, same rationale as the
@@ -869,9 +947,27 @@ class FleetServer:
         restart member ids must not grow /metrics without bound (same
         policy as the tenant-depth gauge)."""
         if self.metrics is None:
+            if members:
+                self._forget_wires(members)
             return
         for member in members:
             self.metrics.remove_member_telemetry(member)
+        self._forget_wires(members)
+
+    def _forget_wires(self, members: List[str]) -> None:
+        """Drop pruned/dead members' learned-rate state: their wire
+        series leave the gauge (bounded label sets) and their stored
+        cumulative counters leave the delta table."""
+        for member in members:
+            self.mesh_rates.drop_member(member)
+        with self._lock:
+            for member in members:
+                self._intro_endpoints.pop(member, None)
+            gone = [k for k in self._kvwire_last
+                    if k[0] in members or k[1] in members
+                    or k[2] in members]
+            for key in gone:
+                del self._kvwire_last[key]
 
     def telemetry_snapshot(self) -> Dict[str, Dict[str, Any]]:
         """Per-member telemetry for GET /server/perf: last frame per
@@ -929,6 +1025,11 @@ class FleetServer:
                     breaker_threshold=self.health_settings.wire_failures,
                     breaker_open_s=self.health_settings.breaker_open_s,
                     retry_budget=self.retry_budget,
+                    # learned wire-rate model (serving/fleet_mesh.py):
+                    # the host's channels are the "registry" -> member
+                    # wires in the (src, dst) rate key space
+                    rate_estimator=self.mesh_rates.estimator(
+                        "registry", member_id),
                 )
             for runner in session.runners.values():
                 runner.kv_channel = session.kv_channel
@@ -958,6 +1059,106 @@ class FleetServer:
             if channel is not None:
                 out[member_id] = channel.stats()
         return out
+
+    # -- KV mesh introduction broker (session reader threads) ---------------
+
+    def _broker_intros(self, session: _MemberSession, member_id: str,
+                       data_port: int) -> None:
+        """Keep every member introduced to every other member's
+        advertised data-plane endpoint (serving/fleet_mesh.py). Called
+        per heartbeat, but intros only cross the wire when an endpoint
+        is NEW or CHANGED — plus a full catch-up of the existing fleet
+        to a member whose endpoint just (re)appeared, covering both a
+        fresh joiner and a reconnect after the registry bounced."""
+        if not self.settings.mesh_enabled:
+            return
+        host = session.peer.rsplit(":", 1)[0]
+        endpoint = (host, int(data_port)) if data_port > 0 else None
+        with self._lock:
+            prev = self._intro_endpoints.get(member_id)
+            if endpoint == prev:
+                return
+            if endpoint is None:
+                self._intro_endpoints.pop(member_id, None)
+            else:
+                self._intro_endpoints[member_id] = endpoint
+            others = [(m, s, self._intro_endpoints.get(m))
+                      for m, s in self._by_member.items()
+                      if m != member_id]
+        if endpoint is None:
+            # the member stopped advertising a data plane: retract it
+            for other_id, other_session, _ep in others:
+                self._send_intro(other_session,
+                                 {"member_id": member_id, "gone": True})
+            return
+        grant = self.settings.kv_max_streams
+        for other_id, other_session, other_ep in others:
+            # both directions: the fleet learns the (new) endpoint...
+            self._send_intro(other_session, {
+                "member_id": member_id, "host": endpoint[0],
+                "data_port": endpoint[1], "max_streams": grant,
+            })
+            # ...and the (re)joiner learns the existing fleet
+            if other_ep is not None:
+                self._send_intro(session, {
+                    "member_id": other_id, "host": other_ep[0],
+                    "data_port": other_ep[1], "max_streams": grant,
+                })
+
+    def _send_intro(self, session: _MemberSession,
+                    obj: Dict[str, Any]) -> None:
+        """One KvIntro send, outcome-counted: the broker is best-effort
+        by design (a dropped intro only costs the mesh route — the
+        fetch degrades to recompute, never to an error)."""
+        try:
+            # injected broker drop (docs/RESILIENCE.md fleet.kv_intro)
+            faults.fire("fleet.kv_intro")
+            session.send("KvIntro", obj)
+            outcome = "gone" if obj.get("gone") else "sent"
+        except faults.InjectedFault:
+            outcome = "dropped"
+        except (FleetWireError, OSError) as e:
+            logger.debug("kv intro to %s failed: %s", session.member_id, e)
+            outcome = "failed"
+        if self.metrics is not None:
+            self.metrics.record_kv_intro(outcome)
+
+    def mesh_route(self, target_member: str, peer_member: str) -> bool:
+        """True when the mesh has (or will have, via the per-heartbeat
+        broker) introduced ``target_member`` to ``peer_member`` — the
+        gate for delegating a remote-target/remote-peer fetch to the
+        member instead of relaying chunk bytes through this host."""
+        if not self.settings.mesh_enabled or target_member == peer_member:
+            return False
+        with self._lock:
+            return (target_member in self._intro_endpoints
+                    and peer_member in self._intro_endpoints)
+
+    def kv_wire_stats(self) -> List[Dict[str, Any]]:
+        """The ``kv_wires`` table of ``/server/stats``: one row per
+        directed wire with its learned rate and lifetime bytes/chunks
+        (serving/fleet_mesh.py). Registry-owned wires carry live
+        connectivity + breaker state from their channel; member-to-
+        member wires carry whether the pair is currently introduced
+        (their sockets live in the members — the rows' rates arrive via
+        telemetry)."""
+        rows: Dict[Tuple[str, str], Dict[str, Any]] = {
+            (r["src"], r["dst"]): r for r in self.mesh_rates.snapshot()
+        }
+        for member_id, st in self.kv_stats().items():
+            row = rows.setdefault(("registry", member_id), {
+                "src": "registry", "dst": member_id,
+                "rate_bytes_per_s": None, "bytes": 0, "chunks": 0,
+            })
+            row["connected"] = st.get("connected", False)
+            row["breaker"] = st.get("breaker")
+        with self._lock:
+            introduced = set(self._intro_endpoints)
+        for (src, dst), row in rows.items():
+            if "connected" not in row:
+                row["introduced"] = (src in introduced
+                                     and dst in introduced)
+        return [rows[k] for k in sorted(rows)]
 
     # -- runner materialization (session reader threads) -------------------
 
@@ -1020,6 +1221,20 @@ class FleetServer:
             # exactly once, mid-stream ones fail fast (RESILIENCE.md)
             session.detach_runners(
                 f"fleet member {member_id} dead (missed heartbeats)")
+            # KV mesh: retract the dead member's endpoint from the
+            # fleet (each receiver closes its wire) and drop its
+            # learned-rate series — dead host:pid identities must not
+            # pin gauge labels (serving/fleet_mesh.py)
+            if self.settings.mesh_enabled:
+                with self._lock:
+                    known = member_id in self._intro_endpoints
+                    others = [s for m, s in self._by_member.items()
+                              if m != member_id]
+                if known:
+                    for other in others:
+                        self._send_intro(other, {"member_id": member_id,
+                                                 "gone": True})
+            self._forget_wires([member_id])
         elif new == MEMBER_SUSPECT:
             with session._lock:
                 runners = list(session.runners.values())

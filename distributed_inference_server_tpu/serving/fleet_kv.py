@@ -206,7 +206,7 @@ class _KvStream:
     connect failure, or by the connection dying under it."""
 
     __slots__ = ("key", "op", "rid", "cb", "chunks", "started_at",
-                 "result_depth")
+                 "result_depth", "wire_bytes", "wire_chunks")
 
     def __init__(self, op: str, rid: str, cb: Callable):
         self.key = f"{op}:{rid}"
@@ -216,6 +216,11 @@ class _KvStream:
         self.chunks: List[KvChunk] = []  # fetch-response reassembly
         self.started_at = time.monotonic()
         self.result_depth = 0  # fetch: depth the member actually served
+        # bulk payload accounting for the learned wire-rate model
+        # (serving/fleet_mesh.py): bytes/chunks this stream moved in
+        # EITHER direction — sent (handoff/import) or received (fetch)
+        self.wire_bytes = 0
+        self.wire_chunks = 0
 
 
 class KvDataChannel:
@@ -243,6 +248,8 @@ class KvDataChannel:
         breaker_threshold: int = 3,
         breaker_open_s: float = 5.0,
         retry_budget=None,
+        rate_estimator=None,
+        peer_wire: bool = False,
     ):
         """``on_event(obj)`` receives FleetEvent frames (decode tokens
         of migrated requests) on the reader thread. ``on_lost_requests``
@@ -255,7 +262,15 @@ class KvDataChannel:
         skips this member (``wire_available``) until a half-open probe
         succeeds. ``retry_budget`` (health.RetryBudget): reconnects
         after a failure draw from the shared budget, so a fleet of
-        broken wires cannot amplify dial load."""
+        broken wires cannot amplify dial load. ``rate_estimator``
+        (serving/fleet_mesh.py WireRateEstimator): each completed
+        stream's bulk bytes/seconds feed the learned per-wire transfer
+        rate the routing cost model prices fetches with; None = no
+        observation (the wire stays priced at the configured prior).
+        ``peer_wire`` marks a member-to-member mesh channel (dialed
+        from a KvIntro, not by the registry host): the dial-death
+        fault point is then ``fleet.kv_peer_dial`` instead of
+        ``fleet.kv_connect`` (docs/RESILIENCE.md)."""
         from distributed_inference_server_tpu.serving.health import (
             CircuitBreaker,
         )
@@ -268,6 +283,8 @@ class KvDataChannel:
         self.on_event = on_event
         self.on_lost_requests = on_lost_requests
         self.retry_budget = retry_budget
+        self.rate_estimator = rate_estimator
+        self.peer_wire = peer_wire
         self.breaker = CircuitBreaker(
             threshold=breaker_threshold, open_s=breaker_open_s,
             on_transition=(metrics.record_breaker_transition
@@ -406,6 +423,8 @@ class KvDataChannel:
                 "bytes_received": self._bytes_received,
             }
         out["breaker"] = self.breaker.stats()
+        if self.rate_estimator is not None:
+            out["rate_bytes_per_s"] = self.rate_estimator.rate()
         return out
 
     def close(self, reason: str = "channel closed") -> None:
@@ -506,6 +525,10 @@ class KvDataChannel:
                     n = send_kv_frame(sock, name, obj)
                     with self._lock:
                         self._bytes_sent += n
+                        if stream is not None:
+                            stream.wire_bytes += n
+                            if name == "KvChunk":
+                                stream.wire_chunks += 1
             except Exception as e:  # noqa: BLE001 — transport fault
                 # domain: the stream fails, the connection is torn down
                 # (its reader resolves every OTHER in-flight stream)
@@ -543,8 +566,13 @@ class KvDataChannel:
                 f"kv data channel to {self.member_id}: retry budget "
                 "exhausted"
             )
-        # injected dial failure (docs/RESILIENCE.md fleet.kv_connect)
-        faults.fire("fleet.kv_connect")
+        # injected dial failure (docs/RESILIENCE.md): member-to-member
+        # mesh wires and registry-to-member wires are distinct chaos
+        # fault domains, so each gets its own LITERAL point
+        if self.peer_wire:
+            faults.fire("fleet.kv_peer_dial")
+        else:
+            faults.fire("fleet.kv_connect")
         try:
             # the channel's dedicated wire worker thread: blocking by
             # design with a bounded timeout; never a dispatch/async path
@@ -581,9 +609,13 @@ class KvDataChannel:
                 name, obj = frame
                 if name == "KvChunk":
                     with self._lock:
-                        self._bytes_received += len(obj.get("payload", b""))
+                        payload_n = len(obj.get("payload", b""))
+                        self._bytes_received += payload_n
                         stream = self._streams.get(
                             f"fetch:{obj.get('handoff_id', '')}")
+                        if stream is not None:
+                            stream.wire_bytes += payload_n
+                            stream.wire_chunks += 1
                     if stream is not None:
                         stream.chunks.append(chunk_from_wire(obj))
                 elif name == "KvStreamResult":
@@ -611,6 +643,16 @@ class KvDataChannel:
         # member-side rejects (validation, engine unavailable) are not
         # wire failures and must not open the breaker
         self.breaker.record_success()
+        if (self.rate_estimator is not None and bool(obj.get("ok"))
+                and stream.wire_bytes > 0):
+            # feed the learned wire-rate model (serving/fleet_mesh.py):
+            # only OK streams with bulk payload count — a reject moved
+            # control frames, not pages, and would poison the rate
+            self.rate_estimator.observe(
+                stream.wire_bytes,
+                max(time.monotonic() - stream.started_at, 1e-6),
+                chunks=stream.wire_chunks,
+            )
         stream.result_depth = obj.get("depth", 0)
         try:
             stream.cb(bool(obj.get("ok")),

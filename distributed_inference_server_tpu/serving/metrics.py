@@ -571,6 +571,24 @@ class MetricsCollector:
             "digest (0 until it has a windowed sample)",
             ["member"], registry=r,
         )
+        # KV mesh (serving/fleet_mesh.py; docs/FLEET.md "KV mesh"):
+        # learned per-wire transfer rates and intro-broker traffic.
+        # src/dst are member ids ("registry" = this host); dead
+        # members' series are removed (tenant-gauge policy)
+        self.kv_wire_rate = Gauge(
+            "fleet_kv_wire_rate_bytes_per_s",
+            "Learned KV wire transfer rate over the configured window "
+            "(fleet.kv_rate_window_s); absent while the wire is cold "
+            "(it then prices at the fleet.kv_rate_prior constant)",
+            ["src", "dst"], registry=r,
+        )
+        self.kv_intros = Counter(
+            "fleet_kv_intro_total",
+            "KvIntro broker sends by outcome (sent | gone = retraction "
+            "| dropped = injected fleet.kv_intro fault | failed = "
+            "member session wire error)",
+            ["outcome"], registry=r,
+        )
 
         # windowed performance digests (serving/teledigest.py): the
         # sliding-epoch store behind GET /server/perf, the snapshot's
@@ -613,6 +631,7 @@ class MetricsCollector:
         self._retry_denied: Dict[str, int] = {}
         self._fleet_heartbeats: Dict[str, int] = {}
         self._fleet_reroles: Dict[str, int] = {}
+        self._kv_intros: Dict[str, int] = {}
         self._tenants_seen: set = set()
         self._trace_drops: Dict[str, int] = {}
         self._phase_sums: Dict[str, float] = {}
@@ -712,10 +731,12 @@ class MetricsCollector:
                             nbytes: int = 0,
                             scope: str = "local") -> None:
         """One peer-to-peer prefix fetch (disagg.PrefixFetcher):
-        ``outcome`` is "ok" (pages seated on the cold replica) or
-        "fallback" (any failure — the request recomputed instead);
-        ``scope`` is "local" (in-process peer) or "remote" (a fleet
-        member over its KV data channel, serving/fleet_kv.py)."""
+        ``outcome`` is "ok" (pages seated on the cold replica),
+        "fallback" (any failure — the request recomputed instead), or
+        "delegated" (handed to a fleet member as a mesh fetch hint);
+        ``scope`` is "local" (in-process peer), "remote" (a fleet
+        member over its KV data channel, serving/fleet_kv.py), or
+        "mesh" (member pulls directly from member, fleet_mesh.py)."""
         self.prefix_fetches.labels(outcome=outcome, scope=scope).inc()
         if seconds is not None:
             self.prefix_fetch_latency.observe(seconds)
@@ -1081,6 +1102,32 @@ class MetricsCollector:
                 self._fleet_reroles.get(direction, 0) + 1
             )
 
+    def record_kv_intro(self, outcome: str) -> None:
+        """One KvIntro broker send (serving/fleet.py): sent | gone |
+        dropped | failed."""
+        self.kv_intros.labels(outcome=outcome).inc()
+        with self._lock:
+            self._kv_intros[outcome] = self._kv_intros.get(outcome, 0) + 1
+
+    def set_kv_wire_rate(self, src: str, dst: str, rate: float) -> None:
+        """Refresh one wire's learned-rate gauge (serving/fleet_mesh.py
+        MeshWireRates — the sole writer, which also drives removal, so
+        the label set stays bounded by live wires)."""
+        with self._lock:
+            # series add/remove under the collector lock (tenant-gauge
+            # discipline): an observation racing a member prune must
+            # not interleave a remove with this set
+            self.kv_wire_rate.labels(src=src, dst=dst).set(rate)
+
+    def remove_kv_wire_rate(self, src: str, dst: str) -> None:
+        """Drop a dead member's wire series (serving/fleet_mesh.py
+        drop_member): its last rate must stop reading as live."""
+        with self._lock:
+            try:
+                self.kv_wire_rate.remove(src, dst)
+            except KeyError:
+                pass
+
     def set_tenant_depths(self, depths: Dict[str, int]) -> None:
         """Per-tenant queue occupancy. A tenant that drained since the
         last publish has its series REMOVED (after this call a scrape
@@ -1125,6 +1172,7 @@ class MetricsCollector:
             return {
                 "heartbeats": dict(self._fleet_heartbeats),
                 "reroles": dict(self._fleet_reroles),
+                "kv_intros": dict(self._kv_intros),
             }
 
     # -- rendering ---------------------------------------------------------

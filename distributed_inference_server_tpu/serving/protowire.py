@@ -248,6 +248,28 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         # parents its fleet.serve span on this; "" = untraced
         10: ("trace_id", "string", "one"),
         11: ("parent_span_id", "string", "one"),
+        # KV mesh fetch hint (serving/fleet_mesh.py): the registry host
+        # attaches the fetch plan to the submit it was sending anyway,
+        # and the member pulls the prefix straight from the named peer
+        # over its own mesh channel — bulk bytes skip the registry.
+        # fetch_member "" = no hint; old members skip unknown fields
+        # and serve by recompute (graceful degradation).
+        12: ("fetch_member", "string", "one"),
+        13: ("fetch_source_engine", "string", "one"),
+        14: ("fetch_hashes", "uint64", "rep"),
+        15: ("fetch_chunk_pages", "uint32", "one"),
+        16: ("fetch_wire_quant", "string", "one"),
+    },
+    # KV mesh introduction (serving/fleet_mesh.py; docs/FLEET.md "KV
+    # mesh"): the registry host brokers member↔member data-plane
+    # endpoints over fleet-wire frame kind 6; gone=true retracts a dead
+    # member's endpoint.
+    "KvIntro": {
+        1: ("member_id", "string", "one"),
+        2: ("host", "string", "one"),
+        3: ("data_port", "uint32", "one"),
+        4: ("max_streams", "uint32", "one"),
+        5: ("gone", "bool", "one"),
     },
     "FleetEvent": {
         1: ("request_id", "string", "one"),

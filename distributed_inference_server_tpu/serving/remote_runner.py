@@ -229,7 +229,14 @@ class RemoteRunner:
     def shutdown(self, timeout: float = 0.0) -> None:
         self.detach("fleet detach: registry host shutting down")
 
-    def submit(self, requests: Sequence[ServerRequest]) -> None:
+    def submit(self, requests: Sequence[ServerRequest],
+               fetch_hint: Optional[Dict[str, Any]] = None) -> None:
+        """``fetch_hint`` (docs/FLEET.md "KV mesh"): optional mesh
+        fetch-delegation fields (fetch_member/fetch_source_engine/
+        fetch_hashes/fetch_chunk_pages/fetch_wire_quant) merged into
+        each FleetSubmit frame — the member pulls the warm prefix
+        directly from the named peer before computing, degrading to
+        plain recompute on any mesh failure."""
         reqs = list(requests)
         with self._events_lock:
             for r in reqs:
@@ -256,6 +263,8 @@ class RemoteRunner:
                     "stop_sequences": list(r.params.stop_sequences),
                     "tenant": getattr(r, "tenant", "") or "",
                 }
+                if fetch_hint:
+                    frame.update(fetch_hint)
                 span = getattr(r, "span", None)
                 if span is not None:
                     # trace context rides the wire: the member parents
@@ -597,6 +606,13 @@ class FleetWorker:
         # member control-plane-only (no handoff target, no fetch
         # source — the pre-data-plane behavior).
         self.kv_server = None
+        # member->member KV mesh (serving/fleet_mesh.py; docs/FLEET.md
+        # "KV mesh"): peer channels dialed from registry KvIntro
+        # frames, plus this member's learned wire rates — shipped to
+        # the registry as kvwire| perf counters on the telemetry
+        # piggyback so plan_route prices the wires it never touches.
+        self.mesh_client = None
+        self.mesh_rates = None
         self._sock: Optional[socket.socket] = None
         # serializes frame writes: the heartbeat thread and every local
         # runner thread's _RemoteSink share the socket
@@ -630,6 +646,23 @@ class FleetWorker:
                 metrics=self.metrics,
             )
             self.kv_server.start()
+        if (self.settings.kv_enabled and self.settings.mesh_enabled
+                and self.mesh_client is None):
+            from distributed_inference_server_tpu.serving.fleet_mesh import (
+                MeshClient,
+                MeshWireRates,
+            )
+
+            self.mesh_rates = MeshWireRates(
+                window_s=self.settings.kv_rate_window_s,
+                prior_rate=self.settings.kv_rate_prior,
+                perf=(self.metrics.perf_store()
+                      if self.metrics is not None else None),
+            )
+            self.mesh_client = MeshClient(
+                self.member_id, self.mesh_rates, metrics=self.metrics,
+                connect_timeout_s=self.settings.kv_connect_timeout_s,
+            )
         self._connect(connect_timeout_s)
         self._stop.clear()
         # lifecycle handle  # distlint: ignore[DL008]
@@ -644,6 +677,10 @@ class FleetWorker:
         if self.kv_server is not None:
             self.kv_server.stop()
             self.kv_server = None
+        if self.mesh_client is not None:
+            self.mesh_client.close()
+            self.mesh_client = None
+            self.mesh_rates = None
         if self._beat_thread is not None:
             self._beat_thread.join(5.0)
             self._beat_thread = None
@@ -828,6 +865,8 @@ class FleetWorker:
                 name, obj = frame
                 if name == "FleetSubmit":
                     self._serve_submit(obj)
+                elif name == "KvIntro":
+                    self._on_kv_intro(obj)
                 # heartbeats/events only flow worker -> host; ignore
         except OSError:
             return  # connection died; the beat loop reconnects
@@ -842,6 +881,15 @@ class FleetWorker:
         except Exception:  # noqa: BLE001 — reader must not die silently
             logger.exception("fleet worker %s reader failed", self.member_id)
             self._close()
+
+    def _on_kv_intro(self, obj: Dict[str, Any]) -> None:
+        """Registry introduction (docs/FLEET.md "KV mesh"): learn —
+        or forget, on ``gone`` — a peer member's data endpoint. A
+        member with the mesh disabled (or an older build that never
+        decodes frame kind 6) just ignores the frame; fetch hints it
+        cannot honor degrade to plain recompute."""
+        if self.mesh_client is not None:
+            self.mesh_client.on_intro(obj)
 
     def _serve_submit(self, obj: Dict[str, Any]) -> None:
         rid = obj.get("request_id", "")
@@ -892,4 +940,89 @@ class FleetWorker:
         # (the reader thread stalls): a gray-failing box is slow for
         # everything behind the slow request too.
         faults.fire("fleet.slow_member")
+        if self._mesh_prefetch(runner, req, obj, span):
+            return
         runner.submit([req])
+
+    def _mesh_prefetch(self, runner, req: ServerRequest,
+                       obj: Dict[str, Any], span) -> bool:
+        """Honor a mesh fetch hint (docs/FLEET.md "KV mesh"): pull the
+        warm prefix DIRECTLY from the hinted peer member over this
+        member's own data channel, seat it in the local engine's
+        prefix cache, then submit the request. Returns True when this
+        path owns the submit (it happens in a callback); False hands
+        the request straight back to the plain-submit path.
+
+        Failure semantics mirror disagg.PrefixFetcher exactly: the
+        fetch is an accelerator, never a gate. No intro for the peer,
+        a dead/breaker-open wire (``fleet.kv_peer_dial``), a stale or
+        empty export, or an import rejection all degrade the request
+        to plain recompute HERE, exactly once — each stage's callback
+        fires once and every failure arm ends in the same finisher."""
+        member = obj.get("fetch_member") or ""
+        hashes = [int(h) for h in obj.get("fetch_hashes") or ()]
+        if not member or not hashes or self.mesh_client is None:
+            return False
+        peer = self.mesh_client.peer(
+            member, obj.get("fetch_source_engine") or "")
+        if peer is None:
+            # never introduced (or already retracted): recompute
+            if self.metrics:
+                self.metrics.record_prefix_fetch("fallback",
+                                                 scope="mesh")
+            return False
+        chunk_pages = int(obj.get("fetch_chunk_pages") or 0) or 1
+        wire_quant = obj.get("fetch_wire_quant") or "none"
+        t0 = time.monotonic()
+
+        def _finish(outcome: str, nbytes: int = 0) -> None:
+            if self.metrics:
+                self.metrics.record_prefix_fetch(
+                    outcome, seconds=time.monotonic() - t0,
+                    nbytes=nbytes, scope="mesh")
+            runner.submit([req])
+
+        def _on_import(ok: bool, err, nbytes: int) -> None:
+            if not ok:
+                logger.debug("mesh prefetch for %s: import rejected "
+                             "(%s); recomputing", req.request_id, err)
+            _finish("ok" if ok else "fallback", nbytes)
+
+        def _on_export(result, err) -> None:
+            # peer channel's reader thread (or this one, fail-fast)
+            if result is None:
+                logger.debug("mesh prefetch for %s: peer %s export "
+                             "failed (%s); recomputing",
+                             req.request_id, member, err)
+                _finish("fallback")
+                return
+            depth, chunks = result
+            if depth <= 0 or not chunks:
+                _finish("fallback")  # peer evicted the chain
+                return
+            try:
+                ps = max(1, getattr(runner.status(), "page_size", 0)
+                         or 1)
+                tokens = list(req.prompt_ids[: depth * ps])
+                nbytes = sum(len(c.payload) for c in chunks)
+                runner.submit_prefix_import(
+                    req.request_id, tokens, chunks,
+                    lambda ok, ierr: _on_import(ok, ierr, nbytes),
+                )
+            except Exception as e:  # noqa: BLE001 — import fault
+                # domain: a torn chunk set must not kill the reader
+                logger.debug("mesh prefetch for %s: import failed "
+                             "(%s); recomputing", req.request_id, e)
+                _finish("fallback")
+
+        try:
+            peer.submit_prefix_export(
+                req.request_id, hashes, chunk_pages, wire_quant,
+                _on_export,
+                trace=(span.context() if span is not None else None),
+            )
+        except Exception as e:  # noqa: BLE001 — channel fault domain
+            logger.debug("mesh prefetch for %s: dispatch failed (%s); "
+                         "recomputing", req.request_id, e)
+            _finish("fallback")
+        return True

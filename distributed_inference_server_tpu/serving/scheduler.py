@@ -27,7 +27,7 @@ import random
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from distributed_inference_server_tpu.serving import faults
 from distributed_inference_server_tpu.serving.health import health_rank
@@ -145,6 +145,8 @@ def plan_route(
     roles: Optional[Sequence[str]] = None,
     costs: Optional[FetchCosts] = None,
     page_size: int = 0,
+    wire_cost: Optional[Callable] = None,
+    mesh_route: Optional[Callable] = None,
 ) -> Optional[PrefixRoutePlan]:
     """Three-way cache_aware routing: route-to-warm vs fetch-to-cold vs
     recompute, scored per admissible engine in page units —
@@ -161,7 +163,20 @@ def plan_route(
     ``sched.fetch_decision`` fault flag (docs/RESILIENCE.md) forces the
     cheapest FETCH option when one exists, so chaos scenarios can drive
     the fetch path deterministically under random load. Returns None
-    when no healthy admissible engine exists."""
+    when no healthy admissible engine exists.
+
+    ``wire_cost(target_status, peer_status) -> Optional[float]``
+    (serving/fleet_mesh.py MeshWireRates via the server wiring): the
+    LEARNED per-page cost of the specific (src, dst) wire a fetch
+    would cross; None = the wire is cold, charge the static constant
+    (``page_cost`` / ``remote_page_cost``) as the prior. A congested
+    wire prices itself out of the fetch option instead of being
+    guessed at the constant. ``mesh_route(target_status, peer_status)
+    -> bool`` additionally admits REMOTE fetch targets when the mesh
+    has introduced the (target member, peer member) pair — the member
+    then pulls the chunks over its own direct wire (FleetSubmit fetch
+    hint), so fetch capacity scales with member count instead of
+    terminating every stream on this host."""
     costs = costs or FetchCosts()
     healthy = [s for s in statuses if s.healthy]
     admissible = (healthy if roles is None else
@@ -219,18 +234,32 @@ def plan_route(
         base = costs.load_cost_pages * load(s)
         options.append((base + (n_pages - d), 0, load(s), s.engine_id,
                         "route", s, d))
+        # remote fetch TARGETS are admissible only through the mesh:
+        # the member must hold (or be introduced into) a direct wire to
+        # the peer member, or the chunks would relay through this host
+        target_ok = (not getattr(s, "remote", False)
+                     or (mesh_route is not None and peer is not None
+                         and getattr(peer, "remote", False)
+                         and getattr(s, "data_plane", False)
+                         and mesh_route(s, peer)))
         if (costs.enabled and peer is not None
                 and s.engine_id != peer.engine_id
-                and not getattr(s, "remote", False)
+                and target_ok
                 and peer_depth - d >= costs.min_pages):
             # the wire term charges the WHOLE chain: the fetch moves
             # pages 0..peer_depth (head-first contiguous tiling), not
-            # just the target's missing suffix. peer_page_cost is the
+            # just the target's missing suffix. The learned (src, dst)
+            # wire rate prices the move when warm (wire_cost); cold
+            # wires charge the configured prior — peer_page_cost: the
             # in-process rate for a local peer, fleet.kv_page_cost for
             # a cross-host one.
+            per_page = (wire_cost(s, peer)
+                        if wire_cost is not None else None)
+            if per_page is None:
+                per_page = peer_page_cost
             options.append((
                 base + (n_pages - peer_depth)
-                + peer_page_cost * peer_depth,
+                + per_page * peer_depth,
                 1, load(s), s.engine_id, "fetch", s, d,
             ))
     if faults.flag("sched.fetch_decision"):
@@ -358,6 +387,15 @@ class AdaptiveScheduler:
         # health tiering. Single-writer (server boot), read per snapshot
         # distlint: ignore[DL008]
         self.health_scorer = None
+        # learned wire pricing + mesh routing (serving/fleet_mesh.py),
+        # wired by the server on the registry host: wire_cost prices
+        # the (src, dst) wire a fetch/handoff would cross, mesh_route
+        # admits remote fetch targets whose member holds a direct wire
+        # to the peer. Single-writer (server boot)
+        # distlint: ignore[DL008]
+        self.wire_cost = None
+        # distlint: ignore[DL008]
+        self.mesh_route = None
 
     # -- registration ------------------------------------------------------
 
@@ -529,15 +567,17 @@ class AdaptiveScheduler:
                     )
                 plan = plan_route(statuses, prefix_hashes, roles=roles,
                                   costs=self._fetch_costs,
-                                  page_size=hash_ps)
+                                  page_size=hash_ps,
+                                  wire_cost=self.wire_cost,
+                                  mesh_route=self.mesh_route)
                 if plan is None:
                     out.append((None, None))
                     continue
                 out.append((self._engines.get(plan.engine_id), plan))
         return out
 
-    def schedule_decode(self, exclude: Optional[str] = None
-                        ) -> Optional[EngineRunner]:
+    def schedule_decode(self, exclude: Optional[str] = None,
+                        pages: int = 0) -> Optional[EngineRunner]:
         """Pick the migration target for a finished prefill: the least-
         loaded healthy decode-role engine (``exclude`` drops the source,
         relevant only if an engine is both). None = no decode capacity —
@@ -545,7 +585,14 @@ class AdaptiveScheduler:
         qualify when their member carries a KV data channel
         (``supports_kv_import``, serving/fleet_kv.py) — the two-phase
         import stream then runs over the wire; control-plane-only
-        remotes stay excluded (no way to move the pages)."""
+        remotes stay excluded (no way to move the pages).
+
+        With ``wire_cost`` wired and ``pages`` known (the handoff's
+        prefix size), the election charges each remote candidate the
+        LEARNED cost of moving the pages over its wire (serving/
+        fleet_mesh.py) in the same page units ``plan_route`` uses —
+        a congested wire loses the election to a slightly-busier local
+        engine instead of being picked at the static constant."""
         candidates = [
             r for r in self.engines()
             if r.engine_id != exclude
@@ -558,9 +605,34 @@ class AdaptiveScheduler:
             # supports_kv_import above already excludes members whose
             # data-channel breaker is OPEN (serving/health.py)
             statuses = self.health_scorer.stamp(statuses)
-        engine_id = choose_engine(
-            SchedulingStrategy.LEAST_LOADED, statuses, 0, roles=("decode",)
-        )
+        if self.wire_cost is not None and pages > 0:
+            decode = [s for s in statuses if s.healthy
+                      and getattr(s, "role", "unified") == "decode"]
+            if not decode:
+                return None
+            costs = self._fetch_costs
+
+            def score(s: EngineStatus):
+                wire_pages = 0.0
+                if getattr(s, "remote", False):
+                    # this host is the handoff source: the wire is
+                    # (registry -> member); cold wires charge the prior
+                    per_page = self.wire_cost(s, None)
+                    if per_page is None:
+                        per_page = costs.remote_page_cost
+                    wire_pages = per_page * pages
+                return (health_rank(getattr(s, "health", "healthy")),
+                        costs.load_cost_pages
+                        * (s.active_requests + s.waiting_requests)
+                        + wire_pages,
+                        s.engine_id)
+
+            engine_id = min(decode, key=score).engine_id
+        else:
+            engine_id = choose_engine(
+                SchedulingStrategy.LEAST_LOADED, statuses, 0,
+                roles=("decode",)
+            )
         if engine_id is None:
             return None
         with self._lock:

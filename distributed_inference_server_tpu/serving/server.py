@@ -312,6 +312,48 @@ class InferenceServer:
                 self.health.telemetry_fn = (
                     self.fleet_server.telemetry_snapshot
                 )
+            # telemetry-learned wire costs (serving/fleet_mesh.py;
+            # docs/CACHING.md): plan_route and the handoff election
+            # price the ACTUAL (src, dst) wire a move would cross from
+            # observed chunk bytes/seconds; cold wires keep charging
+            # the fleet.kv_page_cost constant as the prior. mesh_route
+            # additionally admits member->member fetch delegation once
+            # the registry has introduced the pair (docs/FLEET.md).
+            fs = self.fleet_server
+
+            def _member_of(status) -> str:
+                # remote engine ids are "<member>:<engine>"; a local
+                # status (or a None peer, the handoff source) is this
+                # host — the registry side of the wire
+                if status is None or not getattr(status, "remote",
+                                                 False):
+                    return "registry"
+                return status.engine_id.rsplit(":", 1)[0]
+
+            def _wire_cost(target, peer):
+                dst = _member_of(target)
+                src = _member_of(peer)
+                if src == dst:
+                    return None  # no wire crossed: static model rules
+                base = self.scheduler._fetch_costs.remote_page_cost
+                # the mover is the wire's src->dst direction: chunks
+                # flow FROM the warm side (peer / handoff source) TO
+                # the target, but rates are keyed by the channel that
+                # carries them — registry channels are keyed
+                # ("registry", member) regardless of direction
+                if "registry" in (src, dst):
+                    member = dst if src == "registry" else src
+                    return fs.mesh_rates.page_cost(
+                        "registry", member, base)
+                return fs.mesh_rates.page_cost(dst, src, base)
+
+            def _mesh_route(target, peer) -> bool:
+                return fs.mesh_route(_member_of(target),
+                                     _member_of(peer))
+
+            self.scheduler.wire_cost = _wire_cost
+            self.scheduler.mesh_route = _mesh_route
+            self.prefix_fetcher.mesh_route = fs.mesh_route
         if self.fleet_settings.rerole:
             self.role_balancer = RoleBalancer(
                 self.scheduler, self.dispatcher, self.fleet_settings,
@@ -611,6 +653,10 @@ class InferenceServer:
             # KV data plane (serving/fleet_kv.py): per-member channel
             # state — connected / in-flight streams / bytes moved
             out["kv_channels"] = self.fleet_server.kv_stats()
+            # KV mesh (serving/fleet_mesh.py): every priced wire —
+            # registry<->member and member<->member — with its learned
+            # rate and lifetime bytes/chunks
+            out["kv_wires"] = self.fleet_server.kv_wire_stats()
         if self.role_balancer is not None:
             out["rebalancer"] = self.role_balancer.stats()
         out["role_map"] = {

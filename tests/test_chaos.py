@@ -579,6 +579,17 @@ class TestFleetChaosSeeds:
         ("breaker_flap", 51),
         ("breaker_flap", 52),
         ("breaker_flap", 53),
+        # KV mesh (docs/FLEET.md "KV mesh"): a delegated fetch's direct
+        # member-to-member wire dies — w2's import session rejects a
+        # chunk (61), the peer dial fails (62), a chunk tears off the
+        # response stream (63) — and the hinted request degrades to
+        # recompute ON THE MEMBER, exactly once, zero pages leaked on
+        # any of the three processes; each seed asserts the fetch hint
+        # actually left the host (a delegation that silently relays or
+        # recomputes host-side is a violation, not a degradation).
+        ("mesh_peer_wire_death", 61),
+        ("mesh_peer_wire_death", 62),
+        ("mesh_peer_wire_death", 63),
     ])
     def test_scenario_clean(self, scenario, seed, fleet_chaos_cache):
         from tools import chaos_fleet

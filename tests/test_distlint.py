@@ -1229,6 +1229,36 @@ def f():
     assert "DIS_TPU_SERVER__PROT" in out[0].message
 
 
+def test_dl012_fleet_mesh_keys():
+    """The KV-mesh knobs (config ``fleet.mesh_enabled`` /
+    ``kv_rate_window_s`` / ``kv_rate_prior``) are schema keys like any
+    other: correct accesses pass, a typo'd variant flags, and the env
+    spellings resolve."""
+    mesh_schema = """
+_SCHEMA = {
+    "fleet": {
+        "mesh_enabled": (bool, False),
+        "kv_rate_window_s": (float, 30.0),
+        "kv_rate_prior": (float, 125000000.0),
+    },
+}
+"""
+    out = pcheck("DL012", {
+        _CONFIG_FIXTURE: mesh_schema,
+        f"{PKG}/serving/x.py": """
+import os
+def f(cfg):
+    a = cfg.get("fleet", "mesh_enabled")
+    b = cfg.get("fleet", "kv_rate_window_s")
+    c = cfg.get("fleet", "kv_rate_prior")
+    d = os.environ.get("DIS_TPU_FLEET__MESH_ENABLED")
+    bad = cfg.get("fleet", "mesh_enable")
+    return a, b, c, d, bad
+""",
+    })
+    assert len(out) == 1 and "fleet.mesh_enable" in out[0].message
+
+
 def test_dl012_schema_internal_literals():
     out = pcheck("DL012", {_CONFIG_FIXTURE: _SCHEMA_SRC + """
 HOT_RELOADABLE = {("server", "port"), ("queue", "high_watermrk")}

@@ -664,6 +664,21 @@ class TestFleetConfig:
         s = cfg.fleet_settings()
         assert s.enabled and s.port == 7001
         assert s.rerole and s.rerole_high_ratio == 8.0
+        # KV-mesh defaults: off, 30 s learning window, GbE-ish prior
+        assert not s.mesh_enabled
+        assert s.kv_rate_window_s == 30.0
+        assert s.kv_rate_prior == 125_000_000.0
+
+    def test_mesh_settings_mapping(self):
+        cfg = ServerConfig.load(environ={
+            "DIS_TPU_FLEET__MESH_ENABLED": "true",
+            "DIS_TPU_FLEET__KV_RATE_WINDOW_S": "12.5",
+            "DIS_TPU_FLEET__KV_RATE_PRIOR": "0",  # learned pricing off
+        })
+        s = cfg.fleet_settings()
+        assert s.mesh_enabled
+        assert s.kv_rate_window_s == 12.5
+        assert s.kv_rate_prior == 0.0
 
     def test_queue_tenant_mapping(self):
         cfg = ServerConfig.load(environ={
@@ -679,6 +694,8 @@ class TestFleetConfig:
         {"DIS_TPU_FLEET__DEAD_AFTER_S": "1.0"},  # <= suspect
         {"DIS_TPU_FLEET__REROLE_LOW_RATIO": "9.0"},  # >= high
         {"DIS_TPU_FLEET__CONNECT": "nonsense"},
+        {"DIS_TPU_FLEET__KV_RATE_WINDOW_S": "0"},  # must be positive
+        {"DIS_TPU_FLEET__KV_RATE_PRIOR": "-1"},  # 0 disables, < 0 invalid
         {"DIS_TPU_QUEUE__TENANT_WEIGHTS": "a=-1"},
         {"DIS_TPU_QUEUE__TENANT_WEIGHTS": "a=x"},
         {"DIS_TPU_QUEUE__TENANT_WEIGHTS": "justname"},

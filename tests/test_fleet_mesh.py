@@ -1,0 +1,410 @@
+"""Member↔member KV mesh with telemetry-learned wire costs
+(serving/fleet_mesh.py; docs/FLEET.md "KV mesh"). Covers the windowed
+wire-rate estimator (cold prior fallback, window decay, lifetime
+totals), the MeshWireRates registry (pricing band, disable switch,
+bounded label sets, telemetry piggyback), MeshClient intro lifecycle
+(add / unchanged / endpoint change / gone retraction), the MeshPeer
+fail-fast arm, and the plan_route pricing matrix — including THE
+acceptance pin: a fetch decision flips targets when a wire's learned
+rate degrades."""
+
+import pytest
+
+from distributed_inference_server_tpu.engine.kv_cache import chain_hashes
+from distributed_inference_server_tpu.serving.fleet_mesh import (
+    _MAX_PAGE_COST,
+    _MIN_PAGE_COST,
+    WIRE_COUNTER_PREFIX,
+    MeshClient,
+    MeshPeer,
+    MeshWireRates,
+    WireRateEstimator,
+)
+from distributed_inference_server_tpu.serving.metrics import EngineStatus
+from distributed_inference_server_tpu.serving.scheduler import (
+    FetchCosts,
+    plan_route,
+)
+
+T0 = 1_000_000.0  # deterministic wall clock for `now=` injection
+
+
+# ---------------------------------------------------------------------------
+# WireRateEstimator: the windowed learner
+# ---------------------------------------------------------------------------
+
+
+class TestWireRateEstimator:
+    def test_cold_wire_has_no_rate(self):
+        assert WireRateEstimator(window_s=30.0).rate(now=T0) is None
+
+    def test_rate_is_window_bytes_over_seconds(self):
+        est = WireRateEstimator(window_s=30.0)
+        est.observe(1000, 0.5, chunks=2, now=T0)
+        est.observe(3000, 1.5, chunks=1, now=T0 + 1.0)
+        assert est.rate(now=T0 + 2.0) == pytest.approx(4000 / 2.0)
+        assert est.totals() == (4000, 3)
+
+    def test_window_decay_returns_to_cold(self):
+        """An observation older than the window is pruned: the wire
+        goes back to COLD (None) instead of trusting a stale rate —
+        the caller re-prices at the prior."""
+        est = WireRateEstimator(window_s=10.0)
+        est.observe(8192, 0.25, now=T0)
+        assert est.rate(now=T0 + 5.0) == pytest.approx(8192 / 0.25)
+        assert est.rate(now=T0 + 60.0) is None
+        # lifetime totals survive decay (the kv_wires stats table)
+        assert est.totals() == (8192, 0)
+
+    def test_degenerate_observations_ignored(self):
+        est = WireRateEstimator(window_s=10.0)
+        est.observe(0, 1.0, now=T0)
+        est.observe(100, 0.0, now=T0)
+        est.observe(-5, 1.0, now=T0)
+        assert est.rate(now=T0) is None
+        assert est.totals() == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# MeshWireRates: the (src, dst) registry and pricing
+# ---------------------------------------------------------------------------
+
+
+class _FakeMetrics:
+    def __init__(self):
+        self.set_calls = []
+        self.removed = []
+
+    def set_kv_wire_rate(self, src, dst, rate):
+        self.set_calls.append((src, dst, rate))
+
+    def remove_kv_wire_rate(self, src, dst):
+        self.removed.append((src, dst))
+
+
+class _FakePerf:
+    def __init__(self):
+        self.counters = {}
+
+    def add_counter(self, name, value):
+        self.counters[name] = self.counters.get(name, 0.0) + value
+
+
+class TestMeshWireRates:
+    def test_cold_wire_prices_at_the_prior(self):
+        """page_cost is None for an unobserved wire: the caller falls
+        back to the configured constant (the prior)."""
+        rates = MeshWireRates(prior_rate=1000.0)
+        assert rates.page_cost("a", "b", 0.6, now=T0) is None
+
+    def test_wire_at_the_prior_rate_costs_the_constant(self):
+        rates = MeshWireRates(window_s=30.0, prior_rate=1000.0)
+        rates.observe("a", "b", 2000, 2.0, now=T0)  # exactly the prior
+        assert rates.page_cost("a", "b", 0.6, now=T0) == \
+            pytest.approx(0.6)
+
+    def test_slow_wire_dearer_fast_wire_cheaper_clamped(self):
+        rates = MeshWireRates(window_s=30.0, prior_rate=1000.0)
+        rates.observe("slow", "b", 100, 1.0, now=T0)  # 10x under prior
+        assert rates.page_cost("slow", "b", 0.6, now=T0) == \
+            pytest.approx(6.0)
+        rates.observe("fast", "b", 10_000, 1.0, now=T0)  # 10x over
+        assert rates.page_cost("fast", "b", 0.6, now=T0) == \
+            pytest.approx(0.06)
+        rates.observe("crawl", "b", 1, 1e6, now=T0)
+        assert rates.page_cost("crawl", "b", 0.6, now=T0) == \
+            _MAX_PAGE_COST
+        rates.observe("warp", "b", 10**15, 0.001, now=T0)
+        assert rates.page_cost("warp", "b", 0.6, now=T0) == \
+            _MIN_PAGE_COST
+
+    def test_prior_zero_disables_learned_pricing(self):
+        """fleet.kv_rate_prior <= 0: every wire prices at the constant
+        (page_cost None) while rates keep flowing for observability."""
+        rates = MeshWireRates(window_s=30.0, prior_rate=0.0)
+        rates.observe("a", "b", 5000, 1.0, now=T0)
+        assert rates.page_cost("a", "b", 0.6, now=T0) is None
+        assert rates.rate("a", "b", now=T0) == pytest.approx(5000.0)
+
+    def test_drop_member_clears_wires_and_gauge_series(self):
+        """Dead members leave the label set (the tenant-gauge policy):
+        every wire touching the member goes, both directions."""
+        metrics = _FakeMetrics()
+        rates = MeshWireRates(prior_rate=1000.0, metrics=metrics)
+        rates.observe("m1", "m2", 100, 1.0, now=T0)
+        rates.observe("m2", "m1", 200, 1.0, now=T0)
+        rates.observe("registry", "m3", 300, 1.0, now=T0)
+        rates.drop_member("m1")
+        assert rates.rate("m1", "m2", now=T0) is None
+        assert rates.rate("m2", "m1", now=T0) is None
+        assert rates.rate("registry", "m3", now=T0) == pytest.approx(300)
+        assert sorted(metrics.removed) == [("m1", "m2"), ("m2", "m1")]
+
+    def test_snapshot_rows_are_stable_and_total(self):
+        rates = MeshWireRates(window_s=10.0, prior_rate=1000.0)
+        rates.observe("b", "a", 100, 1.0, chunks=1, now=T0)
+        rates.observe("a", "b", 200, 1.0, chunks=2, now=T0)
+        rows = rates.snapshot(now=T0 + 60.0)  # decayed: rate None
+        assert [(r["src"], r["dst"]) for r in rows] == \
+            [("a", "b"), ("b", "a")]
+        assert rows[0]["bytes"] == 200 and rows[0]["chunks"] == 2
+        assert rows[0]["rate_bytes_per_s"] is None
+
+    def test_observations_piggyback_on_perf_telemetry(self):
+        """Worker-side rates bump cumulative kvwire counters so the
+        registry learns member↔member rates from the frames the
+        heartbeat was shipping anyway."""
+        perf = _FakePerf()
+        rates = MeshWireRates(prior_rate=1000.0, perf=perf)
+        rates.observe("w2", "w1", 4096, 0.5, chunks=3, now=T0)
+        rates.observe("w2", "w1", 4096, 0.5, chunks=1, now=T0 + 1)
+        base = f"{WIRE_COUNTER_PREFIX}w2|w1|"
+        assert perf.counters[base + "bytes"] == pytest.approx(8192.0)
+        assert perf.counters[base + "seconds"] == pytest.approx(1.0)
+        assert perf.counters[base + "chunks"] == pytest.approx(4.0)
+
+    def test_channel_handle_feeds_the_keyed_estimator(self):
+        rates = MeshWireRates(window_s=30.0, prior_rate=1000.0)
+        handle = rates.estimator("w2", "w1")
+        handle.observe(500, 0.5, now=T0)
+        assert handle.rate(now=T0) == pytest.approx(1000.0)
+        assert rates.rate("w2", "w1", now=T0) == pytest.approx(1000.0)
+
+
+# ---------------------------------------------------------------------------
+# MeshClient: intro lifecycle (no sockets — channels dial lazily)
+# ---------------------------------------------------------------------------
+
+
+def _client(member="w2"):
+    return MeshClient(member, MeshWireRates(prior_rate=1000.0))
+
+
+class TestMeshClientIntros:
+    def test_intro_creates_a_lazy_channel(self):
+        client = _client()
+        try:
+            client.on_intro({"member_id": "w1", "host": "127.0.0.1",
+                             "data_port": 19999, "max_streams": 4})
+            ch = client.channel("w1")
+            assert ch is not None and ch.address == ("127.0.0.1", 19999)
+            assert client.channel("nobody") is None
+            assert client.peer("nobody", "engine-0") is None
+        finally:
+            client.close()
+
+    def test_unchanged_reintro_keeps_the_channel(self):
+        """The broker resends intros every heartbeat; an unchanged
+        endpoint must not churn the channel (breaker/backoff state
+        lives there)."""
+        client = _client()
+        try:
+            intro = {"member_id": "w1", "host": "127.0.0.1",
+                     "data_port": 19999, "max_streams": 4}
+            client.on_intro(intro)
+            first = client.channel("w1")
+            client.on_intro(dict(intro))
+            assert client.channel("w1") is first
+        finally:
+            client.close()
+
+    def test_changed_endpoint_replaces_the_channel(self):
+        client = _client()
+        try:
+            client.on_intro({"member_id": "w1", "host": "127.0.0.1",
+                             "data_port": 19999, "max_streams": 4})
+            first = client.channel("w1")
+            client.on_intro({"member_id": "w1", "host": "127.0.0.1",
+                             "data_port": 20001, "max_streams": 4})
+            second = client.channel("w1")
+            assert second is not first
+            assert second.address == ("127.0.0.1", 20001)
+        finally:
+            client.close()
+
+    def test_gone_retracts_channel_and_learned_rates(self):
+        client = _client()
+        try:
+            client.on_intro({"member_id": "w1", "host": "127.0.0.1",
+                             "data_port": 19999, "max_streams": 4})
+            client.rates.observe("w2", "w1", 100, 1.0, now=T0)
+            client.on_intro({"member_id": "w1", "gone": True})
+            assert client.channel("w1") is None
+            assert client.rates.rate("w2", "w1", now=T0) is None
+        finally:
+            client.close()
+
+    def test_self_and_invalid_intros_ignored(self):
+        client = _client()
+        try:
+            client.on_intro({"member_id": "w2", "host": "127.0.0.1",
+                             "data_port": 19999})  # self
+            client.on_intro({"member_id": "w1", "host": "",
+                             "data_port": 19999})  # no host -> retract
+            client.on_intro({"member_id": "w1", "host": "127.0.0.1",
+                             "data_port": 0})  # no port -> retract
+            assert client.stats() == {}
+        finally:
+            client.close()
+
+    def test_close_drops_everything_and_refuses_new_intros(self):
+        client = _client()
+        client.on_intro({"member_id": "w1", "host": "127.0.0.1",
+                         "data_port": 19999, "max_streams": 4})
+        client.close()
+        assert client.channel("w1") is None
+        client.on_intro({"member_id": "w3", "host": "127.0.0.1",
+                         "data_port": 20002, "max_streams": 4})
+        assert client.channel("w3") is None
+
+
+class TestMeshPeerFailFast:
+    def test_missing_wire_fails_the_export_immediately(self):
+        """The exactly-once callback contract's fail-fast arm: no
+        channel, or a breaker-open one, answers on_done(None, err)
+        without touching a socket — the worker degrades to recompute."""
+        done = []
+        MeshPeer(None, "engine-0").submit_prefix_export(
+            "r1", [1, 2], 2, "none", lambda c, e: done.append((c, e)))
+        assert done == [(None, "mesh peer wire unavailable")]
+
+    def test_breaker_open_wire_fails_fast(self):
+        class _OpenBreakerChannel:
+            def wire_available(self):
+                return False
+
+        done = []
+        MeshPeer(_OpenBreakerChannel(), "engine-0").submit_prefix_export(
+            "r1", [1, 2], 2, "none", lambda c, e: done.append((c, e)))
+        assert done == [(None, "mesh peer wire unavailable")]
+
+
+# ---------------------------------------------------------------------------
+# plan_route pricing: learned wire rates steer the fetch target
+# ---------------------------------------------------------------------------
+
+PS = 4
+PROMPT = list(range(33))  # 8 full pages + 1
+HASHES = chain_hashes(PROMPT, PS, max_pages=8)
+COSTS = FetchCosts(min_pages=2, page_cost=0.25, load_cost_pages=4.0,
+                   remote_page_cost=0.6)
+
+
+def _status(eid, healthy=True, active=0, waiting=0, digest=None,
+            remote=False, data_plane=False):
+    return EngineStatus(
+        engine_id=eid, healthy=healthy, active_requests=active,
+        waiting_requests=waiting, total_processed=0,
+        memory_used_pages=0, memory_total_pages=100,
+        prefix_digest=digest, page_size=PS, role="unified",
+        digest_depth=8, remote=remote, data_plane=data_plane,
+    )
+
+
+def _wire_cost(rates):
+    """The server wiring (serving/server.py): a status pair becomes a
+    (src, dst) rate key — "registry" for this host, the member id for a
+    remote proxy — and cold wires return None (charge the constant)."""
+    def member_of(status):
+        if status is None or not getattr(status, "remote", False):
+            return "registry"
+        return status.engine_id.rsplit(":", 1)[0]
+
+    def cost(target, peer):
+        src, dst = member_of(target), member_of(peer)
+        if src == dst:
+            return None
+        if "registry" in (src, dst):
+            member = dst if src == "registry" else src
+            return rates.page_cost("registry", member,
+                                   COSTS.remote_page_cost, now=T0)
+        return rates.page_cost(src, dst, COSTS.remote_page_cost, now=T0)
+
+    return cost
+
+
+def _mesh_statuses():
+    """A saturated warm remote peer, a cold remote mesh target, and a
+    cold local engine: fetch beats route, and the (src, dst) wire
+    prices decide WHICH target pulls the chain."""
+    return [
+        _status("engine-0"),
+        _status("w1:engine-0", active=6, waiting=4,
+                digest=frozenset(HASHES), remote=True, data_plane=True),
+        _status("w2:engine-0", remote=True, data_plane=True),
+    ]
+
+
+class TestMeshRoutingMatrix:
+    def test_cold_wires_price_at_the_prior_and_tie_break(self):
+        """Every wire cold: both fetch options charge the constant
+        (cold page_cost is None -> the static prior), so the decision
+        falls to the deterministic engine-id tie-break — here the local
+        relay, which sorts first. Identical pricing to passing no
+        wire_cost at all."""
+        rates = MeshWireRates(window_s=30.0, prior_rate=1000.0)
+        plan = plan_route(_mesh_statuses(), HASHES, costs=COSTS,
+                          page_size=PS, wire_cost=_wire_cost(rates),
+                          mesh_route=lambda t, p: True)
+        assert plan.decision == "fetch"
+        assert plan.engine_id == "engine-0"
+        assert plan.peer_id == "w1:engine-0"
+        bare = plan_route(_mesh_statuses(), HASHES, costs=COSTS,
+                          page_size=PS,
+                          mesh_route=lambda t, p: True)
+        assert (bare.engine_id, bare.decision) == \
+            (plan.engine_id, plan.decision)
+
+    def test_fast_mesh_wire_beats_the_relay(self):
+        rates = MeshWireRates(window_s=30.0, prior_rate=1000.0)
+        rates.observe("w2", "w1", 100_000, 1.0, now=T0)  # 100x prior
+        plan = plan_route(_mesh_statuses(), HASHES, costs=COSTS,
+                          page_size=PS, wire_cost=_wire_cost(rates),
+                          mesh_route=lambda t, p: True)
+        assert (plan.engine_id, plan.decision) == ("w2:engine-0", "fetch")
+
+    def test_degraded_wire_rate_flips_the_fetch_target(self):
+        """THE acceptance pin: the same fleet, the same request — when
+        the member↔member wire's learned rate degrades, the fetch
+        decision demonstrably flips off the mesh target onto the host
+        (whose registry wire now prices better)."""
+        rates = MeshWireRates(window_s=30.0, prior_rate=1000.0)
+        rates.observe("w2", "w1", 100_000, 1.0, now=T0)
+        route = lambda t, p: True  # noqa: E731
+        before = plan_route(_mesh_statuses(), HASHES, costs=COSTS,
+                            page_size=PS, wire_cost=_wire_cost(rates),
+                            mesh_route=route)
+        assert before.engine_id == "w2:engine-0"
+        # congestion: the wire now measures 100x SLOWER than the prior
+        rates.observe("w2", "w1", 100_000, 10_000.0, now=T0 + 1)
+        after = plan_route(_mesh_statuses(), HASHES, costs=COSTS,
+                           page_size=PS, wire_cost=_wire_cost(rates),
+                           mesh_route=route)
+        assert after.decision == "fetch"
+        assert after.engine_id == "engine-0"
+        assert after.peer_id == "w1:engine-0"
+
+    def test_mesh_gate_closed_excludes_the_remote_target(self):
+        """Without an introduction (mesh_route False) the remote target
+        has no admissible wire to the peer: the fetch stays on the
+        host, however fast the member wire claims to be."""
+        rates = MeshWireRates(window_s=30.0, prior_rate=1000.0)
+        rates.observe("w2", "w1", 100_000, 1.0, now=T0)
+        plan = plan_route(_mesh_statuses(), HASHES, costs=COSTS,
+                          page_size=PS, wire_cost=_wire_cost(rates),
+                          mesh_route=lambda t, p: False)
+        assert (plan.engine_id, plan.decision) == ("engine-0", "fetch")
+
+    def test_no_data_plane_excludes_the_remote_target(self):
+        """A remote target without a KV data plane cannot seat imported
+        pages: its fetch option never exists (breaker-open wires land
+        here too — data_plane clears while the breaker is open)."""
+        statuses = _mesh_statuses()
+        statuses[2] = _status("w2:engine-0", remote=True,
+                              data_plane=False)
+        rates = MeshWireRates(window_s=30.0, prior_rate=1000.0)
+        rates.observe("w2", "w1", 100_000, 1.0, now=T0)
+        plan = plan_route(statuses, HASHES, costs=COSTS, page_size=PS,
+                          wire_cost=_wire_cost(rates),
+                          mesh_route=lambda t, p: True)
+        assert (plan.engine_id, plan.decision) == ("engine-0", "fetch")

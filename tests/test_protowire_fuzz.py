@@ -414,3 +414,56 @@ def test_tele_digest_wire_matches_live_digest():
     assert got == wire
     # and a wire round-trip is transparent to the merge algebra
     assert merge_digests([got, got]) == merge_digests([wire, wire])
+
+
+# ---------------------------------------------------------------------------
+# KvIntro — the mesh introduction frame (fleet-wire kind 6)
+# ---------------------------------------------------------------------------
+
+
+def _rand_intro(rng: random.Random) -> dict:
+    return {
+        "member_id": _rand_text(rng, 24) or "m0",
+        "host": rng.choice(["127.0.0.1", "10.1.2.3", "fe80::1%eth0",
+                            _rand_text(rng, 16)]),
+        "data_port": rng.randrange(0, 65536),
+        "max_streams": rng.randrange(0, 64),
+        "gone": rng.random() < 0.3,
+    }
+
+
+def test_kv_intro_roundtrip_fuzz():
+    """KvIntro — the registry's mesh introduction broker frame
+    (fleet-wire kind 6, serving/fleet_mesh.py) — survives the wire
+    field-for-field, including zero ports and gone retractions."""
+    rng = random.Random(0x7E21)
+    for i in range(200):
+        msg = _rand_intro(rng)
+        got = protowire.decode("KvIntro",
+                               protowire.encode("KvIntro", msg))
+        assert got == msg, i
+
+
+def test_kv_intro_truncation_and_unknown_fields():
+    """An intro cut mid-field is rejected — a member must never dial a
+    half-parsed endpoint — and unknown fields skip cleanly (newer
+    registries can extend the introduction without breaking members)."""
+    rng = random.Random(0x7E22)
+    msg = _rand_intro(rng)
+    msg["gone"] = True  # a trailing one-byte field to cut the value off
+    base = protowire.encode("KvIntro", msg)
+    with pytest.raises(ValueError):
+        protowire.decode("KvIntro", base[: len(base) - 1])
+    unknown = protowire._key(77, 2) + bytes([4, 9, 9, 9, 9])
+    assert protowire.decode("KvIntro", unknown + base) == \
+        protowire.decode("KvIntro", base)
+
+
+def test_kv_intro_decode_fills_proto3_defaults():
+    """A minimal intro (member_id only) decodes with every other field
+    at its proto3 default — absent gone reads False, absent port 0, so
+    MeshClient's gone-or-invalid-endpoint check is well-defined."""
+    got = protowire.decode(
+        "KvIntro", protowire.encode("KvIntro", {"member_id": "m1"}))
+    assert got == {"member_id": "m1", "host": "", "data_port": 0,
+                   "max_streams": 0, "gone": False}

@@ -60,6 +60,7 @@ DEFAULT_SCENARIOS = (
     "slow_member_brownout",
     "breaker_flap",
     "overload_shed",
+    "mesh_peer_wire_death",
 )
 
 _PROMPT = "chaos is a ladder, resilience is a lattice"
@@ -136,7 +137,7 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
                 channel="inproc", auto_restart=True, warmup=False,
                 handoff_timeout_s=20.0, engine_kwargs=None,
                 fleet=False, rerole=False, member_roles=("unified",),
-                health=None, admission=None, slo=None):
+                health=None, admission=None, slo=None, mesh=False):
     """A tiny-model fleet wired exactly like production (the
     disagg_smoke.py topology, sans HTTP): real engines, real runners,
     real dispatcher/scheduler/controller. Health loop runs hot
@@ -161,7 +162,13 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
     admission, and short SLO digest windows so latency evidence decays
     inside a scenario. ``slo`` is applied to the member server too —
     digest epochs must agree or the host drops the member's telemetry
-    frames as foreign."""
+    frames as foreign.
+
+    ``mesh=True`` (implies ``fleet``) turns on the member<->member KV
+    mesh (docs/FLEET.md "KV mesh") and joins a SECOND member
+    (``chaos-w2``, same roles) so the registry has a pair to introduce
+    — three schedulers, three allocators, one real localhost wire per
+    member plus the brokered member->member data wire."""
     import jax.numpy as jnp
 
     from distributed_inference_server_tpu.engine.engine import (
@@ -193,11 +200,13 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
 
     # aging windows sized for LOADED runners: a GIL stall from a
     # concurrent engine compile must read as jitter, not death
+    fleet = fleet or mesh
     fleet_settings = FleetSettings(
         enabled=fleet, heartbeat_interval_s=0.1, suspect_after_s=0.6,
         dead_after_s=1.5, rerole=rerole, rerole_high_ratio=2.0,
         rerole_low_ratio=0.5, rerole_cooldown_s=0.3,
         rerole_interval_s=60.0,  # scenarios drive evaluate() themselves
+        mesh_enabled=mesh,
     )
     srv = InferenceServer(
         factory, ByteTokenizer(), model_name="tiny-chaos",
@@ -215,6 +224,8 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
     srv.start()
     srv._fleet_worker = None
     srv._fleet_worker_srv = None
+    srv._fleet_worker2 = None
+    srv._fleet_worker2_srv = None
     if fleet:
         worker_srv = InferenceServer(
             factory, ByteTokenizer(), model_name="tiny-chaos-member",
@@ -229,13 +240,31 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
         srv._fleet_worker_settings = FleetSettings(
             connect=f"127.0.0.1:{srv.fleet_server.bound_port}",
             heartbeat_interval_s=0.1,
+            mesh_enabled=mesh,
         )
+        if mesh:
+            worker2_srv = InferenceServer(
+                factory, ByteTokenizer(), model_name="tiny-chaos-member2",
+                num_engines=len(member_roles),
+                engine_roles=list(member_roles),
+                auto_restart=auto_restart,
+                health_check_interval_s=0.1,
+                slo_settings=slo,
+            )
+            worker2_srv.start()
+            srv._fleet_worker2_srv = worker2_srv
         _ensure_worker(srv)
+        if mesh:
+            _ensure_worker2(srv)
         orig_shutdown = srv.shutdown
 
         def _shutdown(drain_timeout_s=30.0):
             if srv._fleet_worker is not None:
                 srv._fleet_worker.stop()
+            if srv._fleet_worker2 is not None:
+                srv._fleet_worker2.stop()
+            if srv._fleet_worker2_srv is not None:
+                srv._fleet_worker2_srv.shutdown(drain_timeout_s)
             worker_srv.shutdown(drain_timeout_s)
             orig_shutdown(drain_timeout_s)
 
@@ -243,33 +272,45 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
     return srv
 
 
-def _ensure_worker(srv, timeout_s: float = 20.0):
-    """Make sure the chaos member is connected, alive in the registry,
+def _ensure_member(srv, member_id: str, member_srv, worker_attr: str,
+                   timeout_s: float = 20.0):
+    """Make sure a chaos member is connected, alive in the registry,
     and its remote proxy is registered + healthy (a crashed member from
     a previous seed rejoins under the same member id)."""
     from distributed_inference_server_tpu.serving.remote_runner import (
         FleetWorker,
     )
 
-    fw = srv._fleet_worker
+    fw = getattr(srv, worker_attr)
     if fw is None or fw._crashed or not fw.is_connected():
         if fw is not None:
             fw.stop()
-        fw = FleetWorker(srv._fleet_worker_srv.scheduler,
-                         srv._fleet_worker_settings, member_id="chaos-w1",
-                         metrics=srv._fleet_worker_srv.metrics,
-                         tracer=srv._fleet_worker_srv.tracer)
+        fw = FleetWorker(member_srv.scheduler,
+                         srv._fleet_worker_settings, member_id=member_id,
+                         metrics=member_srv.metrics,
+                         tracer=member_srv.tracer)
         fw.start()
-        srv._fleet_worker = fw
+        setattr(srv, worker_attr, fw)
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
-        if srv.fleet_registry.member_state("chaos-w1") == "alive" and any(
+        if srv.fleet_registry.member_state(member_id) == "alive" and any(
             getattr(r, "is_remote", False) and r.is_healthy()
+            and r.engine_id.startswith(member_id + ":")
             for r in srv.scheduler.engines()
         ):
             return fw
         time.sleep(0.03)
-    raise RuntimeError("chaos fleet member failed to join")
+    raise RuntimeError(f"chaos fleet member {member_id} failed to join")
+
+
+def _ensure_worker(srv, timeout_s: float = 20.0):
+    return _ensure_member(srv, "chaos-w1", srv._fleet_worker_srv,
+                          "_fleet_worker", timeout_s)
+
+
+def _ensure_worker2(srv, timeout_s: float = 20.0):
+    return _ensure_member(srv, "chaos-w2", srv._fleet_worker2_srv,
+                          "_fleet_worker2", timeout_s)
 
 
 def _wait_member_state(srv, state: str, timeout_s: float = 10.0) -> bool:
@@ -328,13 +369,15 @@ def check_invariants(srv, sinks, require_success=False,
             )
         if require_success and s.errors:
             violations.append(f"{s.rid}: expected success, got {s.errors}")
-    member_srv = getattr(srv, "_fleet_worker_srv", None)
+    member_srvs = [m for m in (getattr(srv, "_fleet_worker_srv", None),
+                               getattr(srv, "_fleet_worker2_srv", None))
+                   if m is not None]
     deadline = time.monotonic() + converge_timeout_s
     auto = srv.scheduler._auto_restart
     while time.monotonic() < deadline:
         runners = srv.scheduler.engines()
-        if member_srv is not None:
-            runners = runners + member_srv.scheduler.engines()
+        for m in member_srvs:
+            runners = runners + m.scheduler.engines()
         healthy = all(r.is_healthy() for r in runners)
         fetcher = getattr(srv.dispatcher, "prefix_fetcher", None)
         drained = (
@@ -361,10 +404,11 @@ def check_invariants(srv, sinks, require_success=False,
         )
     for r in srv.scheduler.engines():
         violations.extend(r.audit())
-    if member_srv is not None:
-        # zero page leak on BOTH sides of the data plane: a torn
-        # cross-host stream must release the member's reserved pages too
-        for r in member_srv.scheduler.engines():
+    for m in member_srvs:
+        # zero page leak on EVERY side of the data plane: a torn
+        # cross-host (or member->member mesh) stream must release the
+        # member's reserved pages too
+        for r in m.scheduler.engines():
             violations.extend(r.audit())
     return violations
 
@@ -960,6 +1004,95 @@ def scenario_overload_shed(srv, seed: int):
     return sinks, True, extra
 
 
+def scenario_mesh_peer_wire_death(srv, seed: int):
+    """The KV mesh (docs/FLEET.md "KV mesh"): the cost model picks a
+    REMOTE fetch target (chaos-w2) against a remote warm peer
+    (chaos-w1) — admissible only because the registry introduced the
+    pair — so the host ships a fetch HINT and w2 pulls the chunks
+    directly from w1 over its own data wire. Then that wire dies: the
+    peer dial fails (fleet.kv_peer_dial), a chunk tears off the
+    response stream (fleet.kv_chunk), or w2's import session rejects a
+    chunk (kv.import_chunk). Every death must degrade the hinted
+    request to plain recompute ON THE MEMBER, exactly once, with zero
+    pages leaked on any of the three processes."""
+    rng = random.Random(seed)
+    from distributed_inference_server_tpu.engine.engine import SamplingParams
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.runner import ServerRequest
+
+    _ensure_worker(srv)
+    _ensure_worker2(srv)
+    w1 = next(r for r in srv.scheduler.engines()
+              if r.engine_id.startswith("chaos-w1:"))
+    # seed-unique from the FIRST page: chain hashes are cumulative, so
+    # a shared head (the previous seed's recompute left _PROMPT's pages
+    # on w2) would leave w2 within min_pages of the peer's depth and
+    # cost it its fetch option on a reused fleet
+    prompt = f"mesh{seed} " * rng.randint(2, 3) + _PROMPT
+    # warm the prefix on MEMBER w1 through the control wire, then wait
+    # for its digest to ride a heartbeat AND for the registry to have
+    # both data endpoints (the introduction precondition)
+    warm = []
+    for i in range(2):
+        sink = ChaosSink(f"mw-{seed}-{i}")
+        w1.submit([ServerRequest(
+            sink.rid, ByteTokenizer().encode(prompt),
+            SamplingParams(max_tokens=8, temperature=0.0), sink,
+        )])
+        warm.append(sink)
+    wait_terminal(warm)
+    from distributed_inference_server_tpu.engine.kv_cache import chain_hashes
+    from distributed_inference_server_tpu.serving.scheduler import (
+        prefix_match_depth,
+    )
+    toks = ByteTokenizer().encode(prompt)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        s = w1.status()
+        # the digest must cover THIS seed's prompt (a reused fleet's
+        # digest is already non-empty from the previous seed — waiting
+        # on mere truthiness would race the heartbeat carrying the new
+        # chain and leave plan_route with no fetch option to force)
+        hashes = chain_hashes(toks, max(1, getattr(s, "page_size", 0) or 1))
+        if (hashes and prefix_match_depth(s, hashes) == len(hashes)
+                and getattr(s, "data_plane", False)
+                and srv.fleet_server.mesh_route("chaos-w2", "chaos-w1")):
+            break
+        time.sleep(0.05)
+
+    def delegated_count():
+        cache = srv.metrics.snapshot().to_dict().get("cache") or {}
+        return (cache.get("peer_fetch") or {}).get("delegated", 0)
+
+    before = delegated_count()
+    sinks = []
+    spec = rng.choice([
+        "sched.fetch_decision:nth=1;fleet.kv_peer_dial:nth=1",
+        f"sched.fetch_decision:nth=1;fleet.kv_chunk:nth={rng.randint(1, 2)}",
+        "sched.fetch_decision:nth=1;kv.import_chunk:nth=1",
+    ])
+    # the local engine is unregistered for the one faulted decision, so
+    # the mesh pair is the ONLY fetch option the flag can force: a
+    # previous seed's transfer leaves a (correctly) terrible learned
+    # rate on the mesh wire, and pricing the relay against it would
+    # route the fetch through the host — sound routing, wrong scenario
+    local = next(r for r in srv.scheduler.engines()
+                 if not getattr(r, "is_remote", False))
+    srv.scheduler.unregister(local.engine_id)
+    try:
+        _arm(spec, seed)
+        submit(srv, f"mesh-{seed}", prompt=prompt, max_tokens=16,
+               sinks=sinks)
+        wedged = wait_terminal(sinks, timeout_s=90.0)
+    finally:
+        srv.scheduler.register(local)
+    extra = [f"{r}: no terminal event (wedged)" for r in wedged]
+    if delegated_count() <= before:
+        extra.append("fetch was never delegated to the mesh "
+                     "(no fetch hint left the host)")
+    return sinks, True, extra
+
+
 #: chaos-paced gray-failure settings (serving/health.py): scenarios
 #: drive evaluate() themselves (interval_s=60), evidence windows short
 #: enough to decay inside one scenario, thresholds low enough for a
@@ -1067,6 +1200,16 @@ SCENARIOS = {
                        "health": _chaos_health(),
                        "slo": _chaos_slo(),
                        "admission": _chaos_admission()}),
+    # the KV mesh (docs/FLEET.md "KV mesh"): registry + TWO members,
+    # the fetch delegated member->member over the brokered wire, and
+    # the wire killed under it. Digests need the Python allocator tier
+    # (same constraint as warm_peer_fetch_death).
+    "mesh_peer_wire_death": (scenario_mesh_peer_wire_death,
+                             {"roles": ("unified",), "mesh": True,
+                              "strategy": "cache_aware",
+                              "member_roles": ("unified",),
+                              "engine_kwargs": {
+                                  "native_allocator": False}}),
 }
 
 

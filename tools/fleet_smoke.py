@@ -22,6 +22,13 @@ frames) — then proves the control plane end to end:
    TTFT p99 EXACTLY equals an offline re-merge of the member digests
    fetched from each process, and ``fleet_*{member}`` series appear in
    host ``/metrics``;
+2c. **KV mesh** (docs/FLEET.md "KV mesh"): a three-process fleet —
+   registry + two mesh members — where a forced fetch moves the warm
+   member's chunks DIRECTLY to the cold member over the
+   registry-introduced wire, token-identically, while the registry's
+   own data-channel byte counters do NOT move (the broker never
+   relays), and the puller's observed transfer surfaces as a learned
+   wire-rate row in the host's ``kv_wires`` stats table;
 3. **remote death**: the worker process is SIGKILLed with a zero-token
    request in flight; the request must complete via crash-safe
    redispatch on the local engine — token-identically, exactly once,
@@ -72,14 +79,18 @@ def _smoke_slo():
     return SloSettings(window_s=8.0, epoch_s=1.0)
 
 
-def _build_server(fleet_settings=None, engine_roles=None, health=None):
+def _build_server(fleet_settings=None, engine_roles=None, health=None,
+                  strategy=None, engine_kwargs=None):
     """One-engine InferenceServer on the seeded tiny model (both
     processes build identical params: PRNGKey(0) is deterministic).
     ``engine_roles`` (a LIST, e.g. ``["prefill"]`` / ``["decode"]``)
     shapes the cross-host-handoff leg: the host prefills, a decode-role
     worker is the migration target over the KV data channel. ``health``
     (serving/health.py HealthSettings) paces the host's gray-failure
-    scorer for the degrade-and-recover leg."""
+    scorer for the degrade-and-recover leg. ``strategy`` (a string,
+    e.g. "cache_aware") and ``engine_kwargs`` (EngineConfig overrides —
+    the mesh leg needs ``native_allocator=False`` for the prefix-digest
+    surface) shape the KV-mesh leg's routing."""
     import jax
     import jax.numpy as jnp
 
@@ -93,6 +104,9 @@ def _build_server(fleet_settings=None, engine_roles=None, health=None):
     from distributed_inference_server_tpu.models import llama
     from distributed_inference_server_tpu.models.configs import TINY
     from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving.scheduler import (
+        SchedulingStrategy,
+    )
     from distributed_inference_server_tpu.serving.server import InferenceServer
 
     params = llama.init_params(jax.random.PRNGKey(0), TINY,
@@ -104,7 +118,7 @@ def _build_server(fleet_settings=None, engine_roles=None, health=None):
         return LLMEngine(
             params, TINY, ByteTokenizer(),
             EngineConfig(max_batch=4, prefill_buckets=(16, 64), paged=paged,
-                         warmup_compile=False),
+                         warmup_compile=False, **(engine_kwargs or {})),
             dtype=jnp.float32,
         )
 
@@ -112,6 +126,8 @@ def _build_server(fleet_settings=None, engine_roles=None, health=None):
         factory, ByteTokenizer(), model_name="tiny-fleet-smoke",
         num_engines=len(engine_roles) if engine_roles else 1,
         engine_roles=engine_roles,
+        strategy=(SchedulingStrategy.parse(strategy) if strategy
+                  else SchedulingStrategy.LEAST_LOADED),
         auto_restart=False, fleet_settings=fleet_settings,
         slo_settings=_smoke_slo(), health_settings=health,
     )
@@ -155,7 +171,7 @@ def _request(rid: str):
 
 def run_worker(connect: str, role: str = "",
                member_id: str = MEMBER_ID, http_port: int = 0,
-               fault_spec: str = "") -> int:
+               fault_spec: str = "", mesh: bool = False) -> int:
     """Child process: one engine + a FleetWorker joined to ``connect``;
     serves until killed. ``role`` ("decode") makes this member the
     cross-host handoff target over its KV data channel. ``http_port``
@@ -163,7 +179,11 @@ def run_worker(connect: str, role: str = "",
     fetches its /server/perf digests). ``fault_spec`` arms a seeded
     FaultSet in THIS process (the degrade-and-recover leg's
     fleet.slow_member delay; a bounded ``times=`` makes the fault
-    self-clearing). SIGTERM runs a page-conservation audit and exits
+    self-clearing). ``mesh`` joins the member<->member KV mesh
+    (docs/FLEET.md "KV mesh"): registry KvIntro frames are honored,
+    fetch hints pull directly from peer members, and the engine keeps
+    the Python allocator tier so its prefix digests have a surface.
+    SIGTERM runs a page-conservation audit and exits
     with its verdict — the host's "clean audits both sides" check."""
     _env_setup()
     from distributed_inference_server_tpu.serving import faults
@@ -172,12 +192,16 @@ def run_worker(connect: str, role: str = "",
         FleetWorker,
     )
 
-    srv = _build_server(engine_roles=[role] if role else None)
+    srv = _build_server(
+        engine_roles=[role] if role else None,
+        engine_kwargs={"native_allocator": False} if mesh else None,
+    )
     if fault_spec:
         faults.install(faults.parse_spec(fault_spec, seed=0))
     worker = FleetWorker(
         srv.scheduler,
-        FleetSettings(connect=connect, heartbeat_interval_s=0.2),
+        FleetSettings(connect=connect, heartbeat_interval_s=0.2,
+                      mesh_enabled=mesh),
         member_id=member_id,
         # fleet-stitched tracing: fleet.serve/engine.infer spans ship
         # back to the registry host (docs/OBSERVABILITY.md)
@@ -676,6 +700,186 @@ def _degrade_leg(srv, port: int, registry_port: int) -> Optional[str]:
             child.wait(timeout=10)
 
 
+def _mesh_leg() -> Optional[str]:
+    """The KV-mesh acceptance (docs/FLEET.md "KV mesh", step 2c of the
+    module docstring), on its OWN three-process fleet: a cache_aware
+    registry with mesh introductions on, plus two ``--mesh`` members.
+    amesh-1 is warmed; a forced fetch (the ``sched.fetch_decision``
+    flag, exactly one routing decision) must then land on the cold
+    member — the ids sort before the local ``engine-0`` so the
+    cheapest-fetch tie-break is deterministic — making amesh-2 pull the
+    chunks DIRECTLY from amesh-1 over the registry-introduced wire.
+    Asserts: the stream is token-identical to the warm run, the
+    delegated-fetch counter moved, the REGISTRY's own data-channel byte
+    counters did NOT move (the broker introduces, it never relays), the
+    puller's observed transfer comes back via telemetry as a
+    (src=amesh-2, dst=amesh-1) ``kv_wires`` row with bytes, and page
+    audits are clean on all three processes. Returns a violation string
+    or None."""
+    from distributed_inference_server_tpu.engine.engine import SamplingParams
+    from distributed_inference_server_tpu.engine.kv_cache import chain_hashes
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+    from distributed_inference_server_tpu.serving import faults
+    from distributed_inference_server_tpu.serving.fleet import FleetSettings
+    from distributed_inference_server_tpu.serving.runner import ServerRequest
+    from distributed_inference_server_tpu.serving.scheduler import (
+        prefix_match_depth,
+    )
+
+    prompt = "the mesh moves pages between rooms " + _PROMPT
+    srv = _build_server(
+        FleetSettings(enabled=True, heartbeat_interval_s=0.2,
+                      suspect_after_s=1.0, dead_after_s=2.0,
+                      mesh_enabled=True),
+        strategy="cache_aware",
+        engine_kwargs={"native_allocator": False},
+    )
+    port = srv.fleet_server.bound_port
+    children = []
+    try:
+        for member in ("amesh-1", "amesh-2"):
+            children.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 "--connect", f"127.0.0.1:{port}",
+                 "--member-id", member, "--mesh"],
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            ))
+        deadline = time.monotonic() + 240.0
+        proxies = {}
+        while time.monotonic() < deadline and len(proxies) < 2:
+            for r in srv.scheduler.engines():
+                if getattr(r, "is_remote", False) and r.is_healthy():
+                    proxies[r.engine_id.rsplit(":", 1)[0]] = r
+            if any(c.poll() is not None for c in children):
+                return "a mesh worker died before joining"
+            time.sleep(0.1)
+        if len(proxies) < 2:
+            return "mesh workers never joined the registry"
+
+        # warm amesh-1; its stream is the reference the mesh-fetched
+        # run must reproduce byte-for-byte
+        ref = _Sink()
+        proxies["amesh-1"].submit([ServerRequest(
+            "mesh-warm", ByteTokenizer().encode(prompt),
+            SamplingParams(max_tokens=24, temperature=0.0), ref)])
+        if not ref.ev.wait(120.0) or ref.errors:
+            return f"mesh warm run failed: {ref.errors}"
+
+        # fetch-admissibility: amesh-1's digest covers the prompt's
+        # chain to the published depth (it rides a heartbeat), its data
+        # plane is up, and the registry has introduced the pair. The
+        # chain is capped to the digest depth exactly like the
+        # scheduler's own hashing — the raw prompt can outrun it.
+        toks = ByteTokenizer().encode(prompt)
+        deadline = time.monotonic() + 30.0
+        ready = False
+        while time.monotonic() < deadline and not ready:
+            s = proxies["amesh-1"].status()
+            ps = max(1, getattr(s, "page_size", 0) or 1)
+            hashes = chain_hashes(
+                toks, ps,
+                max_pages=min(getattr(s, "digest_depth", 0) or 8,
+                              (len(toks) - 1) // ps))
+            ready = bool(
+                hashes and prefix_match_depth(s, hashes) == len(hashes)
+                and getattr(s, "data_plane", False)
+                and srv.fleet_server.mesh_route("amesh-2", "amesh-1"))
+            if not ready:
+                time.sleep(0.1)
+        if not ready:
+            s = proxies["amesh-1"].status()
+            return ("mesh pair never became fetch-admissible: "
+                    f"depth={prefix_match_depth(s, hashes)}"
+                    f"/{len(hashes)} "
+                    f"data_plane={getattr(s, 'data_plane', False)} "
+                    "introduced="
+                    f"{srv.fleet_server.mesh_route('amesh-2', 'amesh-1')}")
+
+        reg_bytes_before = {
+            m: (st.get("bytes_sent", 0), st.get("bytes_received", 0))
+            for m, st in srv.fleet_server.kv_stats().items()}
+        snap = srv.metrics.snapshot().to_dict()
+        delegated_before = ((snap.get("cache") or {})
+                            .get("peer_fetch") or {}).get("delegated", 0)
+
+        sink = _Sink()
+        faults.install(faults.parse_spec("sched.fetch_decision:nth=1", 0))
+        try:
+            srv.dispatcher.submit(ServerRequest(
+                "mesh-fetch", ByteTokenizer().encode(prompt),
+                SamplingParams(max_tokens=24, temperature=0.0), sink))
+            if not sink.ev.wait(120.0):
+                dump_postmortem(srv, "mesh-fetch")
+                return "mesh-fetched request never terminated"
+        finally:
+            faults.clear()
+        if sink.errors:
+            dump_postmortem(srv, "mesh-fetch")
+            return f"mesh-fetched request errored: {sink.errors}"
+        if sink.toks != ref.toks:
+            dump_postmortem(srv, "mesh-fetch")
+            return (f"mesh-fetched stream diverged: "
+                    f"{sink.toks} != {ref.toks}")
+
+        snap = srv.metrics.snapshot().to_dict()
+        delegated = ((snap.get("cache") or {})
+                     .get("peer_fetch") or {}).get("delegated", 0)
+        if delegated <= delegated_before:
+            dump_postmortem(srv, "mesh-fetch")
+            return ("fetch was never delegated to the mesh "
+                    "(no fetch hint left the host)")
+        print("fleet-smoke: mesh fetch delegated, stream "
+              "token-identical OK", flush=True)
+
+        reg_bytes_after = {
+            m: (st.get("bytes_sent", 0), st.get("bytes_received", 0))
+            for m, st in srv.fleet_server.kv_stats().items()}
+        if reg_bytes_after != reg_bytes_before:
+            return ("registry data-channel bytes moved during a mesh "
+                    f"fetch (broker must not relay): {reg_bytes_before} "
+                    f"-> {reg_bytes_after}")
+
+        # the puller's kvwire counters ride heartbeats back: the host's
+        # kv_wires table must grow the (amesh-2 <- amesh-1) row
+        deadline = time.monotonic() + 20.0
+        wire = None
+        while time.monotonic() < deadline and wire is None:
+            wire = next(
+                (r for r in srv.fleet_server.kv_wire_stats()
+                 if r["src"] == "amesh-2" and r["dst"] == "amesh-1"
+                 and r.get("bytes", 0) > 0), None)
+            if wire is None:
+                time.sleep(0.2)
+        if wire is None:
+            return ("kv_wires never learned the amesh-2<-amesh-1 "
+                    "transfer (rows: "
+                    f"{srv.fleet_server.kv_wire_stats()})")
+        rate = wire.get("rate_bytes_per_s")
+        print(f"fleet-smoke: registry bytes unmoved, learned wire rate "
+              f"{'cold' if rate is None else f'{rate / 1e6:.1f}MB/s'} "
+              f"over {wire['bytes']}B OK", flush=True)
+
+        # clean audits all three processes: members audit on SIGTERM
+        for c in children:
+            c.terminate()
+        rcs = [c.wait(timeout=30) for c in children]
+        if any(rc != 0 for rc in rcs):
+            return f"mesh worker audits exited {rcs}"
+        issues = next(r for r in srv.scheduler.engines()
+                      if not getattr(r, "is_remote", False)).audit()
+        if issues:
+            return f"mesh host page audit: {issues}"
+        print("fleet-smoke: mesh audits clean on all three processes OK",
+              flush=True)
+        return None
+    finally:
+        for c in children:
+            if c.poll() is None:
+                c.kill()
+                c.wait(timeout=10)
+        srv.shutdown(drain_timeout_s=5.0)
+
+
 def run_host() -> int:
     _env_setup()
     from distributed_inference_server_tpu.serving.fleet import FleetSettings
@@ -776,6 +980,11 @@ def run_host() -> int:
         if violation is not None:
             return _fail(violation)
 
+        # -- 2.8 member<->member KV mesh (own three-process fleet) ------
+        violation = _mesh_leg()
+        if violation is not None:
+            return _fail(violation)
+
         # -- 3. kill the worker mid-zero-token-request ------------------
         r2_req, r2 = _request("smoke-kill")
         remote.submit([r2_req])
@@ -855,12 +1064,17 @@ def main() -> int:
                     help="worker mode: arm this fault spec in the "
                     "worker process (the degrade-and-recover leg's "
                     "fleet.slow_member delay)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="worker mode: join the member<->member KV "
+                    "mesh (honor KvIntro frames, pull fetch hints "
+                    "directly from peer members)")
     args = ap.parse_args()
     if args.worker:
         return run_worker(args.connect, role=args.role,
                           member_id=args.member_id,
                           http_port=args.http_port,
-                          fault_spec=args.fault_spec)
+                          fault_spec=args.fault_spec,
+                          mesh=args.mesh)
     return run_host()
 
 
