@@ -535,6 +535,10 @@ class _MemberSession:
                 raise FleetWireError("member session closed")
             send_frame(self.sock, name, obj)
 
+    # host->member kinds never arrive here: this loop reads what MEMBERS
+    # send (heartbeats, events, spans, telemetry); submits and KvIntro
+    # travel the other direction, on FleetWorker's reader
+    # distlint: wire-ignores[FleetSubmit, KvIntro]
     def run(self) -> None:
         """Reader loop (one thread per session)."""
         try:

@@ -295,6 +295,7 @@ class KvDataChannel:
         # GIL-atomic bool, wire-worker-owned  # distlint: ignore[DL008]
         self._reconnecting = False
         self._lock = threading.Lock()
+        # distlint: registry
         self._streams: Dict[str, _KvStream] = {}
         # request ids of migrated sequences whose decode events ride
         # THIS connection; failed fast if the channel dies under them
@@ -583,8 +584,18 @@ class KvDataChannel:
             self._backoff_s = min(self._backoff_s * 2.0, 5.0)
             self._reconnecting = True
             raise
-        sock.settimeout(None)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            # a socket that dialed but cannot be configured is as dead
+            # as a failed dial: close it (else the fd leaks) and take
+            # the same backoff the dial failure would have
+            sock.close()
+            self._not_before = now + self._backoff_s
+            self._backoff_s = min(self._backoff_s * 2.0, 5.0)
+            self._reconnecting = True
+            raise
         self._backoff_s = 0.25
         self._reconnecting = False
         with self._lock:
@@ -600,6 +611,10 @@ class KvDataChannel:
                     *self.address)
         return sock
 
+    # the host half of the data channel only ever *initiates* streams:
+    # handoff headers/states and prefix-fetch requests flow host->member
+    # and come back as chunks + results, never inbound here
+    # distlint: wire-ignores[KvHandoffHeader, KvHandoff, KvPrefixFetch]
     def _read_loop(self, sock: socket.socket) -> None:
         try:
             while True:
@@ -791,11 +806,13 @@ class _KvPeerConn:
         self.sock = sock
         self.peer = peer
         # reader-owned: inbound stream reassembly keyed by handoff id
+        # distlint: registry
         self._assemblies: Dict[str, _Assembly] = {}
         self._out: "queue.Queue" = queue.Queue(maxsize=256)
         self._lock = threading.Lock()
         # migrated requests decoding locally whose events ride this
         # connection; aborted if the host vanishes mid-decode
+        # distlint: registry
         self._live: Dict[str, str] = {}  # rid -> engine_id
         self._closed = False
         self._writer = threading.Thread(
@@ -840,6 +857,9 @@ class _KvPeerConn:
 
     # -- inbound (reader thread) --------------------------------------------
 
+    # FleetEvent frames go member->host on this wire (_DataEventSink
+    # enqueues them outbound); the peer conn never receives one
+    # distlint: wire-ignores[FleetEvent]
     def run(self) -> None:
         try:
             while True:
@@ -881,6 +901,10 @@ class _KvPeerConn:
     def _maybe_complete(self, hid: str) -> None:
         """An ``open`` stream acts once its chunk count arrives (commit/
         resume wait for their terminal KvHandoff state frame)."""
+        # single-owner: the reader thread is the only resolver of
+        # _assemblies (close() never touches it), so get-then-pop
+        # cannot race a second resolver
+        # distlint: ignore[DL015]
         asm = self._assemblies.get(hid)
         if asm is None or asm.header.get("op") != "open":
             return
@@ -911,6 +935,11 @@ class _KvPeerConn:
         )
 
         rid = obj.get("request_id", "")
+        # pop-before-submit is safe HERE only because _assemblies is
+        # owned by this reader thread alone: no crash sweep races the
+        # window, and if the submit dies the wire dies with it — the
+        # host settles the stream through connection death
+        # distlint: ignore[DL015]
         asm = self._assemblies.pop(rid, None)
         if asm is None:
             return  # state frame with no header: torn stream, ignore

@@ -703,8 +703,12 @@ class FleetWorker:
         # bounded timeout; never a dispatch or asyncio path
         sock = socket.create_connection(  # distlint: ignore[DL001]
             (host, port), timeout=timeout_s)
-        sock.settimeout(None)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            sock.close()  # a dialed-but-unconfigurable socket leaks its fd
+            raise
         with self._send_lock:
             self._sock = sock
         # fresh reader per connection; the old one exited on its EOF
@@ -856,6 +860,9 @@ class FleetWorker:
 
     # -- serving (reader thread) -------------------------------------------
 
+    # member->host kinds (heartbeats, events, spans, telemetry) are what
+    # this worker SENDS — the host never echoes them back on this wire
+    # distlint: wire-ignores[FleetHeartbeat, FleetEvent, FleetSpans, FleetTelemetry]
     def _read_loop(self, sock: socket.socket) -> None:
         try:
             while True:

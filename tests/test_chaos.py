@@ -448,6 +448,43 @@ def disagg_chaos_server(tiny_params):
     srv.shutdown(drain_timeout_s=5.0)
 
 
+@pytest.fixture(scope="class")
+def mono_chaos_server(tiny_params):
+    """stream=False: the monolithic stop-the-world handoff, whose
+    channel error is the ``disagg.transfer`` fault point (the streamed
+    path fires disagg.chunk/disagg.commit instead)."""
+    srv = InferenceServer(
+        lambda: _engine(tiny_params), ByteTokenizer(), "tiny",
+        num_engines=2, auto_restart=False,
+        engine_roles=["prefill", "decode"],
+        disagg_settings=DisaggSettings(
+            stream=False, handoff_timeout_s=30.0),
+    )
+    srv.start()
+    yield srv
+    faults.clear()
+    srv.shutdown(drain_timeout_s=5.0)
+
+
+class TestMonolithicTransferChaos:
+    def test_transfer_fault_retries_and_still_lands(self, mono_chaos_server):
+        """Monolithic handoff channel death: the first transfer attempt
+        dies on the channel, the migration worker records a retry, and
+        the request still reaches a single clean terminal (retry or
+        decode-in-place fallback — never a client-visible error)."""
+        srv = mono_chaos_server
+        faults.install(parse_spec("disagg.transfer:nth=1", seed=8))
+        got = _run_request(srv, "chaos-transfer", max_tokens=48)
+        faults.clear()
+        assert not got.errors, got.errors
+        assert got.terminals == 1
+        snap = srv.metrics.snapshot().to_dict()
+        handoffs = snap["disagg"]["handoffs"]
+        assert handoffs.get("retry", 0) >= 1, handoffs
+        for r in srv.scheduler.engines():
+            assert r.audit() == []
+
+
 class TestDisaggChaos:
     def test_commit_drop_decodes_in_place(self, disagg_chaos_server):
         """Crash-mid-handoff: the switchover commit dies on the channel;

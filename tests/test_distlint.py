@@ -1665,3 +1665,584 @@ def test_dl014_real_repo_catalog_is_in_sync():
 def test_dl014_registered():
     assert "DL014" in RULES
     assert RULES["DL014"].scope == "project"
+
+
+# ---------------------------------------------------------------------------
+# DL015 — exactly-once in-flight registry lifecycle (v3)
+# ---------------------------------------------------------------------------
+
+# acceptance fixture: PR 2's bug shape verbatim — submit_resume registers
+# an on_done continuation in _pending_resumes, the crash sweep _fail_all
+# drains _inflight but NOT _pending_resumes, so a member death leaves the
+# resume's callback never run and the drain wedges
+_DL015_PR2 = """
+class EngineRunner:
+    def __init__(self):
+        self._inflight = {}
+        self._pending_resumes = {}
+    def submit(self, req):
+        self._inflight[req.request_id] = req
+    def submit_resume(self, exp, req, on_done):
+        self._pending_resumes[req.request_id] = on_done
+    def _drain_resume(self, rid):
+        cb = self._pending_resumes.pop(rid, None)
+        if cb is not None:
+            cb(True, None)
+    def _fail_all(self, err):
+        for rid in list(self._inflight):
+            req = self._inflight.pop(rid, None)
+            if req is not None:
+                req.sink.on_error(err)
+"""
+
+
+def test_dl015_pr2_fixture_resume_leak_past_fail_all_is_p0():
+    out = pcheck("DL015", {f"{PKG}/serving/runner.py": _DL015_PR2})
+    assert len(out) == 1, [f.render() for f in out]
+    f = out[0]
+    assert f.severity == "P0"
+    assert "_pending_resumes" in f.message
+    assert "crash path" in f.message
+    # _inflight IS drained by _fail_all, so only the resume map flags
+    assert "_inflight" not in f.message
+
+
+def test_dl015_pr2_fixed_shape_is_clean():
+    fixed = _DL015_PR2.replace(
+        "            if req is not None:\n"
+        "                req.sink.on_error(err)\n",
+        "            if req is not None:\n"
+        "                req.sink.on_error(err)\n"
+        "        for rid in list(self._pending_resumes):\n"
+        "            cb = self._pending_resumes.pop(rid, None)\n"
+        "            if cb is not None:\n"
+        "                cb(False, err)\n",
+    )
+    assert pcheck("DL015", {f"{PKG}/serving/runner.py": fixed}) == []
+
+
+# acceptance fixture: PR 7's bug shape verbatim — _settle pops the entry
+# FIRST and hands it to submit() after, so while the submit runs the
+# request is in neither the registry nor the engine and a concurrent
+# crash sweep cannot resolve it
+_DL015_PR7 = """
+class Dispatcher:
+    def __init__(self):
+        self._inflight = {}
+    def enqueue(self, req):
+        self._inflight[req.request_id] = req
+    def _settle(self, rid):
+        req = self._inflight.pop(rid, None)
+        if req is None:
+            return
+        self.runner.submit(req)
+    def _fail_all(self, err):
+        for rid in list(self._inflight):
+            self._inflight.pop(rid, None)
+"""
+
+
+def test_dl015_pr7_fixture_settle_pop_before_submit_is_p0():
+    out = pcheck("DL015", {f"{PKG}/serving/dispatcher.py": _DL015_PR7})
+    assert len(out) == 1, [f.render() for f in out]
+    f = out[0]
+    assert f.severity == "P0"
+    assert "popped before the handoff" in f.message
+    assert "_settle" in (f.context or "")
+
+
+def test_dl015_pr7_handoff_first_shape_is_clean():
+    fixed = _DL015_PR7.replace(
+        "        req = self._inflight.pop(rid, None)\n"
+        "        if req is None:\n"
+        "            return\n"
+        "        self.runner.submit(req)\n",
+        "        req = self._inflight.pop(rid, None)\n"
+        "        if req is None:\n"
+        "            return\n",
+    )
+    assert pcheck("DL015", {f"{PKG}/serving/dispatcher.py": fixed}) == []
+
+
+def test_dl015_state_map_with_crash_method_is_not_a_registry():
+    # _members is membership STATE (expiry-pruned, no per-entry
+    # continuation): the in-flight naming gate keeps it out even though
+    # the class has a close() and add+del sites
+    src = """
+class Registry:
+    def __init__(self):
+        self._members = {}
+    def observe(self, mid, rec):
+        self._members[mid] = rec
+    def prune(self, mid):
+        del self._members[mid]
+    def close(self):
+        pass
+"""
+    assert pcheck("DL015", {f"{PKG}/serving/fleet.py": src}) == []
+
+
+def test_dl015_marker_opts_in_and_no_resolve_anywhere_is_p0():
+    src = """
+class Router:
+    def __init__(self):
+        # distlint: registry
+        self._routes = {}
+    def learn(self, key, ep):
+        self._routes[key] = ep
+"""
+    out = pcheck("DL015", {f"{PKG}/serving/fleet.py": src})
+    assert len(out) == 1
+    assert out[0].severity == "P0"
+    assert "no pop/del/clear resolve site" in out[0].message
+
+
+def test_dl015_read_before_pop_without_lock_is_p1():
+    src = """
+class Channel:
+    def __init__(self):
+        self._pending = {}
+    def add(self, rid, cb):
+        self._pending[rid] = cb
+    def resolve(self, rid):
+        cb = self._pending.get(rid)
+        if cb is None:
+            return
+        self._pending.pop(rid, None)
+        cb(True)
+    def _fail_all(self):
+        for rid in list(self._pending):
+            self._pending.pop(rid, None)
+"""
+    out = pcheck("DL015", {f"{PKG}/serving/fleet_kv.py": src})
+    assert len(out) == 1
+    assert out[0].severity == "P1"
+    assert "not pop-first gated" in out[0].message
+
+
+def test_dl015_shared_lock_makes_check_then_act_atomic():
+    src = """
+import threading
+class Channel:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = {}
+    def add(self, rid, cb):
+        with self._lock:
+            self._pending[rid] = cb
+    def resolve(self, rid):
+        with self._lock:
+            cb = self._pending.get(rid)
+            if cb is None:
+                return
+            self._pending.pop(rid, None)
+        cb(True)
+    def _fail_all(self):
+        with self._lock:
+            for rid in list(self._pending):
+                self._pending.pop(rid, None)
+"""
+    assert pcheck("DL015", {f"{PKG}/serving/fleet_kv.py": src}) == []
+
+
+def test_dl015_locked_suffix_functions_are_exempt():
+    src = """
+class Rec:
+    def __init__(self):
+        self._streams = {}
+    def add(self, rid, s):
+        self._streams[rid] = s
+    def _get_or_create_locked(self, rid):
+        s = self._streams.get(rid)
+        if s is None:
+            self._streams.pop(rid, None)
+        return s
+    def _fail_all(self):
+        for rid in list(self._streams):
+            self._streams.pop(rid, None)
+"""
+    assert pcheck("DL015", {f"{PKG}/serving/flightrec.py": src}) == []
+
+
+def test_dl015_registered():
+    assert "DL015" in RULES
+    assert RULES["DL015"].scope == "project"
+    assert RULES["DL015"].severity == "P0"
+
+
+# ---------------------------------------------------------------------------
+# DL016 — exception-edge resource leak (v3)
+# ---------------------------------------------------------------------------
+
+
+def test_dl016_risky_call_between_dial_and_store_flags():
+    src = """
+import socket
+class Channel:
+    def _connect(self):
+        sock = socket.create_connection(("h", 1), timeout=1.0)
+        sock.setsockopt(1, 2, 3)
+        self._sock = sock
+"""
+    out = pcheck("DL016", {f"{PKG}/serving/fleet_kv.py": src})
+    assert len(out) == 1
+    assert "dialed socket" in out[0].message
+    assert "setsockopt" in out[0].message
+
+
+def test_dl016_try_except_close_protects_the_edge():
+    src = """
+import socket
+class Channel:
+    def _connect(self):
+        sock = socket.create_connection(("h", 1), timeout=1.0)
+        try:
+            sock.setsockopt(1, 2, 3)
+        except OSError:
+            sock.close()
+            raise
+        self._sock = sock
+"""
+    assert pcheck("DL016", {f"{PKG}/serving/fleet_kv.py": src}) == []
+
+
+def test_dl016_socket_never_settled_flags():
+    src = """
+import socket
+class Channel:
+    def _probe(self):
+        sock = socket.create_connection(("h", 1), timeout=1.0)
+        sock.send(b"hi")
+"""
+    out = pcheck("DL016", {f"{PKG}/serving/fleet_kv.py": src})
+    assert len(out) == 1
+    assert "never released" in out[0].message
+
+
+def test_dl016_breaker_token_risky_send_flags_and_handler_protects():
+    leaky = """
+class Channel:
+    def _start(self):
+        if not self.breaker.try_acquire():
+            return False
+        self.send_header()
+        self.breaker.record_success()
+        return True
+"""
+    out = pcheck("DL016", {f"{PKG}/serving/fleet_kv.py": leaky})
+    assert len(out) == 1
+    assert "breaker half-open token" in out[0].message
+    guarded = """
+class Channel:
+    def _start(self):
+        if not self.breaker.try_acquire():
+            return False
+        try:
+            self.send_header()
+        except OSError:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return True
+"""
+    assert pcheck("DL016", {f"{PKG}/serving/fleet_kv.py": guarded}) == []
+
+
+def test_dl016_with_statement_consumes_the_acquire():
+    src = """
+import socket
+class Channel:
+    def _probe(self):
+        with socket.create_connection(("h", 1), timeout=1.0) as sock:
+            sock.send(b"hi")
+"""
+    assert pcheck("DL016", {f"{PKG}/serving/fleet_kv.py": src}) == []
+
+
+def test_dl016_only_serving_modules_are_checked():
+    src = """
+import socket
+def probe():
+    sock = socket.create_connection(("h", 1), timeout=1.0)
+    sock.send(b"hi")
+"""
+    assert pcheck("DL016", {f"{PKG}/engine/util.py": src}) == []
+
+
+def test_dl016_registered():
+    assert "DL016" in RULES
+    assert RULES["DL016"].scope == "project"
+
+
+# ---------------------------------------------------------------------------
+# DL017 — wire-handler exhaustiveness (v3)
+# ---------------------------------------------------------------------------
+
+_DL017_WIRE = """
+FRAME_KINDS = {1: "Ping", 2: "Pong", 3: "Data"}
+
+def recv_frame(sock):
+    kind = sock.read_u8()
+    name = FRAME_KINDS.get(kind)
+    return (name, {}) if name else None
+"""
+
+_DL017_READER = """
+from x.wire import recv_frame
+
+def read_loop(sock):
+    while True:
+        frame = recv_frame(sock)
+        if frame is None:
+            break
+        name, obj = frame
+        if name == "Ping":
+            sock.pong()
+        elif name == "Pong":
+            pass
+"""
+
+
+def test_dl017_missing_arm_flags_with_marker_suggestion():
+    out = pcheck("DL017", {
+        f"{PKG}/serving/wire.py": _DL017_WIRE,
+        f"{PKG}/serving/client.py": _DL017_READER,
+    })
+    assert len(out) == 1
+    assert "'Data'" in out[0].message
+    assert "wire-ignores[Data]" in out[0].message
+
+
+def test_dl017_wire_ignores_marker_clears_the_arm():
+    marked = _DL017_READER.replace(
+        "def read_loop(sock):",
+        "# distlint: wire-ignores[Data]\ndef read_loop(sock):")
+    assert pcheck("DL017", {
+        f"{PKG}/serving/wire.py": _DL017_WIRE,
+        f"{PKG}/serving/client.py": marked,
+    }) == []
+
+
+def test_dl017_dead_arm_for_unknown_kind_flags():
+    reader = _DL017_READER.replace(
+        'elif name == "Pong":',
+        'elif name == "Goodbye":\n'
+        "            pass\n"
+        '        elif name == "Data":\n'
+        "            pass\n"
+        '        elif name == "Pong":')
+    out = pcheck("DL017", {
+        f"{PKG}/serving/wire.py": _DL017_WIRE,
+        f"{PKG}/serving/client.py": reader,
+    })
+    assert len(out) == 1
+    assert "'Goodbye'" in out[0].message
+
+
+def test_dl017_else_raise_default_is_intolerant():
+    reader = _DL017_READER.replace(
+        'elif name == "Pong":\n'
+        "            pass",
+        'elif name == "Pong":\n'
+        "            pass\n"
+        '        elif name == "Data":\n'
+        "            pass\n"
+        "        else:\n"
+        "            raise ValueError(name)")
+    out = pcheck("DL017", {
+        f"{PKG}/serving/wire.py": _DL017_WIRE,
+        f"{PKG}/serving/client.py": reader,
+    })
+    assert len(out) == 1
+    assert "tolerate" in out[0].message
+
+
+def test_dl017_non_dispatch_forwarder_is_skipped():
+    # a helper that recv()s and forwards whole frames without
+    # dispatching on the kind is not a reader loop
+    fwd = """
+from x.wire import recv_frame
+
+def pump(sock, out):
+    while True:
+        frame = recv_frame(sock)
+        if frame is None:
+            break
+        out.put(frame)
+"""
+    assert pcheck("DL017", {
+        f"{PKG}/serving/wire.py": _DL017_WIRE,
+        f"{PKG}/serving/relay.py": fwd,
+    }) == []
+
+
+def test_dl017_registered():
+    assert "DL017" in RULES
+    assert RULES["DL017"].scope == "project"
+
+
+# ---------------------------------------------------------------------------
+# DL018 — fault-point coverage drift (v3)
+# ---------------------------------------------------------------------------
+
+_DL018_FAULTS = '''
+"""Fault injection.
+
+``wire.send``      send dies on the wire
+``engine.step``    crash mid-step
+"""
+
+def fire(point):
+    pass
+'''
+
+_DL018_CHAOS = """
+SCENARIOS = {"wire_death": "wire.send:nth=1"}
+"""
+
+_DL018_FAULTS_PATH = f"{PKG}/serving/faults.py"
+
+
+def test_dl018_uncovered_point_flags_and_a_test_covers_it(tmp_path):
+    sources = {
+        _DL018_FAULTS_PATH: _DL018_FAULTS,
+        "tools/chaos_fleet.py": _DL018_CHAOS,
+    }
+    (tmp_path / "tests").mkdir()
+    out = pcheck("DL018", sources, root=tmp_path)
+    assert len(out) == 1
+    assert "'engine.step'" in out[0].message
+    # a committed test arming the point clears the finding
+    (tmp_path / "tests" / "test_cov.py").write_text(
+        'faults.install(parse_spec("engine.step:nth=1", seed=1))\n')
+    assert pcheck("DL018", sources, root=tmp_path) == []
+
+
+def test_dl018_point_kwarg_in_tests_counts_as_exercised(tmp_path):
+    sources = {
+        _DL018_FAULTS_PATH: _DL018_FAULTS,
+        "tools/chaos_fleet.py": _DL018_CHAOS,
+    }
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_cov.py").write_text(
+        'FaultRule(point="engine.step", nth=1)\n')
+    assert pcheck("DL018", sources, root=tmp_path) == []
+
+
+def test_dl018_file_restricted_run_is_silent(tmp_path):
+    # without the faults module or the chaos module in view, coverage
+    # cannot be judged — a --changed run must not false-positive
+    assert pcheck("DL018", {
+        _DL018_FAULTS_PATH: _DL018_FAULTS,
+    }, root=tmp_path) == []
+
+
+def test_dl018_real_repo_catalog_is_fully_exercised():
+    findings = list(RULES["DL018"].check_project(
+        list(run_lint.__globals__["collect_modules"](REPO_ROOT).values()),
+        REPO_ROOT,
+    ))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_dl018_registered():
+    assert "DL018" in RULES
+    assert RULES["DL018"].scope == "project"
+
+
+# ---------------------------------------------------------------------------
+# cache pruning (tools/lint/.cache; v3 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_prune_cache_evicts_corrupt_mismatched_and_old(tmp_path, monkeypatch):
+    import os
+    import pickle
+
+    from tools.lint import callgraph
+
+    monkeypatch.setattr(callgraph, "CACHE_DIR", tmp_path)
+
+    def entry(name_key, stored_key, age):
+        p = tmp_path / f"callgraph-{name_key}.pkl"
+        with p.open("wb") as f:
+            pickle.dump((stored_key, callgraph.ProjectSummary()), f)
+        t = 1_700_000_000 - age
+        os.utime(p, (t, t))
+        return p
+
+    # six valid entries, oldest first by age
+    valid = [entry(f"key{i:02d}x", f"key{i:02d}x-full", age=i * 100)
+             for i in range(6)]
+    # a truncated/corrupt pickle and a key-mismatched one
+    corrupt = tmp_path / "callgraph-deadbeef.pkl"
+    corrupt.write_bytes(b"not a pickle")
+    mismatched = entry("aaaa", "bbbb-full", age=1)
+
+    evicted = callgraph.prune_cache(keep=4)
+    # corrupt + mismatched always go; of the 6 valid, the 2 oldest go
+    assert corrupt.name in evicted and mismatched.name in evicted
+    assert not corrupt.exists() and not mismatched.exists()
+    survivors = sorted(p.name for p in tmp_path.glob("callgraph-*.pkl"))
+    assert survivors == sorted(p.name for p in valid[:4])
+
+
+def test_prune_cache_keep_keys_survive_the_age_cut(tmp_path, monkeypatch):
+    import os
+    import pickle
+
+    from tools.lint import callgraph
+
+    monkeypatch.setattr(callgraph, "CACHE_DIR", tmp_path)
+    for i in range(5):
+        p = tmp_path / f"callgraph-key{i:02d}x.pkl"
+        with p.open("wb") as f:
+            pickle.dump((f"key{i:02d}x-full", callgraph.ProjectSummary()), f)
+        t = 1_700_000_000 - i * 100
+        os.utime(p, (t, t))
+    # the OLDEST entry is the one just written by this run: it must
+    # survive a keep=1 prune (an entry never evicts itself)
+    callgraph.prune_cache(keep=1, keep_keys=("key04x",))
+    names = {p.name for p in tmp_path.glob("callgraph-*.pkl")}
+    assert "callgraph-key04x.pkl" in names
+    assert "callgraph-key00x.pkl" in names  # newest valid survives keep=1
+
+
+def test_build_summary_writes_and_prunes_through_the_real_path(
+        tmp_path, monkeypatch):
+    from tools.lint import callgraph
+
+    monkeypatch.setattr(callgraph, "CACHE_DIR", tmp_path)
+    stale = tmp_path / "callgraph-feedface.pkl"
+    stale.write_bytes(b"junk")
+    mods = [module_from_source(f"{PKG}/serving/m{i}.py", "x = 1\n")
+            for i in range(12)]  # >= 10 modules => disk persistence
+    callgraph._MEMO.clear()
+    callgraph.build_summary(mods, use_disk_cache=True)
+    names = [p.name for p in tmp_path.glob("callgraph-*.pkl")]
+    assert len(names) == 1  # the fresh entry; the junk one was evicted
+    assert not stale.exists()
+
+
+# ---------------------------------------------------------------------------
+# --timings (v3 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_run_lint_collects_per_rule_timings():
+    timings = {}
+    run_lint(REPO_ROOT, files=[f"{PKG}/serving/faults.py"],
+             rules=["DL001", "DL004"], timings=timings)
+    assert set(timings) == {"<collect>", "DL001", "DL004"}
+    assert all(v >= 0.0 for v in timings.values())
+
+
+def test_cli_timings_flag_prints_a_table(capsys):
+    from tools.lint.run import main
+
+    rc = main(["--rule", "DL010", "--timings",
+               f"{PKG}/serving/faults.py"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "distlint timings" in out
+    assert "DL010" in out and "total" in out

@@ -1621,3 +1621,102 @@ class TestKvFleetConfig:
             "DIS_TPU_SERVER__ENGINE_ROLES": "decode",
             "DIS_TPU_FLEET__CONNECT": "127.0.0.1:9999"})
         assert worker.engine_roles() == ["decode"]
+
+
+# ---------------------------------------------------------------------------
+# KvIntro broker fault (docs/RESILIENCE.md fleet.kv_intro)
+# ---------------------------------------------------------------------------
+
+
+class TestKvIntroBrokerFault:
+    def test_injected_intro_drop_counts_dropped_and_recovers(self):
+        """An armed ``fleet.kv_intro`` kills exactly one KvIntro on the
+        control wire: the broker books it ``dropped`` (best-effort by
+        design — the mesh route degrades to recompute, never to an
+        error) and the next send goes through and books ``sent``."""
+        from distributed_inference_server_tpu.serving.fleet import FleetServer
+
+        sent = []
+
+        class _Session:
+            member_id = "m-intro"
+
+            def send(self, name, obj):
+                sent.append((name, obj))
+
+        class _Broker:
+            metrics = MetricsCollector()
+            _send_intro = FleetServer._send_intro
+
+        broker = _Broker()
+        faults.install(faults.parse_spec("fleet.kv_intro:nth=1", seed=9))
+        try:
+            broker._send_intro(_Session(), {"member_id": "m2"})
+            broker._send_intro(_Session(), {"member_id": "m2"})
+        finally:
+            faults.clear()
+        assert len(sent) == 1
+        counters = broker.metrics.fleet_counters()["kv_intros"]
+        assert counters == {"dropped": 1, "sent": 1}
+
+
+# ---------------------------------------------------------------------------
+# Dial-path configure failure (distlint DL016 regression: a socket that
+# dialed but cannot be configured must be closed, not leaked)
+# ---------------------------------------------------------------------------
+
+
+class _ConfigFailSock:
+    """create_connection succeeded; configuring the socket then fails
+    (EBADF/ENOTSOCK race with a concurrent close, resource limits)."""
+
+    def __init__(self):
+        self.closed = False
+
+    def settimeout(self, t):
+        raise OSError("bad fd")
+
+    def setsockopt(self, *a):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+class TestDialConfigureFailure:
+    def test_kv_channel_closes_sock_and_backs_off(self, monkeypatch):
+        from distributed_inference_server_tpu.serving.fleet_kv import (
+            KvDataChannel,
+        )
+
+        ch = KvDataChannel("m-cfg", "127.0.0.1", 1)
+        fake = _ConfigFailSock()
+        monkeypatch.setattr(socket, "create_connection",
+                            lambda *a, **k: fake)
+        before = ch._backoff_s
+        with pytest.raises(OSError):
+            ch._ensure_connected()
+        assert fake.closed  # the dialed fd must not leak
+        # the configure failure takes the same backoff a dial failure
+        # would: the next attempt is deferred, not immediate
+        assert ch._reconnecting
+        assert ch._backoff_s == min(before * 2.0, 5.0)
+        assert ch._not_before > time.monotonic() - 1.0
+        assert ch._sock is None
+
+    def test_fleet_worker_closes_sock_on_configure_failure(
+            self, monkeypatch):
+        from distributed_inference_server_tpu.serving.remote_runner import (
+            FleetWorker,
+        )
+
+        class _Stub:
+            class settings:
+                connect = "127.0.0.1:9"
+
+        fake = _ConfigFailSock()
+        monkeypatch.setattr(socket, "create_connection",
+                            lambda *a, **k: fake)
+        with pytest.raises(OSError):
+            FleetWorker._connect(_Stub(), 1.0)
+        assert fake.closed  # the dialed fd must not leak

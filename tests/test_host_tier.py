@@ -614,3 +614,40 @@ class TestMemoryAwareCachedPages:
         ]
         assert choose_engine(SchedulingStrategy.MEMORY_AWARE, statuses,
                              0) == "a"  # id breaks the tie
+
+
+# ---------------------------------------------------------------------------
+# Injected host-copy failure (docs/RESILIENCE.md kv.host_copy)
+# ---------------------------------------------------------------------------
+
+
+class TestHostCopyFault:
+    def test_injected_host_copy_drops_burst_at_hook_boundary(self):
+        """An armed ``kv.host_copy`` makes the demotion offer raise
+        before it mutates anything: the allocator's offload-hook
+        boundary absorbs it (eviction itself never fails), the dropped
+        burst leaves the tier untouched, and the next burst demotes
+        normally once the fault is spent."""
+        from distributed_inference_server_tpu.serving import faults
+
+        t = HostTier(budget_bytes=1 << 20)
+        a = PageAllocator(PagedCacheConfig(
+            num_pages=1, page_size=1, max_pages_per_seq=1))
+        a.offload_hook = lambda victims: t.offer(
+            [(v.hash, v.depth, v.root) for v in victims], _KIND_RAW,
+            _page(1.0), page_size=1)
+        p = a.allocate(1)
+        a.publish([5], p)
+        a.release(p)  # published page parks in LRU, demotable
+        faults.install(faults.parse_spec("kv.host_copy:nth=1", seed=2))
+        try:
+            p2 = a.allocate(1)  # evicts the page -> hook -> injected fault
+        finally:
+            faults.clear()
+        assert p2 == p  # eviction degraded to a plain drop, never failed
+        assert t.empty and t.offloads == 0
+        a.publish([7], p2)
+        a.release(p2)
+        p3 = a.allocate(1)  # fault spent: this burst demotes for real
+        assert p3 == p
+        assert t.offloads == 1

@@ -53,8 +53,12 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from tools.lint.core import Module, dotted_name
 
-CACHE_VERSION = 4
+CACHE_VERSION = 5
 CACHE_DIR = Path(__file__).parent / ".cache"
+#: disk-cache bound: enough entries to keep a few recently-used branches
+#: warm (each branch's file set hashes to its own key) without the cache
+#: dir growing forever
+CACHE_KEEP = 4
 
 _LOCK_FACTORY_RE = re.compile(r"(^|\.)(Lock|RLock|Condition|Semaphore)$")
 _LOCKISH_NAME_RE = re.compile(r"lock|mutex|cond|(^|_)cv$", re.IGNORECASE)
@@ -83,6 +87,9 @@ _FALLBACK_STOPLIST = frozenset({
 _THREAD_ROOT_MARK_RE = re.compile(
     r"#\s*distlint:\s*thread-root(?:\[([A-Za-z0-9_.-]+)\])?")
 _THREAD_CONFINED_MARK_RE = re.compile(r"#\s*distlint:\s*thread-confined")
+#: declares a dict attribute an in-flight registry for DL015 even when
+#: the add/pop convention is not (yet) visible in code
+_REGISTRY_MARK_RE = re.compile(r"#\s*distlint:\s*registry\b")
 
 #: container generics whose single argument is the element type
 _LISTY = frozenset({"List", "list", "Sequence", "Deque", "deque", "Set",
@@ -152,6 +159,24 @@ class AttrCall:
 
 
 @dataclass(frozen=True)
+class RegistryOp:
+    """One lifecycle-relevant operation on a dict attribute with a typed
+    owner: how DL015 sees ``self._inflight[rid] = req`` (op="add"),
+    ``runner._inflight.pop(rid, None)`` (op="pop"), membership tests and
+    value reads. ``op`` is one of add/pop/del/clear/get/read/contains."""
+
+    cls: str  # class id owning the dict attribute
+    attr: str
+    fn: str  # function id containing the operation
+    op: str
+    path: str
+    lineno: int
+    #: lock ids held at the op site — two ops sharing a held lock are
+    #: atomic with respect to each other (kills check-then-act races)
+    locks: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
 class LockOrderEdge:
     held: str
     acquired: str
@@ -193,6 +218,13 @@ class ProjectSummary:
     module_funcs: Dict[str, Dict[str, Sig]] = field(default_factory=dict)
     lock_kinds: Dict[str, str] = field(default_factory=dict)
     thread_marks: Dict[str, str] = field(default_factory=dict)  # fn -> label
+    # -- lifecycle layer (DL015) -------------------------------------------
+    registry_ops: List[RegistryOp] = field(default_factory=list)
+    #: (class id, attr) declared dict attributes (``self.x = {}`` /
+    #: ``Dict[...]`` annotation) — the candidate registry population
+    class_dict_attrs: Dict[str, Set[str]] = field(default_factory=dict)
+    #: (class id, attr) pairs carrying a ``# distlint: registry`` marker
+    registry_marks: Set[Tuple[str, str]] = field(default_factory=set)
 
 
 def short(ident: str) -> str:
@@ -446,6 +478,28 @@ def _signature(fn, is_method: bool) -> Sig:
                      for p, d in zip(a.kwonlyargs, a.kw_defaults)),
         kwarg=a.kwarg is not None,
     )
+
+
+def _is_dict_value(node: Optional[ast.AST]) -> bool:
+    """Does this initializer expression build a dict?"""
+    if isinstance(node, ast.Dict):
+        return True
+    if isinstance(node, ast.Call):
+        tail = dotted_name(node.func).rsplit(".", 1)[-1]
+        return tail in _DICTY or tail == "defaultdict"
+    return False
+
+
+def _annotation_is_dict(node: Optional[ast.AST]) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return False
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    return (dotted_name(node).rsplit(".", 1)[-1] in _DICTY
+            if node is not None else False)
 
 
 def _line_has_mark(module: Module, lineno: int, regex) -> Optional[re.Match]:
@@ -726,6 +780,70 @@ class _FuncWalker:
                     and isinstance(f.value, ast.Attribute):
                 emit(f.value.value, f.value.attr, f.attr, stmt)
 
+    # -- registry lifecycle (DL015) ----------------------------------------
+
+    #: dict method -> canonical lifecycle op (``setdefault`` registers;
+    #: ``popitem`` resolves like ``pop``)
+    _REG_METHOD_OPS = {
+        "pop": "pop", "popitem": "pop", "clear": "clear",
+        "setdefault": "add", "get": "get",
+    }
+
+    def _reg_owner(self, node: ast.AST) -> Optional[Tuple[str, str]]:
+        """``<expr>.attr`` with a class-typed ``<expr>`` -> (cls, attr).
+        The owner is the *holder* of the dict (``self`` / an annotated
+        receiver), not the dict's value type."""
+        if not isinstance(node, ast.Attribute):
+            return None
+        t = self.type_of(node.value)
+        if t and t[0] == "cls":
+            return (t[1], node.attr)
+        return None
+
+    def _emit_reg(self, owner: Tuple[str, str], op: str,
+                  node: ast.AST) -> None:
+        self.s.registry_ops.append(RegistryOp(
+            cls=owner[0], attr=owner[1], fn=self.fn_id, op=op,
+            path=self.path, lineno=node.lineno, locks=tuple(self.held)))
+
+    def _record_registry(self, node: ast.AST) -> None:
+        """Record lifecycle ops on typed dict attributes. Which of these
+        attributes actually *are* registries is decided later (DL015):
+        ops on non-dict or non-registry attributes are inert facts."""
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                    else [tgt]
+                for t in elts:
+                    if isinstance(t, ast.Subscript):
+                        owner = self._reg_owner(t.value)
+                        if owner:
+                            self._emit_reg(owner, "add", node)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    owner = self._reg_owner(t.value)
+                    if owner:
+                        self._emit_reg(owner, "del", node)
+        elif isinstance(node, ast.Call) and isinstance(node.func,
+                                                       ast.Attribute):
+            op = self._REG_METHOD_OPS.get(node.func.attr)
+            if op is not None:
+                owner = self._reg_owner(node.func.value)
+                if owner:
+                    self._emit_reg(owner, op, node)
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx,
+                                                            ast.Load):
+            owner = self._reg_owner(node.value)
+            if owner:
+                self._emit_reg(owner, "read", node)
+        elif isinstance(node, ast.Compare) and any(
+                isinstance(o, (ast.In, ast.NotIn)) for o in node.ops):
+            for comp in node.comparators:
+                owner = self._reg_owner(comp)
+                if owner:
+                    self._emit_reg(owner, "contains", node)
+
     # -- body walk ---------------------------------------------------------
 
     def _lock_id(self, expr: ast.AST) -> Optional[str]:
@@ -772,6 +890,7 @@ class _FuncWalker:
         if isinstance(node, ast.Call):
             self._record_call(node)
         self._record_writes(node)
+        self._record_registry(node)
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name):
             t = self.type_of(node.value)
@@ -835,13 +954,52 @@ def build_summary(modules: Sequence[Module],
     if use_disk_cache:
         try:
             CACHE_DIR.mkdir(exist_ok=True)
-            for old in CACHE_DIR.glob("callgraph-*.pkl"):
-                old.unlink()
             with cache_file.open("wb") as f:
                 pickle.dump((key, summary), f)
+            prune_cache(keep_keys=(key[:16],))
         except OSError:
             pass  # read-only checkout: the in-process memo still holds
     return summary
+
+
+def prune_cache(keep: int = CACHE_KEEP,
+                keep_keys: Tuple[str, ...] = ()) -> List[str]:
+    """Bound ``tools/lint/.cache``: evict pickles whose embedded content
+    key no longer matches their filename (interrupted writes, foreign
+    CACHE_VERSION layouts that fail to load) and all but the ``keep``
+    most recently touched valid entries. Entries whose 16-char key prefix
+    is in ``keep_keys`` survive the age cut (the entry just written must
+    never evict itself). Returns evicted file names, oldest last."""
+    evicted: List[str] = []
+    valid: List[Path] = []
+    for p in sorted(CACHE_DIR.glob("callgraph-*.pkl")):
+        name_key = p.name[len("callgraph-"):-len(".pkl")]
+        try:
+            with p.open("rb") as f:
+                stored_key, summary = pickle.load(f)
+            ok = (isinstance(stored_key, str)
+                  and stored_key.startswith(name_key)
+                  and isinstance(summary, ProjectSummary))
+        except Exception:  # distlint: ignore[DL004] -- any unpickling
+            ok = False  # failure marks the entry stale
+        if ok:
+            valid.append(p)
+            continue
+        evicted.append(p.name)
+        try:
+            p.unlink()
+        except OSError:
+            pass
+    valid.sort(key=lambda p: p.stat().st_mtime, reverse=True)
+    for p in valid[max(keep, len(keep_keys)):]:
+        if p.name[len("callgraph-"):-len(".pkl")] in keep_keys:
+            continue
+        evicted.append(p.name)
+        try:
+            p.unlink()
+        except OSError:
+            pass
+    return evicted
 
 
 def _build(modules: Sequence[Module]) -> ProjectSummary:
@@ -875,10 +1033,20 @@ def _build(modules: Sequence[Module]) -> ProjectSummary:
             methods: Dict[str, Sig] = {}
             locks: Dict[str, str] = {}
             safe: Set[str] = set()
+            dict_attrs: Set[str] = set()
+
+            def note_dict_decl(attr: str, lineno: int) -> None:
+                dict_attrs.add(attr)
+                if _line_has_mark(module, lineno, _REGISTRY_MARK_RE):
+                    s.registry_marks.add((cid, attr))
+
             for item in cnode.body:
                 if isinstance(item, ast.AnnAssign) and isinstance(
                         item.target, ast.Name):
                     members.add(item.target.id)
+                    if _annotation_is_dict(item.annotation) \
+                            or _is_dict_value(item.value):
+                        note_dict_decl(item.target.id, item.lineno)
                 elif isinstance(item, ast.Assign):
                     for t in item.targets:
                         if isinstance(t, ast.Name):
@@ -898,8 +1066,21 @@ def _build(modules: Sequence[Module]) -> ProjectSummary:
                     if mark:
                         s.thread_marks[fid] = mark.group(1) or item.name
                     for stmt in ast.walk(item):
-                        if not (isinstance(stmt, ast.Assign)
-                                and isinstance(stmt.value, ast.Call)):
+                        if isinstance(stmt, ast.AnnAssign):
+                            attr = _self_attr(stmt.target)
+                            if attr is not None and (
+                                    _annotation_is_dict(stmt.annotation)
+                                    or _is_dict_value(stmt.value)):
+                                note_dict_decl(attr, stmt.lineno)
+                            continue
+                        if not isinstance(stmt, ast.Assign):
+                            continue
+                        if _is_dict_value(stmt.value):
+                            for tgt in stmt.targets:
+                                attr = _self_attr(tgt)
+                                if attr is not None:
+                                    note_dict_decl(attr, stmt.lineno)
+                        if not isinstance(stmt.value, ast.Call):
                             continue
                         factory = dotted_name(stmt.value.func)
                         for tgt in stmt.targets:
@@ -924,6 +1105,7 @@ def _build(modules: Sequence[Module]) -> ProjectSummary:
             s.class_members[cid] = members
             s.class_locks[cid] = locks
             s.class_threadsafe_attrs[cid] = safe
+            s.class_dict_attrs[cid] = dict_attrs
 
     for cid, kinds in s.class_locks.items():
         for attr, kind in kinds.items():

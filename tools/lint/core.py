@@ -24,6 +24,7 @@ from __future__ import annotations
 import ast
 import json
 import re
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -301,15 +302,22 @@ def run_lint(
     root: Path,
     files: Optional[Sequence[str]] = None,
     rules: Optional[Sequence[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> Tuple[List[Finding], List[Finding]]:
     """Run ``rules`` (default: all) over ``files`` (default: the package).
     Returns (active_findings, comment_suppressed_findings); baseline
-    filtering is the caller's concern (run.py / tests)."""
+    filtering is the caller's concern (run.py / tests). A ``timings``
+    dict collects per-rule wall seconds (plus the parse under
+    ``"<collect>"``); the first rule to touch the shared callgraph pays
+    its build, later ones hit the memo."""
     # rule registration lives in rules.py; import late so core stays
     # importable from rules.py without a cycle
     from tools.lint import rules as _rules  # noqa: F401
 
+    t0 = time.perf_counter()
     modules = collect_modules(root, files)
+    if timings is not None:
+        timings["<collect>"] = time.perf_counter() - t0
     selected = [RULES[n] for n in (rules or sorted(RULES))]
     findings: List[Finding] = []
     for mod in modules.values():
@@ -321,10 +329,14 @@ def run_lint(
             ))
     all_modules = list(modules.values())
     for rule in selected:
+        t0 = time.perf_counter()
         if rule.scope == "project":
             findings.extend(rule.check_project(all_modules, root))
         else:
             for mod in all_modules:
                 findings.extend(rule.check(mod))
+        if timings is not None:
+            timings[rule.name] = (
+                timings.get(rule.name, 0.0) + time.perf_counter() - t0)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return apply_suppressions(modules, findings)

@@ -1,4 +1,4 @@
-"""distlint rules DL001-DL014 (catalog + rationale: docs/LINTS.md).
+"""distlint rules DL001-DL018 (catalog + rationale: docs/LINTS.md).
 
 Each rule targets a failure class this codebase has actually hit or is
 structurally exposed to: blocking calls on the serving spine, unlocked
@@ -7,7 +7,9 @@ and host-side work leaking into the per-token decode loop (DL001-DL007,
 single-module or table-driven), plus the interprocedural layer
 (tools/lint/callgraph.py + threads.py): cross-thread write analysis,
 lock-order cycles, internal-API call conformance, fault-point drift, and
-config-key drift (DL008-DL012).
+config-key drift (DL008-DL012), plus the v3 lifecycle layer: exactly-once
+registry resolution, exception-edge resource pairing, wire-handler
+exhaustiveness, and fault-point test coverage (DL015-DL018).
 """
 
 from __future__ import annotations
@@ -776,11 +778,12 @@ def _summary_and_module(modules: Sequence[Module]):
 
 
 def _anchored(rule: Rule, by_path: Dict[str, Module], path: str,
-              lineno: int, message: str, context: str) -> Finding:
+              lineno: int, message: str, context: str,
+              severity: Optional[str] = None) -> Finding:
     mod = by_path.get(path)
     line_text = mod.text(lineno) if mod is not None else ""
     return Finding(rule=rule.name, path=path, line=lineno, message=message,
-                   severity=rule.severity, context=context,
+                   severity=severity or rule.severity, context=context,
                    line_text=line_text)
 
 
@@ -1623,4 +1626,768 @@ class DL014(Rule):
                         "serving/metrics.py — the documented series "
                         "can never exist",
                     ))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DL015-DL018 — the v3 lifecycle layer: exactly-once registries,
+# exception-edge resources, wire-handler exhaustiveness, fault-point
+# coverage (docs/LINTS.md "distlint v3")
+# ---------------------------------------------------------------------------
+
+#: crash-path entry points by naming convention: the failure sweeps that
+#: must be able to resolve every in-flight registry (``_fail_all``,
+#: ``on_lost_requests``, ``_drop_connection``, ``close``, ...). The verb
+#: must LEAD the name — ``record_expired`` and ``stop_health_loop`` are
+#: bookkeeping, not sweeps — so the match is anchored
+_CRASH_NAME_RE = re.compile(
+    r"^_*(on_)?(fail|crash|lost|abort|drop|close|shutdown)")
+#: what makes a dict attribute *in-flight* (entries carry continuations
+#: that must run exactly once) rather than a state/telemetry map whose
+#: entries expire or get overwritten: the codebase's own naming
+#: convention — ``_inflight``, ``_pending_*``, mesh ``_live``,
+#: ``_assemblies``, ``_export_jobs``, KV ``_streams`` — or an explicit
+#: ``# distlint: registry`` marker on the declaration
+_INFLIGHT_NAME_RE = re.compile(
+    r"inflight|pending|live|waiter|assembl|resum|import|export|job|stream")
+#: handoff methods whose call AFTER a pop re-opens the PR 7 window: the
+#: popped entry is in neither the registry nor the engine while the
+#: submit runs, so a concurrent crash sweep cannot resolve it
+_HANDOFF_METHODS = frozenset({"submit", "submit_resume", "redispatch"})
+
+
+@register
+class DL015(Rule):
+    """Exactly-once lifecycle for in-flight registries. A *registry* is
+    a dict attribute following the codebase's pop-first convention —
+    registered by a subscript/``setdefault`` add site, resolved by a
+    ``pop``/``del``/``clear`` site, and recognizably *in-flight* by
+    naming (``_inflight``/``_pending_*``/``_live``/``_assemblies``/...)
+    — or any dict attribute whose declaration carries a
+    ``# distlint: registry`` marker. State and telemetry maps are out:
+    their entries expire or get overwritten, so there is no per-entry
+    continuation to lose. Three checks, all scoped to ``serving/``
+    (where the in-flight registries live):
+
+    1. a registry with registrations but **no resolve site anywhere**
+       leaks every entry (P0);
+    2. **crash-path coverage**: when the owning class has crash-named
+       methods (``_fail_all``/``close``/``_drop_connection``/...), some
+       resolve site of the registry must be reachable from one of them
+       through the call graph — otherwise entries leak past the failure
+       sweep and their callbacks never run, the PR 2 ``submit_resume``
+       bug (P0). Closures are invisible to the call graph, so the crash
+       path must resolve at method level (which is also what makes it
+       auditable);
+    3. **pop-first gating** per function: popping an entry *before* the
+       handoff (``submit``) re-opens the PR 7 ``_settle`` window (P0),
+       and reading/membership-testing an entry before popping it is a
+       check-then-act race where two callers can both see the entry and
+       double-resolve (P1 — suppress with the single-thread argument
+       where ownership makes it safe).
+
+    The analysis under-approximates (closures skipped, unresolved
+    receivers dropped): absence of a finding is not a proof."""
+
+    name = "DL015"
+    title = "in-flight registry entry can leak or double-resolve"
+    severity = "P0"
+    scope = "project"
+
+    RESOLVE_OPS = frozenset({"pop", "del", "clear"})
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        from tools.lint import callgraph, threads
+
+        summary, by_path = _summary_and_module(modules)
+        ops_by_reg: Dict[Tuple[str, str], List] = {}
+        for op in summary.registry_ops:
+            ops_by_reg.setdefault((op.cls, op.attr), []).append(op)
+
+        def is_registry(cls: str, attr: str) -> bool:
+            if (cls, attr) in summary.registry_marks:
+                return True
+            if attr not in summary.class_dict_attrs.get(cls, set()):
+                return False
+            if not _INFLIGHT_NAME_RE.search(attr):
+                # state/telemetry maps (member tables, health scores,
+                # backoff clocks) expire or get overwritten — they have
+                # no per-entry continuation to lose, so exactly-once
+                # does not apply; mark the declaration to opt one in
+                return False
+            kinds = {o.op for o in ops_by_reg.get((cls, attr), ())}
+            return "add" in kinds and bool(kinds & self.RESOLVE_OPS)
+
+        handoffs: Dict[Tuple[str, str], List[int]] = {}
+        for ac in summary.attr_calls:
+            if ac.method in _HANDOFF_METHODS:
+                handoffs.setdefault((ac.path, ac.context),
+                                    []).append(ac.lineno)
+
+        findings: List[Finding] = []
+        regs = sorted(k for k in (set(ops_by_reg) | summary.registry_marks)
+                      if is_registry(*k))
+        for cls, attr in regs:
+            if not cls.split("::", 1)[0].startswith(SERVING_PREFIX):
+                continue  # in-flight registries live on the serving spine
+            ops = ops_by_reg.get((cls, attr), [])
+            reg_name = f"{callgraph.short(cls)}.{attr}"
+            adds = [o for o in ops if o.op == "add"]
+            resolves = [o for o in ops if o.op in self.RESOLVE_OPS]
+            # (1) registered but never resolved, anywhere
+            if adds and not resolves:
+                a = min(adds, key=lambda o: (o.path, o.lineno))
+                findings.append(_anchored(
+                    self, by_path, a.path, a.lineno,
+                    f"registry {reg_name} is registered here but has no "
+                    "pop/del/clear resolve site anywhere — every entry "
+                    "leaks",
+                    context=callgraph.short(a.fn)))
+                continue
+            # (2) crash-path coverage over the call graph
+            crash_fns = sorted(
+                fid for fid, node in summary.functions.items()
+                if node.cls == cls and _CRASH_NAME_RE.search(node.name))
+            if adds and resolves and crash_fns:
+                reach = threads.reachable(summary, crash_fns)
+                if not any(o.fn in reach for o in resolves):
+                    a = min(adds, key=lambda o: (o.path, o.lineno))
+                    names = ", ".join(sorted({
+                        summary.functions[f].name for f in crash_fns
+                    })[:4])
+                    findings.append(_anchored(
+                        self, by_path, a.path, a.lineno,
+                        f"registry {reg_name} has no resolve site on the "
+                        f"crash path: none of {names} (nor anything they "
+                        "call) pops/clears it, so entries registered "
+                        "here survive the failure sweep and their "
+                        "callbacks never run — drain it in the sweep, "
+                        "or mark the declaration with the ownership "
+                        "argument",
+                        context=callgraph.short(a.fn)))
+            # (3) per-function ordering: pop-before-handoff (P0) and
+            # check-then-act read-before-pop (P1)
+            by_fn: Dict[str, List] = {}
+            for o in ops:
+                by_fn.setdefault(o.fn, []).append(o)
+            for fn, fn_ops in sorted(by_fn.items()):
+                node = summary.functions.get(fn)
+                if node is None or _CRASH_NAME_RE.search(node.name):
+                    continue  # crash sweeps drain by design
+                if node.name.endswith("_locked"):
+                    # the repo's *_locked convention: the caller holds
+                    # the class lock, so every op in here is atomic
+                    # with respect to racing resolvers
+                    continue
+                pops = [o for o in fn_ops if o.op in ("pop", "del")]
+                if not pops:
+                    continue
+                first_pop = min(pops, key=lambda o: o.lineno)
+                for line in sorted(handoffs.get(
+                        (node.path, callgraph.short(fn)), ())):
+                    if line > first_pop.lineno:
+                        findings.append(_anchored(
+                            self, by_path, first_pop.path,
+                            first_pop.lineno,
+                            f"{reg_name} entry is popped before the "
+                            f"handoff at line {line}: while the submit "
+                            "runs, the entry is in neither the registry "
+                            "nor the engine, so a concurrent crash "
+                            "sweep cannot resolve it (the PR 7 "
+                            "`_settle` window) — hand off first and pop "
+                            "after, or re-register before the handoff",
+                            context=callgraph.short(fn)))
+                        break
+                reads = [o for o in fn_ops
+                         if o.op in ("get", "read", "contains")
+                         and o.lineno < first_pop.lineno
+                         # a lock held across both read and pop makes
+                         # check-then-act atomic: no second resolver
+                         # can interleave between them
+                         and not (set(o.locks) & set(first_pop.locks))]
+                if reads:
+                    r = min(reads, key=lambda o: o.lineno)
+                    findings.append(_anchored(
+                        self, by_path, r.path, r.lineno,
+                        f"resolution of {reg_name} is not pop-first "
+                        f"gated: the read here precedes the pop at line "
+                        f"{first_pop.lineno}, so two racing resolvers "
+                        "can both observe the entry and double-resolve "
+                        "it — pop first (one winner) and act on the "
+                        "popped value, or suppress with the "
+                        "single-owner argument",
+                        context=callgraph.short(fn), severity="P1"))
+        return findings
+
+
+# -- DL016 ------------------------------------------------------------------
+
+#: calls that cannot plausibly raise between an acquire and its release
+#: (pure builtins, logging, collection accessors)
+_DL016_SAFE_NAMES = frozenset({
+    "len", "str", "int", "float", "bool", "min", "max", "isinstance",
+    "getattr", "hasattr", "repr", "format", "sorted", "list", "dict",
+    "set", "tuple", "id",
+})
+_DL016_SAFE_ATTRS = frozenset({
+    "append", "get", "debug", "info", "warning", "error", "exception",
+    "monotonic", "time", "items", "keys", "values", "copy", "strip",
+    "split", "join", "lower", "upper", "format",
+})
+_DL016_RELEASE = {
+    "socket": frozenset({"close", "shutdown", "detach"}),
+    "span": frozenset({"finish", "end", "close"}),
+    "import_session": frozenset({"abort", "commit", "publish", "close"}),
+}
+_DL016_BREAKER_SETTLE = frozenset({
+    "release", "record_success", "record_failure"})
+_DL016_DESC = {
+    "socket": "dialed socket",
+    "span": "tracer span",
+    "breaker": "breaker half-open token",
+    "import_session": "KV import session",
+}
+
+
+def _dl016_acquire_kind(call: ast.Call) -> Optional[str]:
+    dotted = dotted_name(call.func)
+    if dotted.endswith("create_connection") and "socket" in dotted:
+        return "socket"
+    if isinstance(call.func, ast.Attribute):
+        recv_tail = dotted_name(call.func.value).rsplit(".", 1)[-1].lower()
+        if call.func.attr == "start" and recv_tail == "tracer":
+            return "span"
+        if call.func.attr == "try_acquire" and "breaker" in recv_tail:
+            return "breaker"
+        if call.func.attr == "import_stream_open":
+            return "import_session"
+    return None
+
+
+def _dl016_call_is_safe(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _DL016_SAFE_NAMES
+    if isinstance(f, ast.Attribute):
+        return f.attr in _DL016_SAFE_ATTRS
+    return False
+
+
+class _LifetimeScan:
+    """One function body (nested defs skipped): resource acquires, the
+    uses that settle them (release call / store / return / pass-along),
+    every call site for the risky-region test, and per-node try/except/
+    finally containment so protection is judged structurally."""
+
+    def __init__(self) -> None:
+        # {kind, var, lineno, end_lineno, trys, skip}
+        self.acquires: List[Dict] = []
+        # (var name, use kind, lineno, trys); kind is "stored" /
+        # "returned" / "passed" / "method:<name>"
+        self.uses: List[Tuple[str, str, int, Tuple]] = []
+        # (receiver dotted, method, lineno, trys) — breaker settlement
+        self.recv_calls: List[Tuple[str, str, int, Tuple]] = []
+        # (lineno, is_safe, call node, trys)
+        self.calls: List[Tuple[int, bool, ast.Call, Tuple]] = []
+        self._consumed: Set[int] = set()
+
+    def scan(self, fn_node) -> None:
+        for stmt in fn_node.body:
+            self._visit(stmt, ())
+
+    # -- helpers -----------------------------------------------------------
+
+    def _names_in(self, node: ast.AST) -> Set[str]:
+        return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+    def _record_acquire(self, kind: str, var: str, call: ast.Call,
+                        trys: Tuple, skip=None) -> None:
+        self.acquires.append({
+            "kind": kind, "var": var, "lineno": call.lineno,
+            "end_lineno": getattr(call, "end_lineno", call.lineno)
+            or call.lineno,
+            "trys": trys, "skip": skip,
+        })
+        self._consumed.add(id(call))
+
+    # -- walk --------------------------------------------------------------
+
+    def _visit(self, node: ast.AST, trys: Tuple) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested defs are analyzed as their own functions
+        if isinstance(node, ast.Try):
+            tid = id(node)
+            for s in node.body:
+                self._visit(s, trys + ((tid, "body"),))
+            for h in node.handlers:
+                for s in h.body:
+                    self._visit(s, trys + ((tid, "handler"),))
+            for s in node.orelse:
+                self._visit(s, trys + ((tid, "body"),))
+            for s in node.finalbody:
+                self._visit(s, trys + ((tid, "final"),))
+            return
+        self._classify(node, trys)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, trys)
+
+    def _classify(self, node: ast.AST, trys: Tuple) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            # a context manager owns its resource's lifecycle
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call) \
+                            and _dl016_acquire_kind(sub):
+                        self._consumed.add(id(sub))
+            return
+        if isinstance(node, ast.If):
+            # the ``if not breaker.try_acquire(): <fail fast>`` guard:
+            # the guarded body runs with NO token held — exclude it from
+            # the risky region
+            test = node.test
+            neg = isinstance(test, ast.UnaryOp) \
+                and isinstance(test.op, ast.Not)
+            inner = test.operand if neg else test
+            if isinstance(inner, ast.Call) \
+                    and _dl016_acquire_kind(inner) == "breaker":
+                skip = None
+                if neg and node.body:
+                    last = node.body[-1]
+                    skip = (node.body[0].lineno,
+                            getattr(last, "end_lineno", last.lineno)
+                            or last.lineno)
+                self._record_acquire(
+                    "breaker", dotted_name(inner.func.value), inner,
+                    trys, skip=skip)
+            return
+        if isinstance(node, ast.Assign):
+            value = node.value
+            if isinstance(value, ast.Await):
+                value = value.value
+            if isinstance(value, ast.Call):
+                kind = _dl016_acquire_kind(value)
+                if kind == "breaker":
+                    self._record_acquire(
+                        "breaker", dotted_name(value.func.value), value,
+                        trys)
+                elif kind is not None:
+                    tgt = node.targets[0] if len(node.targets) == 1 \
+                        else None
+                    if isinstance(tgt, ast.Name):
+                        self._record_acquire(kind, tgt.id, value, trys)
+                    else:
+                        # stored into an attribute/subscript at birth:
+                        # ownership transferred to the container
+                        self._consumed.add(id(value))
+            # ``self.x = var`` / ``self.d[k] = (var, ...)`` — transfer
+            if not all(isinstance(t, ast.Name) for t in node.targets):
+                for name in self._names_in(node.value):
+                    self.uses.append((name, "stored", node.lineno, trys))
+            return
+        if isinstance(node, ast.Return):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and _dl016_acquire_kind(sub):
+                    self._consumed.add(id(sub))  # returned at birth
+            if node.value is not None:
+                for name in self._names_in(node.value):
+                    self.uses.append((name, "returned", node.lineno, trys))
+            return
+        if isinstance(node, ast.Call):
+            kind = _dl016_acquire_kind(node)
+            if kind is not None and id(node) not in self._consumed:
+                if kind == "breaker":
+                    self._record_acquire(
+                        "breaker", dotted_name(node.func.value), node,
+                        trys)
+                # non-breaker acquires in expression position with no
+                # binding (dropped result / passed as arg) are either
+                # transferred or unobservable — skip both
+                self._consumed.add(id(node))
+            self.calls.append((node.lineno, _dl016_call_is_safe(node),
+                               node, trys))
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for name in self._names_in(arg):
+                    self.uses.append((name, "passed", node.lineno, trys))
+            if isinstance(node.func, ast.Attribute):
+                self.recv_calls.append((
+                    dotted_name(node.func.value), node.func.attr,
+                    node.lineno, trys))
+                if isinstance(node.func.value, ast.Name):
+                    self.uses.append((
+                        node.func.value.id, f"method:{node.func.attr}",
+                        node.lineno, trys))
+
+
+@register
+class DL016(Rule):
+    """Exception-edge resource leak: an acquired resource — dialed
+    socket, tracer span, KV import session, breaker half-open token —
+    must be released, stored, returned, or handed to a callee on every
+    path out of the acquiring function, *including the raise edges* of
+    the calls between acquire and settlement. A call that can raise in
+    that window needs the settlement in a ``finally``/``except`` of a
+    ``try`` enclosing it; ``with`` acquires are exempt (the context
+    manager is the settlement). Pass-along and store count as settling
+    because ownership moved (the container's own lifecycle is DL015's
+    problem). Per-function and syntactic — cross-thread settlement
+    (e.g. a token resolved by a later callback) needs a suppression
+    carrying the settlement argument."""
+
+    name = "DL016"
+    title = "acquired resource leaks on the exception edge"
+    severity = "P1"
+    scope = "project"
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for mod in modules:
+            if not mod.path.startswith(SERVING_PREFIX):
+                continue
+            for qual, fn_node in self._functions(mod.tree):
+                findings.extend(self._check_fn(mod, qual, fn_node))
+        return findings
+
+    @staticmethod
+    def _functions(tree: ast.Module):
+        """Every def in the module — methods AND closures — with its
+        qualname (closures settle resources for DL016 purposes exactly
+        like named functions do)."""
+        out: List[Tuple[str, ast.AST]] = []
+
+        def walk(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = f"{prefix}.{child.name}" if prefix \
+                        else child.name
+                    out.append((qual, child))
+                    walk(child, qual)
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, f"{prefix}.{child.name}" if prefix
+                         else child.name)
+                else:
+                    walk(child, prefix)
+
+        walk(tree, "")
+        return out
+
+    def _check_fn(self, mod: Module, qual: str,
+                  fn_node) -> Iterable[Finding]:
+        scan = _LifetimeScan()
+        scan.scan(fn_node)
+        findings: List[Finding] = []
+        for acq in scan.acquires:
+            kind, var = acq["kind"], acq["var"]
+            if kind == "breaker":
+                settles = [
+                    (lineno, trys)
+                    for recv, meth, lineno, trys in scan.recv_calls
+                    if recv == var and meth in _DL016_BREAKER_SETTLE
+                    and lineno > acq["end_lineno"]
+                ]
+            else:
+                release = _DL016_RELEASE[kind]
+                settles = [
+                    (lineno, trys)
+                    for name, use, lineno, trys in scan.uses
+                    if name == var and lineno > acq["end_lineno"]
+                    and (use in ("stored", "returned", "passed")
+                         or (use.startswith("method:")
+                             and use[len("method:"):] in release))
+                ]
+            desc = _DL016_DESC[kind]
+            anchor = ast.Constant(value=0)
+            anchor.lineno = acq["lineno"]
+            if not settles:
+                findings.append(self.finding(
+                    mod, anchor,
+                    f"{desc} acquired here is never released, stored, "
+                    "returned, or passed on in this function — it leaks "
+                    "on every path (or is settled cross-thread: "
+                    "suppress with the settlement argument)",
+                    context=qual))
+                continue
+            first = min(lineno for lineno, _t in settles)
+            risky = [
+                (c, trys) for lineno, safe, c, trys in scan.calls
+                if not safe and acq["end_lineno"] < lineno < first
+                and not (acq["skip"]
+                         and acq["skip"][0] <= lineno <= acq["skip"][1])
+            ]
+            # a risky call is protected when some try enclosing it
+            # settles the resource in its handler or finally
+            protected_tids = {
+                tid for _lineno, trys in settles
+                for tid, region in trys if region in ("handler", "final")
+            }
+            exposed = [
+                c for c, trys in risky
+                if not any(tid in protected_tids
+                           for tid, region in trys if region == "body")
+            ]
+            if exposed:
+                worst = min(exposed, key=lambda c: c.lineno)
+                findings.append(self.finding(
+                    mod, anchor,
+                    f"{desc} leaks on the exception edge: "
+                    f"`{dotted_name(worst.func) or 'the call'}` at line "
+                    f"{worst.lineno} can raise before the settlement at "
+                    f"line {first} — release in a finally/except around "
+                    "it, or move the handoff adjacent to the acquire",
+                    context=qual))
+        return findings
+
+
+# -- DL017 ------------------------------------------------------------------
+
+#: module-level frame-kind tables: ``FRAME_KINDS`` / ``KV_FRAME_KINDS``
+_FRAME_TABLE_RE = re.compile(r"FRAME_KINDS$")
+#: reader-loop marker: frame kinds this reader deliberately ignores
+#: (one-way kinds that legally never arrive on this side of the wire)
+_WIRE_IGNORES_MARK_RE = re.compile(
+    r"#\s*distlint:\s*wire-ignores\[([A-Za-z0-9_,\s]+)\]")
+_FRAME_KIND_NAME_RE = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+
+
+@register
+class DL017(Rule):
+    """Wire-handler exhaustiveness: every frame kind in a protowire
+    table (``*FRAME_KINDS``) must have a dispatch arm in every reader
+    loop fed by that table's ``recv_*`` function, or be declared
+    deliberately ignored with ``# distlint: wire-ignores[KindA, KindB]``
+    on the reader — the "added kind 6, missed a reader" drift DL005's
+    schema check cannot see. Also flags the inverse (a dispatch arm or
+    ignore entry naming a kind the table doesn't define: dead arm or
+    typo) and an ``else: raise`` default on the dispatch chain (readers
+    must tolerate unknown kinds so old peers survive new frames; the
+    recv layer already rejects undecodable input)."""
+
+    name = "DL017"
+    title = "wire reader loop missing a frame-kind dispatch arm"
+    severity = "P1"
+    scope = "project"
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        from tools.lint.callgraph import _line_has_mark
+
+        # frame-kind tables and the recv functions that decode them
+        tables: Dict[str, Tuple[Module, Set[str]]] = {}
+        for mod in modules:
+            for node in mod.tree.body:
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name) \
+                            and _FRAME_TABLE_RE.search(t.id) \
+                            and isinstance(node.value, ast.Dict):
+                        kinds = {
+                            v.value for v in node.value.values
+                            if isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)
+                        }
+                        if kinds:
+                            tables[f"{mod.path}::{t.id}"] = (mod, kinds)
+        recv_fns: Dict[str, str] = {}  # recv function name -> table key
+        for mod in modules:
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    refs = {n.id for n in ast.walk(node)
+                            if isinstance(n, ast.Name)}
+                    for tkey in tables:
+                        tpath, tname = tkey.split("::", 1)
+                        if tpath == mod.path and tname in refs:
+                            recv_fns[node.name] = tkey
+        if not recv_fns:
+            return []
+
+        findings: List[Finding] = []
+        for mod in modules:
+            for qual, fn_node in DL016._functions(mod.tree):
+                f = self._check_reader(mod, qual, fn_node, recv_fns,
+                                       tables, _line_has_mark)
+                findings.extend(f)
+        return findings
+
+    def _check_reader(self, mod: Module, qual: str, fn_node,
+                      recv_fns: Dict[str, str],
+                      tables: Dict[str, Tuple[Module, Set[str]]],
+                      line_has_mark) -> List[Finding]:
+        # which recv function does this reader drive, and which variable
+        # binds the decoded frame name?
+        tkey = None
+        frame_vars: Set[str] = set()
+        name_var = None
+        for node in ast.walk(fn_node):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            f = node.value.func
+            fname = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if fname not in recv_fns:
+                continue
+            tkey = recv_fns[fname]
+            tgt = node.targets[0] if node.targets else None
+            if isinstance(tgt, ast.Name):
+                frame_vars.add(tgt.id)
+            elif isinstance(tgt, ast.Tuple) and tgt.elts \
+                    and isinstance(tgt.elts[0], ast.Name):
+                name_var = tgt.elts[0].id
+        if tkey is None:
+            return []
+        if name_var is None and frame_vars:
+            # ``frame = recv_x(...)`` then ``name, obj = frame``
+            for node in ast.walk(fn_node):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id in frame_vars \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Tuple) \
+                        and node.targets[0].elts \
+                        and isinstance(node.targets[0].elts[0], ast.Name):
+                    name_var = node.targets[0].elts[0].id
+                    break
+        if name_var is None:
+            return []  # not a dispatch loop (forwarding helper)
+
+        table_mod, kinds = tables[tkey]
+        tname = tkey.split("::", 1)[1]
+        handled: Set[str] = set()
+        intolerant: List[ast.AST] = []
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Compare) \
+                    and isinstance(node.left, ast.Name) \
+                    and node.left.id == name_var \
+                    and len(node.ops) == 1:
+                comp = node.comparators[0]
+                if isinstance(node.ops[0], (ast.Eq, ast.NotEq)) \
+                        and isinstance(comp, ast.Constant) \
+                        and isinstance(comp.value, str):
+                    handled.add(comp.value)
+                elif isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                        and isinstance(comp, (ast.Tuple, ast.List,
+                                              ast.Set)):
+                    handled |= {
+                        e.value for e in comp.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    }
+            if isinstance(node, ast.If) \
+                    and isinstance(node.test, ast.Compare) \
+                    and isinstance(node.test.left, ast.Name) \
+                    and node.test.left.id == name_var \
+                    and node.orelse \
+                    and not (len(node.orelse) == 1
+                             and isinstance(node.orelse[0], ast.If)) \
+                    and any(isinstance(s, ast.Raise)
+                            for s in node.orelse):
+                intolerant.append(node.orelse[0])
+
+        m = line_has_mark(mod, fn_node.lineno, _WIRE_IGNORES_MARK_RE)
+        ignores = ({x.strip() for x in m.group(1).split(",") if x.strip()}
+                   if m else set())
+
+        findings: List[Finding] = []
+        for kind in sorted(kinds - handled - ignores):
+            findings.append(self.finding(
+                mod, fn_node,
+                f"reader dispatches {tname} frames but has no arm for "
+                f"kind {kind!r} — handle it, or declare the one-way "
+                f"kind deliberate with "
+                f"`# distlint: wire-ignores[{kind}]` on the reader",
+                context=qual))
+        for kind in sorted((handled | ignores) - kinds):
+            if _FRAME_KIND_NAME_RE.match(kind):
+                findings.append(self.finding(
+                    mod, fn_node,
+                    f"reader {'handles' if kind in handled else 'ignores'}"
+                    f" frame kind {kind!r} which {tname} does not define "
+                    "— dead dispatch arm or a typo",
+                    context=qual))
+        for node in intolerant:
+            findings.append(self.finding(
+                mod, node,
+                f"dispatch on {tname} raises for unknown frame kinds — "
+                "readers must tolerate kinds newer than they are (log "
+                "and skip); the recv layer already rejects undecodable "
+                "frames",
+                context=qual))
+        return findings
+
+
+# -- DL018 ------------------------------------------------------------------
+
+
+@register
+class DL018(Rule):
+    """Fault-point coverage drift: every point in the DL011 catalog (the
+    serving/faults.py docstring) must be *exercised* — armed via a fault
+    spec string in a chaos scenario (tools/chaos_fleet.py) or a
+    committed test under tests/ — so a new injection point cannot ship
+    with its failure path untested. DL011 keeps the catalog honest
+    against the fire sites; this rule keeps the test surface honest
+    against the catalog (docs/RESILIENCE.md cross-references both)."""
+
+    name = "DL018"
+    title = "cataloged fault point exercised by no scenario or test"
+    severity = "P1"
+    scope = "project"
+
+    FAULTS_PATH = DL011.FAULTS_PATH
+    CHAOS_PATH = "tools/chaos_fleet.py"
+    TESTS_DIR = "tests"
+
+    _POINT_KWARG_RE = re.compile(rf'point\s*=\s*["\']({_POINT_PAT})["\']')
+
+    def _exercised(self, text: str) -> Set[str]:
+        pts = {m.group(1) for m in _SPEC_POINT_RE.finditer(text)}
+        pts |= {m.group(1) for m in self._POINT_KWARG_RE.finditer(text)}
+        return pts
+
+    def check_project(self, modules: Sequence[Module],
+                      root: Path) -> Iterable[Finding]:
+        faults_mod = next(
+            (m for m in modules if m.path == self.FAULTS_PATH), None)
+        chaos_mod = next(
+            (m for m in modules if m.path == self.CHAOS_PATH), None)
+        if faults_mod is None or chaos_mod is None:
+            return []  # file-restricted run: coverage needs the corpus
+        catalog = set(_DOCSTRING_POINT_RE.findall(
+            ast.get_docstring(faults_mod.tree) or ""))
+        if not catalog:
+            return []
+        exercised = self._exercised("\n".join(chaos_mod.lines))
+        tests_dir = root / self.TESTS_DIR
+        if tests_dir.is_dir():
+            for p in sorted(tests_dir.rglob("*.py")):
+                try:
+                    exercised |= self._exercised(p.read_text())
+                except OSError:
+                    continue
+
+        def anchor_line(point: str) -> int:
+            for i, line in enumerate(faults_mod.lines, 1):
+                if point in line:
+                    return i
+            return 1
+
+        findings: List[Finding] = []
+        for point in sorted(catalog - exercised):
+            line = anchor_line(point)
+            findings.append(Finding(
+                rule=self.name, path=faults_mod.path, line=line,
+                message=f"cataloged fault point {point!r} is armed by no "
+                        "chaos scenario (tools/chaos_fleet.py) and no "
+                        "committed test — exercise it so the failure "
+                        "path it guards stays covered",
+                severity=self.severity, context="fault coverage",
+                line_text=faults_mod.text(line),
+            ))
         return findings

@@ -86,6 +86,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--format", choices=("text", "github"), default="text",
                     help="'github' additionally emits ::error workflow "
                          "annotations so findings surface inline on PRs")
+    ap.add_argument("--timings", action="store_true",
+                    help="print per-rule wall time after the run (the "
+                         "first project rule pays the shared callgraph "
+                         "build; docs/LINTS.md budgets the full run)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -107,6 +111,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               "(drop --changed / --rule / file arguments)")
         return 2
 
+    timings = {} if args.timings else None
     if files is not None:
         # file-restricted mode: module-scope rules see only the named
         # files, but project-scope rules (proto drift, metric hygiene)
@@ -116,14 +121,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         mod_rules = [n for n in names if RULES[n].scope == "module"]
         proj_rules = [n for n in names if RULES[n].scope == "project"]
         active, suppressed = run_lint(REPO_ROOT, files=files,
-                                      rules=mod_rules or None) \
+                                      rules=mod_rules or None,
+                                      timings=timings) \
             if mod_rules else ([], [])
         if proj_rules:
-            pa, ps = run_lint(REPO_ROOT, files=None, rules=proj_rules)
+            pa, ps = run_lint(REPO_ROOT, files=None, rules=proj_rules,
+                              timings=timings)
             active, suppressed = active + pa, suppressed + ps
     else:
         active, suppressed = run_lint(REPO_ROOT, files=None,
-                                      rules=args.rules)
+                                      rules=args.rules, timings=timings)
 
     if args.update_baseline:
         keep = [f for f in active if f.severity != "P0"]
@@ -172,6 +179,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                       f"{len(stale)} baseline entr(y/ies) no longer match "
                       "any finding — the baseline may only shrink "
                       "(docs/LINTS.md)")
+    if timings is not None:
+        total = sum(timings.values())
+        print("\ndistlint timings (wall seconds; the first project rule "
+              "pays the shared callgraph build):")
+        for name, secs in sorted(timings.items(),
+                                 key=lambda kv: -kv[1]):
+            print(f"  {name:<10} {secs:7.3f}s")
+        print(f"  {'total':<10} {total:7.3f}s")
     rc = 1 if new else 0
     if args.check_stale and stale:
         rc = 1

@@ -21,7 +21,7 @@ set it does compute corresponds to real concurrent entry paths.
 from __future__ import annotations
 
 from collections import deque
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from tools.lint.callgraph import ProjectSummary, short
 
@@ -89,6 +89,22 @@ def ownership(summary: ProjectSummary) -> Dict[str, Set[str]]:
         if not roots:
             roots.add(MAIN_ROOT)
     return owners
+
+
+def reachable(summary: ProjectSummary, roots: Iterable[str]) -> Set[str]:
+    """Transitive closure over the call graph from ``roots``, inclusive.
+    The lifecycle rules (DL015) use this to ask "does any crash-path
+    entry point reach a resolve site of this registry?" — the same BFS
+    :func:`ownership` runs per spawn root."""
+    seen: Set[str] = set()
+    queue = deque(roots)
+    while queue:
+        fn = queue.popleft()
+        if fn in seen:
+            continue
+        seen.add(fn)
+        queue.extend(summary.calls.get(fn, ()))
+    return seen
 
 
 def describe_roots(roots: Set[str], limit: int = 4) -> str:
