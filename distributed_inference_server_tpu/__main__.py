@@ -282,9 +282,12 @@ def main(argv=None) -> int:
         return 1
 
     fleet_worker = None
-    if cfg.get("fleet", "connect"):
-        # worker mode (docs/FLEET.md): join the registry host — local
-        # engines keep serving their own HTTP surface too
+    if cfg.get("fleet", "connect") or cfg.get("fleet", "registries"):
+        # worker mode (docs/FLEET.md): join the registry host(s) — local
+        # engines keep serving their own HTTP surface too. With
+        # fleet.registries set the worker heartbeats every registry
+        # (registry HA dual-heartbeat), so a standby promotes with a
+        # warm member table.
         from distributed_inference_server_tpu.serving.remote_runner import (
             FleetWorker,
         )
@@ -302,7 +305,7 @@ def main(argv=None) -> int:
             print(f"fleet join failed: {e}", file=sys.stderr)
             server.shutdown()
             return 1
-        print(f"joined fleet at {cfg.get('fleet', 'connect')} as "
+        print(f"joined fleet at {', '.join(fleet_worker.endpoints)} as "
               f"{fleet_worker.member_id}")
 
     watcher = ConfigWatcher(cfg)

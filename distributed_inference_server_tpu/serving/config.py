@@ -279,6 +279,23 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # kv_rate_prior=0 disables learned pricing (constant only).
         "kv_rate_window_s": (float, 30.0),
         "kv_rate_prior": (float, 125000000.0),
+        # registry HA (serving/fleet_ha.py; docs/FLEET.md "Registry
+        # HA"): the ORDERED endpoint list every fleet process agrees
+        # on. On a registry host it must contain this host's own
+        # host:port (list position breaks election ties); on a worker
+        # it is the full set of registries to heartbeat (dual-
+        # heartbeat keeps every standby's member table warm). Empty =
+        # HA off (single-registry fleet, no behavior change).
+        "registries": (tuple, []),
+        # lease aging mirrors member aging: a standby treats the
+        # primary as suspect after lease_suspect_s without a
+        # RegistryLease frame and promotes (epoch+1) after lease_s
+        "lease_s": (float, 3.0),
+        "lease_suspect_s": (float, 1.5),
+        # standby_http=true (default) keeps every registry's HTTP
+        # ingress open — multi-ingress serving through any registry;
+        # false gates /generate admission to the current primary
+        "standby_http": (bool, True),
     },
     "health": {
         # gray-failure defense (serving/health.py HealthScorer;
@@ -451,6 +468,17 @@ def _coerce(section: str, key: str, value: Any) -> Any:
         if isinstance(value, str):
             return [int(v) for v in value.split(",") if v.strip()]
         raise ConfigError(f"{section}.{key}: expected list, got {value!r}")
+    if typ is tuple:
+        # string list (e.g. fleet.registries): a YAML/TOML list or a
+        # comma-separated string ("hostA:7070,hostB:7070") — the latter
+        # is how env/CLI overrides spell it
+        if isinstance(value, (list, tuple)):
+            return [str(v) for v in value]
+        if isinstance(value, str):
+            return [v.strip() for v in value.split(",") if v.strip()]
+        raise ConfigError(
+            f"{section}.{key}: expected list of strings, got {value!r}"
+        )
     try:
         return typ(value)
     except (TypeError, ValueError):
@@ -631,6 +659,10 @@ class ServerConfig:
             mesh_enabled=f["mesh_enabled"],
             kv_rate_window_s=f["kv_rate_window_s"],
             kv_rate_prior=f["kv_rate_prior"],
+            registries=tuple(f["registries"]),
+            lease_s=f["lease_s"],
+            lease_suspect_s=f["lease_suspect_s"],
+            standby_http=f["standby_http"],
         )
 
     def slo_settings(self):
@@ -952,6 +984,30 @@ class ServerConfig:
                 "fleet.kv_rate_prior must be >= 0 (0 disables learned "
                 "pricing)"
             )
+        # registry HA (serving/fleet_ha.py)
+        if f["registries"]:
+            from distributed_inference_server_tpu.serving.fleet import (
+                parse_connect,
+            )
+
+            for ep in f["registries"]:
+                try:
+                    parse_connect(ep)
+                except Exception:
+                    raise ConfigError(
+                        f"fleet.registries: {ep!r} is not a host:port "
+                        "endpoint"
+                    ) from None
+            if f["lease_suspect_s"] <= f["heartbeat_interval_s"]:
+                raise ConfigError(
+                    "fleet.lease_suspect_s must exceed "
+                    "fleet.heartbeat_interval_s (one missed lease beat "
+                    "is jitter, not a dead primary)"
+                )
+            if f["lease_s"] <= f["lease_suspect_s"]:
+                raise ConfigError(
+                    "fleet.lease_s must exceed fleet.lease_suspect_s"
+                )
 
     def hot_diff(self, other: "ServerConfig") -> Dict[tuple, Any]:
         """(section, key) -> new value for hot-reloadable keys that differ."""

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from distributed_inference_server_tpu.core.errors import QueueFull
 from distributed_inference_server_tpu.core.queue import (
@@ -153,6 +153,12 @@ class Dispatcher:
         # degradation-ladder gates (serving/degradation.py; design.md:938-941)
         self.reject_low_priority = False
         self.reject_all = False
+        # registry HA ingress gate (serving/fleet_ha.py): with
+        # fleet.standby_http=false, a standby registry's front door
+        # stays closed (QueueFull -> 503) until it holds the lease.
+        # Checked at submit() ONLY — redispatch and fleet-internal
+        # paths dispatch straight to runners and are never gated.
+        self.ingress_gate: Optional[Callable[[], bool]] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -211,6 +217,8 @@ class Dispatcher:
         overload") — failing fast instead of queueing work the windowed
         queue-wait estimate says is already doomed to queue_timeout."""
         if not self._accepting or self.reject_all:
+            raise QueueFull()
+        if self.ingress_gate is not None and not self.ingress_gate():
             raise QueueFull()
         if self.reject_low_priority and priority is Priority.LOW:
             raise QueueFull()

@@ -100,6 +100,19 @@ Point catalog (the authoritative list lives in docs/RESILIENCE.md):
                         ``fleet.kv_connect``) — the hinted mesh fetch
                         degrades to recompute exactly once, zero page
                         leak, and the wire's breaker walks toward open
+``fleet.lease_beat``    a primary registry's RegistryLease frame is
+                        dropped before the send (serving/fleet_ha.py
+                        ``_tick``; one hit per peer per tick — the
+                        registry-partition model). Standbys age the
+                        lease alive -> suspect -> expired while the
+                        primary's process lives on, then promote at a
+                        higher epoch and fence it
+``fleet.takeover``      a standby crashes at the start of promotion
+                        (serving/fleet_ha.py ``_promote``), BEFORE the
+                        epoch bump or role flip published anything —
+                        takeover must be atomic-or-absent: either the
+                        fleet sees the full new-epoch primary or the
+                        election simply re-runs on the next tick
 ======================  ====================================================
 """
 

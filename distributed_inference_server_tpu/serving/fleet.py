@@ -115,6 +115,21 @@ class FleetSettings:
     # pricing (every wire charges the constant).
     kv_rate_window_s: float = 30.0
     kv_rate_prior: float = 125000000.0
+    # Registry HA (serving/fleet_ha.py; docs/FLEET.md "Registry HA"):
+    # ordered registry endpoint list shared by every process. Workers
+    # dial ALL of them (dual-heartbeat); registries heartbeat each
+    # other and elect a lease-fenced primary (list order breaks ties).
+    # () = single-registry fleet, HA machinery entirely dormant.
+    registries: Tuple[str, ...] = ()
+    # lease aging on the PRIMARY itself: a standby marks the lease
+    # suspect after lease_suspect_s without a beat and takes over after
+    # lease_s (the same alive->suspect->dead machinery used on members)
+    lease_s: float = 3.0
+    lease_suspect_s: float = 1.5
+    # multi-ingress: standbys serve HTTP against their own federated
+    # view. False = a standby's dispatcher rejects ingress (QueueFull)
+    # until it holds the lease — single-front-door deployments.
+    standby_http: bool = True
 
 
 # ---------------------------------------------------------------------------
@@ -134,6 +149,10 @@ FRAME_KINDS: Dict[int, str] = {
     # KV mesh introduction (serving/fleet_mesh.py): registry host ->
     # worker, brokering member-to-member data-plane endpoints
     6: "KvIntro",
+    # registry HA (serving/fleet_ha.py): primary -> standby lease beat
+    # and standby -> primary state echo, registry <-> registry
+    7: "RegistryLease",
+    8: "RegistryState",
 }
 _KIND_BY_NAME = {name: kind for kind, name in FRAME_KINDS.items()}
 
@@ -563,6 +582,13 @@ class _MemberSession:
                     # per member, merged on demand at GET /server/perf
                     self.server.ingest_telemetry(
                         obj, self.member_id or obj.get("member_id", ""))
+                elif name in ("RegistryLease", "RegistryState"):
+                    # registry HA (serving/fleet_ha.py): a peer
+                    # registry's lease beat / state echo arriving on
+                    # our member listener — routed to the HA module;
+                    # the session stays member-less (close is a no-op
+                    # detach), so peer wires never fabricate members
+                    self.server.on_registry_frame(name, obj)
                 # FleetSubmit frames only flow host -> worker; one
                 # arriving here is a confused peer — ignore it
         except (OSError, FleetWireError) as e:
@@ -723,6 +749,10 @@ class FleetServer:
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
         self.bound_port: int = 0
+        # registry HA (serving/fleet_ha.py): set by the server when
+        # fleet.registries is configured. None = single-registry fleet
+        # — every HA hook below degrades to the pre-HA behavior.
+        self.ha = None
         registry.on_state_change = self._on_member_state
 
     # -- lifecycle ---------------------------------------------------------
@@ -798,6 +828,43 @@ class FleetServer:
             if (session.member_id is not None
                     and self._by_member.get(session.member_id) is session):
                 self._by_member.pop(session.member_id, None)
+
+    # -- registry HA hooks (serving/fleet_ha.py) ---------------------------
+
+    def control_epoch(self) -> int:
+        """The epoch stamped on every control frame this registry sends
+        (submits, aborts, KvIntros). 0 = no HA configured — members
+        treat 0 as unfenced (legacy single-registry behavior)."""
+        ha = self.ha
+        return ha.epoch if ha is not None else 0
+
+    def on_registry_frame(self, name: str, obj: Dict[str, Any]) -> None:
+        """A peer registry's RegistryLease / RegistryState frame,
+        arriving on a member session's reader thread."""
+        ha = self.ha
+        if ha is not None:
+            ha.on_peer_frame(name, obj)
+
+    def on_ha_promote(self) -> None:
+        """Takeover re-arm: this registry just won the lease. The member
+        table, proxies, and learned rates are already warm (the dual
+        heartbeat kept them live) — what needs re-arming is the intro
+        broker: re-publish every known endpoint at the NEW epoch so
+        members fence out any stale intros from the old primary."""
+        if not self.settings.mesh_enabled:
+            return
+        with self._lock:
+            endpoints = dict(self._intro_endpoints)
+            sessions = dict(self._by_member)
+        grant = self.settings.kv_max_streams
+        for member_id, session in sessions.items():
+            for other_id, ep in endpoints.items():
+                if other_id == member_id:
+                    continue
+                self._send_intro(session, {
+                    "member_id": other_id, "host": ep[0],
+                    "data_port": ep[1], "max_streams": grant,
+                })
 
     # -- span ingest (session reader threads) ------------------------------
 
@@ -1076,6 +1143,20 @@ class FleetServer:
         fresh joiner and a reconnect after the registry bounced."""
         if not self.settings.mesh_enabled:
             return
+        if self.ha is not None and not self.ha.is_primary():
+            # standby: track endpoints (warm state) but never broker —
+            # only the lease holder publishes intros; on takeover
+            # on_ha_promote() re-publishes everything at the new epoch
+            endpoint_ = None
+            host_ = session.peer.rsplit(":", 1)[0]
+            if data_port > 0:
+                endpoint_ = (host_, int(data_port))
+            with self._lock:
+                if endpoint_ is None:
+                    self._intro_endpoints.pop(member_id, None)
+                else:
+                    self._intro_endpoints[member_id] = endpoint_
+            return
         host = session.peer.rsplit(":", 1)[0]
         endpoint = (host, int(data_port)) if data_port > 0 else None
         with self._lock:
@@ -1114,6 +1195,11 @@ class FleetServer:
         """One KvIntro send, outcome-counted: the broker is best-effort
         by design (a dropped intro only costs the mesh route — the
         fetch degrades to recompute, never to an error)."""
+        ha = getattr(self, "ha", None)
+        if ha is not None and ha.epoch:
+            # registry HA fence: members ignore intros older than the
+            # highest epoch they have seen (serving/fleet_ha.py)
+            obj = dict(obj, epoch=ha.epoch)
         try:
             # injected broker drop (docs/RESILIENCE.md fleet.kv_intro)
             faults.fire("fleet.kv_intro")
@@ -1197,6 +1283,9 @@ class FleetServer:
                     )
                     runner.redispatch = self.redispatch
                     runner.kv_channel = session.kv_channel
+                    # registry HA: stamp submits/aborts with this
+                    # registry's control epoch (0 = unfenced)
+                    runner.epoch_fn = self.control_epoch
                     session.runners[local_id] = runner
                     self.scheduler.register(runner)
                     logger.info("fleet: registered remote engine %s "
@@ -1284,6 +1373,10 @@ class RoleBalancer:
         self._history: Deque[Dict[str, Any]] = deque(maxlen=64)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # registry HA (serving/fleet_ha.py): only the lease-holding
+        # primary balances roles — the server wires this to
+        # RegistryHA.is_primary. None = always active (no HA).
+        self.active_fn: Optional[Callable[[], bool]] = None
 
     # -- the decision ------------------------------------------------------
 
@@ -1304,6 +1397,10 @@ class RoleBalancer:
         previous flip — that cooldown IS the temporal hysteresis the
         ``rerole_flap`` chaos scenario pins."""
         if not self.settings.rerole:
+            return None
+        if self.active_fn is not None and not self.active_fn():
+            # registry HA: a standby's balancer stays armed but quiet —
+            # two balancers flipping the same fleet would fight
             return None
         now = time.monotonic() if now is None else now
         statuses = self.scheduler.statuses()

@@ -589,6 +589,33 @@ class MetricsCollector:
             "member session wire error)",
             ["outcome"], registry=r,
         )
+        # registry HA (serving/fleet_ha.py; docs/FLEET.md "Registry
+        # HA"): lease-fenced warm-standby control plane. Role is a 0/1
+        # gauge per role label (both series always published so an
+        # alert on absent(fleet_registry_role{role="primary"}) works);
+        # epoch is the fencing token members compare control frames
+        # against.
+        self.registry_role = Gauge(
+            "fleet_registry_role",
+            "This registry's HA role as a 0/1 gauge per role label "
+            "(primary | standby); exactly one series is 1 at a time",
+            ["role"], registry=r,
+        )
+        self.registry_takeovers = Counter(
+            "fleet_registry_takeovers_total",
+            "Registry HA role transitions by reason (lease_expired = "
+            "standby promoted after the primary lease aged out | "
+            "fenced = a primary demoted on seeing a higher-epoch or "
+            "lower-index peer lease)",
+            ["reason"], registry=r,
+        )
+        self.registry_epoch = Gauge(
+            "fleet_registry_epoch",
+            "This registry's current control epoch (the fencing token "
+            "stamped on FleetSubmit/KvIntro frames; members reject "
+            "control from lower epochs)",
+            registry=r,
+        )
 
         # windowed performance digests (serving/teledigest.py): the
         # sliding-epoch store behind GET /server/perf, the snapshot's
@@ -632,6 +659,7 @@ class MetricsCollector:
         self._fleet_heartbeats: Dict[str, int] = {}
         self._fleet_reroles: Dict[str, int] = {}
         self._kv_intros: Dict[str, int] = {}
+        self._registry_takeovers: Dict[str, int] = {}
         self._tenants_seen: set = set()
         self._trace_drops: Dict[str, int] = {}
         self._phase_sums: Dict[str, float] = {}
@@ -1108,6 +1136,31 @@ class MetricsCollector:
         self.kv_intros.labels(outcome=outcome).inc()
         with self._lock:
             self._kv_intros[outcome] = self._kv_intros.get(outcome, 0) + 1
+
+    def set_registry_role(self, role: str) -> None:
+        """Publish this registry's HA role (serving/fleet_ha.py). Both
+        role series are written every time (winner 1, loser 0) so a
+        flip never leaves two series reading 1 and an absent() alert
+        on the primary series stays meaningful."""
+        self.registry_role.labels(role="primary").set(
+            1 if role == "primary" else 0
+        )
+        self.registry_role.labels(role="standby").set(
+            1 if role == "standby" else 0
+        )
+
+    def record_registry_takeover(self, reason: str) -> None:
+        """One HA role transition (serving/fleet_ha.py): lease_expired
+        = standby promoted | fenced = old primary demoted."""
+        self.registry_takeovers.labels(reason=reason).inc()
+        with self._lock:
+            self._registry_takeovers[reason] = (
+                self._registry_takeovers.get(reason, 0) + 1
+            )
+
+    def set_registry_epoch(self, epoch: int) -> None:
+        """Publish this registry's control epoch (the fencing token)."""
+        self.registry_epoch.set(epoch)
 
     def set_kv_wire_rate(self, src: str, dst: str, rate: float) -> None:
         """Refresh one wire's learned-rate gauge (serving/fleet_mesh.py

@@ -259,6 +259,9 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         14: ("fetch_hashes", "uint64", "rep"),
         15: ("fetch_chunk_pages", "uint32", "one"),
         16: ("fetch_wire_quant", "string", "one"),
+        # registry HA epoch fence (serving/fleet_ha.py): members accept
+        # control only from the highest epoch seen; 0 = unfenced legacy
+        17: ("epoch", "uint64", "one"),
     },
     # KV mesh introduction (serving/fleet_mesh.py; docs/FLEET.md "KV
     # mesh"): the registry host brokers member↔member data-plane
@@ -270,6 +273,25 @@ MESSAGES: Dict[str, Dict[int, _F]] = {
         3: ("data_port", "uint32", "one"),
         4: ("max_streams", "uint32", "one"),
         5: ("gone", "bool", "one"),
+        # registry HA epoch fence (serving/fleet_ha.py): stale-epoch
+        # intros from a fenced registry are ignored by members
+        6: ("epoch", "uint64", "one"),
+    },
+    # Registry HA control wire (serving/fleet_ha.py; docs/FLEET.md
+    # "Registry HA"): the primary's lease beat (frame kind 7) and a
+    # standby's state echo (frame kind 8), exchanged registry↔registry
+    # over the same fleet wire. Epochs are monotonic across takeovers
+    # and fence partitioned old primaries.
+    "RegistryLease": {
+        1: ("registry_id", "string", "one"),
+        2: ("epoch", "uint64", "one"),
+        3: ("seq", "uint64", "one"),
+        4: ("role", "string", "one"),
+    },
+    "RegistryState": {
+        1: ("registry_id", "string", "one"),
+        2: ("epoch", "uint64", "one"),
+        3: ("role", "string", "one"),
     },
     "FleetEvent": {
         1: ("request_id", "string", "one"),

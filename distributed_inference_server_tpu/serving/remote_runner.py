@@ -39,7 +39,8 @@ import socket
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+from typing import (Any, Callable, Deque, Dict, List, Optional, Sequence,
+                    Tuple)
 
 from distributed_inference_server_tpu.core.models import FinishReason, Usage
 from distributed_inference_server_tpu.engine.engine import SamplingParams
@@ -101,6 +102,11 @@ class RemoteRunner:
         self._send = send
         # wired by the FleetServer to Dispatcher.redispatch
         self.redispatch: Optional[Callable] = None
+        # registry HA (serving/fleet_ha.py): the registry's control
+        # epoch, stamped on every submit/abort frame so members can
+        # fence a partitioned old primary. None/0 = unfenced (single-
+        # registry fleets) — the field is simply omitted on the wire.
+        self.epoch_fn: Optional[Callable[[], int]] = None
         # fleet KV data plane (serving/fleet_kv.py; docs/FLEET.md "KV
         # data plane"): the member's lazily-dialed data channel, set by
         # the FleetServer when the member advertises a data_port. None =
@@ -245,6 +251,7 @@ class RemoteRunner:
             self._fail_all_of(
                 reqs, self._last_error or "fleet member unavailable")
             return
+        epoch = self.epoch_fn() if self.epoch_fn is not None else 0
         try:
             for r in reqs:
                 # forwarded submit dies on the wire (docs/RESILIENCE.md)
@@ -263,6 +270,8 @@ class RemoteRunner:
                     "stop_sequences": list(r.params.stop_sequences),
                     "tenant": getattr(r, "tenant", "") or "",
                 }
+                if epoch:
+                    frame["epoch"] = epoch
                 if fetch_hint:
                     frame.update(fetch_hint)
                 span = getattr(r, "span", None)
@@ -288,12 +297,16 @@ class RemoteRunner:
             self._inflight.pop(request_id, None)
         if self.kv_channel is not None:
             self.kv_channel.release_request(request_id)
+        epoch = self.epoch_fn() if self.epoch_fn is not None else 0
+        frame = {
+            "request_id": str(request_id),
+            "engine_id": self.local_engine_id,
+            "abort": True,
+        }
+        if epoch:
+            frame["epoch"] = epoch
         try:
-            self._send("FleetSubmit", {
-                "request_id": str(request_id),
-                "engine_id": self.local_engine_id,
-                "abort": True,
-            })
+            self._send("FleetSubmit", frame)
         except Exception as e:  # noqa: BLE001 — the member is dying
             # anyway; its requests die with it
             self._absorbed("abort_send", e)
@@ -527,11 +540,16 @@ class _RemoteSink:
     span is what ships back to the host."""
 
     def __init__(self, worker: "FleetWorker", request_id: str,
-                 engine_id: str, span=None):
+                 engine_id: str, span=None, link=None):
+        """``link`` (registry HA multi-ingress, serving/fleet_ha.py):
+        the registry wire the submit ARRIVED on — events stream back on
+        the same wire, so a request submitted through a standby's front
+        door resolves on the standby. None = the primary link."""
         self._worker = worker
         self._rid = request_id
         self._eid = engine_id
         self._span = span
+        self._link = link
 
     def _finish_span(self, status: str) -> None:
         span, self._span = self._span, None
@@ -541,7 +559,7 @@ class _RemoteSink:
     def _event(self, obj: Dict[str, Any]) -> None:
         obj["request_id"] = self._rid
         obj["engine_id"] = self._eid
-        self._worker.send_event(obj)
+        self._worker.send_event(obj, link=self._link)
 
     def on_token(self, token_id, text, token_index, logprob=None) -> None:
         ev = {"kind": "token", "text": text or "",
@@ -568,12 +586,43 @@ class _RemoteSink:
                      "code": code or "inference_failed"})
 
 
+class _RegistryLink:
+    """Per-registry connection state of a FleetWorker (registry HA
+    dual-heartbeat, serving/fleet_ha.py): socket + send lock, the
+    per-connection heartbeat sequence, and a per-link bounded span
+    buffer (each registry must see every span — a shared buffer would
+    ship each span to whichever link drained first). The FIRST link is
+    the worker's legacy single wire: its fields are aliased by the
+    worker's historical attributes and its frames route through
+    ``FleetWorker._send``."""
+
+    def __init__(self, endpoint: str, primary: bool):
+        self.endpoint = endpoint
+        self.primary = primary
+        self.sock: Optional[socket.socket] = None
+        # serializes frame writes: the link loop and every local
+        # runner thread's _RemoteSink share the socket
+        self.send_lock = threading.Lock()
+        self.seq = 0
+        self.span_buf: Deque = deque()
+        self.span_lock = threading.Lock()
+        self.span_dropped = 0
+        self.thread: Optional[threading.Thread] = None
+        self.reader: Optional[threading.Thread] = None
+
+
 class FleetWorker:
-    """Joins a fleet: dials the registry host, heartbeats the local
-    replica set, and serves forwarded requests against the local
-    runners. One duplex connection; reconnects with backoff when the
-    registry host bounces (a rejoin — the registry re-materializes
-    fresh proxies)."""
+    """Joins a fleet: dials every registry, heartbeats the local
+    replica set to all of them, and serves forwarded requests against
+    the local runners. One duplex connection per registry endpoint
+    (``fleet.registries``; the legacy single ``fleet.connect`` is just
+    a one-link fleet), each reconnecting with backoff independently —
+    so every registry holds a warm member table at all times and a
+    standby's takeover needs no rejoin (registry HA, serving/
+    fleet_ha.py). Submits are accepted on ANY link (multi-ingress) and
+    their events return on the wire they arrived on; control frames
+    carrying a stale HA epoch are fenced (rejected as
+    ``worker_failure``, redispatching on the sender's side)."""
 
     #: cap on spans buffered between heartbeats and per FleetSpans
     #: frame — the trace channel must never amplify into the data path
@@ -613,25 +662,101 @@ class FleetWorker:
         # piggyback so plan_route prices the wires it never touches.
         self.mesh_client = None
         self.mesh_rates = None
-        self._sock: Optional[socket.socket] = None
-        # serializes frame writes: the heartbeat thread and every local
-        # runner thread's _RemoteSink share the socket
-        self._send_lock = threading.Lock()
+        # one link per registry endpoint (registry HA dual-heartbeat):
+        # the legacy fleet.connect endpoint stays first so the
+        # single-registry shape is exactly one primary link; the
+        # fleet.registries list adds the rest
+        endpoints = []
+        if settings.connect:
+            endpoints.append(settings.connect)
+        for ep in settings.registries:
+            if ep not in endpoints:
+                endpoints.append(ep)
+        if not endpoints:
+            # no endpoint configured: one placeholder link so start()
+            # fails with the same ConfigError it always raised
+            endpoints.append(settings.connect)
+        self._links: List[_RegistryLink] = [
+            _RegistryLink(ep, primary=(i == 0))
+            for i, ep in enumerate(endpoints)
+        ]
         self._stop = threading.Event()
         self._crashed = False  # injected fleet.submit crash: stay down
-        self._beat_thread: Optional[threading.Thread] = None
-        self._reader: Optional[threading.Thread] = None
-        self._seq = 0
-        # finished spans awaiting shipment (beat thread drains); the
-        # buffer is bounded — overflow counts as a wire drop locally AND
-        # rides the next frame's `dropped` field so the HOST's counter
-        # stays truthful even though the spans never crossed
-        self._span_buf: Deque = deque()
-        self._span_lock = threading.Lock()
-        self._span_dropped = 0
+        # registry HA fence: the highest control epoch seen on any
+        # link; stale-epoch submits/intros are refused. GIL-atomic int
+        # written by reader threads  # distlint: ignore[DL008]
+        self._fleet_epoch = 0
         self._epoch_offset_ns = time.time_ns() - time.monotonic_ns()
         if tracer is not None:
             tracer.exporters.append(self._buffer_span)
+
+    @property
+    def endpoints(self) -> Tuple[str, ...]:
+        """Every registry endpoint this worker heartbeats, primary first."""
+        return tuple(link.endpoint for link in self._links)
+
+    # -- legacy single-link surface (aliases of the primary link) ----------
+    # The pre-HA worker had exactly one wire and tests/chaos drive that
+    # shape through these names; they remain the primary link's truth.
+
+    @property
+    def _sock(self) -> Optional[socket.socket]:
+        return self._links[0].sock
+
+    @_sock.setter
+    def _sock(self, value: Optional[socket.socket]) -> None:
+        # test seam: production writes go through _connect_link /
+        # _close_link under send_lock  # distlint: ignore[DL008]
+        self._links[0].sock = value
+
+    @property
+    def _send_lock(self) -> threading.Lock:
+        return self._links[0].send_lock
+
+    @property
+    def _seq(self) -> int:
+        return self._links[0].seq
+
+    @_seq.setter
+    def _seq(self, value: int) -> None:
+        # test seam: in production only the link's own loop increments
+        # its beat counter  # distlint: ignore[DL008]
+        self._links[0].seq = value
+
+    @property
+    def _span_buf(self) -> Deque:
+        return self._links[0].span_buf
+
+    @property
+    def _span_lock(self) -> threading.Lock:
+        return self._links[0].span_lock
+
+    @property
+    def _span_dropped(self) -> int:
+        return self._links[0].span_dropped
+
+    @_span_dropped.setter
+    def _span_dropped(self, value: int) -> None:
+        # test seam: every production write holds the link's span_lock
+        # distlint: ignore[DL008]
+        self._links[0].span_dropped = value
+
+    @property
+    def _beat_thread(self) -> Optional[threading.Thread]:
+        return self._links[0].thread
+
+    @_beat_thread.setter
+    def _beat_thread(self, value: Optional[threading.Thread]) -> None:
+        self._links[0].thread = value
+
+    @property
+    def _reader(self) -> Optional[threading.Thread]:
+        return self._links[0].reader
+
+    @_reader.setter
+    def _reader(self, value: Optional[threading.Thread]) -> None:
+        # lifecycle handle  # distlint: ignore[DL008]
+        self._links[0].reader = value
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -663,17 +788,34 @@ class FleetWorker:
                 self.member_id, self.mesh_rates, metrics=self.metrics,
                 connect_timeout_s=self.settings.kv_connect_timeout_s,
             )
-        self._connect(connect_timeout_s)
+        errors: List[OSError] = []
+        for link in self._links:
+            try:
+                self._connect_link(link, connect_timeout_s)
+            except OSError as e:
+                # registry HA: a standby being down must not stop the
+                # worker joining the rest of the fleet — the link loop
+                # keeps redialing it. With ONE endpoint the legacy
+                # contract holds: the join raises.
+                errors.append(e)
+                logger.warning("fleet worker %s: initial dial of %s "
+                               "failed: %s", self.member_id,
+                               link.endpoint, e)
+        if errors and len(errors) == len(self._links):
+            raise errors[0]
         self._stop.clear()
-        # lifecycle handle  # distlint: ignore[DL008]
-        self._beat_thread = threading.Thread(
-            target=self._beat_loop, name="fleet-worker-beat", daemon=True
-        )
-        self._beat_thread.start()
+        for link in self._links:
+            # lifecycle handle  # distlint: ignore[DL008]
+            link.thread = threading.Thread(
+                target=self._link_loop, args=(link,),
+                name=f"fleet-worker-beat-{link.endpoint}", daemon=True,
+            )
+            link.thread.start()
 
     def stop(self) -> None:
         self._stop.set()
-        self._close()
+        for link in self._links:
+            self._close_link(link)
         if self.kv_server is not None:
             self.kv_server.stop()
             self.kv_server = None
@@ -681,9 +823,10 @@ class FleetWorker:
             self.mesh_client.close()
             self.mesh_client = None
             self.mesh_rates = None
-        if self._beat_thread is not None:
-            self._beat_thread.join(5.0)
-            self._beat_thread = None
+        for link in self._links:
+            if link.thread is not None:
+                link.thread.join(5.0)
+                link.thread = None
         # detach the span exporter: a restarted worker (chaos rebuilds
         # one per crash iteration against the SAME tracer) must not
         # leave dead buffers behind — each would pin 512 spans forever
@@ -695,10 +838,20 @@ class FleetWorker:
                 pass
 
     def is_connected(self) -> bool:
-        return self._sock is not None
+        return any(link.sock is not None for link in self._links)
 
     def _connect(self, timeout_s: float) -> None:
-        host, port = parse_connect(self.settings.connect)
+        # class-qualified: tests drive the dial/configure failure arms
+        # through minimal stubs that only carry settings.connect
+        FleetWorker._connect_link(self, None, timeout_s)
+
+    def _connect_link(self, link: Optional[_RegistryLink],
+                      timeout_s: float) -> None:
+        # link None = the primary link (resolved AFTER the dial: the
+        # dial/configure failure arms must not depend on link state)
+        endpoint = link.endpoint if link is not None else \
+            self.settings.connect
+        host, port = parse_connect(endpoint)
         # worker-side join/reconnect thread: blocking by design with a
         # bounded timeout; never a dispatch or asyncio path
         sock = socket.create_connection(  # distlint: ignore[DL001]
@@ -709,20 +862,33 @@ class FleetWorker:
         except OSError:
             sock.close()  # a dialed-but-unconfigurable socket leaks its fd
             raise
-        with self._send_lock:
-            self._sock = sock
+        if link is None:
+            link = self._links[0]
+        with link.send_lock:
+            # every link.sock write holds this link's send_lock (here and
+            # in _close_link); the lint can't see the per-link lock
+            link.sock = sock  # distlint: ignore[DL008]
         # fresh reader per connection; the old one exited on its EOF
-        self._reader = threading.Thread(
-            target=self._read_loop, args=(sock,),
-            name="fleet-worker-reader", daemon=True,
+        link.reader = threading.Thread(
+            target=self._read_loop, args=(sock, link),
+            name=f"fleet-worker-reader-{link.endpoint}", daemon=True,
         )
-        self._reader.start()
+        link.reader.start()
         logger.info("fleet worker %s connected to %s:%d", self.member_id,
                     host, port)
 
     def _close(self) -> None:
-        with self._send_lock:
-            sock, self._sock = self._sock, None
+        self._close_link(self._links[0])
+
+    def _close_all(self) -> None:
+        for link in self._links:
+            self._close_link(link)
+
+    def _close_link(self, link: _RegistryLink) -> None:
+        with link.send_lock:
+            # every link.sock write holds this link's send_lock (here and
+            # in _connect_link); the lint can't see the per-link lock
+            sock, link.sock = link.sock, None  # distlint: ignore[DL008]
         if sock is not None:
             try:
                 sock.close()
@@ -737,9 +903,23 @@ class FleetWorker:
                 raise OSError("fleet worker not connected")
             send_frame(self._sock, name, obj)
 
-    def send_event(self, obj: Dict[str, Any]) -> None:
+    def _send_link(self, link: Optional[_RegistryLink], name: str,
+                   obj: Dict[str, Any]) -> None:
+        """One frame on ``link`` (None = the primary link). The primary
+        link routes through ``_send`` — the seam tests interpose on."""
+        if link is None or link.primary:
+            self._send(name, obj)
+            return
+        with link.send_lock:
+            if link.sock is None:
+                raise OSError("fleet worker not connected")
+            send_frame(link.sock, name, obj)
+
+    def send_event(self, obj: Dict[str, Any], link=None) -> None:
+        """``link``: the registry wire the request arrived on (registry
+        HA multi-ingress) — its events go back the same way."""
         try:
-            self._send("FleetEvent", obj)
+            self._send_link(link, "FleetEvent", obj)
         except Exception as e:  # noqa: BLE001 — registry link fault
             # domain: the host's death path owns the request now
             logger.debug("fleet worker %s: event send failed: %s",
@@ -749,36 +929,47 @@ class FleetWorker:
 
     def _buffer_span(self, span) -> None:
         """Tracer exporter: queue a finished span for the next shipment
-        (any thread; bounded — never blocks the finishing thread)."""
-        overflowed = False
-        with self._span_lock:
-            if len(self._span_buf) >= self.SPAN_BUFFER:
-                self._span_buf.popleft()
-                self._span_dropped += 1
-                overflowed = True
-            self._span_buf.append(span)
-        if overflowed and self.tracer is not None:
-            self.tracer.record_drop("wire")
+        (any thread; bounded — never blocks the finishing thread). Every
+        link buffers its own copy: each registry must see every span
+        (registry HA dual-heartbeat), and one link's stall must not
+        starve the others. The tracer's local wire-drop counter tracks
+        the PRIMARY link only — it counts spans lost to the operator's
+        view, not per-wire copies."""
+        for link in self._links:
+            overflowed = False
+            with link.span_lock:
+                # every span_buf/span_dropped write holds this link's
+                # span_lock; the lint can't see the per-link lock
+                if len(link.span_buf) >= self.SPAN_BUFFER:
+                    link.span_buf.popleft()  # distlint: ignore[DL008]
+                    link.span_dropped += 1  # distlint: ignore[DL008]
+                    overflowed = True
+                link.span_buf.append(span)  # distlint: ignore[DL008]
+            if overflowed and link.primary and self.tracer is not None:
+                self.tracer.record_drop("wire")
 
-    def ship_spans_once(self) -> bool:
-        """Send one FleetSpans frame with everything buffered (capped at
-        SPANS_PER_FRAME; the overflow counts as dropped). Piggybacks on
-        the heartbeat cadence — the beat loop calls this right after a
-        successful beat. Returns False when the link is down (the spans
-        are counted dropped, not retried: a trace is advisory, the
-        reconnect path must not grow a replay buffer)."""
+    def ship_spans_once(self, link: Optional[_RegistryLink] = None) -> bool:
+        """Send one FleetSpans frame with everything ``link`` buffered
+        (capped at SPANS_PER_FRAME; the overflow counts as dropped).
+        Piggybacks on the heartbeat cadence — each link loop calls this
+        right after a successful beat. Returns False when the link is
+        down (the spans are counted dropped, not retried: a trace is
+        advisory, the reconnect path must not grow a replay buffer)."""
         if self.tracer is None:
             return True
-        with self._span_lock:
-            if not self._span_buf and not self._span_dropped:
+        link = self._links[0] if link is None else link
+        with link.span_lock:
+            if not link.span_buf and not link.span_dropped:
                 return True
-            spans = list(self._span_buf)
-            self._span_buf.clear()
-            dropped, self._span_dropped = self._span_dropped, 0
+            # under this link's span_lock, as is every other
+            # span_buf/span_dropped write; the lint can't see it
+            spans = list(link.span_buf)
+            link.span_buf.clear()  # distlint: ignore[DL008]
+            dropped, link.span_dropped = link.span_dropped, 0  # distlint: ignore[DL008]
         shipped = spans[:self.SPANS_PER_FRAME]
         dropped += len(spans) - len(shipped)
         try:
-            self._send("FleetSpans", {
+            self._send_link(link, "FleetSpans", {
                 "member_id": self.member_id,
                 "spans": [span_to_wire(s, self._epoch_offset_ns)
                           for s in shipped],
@@ -788,13 +979,14 @@ class FleetWorker:
         except Exception as e:  # noqa: BLE001 — link fault domain
             logger.debug("fleet worker %s: span ship failed: %s",
                          self.member_id, e)
-            with self._span_lock:
-                self._span_dropped += len(shipped) + dropped
-            if self.tracer is not None:
+            with link.span_lock:
+                link.span_dropped += len(shipped) + dropped  # distlint: ignore[DL008]
+            if link.primary and self.tracer is not None:
                 self.tracer.record_drop("wire", len(shipped))
             return False
 
-    def ship_telemetry_once(self) -> bool:
+    def ship_telemetry_once(self,
+                            link: Optional[_RegistryLink] = None) -> bool:
         """Send one FleetTelemetry frame: the full current digest
         windows + cumulative step-clock counters (serving/teledigest.py).
         Piggybacked after each successful beat, like spans. Stateless by
@@ -809,8 +1001,8 @@ class FleetWorker:
         if not body["digests"] and not body["counters"]:
             return True
         try:
-            self._send("FleetTelemetry",
-                       {"member_id": self.member_id, **body})
+            self._send_link(link, "FleetTelemetry",
+                            {"member_id": self.member_id, **body})
             self.metrics.record_telemetry_frame("sent")
             return True
         except Exception as e:  # noqa: BLE001 — link fault domain
@@ -819,13 +1011,15 @@ class FleetWorker:
             self.metrics.record_telemetry_frame("failed")
             return False
 
-    def heartbeat_once(self) -> bool:
-        """Send one heartbeat; returns False when the link is down."""
-        self._seq += 1
+    def heartbeat_once(self, link: Optional[_RegistryLink] = None) -> bool:
+        """Send one heartbeat on ``link`` (None = primary); returns
+        False when that link is down."""
+        link = self._links[0] if link is None else link
+        link.seq += 1
         try:
-            self._send("FleetHeartbeat", {
+            self._send_link(link, "FleetHeartbeat", {
                 "member_id": self.member_id,
-                "seq": self._seq,
+                "seq": link.seq,
                 "engines": [status_to_wire(s)
                             for s in self.scheduler.statuses()],
                 "data_port": (self.kv_server.bound_port
@@ -837,19 +1031,22 @@ class FleetWorker:
                          self.member_id, e)
             return False
 
-    def _beat_loop(self) -> None:
+    def _link_loop(self, link: _RegistryLink) -> None:
+        """One link's beat + reconnect loop (registry HA: each registry
+        endpoint gets its own, so a dead standby cannot slow the
+        primary's heartbeat cadence and vice versa)."""
         backoff = self.settings.heartbeat_interval_s
         while not self._stop.wait(self.settings.heartbeat_interval_s):
             if self._crashed:
                 return  # injected crash: the process is "dead"
-            if (self._sock is None or not self.heartbeat_once()
-                    or not self.ship_spans_once()
-                    or not self.ship_telemetry_once()):
-                self._close()
+            if (link.sock is None or not self.heartbeat_once(link)
+                    or not self.ship_spans_once(link)
+                    or not self.ship_telemetry_once(link)):
+                self._close_link(link)
                 if self._stop.is_set() or self._crashed:
                     return
                 try:
-                    self._connect(timeout_s=5.0)
+                    self._connect_link(link, timeout_s=5.0)
                     backoff = self.settings.heartbeat_interval_s
                 except OSError as e:
                     logger.debug("fleet worker %s: reconnect failed: %s",
@@ -861,9 +1058,12 @@ class FleetWorker:
     # -- serving (reader thread) -------------------------------------------
 
     # member->host kinds (heartbeats, events, spans, telemetry) are what
-    # this worker SENDS — the host never echoes them back on this wire
-    # distlint: wire-ignores[FleetHeartbeat, FleetEvent, FleetSpans, FleetTelemetry]
-    def _read_loop(self, sock: socket.socket) -> None:
+    # this worker SENDS — the host never echoes them back on this wire;
+    # registry lease/state frames only cross registry<->registry wires
+    # distlint: wire-ignores[FleetHeartbeat, FleetEvent, FleetSpans, FleetTelemetry, RegistryLease, RegistryState]
+    def _read_loop(self, sock: socket.socket,
+                   link: Optional[_RegistryLink] = None) -> None:
+        link = self._links[0] if link is None else link
         try:
             while True:
                 frame = recv_frame(sock)
@@ -871,23 +1071,23 @@ class FleetWorker:
                     return
                 name, obj = frame
                 if name == "FleetSubmit":
-                    self._serve_submit(obj)
+                    self._serve_submit(obj, link)
                 elif name == "KvIntro":
                     self._on_kv_intro(obj)
                 # heartbeats/events only flow worker -> host; ignore
         except OSError:
-            return  # connection died; the beat loop reconnects
+            return  # connection died; the link loop reconnects
         except faults.InjectedFault:
             # fleet.submit armed on the worker: the member "crashes" on
-            # receipt — drop the connection, serve nothing, stay down
-            # (the registry host redispatches our zero-token in-flight)
+            # receipt — drop every connection, serve nothing, stay down
+            # (the registry hosts redispatch our zero-token in-flight)
             logger.warning("fleet worker %s: injected crash on submit",
                            self.member_id)
             self._crashed = True
-            self._close()
+            self._close_all()
         except Exception:  # noqa: BLE001 — reader must not die silently
             logger.exception("fleet worker %s reader failed", self.member_id)
-            self._close()
+            self._close_link(link)
 
     def _on_kv_intro(self, obj: Dict[str, Any]) -> None:
         """Registry introduction (docs/FLEET.md "KV mesh"): learn —
@@ -895,17 +1095,48 @@ class FleetWorker:
         member with the mesh disabled (or an older build that never
         decodes frame kind 6) just ignores the frame; fetch hints it
         cannot honor degrade to plain recompute."""
+        epoch = int(obj.get("epoch") or 0)
+        if epoch and epoch < self._fleet_epoch:
+            # registry HA fence: a stale-epoch intro (a partitioned old
+            # primary still brokering) — ignore; the mesh degrades to
+            # recompute, never to a wrong wire
+            return
+        if epoch > self._fleet_epoch:
+            self._fleet_epoch = epoch
         if self.mesh_client is not None:
             self.mesh_client.on_intro(obj)
 
-    def _serve_submit(self, obj: Dict[str, Any]) -> None:
+    def _serve_submit(self, obj: Dict[str, Any],
+                      link: Optional[_RegistryLink] = None) -> None:
         rid = obj.get("request_id", "")
         engine_id = obj.get("engine_id", "")
         runner = self.scheduler.get(engine_id)
         if obj.get("abort"):
+            # aborts are NOT fenced: a demoted registry may still own
+            # requests it routed before losing the lease, and honoring
+            # its abort only releases local work
             if runner is not None:
                 runner.abort(rid)
             return
+        epoch = int(obj.get("epoch") or 0)
+        if epoch and epoch < self._fleet_epoch:
+            # registry HA fence (serving/fleet_ha.py): control from a
+            # lower epoch than the highest seen is a partitioned old
+            # primary. Refuse as a zero-token worker_failure ON THE
+            # ARRIVING WIRE — the sender's proxy redispatches on its
+            # side, bounded by its usual redispatch budget.
+            logger.warning("fleet worker %s: fenced submit %s (epoch %d "
+                           "< %d)", self.member_id, rid, epoch,
+                           self._fleet_epoch)
+            self.send_event({
+                "request_id": rid, "engine_id": engine_id,
+                "kind": "error", "code": "worker_failure",
+                "message": f"stale control epoch {epoch} (member has "
+                           f"seen {self._fleet_epoch}): fenced",
+            }, link=link)
+            return
+        if epoch > self._fleet_epoch:
+            self._fleet_epoch = epoch
         # the member crashing on receipt (fault domain of the REMOTE
         # process): raises InjectedFault through to the read loop
         faults.fire("fleet.submit")
@@ -921,7 +1152,7 @@ class FleetWorker:
                 request_id=rid, engine_id=engine_id,
                 member_id=self.member_id,
             )
-        sink = _RemoteSink(self, rid, engine_id, span=span)
+        sink = _RemoteSink(self, rid, engine_id, span=span, link=link)
         if runner is None or not runner.is_healthy():
             sink.on_error(
                 f"remote engine {engine_id!r} unavailable", "worker_failure"

@@ -293,6 +293,7 @@ class InferenceServer:
         self.fleet_registry: Optional[FleetRegistry] = None
         self.fleet_server: Optional[FleetServer] = None
         self.role_balancer: Optional[RoleBalancer] = None
+        self.fleet_ha = None
         if self.fleet_settings.enabled:
             self.fleet_registry = FleetRegistry(
                 self.fleet_settings, metrics=self.metrics
@@ -354,12 +355,34 @@ class InferenceServer:
             self.scheduler.wire_cost = _wire_cost
             self.scheduler.mesh_route = _mesh_route
             self.prefix_fetcher.mesh_route = fs.mesh_route
+            # registry HA (serving/fleet_ha.py; docs/FLEET.md "Registry
+            # HA"): fleet.registries names the warm-standby set — this
+            # registry joins the lease election and stamps its epoch on
+            # every control frame. Single-registry fleets skip all of it.
+            if self.fleet_settings.registries:
+                from distributed_inference_server_tpu.serving.fleet_ha \
+                    import RegistryHA
+
+                self.fleet_ha = RegistryHA(
+                    self.fleet_server, self.fleet_settings,
+                    metrics=self.metrics, recorder=self.recorder,
+                )
+                self.fleet_server.ha = self.fleet_ha
+                if not self.fleet_settings.standby_http:
+                    # single-front-door mode: a standby's dispatcher
+                    # rejects ingress (QueueFull) until it holds the
+                    # lease; fleet-internal paths are never gated
+                    self.dispatcher.ingress_gate = self.fleet_ha.is_primary
         if self.fleet_settings.rerole:
             self.role_balancer = RoleBalancer(
                 self.scheduler, self.dispatcher, self.fleet_settings,
                 metrics=self.metrics,
                 recorder=self.recorder,
             )
+            if self.fleet_ha is not None:
+                # only the lease holder balances roles: two balancers
+                # flipping the same fleet would fight (fleet_ha.py)
+                self.role_balancer.active_fn = self.fleet_ha.is_primary
         self._num_engines = num_engines
         self._next_engine_idx = 0
         self._started = False
@@ -379,6 +402,12 @@ class InferenceServer:
             self.health.start()
         if self.fleet_server is not None:
             self.fleet_server.start()
+        if self.fleet_ha is not None:
+            # after fleet_server.start(): the lease wire's self identity
+            # needs the BOUND port (fleet.port=0 binds ephemerally)
+            self.fleet_ha.start(
+                f"{self.fleet_settings.host}:{self.fleet_server.bound_port}"
+            )
         if self.role_balancer is not None:
             self.role_balancer.start()
         # lifecycle flag, orchestrator-called  # distlint: ignore[DL008]
@@ -395,6 +424,10 @@ class InferenceServer:
         if self.role_balancer is not None:
             self.role_balancer.stop()
         self.dispatcher.shutdown(drain_timeout_s)
+        if self.fleet_ha is not None:
+            # before the fleet server: the lease loop must not race the
+            # listener teardown (peers just see the lease age out)
+            self.fleet_ha.stop()
         if self.fleet_server is not None:
             # after the drain (remote in-flight counted), before the
             # local engines stop: detaches member sessions cleanly
@@ -657,6 +690,10 @@ class InferenceServer:
             # registry<->member and member<->member — with its learned
             # rate and lifetime bytes/chunks
             out["kv_wires"] = self.fleet_server.kv_wire_stats()
+        if self.fleet_ha is not None:
+            # registry HA (serving/fleet_ha.py): role, epoch, lease age
+            # + holder state, peer-registry views, takeover counts
+            out["registry"] = self.fleet_ha.stats()
         if self.role_balancer is not None:
             out["rebalancer"] = self.role_balancer.stats()
         out["role_map"] = {

@@ -627,6 +627,22 @@ class TestFleetChaosSeeds:
         ("mesh_peer_wire_death", 61),
         ("mesh_peer_wire_death", 62),
         ("mesh_peer_wire_death", 63),
+        # registry HA (docs/FLEET.md "Registry HA"): the primary dies
+        # in-process and the warm standby promotes within the lease
+        # window at a bumped epoch, serves through its own ingress, and
+        # the restarted old primary rejoins as a fenced standby. Odd
+        # seeds (71, 73) also crash the first promotion attempt
+        # (fleet.takeover) — takeover must be atomic-or-absent.
+        ("registry_failover", 71),
+        ("registry_failover", 72),
+        ("registry_failover", 73),
+        # a registry<->registry partition (fleet.lease_beat) makes two
+        # primaries; the member fences the stale epoch's control, and
+        # on heal the old primary demotes — exactly one primary, epochs
+        # converged, every request exactly-once.
+        ("registry_split_brain", 71),
+        ("registry_split_brain", 72),
+        ("registry_split_brain", 73),
     ])
     def test_scenario_clean(self, scenario, seed, fleet_chaos_cache):
         from tools import chaos_fleet

@@ -1259,6 +1259,37 @@ def f(cfg):
     assert len(out) == 1 and "fleet.mesh_enable" in out[0].message
 
 
+def test_dl012_fleet_ha_keys():
+    """The registry-HA knobs (config ``fleet.registries`` / ``lease_s``
+    / ``lease_suspect_s`` / ``standby_http``) are schema keys like any
+    other: correct accesses pass, a typo'd variant flags, and the env
+    spellings resolve."""
+    ha_schema = """
+_SCHEMA = {
+    "fleet": {
+        "registries": (tuple, []),
+        "lease_s": (float, 3.0),
+        "lease_suspect_s": (float, 1.5),
+        "standby_http": (bool, True),
+    },
+}
+"""
+    out = pcheck("DL012", {
+        _CONFIG_FIXTURE: ha_schema,
+        f"{PKG}/serving/x.py": """
+import os
+def f(cfg):
+    a = cfg.get("fleet", "registries")
+    b = cfg.get("fleet", "lease_s")
+    c = cfg.get("fleet", "lease_suspect_s")
+    d = os.environ.get("DIS_TPU_FLEET__STANDBY_HTTP")
+    bad = cfg.get("fleet", "lease_suspect")
+    return a, b, c, d, bad
+""",
+    })
+    assert len(out) == 1 and "fleet.lease_suspect" in out[0].message
+
+
 def test_dl012_schema_internal_literals():
     out = pcheck("DL012", {_CONFIG_FIXTURE: _SCHEMA_SRC + """
 HOT_RELOADABLE = {("server", "port"), ("queue", "high_watermrk")}

@@ -1720,3 +1720,203 @@ class TestDialConfigureFailure:
         with pytest.raises(OSError):
             FleetWorker._connect(_Stub(), 1.0)
         assert fake.closed  # the dialed fd must not leak
+
+
+# ---------------------------------------------------------------------------
+# Registry HA: lease-fenced failover (serving/fleet_ha.py)
+# ---------------------------------------------------------------------------
+
+
+class _StubFleetServer:
+    def __init__(self):
+        self.promotes = 0
+
+    def on_ha_promote(self):
+        self.promotes += 1
+
+
+class _StubPeerLink:
+    """Records frames instead of dialing; stands in for _PeerLink."""
+
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+        self.frames = []
+
+    def send(self, name, obj):
+        self.frames.append((name, dict(obj)))
+        return True
+
+    def connected(self):
+        return True
+
+    def close(self):
+        pass
+
+
+REGS = ("127.0.0.1:7101", "127.0.0.1:7102", "127.0.0.1:7103")
+
+
+def _ha(me=0, lease_s=3.0, lease_suspect_s=1.5):
+    """A RegistryHA with its beat thread parked (60s interval) and its
+    peer wires stubbed — tests drive _tick(now) / on_peer_frame by hand."""
+    from distributed_inference_server_tpu.serving.fleet_ha import RegistryHA
+
+    settings = FleetSettings(
+        enabled=True, registries=REGS, lease_s=lease_s,
+        lease_suspect_s=lease_suspect_s, heartbeat_interval_s=60.0,
+    )
+    srv = _StubFleetServer()
+    ha = RegistryHA(srv, settings)
+    ha.start(REGS[me])
+    ha.stop()  # park the thread; state survives, ticks are now manual
+    ha._peers = [_StubPeerLink(ep) for i, ep in enumerate(REGS) if i != me]
+    return ha, srv
+
+
+class TestRegistryHA:
+    def test_boots_standby_and_respects_boot_grace(self):
+        ha, srv = _ha(me=0)
+        assert ha.role == "standby" and ha.epoch == 0
+        now = time.monotonic()
+        ha._tick(now)  # within the one-lease boot grace: no election
+        assert ha.role == "standby" and srv.promotes == 0
+        # ...and the standby beat announced itself to every peer
+        assert all(link.frames[-1][0] == "RegistryState"
+                   for link in ha._peers)
+
+    def test_lowest_index_promotes_after_grace(self):
+        ha, srv = _ha(me=0)
+        now = time.monotonic()
+        ha._tick(now + ha.settings.lease_s + 0.1)
+        assert ha.is_primary() and ha.epoch == 1
+        assert srv.promotes == 1
+        assert ha.stats()["takeovers"] == {"lease_expired": 1}
+        # the next tick beats an epoch-stamped lease to every peer
+        ha._tick(now + ha.settings.lease_s + 0.2)
+        for link in ha._peers:
+            name, frame = link.frames[-1]
+            assert name == "RegistryLease"
+            assert frame["epoch"] == 1 and frame["role"] == "primary"
+
+    def test_standby_defers_to_fresh_lower_index_peer(self):
+        ha, srv = _ha(me=1)
+        now = time.monotonic()
+        # age the boot clock so the grace has lapsed, then observe a
+        # FRESH frame from registries[0] (any kind): it defers us
+        ha._lease_rx_at = now - ha.settings.lease_s - 0.1
+        ha.on_peer_frame("RegistryState",
+                         {"registry_id": REGS[0], "epoch": 0,
+                          "role": "standby"})
+        ha._tick(now)
+        assert ha.role == "standby" and srv.promotes == 0
+        # once that frame ages past one lease window, we stop deferring
+        ha._tick(now + ha.settings.lease_s + 0.2)
+        assert ha.is_primary() and ha.epoch == 1
+
+    def test_lease_accept_then_expiry_promotes_above_learned_epoch(self):
+        ha, srv = _ha(me=1)
+        ha.on_peer_frame("RegistryLease",
+                         {"registry_id": REGS[0], "epoch": 5,
+                          "role": "primary"})
+        assert ha.epoch == 5 and ha.role == "standby"
+        st = ha.stats()
+        assert st["lease"]["holder"] == REGS[0]
+        assert st["lease"]["state"] == MEMBER_ALIVE
+        now = time.monotonic()
+        ha._tick(now)  # lease alive: no election
+        assert ha.role == "standby"
+        # no beat for a full lease window: the watch ages the holder
+        # dead, the deferral window lapses with it, and we take over
+        ha._tick(now + ha.settings.lease_s + 0.1)
+        assert ha.is_primary()
+        assert ha.epoch == 6  # max(self, peer) + 1: fences the old primary
+        assert srv.promotes == 1
+
+    def test_primary_fenced_by_higher_epoch_lease(self):
+        ha, srv = _ha(me=0)
+        ha._tick(time.monotonic() + ha.settings.lease_s + 0.1)
+        assert ha.is_primary() and ha.epoch == 1
+        ha.on_peer_frame("RegistryLease",
+                         {"registry_id": REGS[1], "epoch": 3,
+                          "role": "primary"})
+        assert ha.role == "standby" and ha.epoch == 3
+        assert ha.stats()["takeovers"].get("fenced") == 1
+        # the fencing lease is also ACCEPTED: the demoted registry
+        # immediately watches the new primary's lease
+        assert ha.stats()["lease"]["holder"] == REGS[1]
+
+    def test_same_epoch_tie_breaks_on_list_order(self):
+        # the higher-index primary yields...
+        ha, _ = _ha(me=1)
+        ha._tick(time.monotonic() + 2 * ha.settings.lease_s + 0.2)
+        assert ha.is_primary() and ha.epoch == 1
+        ha.on_peer_frame("RegistryLease",
+                         {"registry_id": REGS[0], "epoch": 1,
+                          "role": "primary"})
+        assert ha.role == "standby"
+        # ...and the lower-index primary holds its ground
+        ha0, _ = _ha(me=0)
+        ha0._tick(time.monotonic() + ha0.settings.lease_s + 0.1)
+        assert ha0.is_primary() and ha0.epoch == 1
+        ha0.on_peer_frame("RegistryLease",
+                          {"registry_id": REGS[1], "epoch": 1,
+                           "role": "primary"})
+        assert ha0.is_primary() and ha0.epoch == 1
+
+    def test_stale_lease_ignored(self):
+        ha, _ = _ha(me=1)
+        ha.on_peer_frame("RegistryLease",
+                         {"registry_id": REGS[0], "epoch": 5,
+                          "role": "primary"})
+        ha.on_peer_frame("RegistryLease",
+                         {"registry_id": REGS[2], "epoch": 3,
+                          "role": "primary"})
+        # the partitioned old primary's lease changes nothing here
+        assert ha.epoch == 5
+        assert ha.stats()["lease"]["holder"] == REGS[0]
+
+    def test_registry_state_echo_fences_primary(self):
+        ha, _ = _ha(me=0)
+        ha._tick(time.monotonic() + ha.settings.lease_s + 0.1)
+        assert ha.is_primary()
+        # a standby that has already seen a newer primary than us
+        ha.on_peer_frame("RegistryState",
+                         {"registry_id": REGS[2], "epoch": 4,
+                          "role": "standby"})
+        assert ha.role == "standby" and ha.epoch == 4
+
+    def test_restart_resets_election_state(self):
+        ha, _ = _ha(me=0)
+        ha._tick(time.monotonic() + ha.settings.lease_s + 0.1)
+        assert ha.is_primary() and ha.epoch == 1
+        ha.stop()
+        ha.start(REGS[0])  # models a process restart
+        ha.stop()
+        assert ha.role == "standby" and ha.epoch == 0
+        assert ha.stats()["takeovers"] == {}
+
+    def test_injected_takeover_crash_is_atomic_or_absent(self):
+        ha, srv = _ha(me=0)
+        faults.install(faults.parse_spec("fleet.takeover:nth=1", seed=7))
+        now = time.monotonic()
+        with pytest.raises(faults.InjectedFault):
+            ha._tick(now + ha.settings.lease_s + 0.1)
+        # the crash fired BEFORE any state change: still a standby at
+        # epoch 0, zero takeovers recorded, promote hook never ran
+        assert ha.role == "standby" and ha.epoch == 0
+        assert ha.stats()["takeovers"] == {} and srv.promotes == 0
+        # the one-shot fault is spent: the retry tick promotes cleanly
+        ha._tick(now + ha.settings.lease_s + 0.2)
+        assert ha.is_primary() and ha.epoch == 1
+
+    def test_stats_shape(self):
+        ha, _ = _ha(me=2)
+        ha.on_peer_frame("RegistryLease",
+                         {"registry_id": REGS[0], "epoch": 2,
+                          "role": "primary"})
+        st = ha.stats()
+        assert st["registry_id"] == REGS[2]
+        assert st["role"] == "standby" and st["epoch"] == 2
+        assert st["peers"][REGS[0]]["role"] == "primary"
+        assert st["peers"][REGS[0]]["epoch"] == 2
+        assert st["lease"]["age_s"] >= 0.0

@@ -429,6 +429,9 @@ def _rand_intro(rng: random.Random) -> dict:
         "data_port": rng.randrange(0, 65536),
         "max_streams": rng.randrange(0, 64),
         "gone": rng.random() < 0.3,
+        # registry HA: the broker stamps its fencing epoch on re-brokered
+        # intros (serving/fleet_ha.py)
+        "epoch": rng.randrange(0, 1 << 31),
     }
 
 
@@ -466,4 +469,4 @@ def test_kv_intro_decode_fills_proto3_defaults():
     got = protowire.decode(
         "KvIntro", protowire.encode("KvIntro", {"member_id": "m1"}))
     assert got == {"member_id": "m1", "host": "", "data_port": 0,
-                   "max_streams": 0, "gone": False}
+                   "max_streams": 0, "gone": False, "epoch": 0}

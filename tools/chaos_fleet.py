@@ -54,6 +54,8 @@ DEFAULT_SCENARIOS = (
     "warm_peer_fetch_death",
     "registry_partition",
     "remote_runner_crash_mid_request",
+    "registry_failover",
+    "registry_split_brain",
     "rerole_flap",
     "cross_host_handoff_death",
     "remote_fetch_source_death",
@@ -137,7 +139,8 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
                 channel="inproc", auto_restart=True, warmup=False,
                 handoff_timeout_s=20.0, engine_kwargs=None,
                 fleet=False, rerole=False, member_roles=("unified",),
-                health=None, admission=None, slo=None, mesh=False):
+                health=None, admission=None, slo=None, mesh=False,
+                ha=False):
     """A tiny-model fleet wired exactly like production (the
     disagg_smoke.py topology, sans HTTP): real engines, real runners,
     real dispatcher/scheduler/controller. Health loop runs hot
@@ -168,7 +171,17 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
     mesh (docs/FLEET.md "KV mesh") and joins a SECOND member
     (``chaos-w2``, same roles) so the registry has a pair to introduce
     — three schedulers, three allocators, one real localhost wire per
-    member plus the brokered member->member data wire."""
+    member plus the brokered member->member data wire.
+
+    ``ha=True`` (implies ``fleet``) arms registry HA (docs/FLEET.md
+    "Registry HA"): TWO registry InferenceServers on pre-picked fixed
+    localhost ports share an ordered ``fleet.registries`` list, elect
+    ``registries[0]`` (``srv``) primary, and the member dual-heartbeats
+    both over real wires. The standby rides on ``srv._ha_standby_srv``;
+    chaos-fast lease windows (lease_s=1.2) keep failover inside a
+    scenario. Scenarios kill/partition the primary IN-PROCESS (stop its
+    listener + HA loop) — the true SIGKILL path is tools/fleet_smoke.py
+    ``--ha``."""
     import jax.numpy as jnp
 
     from distributed_inference_server_tpu.engine.engine import (
@@ -200,13 +213,33 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
 
     # aging windows sized for LOADED runners: a GIL stall from a
     # concurrent engine compile must read as jitter, not death
-    fleet = fleet or mesh
+    fleet = fleet or mesh or ha
+    ha_registries = ()
+    ha_ports = ()
+    if ha:
+        # pre-pick two free fixed ports: the ordered fleet.registries
+        # list must name both listeners BEFORE either server starts
+        import socket as _socket
+
+        picked = []
+        for _ in range(2):
+            s = _socket.socket()
+            s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            picked.append(s)
+        ha_ports = tuple(s.getsockname()[1] for s in picked)
+        for s in picked:
+            s.close()
+        ha_registries = tuple(f"127.0.0.1:{p}" for p in ha_ports)
     fleet_settings = FleetSettings(
         enabled=fleet, heartbeat_interval_s=0.1, suspect_after_s=0.6,
         dead_after_s=1.5, rerole=rerole, rerole_high_ratio=2.0,
         rerole_low_ratio=0.5, rerole_cooldown_s=0.3,
         rerole_interval_s=60.0,  # scenarios drive evaluate() themselves
         mesh_enabled=mesh,
+        # chaos-fast lease windows: failover resolves inside a scenario
+        port=ha_ports[0] if ha else 0, registries=ha_registries,
+        lease_s=1.2, lease_suspect_s=0.6,
     )
     srv = InferenceServer(
         factory, ByteTokenizer(), model_name="tiny-chaos",
@@ -226,6 +259,33 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
     srv._fleet_worker_srv = None
     srv._fleet_worker2 = None
     srv._fleet_worker2_srv = None
+    srv._ha_standby_srv = None
+    if ha:
+        import dataclasses
+
+        standby_srv = InferenceServer(
+            factory, ByteTokenizer(), model_name="tiny-chaos-standby",
+            num_engines=len(roles), engine_roles=list(roles),
+            strategy=SchedulingStrategy.parse(strategy),
+            auto_restart=auto_restart, health_check_interval_s=0.1,
+            restart_backoff_s=0.2, restart_backoff_max_s=2.0,
+            fleet_settings=dataclasses.replace(fleet_settings,
+                                               port=ha_ports[1]),
+            slo_settings=slo,
+        )
+        standby_srv.start()
+        srv._ha_standby_srv = standby_srv
+        # initial election: registries[0] (srv) wins after the boot
+        # grace (one lease window); the standby defers to it
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if srv.fleet_ha.is_primary():
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError(
+                f"HA fleet never elected a primary: "
+                f"{srv.fleet_ha.stats()} / {standby_srv.fleet_ha.stats()}")
     if fleet:
         worker_srv = InferenceServer(
             factory, ByteTokenizer(), model_name="tiny-chaos-member",
@@ -241,6 +301,9 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
             connect=f"127.0.0.1:{srv.fleet_server.bound_port}",
             heartbeat_interval_s=0.1,
             mesh_enabled=mesh,
+            # dual-heartbeat: the member keeps a live wire to BOTH
+            # registries, so the standby's member table stays warm
+            registries=ha_registries,
         )
         if mesh:
             worker2_srv = InferenceServer(
@@ -265,6 +328,8 @@ def build_fleet(roles=("unified", "unified"), strategy="least_loaded",
                 srv._fleet_worker2.stop()
             if srv._fleet_worker2_srv is not None:
                 srv._fleet_worker2_srv.shutdown(drain_timeout_s)
+            if srv._ha_standby_srv is not None:
+                srv._ha_standby_srv.shutdown(drain_timeout_s)
             worker_srv.shutdown(drain_timeout_s)
             orig_shutdown(drain_timeout_s)
 
@@ -322,6 +387,15 @@ def _wait_member_state(srv, state: str, timeout_s: float = 10.0) -> bool:
     return False
 
 
+def _wait_until(pred, timeout_s: float, interval_s: float = 0.05) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
 def submit(srv, rid: str, prompt: str = _PROMPT, max_tokens: int = 16,
            sinks=None):
     """Submit one request; returns its ChaosSink, or None if admission
@@ -370,7 +444,8 @@ def check_invariants(srv, sinks, require_success=False,
         if require_success and s.errors:
             violations.append(f"{s.rid}: expected success, got {s.errors}")
     member_srvs = [m for m in (getattr(srv, "_fleet_worker_srv", None),
-                               getattr(srv, "_fleet_worker2_srv", None))
+                               getattr(srv, "_fleet_worker2_srv", None),
+                               getattr(srv, "_ha_standby_srv", None))
                    if m is not None]
     deadline = time.monotonic() + converge_timeout_s
     auto = srv.scheduler._auto_restart
@@ -1093,6 +1168,181 @@ def scenario_mesh_peer_wire_death(srv, seed: int):
     return sinks, True, extra
 
 
+def _registry_serving(reg_srv) -> bool:
+    """A registry's federated view is LIVE: the member alive in its
+    table and a healthy remote proxy in its routing set."""
+    return (reg_srv.fleet_registry.member_state("chaos-w1") == "alive"
+            and any(getattr(r, "is_remote", False) and r.is_healthy()
+                    for r in reg_srv.scheduler.engines()))
+
+
+def scenario_registry_failover(srv, seed: int):
+    """Registry HA (docs/FLEET.md "Registry HA"): the PRIMARY registry
+    dies in-process — lease loop and member listener stopped cold — and
+    the warm standby must promote within the lease window at a bumped
+    epoch, its member table and proxies already live from the dual
+    heartbeat, and serve traffic through its OWN ingress. Then the old
+    primary restarts on the same port and must rejoin as a STANDBY (it
+    boots at epoch 0, learns the cluster epoch from the new primary's
+    lease, and never splits the brain). Odd seeds crash the standby's
+    first promotion attempt (fleet.takeover) — the takeover must be
+    atomic-or-absent, the election simply re-running next tick."""
+    rng = random.Random(seed)
+    from distributed_inference_server_tpu.serving import faults as _faults
+
+    _ensure_worker(srv)
+    # the fleet is reused across seeds and each iteration SWAPS the
+    # roles — find the current primary instead of assuming srv holds it
+    a, b = srv, srv._ha_standby_srv
+    pri, stb = (a, b) if a.fleet_ha.is_primary() else (b, a)
+    lease_s = srv.fleet_settings.lease_s
+    pri_epoch = pri.fleet_ha.epoch
+    sinks = []
+    extra = []
+    # pre-kill reference traffic through the primary
+    for i in range(rng.randint(1, 2)):
+        submit(pri, f"fo-{seed}-a{i}", sinks=sinks)
+    wedged = wait_terminal(sinks)
+    extra += [f"{r}: no terminal event (wedged)" for r in wedged]
+    if seed % 2:
+        # crash the standby at the start of its first promotion: the
+        # fault fires before any state changed, so the next tick must
+        # simply re-run the election (atomic-or-absent)
+        _arm("fleet.takeover:nth=1", seed)
+    # the primary dies in-process: listener + HA loop gone, engines
+    # orphaned (the true SIGKILL path is fleet_smoke --ha)
+    pri.fleet_ha.stop()
+    pri.fleet_server.stop()
+    if not _wait_until(stb.fleet_ha.is_primary,
+                       timeout_s=lease_s * 4 + 5.0):
+        extra.append(f"standby never promoted: {stb.fleet_ha.stats()}")
+    _faults.clear()
+    takeovers = stb.fleet_ha.stats()["takeovers"]
+    if stb.fleet_ha.is_primary() and not takeovers.get("lease_expired"):
+        extra.append(f"promotion not counted as lease_expired: {takeovers}")
+    if stb.fleet_ha.is_primary() and stb.fleet_ha.epoch <= pri_epoch:
+        extra.append(
+            f"promotion did not bump the epoch past the old primary's: "
+            f"{stb.fleet_ha.epoch} <= {pri_epoch}")
+    # the standby was WARM: its member table and proxies must go (stay)
+    # live without the member doing anything but its usual beats
+    if not _wait_until(lambda: _registry_serving(stb), timeout_s=10.0):
+        extra.append("standby's warm member table never went live after "
+                     "takeover")
+    else:
+        post = submit(stb, f"fo-{seed}-post", sinks=sinks)
+        if post is None:
+            extra.append("post-takeover submit rejected at the new primary")
+    wedged = wait_terminal(sinks, timeout_s=90.0)
+    extra += [f"{r}: no terminal event (wedged)" for r in wedged]
+    # the old primary restarts on the SAME port: it must come back
+    # standby, learn the new epoch from the lease, and NOT fight
+    pri.fleet_server.start()
+    pri.fleet_ha.start(f"127.0.0.1:{pri.fleet_server.bound_port}")
+    if not _wait_until(
+            lambda: (not pri.fleet_ha.is_primary()
+                     and pri.fleet_ha.epoch == stb.fleet_ha.epoch),
+            timeout_s=lease_s * 4 + 5.0):
+        extra.append(
+            f"old primary did not rejoin as standby at the new epoch: "
+            f"{pri.fleet_ha.stats()} vs {stb.fleet_ha.stats()}")
+    if stb.fleet_ha.is_primary() == pri.fleet_ha.is_primary():
+        extra.append(
+            f"not exactly one primary after rejoin: "
+            f"{pri.fleet_ha.stats()} / {stb.fleet_ha.stats()}")
+    # the member's wire to the restarted listener reconnects and the
+    # old primary's (now standby) view warms back up — reconvergence
+    # means every front door serves again
+    if not _wait_until(lambda: _registry_serving(pri), timeout_s=15.0):
+        extra.append("restarted registry's member table never re-warmed")
+    return sinks, False, extra
+
+
+def scenario_registry_split_brain(srv, seed: int):
+    """Registry HA fencing (docs/FLEET.md "Registry HA"): a
+    registry<->registry partition (fleet.lease_beat drops every lease
+    beat before the wire) while BOTH registries live. The standby's
+    lease expires, it promotes at a higher epoch — two primaries exist.
+    The member, having executed one control frame from the new primary,
+    must bounce the OLD primary's submits as stale-epoch failures
+    (which redispatch on the old primary's own local engine, invisibly
+    to the client). When the partition heals, the old primary sees the
+    higher-epoch lease and demotes — fenced, exactly one primary."""
+    rng = random.Random(seed)  # noqa: F841 — seed selects the FaultSet RNG
+    from distributed_inference_server_tpu.serving import faults as _faults
+
+    worker = _ensure_worker(srv)
+    # the fleet is reused across seeds and each iteration SWAPS the
+    # roles — find the current primary instead of assuming srv holds it
+    a, b = srv, srv._ha_standby_srv
+    pri, stb = (a, b) if a.fleet_ha.is_primary() else (b, a)
+    lease_s = srv.fleet_settings.lease_s
+    fenced_before = pri.fleet_ha.stats()["takeovers"].get("fenced", 0)
+    sinks = []
+    extra = []
+    # the partition: every lease beat drops before the wire (the point
+    # fires on the PRIMARY's send path only; RegistryState echoes and
+    # member heartbeats still flow — a pure registry<->registry split)
+    _arm("fleet.lease_beat:prob=1.0,times=100000", seed)
+    if not _wait_until(stb.fleet_ha.is_primary,
+                       timeout_s=lease_s * 4 + 5.0):
+        extra.append(f"standby never promoted under the partition: "
+                     f"{stb.fleet_ha.stats()}")
+    split = pri.fleet_ha.is_primary() and stb.fleet_ha.is_primary()
+    if not split:
+        extra.append(
+            f"no split-brain manufactured: {pri.fleet_ha.stats()} / "
+            f"{stb.fleet_ha.stats()}")
+    # teach the member the NEW epoch: one request through the new
+    # primary's remote proxy puts its epoch on a FleetSubmit frame
+    if _wait_until(lambda: _registry_serving(stb), timeout_s=10.0):
+        _drive_remote(stb, f"sb-{seed}-new", sinks=sinks)
+        wait_terminal(sinks[-1:], timeout_s=60.0)
+        if worker._fleet_epoch != stb.fleet_ha.epoch:
+            extra.append(
+                f"member never learned the new primary's epoch: "
+                f"{worker._fleet_epoch} != {stb.fleet_ha.epoch}")
+    else:
+        extra.append("new primary's member view never went live")
+    # the OLD primary (still primary, lower epoch) forwards a request
+    # straight at its remote proxy: the member must fence it (stale
+    # epoch -> worker_failure event). The old primary redispatches on
+    # its side — usually completing on its local engine, but the
+    # documented bounded-failure contract allows the budget to exhaust
+    # as worker_failure if routing keeps re-picking the fenced proxy;
+    # what is NEVER legal is the member executing the stale control
+    if split and _registry_serving(pri):
+        fenced = _drive_remote(pri, f"sb-{seed}-old", sinks=sinks)
+        fenced.ev.wait(60.0)
+        for _msg, code in fenced.errors:
+            if code != "worker_failure":
+                extra.append(
+                    f"fenced submit failed with {code!r} (want a clean "
+                    "redispatch completion or worker_failure)")
+    # heal: the surviving lease beats reach the old primary, which must
+    # demote (fenced) — exactly one primary again
+    _faults.clear()
+    if not _wait_until(
+            lambda: (not pri.fleet_ha.is_primary()
+                     and stb.fleet_ha.is_primary()),
+            timeout_s=lease_s * 4 + 5.0):
+        extra.append(
+            f"old primary never fenced after the partition healed: "
+            f"{pri.fleet_ha.stats()} / {stb.fleet_ha.stats()}")
+    else:
+        fenced_after = pri.fleet_ha.stats()["takeovers"].get("fenced", 0)
+        if fenced_after <= fenced_before:
+            extra.append(
+                f"demotion not counted as fenced: {pri.fleet_ha.stats()}")
+        if pri.fleet_ha.epoch != stb.fleet_ha.epoch:
+            extra.append(
+                f"epochs never converged: {pri.fleet_ha.epoch} != "
+                f"{stb.fleet_ha.epoch}")
+    wedged = wait_terminal(sinks, timeout_s=90.0)
+    extra += [f"{r}: no terminal event (wedged)" for r in wedged]
+    return sinks, False, extra
+
+
 #: chaos-paced gray-failure settings (serving/health.py): scenarios
 #: drive evaluate() themselves (interval_s=60), evidence windows short
 #: enough to decay inside one scenario, thresholds low enough for a
@@ -1154,6 +1404,14 @@ SCENARIOS = {
     "remote_runner_crash_mid_request": (
         scenario_remote_runner_crash_mid_request,
         {"roles": ("unified",), "fleet": True}),
+    # registry HA (docs/FLEET.md "Registry HA"): two registry hosts on
+    # an ordered fleet.registries list + one dual-heartbeating member;
+    # the primary dies in-process / is partitioned and the warm standby
+    # takes over lease-fenced
+    "registry_failover": (scenario_registry_failover,
+                          {"roles": ("unified",), "ha": True}),
+    "registry_split_brain": (scenario_registry_split_brain,
+                             {"roles": ("unified",), "ha": True}),
     # role rebalancing: one unified admission engine + one decode target
     # (list-form roles skip parse_roles's static-topology check — the
     # balancer IS the prefill source here)

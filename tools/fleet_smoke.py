@@ -22,7 +22,15 @@ frames) — then proves the control plane end to end:
    TTFT p99 EXACTLY equals an offline re-merge of the member digests
    fetched from each process, and ``fleet_*{member}`` series appear in
    host ``/metrics``;
-2c. **KV mesh** (docs/FLEET.md "KV mesh"): a three-process fleet —
+2c. **registry HA** (docs/FLEET.md "Registry HA"): a three-process
+   fleet — a primary registry child, a warm-standby registry (this
+   process), and a dual-heartbeating worker. The primary child is
+   SIGKILLed mid-fleet; the standby must promote itself within its
+   lease window, serve a ``/generate`` through its own front door that
+   routes over its ALREADY-WARM member proxy token-identically to the
+   dead primary's pre-kill reference, and when the old primary reboots
+   it must rejoin FENCED: standby, at the learned (higher) epoch;
+2d. **KV mesh** (docs/FLEET.md "KV mesh"): a three-process fleet —
    registry + two mesh members — where a forced fetch moves the warm
    member's chunks DIRECTLY to the cold member over the
    registry-introduced wire, token-identically, while the registry's
@@ -171,7 +179,8 @@ def _request(rid: str):
 
 def run_worker(connect: str, role: str = "",
                member_id: str = MEMBER_ID, http_port: int = 0,
-               fault_spec: str = "", mesh: bool = False) -> int:
+               fault_spec: str = "", mesh: bool = False,
+               registries: str = "") -> int:
     """Child process: one engine + a FleetWorker joined to ``connect``;
     serves until killed. ``role`` ("decode") makes this member the
     cross-host handoff target over its KV data channel. ``http_port``
@@ -183,6 +192,8 @@ def run_worker(connect: str, role: str = "",
     (docs/FLEET.md "KV mesh"): registry KvIntro frames are honored,
     fetch hints pull directly from peer members, and the engine keeps
     the Python allocator tier so its prefix digests have a surface.
+    ``registries`` (comma-separated endpoints) dual-heartbeats EVERY
+    registry (docs/FLEET.md "Registry HA") — the HA leg's worker.
     SIGTERM runs a page-conservation audit and exits
     with its verdict — the host's "clean audits both sides" check."""
     _env_setup()
@@ -198,10 +209,11 @@ def run_worker(connect: str, role: str = "",
     )
     if fault_spec:
         faults.install(faults.parse_spec(fault_spec, seed=0))
+    regs = tuple(r.strip() for r in registries.split(",") if r.strip())
     worker = FleetWorker(
         srv.scheduler,
-        FleetSettings(connect=connect, heartbeat_interval_s=0.2,
-                      mesh_enabled=mesh),
+        FleetSettings(connect=connect, registries=regs,
+                      heartbeat_interval_s=0.2, mesh_enabled=mesh),
         member_id=member_id,
         # fleet-stitched tracing: fleet.serve/engine.infer spans ship
         # back to the registry host (docs/OBSERVABILITY.md)
@@ -213,8 +225,8 @@ def run_worker(connect: str, role: str = "",
     worker.start(connect_timeout_s=30.0)
     if http_port:
         _start_http(srv, port=http_port)
-    print(f"fleet-smoke worker: joined {connect} (role={role or 'unified'})",
-          flush=True)
+    print(f"fleet-smoke worker: joined {connect or ','.join(regs)} "
+          f"(role={role or 'unified'})", flush=True)
 
     def _on_term(_sig, _frame):
         issues = []
@@ -700,8 +712,205 @@ def _degrade_leg(srv, port: int, registry_port: int) -> Optional[str]:
             child.wait(timeout=10)
 
 
+def run_registry(fleet_port: int, registries: str, http_port: int) -> int:
+    """Child process: a FULL registry — its own engine, a fleet
+    listener on ``fleet_port``, the HA lease election over the
+    ``registries`` list, and its own HTTP front door (multi-ingress).
+    The HA leg SIGKILLs this process while it holds the lease, then
+    reboots it to watch it rejoin fenced."""
+    _env_setup()
+    from distributed_inference_server_tpu.serving.fleet import FleetSettings
+
+    regs = tuple(r.strip() for r in registries.split(",") if r.strip())
+    srv = _build_server(FleetSettings(
+        enabled=True, port=fleet_port, registries=regs,
+        heartbeat_interval_s=0.2, suspect_after_s=1.0, dead_after_s=2.0,
+        lease_s=1.2, lease_suspect_s=0.6,
+    ))
+    _start_http(srv, port=http_port)
+    print(f"fleet-smoke registry: fleet :{fleet_port} http :{http_port}",
+          flush=True)
+    while True:  # serve until the parent kills us
+        time.sleep(1.0)
+
+
+def _reg_stats(http_port: int) -> Optional[dict]:
+    """A registry child's /server/stats ``registry`` block, or None
+    while its HTTP surface is still booting."""
+    try:
+        return _http_json(
+            "GET", f"http://127.0.0.1:{http_port}/server/stats",
+            timeout=5.0)["fleet"]["registry"]
+    except Exception:  # noqa: BLE001 — child still booting
+        return None
+
+
+def _ha_leg() -> Optional[str]:
+    """The registry-HA acceptance (docs/FLEET.md "Registry HA", step 2c
+    of the module docstring), on its OWN three-process fleet: a primary
+    registry child at registries[0], THIS process as the warm standby
+    at registries[1], and one worker dual-heartbeating both. Asserts:
+    the child wins the boot election (list order); SIGKILLing it
+    promotes the standby within ITS lease window with a
+    ``lease_expired`` takeover and a higher epoch; a ``/generate``
+    through the standby's own front door — with its local engine
+    unregistered, so the request MUST ride the already-warm remote
+    proxy — is token-identical to the dead primary's pre-kill
+    reference; and the rebooted old primary rejoins FENCED (standby, at
+    the learned epoch) while the new primary keeps the lease. Returns a
+    violation string or None."""
+    from distributed_inference_server_tpu.serving.fleet import FleetSettings
+
+    port_a, port_b = _free_port(), _free_port()
+    http_a = _free_port()
+    regs = (f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}")
+
+    def _spawn_registry():
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--registry",
+             "--fleet-port", str(port_a),
+             "--registries", ",".join(regs),
+             "--http-port", str(http_a)],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+
+    # the child boots FIRST and must already hold the lease before the
+    # standby exists: the leg's election claim is about list order, not
+    # about who booted first
+    child = _spawn_registry()
+    srv = None
+    worker = None
+    try:
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            reg = _reg_stats(http_a)
+            if reg is not None and reg["role"] == "primary":
+                break
+            if child.poll() is not None:
+                return "primary registry child died before electing"
+            time.sleep(0.2)
+        else:
+            return "registry child never won the boot election"
+
+        # the standby: lease_s=3.0 keeps its boot grace longer than the
+        # child's worst-case peer-redial backoff (2s), so the standby
+        # never transiently self-promotes while joining a live primary
+        srv = _build_server(FleetSettings(
+            enabled=True, port=port_b, registries=regs,
+            heartbeat_interval_s=0.2, suspect_after_s=1.0,
+            dead_after_s=2.0, lease_s=3.0, lease_suspect_s=1.0,
+        ))
+        worker = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--registries", ",".join(regs), "--member-id", "ha-w1"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+
+        # warm standby: BOTH registries must hold the member before the
+        # kill — the child as lease holder, the standby via its own
+        # dual-heartbeat wire
+        deadline = time.monotonic() + 240.0
+        proxy = None
+        while time.monotonic() < deadline:
+            proxy = next((r for r in srv.scheduler.engines()
+                          if getattr(r, "is_remote", False)
+                          and r.is_healthy()), None)
+            lease = srv.fleet_ha.stats()["lease"]
+            if proxy is not None and lease["holder"] == regs[0]:
+                break
+            if worker.poll() is not None:
+                return "HA worker died before joining"
+            time.sleep(0.2)
+        if proxy is None:
+            return "the standby never materialized a warm member proxy"
+        if srv.fleet_ha.is_primary():
+            return "the standby won an election over a live registries[0]"
+        epoch_before = srv.fleet_ha.epoch
+        takeovers_before = dict(srv.fleet_ha.stats()["takeovers"])
+
+        # reference through the PRIMARY's front door, pre-kill
+        ref = _http_json(
+            "POST", f"http://127.0.0.1:{http_a}/generate",
+            {"prompt": _PROMPT, "max_tokens": 24, "temperature": 0.0})
+        ref_text = ref.get("choices", [{}])[0].get("text", "")
+        if not ref_text:
+            return f"primary /generate returned no text: {ref}"
+
+        # SIGKILL the lease holder; the standby must take over within
+        # its OWN lease window (plus scheduler slack)
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=10)
+        t_kill = time.monotonic()
+        lease_s = srv.fleet_ha.settings.lease_s
+        while (time.monotonic() - t_kill < lease_s + 5.0
+               and not srv.fleet_ha.is_primary()):
+            time.sleep(0.05)
+        took = time.monotonic() - t_kill
+        if not srv.fleet_ha.is_primary():
+            return (f"standby never took over ({took:.1f}s > lease "
+                    f"{lease_s}s + slack): {srv.fleet_ha.stats()}")
+        st = srv.fleet_ha.stats()
+        if (st["takeovers"].get("lease_expired", 0)
+                <= takeovers_before.get("lease_expired", 0)):
+            return f"takeover not recorded as lease_expired: {st}"
+        if st["epoch"] <= epoch_before:
+            return (f"promotion did not advance the epoch: "
+                    f"{epoch_before} -> {st['epoch']}")
+        print(f"fleet-smoke: standby promoted in {took:.2f}s "
+              f"(lease {lease_s}s, epoch {st['epoch']}) OK", flush=True)
+
+        # multi-ingress through the NEW primary's own front door; its
+        # local engine is unregistered so the request MUST ride the
+        # warm remote proxy it learned while still a standby
+        _loop, _runner, http_b = _start_http(srv)
+        local = next(r for r in srv.scheduler.engines()
+                     if not getattr(r, "is_remote", False))
+        srv.scheduler.unregister(local.engine_id)
+        try:
+            resp = _http_json(
+                "POST", f"http://127.0.0.1:{http_b}/generate",
+                {"prompt": _PROMPT, "max_tokens": 24, "temperature": 0.0})
+        finally:
+            srv.scheduler.register(local)
+        text = resp.get("choices", [{}])[0].get("text", "")
+        if text != ref_text:
+            return (f"failover stream diverged over the warm proxy: "
+                    f"{text!r} != {ref_text!r}")
+        print("fleet-smoke: failover /generate over the warm member "
+              "proxy token-identical OK", flush=True)
+
+        # reboot the old primary: it must rejoin FENCED — standby, at
+        # the cluster epoch it learns from the new primary's lease
+        child = _spawn_registry()
+        deadline = time.monotonic() + 240.0
+        reg = None
+        while time.monotonic() < deadline:
+            reg = _reg_stats(http_a)
+            if (reg is not None and reg["role"] == "standby"
+                    and reg["epoch"] == srv.fleet_ha.epoch):
+                break
+            if child.poll() is not None:
+                return "rebooted old primary died while rejoining"
+            time.sleep(0.2)
+        else:
+            return (f"old primary never rejoined fenced: {reg} vs "
+                    f"epoch {srv.fleet_ha.epoch}")
+        if not srv.fleet_ha.is_primary():
+            return "the new primary lost the lease during the rejoin"
+        print(f"fleet-smoke: old primary rejoined fenced (standby, "
+              f"epoch {reg['epoch']}) OK", flush=True)
+        return None
+    finally:
+        for c in (child, worker):
+            if c is not None and c.poll() is None:
+                c.kill()
+                c.wait(timeout=10)
+        if srv is not None:
+            srv.shutdown(drain_timeout_s=5.0)
+
+
 def _mesh_leg() -> Optional[str]:
-    """The KV-mesh acceptance (docs/FLEET.md "KV mesh", step 2c of the
+    """The KV-mesh acceptance (docs/FLEET.md "KV mesh", step 2d of the
     module docstring), on its OWN three-process fleet: a cache_aware
     registry with mesh introductions on, plus two ``--mesh`` members.
     amesh-1 is warmed; a forced fetch (the ``sched.fetch_decision``
@@ -980,7 +1189,12 @@ def run_host() -> int:
         if violation is not None:
             return _fail(violation)
 
-        # -- 2.8 member<->member KV mesh (own three-process fleet) ------
+        # -- 2.8 registry HA failover (own three-process fleet) ---------
+        violation = _ha_leg()
+        if violation is not None:
+            return _fail(violation)
+
+        # -- 2.9 member<->member KV mesh (own three-process fleet) ------
         violation = _mesh_leg()
         if violation is not None:
             return _fail(violation)
@@ -1068,13 +1282,26 @@ def main() -> int:
                     help="worker mode: join the member<->member KV "
                     "mesh (honor KvIntro frames, pull fetch hints "
                     "directly from peer members)")
+    ap.add_argument("--registry", action="store_true",
+                    help="run as an HA registry child (the HA leg's "
+                    "killable primary)")
+    ap.add_argument("--fleet-port", type=int, default=0,
+                    help="registry mode: bind the fleet listener here")
+    ap.add_argument("--registries", default="",
+                    help="comma-separated fleet.registries list "
+                    "(registry mode: the election peers; worker mode: "
+                    "dual-heartbeat every one of them)")
     args = ap.parse_args()
+    if args.registry:
+        return run_registry(args.fleet_port, args.registries,
+                            args.http_port)
     if args.worker:
         return run_worker(args.connect, role=args.role,
                           member_id=args.member_id,
                           http_port=args.http_port,
                           fault_spec=args.fault_spec,
-                          mesh=args.mesh)
+                          mesh=args.mesh,
+                          registries=args.registries)
     return run_host()
 
 
