@@ -775,6 +775,208 @@ def bench_mixed() -> None:
             sys.exit(3)
 
 
+def bench_loop() -> None:
+    """Run-to-completion looped decode microbench (BENCH_LOOP=1; ISSUE
+    19): a mixed long-prompt/chat workload on ONE unified engine, swept
+    over {fixed-K, loop_to_completion} x {plain decode, mixed step at
+    K-block fusion}. Per config it emits one JSON line per mode with
+
+    - ``dispatches_per_decode_token`` on the mode's decode-serving path
+      (the acceptance number: at K=8 the fused looped mixed step must
+      spend >= 4x fewer mixed dispatches per decode token than the
+      per-token fixed mixed step),
+    - overall tokens/s at the fixed geometry, and
+    - ``tokens_identical`` — greedy streams bit-identical to the
+      fixed-path baseline of the same workload.
+
+    Engine-level on purpose (no HTTP jitter), single-threaded XLA + the
+    tiny-4l model exactly like BENCH_MIXED — at TINY scale a dispatch
+    boundary costs more than the flops it frames, which is precisely the
+    host-sync overhead kernel looping removes. Knobs: BENCH_LOOP_REPS
+    (3), BENCH_LOOP_K (8, decode_block_size = the fusion width),
+    BENCH_LOOP_PROMPTS ("128" burst prompt lengths),
+    BENCH_LOOP_TOKENS (24, the packed mixed width)."""
+    import gc
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_multi_thread_eigen=false"
+        + " intra_op_parallelism_threads=1"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+    reps = int(os.environ.get("BENCH_LOOP_REPS", "3"))
+    k_block = int(os.environ.get("BENCH_LOOP_K", "8"))
+    prompt_lens = [int(x) for x in os.environ.get(
+        "BENCH_LOOP_PROMPTS", "128").split(",") if x.strip()]
+    mixed_tokens = int(os.environ.get("BENCH_LOOP_TOKENS", "24"))
+    n_burst = 4
+    mcfg = TINY.with_overrides(
+        name="tiny-4l", hidden_size=128, intermediate_size=512,
+        num_layers=4, num_heads=8, num_kv_heads=4, head_dim=16,
+    )
+    ps = 8
+    n_chat = 3
+    # the chat budget must OUTLIVE the prompt-loading window in the
+    # fused mode (K decode tokens per dispatch): a chat that runs dry
+    # mid-burst leaves later mixed dispatches with no decode rows,
+    # muddying the per-path dispatch ratio being measured; the prompt
+    # rows themselves stop after 4 tokens so the mixed window stays
+    # dominated by the long-lived chats
+    chat_len, chat_tokens = ps, 256
+    max_pages = -(-(max(prompt_lens) + chat_tokens + 8) // ps)
+    paged = PagedCacheConfig(
+        num_pages=(n_chat + n_burst + 2) * max_pages, page_size=ps,
+        max_pages_per_seq=max_pages,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), mcfg,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(23)
+    hi = min(mcfg.vocab_size, 250)
+
+    def mk(loop: bool, mixed: bool):
+        return LLMEngine(
+            params, mcfg, ByteTokenizer(),
+            EngineConfig(
+                max_batch=n_chat + n_burst,
+                prefill_buckets=(32, 64, 128, 256),
+                paged=paged, decode_block_size=k_block, pipeline_depth=1,
+                mixed_step_tokens=mixed_tokens if mixed else 0,
+                loop_to_completion=loop, loop_max_steps=256,
+            ),
+            dtype=jnp.float32,
+        )
+
+    def run_once(engine, chats, prompts):
+        """Seat the chats, fire the prompt burst, drain. Returns
+        (toks, decode_tokens/s, decode-path dispatches, decode tokens)
+        for the burst window on."""
+        sc0 = engine.step_clock_stats()["kinds"]
+        d0 = {k: v["dispatches"] for k, v in sc0.items()}
+        toks = {}
+        n_req = len(chats) + len(prompts)
+        for i, ids in enumerate(chats):
+            engine.add_request(f"c{i}", ids, SamplingParams(
+                max_tokens=chat_tokens, temperature=0.0))
+        for i, ids in enumerate(prompts):
+            engine.add_request(f"p{i}", ids, SamplingParams(
+                max_tokens=4, temperature=0.0))
+        t0 = time.perf_counter()
+        produced = 0
+        while engine.has_work():
+            for out in engine.step():
+                if out.token_id is not None:
+                    produced += 1
+                    toks.setdefault(out.request_id, []).append(out.token_id)
+        elapsed = time.perf_counter() - t0
+        sc = engine.step_clock_stats()["kinds"]
+        # dispatches on the decode-serving path: every launch that
+        # advanced decode rows (prefill-only launches excluded)
+        decode_kinds = ("decode_block", "mixed", "loop")
+        disp = sum(sc[k]["dispatches"] - d0.get(k, 0)
+                   for k in decode_kinds if k in sc)
+        decode_toks = produced - n_req  # prefill samples each first token
+        ms = engine.mixed_stats()
+        return toks, produced / elapsed, disp, decode_toks, ms
+
+    for n in prompt_lens:
+        chats = [rng.integers(1, hi, size=chat_len).tolist()
+                 for _ in range(n_chat)]
+        prompts = [rng.integers(1, hi, size=n).tolist()
+                   for _ in range(n_burst)]
+        results = {}
+        modes = (
+            ("fixed", False, False),
+            ("loop", True, False),
+            ("fixed+mixed", False, True),
+            ("loop+mixed", True, True),
+        )
+        for mode, loop, mixed in modes:
+            engine = mk(loop, mixed)
+            tput, last = [], None
+            for r in range(reps + 1):
+                gc.collect()
+                gc.disable()
+                try:
+                    last = run_once(engine, chats, prompts)
+                finally:
+                    gc.enable()
+                toks, tp, disp, decode_toks, ms = last
+                for rid in list(toks):
+                    engine.abort(rid)
+                engine.evict_cache(0.0, drop_host_tier=True)
+                if r:  # rep 0 warms compile caches
+                    tput.append(tp)
+            toks, _, disp, decode_toks, ms = last
+            results[mode] = {
+                "toks": toks,
+                "tokens_per_sec": float(np.median(tput)),
+                "dispatches_per_decode_token": disp / max(1, decode_toks),
+                "decode_tokens": decode_toks,
+                # the acceptance ratio: mixed dispatches per decode
+                # token ADVANCED BY THE MIXED PATH (cumulative over the
+                # reps — every rep runs the identical workload)
+                "mixed_dispatches_per_decode_token": (
+                    ms["steps"] / max(1, ms["decode_tokens"])
+                    if ms else None),
+            }
+        ok = True
+        for mode in ("loop", "fixed+mixed", "loop+mixed"):
+            if results[mode]["toks"] != results["fixed"]["toks"]:
+                ok = False
+        for mode, loop, mixed in modes:
+            r = results[mode]
+            _emit({
+                "metric": "loop_dispatches_per_decode_token_cpu",
+                "value": round(r["dispatches_per_decode_token"], 4),
+                "unit": "dispatches/token",
+                "vs_baseline": 0.0,
+                "mode": mode,
+                "k_block": k_block,
+                "prompt_len": n,
+                "burst_prompts": n_burst,
+                "chat_rows": n_chat,
+                "mixed_step_tokens": mixed_tokens if mixed else 0,
+                "decode_tokens": r["decode_tokens"],
+                "tokens_per_sec": round(r["tokens_per_sec"], 2),
+                "mixed_dispatches_per_decode_token": (
+                    round(r["mixed_dispatches_per_decode_token"], 4)
+                    if r["mixed_dispatches_per_decode_token"] is not None
+                    else None),
+                "tokens_identical": ok,
+                "reps": reps,
+            })
+        if not ok:
+            print("BENCH_LOOP: token streams DIVERGED between modes",
+                  file=sys.stderr)
+            sys.exit(3)
+        fused = results["loop+mixed"]["mixed_dispatches_per_decode_token"]
+        base = results["fixed+mixed"]["mixed_dispatches_per_decode_token"]
+        if fused > base / 4.0:
+            print(
+                "BENCH_LOOP: mixed-path dispatch collapse below 4x "
+                f"({base:.3f} -> {fused:.3f} per decode token)",
+                file=sys.stderr)
+            sys.exit(4)
+
+
 def bench_telem() -> None:
     """Telemetry-overhead microbench (BENCH_TELEM=1; ISSUE 14): decode
     tokens/s through a REAL EngineRunner with the performance-telemetry
@@ -952,6 +1154,9 @@ def main() -> None:
         return
     if os.environ.get("BENCH_MIXED") == "1":
         bench_mixed()
+        return
+    if os.environ.get("BENCH_LOOP") == "1":
+        bench_loop()
         return
     if os.environ.get("BENCH_PREFIX") == "1":
         bench_prefix()

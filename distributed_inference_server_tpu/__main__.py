@@ -104,6 +104,10 @@ def main(argv=None) -> int:
         # ragged mixed-batch stepping (docs/PERF.md): one dispatch for
         # decode rows + prefill chunks while prefill work is pending
         mixed_step_tokens=cfg.get("engine", "mixed_step_tokens"),
+        # run-to-completion looped decode blocks (docs/PERF.md "Kernel
+        # Looping"): one dispatch runs to the stop condition on-device
+        loop_to_completion=cfg.get("engine", "loop_to_completion"),
+        loop_max_steps=cfg.get("engine", "loop_max_steps"),
         pp_microbatches=cfg.get("engine", "pp_microbatches"),
         cp_min_tokens=cfg.get("engine", "cp_min_tokens") or None,
         sp_impl=cfg.get("engine", "sp_impl"),

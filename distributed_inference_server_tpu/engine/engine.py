@@ -110,6 +110,39 @@ def _chosen_logprob(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     return chosen - lse
 
 
+def _device_append_pages(block_tables, bt_counts, free_pages, n_free,
+                         free_used, needed, rows, sub_rounds):
+    """Grow row block tables from the device-held page free-list inside
+    a looped decode block (kernel looping, docs/PERF.md). ``needed`` is
+    each row's target page count for its next write(s) (0 for rows that
+    must not grow); up to ``sub_rounds`` statically-unrolled passes each
+    assign at most one page per row, in row order, via a cumsum rank
+    over the rows still short. ``free_used`` indexes into
+    ``free_pages`` (sentinel-padded past ``n_free``); assignment order
+    is deterministic, so the host can replay it from the returned
+    tables alone. Rows the list cannot cover come back ``starved`` —
+    the loop freezes them with exit reason 'pages' and the host
+    re-stages them after reconciling the draw."""
+    P = block_tables.shape[1]
+    for _ in range(sub_rounds):
+        need = (bt_counts < needed) & (bt_counts < P)
+        rank = jnp.cumsum(need.astype(jnp.int32)) - 1
+        draw_idx = free_used + rank
+        got = need & (draw_idx < n_free)
+        new_page = free_pages[
+            jnp.minimum(draw_idx, free_pages.shape[0] - 1)
+        ]
+        col = jnp.minimum(bt_counts, P - 1)
+        cur = block_tables[rows, col]
+        block_tables = block_tables.at[rows, col].set(
+            jnp.where(got, new_page, cur)
+        )
+        bt_counts = bt_counts + got.astype(jnp.int32)
+        free_used = free_used + jnp.sum(got.astype(jnp.int32))
+    starved = bt_counts < needed
+    return block_tables, bt_counts, free_used, starved
+
+
 def _make_allocator(pcfg: PagedCacheConfig, force: Optional[bool],
                     need_offload_hook: bool = False):
     """Pick the page-allocator tier: the native C++ implementation
@@ -197,6 +230,26 @@ class EngineConfig:
     # Does not compose with speculative decoding or stage/seq/data mesh
     # axes (rejected at construction).
     mixed_step_tokens: int = 0
+    # run-to-completion decode blocks (Kernel Looping, docs/PERF.md;
+    # arxiv 2410.23668): decode blocks carry an on-device page free-list
+    # and run to the stop condition (EOS / budget / free-list
+    # exhaustion / loop_max_steps) inside ONE compiled lax.while_loop
+    # instead of stopping at a host-chosen decode_block_size. The host
+    # PageAllocator draws pages into a DEVICE-HELD state at launch and
+    # reconciles the device's block-table appends afterwards. Also
+    # folds the mixed step into K-block form (one ragged dispatch
+    # advances decode_block_size decode tokens per iteration while
+    # prefill chunks pack the remainder) and lifts the
+    # mixed-vs-speculation exclusion (draft+verify compose inside the
+    # same looped program). Greedy tokens are bit-identical to the
+    # fixed-K path (tests/test_engine_loop.py).
+    loop_to_completion: bool = False
+    # per-launch iteration cap for looped blocks so a runaway row cannot
+    # starve admission or hold the device carry forever: a block that
+    # hits the cap simply resumes at the next engine step. Degradation
+    # rungs shrink the effective cap (set_loop_cap_frac) like they
+    # shrink the mixed prefill frac.
+    loop_max_steps: int = 256
     # GPipe microbatches per forward when the mesh has a stage axis
     # (pipeline parallelism, parallel/pp.py); must divide max_batch and
     # prefill_batch
@@ -454,11 +507,17 @@ class LLMEngine:
                     "packed width holds every decode slot plus at least "
                     "one prefill token"
                 )
-            if draft_params is not None:
+            if draft_params is not None and not self.ecfg.loop_to_completion:
+                # under loop_to_completion the exclusion lifts: mixed
+                # iterations advance decode rows one PLAIN token while a
+                # prompt backlog exists (greedy spec ≡ greedy plain, so
+                # token identity holds), and once the backlog drains the
+                # looped spec block owns the carry gamma+1 at a time
                 raise ValueError(
                     "mixed_step_tokens does not compose with speculative "
                     "decoding: the mixed step owns the decode carry one "
-                    "token at a time, the spec block gamma+1 at a time"
+                    "token at a time, the spec block gamma+1 at a time "
+                    "(set engine.loop_to_completion to compose them)"
                 )
             if mesh is not None and (
                 mesh.shape.get("stage", 1) > 1
@@ -470,6 +529,11 @@ class LLMEngine:
                     "tensor-axis meshes only (the ragged attend shards "
                     "heads; stage/seq/data axes take the quantum path)"
                 )
+        if self.ecfg.loop_to_completion and self.ecfg.loop_max_steps < 1:
+            raise ValueError(
+                f"loop_max_steps must be >= 1, got "
+                f"{self.ecfg.loop_max_steps}"
+            )
         self.draft_state = (
             PagedKVState.create(draft_cfg, self.pcfg, dtype=dtype,
                                 kv_quant=kvq)
@@ -662,6 +726,19 @@ class LLMEngine:
         self._mixed_prefill_tokens = 0
         self._mixed_decode_tokens = 0
         self._mixed_density_sum = 0.0
+        # run-to-completion looped blocks (EngineConfig.loop_to_completion;
+        # kernel looping): compiled per effective iteration cap (the
+        # degradation ladder shrinks it), spec variants per (use_topp,
+        # cap). Host-side counters feed engine_loop_steps_total /
+        # engine_loop_exit_total via loop_stats() — the runner
+        # delta-reports them like the mixed block.
+        self._loop_fns: Dict[int, Callable] = {}
+        self._spec_loop_fns: Dict[Tuple[bool, int], Callable] = {}
+        self._loop_cap_frac = 1.0
+        self._loop_blocks = 0
+        self._loop_steps = 0
+        self._loop_decode_tokens = 0
+        self._loop_exits = {"eos": 0, "budget": 0, "pages": 0, "cap": 0}
         # engine step clock (docs/OBSERVABILITY.md "Performance
         # telemetry"): host-side wall time, dispatch counts, tokens and
         # batch rows per dispatch kind, plus step-loop pressure events.
@@ -671,7 +748,7 @@ class LLMEngine:
         # block, and drains _sc_samples into the windowed digests.
         self._sc_kinds: Dict[str, Dict[str, float]] = {
             k: {"dispatches": 0, "wall_s": 0.0, "tokens": 0, "rows": 0}
-            for k in ("prefill", "decode_block", "mixed")
+            for k in ("prefill", "decode_block", "mixed", "loop")
         }
         self._sc_events: Dict[str, int] = {
             "cache_full": 0, "preempt": 0, "reclaim": 0, "retrace": 0,
@@ -753,7 +830,16 @@ class LLMEngine:
         every seated decode row advances one token while the prefill
         backlog consumes the packed budget's remainder — a long prompt
         no longer stalls in-flight decodes for a full quantum. With no
-        prefill backlog, decode runs the K-step block path unchanged."""
+        prefill backlog, decode runs the K-step block path unchanged.
+
+        With ``loop_to_completion`` set, pure-decode iterations run as
+        run-to-completion looped blocks instead of fixed-K blocks: ONE
+        dispatch per launch that keeps stepping on-device — growing row
+        block tables from a device-held page free-list — until every
+        row hits EOS / its budget / free-list exhaustion or the
+        iteration cap. Looped blocks do not pipeline (the loop already
+        amortizes the host round-trip over its whole run); they are
+        processed synchronously right after the dispatch returns."""
         outputs: List[StepOutput] = []
         self._prof_begin()
         self._admit(outputs)
@@ -763,6 +849,9 @@ class LLMEngine:
             for s in self.slots
         ):
             launched = self._mixed_step(outputs)
+        elif self.ecfg.loop_to_completion:
+            self._prefill_quantum(outputs)
+            launched = self._loop_step(outputs)
         else:
             self._prefill_quantum(outputs)
             launched = self._maybe_launch(outputs)
@@ -1882,6 +1971,37 @@ class LLMEngine:
             "prefill_frac": self._mixed_prefill_frac,
         }
 
+    def set_loop_cap_frac(self, frac: float) -> None:
+        """Degradation-ladder hook (serving/degradation.py): shrink the
+        looped block's iteration cap under memory pressure so page draws
+        stay small and admission gets the device back sooner — the loop
+        analogue of set_mixed_prefill_frac. Engine-thread only (the
+        runner posts it); floor 0.05 so decode always progresses."""
+        self._loop_cap_frac = min(1.0, max(0.05, float(frac)))
+
+    def _loop_cap(self) -> int:
+        """Effective iteration cap for the next looped block: the
+        configured loop_max_steps scaled by the degradation ladder's
+        fraction, never below one step."""
+        return max(1, int(self.ecfg.loop_max_steps * self._loop_cap_frac))
+
+    def loop_stats(self) -> Optional[Dict[str, object]]:
+        """Looped-block traffic snapshot for /metrics and the
+        /server/stats engine block; None when loop_to_completion is off.
+        ``steps`` counts device loop iterations (the dispatch-amortized
+        unit the fixed-K path pays one host round-trip per block for);
+        ``exits`` counts per-row stop reasons at block reconcile."""
+        if not self.ecfg.loop_to_completion:
+            return None
+        return {
+            "blocks": self._loop_blocks,
+            "steps": self._loop_steps,
+            "decode_tokens": self._loop_decode_tokens,
+            "exits": dict(self._loop_exits),
+            "cap": self._loop_cap(),
+            "cap_frac": self._loop_cap_frac,
+        }
+
     # ------------------------------------------------------------------
     # engine step clock (docs/OBSERVABILITY.md "Performance telemetry")
     # ------------------------------------------------------------------
@@ -1997,6 +2117,14 @@ class LLMEngine:
             )
             return False
 
+    def _mixed_block_k(self) -> int:
+        """Decode tokens one mixed dispatch advances: decode_block_size
+        under loop_to_completion (K-block fusion — the mixed path's
+        dispatch count per decode token drops K×), 1 otherwise (the
+        original per-token mixed step)."""
+        return (self.ecfg.decode_block_size
+                if self.ecfg.loop_to_completion else 1)
+
     def _get_mixed_fn(self) -> Callable:
         if self._mixed_fn is None:
             self._event("retrace")
@@ -2014,15 +2142,26 @@ class LLMEngine:
         advances the decode carry one token with the block path's exact
         EOS/budget freeze law. The host sees [1, B] decode ids (the same
         pending-block framing as the K-step path) plus [Bp] first-token
-        candidates it reaps only for prompts that completed."""
+        candidates it reaps only for prompts that completed.
+
+        Under ``loop_to_completion`` the mixed step runs in K-BLOCK form
+        (kernel looping, docs/PERF.md): after the packed ragged forward,
+        K-1 additional plain decode steps (the fixed block's exact
+        one_step math) advance the decode carry inside the SAME program,
+        so the mixed path pays one dispatch per K decode tokens instead
+        of one per token. The host sees [K, B] ids on the same pending
+        frame; prefill chunks still land once per dispatch."""
         cfg = self.cfg
         impl = self._resolved_mixed_impl()
         ps = self.pcfg.page_size
         S = self.ecfg.mixed_step_tokens
         B = self.ecfg.max_batch
         Bp = min(self.ecfg.prefill_batch, S - B)
+        K = self._mixed_block_k()
         num_slots = self._num_slots_flat
         moe_impl = self._moe_impl()
+        impl_blk = self._resolved_impl()
+        fwd = self._fwd
         mesh = self.mesh
         eos = jnp.asarray(sorted(self.tok.eos_ids), jnp.int32)
 
@@ -2091,7 +2230,68 @@ class LLMEngine:
             steps_left = jnp.where(active, steps_left - 1, steps_left)
             tokens = jnp.where(active, d_next, tokens)
             active = active & ~is_eos & (steps_left > 0)
-            return (out[None], d_lp[None], p_next, p_lp, tokens,
+            outs_all = out[None]
+            lps_all = d_lp[None]
+            if K > 1:
+                # K-block fusion (loop_to_completion): K-1 extra plain
+                # decode steps on the decode rows — the fixed block's
+                # one_step verbatim, over the [:B] slice of the packed
+                # tables — inside this same dispatch
+                gather_d = gather[:B]
+
+                def one_step(carry, _):
+                    (tokens, positions, steps_left, active,
+                     pool_k, pool_v, rng) = carry
+                    page = block_tables[rows, positions // ps]
+                    write = jnp.where(
+                        active, page * ps + positions % ps, num_slots
+                    )[:, None]
+                    kv_valid = jnp.where(active, positions + 1, 0)
+                    logits, pool_k, pool_v = fwd(
+                        params, cfg, tokens[:, None], positions[:, None],
+                        pool_k, pool_v, write, gather_d, kv_valid,
+                        impl_blk, moe_impl,
+                    )
+                    rng, sub = jax.random.split(rng)
+                    nxt2 = lax.switch(
+                        sample_mode,
+                        [
+                            lambda a: jnp.argmax(a[1], -1).astype(
+                                jnp.int32),
+                            lambda a: sample_tokens(a[0], a[1], a[2],
+                                                    a[3], use_topp=False),
+                            lambda a: sample_tokens(a[0], a[1], a[2],
+                                                    a[3], use_topp=True),
+                        ],
+                        (sub, logits[:, 0], temp, top_p),
+                    )
+                    lp2 = _chosen_logprob(logits[:, 0], nxt2)
+                    out2 = jnp.where(active, nxt2, -1)
+                    is_eos2 = (
+                        (nxt2[:, None] == eos[None, :]).any(-1)
+                        if eos.size
+                        else jnp.zeros_like(active)
+                    )
+                    positions = jnp.where(active, positions + 1,
+                                          positions)
+                    steps_left = jnp.where(active, steps_left - 1,
+                                           steps_left)
+                    tokens = jnp.where(active, nxt2, tokens)
+                    active = active & ~is_eos2 & (steps_left > 0)
+                    return (tokens, positions, steps_left, active,
+                            pool_k, pool_v, rng), (out2, lp2)
+
+                carry, (outs_rest, lps_rest) = lax.scan(
+                    one_step,
+                    (tokens, positions, steps_left, active,
+                     pool_k, pool_v, rng),
+                    None, length=K - 1,
+                )
+                (tokens, positions, steps_left, active,
+                 pool_k, pool_v, rng) = carry
+                outs_all = jnp.concatenate([outs_all, outs_rest], 0)
+                lps_all = jnp.concatenate([lps_all, lps_rest], 0)
+            return (outs_all, lps_all, p_next, p_lp, tokens,
                     positions, steps_left, active, pool_k, pool_v, rng)
 
         return self._with_mesh(mixed)
@@ -2111,6 +2311,7 @@ class LLMEngine:
         Bp = min(self.ecfg.prefill_batch, Sp)
         ps = self.pcfg.page_size
         P = self.pcfg.max_pages_per_seq
+        K = self._mixed_block_k()
 
         def mid_prefill(s: _Seq) -> bool:
             return s.next_token is None and s.seq_len < len(s.token_ids)
@@ -2127,8 +2328,13 @@ class LLMEngine:
             for i, s in enumerate(self.slots):
                 if s is not None:
                     self._reclaim_window_pages(s)
+            # K-block fusion (loop_to_completion): each dispatch advances
+            # up to K decode tokens per row; pages are pre-allocated for
+            # the full advance (exact for active rows — plain steps emit
+            # what they assume unless frozen, and frozen rows stop
+            # writing)
             advs = {
-                id(s): (1 if s.dev_steps_left > 0 else 0)
+                id(s): min(K, max(0, s.dev_steps_left))
                 for _, s in decode_seated
             }
             try:
@@ -2855,6 +3061,617 @@ class LLMEngine:
                     pool_k, pool_v, rng)
 
         return self._with_mesh(block)
+
+    # ------------------------------------------------------------------
+    # run-to-completion looped blocks (EngineConfig.loop_to_completion;
+    # Kernel Looping, docs/PERF.md)
+    # ------------------------------------------------------------------
+
+    def _get_loop_fn(self, cap: int) -> Callable:
+        fn = self._loop_fns.get(cap)
+        if fn is None:
+            self._event("retrace")
+            fn = self._build_loop_block(cap)
+            self._loop_fns[cap] = fn
+        return fn
+
+    def _get_spec_loop_fn(self, use_topp: bool, cap: int) -> Callable:
+        fn = self._spec_loop_fns.get((use_topp, cap))
+        if fn is None:
+            self._event("retrace")
+            fn = self._build_spec_loop_block(use_topp, cap)
+            self._spec_loop_fns[(use_topp, cap)] = fn
+        return fn
+
+    def _build_loop_block(self, cap: int) -> Callable:
+        """Compile the run-to-completion decode block: a ``lax.while_loop``
+        whose body is EXACTLY the fixed-K block's per-step math (same
+        gather/write/kv_valid arithmetic, same sampler switch, same
+        ``active & ~is_eos & (steps_left > 0)`` freeze law — greedy
+        tokens are bit-identical, tests/test_engine_loop.py), prefixed
+        by an on-device page append: rows whose next write crosses a
+        page boundary take the next page off the device-held free list
+        and grow their block table inside the loop, so no host-chosen K
+        bounds the run. The loop exits when every row froze (EOS /
+        budget / free-list exhaustion) or after ``cap`` iterations; a
+        per-row exit code (1=eos 2=budget 3=pages 4=cap) and the final
+        tables come back for host reconcile. Output buffers are
+        preallocated [cap, B] with the fixed path's -1 freeze sentinel."""
+        cfg = self.cfg
+        impl = self._resolved_impl()
+        ps = self.pcfg.page_size
+        num_slots = self._num_slots_flat
+        moe_impl = self._moe_impl()
+        fwd = self._fwd
+        eos = jnp.asarray(sorted(self.tok.eos_ids), jnp.int32)
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6, 10))
+        def loop_block(params, pool_k, pool_v, tokens, positions,
+                       steps_left, active, block_tables, temp, top_p, rng,
+                       set_mask, set_active, set_tokens, set_positions,
+                       set_steps, bt_counts, free_pages, n_free,
+                       sample_mode):
+            # merge host overrides (admissions / deactivations) into carry
+            tokens = jnp.where(set_mask, set_tokens, tokens)
+            positions = jnp.where(set_mask, set_positions, positions)
+            steps_left = jnp.where(set_mask, set_steps, steps_left)
+            active = jnp.where(set_mask, set_active, active)
+
+            B = tokens.shape[0]
+            rows = jnp.arange(B)
+            offs = jnp.arange(block_tables.shape[1] * ps, dtype=jnp.int32)
+
+            def cond(st):
+                return (st[0] < cap) & st[4].any()
+
+            def body(st):
+                (k, tokens, positions, steps_left, active, block_tables,
+                 bt_counts, free_used, exit_code, outs, lps_buf,
+                 pool_k, pool_v, rng) = st
+                # --- on-device page append: a row whose write position
+                # entered an unallocated page takes the next free-list
+                # page; rows the list cannot cover freeze (reason 3) ---
+                needed = jnp.where(active, positions // ps + 1, 0)
+                (block_tables, bt_counts, free_used,
+                 starved) = _device_append_pages(
+                    block_tables, bt_counts, free_pages, n_free,
+                    free_used, needed, rows, 1,
+                )
+                exit_code = jnp.where(
+                    starved & (exit_code == 0), 3, exit_code
+                )
+                active = active & ~starved
+
+                # --- one decode step: the fixed block's exact math
+                # (gather recomputed per iteration because the tables
+                # grow; entries past kv_valid are never attended, so
+                # the numerics match the fixed path bit-for-bit) ---
+                gather = block_tables[:, offs // ps] * ps + offs % ps
+                page = block_tables[rows, positions // ps]
+                write = jnp.where(
+                    active, page * ps + positions % ps, num_slots
+                )[:, None]
+                kv_valid = jnp.where(active, positions + 1, 0)
+                logits, pool_k, pool_v = fwd(
+                    params, cfg, tokens[:, None], positions[:, None],
+                    pool_k, pool_v, write, gather, kv_valid, impl,
+                    moe_impl,
+                )
+                rng, sub = jax.random.split(rng)
+                nxt = lax.switch(
+                    sample_mode,
+                    [
+                        lambda a: jnp.argmax(a[1], -1).astype(jnp.int32),
+                        lambda a: sample_tokens(a[0], a[1], a[2], a[3],
+                                                use_topp=False),
+                        lambda a: sample_tokens(a[0], a[1], a[2], a[3],
+                                                use_topp=True),
+                    ],
+                    (sub, logits[:, 0], temp, top_p),
+                )
+                lp = _chosen_logprob(logits[:, 0], nxt)
+                out = jnp.where(active, nxt, -1)
+                is_eos = (
+                    (nxt[:, None] == eos[None, :]).any(-1)
+                    if eos.size
+                    else jnp.zeros_like(active)
+                )
+                positions = jnp.where(active, positions + 1, positions)
+                steps_left = jnp.where(active, steps_left - 1, steps_left)
+                tokens = jnp.where(active, nxt, tokens)
+                was_active = active
+                active = active & ~is_eos & (steps_left > 0)
+                froze = was_active & ~active
+                exit_code = jnp.where(
+                    froze & is_eos & (exit_code == 0), 1, exit_code
+                )
+                exit_code = jnp.where(
+                    froze & ~is_eos & (exit_code == 0), 2, exit_code
+                )
+                outs = lax.dynamic_update_index_in_dim(outs, out, k, 0)
+                lps_buf = lax.dynamic_update_index_in_dim(lps_buf, lp, k, 0)
+                return (k + 1, tokens, positions, steps_left, active,
+                        block_tables, bt_counts, free_used, exit_code,
+                        outs, lps_buf, pool_k, pool_v, rng)
+
+            st = lax.while_loop(cond, body, (
+                jnp.asarray(0, jnp.int32), tokens, positions, steps_left,
+                active, block_tables, bt_counts,
+                jnp.asarray(0, jnp.int32), jnp.zeros((B,), jnp.int32),
+                jnp.full((cap, B), -1, jnp.int32),
+                jnp.zeros((cap, B), jnp.float32),
+                pool_k, pool_v, rng,
+            ))
+            (n_steps, tokens, positions, steps_left, active, block_tables,
+             bt_counts, free_used, exit_code, outs, lps_buf,
+             pool_k, pool_v, rng) = st
+            exit_code = jnp.where(active & (exit_code == 0), 4, exit_code)
+            return (outs, lps_buf, exit_code, n_steps, block_tables,
+                    bt_counts, tokens, positions, steps_left, active,
+                    pool_k, pool_v, rng)
+
+        return self._with_mesh(loop_block)
+
+    def _build_spec_loop_block(self, use_topp: bool, cap: int) -> Callable:
+        """Compile the speculative run-to-completion block: draft+verify
+        rounds (the fixed spec block's exact round body — draft gamma
+        proposals, ONE gamma+1 verify forward, shared rejection
+        sampling) inside a ``lax.while_loop``, with the same on-device
+        page append as the plain loop block growing each row's table to
+        cover the round's gamma+1 writes before they happen. One
+        compiled program replaces the fixed path's two-dispatches-per-
+        round; ``cap`` device steps round up to ceil(cap / (gamma+1))
+        rounds. Greedy rows stay bit-identical to plain decoding (the
+        accept law is exact-match and key-independent under argmax)."""
+        cfg, dcfg = self.cfg, self.draft_cfg
+        impl = self._resolved_impl()
+        ps = self.pcfg.page_size
+        gamma = self.spec.num_draft_tokens
+        W = gamma + 1
+        rounds = max(1, -(-cap // W))
+        # pages one round can demand beyond a row's table: its W writes
+        # span at most W//ps + 1 pages, +1 covers a mid-page start
+        sub_rounds = W // ps + 2
+        smax = self._smax
+        num_slots = self._num_slots_flat
+        moe_impl = self._moe_impl()
+        fwd = self._fwd
+        eos = jnp.asarray(sorted(self.tok.eos_ids), jnp.int32)
+
+        @functools.partial(
+            jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 14)
+        )
+        def loop_block(params, dparams, pool_k, pool_v, dpool_k, dpool_v,
+                       tokens, positions, steps_left, active, block_tables,
+                       temp, top_p, spec_ok, rng,
+                       set_mask, set_active, set_tokens, set_positions,
+                       set_steps, bt_counts, free_pages, n_free, any_temp):
+            tokens = jnp.where(set_mask, set_tokens, tokens)
+            positions = jnp.where(set_mask, set_positions, positions)
+            steps_left = jnp.where(set_mask, set_steps, steps_left)
+            active = jnp.where(set_mask, set_active, active)
+
+            B = tokens.shape[0]
+            rows = jnp.arange(B)
+            offs = jnp.arange(block_tables.shape[1] * ps, dtype=jnp.int32)
+            max_pages = block_tables.shape[1]
+
+            def cond(st):
+                return (st[0] < rounds) & st[4].any()
+
+            def body(st):
+                (k, tokens, positions, steps_left, active, block_tables,
+                 bt_counts, free_used, exit_code, toks_buf, lps_buf,
+                 counts_buf, acc_buf, prop_buf,
+                 pool_k, pool_v, dpool_k, dpool_v, rng) = st
+                # --- page append covering this round's W writes ---
+                last_pos = jnp.minimum(positions + W - 1, smax - 1)
+                needed = jnp.where(active, last_pos // ps + 1, 0)
+                (block_tables, bt_counts, free_used,
+                 starved) = _device_append_pages(
+                    block_tables, bt_counts, free_pages, n_free,
+                    free_used, needed, rows, sub_rounds,
+                )
+                exit_code = jnp.where(
+                    starved & (exit_code == 0), 3, exit_code
+                )
+                active = active & ~starved
+
+                gather = block_tables[:, offs // ps] * ps + offs % ps
+
+                def flat_slot(pos):
+                    page = block_tables[
+                        rows, jnp.minimum(pos // ps, max_pages - 1)
+                    ]
+                    return page * ps + pos % ps
+
+                rng, sub = jax.random.split(rng)
+                keys = jax.random.split(sub, gamma + 3)
+
+                def dstep(c, key):
+                    dpk, dpv, tok, pos = c
+                    ok = active & (pos < smax)
+                    write = jnp.where(
+                        ok, flat_slot(pos), num_slots
+                    )[:, None]
+                    kv_valid = jnp.where(active, pos + 1, 0)
+                    logits, dpk, dpv = fwd(
+                        dparams, dcfg, tok[:, None], pos[:, None],
+                        dpk, dpv, write, gather, kv_valid, impl, "dense",
+                    )
+                    q = spec_probs(logits[:, 0], temp)
+                    if use_topp:
+                        q = spec_nucleus(q, top_p)
+                    nxt = lax.cond(
+                        any_temp,
+                        lambda a: jax.random.categorical(
+                            a[0], jnp.log(a[1] + 1e-30), axis=-1
+                        ).astype(jnp.int32),
+                        lambda a: jnp.argmax(a[1], -1).astype(jnp.int32),
+                        (key, q),
+                    )
+                    return (dpk, dpv, nxt, pos + 1), (nxt, q)
+
+                (dpool_k, dpool_v, _, _), (dtoks, dqs) = lax.scan(
+                    dstep, (dpool_k, dpool_v, tokens, positions),
+                    keys[: gamma + 1],
+                )
+                dtoks = dtoks.T[:, :gamma]
+                dqs = jnp.moveaxis(dqs, 0, 1)[:, :gamma]
+
+                ver_tokens = jnp.concatenate([tokens[:, None], dtoks], 1)
+                ver_pos = positions[:, None] + jnp.arange(W)[None]
+                ok = active[:, None] & (ver_pos < smax)
+                vpage = block_tables[
+                    rows[:, None],
+                    jnp.minimum(ver_pos // ps, max_pages - 1),
+                ]
+                write = jnp.where(ok, vpage * ps + ver_pos % ps, num_slots)
+                kv_valid = jnp.where(active, positions + W, 0)
+                logits, pool_k, pool_v = fwd(
+                    params, cfg, ver_tokens, ver_pos, pool_k, pool_v,
+                    write, gather, kv_valid, impl, moe_impl,
+                )
+                tps = spec_probs(logits, temp[:, None])
+                x32 = logits.astype(jnp.float32)
+                lse = jax.scipy.special.logsumexp(x32, axis=-1)
+
+                toks_out, num_accepted = spec_accept_resample(
+                    tps, dtoks, dqs, keys[gamma + 1], keys[gamma + 2],
+                    spec_ok=spec_ok,
+                    top_p=top_p if use_topp else None,
+                    greedy_only=~any_temp,
+                )
+                idx = jnp.arange(W)[None]
+                base = num_accepted + 1
+                is_eos = (
+                    (toks_out[..., None] == eos[None, None, :]).any(-1)
+                    if eos.size
+                    else jnp.zeros(toks_out.shape, bool)
+                ) & (idx < base[:, None])
+                has_eos = is_eos.any(-1)
+                first_eos = jnp.argmax(is_eos, axis=-1)
+                emitted = jnp.where(
+                    has_eos, jnp.minimum(base, first_eos + 1), base
+                )
+                emitted = jnp.where(active, emitted, 0)
+                acc_out = jnp.where(active & spec_ok, num_accepted, 0)
+                prop_out = jnp.where(active & spec_ok, gamma, 0)
+                toks_out = jnp.where(
+                    (idx < emitted[:, None]) & active[:, None],
+                    toks_out, -1,
+                )
+                lp_out = jnp.take_along_axis(
+                    x32, jnp.maximum(toks_out, 0)[..., None], axis=-1
+                )[..., 0] - lse
+                new_last = toks_out[rows, jnp.maximum(emitted, 1) - 1]
+                tokens = jnp.where(
+                    active & (emitted > 0), new_last, tokens
+                )
+                positions = positions + emitted
+                steps_left = steps_left - emitted
+                was_active = active
+                active = active & ~has_eos & (steps_left > 0)
+                froze = was_active & ~active
+                exit_code = jnp.where(
+                    froze & has_eos & (exit_code == 0), 1, exit_code
+                )
+                exit_code = jnp.where(
+                    froze & ~has_eos & (exit_code == 0), 2, exit_code
+                )
+                toks_buf = lax.dynamic_update_index_in_dim(
+                    toks_buf, toks_out, k, 0)
+                lps_buf = lax.dynamic_update_index_in_dim(
+                    lps_buf, lp_out, k, 0)
+                counts_buf = lax.dynamic_update_index_in_dim(
+                    counts_buf, emitted, k, 0)
+                acc_buf = lax.dynamic_update_index_in_dim(
+                    acc_buf, acc_out, k, 0)
+                prop_buf = lax.dynamic_update_index_in_dim(
+                    prop_buf, prop_out, k, 0)
+                return (k + 1, tokens, positions, steps_left, active,
+                        block_tables, bt_counts, free_used, exit_code,
+                        toks_buf, lps_buf, counts_buf, acc_buf, prop_buf,
+                        pool_k, pool_v, dpool_k, dpool_v, rng)
+
+            st = lax.while_loop(cond, body, (
+                jnp.asarray(0, jnp.int32), tokens, positions, steps_left,
+                active, block_tables, bt_counts,
+                jnp.asarray(0, jnp.int32), jnp.zeros((B,), jnp.int32),
+                jnp.full((rounds, B, W), -1, jnp.int32),
+                jnp.zeros((rounds, B, W), jnp.float32),
+                jnp.zeros((rounds, B), jnp.int32),
+                jnp.zeros((rounds, B), jnp.int32),
+                jnp.zeros((rounds, B), jnp.int32),
+                pool_k, pool_v, dpool_k, dpool_v, rng,
+            ))
+            (n_rounds, tokens, positions, steps_left, active, block_tables,
+             bt_counts, free_used, exit_code, toks_buf, lps_buf,
+             counts_buf, acc_buf, prop_buf,
+             pool_k, pool_v, dpool_k, dpool_v, rng) = st
+            exit_code = jnp.where(active & (exit_code == 0), 4, exit_code)
+            return (toks_buf, lps_buf, counts_buf, acc_buf, prop_buf,
+                    exit_code, n_rounds, block_tables, bt_counts,
+                    tokens, positions, steps_left, active,
+                    pool_k, pool_v, dpool_k, dpool_v, rng)
+
+        return self._with_mesh(loop_block)
+
+    def _loop_step(self, outputs: List[StepOutput]) -> bool:
+        """Launch ONE run-to-completion block and reconcile it
+        synchronously (looped blocks do not pipeline: the loop itself
+        amortizes the host round-trip over its whole run, and processing
+        immediately keeps the host view exact for admission/preemption).
+        Page pressure drains/preempts exactly like _maybe_launch; the
+        host guarantees only each row's FIRST write host-side (the
+        livelock guard — every launched row advances at least one step),
+        then sizes a device free-list draw for the worst-case remainder
+        and reconciles claimed/returned pages with the allocator
+        afterwards."""
+        if self._pending:
+            # fixed/mixed frames from earlier iterations reconcile first
+            # so slots, dev_pos and the carry projection are exact
+            self._drain_pending(outputs)
+        sc_t0 = time.monotonic()
+        sc_excl = 0.0
+        cap = self._loop_cap()
+        use_spec = False
+        while True:
+            seated = [(i, s) for i, s in enumerate(self.slots)
+                      if s is not None]
+            if not any(u[0] for u in self._slot_updates.values()) and not any(
+                s.dev_steps_left > 0 for _, s in seated
+            ):
+                return False
+            use_spec, spec_ok = self._spec_plan(seated)
+            for _, s in seated:
+                self._reclaim_window_pages(s)
+            W = self.spec.num_draft_tokens + 1 if use_spec else 1
+            try:
+                for _, s in seated:
+                    if s.dev_steps_left > 0:
+                        self._ensure_block_pages(s, W)
+                break
+            except CacheFull:
+                self._event("cache_full")
+                if self._pending:
+                    drain_t0 = time.monotonic()
+                    self._drain_pending(outputs)
+                    sc_excl += time.monotonic() - drain_t0
+                    continue
+                if seated:
+                    self._preempt_youngest(outputs)
+                    continue
+                return False
+        ps = self.pcfg.page_size
+        P = self.pcfg.max_pages_per_seq
+        gamma = self.spec.num_draft_tokens if use_spec else 0
+        advs: Dict[int, int] = {}
+        want = 0
+        for i, s in seated:
+            if s.dev_steps_left <= 0:
+                advs[id(s)] = 0
+                continue
+            if use_spec:
+                adv = min(max(1, -(-cap // W)) * W, s.dev_steps_left + gamma)
+            else:
+                adv = min(cap, s.dev_steps_left)
+            advs[id(s)] = adv
+            needed = min((s.dev_pos + adv - 1) // ps + 1, P)
+            want += max(0, needed - len(s.block_table))
+        drawn = self.allocator.draw_device(want) if want > 0 else []
+        free_arr = np.full((self.pcfg.num_pages,), self.pcfg.num_pages,
+                           np.int32)
+        free_arr[: len(drawn)] = drawn
+        for i, s in seated:
+            if self._bt_pages[i] != len(s.block_table):
+                self._refresh_bt_row(i, s)
+        # snapshot records each row's table length at launch so the
+        # reconcile can read the device's appends off the returned table
+        snapshot = [(i, s, advs[id(s)], len(s.block_table))
+                    for i, s in seated]
+        injects = self._drain_slot_updates()
+        tokens, positions, steps_left, active, rng = self._carry
+        # the loop appends pages at ANY index, so the uploaded table
+        # keeps full capacity width (no gather bucketing; attention is
+        # kv_valid-masked either way)
+        uploads = (
+            jnp.asarray(np.ascontiguousarray(self._bt)),
+            jnp.asarray(self._temp),
+            jnp.asarray(self._topp),
+        )
+        use_topp = any(
+            s.params.top_p < 1.0 and s.params.temperature > 0.0
+            for _, s in seated
+        )
+        any_temp = any(s.params.temperature > 0.0 for _, s in seated)
+        sample_mode = 2 if use_topp else (1 if any_temp else 0)
+        loop_extras = (
+            jnp.asarray(self._bt_pages), jnp.asarray(free_arr),
+            jnp.asarray(len(drawn), jnp.int32),
+        )
+        if use_spec:
+            ok_arr = np.zeros((self.ecfg.max_batch,), bool)
+            for i, _ in seated:
+                ok_arr[i] = spec_ok is None or spec_ok.get(i, True)
+            (toks, lps, counts, acc, prop, codes, n_steps, tbl, cnt,
+             tokens, positions, steps_left, active,
+             self.state.k, self.state.v,
+             self.draft_state.k, self.draft_state.v,
+             rng) = self._get_spec_loop_fn(use_topp, cap)(
+                self.params, self.draft_params,
+                self.state.k, self.state.v,
+                self.draft_state.k, self.draft_state.v,
+                tokens, positions, steps_left, active,
+                *uploads, jnp.asarray(ok_arr), rng, *injects,
+                *loop_extras, jnp.asarray(any_temp),
+            )
+        else:
+            (toks, lps, codes, n_steps, tbl, cnt,
+             tokens, positions, steps_left, active,
+             self.state.k, self.state.v, rng) = self._get_loop_fn(cap)(
+                self.params, self.state.k, self.state.v,
+                tokens, positions, steps_left, active,
+                *uploads, rng, *injects, *loop_extras,
+                jnp.asarray(sample_mode, jnp.int32),
+            )
+            counts = acc = prop = None
+        self._carry = (tokens, positions, steps_left, active, rng)
+        for _, s in seated:
+            adv = advs[id(s)]
+            s.dev_pos += adv
+            s.dev_steps_left -= adv
+        emitted = self._process_loop_block(
+            toks, lps, counts, acc, prop, codes, n_steps, tbl, cnt,
+            snapshot, drawn, outputs,
+        )
+        self._clock("loop",
+                    max(0.0, time.monotonic() - sc_t0 - sc_excl),
+                    tokens=emitted, rows=len(seated), dispatches=1)
+        return True
+
+    def _process_loop_block(self, toks_d, lps_d, counts_d, acc_d, prop_d,
+                            codes_d, steps_d, tbl_d, cnt_d, snapshot,
+                            drawn: List[int],
+                            outputs: List[StepOutput]) -> int:
+        """Reconcile one looped block. Page settlement comes FIRST:
+        device-appended pages join live rows' block tables (so a row the
+        emission walk finishes releases them through _finish ->
+        _release_seq like any other page), appends on rows aborted
+        mid-flight are orphans, and orphans plus the draw's unused tail
+        go back to the allocator via reconcile_device — audit()
+        conservation holds again the moment this returns. Then the
+        fixed path's emission walk runs unchanged (freeze sentinels,
+        spec counts, failure isolation, assumed-vs-emitted reconcile),
+        rows frozen for pages (exit 3) re-stage for the next launch,
+        and the per-row exit codes feed engine_loop_exit_total. The
+        np.asarray calls below are the block-boundary device reads;
+        nothing else here may touch the device (distlint DL007)."""
+        toks = np.asarray(toks_d)
+        lps = np.asarray(lps_d)
+        codes = np.asarray(codes_d)
+        n_steps = int(np.asarray(steps_d))
+        tbl = np.asarray(tbl_d)
+        cnt = np.asarray(cnt_d)
+        # --- page settlement (before the walk: _finish must see the
+        # device-grown tables to free them) ---
+        claimed: List[int] = []
+        for slot, seq, _, n0 in snapshot:
+            n1 = int(cnt[slot])
+            if n1 <= n0:
+                continue
+            pages = [int(p) for p in tbl[slot, n0:n1]]
+            if self._by_id.get(seq.request_id) is seq:
+                claimed.extend(pages)
+                seq.block_table.extend(pages)
+            # aborted rows' appends fall through to the returned list:
+            # their KV is garbage (same safety argument as abort's
+            # in-flight block writes) and the pages go straight back
+        claimed_set = set(claimed)
+        returned = [p for p in drawn if p not in claimed_set]
+        if drawn:
+            self.allocator.reconcile_device(claimed, returned)
+        if counts_d is None:
+            toks3 = toks[:, :, None]
+            lps3 = lps[:, :, None]
+            counts = (toks >= 0).astype(np.int32)
+        else:
+            toks3 = toks
+            lps3 = lps
+            counts = np.asarray(counts_d)
+            if self.spec_trackers is not None:
+                prop_arr = np.asarray(prop_d)
+                acc_arr = np.asarray(acc_d)
+                agg: Dict[tuple, list] = {}
+                for slot, seq, _, _ in snapshot:
+                    p = int(prop_arr[:, slot].sum())
+                    if p <= 0:
+                        continue
+                    a = agg.setdefault(spec_signature(seq.params),
+                                       [0, 0, 0])
+                    a[0] += int(acc_arr[:, slot].sum())
+                    a[1] += p
+                    a[2] += int((prop_arr[:, slot] > 0).sum())
+                for sig, (acc_n, prop_n, rows_n) in agg.items():
+                    self.spec_trackers.update(
+                        sig, acc_n, prop_n, rows=rows_n
+                    )
+        R = toks3.shape[0]
+        sc_emitted = 0
+        for slot, seq, assumed, _ in snapshot:
+            if self._by_id.get(seq.request_id) is not seq:
+                continue  # finished or aborted while the block ran
+            emitted_here = 0
+            try:
+                done = False
+                for k in range(R):
+                    c = int(counts[k, slot])
+                    if c <= 0:
+                        break  # row froze on-device before this round
+                    for w in range(c):
+                        t = int(toks3[k, slot, w])
+                        if t < 0:
+                            break
+                        seq.token_ids.append(seq.next_token)
+                        seq.seq_len += 1
+                        emitted_here += 1
+                        self._emit_token(seq, t, outputs,
+                                         float(lps3[k, slot, w]))
+                        if self._by_id.get(seq.request_id) is not seq:
+                            self._deact_slot(slot)
+                            done = True
+                            break
+                    if done:
+                        break
+            except Exception as e:  # failure isolation (Property 22)
+                if self.slots[slot] is seq:
+                    self.slots[slot] = None
+                self._deact_slot(slot)
+                self._by_id.pop(seq.request_id, None)
+                self._release_seq(seq)
+                outputs.append(StepOutput(
+                    request_id=seq.request_id, finished=True, error=str(e)))
+                continue
+            sc_emitted += emitted_here
+            if self._by_id.get(seq.request_id) is seq:
+                delta = assumed - emitted_here
+                seq.dev_pos -= delta
+                seq.dev_steps_left += delta
+        # rows the free list starved (exit 3) froze on-device but are
+        # still live on the host: re-stage them so the next launch
+        # re-injects the carry row (host pages guaranteed then)
+        _REASONS = ("", "eos", "budget", "pages", "cap")
+        for slot, seq, _, _ in snapshot:
+            c = int(codes[slot])
+            if c:
+                self._loop_exits[_REASONS[c]] += 1
+            if (c == 3 and self._by_id.get(seq.request_id) is seq
+                    and self.slots[slot] is seq):
+                self._stage_seat(slot, seq)
+        self._loop_blocks += 1
+        self._loop_steps += n_steps
+        self._loop_decode_tokens += sc_emitted
+        return sc_emitted
 
     def _get_spec_block(self, use_topp: bool) -> Callable:
         """Speculative block variant for this launch: the use_topp=True

@@ -41,6 +41,7 @@ from typing import (
     NamedTuple,
     Optional,
     Sequence,
+    Set,
     Tuple,
 )
 
@@ -229,9 +230,11 @@ class CacheStats:
 class PageAllocator:
     """Host bookkeeping for the device page pool.
 
-    Pages move between three states: FREE (never cached / evicted), ACTIVE
-    (refcount > 0, held by live or cached prefixes), and CACHED (refcount 0
-    but content-addressed, reclaimable LRU). Matches the reference's cache
+    Pages move between four states: FREE (never cached / evicted), ACTIVE
+    (refcount > 0, held by live or cached prefixes), CACHED (refcount 0
+    but content-addressed, reclaimable LRU), and DEVICE-HELD (drawn onto
+    a looped decode block's on-device free-list, pending reconcile —
+    draw_device/reconcile_device). Matches the reference's cache
     manager contract (get/get_prefix/put/evict_lru/stats,
     design.md:393-402 [spec]) reinterpreted over pages.
     """
@@ -239,6 +242,15 @@ class PageAllocator:
     def __init__(self, cfg: PagedCacheConfig):
         self.cfg = cfg
         self._free: List[int] = list(range(cfg.num_pages - 1, -1, -1))
+        # pages drawn onto the DEVICE free-list for a run-to-completion
+        # decode block (kernel looping, docs/PERF.md): a fourth page
+        # state alongside FREE/ACTIVE/CACHED. The device appends them to
+        # row block tables inside the compiled loop; the host learns the
+        # assignment only at block reconcile (reconcile_device), so until
+        # then these pages are neither free nor live-held — audit()
+        # accounts them explicitly so an in-flight draw never reads as a
+        # leak.
+        self._device_held: Set[int] = set()
         # content address -> cached page
         self._by_hash: Dict[int, _CachedPage] = {}
         # page_id -> (hash, _CachedPage) for pages that are content-addressed
@@ -334,6 +346,54 @@ class PageAllocator:
             out.append(self._free.pop())
         out.extend(evicted)
         return out
+
+    def draw_device(self, n: int) -> List[int]:
+        """Move up to ``n`` pages into the DEVICE-HELD state for a
+        run-to-completion decode block's on-device free-list (kernel
+        looping, docs/PERF.md). Unlike allocate(), a partial draw is
+        fine — the compiled loop simply freezes rows with exit reason
+        ``pages`` when the device list runs dry — so this never raises
+        for a shortfall. Free-list pages are preferred; LRU-cached pages
+        are reclaimed (with host-tier demotion) only for the remainder.
+        The draw must be reconciled (reconcile_device) when the block
+        returns: until then the pages are neither free nor live-held."""
+        out: List[int] = []
+        while self._free and len(out) < n:
+            out.append(self._free.pop())
+        deficit = n - len(out)
+        if deficit > 0 and self._lru:
+            out.extend(self._evict_lru_batch(deficit))
+        self._device_held.update(out)
+        return out
+
+    def reconcile_device(
+        self, claimed: Sequence[int], returned: Sequence[int]
+    ) -> None:
+        """Settle a device draw at block reconcile: ``claimed`` pages
+        were appended to some row's block table inside the loop and are
+        now plain live-held (the holder releases them like any
+        allocate()d page); ``returned`` pages were never assigned (or
+        their row was aborted before the host ever saw the assignment)
+        and go straight back to the free list. Every drawn page must
+        come back through exactly one of the two lists."""
+        for pid in claimed:
+            if pid not in self._device_held:
+                raise ValueError(
+                    f"page {pid} claimed but not device-held"
+                )
+            self._device_held.discard(pid)
+        for pid in returned:
+            if pid not in self._device_held:
+                raise ValueError(
+                    f"page {pid} returned but not device-held"
+                )
+            self._device_held.discard(pid)
+            self._free.append(pid)
+
+    def device_held(self) -> int:
+        """Pages currently drawn onto a device free-list (in-flight
+        looped block). Engine-thread only."""
+        return len(self._device_held)
 
     def _evict_lru_batch(self, count: int, demote: bool = True) -> List[int]:
         """Evict up to ``count`` LRU cached pages, invoking the offload
@@ -501,6 +561,14 @@ class PageAllocator:
                 bad(f"free page {pid} out of range [0, {total})")
             if pid in self._by_page:
                 bad(f"page {pid} is both free and content-addressed")
+        for pid in self._device_held:
+            if not (0 <= pid < total):
+                bad(f"device-held page {pid} out of range [0, {total})")
+            if pid in free_set:
+                bad(f"page {pid} is both free and device-held")
+            if pid in self._by_page:
+                bad(f"page {pid} is both device-held and "
+                    "content-addressed")
         for h, entry in self._by_hash.items():
             back = self._by_page.get(entry.page_id)
             if back is None or back[0] != h or back[1] is not entry:
@@ -535,6 +603,9 @@ class PageAllocator:
                 if pid in free_set:
                     bad(f"live page {pid} is on the free list "
                         "(use-after-free)")
+                if pid in self._device_held:
+                    bad(f"live page {pid} is still device-held "
+                        "(unreconciled device draw)")
                 addressed = self._by_page.get(pid)
                 if addressed is not None:
                     if addressed[1].refcount != count:
@@ -549,11 +620,13 @@ class PageAllocator:
                     bad(f"page {pid}: refcount {entry.refcount} with no "
                         "live holder (leaked reference)")
             accounted = (len(free_set) + len(self._lru)
-                         + len(set(held) - set(self._lru)))
+                         + len(set(held) - set(self._lru))
+                         + len(self._device_held))
             if accounted != total:
                 bad(f"conservation: {len(free_set)} free + "
                     f"{len(self._lru)} cached + "
-                    f"{len(set(held) - set(self._lru))} live = "
+                    f"{len(set(held) - set(self._lru))} live + "
+                    f"{len(self._device_held)} device-held = "
                     f"{accounted}, pool has {total} "
                     f"({total - accounted:+d} leaked)")
         return issues

@@ -262,6 +262,11 @@ class NativePageAllocator:
         self._lib = lib
         self.cfg = cfg
         self._ptr = lib.pa_create(cfg.num_pages, cfg.page_size)
+        # pages drawn onto a device-resident free-list for a looped
+        # decode block (kernel looping, docs/PERF.md): tracked Python-
+        # side — the native core sees a plain allocate, and returned
+        # (never-assigned) pages go back through release()
+        self._device_held: set = set()
 
     def __del__(self):
         ptr = getattr(self, "_ptr", None)
@@ -287,6 +292,43 @@ class NativePageAllocator:
         if self._lib.pa_allocate(self._ptr, n, out) != 0:
             raise CacheFull()
         return [out[i] for i in range(n)]
+
+    def draw_device(self, n: int) -> List[int]:
+        """Contract of ``PageAllocator.draw_device``: move up to ``n``
+        pages into the DEVICE-HELD state for a looped decode block's
+        on-device free-list; a partial draw never raises. The native
+        core has no device-held notion, so the draw is a plain
+        allocate() of what fits and the state lives Python-side."""
+        from distributed_inference_server_tpu.core.errors import CacheFull
+
+        m = min(n, self.num_free())
+        if m <= 0:
+            return []
+        try:
+            pages = self.allocate(m)
+        except CacheFull:  # pragma: no cover — num_free() raced
+            return []
+        self._device_held.update(pages)
+        return pages
+
+    def reconcile_device(
+        self, claimed: Sequence[int], returned: Sequence[int]
+    ) -> None:
+        """Contract of ``PageAllocator.reconcile_device``: ``claimed``
+        pages joined a row's block table on device and are now plain
+        live-held (released later like any allocate()d page);
+        ``returned`` pages were never assigned and go back to free."""
+        for pid in list(claimed) + list(returned):
+            if pid not in self._device_held:
+                raise ValueError(
+                    f"page {pid} reconciled but not device-held"
+                )
+            self._device_held.discard(pid)
+        if returned:
+            self.release(list(returned))
+
+    def device_held(self) -> int:
+        return len(self._device_held)
 
     def publish(self, tokens: Sequence[int], page_ids: Sequence[int]) -> None:
         self._lib.pa_publish(

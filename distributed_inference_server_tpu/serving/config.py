@@ -117,6 +117,18 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         # width (decode slots + prefill budget) and must exceed
         # engine.max_batch. 0 = off (quantum-interleave baseline).
         "mixed_step_tokens": (int, 0),
+        # run-to-completion decode blocks (engine/engine.py; docs/PERF.md
+        # "Kernel Looping"): decode blocks carry an on-device page
+        # free-list and keep stepping inside ONE compiled program until
+        # EOS / budget / free-list exhaustion / loop_max_steps, instead
+        # of returning to the host every decode_block_size tokens. Also
+        # folds the mixed step into K-block form and lets speculation
+        # compose with mixed_step_tokens.
+        "loop_to_completion": (bool, False),
+        # per-launch iteration cap for looped blocks — bounds how long a
+        # runaway row can hold the device before admission runs again;
+        # degradation rungs shrink the effective cap further
+        "loop_max_steps": (int, 256),
         # speculative decoding knobs (Req 12.3-12.5)
         "num_draft_tokens": (int, 4),
         "spec_disable_threshold": (float, 0.5),
@@ -794,6 +806,8 @@ class ServerConfig:
                 "(the packed width holds every decode slot plus at "
                 "least one prefill token)"
             )
+        if r["engine"]["loop_max_steps"] < 1:
+            raise ConfigError("engine.loop_max_steps must be >= 1")
         if not r["engine"]["prefill_buckets"]:
             raise ConfigError("engine.prefill_buckets must be non-empty")
         if sorted(r["engine"]["prefill_buckets"]) != r["engine"]["prefill_buckets"]:

@@ -169,6 +169,18 @@ class DegradationController:
         DegradationLevel.EMERGENCY: 0.25,
     }
 
+    #: looped-block iteration-cap share per ladder level (engine/engine.py
+    #: set_loop_cap_frac): under pressure, run-to-completion blocks give
+    #: the host back control sooner so admission and preemption can run —
+    #: the same lever MIXED_PREFILL_FRAC pulls on prompt loading
+    LOOP_CAP_FRAC = {
+        DegradationLevel.NORMAL: 1.0,
+        DegradationLevel.REDUCED_BATCH_SIZE: 0.5,
+        DegradationLevel.AGGRESSIVE_CACHE_EVICTION: 0.5,
+        DegradationLevel.REJECT_LOW_PRIORITY: 0.25,
+        DegradationLevel.EMERGENCY: 0.25,
+    }
+
     def _apply(self, old: DegradationLevel, new: DegradationLevel) -> None:
         # batch-size reduction: owns only the divisor — the config itself
         # stays owned by hot-reload, so the two compose
@@ -178,10 +190,16 @@ class DegradationController:
         # mixed-step prefill share (no-op on engines without the mixed
         # step); restored on the way back down the ladder
         frac = self.MIXED_PREFILL_FRAC[new]
+        loop_frac = self.LOOP_CAP_FRAC[new]
         for runner in self.scheduler.engines():
             setter = getattr(runner, "set_mixed_prefill_frac", None)
             if setter is not None:
                 setter(frac)
+            # looped-block cap (no-op on engines without
+            # loop_to_completion); restored on the way back down
+            loop_setter = getattr(runner, "set_loop_cap_frac", None)
+            if loop_setter is not None:
+                loop_setter(loop_frac)
         # cache eviction
         if new >= DegradationLevel.AGGRESSIVE_CACHE_EVICTION > old or (
             new >= DegradationLevel.EMERGENCY > old

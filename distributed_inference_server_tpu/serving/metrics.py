@@ -85,6 +85,11 @@ class EngineStatus:
     # steps / prefill_tokens / decode_tokens / batch_density /
     # prefill_frac — None when engine.mixed_step_tokens is 0
     mixed: Any = None
+    # run-to-completion looped decode blocks (engine.loop_stats();
+    # docs/PERF.md "Kernel Looping"): blocks / steps / decode_tokens /
+    # exits / cap / cap_frac — None when engine.loop_to_completion is
+    # off
+    loop: Any = None
     # fleet control plane (serving/fleet.py): True for a RemoteRunner
     # proxy's status reconstructed from a member heartbeat. Remote
     # replicas take routed admissions; without a data plane they are
@@ -121,6 +126,8 @@ class EngineStatus:
             d["host_tier"] = self.host_tier
         if self.mixed is not None:
             d["mixed"] = self.mixed
+        if self.loop is not None:
+            d["loop"] = self.loop
         if self.remote:
             d["remote"] = True
             if self.data_plane:
@@ -314,6 +321,23 @@ class MetricsCollector:
             "mixed dispatch (1.0 = every MXU tile slot carried a real "
             "token)", ["engine_id"],
             registry=r,
+        )
+        # run-to-completion looped decode blocks (engine/engine.py
+        # _loop_step; docs/PERF.md "Kernel Looping"): device iterations
+        # executed inside looped blocks, and why each block stopped
+        self.loop_steps_total = Counter(
+            "engine_loop_steps_total",
+            "Device iterations executed inside run-to-completion looped "
+            "decode blocks (each iteration advances every active row "
+            "one token, or one speculative round, with no host sync)",
+            registry=r,
+        )
+        self.loop_exit_total = Counter(
+            "engine_loop_exit_total",
+            "Looped decode-block row exits by stop condition (eos | "
+            "budget | pages = device free-list exhausted | cap = "
+            "loop_max_steps iteration cap)",
+            ["reason"], registry=r,
         )
         self.queue_depth_g = Gauge(
             "queue_depth", "Queued requests by priority", ["priority"], registry=r
@@ -806,6 +830,16 @@ class MetricsCollector:
     def set_mixed_density(self, engine_id: str, density: float) -> None:
         """Rolling mixed-batch density gauge for one engine replica."""
         self.mixed_density.labels(engine_id=engine_id).set(density)
+
+    def record_loop_block(self, steps: int = 0,
+                          exits: Optional[Dict[str, int]] = None) -> None:
+        """Looped-block deltas since the last report (runner): device
+        iterations plus per-reason row exits."""
+        if steps:
+            self.loop_steps_total.inc(steps)
+        for reason, n in (exits or {}).items():
+            if n:
+                self.loop_exit_total.labels(reason=reason).inc(n)
 
     def set_queue_depth(self, high: int, normal: int, low: int) -> None:
         self.queue_depth_g.labels(priority="high").set(high)

@@ -183,6 +183,10 @@ class EngineRunner:
         # mixed-step counter watermarks (engine.mixed_stats() reports
         # totals; the collector wants deltas)
         self._mixed_seen = {"prefill_tokens": 0, "decode_tokens": 0}
+        # looped-block counter watermarks (engine.loop_stats() reports
+        # totals; the collector wants deltas — same shape as the mixed
+        # block)
+        self._loop_seen: Dict[str, Any] = {"steps": 0, "exits": {}}
         # step-clock watermarks (engine.step_clock_stats() reports
         # cumulative kind/event counters; the collector wants deltas —
         # same shape as the mixed block, docs/OBSERVABILITY.md)
@@ -772,6 +776,17 @@ class EngineRunner:
 
         self._post(_do)
 
+    def set_loop_cap_frac(self, frac: float) -> None:
+        """Degradation-ladder hook: shrink the looped-block iteration
+        cap under pressure so run-to-completion blocks hand control
+        back to the host sooner (engine.set_loop_cap_frac on the
+        engine thread; a no-op when loop_to_completion is off)."""
+
+        def _do() -> None:
+            self._engine.set_loop_cap_frac(frac)
+
+        self._post(_do)
+
     def reset_speculation(self) -> None:
         """Clear every pattern's acceptance tracker (Req 12.5 explicit
         reset — e.g. the operator knows the request pattern changed);
@@ -870,6 +885,7 @@ class EngineRunner:
                 self._cache_seen = {"hits": 0, "misses": 0, "evictions": 0}
                 self._mixed_seen = {"prefill_tokens": 0,
                                     "decode_tokens": 0}
+                self._loop_seen = {"steps": 0, "exits": {}}
                 self._sc_seen = {"kinds": {}, "events": {}}
                 if on_done:
                     on_done(True, None)
@@ -926,7 +942,7 @@ class EngineRunner:
         eng = self._engine
         used = total = cached = page_size = digest_depth = 0
         waiting = 0
-        speculation = host_tier = mixed = None
+        speculation = host_tier = mixed = loop = None
         if eng is not None:
             try:
                 s = eng.cache_stats()
@@ -945,6 +961,7 @@ class EngineRunner:
                 waiting = eng.num_waiting()
                 host_tier = eng.host_tier_stats()
                 mixed = eng.mixed_stats()
+                loop = eng.loop_stats()
                 speculation = eng.spec_stats()
                 if speculation is not None and self.metrics:
                     self.metrics.set_speculation(self.engine_id, speculation)
@@ -966,6 +983,7 @@ class EngineRunner:
             digest_depth=digest_depth,
             host_tier=host_tier,
             mixed=mixed,
+            loop=loop,
         )
 
     # -- runner thread ----------------------------------------------------
@@ -1173,6 +1191,7 @@ class EngineRunner:
             host = self._engine.host_tier_stats()
             reloads = self._engine.drain_reload_durations()
             mixed = self._engine.mixed_stats()
+            loop = self._engine.loop_stats()
             step_clock = self._engine.step_clock_stats()
             step_samples = self._engine.drain_step_samples()
         except Exception as e:  # noqa: BLE001
@@ -1192,6 +1211,18 @@ class EngineRunner:
                 "prefill_tokens": mixed["prefill_tokens"],
                 "decode_tokens": mixed["decode_tokens"],
             }
+        if loop is not None:
+            seen_l = self._loop_seen
+            d_steps = max(0, loop["steps"] - seen_l["steps"])
+            d_exits = {
+                reason: max(0, n - seen_l["exits"].get(reason, 0))
+                for reason, n in loop["exits"].items()
+            }
+            if d_steps or any(d_exits.values()):
+                self.metrics.record_loop_block(steps=d_steps,
+                                               exits=d_exits)
+            self._loop_seen = {"steps": loop["steps"],
+                               "exits": dict(loop["exits"])}
         seen = self._cache_seen
         hits = max(0, s.hits - seen["hits"])
         self.metrics.record_cache(

@@ -460,6 +460,7 @@ DIGEST_NAMES = (
     "step_ms.prefill",
     "step_ms.decode_block",
     "step_ms.mixed",
+    "step_ms.loop",
     "slo.ok",
     "slo.violated",
 )

@@ -111,3 +111,49 @@ class TestControllerActions:
     def test_memory_pressure_no_engines_is_zero(self):
         c, _ = _controller()
         assert c.memory_pressure() == 0.0
+
+    def test_loop_cap_frac_follows_the_ladder(self):
+        """ISSUE 19: each rung shrinks the run-to-completion loop cap
+        alongside the mixed prefill share (LOOP_CAP_FRAC), and recovery
+        restores it — pressure hands control back to the host sooner
+        without abandoning looped dispatch."""
+
+        class _Runner:
+            engine_id = "e0"
+
+            def __init__(self):
+                self.loop_fracs = []
+                self.mixed_fracs = []
+
+            def set_loop_cap_frac(self, f):
+                self.loop_fracs.append(f)
+
+            def set_mixed_prefill_frac(self, f):
+                self.mixed_fracs.append(f)
+
+            def evict_cache(self, target, drop_host_tier=False): ...
+
+        c, d = _controller()
+        r = _Runner()
+        d.scheduler.register(r)
+        c.evaluate(pressure=0.75)   # REDUCED_BATCH_SIZE
+        c.evaluate(pressure=0.92)   # REJECT_LOW_PRIORITY
+        c.evaluate(pressure=0.10)   # recovery
+        assert r.loop_fracs == [0.5, 0.25, 1.0]
+        # the two levers move together, rung for rung
+        assert r.mixed_fracs == [0.5, 0.25, 1.0]
+
+    def test_loop_cap_frac_noop_without_setter(self):
+        """Engines without loop_to_completion (or the mixed step) are
+        skipped, not crashed — the ladder getattr-gates both setters."""
+
+        class _Bare:
+            engine_id = "bare"
+
+            def evict_cache(self, target, drop_host_tier=False): ...
+
+        c, d = _controller()
+        d.scheduler.register(_Bare())
+        c.evaluate(pressure=0.92)
+        c.evaluate(pressure=0.10)
+        assert c.level == DegradationLevel.NORMAL

@@ -1493,6 +1493,36 @@ def f(cfg: ServerConfig):
     assert "engine.mixed_step_tokenz" in out[0].message
 
 
+def test_dl012_loop_keys_checked():
+    """The kernel-looping knobs (config ``engine.loop_to_completion`` /
+    ``engine.loop_max_steps``, ISSUE 19): correct gets and the env
+    spelling are clean, a typo'd key flags against the schema."""
+    out = pcheck("DL012", {
+        _CONFIG_FIXTURE: """
+_SCHEMA = {
+    "engine": {
+        "loop_to_completion": (bool, False),
+        "loop_max_steps": (int, 256),
+    },
+}
+class ServerConfig:
+    def get(self, section, key):
+        return None
+""",
+        f"{PKG}/serving/x.py": f"""
+import os
+from {PKG.replace('/', '.')}.serving.config import ServerConfig
+def f(cfg: ServerConfig):
+    ok = cfg.get("engine", "loop_to_completion")
+    env = os.environ.get("DIS_TPU_ENGINE__LOOP_MAX_STEPS")
+    bad = cfg.get("engine", "loop_max_stepz")
+    return ok, env, bad
+""",
+    })
+    assert len(out) == 1
+    assert "engine.loop_max_stepz" in out[0].message
+
+
 def test_dl012_fleet_kv_keys_checked():
     """The fleet.kv_* keys (ISSUE 13, serving/fleet_kv.py): a correct
     get (and the env-token spelling) is clean, a typo'd key flags."""

@@ -113,6 +113,38 @@ class TestPhaseModel:
         # decode is untouched by the prefill-side window
         assert phases["decode"] >= 0.01 - 1e-3
 
+    def test_looped_block_bursts_keep_partition_exact(self):
+        """ISSUE 19 (kernel looping): a run-to-completion decode block
+        surfaces a whole block's tokens as one burst at reconcile, and
+        block lengths vary (eos / budget / pages / cap exits) — so the
+        per-request token cadence is lumpy and the decode window spans
+        host-silent stretches. The phase model needs no loop awareness:
+        decode is still first_token -> finish minus the windows inside
+        it, and the partition stays exact under bursts of any shape."""
+        rec = FlightRecorder()
+        rec.admit("r1", endpoint="generate")
+        rec.note("r1", "schedule", engine="e0", strategy="least_loaded")
+        time.sleep(0.01)
+        rec.token("r1", 1)  # prefill's token: prefill complete
+        # looped blocks reconcile at irregular intervals with
+        # variable-size bursts (cap exit, pages exit, final eos)
+        for burst, gap in ((8, 0.02), (3, 0.01), (5, 0.015)):
+            time.sleep(gap)
+            rec.token("r1", burst)
+        # a handoff window lands INSIDE the looped-decode stretch
+        rec.note("r1", "handoff_resume", target="e1", stall_s=0.012)
+        phases = rec.finish("r1", "ok")
+        tl = rec.timeline("r1")
+        assert tl["tokens"] == 1 + 8 + 3 + 5
+        # exact partition: bursts and silent stretches don't tear it
+        assert abs(sum(phases.values()) - tl["wall_s"]) < 1e-6
+        # the stall window subtracted from DECODE, exactly
+        assert abs(phases["handoff_stall"] - 0.012) < 1e-6
+        assert phases["decode"] >= 0.045 - 0.012 - 1e-3
+        # prefill is untouched by the decode-side window
+        assert phases["prefill"] >= 0.01 - 1e-3
+        assert phases["prefill"] <= tl["ttft_s"] + 1e-6
+
     def test_zero_token_error_request(self):
         rec = FlightRecorder()
         rec.admit("r1")

@@ -235,3 +235,78 @@ def test_gather_kv_window_page_path_matches_slot_path():
     k_slow, v_slow = llama.gather_kv_window(k, v, jnp.asarray(gather), 0)
     np.testing.assert_array_equal(np.asarray(k_fast), np.asarray(k_slow))
     np.testing.assert_array_equal(np.asarray(v_fast), np.asarray(v_slow))
+
+
+# -- Device-held pages (kernel looping, ISSUE 19) ---------------------------
+# A run-to-completion decode block draws pages onto an on-device
+# free-list (draw_device); at block reconcile every drawn page comes
+# back as either claimed (now live-held by a row) or returned (back to
+# the free list). The DEVICE-HELD state participates in conservation.
+
+
+def test_draw_device_prefers_free_then_evicts_lru():
+    a = PageAllocator(PCFG)
+    # publish 2 cached pages, keep 4 live, leaving 2 truly free
+    live = a.allocate(4)
+    for i in range(2):
+        p = a.allocate(1)
+        a.publish([100 + i] * 4, p)
+        a.release(p)
+    assert a.stats().pages_free == 2
+    drawn = a.draw_device(4)  # 2 free + 2 reclaimed from LRU
+    assert len(drawn) == 4
+    assert a.device_held() == 4
+    assert a.stats().evictions == 2
+    # outstanding draw is NOT a leak: conservation counts device-held
+    assert a.audit(live_pages=live) == []
+    a.reconcile_device(claimed=[], returned=drawn)
+    assert a.device_held() == 0
+    assert a.num_free() == 4
+    a.release(live)
+
+
+def test_draw_device_partial_when_starved():
+    a = PageAllocator(PCFG)
+    live = a.allocate(7)  # one page left, nothing cached
+    drawn = a.draw_device(3)
+    assert len(drawn) == 1  # partial draw, no CacheFull
+    assert a.draw_device(2) == []  # fully dry: empty, still no raise
+    a.reconcile_device(claimed=[], returned=drawn)
+    a.release(live)
+
+
+def test_reconcile_claimed_pages_become_live_held():
+    a = PageAllocator(PCFG)
+    drawn = a.draw_device(2)
+    a.reconcile_device(claimed=[drawn[0]], returned=[drawn[1]])
+    # the claimed page is now an ordinary live-held page; the returned
+    # one is free again
+    assert a.device_held() == 0
+    assert a.num_free() == PCFG.num_pages - 1
+    assert a.audit(live_pages=[drawn[0]]) == []
+    a.release([drawn[0]])
+    assert a.num_free() == PCFG.num_pages
+
+
+def test_audit_flags_live_page_still_device_held():
+    a = PageAllocator(PCFG)
+    drawn = a.draw_device(1)
+    # a row's block table references the page before the host settled
+    # the draw — audit must call out the unreconciled overlap
+    issues = a.audit(live_pages=drawn)
+    assert any("unreconciled device draw" in m for m in issues)
+    a.reconcile_device(claimed=drawn, returned=[])
+    assert a.audit(live_pages=drawn) == []
+    a.release(drawn)
+
+
+def test_reconcile_of_non_held_page_raises():
+    a = PageAllocator(PCFG)
+    drawn = a.draw_device(1)
+    other = a.allocate(1)
+    with pytest.raises(ValueError, match="not device-held"):
+        a.reconcile_device(claimed=other, returned=[])
+    with pytest.raises(ValueError, match="not device-held"):
+        a.reconcile_device(claimed=[], returned=other)
+    a.reconcile_device(claimed=[], returned=drawn)
+    a.release(other)

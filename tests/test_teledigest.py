@@ -323,7 +323,7 @@ class TestMetricsIntegration:
              "handoff_stall": 0.0, "decode": 0.1, "detok": 0.001},
             tbt_s=0.02,
         )
-        for kind in ("prefill", "decode_block", "mixed"):
+        for kind in ("prefill", "decode_block", "mixed", "loop"):
             m.observe_step(kind, 0.003)
         m.record_slo("default", "ok", tokens=10)
         m.record_slo("default", "violated")

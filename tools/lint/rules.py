@@ -727,6 +727,11 @@ class DL007(Rule):
         # beyond that stalls the mixed pipeline exactly like the decode
         # loop
         "_reap_mixed_prefill",
+        # the looped-block reap (kernel looping, docs/PERF.md): runs
+        # once per run-to-completion block and settles the device page
+        # draw + walks every emitted token — the whole point of the
+        # loop is killing host sync, so device work here would undo it
+        "_process_loop_block",
     })
     _SYNC_ATTRS = frozenset({"block_until_ready", "item"})
 

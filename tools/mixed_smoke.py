@@ -1,16 +1,24 @@
-"""Mixed-batch step smoke (ISSUE 12; CI: disagg-smoke job).
+"""Mixed-batch step smoke (ISSUE 12 + 19; CI: disagg-smoke job).
 
-Two assertions on the ragged mixed step, end to end on the CPU backend:
+Three assertions on the ragged mixed step and the run-to-completion
+loop, end to end on the CPU backend:
 
 1. **Token identity** — a mixed long-prompt/chat workload emits
    bit-identical token streams under ``engine.mixed_step_tokens`` and
    under the quantum-interleave path it replaces (greedy; the
    acceptance criterion).
-2. **Metrics** — driven through a real ``EngineRunner`` +
-   ``MetricsCollector``, the new surfaces are populated:
-   ``engine_mixed_step_tokens{kind=prefill|decode}`` counters and the
-   ``engine_mixed_batch_density`` gauge in /metrics text, plus the
-   ``mixed`` block in the engine's /server/stats status dict.
+2. **Loop identity** — the same workload under
+   ``engine.loop_to_completion`` (run-to-completion looped blocks +
+   K-block mixed fusion, ISSUE 19) emits the same streams again, and
+   the loop actually ran (blocks dispatched, exit reasons recorded).
+3. **Metrics** — driven through a real ``EngineRunner`` +
+   ``MetricsCollector`` with BOTH features on, the surfaces are
+   populated: ``engine_mixed_step_tokens{kind=prefill|decode}``
+   counters, the ``engine_mixed_batch_density`` gauge,
+   ``engine_loop_steps_total`` and
+   ``engine_loop_exit_total{reason=...}`` in /metrics text, plus the
+   ``mixed`` and ``loop`` blocks in the engine's /server/stats status
+   dict.
 
 Exits non-zero (with a message) on any violation.
 """
@@ -20,6 +28,7 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -54,12 +63,13 @@ def main() -> int:
     paged = PagedCacheConfig(num_pages=64, page_size=4,
                              max_pages_per_seq=24)
 
-    def mk(mixed: bool) -> LLMEngine:
+    def mk(mixed: bool, loop: bool = False) -> LLMEngine:
         return LLMEngine(
             params, TINY, ByteTokenizer(),
             EngineConfig(max_batch=4, prefill_buckets=(8, 32),
                          paged=paged, decode_block_size=4,
-                         mixed_step_tokens=20 if mixed else 0),
+                         mixed_step_tokens=20 if mixed else 0,
+                         loop_to_completion=loop, loop_max_steps=64),
             dtype=jnp.float32,
         )
 
@@ -68,8 +78,8 @@ def main() -> int:
     long_prompt = rng.integers(1, 200, size=60).tolist()
 
     # ---- leg 1: engine-level token identity, mixed vs quantum ----
-    def drive(mixed: bool):
-        eng = mk(mixed)
+    def drive(mixed: bool, loop: bool = False):
+        eng = mk(mixed, loop)
         toks: dict = {}
         for i, ids in enumerate(chats):
             eng.add_request(f"c{i}", ids, SamplingParams(
@@ -104,6 +114,22 @@ def main() -> int:
           f"{stats['steps']} mixed steps, density "
           f"{stats['batch_density']})")
 
+    # ---- leg 1b: run-to-completion loop identity (ISSUE 19) ----
+    for mixed in (False, True):
+        got_loop, eng_loop = drive(mixed, loop=True)
+        if got_loop != want:
+            print(f"FAIL: loop_to_completion (mixed={mixed}) diverged: "
+                  f"{got_loop} != {want}", file=sys.stderr)
+            return 1
+        ls = eng_loop.loop_stats()
+        assert ls and ls["blocks"] > 0 and sum(ls["exits"].values()) > 0, (
+            f"loop never ran: {ls}"
+        )
+        leaks = eng_loop.audit_pages()
+        assert leaks == [], f"page audit after looped drain: {leaks}"
+        print(f"loop identity OK (mixed={mixed}: {ls['blocks']} blocks, "
+              f"{ls['steps']} device steps, exits {ls['exits']})")
+
     # ---- leg 2: metrics through a real runner ----
     class Sink:
         def __init__(self):
@@ -121,7 +147,8 @@ def main() -> int:
             self.done.set()
 
     metrics = MetricsCollector()
-    runner = EngineRunner("mixed-0", lambda: mk(True), metrics=metrics)
+    runner = EngineRunner("mixed-0", lambda: mk(True, True),
+                          metrics=metrics)
     runner.start()
     try:
         sinks = []
@@ -140,7 +167,20 @@ def main() -> int:
             assert s.done.wait(120), "request did not finish"
             assert s.error is None, s.error
 
-        prom = metrics.prometheus_text().decode()
+        def _loop_reported(text: str) -> bool:
+            for line in text.splitlines():
+                if line.startswith("engine_loop_steps_total "):
+                    return float(line.rsplit(" ", 1)[1]) > 0
+            return False
+
+        # the loop counters land in the runner's report AFTER the final
+        # step's tokens reach the sinks — poll past that tiny window
+        deadline = time.time() + 10.0
+        while True:
+            prom = metrics.prometheus_text().decode()
+            if _loop_reported(prom) or time.time() >= deadline:
+                break
+            time.sleep(0.05)
         for needle in (
             'engine_mixed_step_tokens_total{kind="prefill"}',
             'engine_mixed_step_tokens_total{kind="decode"}',
@@ -163,12 +203,25 @@ def main() -> int:
             print("FAIL: mixed prefill token counter never incremented",
                   file=sys.stderr)
             return 1
+        if series_value("engine_loop_steps_total") <= 0:
+            print("FAIL: engine_loop_steps_total never incremented",
+                  file=sys.stderr)
+            return 1
+        if "engine_loop_exit_total{reason=" not in prom:
+            print("FAIL: engine_loop_exit_total{reason=...} missing "
+                  "from /metrics", file=sys.stderr)
+            return 1
         status = runner.status().to_dict()
         if "mixed" not in status or status["mixed"]["steps"] <= 0:
             print(f"FAIL: /server/stats engine block lacks mixed stats: "
                   f"{status}", file=sys.stderr)
             return 1
-        print(f"metrics OK (mixed block: {status['mixed']})")
+        if "loop" not in status or status["loop"]["steps"] <= 0:
+            print(f"FAIL: /server/stats engine block lacks loop stats: "
+                  f"{status}", file=sys.stderr)
+            return 1
+        print(f"metrics OK (mixed block: {status['mixed']}, "
+              f"loop block: {status['loop']})")
     finally:
         runner.shutdown()
     print("mixed smoke OK")
