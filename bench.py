@@ -1145,9 +1145,159 @@ def bench_telem() -> None:
     assert sum(counts.get("default", {}).values()) >= rows * reps
 
 
+def bench_latent() -> None:
+    """Latent-KV codec microbench (BENCH_LATENT=1; ISSUE 20, TPLA
+    stage (a)): sweep rank x wire encoding (none/int8/latent/
+    latent_int8) over the three KV byte paths on the tiny CPU fixture —
+
+    - disagg handoff: monolithic export -> import; stall + payload bytes;
+    - peer prefix fetch: export_prefix_chunks bytes for a warm chain;
+    - host-tier reload: churn the prefix into the tier, re-prefill, and
+      read the engine's reload timer + stored tier bytes;
+
+    each emitting one JSON line with ``tokens_identical`` — greedy
+    decode of the moved sequence must match the never-moved reference
+    at the swept rank (the acceptance tolerance harness; a latent rank
+    that flips a token shows up as tokens_identical=false, not a
+    silently worse number).
+
+    Knobs: BENCH_LATENT_RANKS ("4,8"; rank sweep for the latent wires —
+    none/int8 are rank-independent and run once at rank 0),
+    BENCH_LATENT_REPS (3)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax.numpy as jnp
+
+    from distributed_inference_server_tpu.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        PagedCacheConfig,
+        chain_hashes,
+    )
+    from distributed_inference_server_tpu.models import llama
+    from distributed_inference_server_tpu.models.configs import TINY
+    from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+
+    ranks = [int(x) for x in os.environ.get(
+        "BENCH_LATENT_RANKS", "4,8").split(",") if x.strip()]
+    reps = int(os.environ.get("BENCH_LATENT_REPS", "3"))
+    ps = 4
+    params = llama.init_params(jax.random.PRNGKey(0), TINY,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    prompt = [1 + int(t) for t in rng.integers(0, 200, 88)]  # 22 pages
+    hashes = chain_hashes(prompt, ps, max_pages=(len(prompt) - 1) // ps)
+
+    def mk(rank, num_pages=96, **over):
+        return LLMEngine(
+            params, TINY, ByteTokenizer(),
+            EngineConfig(max_batch=4, prefill_buckets=(8, 128),
+                         paged=PagedCacheConfig(num_pages=num_pages,
+                                                page_size=ps,
+                                                max_pages_per_seq=32),
+                         latent_rank=rank, native_allocator=False, **over),
+            dtype=jnp.float32,
+        )
+
+    def run(engine, rid, ids, max_tokens=8):
+        engine.add_request(rid, ids, SamplingParams(
+            max_tokens=max_tokens, temperature=0.0))
+        toks = []
+        while engine.has_work():
+            for o in engine.step():
+                if o.token_id is not None:
+                    toks.append(o.token_id)
+        return toks
+
+    ref = mk(0)
+    want = run(ref, "ref", prompt)
+    sweep = [("none", 0), ("int8", 0)] + [
+        (wq, r) for r in ranks for wq in ("latent", "latent_int8")]
+
+    for wq, rank in sweep:
+        # path 1: handoff (stall = export -> import seated)
+        stalls, nbytes, identical = [], 0, True
+        src, dst = mk(rank), mk(rank)
+        for rep in range(reps + 1):
+            rid = f"{wq}{rank}h{rep}"
+            got = []
+            src.add_request(rid, prompt, SamplingParams(
+                max_tokens=8, temperature=0.0), prefill_only=True)
+            while src.has_work() and not src.handoff_ready_ids():
+                for o in src.step():
+                    if o.token_id is not None:
+                        got.append(o.token_id)
+            t0 = time.monotonic()
+            exp = src.export_handoff(rid, wire_quant=wq)
+            dst.import_sequence(exp)
+            t1 = time.monotonic()
+            while dst.has_work():
+                for o in dst.step():
+                    if o.token_id is not None:
+                        got.append(o.token_id)
+            identical &= got == want
+            nbytes = len(exp.kv)
+            if rep:  # rep 0 warms compile caches
+                stalls.append(t1 - t0)
+        _emit({
+            "metric": "kv_latent_handoff_stall_ms_tiny_cpu",
+            "value": round(float(np.median(stalls)) * 1e3, 3),
+            "unit": "ms", "vs_baseline": 0.0, "wire_quant": wq,
+            "rank": rank, "bytes": nbytes, "tokens_identical": identical,
+            "reps": reps,
+        })
+
+        # path 2: peer prefix fetch (bytes on the wire + token identity)
+        warm = mk(rank)
+        run(warm, "warm", prompt)
+        depth, chunks = warm.export_prefix_chunks(hashes, chunk_pages=2,
+                                                  wire_quant=wq)
+        target = mk(rank)
+        target.import_prefix(prompt[: depth * ps], chunks)
+        _emit({
+            "metric": "kv_latent_fetch_bytes_tiny_cpu",
+            "value": sum(len(c.payload) for c in chunks),
+            "unit": "bytes", "vs_baseline": 0.0, "wire_quant": wq,
+            "rank": rank, "pages": depth,
+            "tokens_identical": run(target, "probe", prompt) == want,
+        })
+
+        # path 3: host-tier reload (stored tier encoding = the wire);
+        # the pool holds ONE resident sequence (22-page prompt + decode)
+        # plus a little headroom, so churn demotes the warm prefix
+        tier = mk(rank, num_pages=30, host_tier_bytes=1 << 22,
+                  host_tier_quant=wq)
+        run(tier, "seed", prompt)
+        for i in range(6):  # churn the 12-page pool: the prefix demotes
+            run(tier, f"churn{i}",
+                rng.integers(100, 200, size=7).tolist(), max_tokens=2)
+        tier.host_tier.flush()
+        tier.drain_reload_durations()
+        got = run(tier, "probe", prompt)
+        reloads = tier.drain_reload_durations()
+        st = tier.host_tier_stats() or {}
+        _emit({
+            "metric": "kv_latent_hosttier_reload_ms_tiny_cpu",
+            "value": round(sum(reloads) * 1e3, 3),
+            "unit": "ms", "vs_baseline": 0.0, "wire_quant": wq,
+            "rank": rank, "tier_bytes": st.get("bytes", 0),
+            "tier_pages": st.get("pages", 0),
+            "hit_pages": st.get("hit_pages", 0),
+            "tokens_identical": got == want,
+        })
+
+
 def main() -> None:
     if os.environ.get("BENCH_HANDOFF") == "1":
         bench_handoff()
+        return
+    if os.environ.get("BENCH_LATENT") == "1":
+        bench_latent()
         return
     if os.environ.get("BENCH_TELEM") == "1":
         bench_telem()

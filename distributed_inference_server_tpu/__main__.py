@@ -116,6 +116,9 @@ def main(argv=None) -> int:
         # tiered prefix cache (docs/CACHING.md): host-RAM demotion pool
         host_tier_bytes=cfg.get("cache", "host_tier_bytes"),
         host_tier_quant=cfg.get("cache", "host_tier_quant"),
+        # latent page codec (docs/CACHING.md "Latent KV pages"): rank-r
+        # projection for latent/latent_int8 wire + tier encodings
+        latent_rank=cfg.get("cache", "latent_rank"),
         # fleet prefix sharing: routing-digest chain depth
         digest_depth=cfg.get("cache", "digest_depth"),
     )

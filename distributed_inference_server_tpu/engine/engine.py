@@ -54,6 +54,7 @@ from distributed_inference_server_tpu.core.errors import (
 from distributed_inference_server_tpu.core.models import FinishReason, Usage
 from distributed_inference_server_tpu.core.types import RequestId
 from distributed_inference_server_tpu.engine.kv_cache import (
+    _KIND_LATENT,
     _KIND_QPOOL,
     _KIND_WIRE8,
     _encode_group,
@@ -63,12 +64,16 @@ from distributed_inference_server_tpu.engine.kv_cache import (
     HostTier,
     KvChunk,
     KvImportSession,
+    LATENT_QUANTS,
+    LatentCodec,
     PageAllocator,
     PagedCacheConfig,
     PagedKVState,
     QuantPool,
+    default_latent_rank,
     deserialize_into_allocator,
     deserialize_kv,
+    encoded_page_fraction,
     gather_kv_parts,
     iter_chain_hashes,
     payload_kind,
@@ -283,9 +288,17 @@ class EngineConfig:
     host_tier_bytes: int = 0
     # host-tier storage encoding for FLOAT pools: "int8" stores demoted
     # pages as per-vector absmax codes + f32 scales (4x smaller for f32
-    # pools, lossy like the disagg wire quant); quantized pools always
-    # store their native codes exactly.
+    # pools, lossy like the disagg wire quant); "latent"/"latent_int8"
+    # store rank-r latent codes (needs latent_rank > 0); quantized
+    # pools always store their native codes exactly.
     host_tier_quant: str = "none"
+    # latent page codec (TPLA stage (a), docs/CACHING.md "Latent KV
+    # pages"): rank of the per-(layer, kv-head) projection pairs the
+    # engine calibrates at construction. 0 = off (no codec; latent
+    # wire/tier settings degrade to "none"). Float pools only — gated
+    # off for quantized pools and speculative engines like the host
+    # tier is.
+    latent_rank: int = 0
     # chain depth covered by the published routing digest (config
     # cache.digest_depth): first-K page hashes per cached chain. Deeper
     # digests let the fleet cost model (serving/scheduler.py plan_route)
@@ -319,7 +332,7 @@ class SequenceExport:
     source_engine: str = ""
     # streamed handoff (export_handoff_begin/finish): page-group chunks
     # replace the monolithic ``kv`` payload; ``wire_quant`` names the
-    # per-chunk wire encoding ("none" | "int8"). ``stalled_at`` is the
+    # per-chunk wire encoding (kv_cache.WIRE_QUANTS). ``stalled_at`` is the
     # host-local monotonic instant the sequence stopped decoding on the
     # source (drives kv_handoff_stall_seconds; never on the wire).
     kv_chunks: Optional[List[KvChunk]] = None
@@ -768,6 +781,38 @@ class LLMEngine:
         if draft_params is not None:
             self._spec_block_fns[False] = self._build_spec_block(False)
 
+        # per-kind encoded payload byte counters (runner delta-reports
+        # them into kv_payload_bytes_total{kind}; docs/OBSERVABILITY.md)
+        # + the raw-equivalent bytes latent encodes stood in for (the
+        # /server/stats cache block's savings figure). Initialized
+        # BEFORE codec calibration — its prefill pass can demote pages.
+        self._payload_bytes: Dict[str, int] = {
+            k: 0 for k in ("raw", "int8", "qpool", "latent", "latent_int8")
+        }
+        self._latent_raw_equiv_bytes = 0
+        # latent page codec (TPLA stage (a)): per-(layer, kv-head)
+        # rank-r projections, calibrated over a short deterministic
+        # prefill pass at construction (or loaded when the model config
+        # ships them). Gated like the host tier: float pools only, no
+        # speculative engines (the draft pool would need its own codec
+        # and bit-exactness for the acceptance law).
+        self.latent_codec: Optional[LatentCodec] = None
+        self._warned_latent_off = False
+        if self.ecfg.latent_rank > 0:
+            if self.draft_state is not None or isinstance(
+                self.state.k, QuantPool
+            ):
+                logger.warning(
+                    "latent KV codec disabled: %s",
+                    "speculative engines need the draft pool bit-exact"
+                    if self.draft_state is not None
+                    else "quantized pools ship native codes exactly",
+                )
+            else:
+                self.latent_codec = self._calibrate_latent(
+                    self.ecfg.latent_rank
+                )
+
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
@@ -966,7 +1011,8 @@ class LLMEngine:
         victims = [v for v in victims if not tier.has(v.hash)]
         ps = self.pcfg.page_size
         cap = self._OFFLOAD_BUCKETS[-1]
-        kind = payload_kind(self.state.k, tier.quant)
+        quant = self._effective_wire_quant(tier.quant)
+        kind = payload_kind(self.state.k, quant)
         for start in range(0, len(victims), cap):
             group = victims[start:start + cap]
             bucket = next(b for b in self._OFFLOAD_BUCKETS
@@ -979,14 +1025,25 @@ class LLMEngine:
                  for v in padded]
             ))
             if kind == _KIND_QPOOL:
+                # quant normalized to "none": 5 QuantPool args must
+                # never dispatch gather's latent (also 5-arg) form
                 arrs = self._offload_pull(
-                    tier.quant, self.state.k.data, self.state.k.scale,
+                    "none", self.state.k.data, self.state.k.scale,
                     self.state.v.data, self.state.v.scale, slots,
                 )
+            elif kind == _KIND_LATENT:
+                kp, vp = self.latent_codec.device_projs()
+                arrs = self._offload_pull(quant, self.state.k,
+                                          self.state.v, slots, kp, vp)
             else:
-                arrs = self._offload_pull(tier.quant, self.state.k,
+                arrs = self._offload_pull(quant, self.state.k,
                                           self.state.v, slots)
             start_host_copies(arrs)
+            # encoded-bytes accounting: the bucket gathers padded slots,
+            # the tier keeps len(group) pages of them
+            nbytes = sum(int(a.nbytes) for a in arrs)
+            self._note_payload(kind, quant,
+                               nbytes * len(group) // bucket)
             # groups past the first are burst continuations: the window
             # must not drain this very burst's still-in-flight copies
             tier.offer([(v.hash, v.depth, v.root) for v in group], kind,
@@ -1055,6 +1112,27 @@ class LLMEngine:
                 v = (jnp.asarray(v_q, jnp.float32)
                      * jnp.asarray(v_s)[..., None]).astype(dt)
                 parts = (k, v)
+            elif kind == _KIND_LATENT:
+                # latent host tier into a float pool: upload the rank-r
+                # codes (the smallest PCIe transfer of any encoding) and
+                # reconstruct on device against the codec projections
+                if self.latent_codec is None:
+                    raise CacheDeserializationError(
+                        "host tier holds latent pages but the engine "
+                        "has no codec"
+                    )
+                dt = self.state.k.dtype
+                if len(merged) == 4:  # latent_int8: dequant codes first
+                    k_q, v_q, k_s, v_s = merged
+                    k_codes = (jnp.asarray(k_q, jnp.float32)
+                               * jnp.asarray(k_s)[..., None])
+                    v_codes = (jnp.asarray(v_q, jnp.float32)
+                               * jnp.asarray(v_s)[..., None])
+                else:
+                    k_codes = jnp.asarray(merged[0])
+                    v_codes = jnp.asarray(merged[1])
+                k, v = self.latent_codec.decode_device(k_codes, v_codes)
+                parts = (k.astype(dt), v.astype(dt))
             else:
                 # _KIND_RAW into a float pool / _KIND_QPOOL into a QuantPool
                 parts = merged
@@ -1139,6 +1217,151 @@ class LLMEngine:
         return out
 
     # ------------------------------------------------------------------
+    # latent page codec (TPLA stage (a); docs/CACHING.md "Latent KV pages")
+    # ------------------------------------------------------------------
+
+    def _calibrate_latent(self, rank: int) -> Optional[LatentCodec]:
+        """Fit the per-(layer, kv-head) projection pairs by SVD over a
+        short DETERMINISTIC calibration pass: a couple of seeded prompts
+        prefill through the normal request path, the touched pool slots
+        are harvested as activation samples, and the engine is reset to
+        pristine (fresh allocator, zeroed pools, reset step clock) so
+        calibration pages and counters never leak into serving state.
+        Same weights + same seed ⇒ bit-identical projections on every
+        engine of a homogeneous fleet, so codecs agree without ever
+        shipping a basis on the wire. A checkpoint-shipped codec
+        (``model config latent_codec_path``) skips the pass entirely."""
+        path = getattr(self.cfg, "latent_codec_path", None) or None
+        if path:
+            codec = LatentCodec.load(path)
+            if codec.rank != rank:
+                raise ValueError(
+                    f"model-shipped latent codec has rank {codec.rank}, "
+                    f"config asks for {rank}"
+                )
+            return codec
+        head_dim = self.cfg.head_dim
+        if not 0 < rank <= head_dim:
+            raise ValueError(
+                f"latent_rank must be in (0, head_dim={head_dim}], "
+                f"got {rank}"
+            )
+        # ~2 prompts of >= 2*head_dim tokens give the per-head SVDs an
+        # overdetermined sample matrix; clamp to what the pool can seat
+        cap = self.pcfg.max_seq_len - 2
+        n_tok = min(max(2 * head_dim, 32), cap)
+        rng = np.random.default_rng(0x7A7E)
+        vocab = max(2, self.cfg.vocab_size - 1)
+        greedy = SamplingParams(max_tokens=1, temperature=0.0)
+        for i in range(2):
+            prompt = [1 + int(t) for t in rng.integers(0, vocab, n_tok)]
+            self.add_request(f"__latent_calib_{i}", prompt, greedy)
+            while self.has_work():
+                self.step()
+        k = np.asarray(self.state.k, np.float32)
+        v = np.asarray(self.state.v, np.float32)
+        used = np.any(k != 0.0, axis=(0, 2, 3)) | np.any(
+            v != 0.0, axis=(0, 2, 3))
+        if int(used.sum()) < 2:
+            logger.warning(
+                "latent KV codec disabled: calibration pass touched "
+                "%d pool slots", int(used.sum()),
+            )
+            codec = None
+        else:
+            codec = LatentCodec.calibrate(k[:, used], v[:, used], rank)
+        # reset to pristine: calibration pages, content addresses, and
+        # step-clock samples must not outlive the pass
+        self.state = PagedKVState(jnp.zeros_like(self.state.k),
+                                  jnp.zeros_like(self.state.v))
+        self.allocator = _make_allocator(
+            self.pcfg, self.ecfg.native_allocator,
+            need_offload_hook=(self.ecfg.host_tier_bytes > 0
+                               and self.draft_state is None),
+        )
+        if self.host_tier is not None:
+            self.host_tier.clear()
+            self.allocator.offload_hook = self._offload_pages
+        self._by_id.clear()
+        self.waiting.clear()
+        self.slots = [None] * self.ecfg.max_batch
+        self._slot_updates.clear()
+        self._carry = None
+        self._pending.clear()
+        self._rng = jax.random.PRNGKey(self.ecfg.seed)
+        for d in self._sc_kinds.values():
+            d.update(dispatches=0, wall_s=0.0, tokens=0, rows=0)
+        self._sc_events = {k: 0 for k in self._sc_events}
+        self._sc_samples.clear()
+        self._host_hit_pages = 0
+        self._host_reload_durations.clear()
+        self._payload_bytes = {k: 0 for k in self._payload_bytes}
+        # a calibration-time offload legitimately sees no codec yet;
+        # re-arm the one-shot warning for real serving-time degrades
+        self._warned_latent_off = False
+        self._latent_raw_equiv_bytes = 0
+        return codec
+
+    def _effective_wire_quant(self, wire_quant: str) -> str:
+        """Degrade a latent wire request to "none" when this engine has
+        no codec (latent_rank=0, spec engine, calibration declined) and
+        the pool is float — QuantPool exports pass native codes through
+        whatever the wire setting, so they keep it. One warning, not one
+        per export."""
+        if (wire_quant in LATENT_QUANTS and self.latent_codec is None
+                and not isinstance(self.state.k, QuantPool)):
+            if not self._warned_latent_off:
+                self._warned_latent_off = True
+                logger.warning(
+                    "wire_quant %r degraded to \"none\": engine has no "
+                    "latent codec (cache.latent_rank unset or codec "
+                    "gated off)", wire_quant,
+                )
+            return "none"
+        return wire_quant
+
+    def _payload_label(self, kind: int, wire_quant: str) -> str:
+        if kind == _KIND_QPOOL:
+            return "qpool"
+        if kind == _KIND_LATENT:
+            return ("latent_int8" if wire_quant == "latent_int8"
+                    else "latent")
+        return "int8" if kind == _KIND_WIRE8 else "raw"
+
+    def _note_payload(self, kind: int, wire_quant: str, nbytes: int) -> None:
+        """Account encoded payload bytes by kind (every encode site:
+        handoff, streamed chunks, prefix export, host-tier offload) —
+        the runner delta-reports into kv_payload_bytes_total{kind}."""
+        label = self._payload_label(kind, wire_quant)
+        self._payload_bytes[label] += int(nbytes)
+        if kind == _KIND_LATENT and self.latent_codec is not None:
+            # latent payloads only come off float pools
+            frac = encoded_page_fraction(
+                wire_quant, self.state.k.dtype.itemsize,
+                self.cfg.head_dim, self.latent_codec.rank,
+            )
+            if frac > 0:
+                self._latent_raw_equiv_bytes += int(nbytes / frac)
+
+    def payload_byte_counters(self) -> Dict[str, int]:
+        """Cumulative encoded-bytes-by-kind snapshot (runner thread
+        delta-reports it; plain int reads are atomic)."""
+        return dict(self._payload_bytes)
+
+    def latent_stats(self) -> Optional[Dict[str, int]]:
+        """/server/stats cache block ``latent`` entry: codec rank plus
+        encoded vs raw-equivalent byte totals; None when no codec."""
+        if self.latent_codec is None:
+            return None
+        encoded = (self._payload_bytes["latent"]
+                   + self._payload_bytes["latent_int8"])
+        return {
+            "rank": self.latent_codec.rank,
+            "encoded_bytes": encoded,
+            "saved_bytes": max(0, self._latent_raw_equiv_bytes - encoded),
+        }
+
+    # ------------------------------------------------------------------
     # KV handoff (disaggregated prefill/decode serving, serving/disagg.py)
     # ------------------------------------------------------------------
 
@@ -1172,8 +1395,11 @@ class LLMEngine:
                 "handoff candidate has window-reclaimed pages"
             )
         ps = self.pcfg.page_size
+        wire_quant = self._effective_wire_quant(wire_quant)
         kv = serialize_kv(self.state, seq.block_table, ps, seq.seq_len,
-                          wire_quant=wire_quant)
+                          wire_quant=wire_quant, codec=self.latent_codec)
+        self._note_payload(payload_kind(self.state.k, wire_quant),
+                           wire_quant, len(kv))
         draft_kv = (
             serialize_kv(self.draft_state, seq.block_table, ps, seq.seq_len)
             if self.draft_state is not None
@@ -1192,6 +1418,7 @@ class LLMEngine:
             pending_ids=list(seq.pending_ids),
             kv=kv,
             draft_kv=draft_kv,
+            wire_quant=wire_quant,
         )
         self._by_id.pop(request_id, None)
         if seq.freed_upto == 0:
@@ -1244,7 +1471,7 @@ class LLMEngine:
             seq=seq,
             prefix_pages=list(seq.block_table[:n_full]),
             chunk_pages=max(1, chunk_pages),
-            wire_quant=wire_quant,
+            wire_quant=self._effective_wire_quant(wire_quant),
         )
         seq.exporting = True
         seq.prefill_only = False
@@ -1274,11 +1501,16 @@ class LLMEngine:
             session.dead = True
             session.seq.exporting = False
             return True
-        session.chunks.extend(serialize_kv_chunks(
+        new_chunks = list(serialize_kv_chunks(
             self.state, session.prefix_pages, self.pcfg.page_size,
             chunk_pages=session.chunk_pages,
             wire_quant=session.wire_quant,
+            codec=self.latent_codec,
         ))
+        kind = payload_kind(self.state.k, session.wire_quant)
+        for c in new_chunks:
+            self._note_payload(kind, session.wire_quant, len(c.payload))
+        session.chunks.extend(new_chunks)
         session.prefix_done = True
         return True
 
@@ -1322,13 +1554,18 @@ class LLMEngine:
         chunks = list(session.chunks)
         tail_pages = seq.block_table[n_prefix:]
         if tail_pages:
-            chunks.extend(serialize_kv_chunks(
+            tail_chunks = list(serialize_kv_chunks(
                 self.state, tail_pages, self.pcfg.page_size,
                 chunk_pages=session.chunk_pages,
                 wire_quant=session.wire_quant,
                 first_chunk_index=len(chunks),
                 first_page_index=n_prefix,
+                codec=self.latent_codec,
             ))
+            kind = payload_kind(self.state.k, session.wire_quant)
+            for c in tail_chunks:
+                self._note_payload(kind, session.wire_quant, len(c.payload))
+            chunks.extend(tail_chunks)
         total = len(chunks)
         chunks = [dc_replace(c, total=total) for c in chunks]
         exp = SequenceExport(
@@ -1379,7 +1616,8 @@ class LLMEngine:
             # complete stream; any failure releases everything
             # (KvImportSession). The phased form used by the serving
             # path is import_stream_open/add/commit.
-            session = KvImportSession(self.state, self.allocator, ps)
+            session = KvImportSession(self.state, self.allocator, ps,
+                                      codec=self.latent_codec)
             try:
                 session.reserve(-(-n // ps))
                 for chunk in exp.kv_chunks:
@@ -1392,7 +1630,8 @@ class LLMEngine:
                 raise CacheDeserializationError(str(e)) from None
         elif exp.draft_kv is None:
             self.state, pages = deserialize_into_allocator(
-                self.state, self.allocator, exp.kv, exp.token_ids, ps
+                self.state, self.allocator, exp.kv, exp.token_ids, ps,
+                codec=self.latent_codec,
             )
         else:
             # both pools restore into the SAME pages (shared block
@@ -1475,7 +1714,8 @@ class LLMEngine:
                 f"per-sequence capacity ({self.pcfg.max_pages_per_seq})"
             )
         session = KvImportSession(self.state, self.allocator,
-                                  self.pcfg.page_size)
+                                  self.pcfg.page_size,
+                                  codec=self.latent_codec)
         try:
             session.reserve(prefix_pages)
         except Exception:
@@ -1542,6 +1782,7 @@ class LLMEngine:
         clocks — a peer-fetched chain is re-used traffic and earns its
         chain protection."""
         ps = self.pcfg.page_size
+        wire_quant = self._effective_wire_quant(wire_quant)
         lookup = getattr(self.allocator, "cached_page", None)
         # ("hbm", page_id) | ("host", _HostPage), consecutive from head
         entries: List[Tuple[str, object]] = []
@@ -1564,11 +1805,16 @@ class LLMEngine:
             if src == "hbm":
                 while j < len(entries) and entries[j][0] == "hbm":
                     j += 1
-                chunks.extend(serialize_kv_chunks(
+                hbm_chunks = list(serialize_kv_chunks(
                     self.state, [p for _, p in entries[i:j]], ps,
                     chunk_pages=chunk_pages, wire_quant=wire_quant,
                     first_chunk_index=len(chunks), first_page_index=i,
+                    codec=self.latent_codec,
                 ))
+                hbm_kind = payload_kind(self.state.k, wire_quant)
+                for c in hbm_chunks:
+                    self._note_payload(hbm_kind, wire_quant, len(c.payload))
+                chunks.extend(hbm_chunks)
             else:
                 kind = entries[i][1].kind
                 while (j < len(entries) and entries[j][0] == "host"
@@ -1581,8 +1827,14 @@ class LLMEngine:
                     for m in range(len(group[0].parts))
                 )
                 # the ONE payload encoder the handoff wire uses — the
-                # peer-fetch wire must never diverge from it
+                # peer-fetch wire must never diverge from it. Host-tier
+                # pages ship in their STORED encoding (kind 3 when the
+                # tier is latent — _encode_group derives the int8 flag
+                # from the part count).
                 payload = _encode_group(self.state, kind, merged, 0)
+                tier_quant = (self.host_tier.quant
+                              if self.host_tier is not None else "none")
+                self._note_payload(kind, tier_quant, len(payload))
                 chunks.append(KvChunk(
                     index=len(chunks), total=0, page_start=i,
                     page_count=len(group), payload=payload,
@@ -1619,7 +1871,8 @@ class LLMEngine:
                 "on a speculative engine would publish pages whose "
                 "draft KV is garbage"
             )
-        session = KvImportSession(self.state, self.allocator, ps)
+        session = KvImportSession(self.state, self.allocator, ps,
+                                  codec=self.latent_codec)
         try:
             session.reserve(n // ps)
             for chunk in chunks:

@@ -640,19 +640,28 @@ class PageAllocator:
 # np.savez route copied the host arrays ~3 extra times through tobytes/
 # frombuffer/BytesIO, measurable on multi-MB handoffs):
 #   magic "KVP1" | kind u8 | dtype_len u8 | dtype name | L,S,KV,D u32 |
-#   token_count u64 | k bytes | v bytes [| k_scale f32 | v_scale f32]
+#   token_count u64 [| flags u8] | k bytes | v bytes
+#   [| k_scale f32 | v_scale f32]
 # kind: 0 = raw pool values (dtype as named, bf16 included — np.savez
 # silently degrades ml_dtypes arrays to void, which is why the format is
 # hand-rolled); 1 = wire-quantized int8 codes + f32 per-vector scales
 # (dtype names the ORIGINAL pool dtype to restore on import); 2 = native
 # QuantPool codes + scales (exact round-trip at the quantized
-# representation, Property 12 semantics).
+# representation, Property 12 semantics); 3 = latent page codes (TPLA
+# stage (a), docs/CACHING.md "Latent KV pages"): K/V projected into a
+# per-(layer, kv-head) rank-r latent by a ``LatentCodec`` — the D slot
+# of the dims carries the RANK, dtype names the ORIGINAL pool dtype, and
+# one extra flags byte follows the dims (bit0 = codes are int8 + f32
+# per-vector scales instead of f16). Kinds 0–2 are byte-identical to the
+# pre-latent format.
 _KV_MAGIC = b"KVP1"
-_KIND_RAW, _KIND_WIRE8, _KIND_QPOOL = 0, 1, 2
+_KIND_RAW, _KIND_WIRE8, _KIND_QPOOL, _KIND_LATENT = 0, 1, 2, 3
 _HDR = struct.Struct("<4sBB")
 _DIMS = struct.Struct("<IIIIQ")
+_LATENT_FLAG_INT8 = 0x01
 
-WIRE_QUANTS = ("none", "int8")
+WIRE_QUANTS = ("none", "int8", "latent", "latent_int8")
+LATENT_QUANTS = ("latent", "latent_int8")
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -678,11 +687,170 @@ def _page_slots(page_ids: Sequence[int], page_size: int) -> np.ndarray:
     )
 
 
+class LatentCodec:
+    """Per-(layer, kv-head) rank-``r`` projection pairs for the latent
+    page codec (kind 3) — TPLA stage (a): K/V vectors project into a
+    low-rank latent on device before the host pull and reconstruct on
+    import, so every KV byte path (handoff wire, host tier, prefix
+    fetch, fleet mesh) moves ``r`` latent components instead of ``D``
+    head dims. Projections are ORTHONORMAL columns (decode is the
+    transpose-free einsum against the same matrix), derived by SVD over
+    a short activation calibration pass (``calibrate``) or loaded from a
+    checkpoint-shipped ``.npz`` (``load``). The codec is deterministic —
+    same weights + same calibration seed give bit-identical projections
+    — so a homogeneous fleet agrees on the basis without shipping it."""
+
+    def __init__(self, k_proj: np.ndarray, v_proj: np.ndarray):
+        k_proj = np.asarray(k_proj, dtype=np.float32)
+        v_proj = np.asarray(v_proj, dtype=np.float32)
+        if k_proj.shape != v_proj.shape or k_proj.ndim != 4:
+            raise ValueError(
+                f"latent projections must share one [L, KV, D, r] shape, "
+                f"got {k_proj.shape} / {v_proj.shape}"
+            )
+        self.k_proj = k_proj
+        self.v_proj = v_proj
+        self.rank = int(k_proj.shape[-1])
+        self.head_dim = int(k_proj.shape[-2])
+        if not 0 < self.rank <= self.head_dim:
+            raise ValueError(
+                f"latent rank must be in (0, head_dim={self.head_dim}], "
+                f"got {self.rank}"
+            )
+        self._device: Optional[tuple] = None
+
+    def device_projs(self) -> tuple:
+        """Lazily-cached device copies for on-device encode/reload."""
+        if self._device is None:
+            self._device = (jnp.asarray(self.k_proj),
+                            jnp.asarray(self.v_proj))
+        return self._device
+
+    @staticmethod
+    def _basis(samples: np.ndarray, rank: int) -> np.ndarray:
+        """Top-``rank`` right singular vectors of an [N, D] sample
+        matrix as a [D, rank] orthonormal basis, in a CANONICAL
+        orientation (largest-|component| of each column positive; SVD
+        sign ambiguity would otherwise let two hosts disagree). When
+        samples span fewer than ``rank`` directions the basis completes
+        deterministically via QR against the identity — no RNG."""
+        d = samples.shape[-1]
+        _, s, vt = np.linalg.svd(
+            samples.astype(np.float64), full_matrices=False
+        )
+        keep = min(rank, int(np.sum(s > 1e-10)))
+        basis = vt[:keep].T  # [D, keep]
+        if keep < rank:
+            q, _ = np.linalg.qr(
+                np.concatenate([basis, np.eye(d)], axis=1)
+            )
+            basis = q[:, :rank]
+        for j in range(basis.shape[1]):
+            col = basis[:, j]
+            if col[np.argmax(np.abs(col))] < 0:
+                basis[:, j] = -col
+        return np.ascontiguousarray(basis, dtype=np.float32)
+
+    @classmethod
+    def calibrate(cls, k_samples: np.ndarray, v_samples: np.ndarray,
+                  rank: int) -> "LatentCodec":
+        """Fit per-(layer, head) bases by SVD over calibration
+        activations ``[L, N, KV, D]`` (N sampled token positions)."""
+        k_samples = np.asarray(k_samples, dtype=np.float32)
+        v_samples = np.asarray(v_samples, dtype=np.float32)
+        if k_samples.ndim != 4 or k_samples.shape != v_samples.shape:
+            raise ValueError(
+                f"calibration samples must share one [L, N, KV, D] "
+                f"shape, got {k_samples.shape} / {v_samples.shape}"
+            )
+        num_layers, _, num_heads, head_dim = k_samples.shape
+        if not 0 < rank <= head_dim:
+            raise ValueError(
+                f"latent rank must be in (0, head_dim={head_dim}], "
+                f"got {rank}"
+            )
+        shape = (num_layers, num_heads, head_dim, rank)
+        k_proj = np.empty(shape, dtype=np.float32)
+        v_proj = np.empty(shape, dtype=np.float32)
+        for layer in range(num_layers):
+            for head in range(num_heads):
+                k_proj[layer, head] = cls._basis(
+                    k_samples[layer, :, head], rank)
+                v_proj[layer, head] = cls._basis(
+                    v_samples[layer, :, head], rank)
+        return cls(k_proj, v_proj)
+
+    @classmethod
+    def load(cls, path: str) -> "LatentCodec":
+        """Load checkpoint-shipped projections (``k_proj``/``v_proj``
+        arrays in an .npz) — the no-calibration path for models whose
+        config names a codec file."""
+        with np.load(path) as z:
+            return cls(z["k_proj"], z["v_proj"])
+
+    def save(self, path: str) -> None:
+        np.savez(path, k_proj=self.k_proj, v_proj=self.v_proj)
+
+    def encode_device(self, k: jnp.ndarray, v: jnp.ndarray) -> tuple:
+        """Project gathered K/V ``[L, S, KV, D]`` into latent codes
+        ``[L, S, KV, r]`` on device (f32 accumulate, f16 codes)."""
+        kp, vp = self.device_projs()
+        k_codes = jnp.einsum("lskd,lkdr->lskr", k.astype(jnp.float32), kp)
+        v_codes = jnp.einsum("lskd,lkdr->lskr", v.astype(jnp.float32), vp)
+        return k_codes, v_codes
+
+    def decode_host(self, k_codes: np.ndarray, v_codes: np.ndarray
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Reconstruct host-side latent codes ``[L, S, KV, r]`` back to
+        ``[L, S, KV, D]`` (f32 — the caller casts to the pool dtype)."""
+        k = np.einsum("lskr,lkdr->lskd",
+                      k_codes.astype(np.float32), self.k_proj)
+        v = np.einsum("lskr,lkdr->lskd",
+                      v_codes.astype(np.float32), self.v_proj)
+        return k, v
+
+    def decode_device(self, k_codes: jnp.ndarray, v_codes: jnp.ndarray
+                      ) -> tuple:
+        """Device-side reconstruction (host-tier reload: upload the
+        small codes, expand on device — fewer PCIe bytes)."""
+        kp, vp = self.device_projs()
+        k = jnp.einsum("lskr,lkdr->lskd", k_codes.astype(jnp.float32), kp)
+        v = jnp.einsum("lskr,lkdr->lskd", v_codes.astype(jnp.float32), vp)
+        return k, v
+
+
+def default_latent_rank(head_dim: int) -> int:
+    """Bench-default rank: a quarter of the head dim, floor 2 — the
+    point the rank sweep (BENCH_NOTES_r13.md) holds token identity on
+    the tiny model while beating int8 bytes ≥ 2×."""
+    return max(2, head_dim // 4)
+
+
+def encoded_page_fraction(wire_quant: str, itemsize: int, head_dim: int,
+                          rank: int = 0) -> float:
+    """Encoded bytes per page as a fraction of the raw pool bytes for
+    one wire encoding — the ONE place the cost model (FetchCosts/
+    plan_route, handoff election) learns what a page actually costs on
+    the wire. Per K/V vector: raw moves D·itemsize; int8 moves D codes
+    + one f32 scale; latent moves r f16 components; latent_int8 moves
+    r int8 codes + one f32 scale. QuantPool pools ship native int8
+    codes whatever the wire setting, so callers pass itemsize=1."""
+    raw = float(head_dim * itemsize)
+    if wire_quant == "int8":
+        return (head_dim + 4) / raw
+    if wire_quant == "latent":
+        return (2 * rank) / raw if rank else 1.0
+    if wire_quant == "latent_int8":
+        return (rank + 4) / raw if rank else 1.0
+    return 1.0
+
+
 def _encode_payload(kind: int, dtype_name: str, shape: Tuple[int, ...],
-                    token_count: int, buffers: Sequence[np.ndarray]) -> bytes:
+                    token_count: int, buffers: Sequence[np.ndarray],
+                    extra: bytes = b"") -> bytes:
     dname = dtype_name.encode("ascii")
     header = (_HDR.pack(_KV_MAGIC, kind, len(dname)) + dname
-              + _DIMS.pack(*shape, token_count))
+              + _DIMS.pack(*shape, token_count) + extra)
     # one allocation + one copy per buffer — the only host copies after
     # the device pull itself
     return b"".join([header] + [_raw_view(b) for b in buffers])
@@ -692,10 +860,16 @@ def payload_kind(pool, quant: str) -> int:
     """Payload layout for a K (or V) pool under optional quantization —
     the ONE definition of kind selection, shared by the disagg wire pull
     (``_pull_group``) and the engine's host-tier offload. Quantized
-    pools always move their native codes exactly; float pools move raw
-    values or per-vector int8 codes + scales when ``quant == "int8"``."""
+    pools always move their native codes exactly (a pass-through
+    DECISION: native int8 codes already round-trip exactly and beat a
+    lossy re-projection, so latent wire settings do not re-encode
+    them); float pools move raw values, per-vector int8 codes + scales
+    (``quant == "int8"``), or rank-r latent codes
+    (``quant in LATENT_QUANTS``)."""
     if isinstance(pool, QuantPool):
         return _KIND_QPOOL
+    if quant in LATENT_QUANTS:
+        return _KIND_LATENT
     return _KIND_WIRE8 if quant == "int8" else _KIND_RAW
 
 
@@ -704,11 +878,30 @@ def gather_kv_parts(quant: str, *args):
     (k, v[, k_scale, v_scale]) — pure and jittable (the engine jits it
     per offload bucket; the wire pull runs it eagerly), so payload
     ordering has exactly one definition for ``_scatter_payload`` and the
-    host tier to agree with. 5 args = a QuantPool's fields
-    (k_data, k_scale, v_data, v_scale, slots): native codes pass through
-    exactly. 3 args = float pools (k, v, slots), quantized per-vector
-    on device when ``quant == "int8"`` — halving (f32: quartering) the
-    bytes crossing the host boundary."""
+    host tier to agree with. Forms, dispatched on ``quant`` then arity:
+
+    - latent quant + 5 args = float pools with codec projections
+      (k, v, slots, k_proj, v_proj): pages project into rank-r latent
+      codes on device (f16, or int8 codes + f32 scales for
+      ``latent_int8``) BEFORE the host copy.
+    - 5 args otherwise = a QuantPool's fields (k_data, k_scale, v_data,
+      v_scale, slots): native codes pass through exactly — QuantPool
+      callers must normalize ``quant`` to "none" (``_pull_group`` and
+      the engine offload both branch on ``payload_kind`` first).
+    - 3 args = float pools (k, v, slots), quantized per-vector on
+      device when ``quant == "int8"``."""
+    if quant in LATENT_QUANTS and len(args) == 5:
+        k, v, slots, k_proj, v_proj = args
+        k_codes = jnp.einsum("lskd,lkdr->lskr",
+                             k[:, slots].astype(jnp.float32), k_proj)
+        v_codes = jnp.einsum("lskd,lkdr->lskr",
+                             v[:, slots].astype(jnp.float32), v_proj)
+        if quant == "latent_int8":
+            k_q, k_s = quantize_kv(k_codes)
+            v_q, v_s = quantize_kv(v_codes)
+            return k_q, v_q, k_s, v_s
+        return (k_codes.astype(jnp.float16),
+                v_codes.astype(jnp.float16))
     if len(args) == 5:
         kd, ks, vd, vs, slots = args
         return kd[:, slots], vd[:, slots], ks[:, slots], vs[:, slots]
@@ -729,16 +922,25 @@ def start_host_copies(arrs) -> None:
             copy_async()
 
 
-def _pull_group(state: PagedKVState, slots: np.ndarray, wire_quant: str):
+def _pull_group(state: PagedKVState, slots: np.ndarray, wire_quant: str,
+                codec: Optional[LatentCodec] = None):
     """Dispatch the device gather (and optional on-device wire
-    quantization) for one page group, then start its device→host copy
-    WITHOUT blocking — the double-buffering primitive. Returns
-    (kind, device arrays in payload order)."""
+    quantization or latent projection) for one page group, then start
+    its device→host copy WITHOUT blocking — the double-buffering
+    primitive. Returns (kind, device arrays in payload order)."""
     sl = jnp.asarray(slots)
     kind = payload_kind(state.k, wire_quant)
     if kind == _KIND_QPOOL:
-        arrs = gather_kv_parts(wire_quant, state.k.data, state.k.scale,
+        arrs = gather_kv_parts("none", state.k.data, state.k.scale,
                                state.v.data, state.v.scale, sl)
+    elif kind == _KIND_LATENT:
+        if codec is None:
+            raise ValueError(
+                f"wire_quant {wire_quant!r} needs a LatentCodec "
+                "(engine has no calibrated codec)"
+            )
+        kp, vp = codec.device_projs()
+        arrs = gather_kv_parts(wire_quant, state.k, state.v, sl, kp, vp)
     else:
         arrs = gather_kv_parts(wire_quant, state.k, state.v, sl)
     start_host_copies(arrs)
@@ -748,32 +950,43 @@ def _pull_group(state: PagedKVState, slots: np.ndarray, wire_quant: str):
 def _encode_group(state: PagedKVState, kind: int, arrs,
                   token_count: int) -> bytes:
     hosts = [np.asarray(a) for a in arrs]
+    extra = b""
     if kind == _KIND_WIRE8:
         dtype_name = str(state.k.dtype)
     elif kind == _KIND_QPOOL:
         dtype_name = "int8"
+    elif kind == _KIND_LATENT:
+        # dtype names the ORIGINAL pool dtype (restored on import); the
+        # dims' D slot carries the rank; flags bit0 = int8-over-latent
+        # (4 buffers: codes + per-vector scales)
+        dtype_name = str(state.k.dtype)
+        flags = _LATENT_FLAG_INT8 if len(hosts) == 4 else 0
+        extra = bytes([flags])
     else:
         dtype_name = str(hosts[0].dtype)
     return _encode_payload(kind, dtype_name, hosts[0].shape, token_count,
-                           hosts)
+                           hosts, extra)
 
 
 def serialize_kv(
     state: PagedKVState, page_ids: Sequence[int], page_size: int,
     token_count: int, wire_quant: str = "none",
+    codec: Optional[LatentCodec] = None,
 ) -> bytes:
     """Pull a sequence's K/V pages to host and pack them with metadata
     (single-payload form; the streamed form is serialize_kv_chunks).
     ``wire_quant="int8"`` quantizes float pools per-vector for the wire
-    (lossy — see docs/DISAGG.md); quantized pools always serialize their
-    native codes exactly."""
+    (lossy — see docs/DISAGG.md); ``"latent"``/``"latent_int8"``
+    project float pools into ``codec``'s rank-r latent (lossier, far
+    fewer bytes — docs/CACHING.md "Latent KV pages"); quantized pools
+    always serialize their native codes exactly."""
     if wire_quant not in WIRE_QUANTS:
         raise ValueError(
             f"unknown wire_quant {wire_quant!r}; known: "
             + "|".join(WIRE_QUANTS)
         )
     slots = _page_slots(page_ids, page_size)
-    kind, arrs = _pull_group(state, slots, wire_quant)
+    kind, arrs = _pull_group(state, slots, wire_quant, codec)
     return _encode_group(state, kind, arrs, token_count)
 
 
@@ -807,6 +1020,7 @@ def serialize_kv_chunks(
     wire_quant: str = "none",
     first_chunk_index: int = 0,
     first_page_index: int = 0,
+    codec: Optional[LatentCodec] = None,
 ) -> Iterator[KvChunk]:
     """Streamed serialize: split ``page_ids`` into ``chunk_pages``-page
     groups and yield one KvChunk per group, DOUBLE-BUFFERING the
@@ -830,13 +1044,13 @@ def serialize_kv_chunks(
     if not groups:
         return
     pending = _pull_group(state, _page_slots(groups[0], page_size),
-                          wire_quant)
+                          wire_quant, codec)
     for n, group in enumerate(groups):
         nxt = None
         if n + 1 < len(groups):
             # dispatch the NEXT group's pull before encoding this one
             nxt = _pull_group(state, _page_slots(groups[n + 1], page_size),
-                              wire_quant)
+                              wire_quant, codec)
         kind, arrs = pending
         payload = _encode_group(state, kind, arrs, 0)
         yield KvChunk(
@@ -857,6 +1071,7 @@ def deserialize_into_allocator(
     data: bytes,
     tokens: Sequence[int],
     page_size: int,
+    codec: Optional[LatentCodec] = None,
 ) -> Tuple[PagedKVState, List[int]]:
     """KV-handoff import primitive: allocate pages for ``tokens`` from a
     LIVE allocator, restore the serialized K/V into them, and content-
@@ -870,7 +1085,8 @@ def deserialize_into_allocator(
         raise CacheDeserializationError("cannot import an empty sequence")
     pages = allocator.allocate(-(-n // page_size))
     try:
-        new_state, token_count = deserialize_kv(state, data, pages, page_size)
+        new_state, token_count = deserialize_kv(state, data, pages, page_size,
+                                                codec)
         if token_count != n:
             raise CacheDeserializationError(
                 f"payload carries {token_count} tokens, expected {n}"
@@ -882,13 +1098,15 @@ def deserialize_into_allocator(
     return new_state, pages
 
 
-def _decode_payload(state: PagedKVState, data: bytes):
+def _decode_payload(state: PagedKVState, data: bytes,
+                    codec: Optional[LatentCodec] = None):
     """Parse one serialized payload into host arrays matched to the
     target pool's representation. Returns ``(token_count, parts)`` where
     parts is ``(k, v)`` for plain pools or ``(k, v, k_scale, v_scale)``
     for QuantPool targets. Wire-quantized (kind 1) payloads are
-    dequantized back to the target pool dtype here; all reads are
-    zero-copy views over ``data``."""
+    dequantized back to the target pool dtype here; latent (kind 3)
+    payloads reconstruct through ``codec``; all reads are zero-copy
+    views over ``data``."""
     quant = isinstance(state.k, QuantPool)
     try:
         magic, kind, dlen = _HDR.unpack_from(data, 0)
@@ -945,6 +1163,44 @@ def _decode_payload(state: PagedKVState, data: bytes):
                 take(np.float32, L * S * KV, (L, S, KV)),
                 take(np.float32, L * S * KV, (L, S, KV)),
             )
+        elif kind == _KIND_LATENT:
+            # injected latent-decode failure (docs/RESILIENCE.md): the
+            # import path wraps this into CacheDeserializationError and
+            # the caller degrades to recompute/decode-in-place exactly
+            # once, like any torn payload
+            _fault("kv.latent_decode")
+            if quant:
+                raise ValueError(
+                    "latent payload cannot restore into a quantized "
+                    "pool (pools quantize natively)"
+                )
+            if codec is None:
+                raise ValueError(
+                    "latent payload needs a LatentCodec (importing "
+                    "engine has no calibrated codec)"
+                )
+            # dims carry (L, S, KV, rank); one flags byte follows
+            rank = D
+            if rank != codec.rank:
+                raise ValueError(
+                    f"latent rank mismatch: payload rank {rank}, "
+                    f"codec rank {codec.rank}"
+                )
+            flags = data[off]
+            off += 1
+            if flags & _LATENT_FLAG_INT8:
+                k_q = take(np.int8, n, shape)
+                v_q = take(np.int8, n, shape)
+                k_s = take(np.float32, L * S * KV, (L, S, KV))
+                v_s = take(np.float32, L * S * KV, (L, S, KV))
+                k_codes = k_q.astype(np.float32) * k_s[..., None]
+                v_codes = v_q.astype(np.float32) * v_s[..., None]
+            else:
+                k_codes = take(np.float16, n, shape)
+                v_codes = take(np.float16, n, shape)
+            dt = _np_dtype(dtype_name)
+            k_rec, v_rec = codec.decode_host(k_codes, v_codes)
+            parts = (k_rec.astype(dt), v_rec.astype(dt))
         else:
             raise ValueError(f"unknown payload kind {kind}")
         if off != len(data):
@@ -984,11 +1240,12 @@ def _scatter_payload(state: PagedKVState, slots: np.ndarray, parts
 
 
 def deserialize_kv(
-    state: PagedKVState, data: bytes, page_ids: Sequence[int], page_size: int
+    state: PagedKVState, data: bytes, page_ids: Sequence[int],
+    page_size: int, codec: Optional[LatentCodec] = None,
 ) -> Tuple[PagedKVState, int]:
     """Restore serialized pages into freshly-allocated page ids. Returns the
     updated device state and the token count."""
-    token_count, parts = _decode_payload(state, data)
+    token_count, parts = _decode_payload(state, data, codec)
     slots = _page_slots(page_ids, page_size)
     if parts[0].shape[1] != len(slots):
         raise CacheDeserializationError(
@@ -1017,10 +1274,11 @@ class KvImportSession:
     the engine semantically unchanged."""
 
     def __init__(self, state: PagedKVState, allocator: "PageAllocator",
-                 page_size: int):
+                 page_size: int, codec: Optional[LatentCodec] = None):
         self._state = state  # representation reference (QuantPool or not)
         self._allocator = allocator
         self._ps = page_size
+        self._codec = codec  # latent (kind 3) reconstruction, if any
         self.pages: List[int] = []
         # index -> (page_start, page_count, decoded parts)
         self._parts: Dict[int, Tuple[int, int, tuple]] = {}
@@ -1064,7 +1322,7 @@ class KvImportSession:
                 f"chunk {chunk.index}: bad page range [{chunk.page_start}, "
                 f"{chunk.page_start + chunk.page_count})"
             )
-        _, parts = _decode_payload(self._state, chunk.payload)
+        _, parts = _decode_payload(self._state, chunk.payload, self._codec)
         if parts[0].shape[1] != chunk.page_count * self._ps:
             raise CacheDeserializationError(
                 f"chunk {chunk.index}: payload covers "

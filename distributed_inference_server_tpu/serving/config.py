@@ -152,8 +152,17 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "host_tier_bytes": (int, 0),
         # host-tier storage encoding for float pools: none | int8
         # (per-vector absmax codes + f32 scales — 4x smaller for f32
-        # pools, bounded accuracy cost like disagg.wire_quant)
+        # pools, bounded accuracy cost like disagg.wire_quant) |
+        # latent | latent_int8 (rank-r latent page codes, needs
+        # cache.latent_rank > 0 — docs/CACHING.md "Latent KV pages")
         "host_tier_quant": (str, "none"),
+        # latent page codec rank (TPLA stage (a); docs/CACHING.md
+        # "Latent KV pages"): per-(layer, kv-head) projection rank the
+        # engine calibrates at construction. 0 = no codec; required > 0
+        # by the latent/latent_int8 wire and tier encodings. Rule of
+        # thumb: head_dim/4 holds greedy token identity on the models
+        # benched so far at ~2.5× fewer bytes than int8.
+        "latent_rank": (int, 0),
         # chain depth of the published routing digest (first-K page
         # hashes per cached chain): the cache_aware cost model can only
         # score — and peer-fetch — matches it can see, so deep shared
@@ -190,7 +199,10 @@ _SCHEMA: Dict[str, Dict[str, Any]] = {
         "chunk_pages": (int, 8),
         # per-chunk wire encoding of float KV pools: none | int8
         # (per-vector absmax codes + f32 scales — halves-plus the bytes
-        # moved, bounded accuracy cost; quantized pools pass through)
+        # moved, bounded accuracy cost; quantized pools pass through) |
+        # latent | latent_int8 (rank-r latent page codes, needs
+        # cache.latent_rank > 0 — several-fold fewer bytes than int8,
+        # docs/CACHING.md "Latent KV pages")
         "wire_quant": (str, "none"),
     },
     "faults": {
@@ -759,7 +771,38 @@ class ServerConfig:
             # serving/fleet_kv.py): the fleet section owns it because
             # it prices the fleet wire, not the cache policy
             remote_page_cost=self.raw["fleet"]["kv_page_cost"],
+            wire_frac=self._wire_frac(),
         )
+
+    def _wire_frac(self) -> float:
+        """Encoded bytes-per-page fraction of the configured fetch wire
+        (kv_cache.encoded_page_fraction): the cost model charges what
+        the wire actually moves — int8 is ~3.2× fewer bytes than f32
+        raw, latent several-fold fewer still. Falls back to 1.0 when
+        the model geometry is not resolvable from the config (custom
+        checkpoint dirs) or the pool is natively quantized (QuantPool
+        codes pass through whatever the wire setting)."""
+        wq = self.raw["disagg"]["wire_quant"]
+        if wq == "none" or self.raw["engine"]["kv_quant"] != "none":
+            return 1.0
+        try:
+            from distributed_inference_server_tpu.engine.kv_cache import (
+                encoded_page_fraction,
+            )
+            from distributed_inference_server_tpu.models.configs import (
+                get_config,
+            )
+
+            head_dim = get_config(self.raw["model"]["model_name"]).head_dim
+            itemsize = {"float32": 4, "bfloat16": 2,
+                        "float16": 2}[self.raw["model"]["dtype"]]
+            return encoded_page_fraction(
+                wq, itemsize, head_dim, self.raw["cache"]["latent_rank"]
+            )
+        except Exception as e:  # noqa: BLE001 — cost scaling is best-effort
+            logger.debug("wire_frac: cannot resolve model geometry for "
+                         "%r (%s); charging raw pages", wq, e)
+            return 1.0
 
     # -- validation --------------------------------------------------------
 
@@ -849,9 +892,10 @@ class ServerConfig:
             )
         if r["disagg"]["chunk_pages"] <= 0:
             raise ConfigError("disagg.chunk_pages must be positive")
-        if r["disagg"]["wire_quant"] not in ("none", "int8"):
+        if r["disagg"]["wire_quant"] not in ("none", "int8", "latent",
+                                             "latent_int8"):
             raise ConfigError(
-                f"disagg.wire_quant must be none/int8, "
+                f"disagg.wire_quant must be none/int8/latent/latent_int8, "
                 f"got {r['disagg']['wire_quant']!r}"
             )
         if r["server"]["max_redispatch"] < 0:
@@ -876,11 +920,23 @@ class ServerConfig:
                 raise ConfigError(f"faults.spec: {e}") from None
         if r["cache"]["host_tier_bytes"] < 0:
             raise ConfigError("cache.host_tier_bytes must be >= 0")
-        if r["cache"]["host_tier_quant"] not in ("none", "int8"):
+        if r["cache"]["host_tier_quant"] not in ("none", "int8", "latent",
+                                                 "latent_int8"):
             raise ConfigError(
-                f"cache.host_tier_quant must be none/int8, "
-                f"got {r['cache']['host_tier_quant']!r}"
+                f"cache.host_tier_quant must be none/int8/latent/"
+                f"latent_int8, got {r['cache']['host_tier_quant']!r}"
             )
+        if r["cache"]["latent_rank"] < 0:
+            raise ConfigError("cache.latent_rank must be >= 0")
+        if r["cache"]["latent_rank"] == 0:
+            for key, section in (("disagg.wire_quant", r["disagg"]["wire_quant"]),
+                                 ("cache.host_tier_quant",
+                                  r["cache"]["host_tier_quant"])):
+                if section in ("latent", "latent_int8"):
+                    raise ConfigError(
+                        f"{key}={section!r} needs cache.latent_rank > 0 "
+                        "(the engine has no codec to encode with)"
+                    )
         if r["cache"]["digest_depth"] <= 0:
             raise ConfigError("cache.digest_depth must be positive")
         if r["cache"]["fetch_min_pages"] < 1:

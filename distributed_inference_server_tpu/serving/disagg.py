@@ -85,8 +85,11 @@ class DisaggSettings:
     chunk_pages: int = 8  # pages per KvChunk
     # per-chunk wire encoding of float pools: "int8" halves-plus the
     # bytes moved (per-vector absmax codes + f32 scales) at a bounded
-    # accuracy cost; natively quantized pools pass through unchanged
-    wire_quant: str = "none"  # none | int8
+    # accuracy cost; "latent"/"latent_int8" project pages into a rank-r
+    # latent (docs/CACHING.md "Latent KV pages") for a further shrink,
+    # degrading to "none" on engines without a codec; natively quantized
+    # pools pass through unchanged
+    wire_quant: str = "none"  # none | int8 | latent | latent_int8
 
 
 def parse_roles(spec: str, num_engines: int,

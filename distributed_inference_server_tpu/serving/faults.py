@@ -49,6 +49,12 @@ Point catalog (the authoritative list lives in docs/RESILIENCE.md):
 ``kv.peer_fetch``       peer-to-peer prefix fetch dies on the wire (one
                         hit per chunk — ``nth`` drops the Nth chunk);
                         the request falls back to recompute
+``kv.latent_decode``    latent payload reconstruction fails on import
+                        (kind-3 decode in ``kv_cache._decode_payload``)
+                        — the session aborts like any validation
+                        failure and the consumer degrades exactly once
+                        (handoff to decode-in-place, fetch to
+                        recompute), zero page leak
 ``sched.health_flap``   flag: the health loop sees a healthy engine as
                         down for one sweep (restart of a live replica)
 ``sched.fetch_decision``  flag: force the cache_aware cost model to pick

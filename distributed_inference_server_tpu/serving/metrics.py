@@ -81,6 +81,10 @@ class EngineStatus:
     # host-tier prefix cache occupancy (engine.host_tier_stats()); None
     # when the tier is off
     host_tier: Any = None
+    # latent page codec (engine.latent_stats(); docs/CACHING.md "Latent
+    # KV pages"): rank / encoded_bytes / saved_bytes — None when no
+    # codec is calibrated
+    latent: Any = None
     # ragged mixed-batch stepping (engine.mixed_stats(); docs/PERF.md):
     # steps / prefill_tokens / decode_tokens / batch_density /
     # prefill_frac — None when engine.mixed_step_tokens is 0
@@ -124,6 +128,8 @@ class EngineStatus:
             d["speculation"] = self.speculation
         if self.host_tier is not None:
             d["host_tier"] = self.host_tier
+        if self.latent is not None:
+            d["latent"] = self.latent
         if self.mixed is not None:
             d["mixed"] = self.mixed
         if self.loop is not None:
@@ -305,6 +311,18 @@ class MetricsCollector:
             "kv_host_tier_pages",
             "Pages resident in the host-RAM prefix-cache tier",
             ["engine_id"], registry=r,
+        )
+        # latent page codec (docs/CACHING.md "Latent KV pages"):
+        # serialized KV payload bytes by encoding kind across all four
+        # KV paths (disagg handoff, host-tier offload, peer prefix
+        # fetch, fleet KV data plane)
+        self.kv_payload_bytes = Counter(
+            "kv_payload_bytes_total",
+            "Serialized KV payload bytes moved, by encoding kind (raw | "
+            "int8 | qpool | latent | latent_int8), across handoff, "
+            "host-tier offload, prefix fetch, and the fleet KV data "
+            "plane",
+            ["kind"], registry=r,
         )
         # ragged mixed-batch stepping (engine/engine.py _mixed_step;
         # docs/PERF.md): tokens consumed by mixed dispatches per kind,
@@ -664,6 +682,7 @@ class MetricsCollector:
         self._fetch_sum = 0.0
         self._fetch_count = 0
         self._prefix_routes: Dict[str, int] = {}
+        self._payload_bytes: Dict[str, int] = {}
         self._handoffs: Dict[str, int] = {}
         self._handoff_bytes = 0
         self._handoff_chunks = 0
@@ -816,6 +835,17 @@ class MetricsCollector:
         """Host-tier occupancy gauges for one engine replica."""
         self.host_tier_bytes_g.labels(engine_id=engine_id).set(nbytes)
         self.host_tier_pages_g.labels(engine_id=engine_id).set(pages)
+
+    def record_kv_payload(self, deltas: Dict[str, int]) -> None:
+        """Serialized KV payload byte deltas by encoding kind since the
+        last report (runner, engine.payload_byte_counters())."""
+        with self._lock:
+            for kind, n in deltas.items():
+                if n > 0:
+                    self.kv_payload_bytes.labels(kind=kind).inc(n)
+                    self._payload_bytes[kind] = (
+                        self._payload_bytes.get(kind, 0) + n
+                    )
 
     def record_mixed_step(self, prefill_tokens: int = 0,
                           decode_tokens: int = 0) -> None:
@@ -1324,6 +1354,20 @@ class MetricsCollector:
                 },
                 "route_decisions": dict(self._prefix_routes),
             }
+            if self._payload_bytes:
+                cache["payload_bytes"] = dict(self._payload_bytes)
+            # latent page codec (docs/CACHING.md "Latent KV pages"):
+            # rank + bytes saved vs raw, summed over replicas that
+            # carry a calibrated codec
+            latents = [s.latent for s in engine_statuses if s.latent]
+            if latents:
+                cache["latent"] = {
+                    "rank": latents[0]["rank"],
+                    "encoded_bytes": sum(
+                        b["encoded_bytes"] for b in latents
+                    ),
+                    "saved_bytes": sum(b["saved_bytes"] for b in latents),
+                }
             resilience = None
             if (self._engine_restarts or self._redispatches
                     or self._requests_expired or self._requests_shed

@@ -183,6 +183,9 @@ class EngineRunner:
         # mixed-step counter watermarks (engine.mixed_stats() reports
         # totals; the collector wants deltas)
         self._mixed_seen = {"prefill_tokens": 0, "decode_tokens": 0}
+        # payload-byte watermarks (engine.payload_byte_counters()
+        # reports totals by encoding kind; the collector wants deltas)
+        self._payload_seen: Dict[str, int] = {}
         # looped-block counter watermarks (engine.loop_stats() reports
         # totals; the collector wants deltas — same shape as the mixed
         # block)
@@ -886,6 +889,7 @@ class EngineRunner:
                 self._mixed_seen = {"prefill_tokens": 0,
                                     "decode_tokens": 0}
                 self._loop_seen = {"steps": 0, "exits": {}}
+                self._payload_seen = {}
                 self._sc_seen = {"kinds": {}, "events": {}}
                 if on_done:
                     on_done(True, None)
@@ -942,7 +946,7 @@ class EngineRunner:
         eng = self._engine
         used = total = cached = page_size = digest_depth = 0
         waiting = 0
-        speculation = host_tier = mixed = loop = None
+        speculation = host_tier = mixed = loop = latent = None
         if eng is not None:
             try:
                 s = eng.cache_stats()
@@ -960,6 +964,7 @@ class EngineRunner:
                 digest_depth = eng.ecfg.digest_depth
                 waiting = eng.num_waiting()
                 host_tier = eng.host_tier_stats()
+                latent = eng.latent_stats()
                 mixed = eng.mixed_stats()
                 loop = eng.loop_stats()
                 speculation = eng.spec_stats()
@@ -982,6 +987,7 @@ class EngineRunner:
             page_size=page_size,
             digest_depth=digest_depth,
             host_tier=host_tier,
+            latent=latent,
             mixed=mixed,
             loop=loop,
         )
@@ -1032,7 +1038,13 @@ class EngineRunner:
                     # fetch) would stay blind to a drained replica's
                     # freshly warmed cache
                     self._refresh_digest(force=not self._engine.has_work())
-                worked |= self._drain_handoffs()
+                if self._drain_handoffs():
+                    # a handoff export moves payload bytes without a
+                    # step — flush the per-kind byte counters now, or
+                    # an otherwise-idle prefill replica's export never
+                    # reaches kv_payload_bytes_total
+                    self._report_cache_deltas()
+                    worked = True
                 worked |= self._step_draining()
                 worked |= self._embed_quantum()
                 if not worked:
@@ -1189,6 +1201,7 @@ class EngineRunner:
         try:
             s = self._engine.cache_stats()
             host = self._engine.host_tier_stats()
+            payload = self._engine.payload_byte_counters()
             reloads = self._engine.drain_reload_durations()
             mixed = self._engine.mixed_stats()
             loop = self._engine.loop_stats()
@@ -1223,6 +1236,15 @@ class EngineRunner:
                                                exits=d_exits)
             self._loop_seen = {"steps": loop["steps"],
                                "exits": dict(loop["exits"])}
+        # payload bytes by encoding kind (kv_payload_bytes_total): the
+        # engine reports totals, the collector wants deltas
+        payload_deltas = {
+            kind: max(0, n - self._payload_seen.get(kind, 0))
+            for kind, n in payload.items()
+        }
+        if any(payload_deltas.values()):
+            self.metrics.record_kv_payload(payload_deltas)
+        self._payload_seen = dict(payload)
         seen = self._cache_seen
         hits = max(0, s.hits - seen["hits"])
         self.metrics.record_cache(

@@ -122,6 +122,16 @@ class FetchCosts:
     # the recompute/queueing gap exceeds the pricier wire term
     # (serving/fleet_kv.py; docs/FLEET.md "KV data plane")
     remote_page_cost: float = 0.6
+    # encoded bytes-per-page as a fraction of raw pool bytes for the
+    # configured wire encoding (kv_cache.encoded_page_fraction): the
+    # wire term must charge what actually crosses the wire — an int8
+    # wire already moves 3.2× fewer bytes than f32 raw and a latent
+    # wire several-fold fewer still, so pricing every encoding at raw
+    # pages systematically under-fetches. Scales BOTH the learned
+    # (bytes/s-derived) and prior per-page costs: the learned rate is
+    # raw wire throughput, so fewer bytes per page means
+    # proportionally less wire time per page.
+    wire_frac: float = 1.0
 
 
 @dataclass(frozen=True)
@@ -257,6 +267,11 @@ def plan_route(
                         if wire_cost is not None else None)
             if per_page is None:
                 per_page = peer_page_cost
+            # charge ENCODED bytes per page: the configured wire
+            # encoding (int8/latent) moves a fraction of the raw
+            # bytes, and the fetch term must price that fraction or
+            # the model under-fetches on every compressed wire
+            per_page *= costs.wire_frac
             options.append((
                 base + (n_pages - peer_depth)
                 + per_page * peer_depth,
@@ -620,7 +635,9 @@ class AdaptiveScheduler:
                     per_page = self.wire_cost(s, None)
                     if per_page is None:
                         per_page = costs.remote_page_cost
-                    wire_pages = per_page * pages
+                    # the handoff wire ships encoded pages too — the
+                    # election charges encoded bytes, like plan_route
+                    wire_pages = per_page * costs.wire_frac * pages
                 return (health_rank(getattr(s, "health", "healthy")),
                         costs.load_cost_pages
                         * (s.active_requests + s.waiting_requests)

@@ -1549,6 +1549,35 @@ def f(cfg: ServerConfig):
     assert "fleet.kv_page_costs" in out[0].message
 
 
+def test_dl012_latent_keys_checked():
+    """The latent page codec knob (config ``cache.latent_rank``,
+    ISSUE 20) and the extended quant values: a correct get (and the env
+    spelling) is clean, a typo'd key flags against the schema."""
+    out = pcheck("DL012", {
+        _CONFIG_FIXTURE: """
+_SCHEMA = {
+    "cache": {"latent_rank": (int, 0), "host_tier_quant": (str, "none")},
+    "disagg": {"wire_quant": (str, "none")},
+}
+class ServerConfig:
+    def get(self, section, key):
+        return None
+""",
+        f"{PKG}/serving/x.py": f"""
+import os
+from {PKG.replace('/', '.')}.serving.config import ServerConfig
+def f(cfg: ServerConfig):
+    ok = cfg.get("cache", "latent_rank")
+    wq = cfg.get("disagg", "wire_quant")
+    env = os.environ.get("DIS_TPU_CACHE__LATENT_RANK")
+    bad = cfg.get("cache", "latent_rankz")
+    return ok, wq, env, bad
+""",
+    })
+    assert len(out) == 1
+    assert "cache.latent_rankz" in out[0].message
+
+
 # ---------------------------------------------------------------------------
 # interprocedural infrastructure: targets, cache, CLI
 # ---------------------------------------------------------------------------

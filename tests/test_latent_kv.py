@@ -1,0 +1,650 @@
+"""Latent-KV compression (ISSUE 20; TPLA stage (a), docs/CACHING.md
+"Latent KV pages"): the rank-r latent page codec behind the
+``"latent"``/``"latent_int8"`` wire/tier encodings.
+
+Covers, bottom-up:
+
+- codec unit behavior: calibration shapes/orthonormality, deterministic
+  recalibration, bounded round-trip reconstruction error across
+  ranks × dtypes × page counts, byte-shrink vs int8, the QuantPool
+  pass-through DECISION (native codes ship unchanged whatever the wire
+  setting), and the rejection matrix (missing codec, rank mismatch);
+- the encoded bytes-per-page cost-model fix (ISSUE 20 satellite): the
+  `FetchCosts.wire_frac` regression proving int8 alone flips a
+  ``plan_route`` fetch decision that raw-page pricing would route warm;
+- token-identity e2e on all four KV paths — disagg handoff, host-tier
+  reload, peer prefix fetch, and the mesh wire (KvChunk protowire
+  frames) — each with zero-leak ``audit_pages()`` teardowns;
+- the ``kv.latent_decode`` fault point: a latent decode failure aborts
+  the import like any validation failure, exactly once, zero page leak
+  (DL011/DL018 coverage).
+
+Deterministic seeded random throughout (no hypothesis in the image)."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_inference_server_tpu.core.errors import (
+    CacheDeserializationError,
+)
+from distributed_inference_server_tpu.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distributed_inference_server_tpu.engine.kv_cache import (
+    _KIND_LATENT,
+    _KIND_QPOOL,
+    KvImportSession,
+    LatentCodec,
+    PageAllocator,
+    PagedCacheConfig,
+    PagedKVState,
+    WIRE_QUANTS,
+    chain_hashes,
+    default_latent_rank,
+    deserialize_kv,
+    encoded_page_fraction,
+    payload_kind,
+    serialize_kv,
+    serialize_kv_chunks,
+)
+from distributed_inference_server_tpu.models import llama
+from distributed_inference_server_tpu.models.configs import TINY
+from distributed_inference_server_tpu.models.tokenizer import ByteTokenizer
+from distributed_inference_server_tpu.serving import faults, protowire
+from distributed_inference_server_tpu.serving.faults import parse_spec
+from distributed_inference_server_tpu.serving.metrics import EngineStatus
+from distributed_inference_server_tpu.serving.scheduler import (
+    FetchCosts,
+    plan_route,
+)
+
+TOK = ByteTokenizer()
+PS = 4
+D = TINY.head_dim  # 16 on the tiny fixture
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
+
+
+def make_engine(tiny_params, latent_rank=4, num_pages=64, **over):
+    return LLMEngine(
+        tiny_params, TINY, TOK,
+        EngineConfig(
+            max_batch=4,
+            prefill_buckets=(8, 64),
+            paged=PagedCacheConfig(
+                num_pages=num_pages, page_size=PS, max_pages_per_seq=16
+            ),
+            latent_rank=latent_rank,
+            native_allocator=False,
+            **over,
+        ),
+        dtype=jnp.float32,
+    )
+
+
+def run_one(engine, rid, prompt, max_tokens=6):
+    engine.add_request(rid, prompt, SamplingParams(max_tokens=max_tokens,
+                                                   temperature=0.0))
+    tokens = []
+    for _ in range(500):
+        if not engine.has_work():
+            break
+        for out in engine.step():
+            assert out.error is None, out.error
+            if out.token_id is not None:
+                tokens.append(out.token_id)
+    assert not engine.has_work()
+    return tokens
+
+
+PREFIX = list(range(40, 60))  # 5 full pages at PS=4
+PROMPT = PREFIX + [7, 8]
+HASHES = chain_hashes(PROMPT, PS, max_pages=(len(PROMPT) - 1) // PS)
+
+
+def _latent_state(rng, cfg, rank, dtype=jnp.float32):
+    """A float pool whose content lies in a rank-``rank`` subspace per
+    (layer, kv-head) — what a trained model's K/V activations look like
+    to the codec — plus the codec calibrated on that content."""
+    state = PagedKVState.create(TINY, cfg, dtype=dtype)
+    L, S, KV, d = state.k.shape
+    basis_k = rng.standard_normal((L, KV, d, rank))
+    basis_v = rng.standard_normal((L, KV, d, rank))
+    k = np.einsum("lskr,lkdr->lskd", rng.standard_normal((L, S, KV, rank)),
+                  basis_k)
+    v = np.einsum("lskr,lkdr->lskd", rng.standard_normal((L, S, KV, rank)),
+                  basis_v)
+    state.k = jnp.asarray(k, dtype=dtype)
+    state.v = jnp.asarray(v, dtype=dtype)
+    codec = LatentCodec.calibrate(k, v, rank)
+    return state, codec
+
+
+def _with_totals(chunks):
+    return [dataclasses.replace(c, total=len(chunks)) for c in chunks]
+
+
+# ---------------------------------------------------------------------------
+# Codec unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestCodecUnit:
+    def test_calibrate_shapes_and_orthonormal(self):
+        rng = np.random.default_rng(1)
+        L, N, KV, rank = TINY.num_layers, 24, TINY.num_kv_heads, 4
+        k = rng.standard_normal((L, N, KV, D))
+        v = rng.standard_normal((L, N, KV, D))
+        codec = LatentCodec.calibrate(k, v, rank)
+        assert codec.rank == rank and codec.head_dim == D
+        assert codec.k_proj.shape == (L, KV, D, rank)
+        for proj in (codec.k_proj, codec.v_proj):
+            gram = np.einsum("lkdr,lkds->lkrs", proj, proj)
+            np.testing.assert_allclose(
+                gram, np.broadcast_to(np.eye(rank), gram.shape), atol=1e-6)
+
+    def test_calibration_is_deterministic(self):
+        rng = np.random.default_rng(2)
+        k = rng.standard_normal((2, 16, 2, D))
+        v = rng.standard_normal((2, 16, 2, D))
+        a = LatentCodec.calibrate(k, v, 4)
+        b = LatentCodec.calibrate(k.copy(), v.copy(), 4)
+        assert np.array_equal(a.k_proj, b.k_proj)
+        assert np.array_equal(a.v_proj, b.v_proj)
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("rank", [2, 4, 8])
+    @pytest.mark.parametrize("n_pages", [1, 3, 5])
+    def test_roundtrip_error_bounded(self, dtype, rank, n_pages):
+        """Content in the codec's span reconstructs within the code
+        dtype's precision across ranks × pool dtypes × page counts (the
+        tolerance harness of the acceptance criteria)."""
+        cfg = PagedCacheConfig(num_pages=16, page_size=PS,
+                               max_pages_per_seq=8)
+        state, codec = _latent_state(np.random.default_rng(rank), cfg,
+                                     rank, dtype)
+        pages = list(range(2, 2 + n_pages))
+        blob = serialize_kv(state, pages, PS, n_pages * PS,
+                            wire_quant="latent", codec=codec)
+        fresh = PagedKVState.create(TINY, cfg, dtype=dtype)
+        restored, _ = deserialize_kv(fresh, blob, pages, PS, codec=codec)
+        slots = np.concatenate(
+            [np.arange(p * PS, (p + 1) * PS) for p in pages])
+        orig = np.asarray(state.k[:, slots], dtype=np.float32)
+        got = np.asarray(restored.k[:, slots], dtype=np.float32)
+        # f16 latent codes: relative error ~1e-3; bf16 pools are the
+        # looser of pool-write and code precision (~1%)
+        tol = 0.02 if dtype == jnp.bfloat16 else 2e-3
+        scale = np.abs(orig).max() + 1e-6
+        assert np.abs(got - orig).max() <= tol * scale
+
+    def test_latent_int8_roundtrip_bounded(self):
+        cfg = PagedCacheConfig(num_pages=16, page_size=PS,
+                               max_pages_per_seq=8)
+        state, codec = _latent_state(np.random.default_rng(7), cfg, 4)
+        blob = serialize_kv(state, [1, 2], PS, 8,
+                            wire_quant="latent_int8", codec=codec)
+        fresh = PagedKVState.create(TINY, cfg, dtype=jnp.float32)
+        restored, _ = deserialize_kv(fresh, blob, [1, 2], PS, codec=codec)
+        slots = np.arange(PS, 3 * PS)
+        orig = np.asarray(state.k[:, slots])
+        got = np.asarray(restored.k[:, slots])
+        # int8 over the codes: ~1/127 relative per latent coordinate
+        scale = np.abs(orig).max() + 1e-6
+        assert np.abs(got - orig).max() <= 0.05 * scale
+
+    def test_latent_bytes_beat_int8_by_2x(self):
+        """The acceptance byte math at the bench-default rank: latent
+        moves ≥2× fewer payload bytes than int8 on the same pages."""
+        cfg = PagedCacheConfig(num_pages=16, page_size=PS,
+                               max_pages_per_seq=8)
+        state, codec = _latent_state(np.random.default_rng(3), cfg,
+                                     default_latent_rank(D))
+        pages = [0, 1, 2, 3]
+        int8 = serialize_kv(state, pages, PS, 16, wire_quant="int8")
+        latent = serialize_kv(state, pages, PS, 16, wire_quant="latent",
+                              codec=codec)
+        latent8 = serialize_kv(state, pages, PS, 16,
+                               wire_quant="latent_int8", codec=codec)
+        assert len(int8) >= 2 * len(latent)
+        # at rank r the int8-over-codes form costs r+4 bytes per vector
+        # vs 2r for f16 codes: a tie at r=4, a strict win past it
+        assert len(latent8) <= len(latent)
+        state8, codec8 = _latent_state(np.random.default_rng(4), cfg, 8)
+        wide = serialize_kv(state8, pages, PS, 16, wire_quant="latent",
+                            codec=codec8)
+        wide8 = serialize_kv(state8, pages, PS, 16,
+                             wire_quant="latent_int8", codec=codec8)
+        assert len(wide8) < len(wide)
+
+    def test_encoded_page_fraction_math(self):
+        # TINY f32: D=16, itemsize=4 → raw vector 64B
+        assert encoded_page_fraction("none", 4, D) == 1.0
+        assert encoded_page_fraction("int8", 4, D) == pytest.approx(0.3125)
+        assert encoded_page_fraction("latent", 4, D, 4) == pytest.approx(
+            0.125)
+        assert encoded_page_fraction("latent_int8", 4, D,
+                                     4) == pytest.approx(0.125)
+        r = default_latent_rank(D)
+        assert encoded_page_fraction("latent", 4, D, r) <= \
+            encoded_page_fraction("int8", 4, D) / 2
+
+    def test_default_latent_rank(self):
+        assert default_latent_rank(16) == 4
+        assert default_latent_rank(128) == 32
+        assert default_latent_rank(4) == 2  # floor
+
+    def test_quantpool_pass_through_decision(self):
+        """DECISION: natively quantized pools ship their exact codes
+        whatever the wire setting — latent never re-encodes a QuantPool
+        (re-projecting int8 codes would compound two lossy steps)."""
+        cfg = PagedCacheConfig(num_pages=16, page_size=PS,
+                               max_pages_per_seq=8)
+        state = PagedKVState.create(TINY, cfg, dtype=jnp.float32,
+                                    kv_quant="int8")
+        assert payload_kind(state.k, "latent") == _KIND_QPOOL
+        assert payload_kind(state.k, "latent_int8") == _KIND_QPOOL
+        blob = serialize_kv(state, [0, 1], PS, 8, wire_quant="latent")
+        fresh = PagedKVState.create(TINY, cfg, dtype=jnp.float32,
+                                    kv_quant="int8")
+        restored, _ = deserialize_kv(fresh, blob, [0, 1], PS)
+        slots = np.arange(2 * PS)
+        np.testing.assert_array_equal(
+            np.asarray(restored.k.data[:, slots]),
+            np.asarray(state.k.data[:, slots]))
+        np.testing.assert_array_equal(
+            np.asarray(restored.k.scale[:, slots]),
+            np.asarray(state.k.scale[:, slots]))
+
+    def test_missing_codec_rejected(self):
+        cfg = PagedCacheConfig(num_pages=16, page_size=PS,
+                               max_pages_per_seq=8)
+        state, codec = _latent_state(np.random.default_rng(5), cfg, 4)
+        with pytest.raises(ValueError, match="codec"):
+            serialize_kv(state, [0], PS, 4, wire_quant="latent")
+        blob = serialize_kv(state, [0], PS, 4, wire_quant="latent",
+                            codec=codec)
+        fresh = PagedKVState.create(TINY, cfg, dtype=jnp.float32)
+        with pytest.raises(CacheDeserializationError, match="LatentCodec"):
+            deserialize_kv(fresh, blob, [0], PS)
+
+    def test_rank_mismatch_rejected(self):
+        cfg = PagedCacheConfig(num_pages=16, page_size=PS,
+                               max_pages_per_seq=8)
+        rng = np.random.default_rng(6)
+        state, codec4 = _latent_state(rng, cfg, 4)
+        blob = serialize_kv(state, [0], PS, 4, wire_quant="latent",
+                            codec=codec4)
+        k = rng.standard_normal((TINY.num_layers, 16, TINY.num_kv_heads, D))
+        codec8 = LatentCodec.calibrate(k, k, 8)
+        fresh = PagedKVState.create(TINY, cfg, dtype=jnp.float32)
+        with pytest.raises(CacheDeserializationError, match="rank"):
+            deserialize_kv(fresh, blob, [0], PS, codec=codec8)
+
+    def test_latent_into_quantpool_rejected(self):
+        cfg = PagedCacheConfig(num_pages=16, page_size=PS,
+                               max_pages_per_seq=8)
+        state, codec = _latent_state(np.random.default_rng(8), cfg, 4)
+        blob = serialize_kv(state, [0], PS, 4, wire_quant="latent",
+                            codec=codec)
+        qpool = PagedKVState.create(TINY, cfg, dtype=jnp.float32,
+                                    kv_quant="int8")
+        with pytest.raises(CacheDeserializationError):
+            deserialize_kv(qpool, blob, [0], PS, codec=codec)
+
+    def test_wire_quants_extended(self):
+        assert WIRE_QUANTS == ("none", "int8", "latent", "latent_int8")
+
+
+# ---------------------------------------------------------------------------
+# Cost model: encoded bytes-per-page (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _status(eid, active=0, digest=None):
+    return EngineStatus(
+        engine_id=eid, healthy=True, active_requests=active,
+        waiting_requests=0, total_processed=0, memory_used_pages=0,
+        memory_total_pages=100, prefix_digest=digest, page_size=PS,
+        digest_depth=8,
+    )
+
+
+RPROMPT = list(range(33))  # 8 full pages + 1
+RHASHES = chain_hashes(RPROMPT, PS, max_pages=8)
+
+
+class TestWireFracCostModel:
+    def test_int8_alone_flips_a_fetch_decision(self):
+        """REGRESSION (the pre-existing inaccuracy): the wire term used
+        to charge raw pages whatever the encoding, though an int8 wire
+        moves 3.2× fewer bytes (BENCH_NOTES_r09.md). Scaling by the
+        encoded fraction flips this borderline decision from warm to
+        fetch with nothing else changed."""
+        statuses = [
+            _status("warm", active=1, digest=frozenset(RHASHES)),
+            _status("cold"),
+        ]
+        # gain 8 pages, load differential 1 request = 4.0 pages of
+        # queueing: raw wire 1.5*8 = 12 > 4 → stay warm; int8 wire
+        # 1.5*0.3125*8 = 3.75 < 4 → fetch pays
+        base = dict(min_pages=2, page_cost=1.5, load_cost_pages=4.0)
+        raw = plan_route(statuses, RHASHES,
+                         costs=FetchCosts(**base, wire_frac=1.0))
+        assert raw.decision == "warm"
+        int8_frac = encoded_page_fraction("int8", 4, D)
+        quant = plan_route(statuses, RHASHES,
+                           costs=FetchCosts(**base, wire_frac=int8_frac))
+        assert quant.decision == "fetch"
+        assert (quant.engine_id, quant.peer_id) == ("cold", "warm")
+
+    def test_latent_wire_cheaper_still(self):
+        """At the default latent rank the same decision flips at an
+        even higher page_cost — the latent wire is the cheapest."""
+        statuses = [
+            _status("warm", active=1, digest=frozenset(RHASHES)),
+            _status("cold"),
+        ]
+        frac = encoded_page_fraction("latent", 4, D, default_latent_rank(D))
+        base = dict(min_pages=2, page_cost=3.5, load_cost_pages=4.0)
+        assert plan_route(
+            statuses, RHASHES,
+            costs=FetchCosts(**base, wire_frac=encoded_page_fraction(
+                "int8", 4, D))).decision == "warm"
+        assert plan_route(
+            statuses, RHASHES,
+            costs=FetchCosts(**base, wire_frac=frac)).decision == "fetch"
+
+
+# ---------------------------------------------------------------------------
+# Engine e2e: token identity on all four KV paths
+# ---------------------------------------------------------------------------
+
+
+class TestEngineE2E:
+    @pytest.mark.parametrize("wire_quant", ["latent", "latent_int8"])
+    def test_handoff_token_identity_and_bytes(self, tiny_params,
+                                              wire_quant):
+        """Path 1 (disagg handoff): a latent-wire migrated sequence
+        decodes token-identically to the never-migrated reference, and
+        the export moves ≥2× fewer bytes than the int8 wire."""
+        sp = SamplingParams(max_tokens=8, temperature=0.0)
+        ref = make_engine(tiny_params)
+        want = run_one(ref, "ref", PROMPT, max_tokens=8)
+
+        src = make_engine(tiny_params)
+        src.add_request("r", PROMPT, sp, prefill_only=True)
+        got = []
+        while src.has_work() and not src.handoff_ready_ids():
+            for o in src.step():
+                assert o.error is None, o.error
+                if o.token_id is not None:
+                    got.append(o.token_id)
+        exp = src.export_handoff("r", wire_quant=wire_quant)
+        assert exp is not None and exp.wire_quant == wire_quant
+
+        # byte comparison against an identical int8 export (the codec
+        # calibration is deterministic, so src2 is bit-equivalent)
+        src2 = make_engine(tiny_params)
+        src2.add_request("r", PROMPT, sp, prefill_only=True)
+        while src2.has_work() and not src2.handoff_ready_ids():
+            src2.step()
+        exp8 = src2.export_handoff("r", wire_quant="int8")
+        assert len(exp8.kv) >= 2 * len(exp.kv)
+
+        dst = make_engine(tiny_params)
+        dst.import_sequence(exp)
+        while dst.has_work():
+            for o in dst.step():
+                assert o.error is None, o.error
+                if o.token_id is not None:
+                    got.append(o.token_id)
+        assert got == want
+        assert src.audit_pages() == [] and dst.audit_pages() == []
+        # byte accounting reached the counters and the stats block
+        label = wire_quant
+        assert src.payload_byte_counters()[label] == len(exp.kv)
+        stats = src.latent_stats()
+        assert stats["rank"] == 4 and stats["saved_bytes"] > 0
+
+    def test_host_tier_reload_token_identity(self, tiny_params):
+        """Path 2 (host-tier reload): a prefix demoted to the host tier
+        in latent encoding re-seats on device token-identically."""
+        cold = make_engine(tiny_params)
+        want = run_one(cold, "cold", PROMPT)
+
+        warm = make_engine(tiny_params, num_pages=10,
+                           host_tier_bytes=1 << 22,
+                           host_tier_quant="latent")
+        run_one(warm, "warm", PROMPT)
+        rng = np.random.default_rng(3)
+        for i in range(8):  # cycle the 10-page pool: the prefix demotes
+            run_one(warm, f"churn{i}",
+                    rng.integers(100, 200, size=7).tolist(), max_tokens=2)
+        warm.host_tier.flush()
+        assert warm.host_tier_stats()["pages"] > 0
+        assert run_one(warm, "probe", PROMPT) == want
+        assert warm.audit_pages() == []
+        # stored latent pages are smaller, so the byte budget holds
+        # more of them than raw would
+        assert warm.payload_byte_counters()["latent"] > 0
+
+    def test_peer_fetch_token_identity(self, tiny_params):
+        """Path 3 (peer prefix fetch): a latent-wire fetched prefix
+        seats and decodes token-identically on the cold replica."""
+        cold = make_engine(tiny_params)
+        want = run_one(cold, "cold", PROMPT)
+
+        warm = make_engine(tiny_params)
+        run_one(warm, "warm", PROMPT)
+        depth, chunks = warm.export_prefix_chunks(
+            HASHES, chunk_pages=2, wire_quant="latent")
+        assert depth == len(HASHES)
+        d8, chunks8 = warm.export_prefix_chunks(
+            HASHES, chunk_pages=2, wire_quant="int8")
+        assert sum(len(c.payload) for c in chunks8) >= \
+            2 * sum(len(c.payload) for c in chunks)
+
+        target = make_engine(tiny_params)
+        seated = target.import_prefix(PROMPT[: depth * PS], chunks)
+        assert seated == depth
+        assert run_one(target, "probe", PROMPT) == want
+        assert target.audit_pages() == [] and warm.audit_pages() == []
+
+    def test_mesh_fetch_token_identity(self, tiny_params):
+        """Path 4 (fleet/mesh wire): latent chunks are self-describing
+        through the protowire KvChunk framing both data channels use
+        (serving/fleet_kv.py, fleet_mesh.py) — no schema change, DL005
+        untouched — and seat token-identically after the wire."""
+        cold = make_engine(tiny_params)
+        want = run_one(cold, "cold", PROMPT)
+        warm = make_engine(tiny_params)
+        run_one(warm, "warm", PROMPT)
+        depth, chunks = warm.export_prefix_chunks(
+            HASHES, chunk_pages=2, wire_quant="latent")
+
+        from distributed_inference_server_tpu.engine.kv_cache import KvChunk
+        wired = []
+        for c in chunks:
+            d = protowire.decode("KvChunk", protowire.encode("KvChunk", {
+                "handoff_id": "mesh", "index": c.index, "total": c.total,
+                "page_start": c.page_start, "page_count": c.page_count,
+                "crc32": c.crc32, "payload": c.payload,
+            }))
+            wired.append(KvChunk(index=d["index"], total=d["total"],
+                                 page_start=d["page_start"],
+                                 page_count=d["page_count"],
+                                 payload=d["payload"], crc32=d["crc32"]))
+        random.Random(11).shuffle(wired)  # transports may reorder
+        target = make_engine(tiny_params)
+        target.import_prefix(PROMPT[: depth * PS], wired)
+        assert run_one(target, "probe", PROMPT) == want
+        assert target.audit_pages() == []
+
+    def test_quantpool_engine_gates_codec_off(self, tiny_params):
+        """A natively quantized engine never calibrates a codec (like
+        the host tier, the latent encode targets float pools only) and
+        its exports pass native codes through."""
+        eng = make_engine(tiny_params, kv_quant="int8")
+        assert eng.latent_codec is None and eng.latent_stats() is None
+        want = run_one(eng, "a", PROMPT)
+        src = make_engine(tiny_params, kv_quant="int8")
+        src.add_request("r", PROMPT,
+                        SamplingParams(max_tokens=6, temperature=0.0),
+                        prefill_only=True)
+        while src.has_work() and not src.handoff_ready_ids():
+            src.step()
+        exp = src.export_handoff("r", wire_quant="latent")
+        dst = make_engine(tiny_params, kv_quant="int8")
+        got = []
+        dst.import_sequence(exp)
+        while dst.has_work():
+            for o in dst.step():
+                if o.token_id is not None:
+                    got.append(o.token_id)
+        assert got == want[-len(got):]
+        assert src.audit_pages() == [] and dst.audit_pages() == []
+
+    def test_no_codec_degrades_to_raw_wire(self, tiny_params):
+        """latent requested on an engine with latent_rank=0: the export
+        degrades to the raw wire (one warning) instead of failing —
+        mixed fleets where only some replicas carry a codec keep
+        moving KV."""
+        src = make_engine(tiny_params, latent_rank=0)
+        assert src.latent_codec is None
+        src.add_request("r", PROMPT,
+                        SamplingParams(max_tokens=6, temperature=0.0),
+                        prefill_only=True)
+        while src.has_work() and not src.handoff_ready_ids():
+            src.step()
+        exp = src.export_handoff("r", wire_quant="latent")
+        assert exp is not None and exp.wire_quant == "none"
+        dst = make_engine(tiny_params, latent_rank=0)
+        dst.import_sequence(exp)  # raw payload needs no codec
+        assert src.audit_pages() == []
+
+
+# ---------------------------------------------------------------------------
+# Fault point kv.latent_decode (DL011/DL018)
+# ---------------------------------------------------------------------------
+
+
+class TestLatentDecodeFault:
+    def test_decode_fault_degrades_exactly_once(self, tiny_params):
+        """An armed ``kv.latent_decode:nth=1`` aborts the import like
+        any chunk-validation failure — every reserved page released,
+        audit clean — and the NEXT import (the retry after the
+        exactly-once degrade) succeeds token-identically."""
+        cold = make_engine(tiny_params)
+        want = run_one(cold, "cold", PROMPT)
+        warm = make_engine(tiny_params)
+        run_one(warm, "warm", PROMPT)
+        depth, chunks = warm.export_prefix_chunks(
+            HASHES, chunk_pages=2, wire_quant="latent")
+
+        target = make_engine(tiny_params)
+        free0 = target.allocator.num_free()
+        faults.install(parse_spec("kv.latent_decode:nth=1", seed=7))
+        with pytest.raises(CacheDeserializationError):
+            target.import_prefix(PROMPT[: depth * PS], chunks)
+        assert target.allocator.num_free() == free0  # zero page leak
+        assert target.audit_pages() == []
+        # the nth=1 rule is one-shot: the degrade happened exactly once
+        # and the retry goes through on the SAME armed registry
+        seated = target.import_prefix(PROMPT[: depth * PS], chunks)
+        assert seated == depth
+        assert run_one(target, "probe", PROMPT) == want
+        assert target.audit_pages() == []
+
+
+# ---------------------------------------------------------------------------
+# Chunked latent wire: session-level reorder / truncation / crc
+# ---------------------------------------------------------------------------
+
+
+class TestLatentChunkValidation:
+    def _chunks(self, rank=4, wire_quant="latent"):
+        cfg = PagedCacheConfig(num_pages=16, page_size=PS,
+                               max_pages_per_seq=8)
+        state, codec = _latent_state(np.random.default_rng(9), cfg, rank)
+        pages = [3, 7, 1, 4]
+        chunks = _with_totals(list(serialize_kv_chunks(
+            state, pages, PS, chunk_pages=1, wire_quant=wire_quant,
+            codec=codec)))
+        return cfg, state, codec, pages, chunks
+
+    @pytest.mark.parametrize("wire_quant", ["latent", "latent_int8"])
+    def test_reorder_seats_identically(self, wire_quant):
+        cfg, state, codec, pages, chunks = self._chunks(
+            wire_quant=wire_quant)
+        fresh = PagedKVState.create(TINY, cfg, dtype=jnp.float32)
+        sess = KvImportSession(fresh, PageAllocator(cfg), PS, codec=codec)
+        sess.reserve(len(pages))
+        for c in reversed(chunks):
+            sess.add_chunk(c)
+        restored, got = sess.finish(fresh, list(range(len(pages) * PS)))
+        slots = np.concatenate(
+            [np.arange(p * PS, (p + 1) * PS) for p in got])
+        src = np.concatenate(
+            [np.arange(p * PS, (p + 1) * PS) for p in pages])
+        err = np.abs(np.asarray(restored.k[:, slots])
+                     - np.asarray(state.k[:, src]))
+        assert err.max() <= 0.05 * (np.abs(np.asarray(state.k)).max())
+
+    def test_truncated_and_corrupt_chunks_release_everything(self):
+        import zlib
+
+        cfg, state, codec, pages, chunks = self._chunks()
+
+        def rejects(bad):
+            fresh = PagedKVState.create(TINY, cfg, dtype=jnp.float32)
+            alloc = PageAllocator(cfg)
+            free0 = alloc.num_free()
+            sess = KvImportSession(fresh, alloc, PS, codec=codec)
+            sess.reserve(len(pages))
+            with pytest.raises(CacheDeserializationError):
+                for c in bad:
+                    sess.add_chunk(c)
+                sess.finish(fresh, list(range(len(pages) * PS)))
+            sess.abort()
+            assert alloc.num_free() == free0
+
+        rejects(chunks[:-1])  # stream truncation: a chunk never lands
+        rejects([dataclasses.replace(chunks[0],
+                                     crc32=chunks[0].crc32 ^ 1)]
+                + chunks[1:])  # torn payload
+        cut = chunks[0].payload[: len(chunks[0].payload) // 2]
+        rejects([dataclasses.replace(chunks[0], payload=cut,
+                                     crc32=zlib.crc32(cut) & 0xFFFFFFFF)]
+                + chunks[1:])  # short payload with a VALID crc
+        rejects([chunks[0]] + chunks)  # duplicate index
+
+    def test_codecless_session_rejects_kind3(self):
+        cfg, state, codec, pages, chunks = self._chunks()
+        fresh = PagedKVState.create(TINY, cfg, dtype=jnp.float32)
+        alloc = PageAllocator(cfg)
+        free0 = alloc.num_free()
+        sess = KvImportSession(fresh, alloc, PS)  # no codec
+        sess.reserve(len(pages))
+        with pytest.raises(CacheDeserializationError, match="LatentCodec"):
+            sess.add_chunk(chunks[0])
+        sess.abort()
+        assert alloc.num_free() == free0

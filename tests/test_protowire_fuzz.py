@@ -470,3 +470,94 @@ def test_kv_intro_decode_fills_proto3_defaults():
         "KvIntro", protowire.encode("KvIntro", {"member_id": "m1"}))
     assert got == {"member_id": "m1", "host": "", "data_port": 0,
                    "max_streams": 0, "gone": False, "epoch": 0}
+
+
+def test_latent_kind3_chunk_wire_fuzz():
+    """Kind-3 (latent) payloads ride the SAME self-describing KvChunk
+    frame (ISSUE 20 — no proto schema change, DL005 untouched): real
+    latent chunks round-trip protowire field-for-field in any order, a
+    truncated frame fails to decode, and a payload truncated *with a
+    recomputed crc* still rejects at the import session (the kind-3
+    buffer-length check), releasing every reserved page."""
+    import dataclasses
+    import zlib
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_inference_server_tpu.core.errors import (
+        CacheDeserializationError,
+    )
+    from distributed_inference_server_tpu.engine.kv_cache import (
+        KvImportSession,
+        LatentCodec,
+        PageAllocator,
+        PagedCacheConfig,
+        PagedKVState,
+        serialize_kv_chunks,
+    )
+    from distributed_inference_server_tpu.models.configs import TINY
+
+    cfg = PagedCacheConfig(num_pages=16, page_size=4, max_pages_per_seq=8)
+    state = PagedKVState.create(TINY, cfg, dtype=jnp.float32)
+    nprng = np.random.default_rng(0x7A13)
+    k = nprng.standard_normal(state.k.shape).astype(np.float32)
+    v = nprng.standard_normal(state.v.shape).astype(np.float32)
+    state.k, state.v = jnp.asarray(k), jnp.asarray(v)
+    codec = LatentCodec.calibrate(k, v, rank=4)
+
+    rng = random.Random(0x7A14)
+    for wire_quant in ("latent", "latent_int8"):
+        pages = rng.sample(range(16), 4)
+        chunks = list(serialize_kv_chunks(state, pages, cfg.page_size,
+                                          chunk_pages=1,
+                                          wire_quant=wire_quant,
+                                          codec=codec))
+        chunks = [dataclasses.replace(c, total=len(chunks))
+                  for c in chunks]
+        # protowire round-trip, arbitrary arrival order
+        wired = []
+        for c in chunks:
+            d = protowire.decode("KvChunk", protowire.encode("KvChunk", {
+                "handoff_id": "h", "index": c.index, "total": c.total,
+                "page_start": c.page_start, "page_count": c.page_count,
+                "crc32": c.crc32, "payload": c.payload,
+            }))
+            assert chunk_crc(d["payload"]) == d["crc32"]
+            wired.append(KvChunk(index=d["index"], total=d["total"],
+                                 page_start=d["page_start"],
+                                 page_count=d["page_count"],
+                                 payload=d["payload"], crc32=d["crc32"]))
+        rng.shuffle(wired)
+        fresh = PagedKVState.create(TINY, cfg, dtype=jnp.float32)
+        alloc = PageAllocator(cfg)
+        sess = KvImportSession(fresh, alloc, cfg.page_size, codec=codec)
+        sess.reserve(len(pages))
+        for c in wired:
+            sess.add_chunk(c)
+        restored, _ = sess.finish(fresh, list(range(len(pages) * 4)))
+
+        # a frame cut mid-payload never decodes
+        frame = protowire.encode("KvChunk", {
+            "handoff_id": "h", "index": 0, "total": len(chunks),
+            "page_start": 0, "page_count": 1,
+            "crc32": chunks[0].crc32, "payload": chunks[0].payload,
+        })
+        with pytest.raises(ValueError):
+            protowire.decode("KvChunk", frame[: len(frame) // 2])
+
+        # truncated payload with a RECOMPUTED crc: survives the wire,
+        # rejects at the kind-3 decode, zero pages leaked
+        cut = chunks[0].payload[: len(chunks[0].payload) - 8]
+        bad = dataclasses.replace(chunks[0], payload=cut,
+                                  crc32=zlib.crc32(cut) & 0xFFFFFFFF)
+        alloc2 = PageAllocator(cfg)
+        free0 = alloc2.num_free()
+        sess2 = KvImportSession(PagedKVState.create(TINY, cfg,
+                                                    dtype=jnp.float32),
+                                alloc2, cfg.page_size, codec=codec)
+        sess2.reserve(len(pages))
+        with pytest.raises(CacheDeserializationError):
+            sess2.add_chunk(bad)
+        sess2.abort()
+        assert alloc2.num_free() == free0

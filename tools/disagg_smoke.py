@@ -11,6 +11,10 @@ process, in seconds, with the tiny-llama fixture. Exit 0 = healthy.
     JAX_PLATFORMS=cpu python tools/disagg_smoke.py --channel protowire
     JAX_PLATFORMS=cpu python tools/disagg_smoke.py --channel protowire \
         --wire-quant int8          # streamed chunks, int8 on the wire
+    JAX_PLATFORMS=cpu python tools/disagg_smoke.py --channel protowire \
+        --wire-quant latent_int8 --check-tokens  # latent codec leg:
+        # asserts the measured encoded fraction beats int8 >= 2x AND the
+        # streamed text matches a unified (never-handed-off) reference
     JAX_PLATFORMS=cpu python tools/disagg_smoke.py --no-stream  # monolithic
 
 ``--bench`` runs the BENCH_NOTES r06/r07 scenario instead: a long and a
@@ -52,12 +56,15 @@ def build_server(channel: str, wire_quant: str = "none", stream: bool = True,
 
     params = llama.init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.float32)
     paged = PagedCacheConfig(num_pages=256, page_size=8, max_pages_per_seq=64)
+    # latent wire encodings need a calibrated codec on both engines
+    # (docs/CACHING.md "Latent KV pages"); rank 4 is the tiny default
+    latent_rank = 4 if wire_quant in ("latent", "latent_int8") else 0
 
     def factory():
         return LLMEngine(
             params, TINY, ByteTokenizer(),
             EngineConfig(max_batch=4, prefill_buckets=(16, 64), paged=paged,
-                         warmup_compile=warmup),
+                         warmup_compile=warmup, latent_rank=latent_rank),
             dtype=jnp.float32,
         )
 
@@ -103,7 +110,27 @@ async def _stream_request(session, base, prompt, max_tokens):
     return events, stamps
 
 
-async def drive(server, max_tokens: int) -> int:
+_PROMPT = "disaggregate me, streamingly"
+
+
+async def _collect_text(server, max_tokens: int) -> str:
+    """Streamed completion text for _PROMPT — the never-handed-off
+    reference for the latent leg's token-identity check."""
+    import aiohttp
+
+    runner, base = await _serve(server)
+    try:
+        async with aiohttp.ClientSession() as session:
+            events, _ = await _stream_request(session, base, _PROMPT,
+                                              max_tokens)
+            return "".join(e["token"] for e in events
+                           if e["type"] == "token")
+    finally:
+        await runner.cleanup()
+
+
+async def drive(server, max_tokens: int, want_text=None,
+                latent: bool = False) -> int:
     import aiohttp
 
     runner, base = await _serve(server)
@@ -111,12 +138,17 @@ async def drive(server, max_tokens: int) -> int:
         async with aiohttp.ClientSession() as session:
             t0 = time.monotonic()
             events, _ = await _stream_request(
-                session, base, "disaggregate me, streamingly", max_tokens)
+                session, base, _PROMPT, max_tokens)
             tokens = [e for e in events if e["type"] == "token"]
             done = [e for e in events if e["type"] == "done"]
             assert tokens, "no tokens streamed"
             assert len(done) == 1, f"expected one done event, got {events}"
             assert done[0]["usage"]["completion_tokens"] <= max_tokens
+            if want_text is not None:
+                text = "".join(t["token"] for t in tokens)
+                assert text == want_text, (
+                    "handed-off tokens diverged from the unified "
+                    f"reference:\n  got  {text!r}\n  want {want_text!r}")
 
             async with session.get(f"{base}/server/stats") as resp:
                 stats = await resp.json()
@@ -125,6 +157,28 @@ async def drive(server, max_tokens: int) -> int:
         roles = {w["engine_id"]: w["role"] for w in stats["worker_statuses"]}
         assert roles == {"engine-0": "prefill", "engine-1": "decode"}, roles
         assert ok >= 1, f"no successful handoff recorded: {disagg}"
+        if latent:
+            # bytes must shrink >= 2x vs what int8 would have moved for
+            # the SAME pages: measured encoded fraction (engine-reported
+            # encoded vs raw-equivalent bytes) against the analytic int8
+            # per-page fraction (kv_cache.encoded_page_fraction)
+            from distributed_inference_server_tpu.engine.kv_cache import (
+                encoded_page_fraction,
+            )
+            from distributed_inference_server_tpu.models.configs import TINY
+
+            lat = (stats.get("cache") or {}).get("latent") or {}
+            enc = lat.get("encoded_bytes", 0)
+            saved = lat.get("saved_bytes", 0)
+            assert enc > 0, f"no latent-encoded payload recorded: {lat}"
+            frac = enc / (enc + saved)
+            int8_frac = encoded_page_fraction("int8", 4, TINY.head_dim)
+            assert 2 * frac <= int8_frac * 1.05, (
+                f"latent wire did not beat int8 2x: measured fraction "
+                f"{frac:.4f} vs int8 {int8_frac:.4f}")
+            print(f"latent: rank {lat.get('rank')}, {enc} encoded bytes, "
+                  f"{saved} saved ({frac:.3f} of raw vs int8 "
+                  f"{int8_frac:.3f})")
         print(
             f"OK: {len(tokens)} tokens streamed in "
             f"{time.monotonic() - t0:.2f}s; roles {roles}; "
@@ -221,8 +275,13 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--channel", default="inproc",
                     choices=["inproc", "protowire"])
-    ap.add_argument("--wire-quant", default="none", choices=["none", "int8"],
+    ap.add_argument("--wire-quant", default="none",
+                    choices=["none", "int8", "latent", "latent_int8"],
                     help="per-chunk wire encoding of the KV payload")
+    ap.add_argument("--check-tokens", action="store_true",
+                    help="first run a unified (never-handed-off) reference "
+                         "server and assert the handed-off stream decodes "
+                         "the identical text")
     ap.add_argument("--no-stream", action="store_true",
                     help="force the monolithic (stop-the-world) export")
     ap.add_argument("--max-tokens", type=int, default=48)
@@ -235,11 +294,22 @@ def main() -> int:
         return asyncio.run(bench_scenario(
             args.channel, args.wire_quant, not args.no_stream,
             args.long_tokens, args.max_tokens))
+    want_text = None
+    if args.check_tokens:
+        ref = build_server("inproc", "none", stream=True,
+                           roles=("unified", "unified"))
+        ref.start()
+        try:
+            want_text = asyncio.run(_collect_text(ref, args.max_tokens))
+        finally:
+            ref.shutdown(drain_timeout_s=5.0)
     server = build_server(args.channel, args.wire_quant,
                           stream=not args.no_stream)
     server.start()
     try:
-        return asyncio.run(drive(server, args.max_tokens))
+        return asyncio.run(drive(
+            server, args.max_tokens, want_text=want_text,
+            latent=args.wire_quant in ("latent", "latent_int8")))
     finally:
         server.shutdown(drain_timeout_s=5.0)
 

@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--wire-quant", default="int8",
-                    choices=("none", "int8"))
+                    choices=("none", "int8", "latent", "latent_int8"))
     ap.add_argument("--channel", default="protowire",
                     choices=("inproc", "protowire"))
     args = ap.parse_args()
@@ -46,9 +46,13 @@ def main() -> int:
         DisaggSettings,
     )
 
+    latent = args.wire_quant in ("latent", "latent_int8")
     srv = chaos_fleet.build_fleet(
         strategy="cache_aware", channel=args.channel,
-        engine_kwargs={"native_allocator": False},
+        # latent wire legs calibrate the rank-4 page codec on every
+        # replica (docs/CACHING.md "Latent KV pages")
+        engine_kwargs={"native_allocator": False,
+                       "latent_rank": 4 if latent else 0},
     )
     # the fetcher reuses the disagg channel settings; re-point it at the
     # requested wire quant (build_fleet's settings default to "none")
@@ -91,6 +95,28 @@ def main() -> int:
             failures.append("no fetch bytes recorded")
         if routes.get("fetch", 0) < 1:
             failures.append(f"no fetch route decision recorded: {routes}")
+        if latent:
+            # bytes must shrink >= 2x vs the int8 wire for the same
+            # pages: measured encoded fraction (engine-reported) against
+            # the analytic int8 per-page fraction
+            from distributed_inference_server_tpu.engine.kv_cache import (
+                encoded_page_fraction,
+            )
+            from distributed_inference_server_tpu.models.configs import TINY
+
+            lat = snap["cache"].get("latent") or {}
+            enc = lat.get("encoded_bytes", 0)
+            saved = lat.get("saved_bytes", 0)
+            int8_frac = encoded_page_fraction("int8", 4, TINY.head_dim)
+            if enc <= 0:
+                failures.append(f"no latent-encoded payload recorded: {lat}")
+            elif 2 * enc / (enc + saved) > int8_frac * 1.05:
+                failures.append(
+                    f"latent wire did not beat int8 2x: fraction "
+                    f"{enc / (enc + saved):.4f} vs int8 {int8_frac:.4f}")
+            else:
+                print(f"latent: rank {lat.get('rank')}, {enc} encoded "
+                      f"bytes, {saved} saved")
         failures.extend(chaos_fleet.check_invariants(
             srv, sinks, require_success=True))
     finally:
